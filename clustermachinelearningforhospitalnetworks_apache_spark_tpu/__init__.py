@@ -1,0 +1,72 @@
+"""TPU-native hospital-network ML framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+``alexv879/ClusterMachineLearningForHospitalNetworks-Apache-Spark``
+(a PySpark Structured-Streaming + MLlib pipeline): streaming CSV ingest
+with event-time watermarking into a checkpointed unbounded table, windowed
+training-set extraction, feature assembly/scaling, distributed training of
+regression/classification/clustering estimators over a TPU device mesh,
+RMSE/accuracy/silhouette evaluation, diagnostic reporting, and model
+persistence — with Spark's JVM machinery (Catalyst, treeAggregate,
+Structured Streaming, Netty RPC) replaced by sharded ``jax.Array`` tables,
+jit'd estimator loops, and XLA collectives over ICI/DCN.
+
+See ``SURVEY.md`` for the full reference analysis and layer mapping.
+"""
+
+from .version import __version__
+from .config import MeshConfig, PipelineConfig
+from .core import (
+    FEATURE_COLS,
+    LABEL_COL,
+    Field,
+    Schema,
+    Table,
+    hospital_event_schema,
+    random_split,
+    train_test_split,
+)
+from .features import (
+    Binarizer,
+    StandardScaler,
+    StringIndexer,
+    VectorAssembler,
+)
+from .evaluation import (
+    ClusteringEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from .parallel import build_mesh, default_mesh, device_dataset, use_mesh
+from .io import load_model, read_csv, read_csv_dir, write_csv
+from . import models
+
+__all__ = [
+    "__version__",
+    "MeshConfig",
+    "PipelineConfig",
+    "FEATURE_COLS",
+    "LABEL_COL",
+    "Field",
+    "Schema",
+    "Table",
+    "hospital_event_schema",
+    "random_split",
+    "train_test_split",
+    "Binarizer",
+    "StandardScaler",
+    "StringIndexer",
+    "VectorAssembler",
+    "ClusteringEvaluator",
+    "MulticlassClassificationEvaluator",
+    "RegressionEvaluator",
+    "build_mesh",
+    "default_mesh",
+    "device_dataset",
+    "use_mesh",
+    "load_model",
+    "read_csv",
+    "read_csv_dir",
+    "write_csv",
+    "models",
+]

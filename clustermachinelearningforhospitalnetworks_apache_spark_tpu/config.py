@@ -1,0 +1,146 @@
+"""Configuration system.
+
+The reference keeps all pipeline configuration in a single flat ``CONFIG``
+dict (``mllearnforhospitalnetwork.py:40-50``) with nine keys: appName,
+hdfsInputPath, checkpointLocation, outputTable, trainingWindowStart,
+trainingWindowEnd, hdfsMaster, modelSavePath, losThreshold.  Here the same
+surface is a frozen dataclass, loadable from JSON or CLI flags, with the
+TPU-native additions (mesh shape instead of a Spark master URL, watermark
+and split constants that the reference hard-codes inline at ``:81`` and
+``:139``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Shape of the device mesh the pipeline trains over.
+
+    Replaces the reference's ``hdfsMaster: spark://master-node-address:7077``
+    (``mllearnforhospitalnetwork.py:47``): instead of naming a cluster
+    scheduler, we name the mesh axes XLA partitions over.
+
+    ``data`` is the row/batch axis (Spark's executor data parallelism);
+    ``model`` shards the feature/centroid axis for large-k clustering (the
+    classical-ML analogue of tensor parallelism, SURVEY.md §2C).  ``-1`` on
+    the data axis means "all remaining devices".
+    """
+
+    data: int = -1
+    model: int = 1
+    # Multi-host: when >1 the data axis is split (hosts, chips/host) and the
+    # host sub-axis rides DCN while the chip sub-axis rides ICI.
+    dcn_hosts: int = 1
+
+    def axis_names(self) -> tuple[str, ...]:
+        return ("data", "model")
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """TPU-native mirror of the reference CONFIG dict.
+
+    Key-for-key parity with ``mllearnforhospitalnetwork.py:40-50``; paths are
+    plain filesystem paths (local/NFS/objstore) instead of ``hdfs://`` URIs.
+    """
+
+    app_name: str = "HospitalResourceDemandPrediction"        # :41 appName
+    input_path: str = "./data/hospitals/incoming"             # :42 hdfsInputPath
+    checkpoint_location: str = "./data/checkpoints/hospital"  # :43 checkpointLocation
+    output_table: str = "hospital_unbounded_table"            # :44 outputTable
+    training_window_start: str = "2025-03-31 22:00:00"        # :45
+    training_window_end: str = "2025-03-31 23:00:00"          # :46
+    model_save_path: str = "./data/models/hospital"           # :48 modelSavePath
+    los_threshold: float = 5.0                                # :49 losThreshold
+
+    # Constants the reference hard-codes inline rather than in CONFIG:
+    watermark_minutes: float = 10.0       # withWatermark("event_time","10 minutes") :81
+    train_fraction: float = 0.7           # randomSplit([0.7, 0.3], seed=42) :139,:180
+    split_seed: int = 42
+
+    # TPU-native replacement for :47 hdfsMaster:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    # Output directory for diagnostic plots (the reference blocks on
+    # plt.show() at :215,:223 — we write PNGs instead; SURVEY.md D6).
+    plot_dir: str = "./data/plots"
+
+    # ------------------------------------------------------------------
+    def replace(self, **kw: Any) -> "PipelineConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "PipelineConfig":
+        d = dict(d)
+        if "mesh" in d and isinstance(d["mesh"], Mapping):
+            d["mesh"] = MeshConfig(**d["mesh"])
+        # Accept the reference's camelCase key spelling too, for drop-in use.
+        aliases = {
+            "appName": "app_name",
+            "hdfsInputPath": "input_path",
+            "checkpointLocation": "checkpoint_location",
+            "outputTable": "output_table",
+            "trainingWindowStart": "training_window_start",
+            "trainingWindowEnd": "training_window_end",
+            "modelSavePath": "model_save_path",
+            "losThreshold": "los_threshold",
+        }
+        for old, new in aliases.items():
+            if old in d:
+                d[new] = d.pop(old)
+        d.pop("hdfsMaster", None)  # superseded by mesh
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_json(cls, path: str) -> "PipelineConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def save_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+    @classmethod
+    def from_flags(cls, argv: Sequence[str] | None = None) -> "PipelineConfig":
+        """CLI flag loader: ``--key=value`` for every dataclass field."""
+        import argparse
+
+        p = argparse.ArgumentParser(description="hospital-tpu pipeline config")
+        p.add_argument("--config", help="JSON config file", default=None)
+        for f in dataclasses.fields(cls):
+            if f.name == "mesh":
+                p.add_argument("--mesh-data", type=int, default=None)
+                p.add_argument("--mesh-model", type=int, default=None)
+                continue
+            p.add_argument(
+                "--" + f.name.replace("_", "-"),
+                type=type(f.default) if f.default is not None else str,
+                default=None,
+            )
+        ns = p.parse_args(argv)
+        base = cls.from_json(ns.config) if ns.config else cls()
+        over = {
+            k: v
+            for k, v in vars(ns).items()
+            if v is not None and k not in ("config", "mesh_data", "mesh_model")
+        }
+        cfg = base.replace(**over) if over else base
+        if ns.mesh_data is not None or ns.mesh_model is not None:
+            cfg = cfg.replace(
+                mesh=MeshConfig(
+                    data=ns.mesh_data if ns.mesh_data is not None else cfg.mesh.data,
+                    model=ns.mesh_model if ns.mesh_model is not None else cfg.mesh.model,
+                )
+            )
+        return cfg

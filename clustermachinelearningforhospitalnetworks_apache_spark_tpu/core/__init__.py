@@ -1,0 +1,29 @@
+from .schema import (
+    FEATURE_COLS,
+    FLOAT,
+    INT,
+    LABEL_COL,
+    STRING,
+    TIMESTAMP,
+    Field,
+    Schema,
+    hospital_event_schema,
+)
+from .table import Table
+from .split import random_split, split_indices, train_test_split
+
+__all__ = [
+    "FEATURE_COLS",
+    "FLOAT",
+    "INT",
+    "LABEL_COL",
+    "STRING",
+    "TIMESTAMP",
+    "Field",
+    "Schema",
+    "hospital_event_schema",
+    "Table",
+    "random_split",
+    "split_indices",
+    "train_test_split",
+]

@@ -1,0 +1,122 @@
+"""Schema types.
+
+Mirrors the capability of ``pyspark.sql.types.StructType`` used by the
+reference at ``mllearnforhospitalnetwork.py:64-72`` to type its 7-field CSV
+stream.  Columns are host-side numpy-typed; numeric columns are the only
+ones that ever reach the TPU (strings/timestamps stay on the host, exactly
+as Spark keeps them out of MLlib's vector path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+import numpy as np
+
+# Canonical dtype vocabulary (reference uses StringType, TimestampType,
+# IntegerType, DoubleType — :64-72).
+STRING = "string"
+TIMESTAMP = "timestamp"
+INT = "int"
+FLOAT = "float"  # DoubleType — we store float64 host-side, cast on device
+
+_NUMPY_DTYPES = {
+    STRING: np.dtype(object),
+    TIMESTAMP: np.dtype("datetime64[ns]"),
+    INT: np.dtype(np.int64),
+    FLOAT: np.dtype(np.float64),
+}
+
+_NUMERIC = {INT, FLOAT}
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: str
+    nullable: bool = True
+
+    def __post_init__(self) -> None:
+        if self.dtype not in _NUMPY_DTYPES:
+            raise ValueError(f"unknown dtype {self.dtype!r}; one of {sorted(_NUMPY_DTYPES)}")
+
+    @property
+    def numpy_dtype(self) -> np.dtype:
+        return _NUMPY_DTYPES[self.dtype]
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.dtype in _NUMERIC
+
+
+@dataclass(frozen=True)
+class Schema:
+    """Ordered collection of named, typed fields."""
+
+    fields: tuple[Field, ...]
+
+    def __init__(self, fields: Iterable[Field | tuple[str, str]]):
+        norm = tuple(f if isinstance(f, Field) else Field(*f) for f in fields)
+        names = [f.name for f in norm]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate field names in schema: {names}")
+        object.__setattr__(self, "fields", norm)
+
+    def __iter__(self) -> Iterator[Field]:
+        return iter(self.fields)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __contains__(self, name: str) -> bool:
+        return any(f.name == name for f in self.fields)
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(f"no field {name!r}; schema has {self.names}")
+
+    def add(self, f: Field | tuple[str, str]) -> "Schema":
+        f = f if isinstance(f, Field) else Field(*f)
+        return Schema(self.fields + (f,))
+
+    def select(self, names: Iterable[str]) -> "Schema":
+        return Schema(tuple(self.field(n) for n in names))
+
+    def numeric_names(self) -> list[str]:
+        return [f.name for f in self.fields if f.is_numeric]
+
+
+def hospital_event_schema() -> Schema:
+    """The reference's streaming schema (``mllearnforhospitalnetwork.py:64-72``).
+
+    7 declared fields; ``ingest_time`` is appended by the ingest stage the
+    way the reference adds ``current_timestamp()`` at ``:82``.
+    """
+    return Schema(
+        [
+            ("hospital_id", STRING),
+            ("event_time", TIMESTAMP),
+            ("admission_count", INT),
+            ("current_occupancy", INT),
+            ("emergency_visits", INT),
+            ("seasonality_index", FLOAT),
+            ("length_of_stay", FLOAT),
+        ]
+    )
+
+
+# Canonical feature/label constants (SURVEY.md Appendix B; reference :134,:136).
+FEATURE_COLS = (
+    "admission_count",
+    "current_occupancy",
+    "emergency_visits",
+    "seasonality_index",
+)
+LABEL_COL = "length_of_stay"

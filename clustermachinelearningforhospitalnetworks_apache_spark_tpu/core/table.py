@@ -1,0 +1,212 @@
+"""Columnar in-memory table — the DataFrame replacement.
+
+The reference routes every relational operation through Spark DataFrames:
+streaming read (:75-80), ``withColumn`` (:82,:176), SQL window extraction
+(:123-128), ``na.drop`` (:128), ``select`` (:137,:204), ``randomSplit``
+(:139,:180), ``toPandas`` (:204).  Here the same surface is an eager,
+host-columnar ``Table`` (numpy columns, Arrow in/out) — there is no lazy
+plan tree because there is no remote cluster to plan for: the expensive
+work happens *after* the table is lowered to a sharded ``jax.Array`` via
+``to_device`` (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from ..parallel.sharding import DeviceDataset, device_dataset
+from .schema import FLOAT, INT, STRING, TIMESTAMP, Field, Schema
+
+
+def _coerce(values: Any, f: Field) -> np.ndarray:
+    arr = np.asarray(values)
+    if f.dtype == TIMESTAMP:
+        return arr.astype("datetime64[ns]")
+    if f.dtype == STRING:
+        return arr.astype(object)
+    if f.dtype == INT and arr.dtype.kind in "fc":
+        # keep NaN-capable representation until na_drop
+        return arr.astype(np.float64)
+    return arr.astype(f.numpy_dtype)
+
+
+@dataclass(frozen=True)
+class Table:
+    schema: Schema
+    columns: dict[str, np.ndarray]
+
+    # ------------------------------------------------------------- basics
+    def __post_init__(self) -> None:
+        lens = {len(v) for v in self.columns.values()}
+        if len(lens) > 1:
+            raise ValueError(f"ragged columns: lengths {lens}")
+        if set(self.columns) != set(self.schema.names):
+            raise ValueError(
+                f"columns {sorted(self.columns)} != schema {sorted(self.schema.names)}"
+            )
+
+    def __len__(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    @property
+    def num_rows(self) -> int:
+        return len(self)
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    # ------------------------------------------------------ constructors
+    @classmethod
+    def from_dict(cls, data: Mapping[str, Any], schema: Schema | None = None) -> "Table":
+        if schema is None:
+            fields = []
+            for k, v in data.items():
+                a = np.asarray(v)
+                if a.dtype.kind in "USO":
+                    fields.append(Field(k, STRING))
+                elif a.dtype.kind == "M":
+                    fields.append(Field(k, TIMESTAMP))
+                elif a.dtype.kind in "iu" or a.dtype.kind == "b":
+                    fields.append(Field(k, INT))
+                else:
+                    fields.append(Field(k, FLOAT))
+            schema = Schema(fields)
+        cols = {f.name: _coerce(data[f.name], f) for f in schema}
+        return cls(schema, cols)
+
+    @classmethod
+    def from_pandas(cls, df, schema: Schema | None = None) -> "Table":
+        return cls.from_dict({c: df[c].to_numpy() for c in df.columns}, schema)
+
+    @classmethod
+    def from_arrow(cls, batch, schema: Schema | None = None) -> "Table":
+        """From a pyarrow Table/RecordBatch — the ingest hand-off format
+        (BASELINE north star: 'Arrow record-batches into sharded jax.Arrays')."""
+        data = {name: batch.column(name).to_numpy(zero_copy_only=False) for name in batch.schema.names}
+        return cls.from_dict(data, schema)
+
+    @classmethod
+    def concat(cls, tables: Sequence["Table"]) -> "Table":
+        if not tables:
+            raise ValueError("concat of no tables")
+        schema = tables[0].schema
+        cols = {
+            n: np.concatenate([t.columns[n] for t in tables]) for n in schema.names
+        }
+        return cls(schema, cols)
+
+    @classmethod
+    def empty(cls, schema: Schema) -> "Table":
+        return cls(schema, {f.name: np.empty((0,), dtype=f.numpy_dtype) for f in schema})
+
+    # ------------------------------------------------------- relational
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table(self.schema.select(names), {n: self.columns[n] for n in names})
+
+    def mask(self, m: np.ndarray) -> "Table":
+        return Table(self.schema, {n: v[m] for n, v in self.columns.items()})
+
+    def filter(self, predicate: Callable[["Table"], np.ndarray]) -> "Table":
+        return self.mask(np.asarray(predicate(self), dtype=bool))
+
+    def with_column(self, name: str, values: Any, dtype: str | None = None) -> "Table":
+        """``DataFrame.withColumn`` analogue (reference :82, :176-177).
+
+        ``values`` may be an array or a callable of the table.
+        """
+        if callable(values):
+            values = values(self)
+        arr = np.asarray(values)
+        if dtype is None:
+            if arr.dtype.kind in "USO":
+                dtype = STRING
+            elif arr.dtype.kind == "M":
+                dtype = TIMESTAMP
+            elif arr.dtype.kind in "iub":
+                dtype = INT
+            else:
+                dtype = FLOAT
+        f = Field(name, dtype)
+        if name in self.schema:
+            schema = Schema(tuple(f if g.name == name else g for g in self.schema))
+        else:
+            schema = self.schema.add(f)
+        cols = dict(self.columns)
+        cols[name] = _coerce(arr, f)
+        return Table(schema, cols)
+
+    def na_drop(self, subset: Sequence[str] | None = None) -> "Table":
+        """``DataFrame.na.drop()`` analogue (reference :128)."""
+        names = list(subset) if subset else self.schema.names
+        keep = np.ones(len(self), dtype=bool)
+        for n in names:
+            v = self.columns[n]
+            if v.dtype.kind == "f":
+                keep &= ~np.isnan(v)
+            elif v.dtype.kind == "M":
+                keep &= ~np.isnat(v)
+            elif v.dtype == object:
+                keep &= np.array([x is not None and x == x for x in v], dtype=bool)
+        return self.mask(keep)
+
+    def between(self, column: str, start: Any, end: Any) -> "Table":
+        """Training-window extraction — the SQL ``WHERE event_time BETWEEN
+        start AND end`` at reference :123-128, as a vectorized mask."""
+        v = self.columns[column]
+        if v.dtype.kind == "M":
+            start = np.datetime64(start)
+            end = np.datetime64(end)
+        return self.mask((v >= start) & (v <= end))
+
+    def sort_by(self, column: str) -> "Table":
+        order = np.argsort(self.columns[column], kind="stable")
+        return self.mask(order)
+
+    def limit(self, n: int) -> "Table":
+        return Table(self.schema, {k: v[:n] for k, v in self.columns.items()})
+
+    def group_count(self, column: str) -> dict[Any, int]:
+        vals, counts = np.unique(self.columns[column], return_counts=True)
+        return dict(zip(vals.tolist(), counts.tolist()))
+
+    # ------------------------------------------------------- conversion
+    def to_pandas(self):
+        """``toPandas`` analogue (reference :204)."""
+        import pandas as pd
+
+        return pd.DataFrame({n: self.columns[n] for n in self.schema.names})
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        return pa.table({n: self.columns[n] for n in self.schema.names})
+
+    def numeric_matrix(self, names: Sequence[str], dtype=np.float64) -> np.ndarray:
+        for n in names:
+            if not self.schema.field(n).is_numeric:
+                raise TypeError(f"column {n!r} is not numeric")
+        if not names:
+            return np.empty((len(self), 0), dtype=dtype)
+        return np.stack([self.columns[n].astype(dtype) for n in names], axis=1)
+
+    def to_device(
+        self,
+        feature_cols: Sequence[str],
+        label_col: str | None = None,
+        mesh=None,
+    ) -> DeviceDataset:
+        """Lower to a padded, weighted, row-sharded device dataset — the
+        single host→device boundary of the whole pipeline (contrast with the
+        reference, which crosses Py4J + executor boundaries on every call,
+        SURVEY.md §3.1)."""
+        x = self.numeric_matrix(feature_cols)
+        y = self.columns[label_col].astype(np.float64) if label_col else None
+        return device_dataset(x, y, mesh=mesh)

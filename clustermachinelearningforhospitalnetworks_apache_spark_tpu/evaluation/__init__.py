@@ -1,0 +1,10 @@
+from .regression import RegressionEvaluator
+from .classification import MulticlassClassificationEvaluator
+from .clustering import ClusteringEvaluator, inertia
+
+__all__ = [
+    "RegressionEvaluator",
+    "MulticlassClassificationEvaluator",
+    "ClusteringEvaluator",
+    "inertia",
+]

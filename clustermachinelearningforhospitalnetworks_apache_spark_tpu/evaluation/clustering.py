@@ -1,0 +1,76 @@
+"""ClusteringEvaluator — silhouette score.
+
+The BASELINE north star requires "silhouette-score parity vs Spark-CPU"
+(BASELINE.json).  Spark's ``ClusteringEvaluator`` computes the
+**squared-Euclidean silhouette** in O(n·k) using per-cluster sufficient
+statistics (no O(n²) pairwise matrix); the same formulation is used here as
+one jit'd pass over the sharded rows:
+
+    Σ_{q∈C} ||p-q||² = N_C·||p||² − 2·p·Y_C + Ψ_C,
+    with Y_C = Σ_{q∈C} q  and  Ψ_C = Σ_{q∈C} ||q||².
+
+a(p) divides by N_C−1 (self excluded), b(p) is the min over other
+clusters dividing by N_C, s(p) = (b−a)/max(a,b); singleton clusters score 0
+(sklearn/Spark convention).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _silhouette_sums(x: jax.Array, assign: jax.Array, w: jax.Array, k: int):
+    wcol = w[:, None]
+    onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * wcol      # (n, k)
+    counts = jnp.sum(onehot, axis=0)                               # N_C
+    y = onehot.T @ x                                               # (k, d) Y_C
+    sq = jnp.sum(x * x, axis=1)                                    # ||p||²
+    psi = onehot.T @ sq                                            # Ψ_C
+
+    # total squared distance from each point to every member of each cluster
+    tot = counts[None, :] * sq[:, None] - 2.0 * (x @ y.T) + psi[None, :]  # (n, k)
+    tot = jnp.maximum(tot, 0.0)
+
+    own = jax.nn.one_hot(assign, k, dtype=bool)
+    n_own = jnp.sum(jnp.where(own, counts[None, :], 0.0), axis=1)
+    a = jnp.sum(jnp.where(own, tot, 0.0), axis=1) / jnp.maximum(n_own - 1.0, 1.0)
+    b = jnp.min(
+        jnp.where(own | (counts[None, :] == 0), jnp.inf, tot / jnp.maximum(counts[None, :], 1.0)),
+        axis=1,
+    )
+    s = jnp.where(n_own > 1.0, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    s = jnp.where(jnp.isfinite(s), s, 0.0)
+    return jnp.sum(s * w), jnp.sum(w)
+
+
+@dataclass(frozen=True)
+class ClusteringEvaluator:
+    """metricName="silhouette", distanceMeasure="squaredEuclidean" (Spark's
+    default evaluator configuration)."""
+
+    metric_name: str = "silhouette"
+
+    def evaluate(self, features, assignments, k: int | None = None, weights=None) -> float:
+        x = jnp.asarray(np.asarray(features), jnp.float32)
+        assign = jnp.asarray(np.asarray(assignments), jnp.int32)
+        w = (
+            jnp.asarray(np.asarray(weights), jnp.float32)
+            if weights is not None
+            else jnp.ones((x.shape[0],), jnp.float32)
+        )
+        k = int(k if k is not None else int(np.asarray(assignments).max()) + 1)
+        s_sum, n = jax.device_get(_silhouette_sums(x, assign, w, k))
+        return float(s_sum / max(float(n), 1.0))
+
+
+@jax.jit
+def inertia(x: jax.Array, centers: jax.Array, assign: jax.Array, w: jax.Array):
+    """Within-cluster sum of squared distances (KMeans ``trainingCost``)."""
+    d = x - centers[assign]
+    return jnp.sum(jnp.sum(d * d, axis=1) * w)

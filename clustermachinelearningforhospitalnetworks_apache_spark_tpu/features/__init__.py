@@ -1,0 +1,14 @@
+from .assembler import AssembledTable, VectorAssembler
+from .scaler import StandardScaler, StandardScalerModel
+from .indexer import StringIndexer, StringIndexerModel
+from .binarizer import Binarizer
+
+__all__ = [
+    "AssembledTable",
+    "VectorAssembler",
+    "StandardScaler",
+    "StandardScalerModel",
+    "StringIndexer",
+    "StringIndexerModel",
+    "Binarizer",
+]

@@ -1,0 +1,64 @@
+"""VectorAssembler — column list → dense feature matrix.
+
+Parity with ``pyspark.ml.feature.VectorAssembler`` at reference
+``mllearnforhospitalnetwork.py:135-136,:179`` (4 numeric input columns →
+``features`` vector).  On TPU "a vector column" is simply a column-stacked
+matrix; assembly is a host-side ``np.stack`` (or a device-side
+``jnp.stack`` when the columns are already on device), after which the
+matrix flows to the mesh in one transfer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..core.table import Table
+
+
+@dataclass(frozen=True)
+class VectorAssembler:
+    input_cols: Sequence[str]
+    output_col: str = "features"
+
+    def transform_matrix(self, table: Table, dtype=np.float64) -> np.ndarray:
+        """The matrix itself — the form every estimator consumes."""
+        return table.numeric_matrix(list(self.input_cols), dtype=dtype)
+
+    def transform(self, table: Table) -> "AssembledTable":
+        return AssembledTable(
+            table=table,
+            feature_cols=tuple(self.input_cols),
+            features=self.transform_matrix(table),
+            output_col=self.output_col,
+        )
+
+
+@dataclass(frozen=True)
+class AssembledTable:
+    """A table plus its assembled feature matrix.
+
+    Mirrors the reference's ``final_data = output.select("features",
+    "length_of_stay")`` (:137) hand-off, keeping the source table alongside
+    so downstream stages (labels, ids, plotting) can still reach raw
+    columns.
+    """
+
+    table: Table
+    feature_cols: tuple[str, ...]
+    features: np.ndarray
+    output_col: str = "features"
+
+    def __len__(self) -> int:
+        return len(self.table)
+
+    def label(self, name: str) -> np.ndarray:
+        return self.table.column(name).astype(np.float64)
+
+    def to_device(self, label_col: str | None = None, mesh=None):
+        from ..parallel.sharding import device_dataset
+
+        y = self.label(label_col) if label_col else None
+        return device_dataset(self.features, y, mesh=mesh)

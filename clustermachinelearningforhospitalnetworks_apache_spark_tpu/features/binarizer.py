@@ -1,0 +1,30 @@
+"""Label binarization.
+
+Parity with the reference's classification-label construction at
+``mllearnforhospitalnetwork.py:176-177``::
+
+    when(col("length_of_stay") > CONFIG["losThreshold"], 1).otherwise(0)
+
+i.e. strictly-greater-than thresholding at ``losThreshold`` (5.0, :49).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.table import Table
+
+
+@dataclass(frozen=True)
+class Binarizer:
+    input_col: str
+    output_col: str
+    threshold: float
+
+    def transform(self, table: Table) -> Table:
+        v = table.column(self.input_col).astype(np.float64)
+        return table.with_column(
+            self.output_col, (v > self.threshold).astype(np.int64), dtype="int"
+        )

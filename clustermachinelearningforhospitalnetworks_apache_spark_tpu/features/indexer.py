@@ -1,0 +1,59 @@
+"""StringIndexer — categorical string column → dense integer codes.
+
+The reference imports ``StringIndexer`` but never uses it
+(``mllearnforhospitalnetwork.py:29``; SURVEY.md D5 reads it as intended
+categorical handling for ``hospital_id``).  Provided here as a working
+stage: frequency-ordered label assignment, matching Spark's default
+``frequencyDesc`` ordering, with deterministic lexicographic tie-break.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from ..core.table import Table
+
+
+@dataclass(frozen=True)
+class StringIndexerModel:
+    input_col: str
+    output_col: str
+    labels: tuple[str, ...]
+    handle_invalid: str = "error"  # "error" | "keep" | "skip"
+
+    def transform(self, table: Table) -> Table:
+        lut = {v: i for i, v in enumerate(self.labels)}
+        vals = table.column(self.input_col)
+        out = np.empty(len(vals), dtype=np.int64)
+        invalid = []
+        for i, v in enumerate(vals):
+            code = lut.get(v)
+            if code is None:
+                if self.handle_invalid == "error":
+                    raise ValueError(f"unseen label {v!r} in {self.input_col}")
+                code = len(self.labels)  # "keep": extra bucket
+                invalid.append(i)
+            out[i] = code
+        t = table.with_column(self.output_col, out, dtype="int")
+        if self.handle_invalid == "skip" and invalid:
+            keep = np.ones(len(t), dtype=bool)
+            keep[invalid] = False
+            t = t.mask(keep)
+        return t
+
+
+@dataclass(frozen=True)
+class StringIndexer:
+    input_col: str
+    output_col: str
+    handle_invalid: str = "error"
+
+    def fit(self, table: Table) -> StringIndexerModel:
+        vals, counts = np.unique(table.column(self.input_col).astype(str), return_counts=True)
+        order = np.lexsort((vals, -counts))  # freq desc, then lexicographic
+        return StringIndexerModel(
+            self.input_col, self.output_col, tuple(vals[order].tolist()), self.handle_invalid
+        )

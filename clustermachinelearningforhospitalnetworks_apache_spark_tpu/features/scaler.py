@@ -1,0 +1,71 @@
+"""StandardScaler — fit/transform with mean/std, computed on device.
+
+The reference itself never scales (it feeds raw columns to MLlib), but the
+BASELINE north star names ``StandardScaler`` in the k=256 feature path
+(BASELINE.json: "StandardScaler+VectorAssembler"), so it is first-class
+here.  The fit is one weighted ``psum``-reduced moment pass over the
+sharded rows — the same shape of reduction MLlib's ``StandardScaler`` runs
+via treeAggregate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.sharding import DeviceDataset
+
+
+@jax.jit
+def _moments(x: jax.Array, w: jax.Array):
+    wcol = w[:, None]
+    n = jnp.sum(w)
+    s1 = jnp.sum(x * wcol, axis=0)
+    s2 = jnp.sum(x * x * wcol, axis=0)
+    mean = s1 / jnp.maximum(n, 1.0)
+    var = s2 / jnp.maximum(n, 1.0) - mean * mean
+    return mean, jnp.sqrt(jnp.maximum(var, 0.0)), n
+
+
+@dataclass(frozen=True)
+class StandardScalerModel:
+    mean: np.ndarray
+    std: np.ndarray
+    with_mean: bool = True
+    with_std: bool = True
+
+    def transform(self, x):
+        xp = jnp if isinstance(x, jax.Array) else np
+        out = x
+        if self.with_mean:
+            out = out - xp.asarray(self.mean, dtype=out.dtype)
+        if self.with_std:
+            safe = xp.where(xp.asarray(self.std) > 0, xp.asarray(self.std), 1.0)
+            out = out / safe.astype(out.dtype)
+        return out
+
+    def transform_dataset(self, ds: DeviceDataset) -> DeviceDataset:
+        # Pad rows are zeros; re-zero them after the affine shift so they
+        # stay inert for weighted reductions downstream.
+        x = self.transform(ds.x) * ds.w[:, None]
+        return DeviceDataset(x=x, y=ds.y, w=ds.w)
+
+
+@dataclass(frozen=True)
+class StandardScaler:
+    with_mean: bool = True
+    with_std: bool = True
+
+    def fit(self, data) -> StandardScalerModel:
+        """``data``: DeviceDataset (sharded) or host ndarray."""
+        if isinstance(data, DeviceDataset):
+            mean, std, _ = _moments(data.x, data.w)
+            mean, std = np.asarray(mean), np.asarray(std)
+        else:
+            x = np.asarray(data, dtype=np.float64)
+            mean = x.mean(axis=0)
+            std = x.std(axis=0)
+        return StandardScalerModel(mean, std, self.with_mean, self.with_std)

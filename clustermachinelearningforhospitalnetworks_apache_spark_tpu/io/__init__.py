@@ -1,0 +1,13 @@
+from .csv import read_csv, read_csv_dir, write_csv
+from .model_io import load_model, register_model, save_model
+from .native import native_available
+
+__all__ = [
+    "read_csv",
+    "read_csv_dir",
+    "write_csv",
+    "load_model",
+    "register_model",
+    "save_model",
+    "native_available",
+]

@@ -1,0 +1,116 @@
+"""CSV ingest.
+
+The reference reads header CSVs from an HDFS directory through Spark's
+streaming file source (``spark.readStream...csv`` with an explicit schema,
+``mllearnforhospitalnetwork.py:74-80``).  Here CSV scanning is a host-side
+concern: the fast path is the native C++ scan shim (``native/csv_scan.cpp``,
+loaded via ctypes — the Tungsten-scan replacement, SURVEY.md E1), with a
+pyarrow fallback and a pure-numpy last resort.  All paths produce a
+schema-typed :class:`~..core.table.Table`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+from ..core.schema import Schema, TIMESTAMP, STRING
+from ..core.table import Table
+from .native import native_read_csv, native_available
+
+
+def read_csv(path: str, schema: Schema, header: bool = True, engine: str = "auto") -> Table:
+    """Read one CSV file into a Table with the given schema.
+
+    engine: "auto" (native → arrow → numpy), "native", "arrow", "numpy".
+    """
+    if engine in ("auto", "native") and native_available():
+        try:
+            return _from_string_columns(native_read_csv(path, len(schema), header), schema)
+        except Exception:
+            if engine == "native":
+                raise
+    if engine in ("auto", "arrow"):
+        try:
+            return _read_arrow(path, schema, header)
+        except ImportError:
+            if engine == "arrow":
+                raise
+    return _read_numpy(path, schema, header)
+
+
+def read_csv_dir(path: str, schema: Schema, header: bool = True) -> Table:
+    """Read every ``*.csv`` under a directory (the batch analogue of the
+    reference's streaming dir source at :42,:75)."""
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path) if f.endswith(".csv")
+    )
+    if not files:
+        return Table.empty(schema)
+    return Table.concat([read_csv(f, schema, header) for f in files])
+
+
+def _read_arrow(path: str, schema: Schema, header: bool) -> Table:
+    import pyarrow.csv as pacsv
+
+    read_opts = pacsv.ReadOptions(
+        column_names=None if header else schema.names, autogenerate_column_names=False
+    )
+    tbl = pacsv.read_csv(path, read_options=read_opts)
+    data = {}
+    for f in schema:
+        col = tbl.column(f.name).to_numpy(zero_copy_only=False)
+        data[f.name] = col
+    return Table.from_dict(data, schema)
+
+
+def _read_numpy(path: str, schema: Schema, header: bool) -> Table:
+    with open(path) as fh:
+        lines = [ln.rstrip("\n") for ln in fh if ln.strip()]
+    if header and lines:
+        lines = lines[1:]
+    cols: list[list[str]] = [[] for _ in schema]
+    for ln in lines:
+        parts = ln.split(",")
+        for i in range(len(schema)):
+            cols[i].append(parts[i] if i < len(parts) else "")
+    return _from_string_columns([np.array(c, dtype=object) for c in cols], schema)
+
+
+def _from_string_columns(cols: Sequence[np.ndarray], schema: Schema) -> Table:
+    data = {}
+    for f, raw in zip(schema, cols):
+        if f.dtype == STRING:
+            data[f.name] = raw
+        elif f.dtype == TIMESTAMP:
+            data[f.name] = np.array(
+                [np.datetime64(v.replace(" ", "T")) if v else np.datetime64("NaT") for v in raw],
+                dtype="datetime64[ns]",
+            )
+        else:
+            out = np.empty(len(raw), dtype=np.float64)
+            for i, v in enumerate(raw):
+                try:
+                    out[i] = float(v)
+                except (TypeError, ValueError):
+                    out[i] = np.nan
+            data[f.name] = out
+    return Table.from_dict(data, schema)
+
+
+def write_csv(table: Table, path: str, header: bool = True) -> None:
+    with open(path, "w") as f:
+        if header:
+            f.write(",".join(table.schema.names) + "\n")
+        cols = [table.columns[n] for n in table.schema.names]
+        for i in range(len(table)):
+            row = []
+            for c in cols:
+                v = c[i]
+                if isinstance(v, np.datetime64):
+                    row.append(str(v).replace("T", " "))
+                else:
+                    row.append(str(v))
+            f.write(",".join(row) + "\n")

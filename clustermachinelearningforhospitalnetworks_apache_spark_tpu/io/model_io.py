@@ -1,0 +1,84 @@
+"""Model persistence.
+
+Parity with MLlib's ``model.write().overwrite().save(path)`` at reference
+``mllearnforhospitalnetwork.py:241-243`` (SURVEY.md §3.5): Spark writes
+Parquet coefficient/tree-node files plus JSON metadata to HDFS.  Here a
+model artifact is a directory containing
+
+    metadata.json   — model class, framework version, params
+    arrays.npz      — every ndarray leaf of the model's pytree
+
+with the same overwrite-or-fail-if-exists semantics.  A registry maps the
+class name in metadata back to the Python class on load, so
+``load_model(path)`` round-trips any registered model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Callable
+
+import numpy as np
+
+from ..version import __version__
+
+_REGISTRY: dict[str, Callable[[dict, dict], Any]] = {}
+
+METADATA_FILE = "metadata.json"
+ARRAYS_FILE = "arrays.npz"
+
+
+def register_model(name: str):
+    """Class decorator: register a ``from_artifacts(metadata, arrays)``
+    constructor under ``name`` for ``load_model``."""
+
+    def deco(cls):
+        _REGISTRY[name] = cls.from_artifacts
+        cls._artifact_name = name
+        return cls
+
+    return deco
+
+
+def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarray], overwrite: bool = True) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(f"{path} exists and overwrite=False")
+        shutil.rmtree(path)
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "model_class": name,
+        "framework_version": __version__,
+        "params": metadata,
+    }
+    tmp = path + ".tmp_meta"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2, default=_json_default)
+    os.replace(tmp, os.path.join(path, METADATA_FILE))
+    np.savez(os.path.join(path, ARRAYS_FILE), **{k: np.asarray(v) for k, v in arrays.items()})
+
+
+def load_model(path: str) -> Any:
+    with open(os.path.join(path, METADATA_FILE)) as f:
+        meta = json.load(f)
+    arrays_path = os.path.join(path, ARRAYS_FILE)
+    arrays: dict[str, np.ndarray] = {}
+    if os.path.exists(arrays_path):
+        with np.load(arrays_path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+    name = meta["model_class"]
+    if name not in _REGISTRY:
+        raise KeyError(f"no registered model class {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](meta["params"], arrays)
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")

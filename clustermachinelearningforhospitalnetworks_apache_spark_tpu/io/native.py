@@ -1,0 +1,88 @@
+"""ctypes loader for the native C++ scan/watch shim.
+
+The reference's host-side data plane (Tungsten CSV scan codegen + the
+streaming file source's directory listing, SURVEY.md E1/E2) is replaced by
+``native/csv_scan.cpp`` — built with ``make -C native`` into
+``libcsv_scan.so``.  Everything degrades gracefully to pure Python when the
+shared library hasn't been built (e.g. fresh checkout, CI without a
+toolchain).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "native", "libcsv_scan.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.csv_count_rows.restype = ctypes.c_long
+        lib.csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.csv_parse_numeric.restype = ctypes.c_long
+        lib.csv_parse_numeric.argtypes = [
+            ctypes.c_char_p,          # path
+            ctypes.c_int,             # header (0/1)
+            ctypes.c_int,             # ncols
+            ctypes.POINTER(ctypes.c_int),     # numeric column indices
+            ctypes.c_int,             # n numeric
+            ctypes.POINTER(ctypes.c_double),  # out buffer (rows*n_numeric)
+            ctypes.c_long,            # capacity rows
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_count_rows(path: str, header: bool = True) -> int:
+    lib = _load()
+    return int(lib.csv_count_rows(path.encode(), 1 if header else 0))
+
+
+def native_parse_numeric(path: str, col_indices: List[int], ncols: int, header: bool = True) -> np.ndarray:
+    """Parse the given numeric columns of a CSV into a float64 matrix."""
+    lib = _load()
+    nrows = native_count_rows(path, header)
+    k = len(col_indices)
+    out = np.empty((max(nrows, 1), k), dtype=np.float64)
+    idx = (ctypes.c_int * k)(*col_indices)
+    got = lib.csv_parse_numeric(
+        path.encode(),
+        1 if header else 0,
+        ncols,
+        idx,
+        k,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        nrows,
+    )
+    return out[: max(int(got), 0)]
+
+
+def native_read_csv(path: str, ncols: int, header: bool = True):
+    """Full-table native read is only used for all-numeric schemas; string/
+    timestamp columns route through the arrow/numpy engines.  Raise to let
+    read_csv fall through when unsupported."""
+    raise NotImplementedError("native engine parses numeric projections only")

@@ -1,0 +1,11 @@
+from .base import Estimator, Model, PredictionResult, as_device_dataset
+from .linear_regression import LinearRegression, LinearRegressionModel
+
+__all__ = [
+    "Estimator",
+    "Model",
+    "PredictionResult",
+    "as_device_dataset",
+    "LinearRegression",
+    "LinearRegressionModel",
+]

@@ -1,0 +1,102 @@
+"""Estimator / Model protocol.
+
+Mirrors the ``pyspark.ml`` Estimator→Model contract the reference leans on
+(``.fit(train)`` then ``model.transform(test)``, ``mllearnforhospitalnetwork
+.py:146-158,183-190``), reshaped for the TPU substrate: estimators consume a
+row-sharded :class:`~..parallel.sharding.DeviceDataset` (or anything
+coercible to one) and models predict on device, returning a
+:class:`PredictionResult` whose arrays stay sharded until explicitly
+collected — so fit→transform→evaluate never leaves the mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..features.assembler import AssembledTable
+from ..parallel.sharding import DeviceDataset, device_dataset, unpad
+
+
+def as_device_dataset(data: Any, label_col: str | None = None, mesh=None) -> DeviceDataset:
+    """Coerce (DeviceDataset | AssembledTable | (X, y) | X) to a sharded dataset."""
+    if isinstance(data, DeviceDataset):
+        return data
+    if isinstance(data, AssembledTable):
+        return data.to_device(label_col=label_col, mesh=mesh)
+    if isinstance(data, tuple) and len(data) == 2:
+        return device_dataset(np.asarray(data[0]), np.asarray(data[1]), mesh=mesh)
+    return device_dataset(np.asarray(data), None, mesh=mesh)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PredictionResult:
+    """Sharded predictions + labels + validity weights (pad rows w=0)."""
+
+    prediction: jax.Array
+    label: jax.Array
+    weight: jax.Array
+
+    def to_numpy(self, n: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+        pred = np.asarray(jax.device_get(self.prediction))
+        lab = np.asarray(jax.device_get(self.label))
+        if n is None:
+            valid = np.asarray(jax.device_get(self.weight)) > 0
+            return pred[valid], lab[valid]
+        return pred[:n], lab[:n]
+
+
+class Estimator:
+    """Base: subclasses implement ``fit(dataset) -> Model``."""
+
+    def fit(self, data: Any, label_col: str | None = None, mesh=None):
+        raise NotImplementedError
+
+
+class Model:
+    """Base: subclasses implement ``predict(x) -> jax.Array`` on device."""
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def transform(self, data: Any, label_col: str | None = None, mesh=None) -> PredictionResult:
+        ds = as_device_dataset(data, label_col=label_col, mesh=mesh)
+        pred = self.predict(ds.x)
+        return PredictionResult(prediction=pred, label=ds.y, weight=ds.w)
+
+    def predict_numpy(self, x: np.ndarray) -> np.ndarray:
+        ds = as_device_dataset(np.asarray(x))
+        n = np.asarray(x).shape[0]
+        return unpad(self.predict(ds.x), n)
+
+    # persistence sugar -------------------------------------------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from ..io.model_io import save_model
+
+        name, meta, arrays = self._artifacts()
+        save_model(path, name, meta, arrays, overwrite=overwrite)
+
+    def write(self) -> "_Writer":
+        """Spark-style ``model.write().overwrite().save(path)`` chain."""
+        return _Writer(self)
+
+    def _artifacts(self) -> tuple[str, dict, dict[str, np.ndarray]]:
+        raise NotImplementedError
+
+
+@dataclass
+class _Writer:
+    model: Model
+    _overwrite: bool = False
+
+    def overwrite(self) -> "_Writer":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        self.model.save(path, overwrite=self._overwrite)

@@ -1,0 +1,107 @@
+"""LinearRegression — sharded weighted least squares.
+
+Parity with ``pyspark.ml.regression.LinearRegression`` at reference
+``mllearnforhospitalnetwork.py:146-148`` (fit on ``features`` →
+``length_of_stay``, then ``transform`` on the test split).
+
+MLlib solves this with WLS when the feature count is small: per-partition
+Gram/moment accumulation combined via ``treeAggregate``, then a normal-
+equations solve on the driver (SURVEY.md §3.3).  The TPU-native form is the
+same algorithm with the communication inverted into XLA: the Gram matrix
+``XᵀWX`` and moment vector ``XᵀWy`` are computed by one jit'd matmul over
+the row-sharded dataset — the cross-shard sum lowers to a ``psum`` over
+ICI — and the (d+1)×(d+1) solve runs on device.  Ridge (``reg_param``)
+matches Spark's L2 regularization (applied to coefficients, not the
+intercept, on standardized features when ``standardize=True``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from ..parallel.sharding import DeviceDataset
+from .base import Estimator, Model, as_device_dataset
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "standardize"))
+def _wls_fit(x, y, w, reg_param, fit_intercept: bool, standardize: bool):
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    wcol = w[:, None]
+
+    # Per-feature scale for Spark-style standardized regularization.
+    mean = jnp.sum(x * wcol, axis=0) / n
+    var = jnp.sum(x * x * wcol, axis=0) / n - mean * mean
+    std = jnp.sqrt(jnp.maximum(var, 1e-12))
+    scale = std if standardize else jnp.ones_like(std)
+
+    if fit_intercept:
+        xa = jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+    else:
+        xa = x
+    d = xa.shape[1]
+    # Gram + moments: the treeAggregate replacement — one matmul each,
+    # cross-shard reduction is an XLA psum.
+    gram = (xa * wcol).T @ xa
+    mom = (xa * wcol).T @ y
+    ridge = jnp.zeros((d,), x.dtype)
+    nfeat = x.shape[1]
+    ridge = ridge.at[:nfeat].set(reg_param * n * scale * scale)
+    gram = gram + jnp.diag(ridge)
+    theta = jnp.linalg.solve(
+        gram + 1e-8 * jnp.eye(d, dtype=x.dtype), mom
+    )
+    coef = theta[:nfeat]
+    intercept = theta[nfeat] if fit_intercept else jnp.zeros((), x.dtype)
+    return coef, intercept
+
+
+@register_model("LinearRegressionModel")
+@dataclass
+class LinearRegressionModel(Model):
+    coefficients: jax.Array
+    intercept: jax.Array
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        return x.astype(jnp.float32) @ self.coefficients + self.intercept
+
+    def _artifacts(self):
+        return (
+            "LinearRegressionModel",
+            {},
+            {
+                "coefficients": np.asarray(self.coefficients),
+                "intercept": np.asarray(self.intercept),
+            },
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            coefficients=jnp.asarray(arrays["coefficients"]),
+            intercept=jnp.asarray(arrays["intercept"]),
+        )
+
+
+@dataclass(frozen=True)
+class LinearRegression(Estimator):
+    features_col: str = "features"
+    label_col: str = "length_of_stay"
+    reg_param: float = 0.0
+    fit_intercept: bool = True
+    standardize: bool = True
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> LinearRegressionModel:
+        ds: DeviceDataset = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        coef, intercept = _wls_fit(
+            ds.x, ds.y, ds.w, jnp.float32(self.reg_param), self.fit_intercept, self.standardize
+        )
+        return LinearRegressionModel(coefficients=coef, intercept=intercept)

@@ -1,0 +1,42 @@
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    build_mesh,
+    build_hybrid_mesh,
+    default_mesh,
+    set_default_mesh,
+    single_device_mesh,
+    use_mesh,
+)
+from .sharding import (
+    DeviceDataset,
+    device_dataset,
+    pad_rows,
+    replicate,
+    row_sharding,
+    shard_rows,
+    unpad,
+)
+from .collectives import global_sum, tree_aggregate
+from . import distributed
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "build_mesh",
+    "build_hybrid_mesh",
+    "default_mesh",
+    "set_default_mesh",
+    "single_device_mesh",
+    "use_mesh",
+    "DeviceDataset",
+    "device_dataset",
+    "pad_rows",
+    "replicate",
+    "row_sharding",
+    "shard_rows",
+    "unpad",
+    "global_sum",
+    "tree_aggregate",
+    "distributed",
+]

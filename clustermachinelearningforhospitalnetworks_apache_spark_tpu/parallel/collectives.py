@@ -1,0 +1,79 @@
+"""Collective-reduction surface.
+
+Spark's MLlib runs every distributed reduction in the reference —
+gradient/Gram sums inside ``LinearRegression.fit`` (:147), histogram merges
+inside tree training (:150-158, :183-190), metric sums inside evaluators
+(:162-165, :193-195) — through ``treeAggregate`` over Netty RPC
+(SURVEY.md §2D).  On TPU the same reductions are XLA collectives over
+ICI/DCN.  Two idioms coexist:
+
+1. **Implicit (preferred)**: operate on sharded ``jax.Array``s under
+   ``jax.jit``; a global ``jnp.sum`` over a row-sharded axis *is* the
+   treeAggregate — XLA inserts the ``psum`` itself.  Most estimators in
+   this framework use this form.
+2. **Explicit**: ``shard_map`` with ``lax.psum(..., axis_name="data")`` when
+   we need per-shard control (Pallas kernels, streaming partial updates).
+
+This module provides the explicit wrappers plus ``tree_aggregate``, a
+named analogue of Spark's API for porting call sites.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .mesh import DATA_AXIS, default_mesh
+
+
+def psum_data(x, axis_name: str = DATA_AXIS):
+    """``lax.psum`` over the data axis — valid only inside shard_map/pmap."""
+    return lax.psum(x, axis_name)
+
+
+def pmean_data(x, axis_name: str = DATA_AXIS):
+    return lax.pmean(x, axis_name)
+
+
+def tree_aggregate(
+    seq_op: Callable[[Any], Any],
+    dataset_shards: Any,
+    mesh: Mesh | None = None,
+    in_spec: P | None = None,
+) -> Any:
+    """Spark ``treeAggregate`` analogue: map each data shard through
+    ``seq_op`` (producing a pytree of sufficient statistics), then psum the
+    results across the mesh's data axis.
+
+    ``dataset_shards`` is a pytree of row-sharded arrays.  Returns the
+    fully-reduced statistics, replicated on every device.
+    """
+    mesh = mesh or default_mesh()
+    in_spec = in_spec if in_spec is not None else P(DATA_AXIS)
+
+    def shard_fn(local):
+        stats = seq_op(local)
+        return jax.tree.map(lambda s: lax.psum(s, DATA_AXIS), stats)
+
+    in_specs = jax.tree.map(lambda _: in_spec, dataset_shards)
+    sample = jax.eval_shape(lambda d: seq_op(d), dataset_shards)
+    out_specs = jax.tree.map(lambda _: P(), sample)
+    return shard_map(shard_fn, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs)(
+        dataset_shards
+    )
+
+
+@partial(jax.jit, static_argnames=("dtype",))
+def global_sum(x: jax.Array, w: jax.Array | None = None, dtype=jnp.float32):
+    """Weighted global sum of a (possibly sharded) array — under jit, XLA
+    lowers the cross-shard part to a psum over ICI."""
+    x = x.astype(dtype)
+    if w is not None:
+        x = x * w.astype(dtype)
+    return jnp.sum(x)

@@ -1,0 +1,71 @@
+"""Multi-host (multi-controller) runtime bootstrap.
+
+The reference's control plane is Spark's driver⇄executor Netty RPC, stood
+up by pointing the session at a cluster master (``mllearnforhospitalnetwork
+.py:47,55-58``).  JAX's model is multi-controller SPMD: every host runs the
+same program and ``jax.distributed.initialize`` wires the runtime together;
+after that, collectives ride ICI within a slice and DCN across slices with
+no user-visible RPC at all (SURVEY.md §2D).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+
+@dataclass(frozen=True)
+class DistributedContext:
+    process_id: int
+    num_processes: int
+    local_devices: int
+    global_devices: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+_CTX: DistributedContext | None = None
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> DistributedContext:
+    """Initialize the multi-host runtime (idempotent).
+
+    On single-host (including the CI CPU mesh) this is a no-op beyond
+    recording the context.  On a real pod slice, arguments default from the
+    standard cluster envs JAX understands (GKE/GCE metadata), mirroring how
+    Spark executors discover the master.
+    """
+    global _CTX
+    if _CTX is not None:
+        return _CTX
+    explicit = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    multi = explicit is not None or (num_processes or 0) > 1
+    if multi:
+        jax.distributed.initialize(
+            coordinator_address=explicit,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    _CTX = DistributedContext(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_devices=jax.local_device_count(),
+        global_devices=jax.device_count(),
+    )
+    return _CTX
+
+
+def context() -> DistributedContext:
+    return _CTX or initialize()
+
+
+def is_coordinator() -> bool:
+    return context().is_coordinator

@@ -1,0 +1,103 @@
+"""Device-mesh construction.
+
+Replaces the reference's cluster bootstrap — ``SparkSession.builder.master(
+"spark://master-node-address:7077")`` at ``mllearnforhospitalnetwork.py:47,
+55-58`` — with a named JAX mesh.  Where Spark schedules row partitions onto
+JVM executors, we lay rows out over the ``data`` axis and (for wide models,
+e.g. k=256 centroids) the feature/centroid axis over ``model``; XLA then
+emits ICI/DCN collectives for every reduction that Spark would have run as
+``treeAggregate``.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config import MeshConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(cfg: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a (data, model) mesh from available devices.
+
+    ``data=-1`` consumes all devices not claimed by ``model``.  On a real
+    multi-host slice the devices JAX enumerates are already ordered so the
+    ICI-adjacent chips land contiguously on the trailing axis; for
+    multi-host DCN+ICI hybrid meshes use :func:`build_hybrid_mesh`.
+    """
+    cfg = cfg or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    model = max(1, cfg.model)
+    if len(devs) % model != 0:
+        raise ValueError(f"{len(devs)} devices not divisible by model={model}")
+    data = cfg.data if cfg.data > 0 else len(devs) // model
+    if data * model != len(devs):
+        devs = devs[: data * model]
+    arr = np.asarray(devs).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def build_hybrid_mesh(dcn_hosts: int, model: int = 1) -> Mesh:
+    """Multi-host mesh whose leading data sub-axis crosses DCN.
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` so that the intra-host
+    portion of the data axis rides ICI and only the host portion crosses
+    DCN — the layout that keeps ``psum`` traffic on the fast interconnect
+    (SURVEY.md §2D).
+    """
+    from jax.experimental import mesh_utils
+
+    n = jax.device_count()
+    per_host = n // dcn_hosts
+    dev = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_host // model, model),
+        dcn_mesh_shape=(dcn_hosts, 1),
+    )
+    return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    dev = device or jax.devices()[0]
+    return Mesh(np.asarray([dev]).reshape(1, 1), (DATA_AXIS, MODEL_AXIS))
+
+
+_DEFAULT_MESH: Mesh | None = None
+
+
+def default_mesh() -> Mesh:
+    """Process-wide default mesh (lazily built over all devices)."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = build_mesh()
+    return _DEFAULT_MESH
+
+
+def set_default_mesh(mesh: Mesh | None) -> None:
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+
+
+@contextmanager
+def use_mesh(mesh: Mesh) -> Iterator[Mesh]:
+    global _DEFAULT_MESH
+    prev = _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _DEFAULT_MESH = prev
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def model_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[MODEL_AXIS]

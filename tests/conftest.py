@@ -1,0 +1,102 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+SURVEY.md §4: the JAX analogue of Spark's local-cluster test mode is
+``--xla_force_host_platform_device_count=8`` on the CPU backend — every
+sharding/psum path becomes testable without TPU hardware, and sharded fits
+can be asserted equal to single-device fits.
+
+Must set the env vars before jax initializes, hence module-level here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The image's sitecustomize imports jax (axon TPU platform) before pytest
+# runs, so env vars alone are too late; the config route still works
+# because backends are initialized lazily.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_numpy_rank_promotion", "raise")
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht  # noqa: E402
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
+    build_mesh,
+    set_default_mesh,
+    single_device_mesh,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.config import MeshConfig  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """8-device (data=8, model=1) mesh."""
+    return build_mesh(MeshConfig(data=8, model=1))
+
+
+@pytest.fixture(scope="session")
+def mesh42():
+    """2-D mesh: data=4, model=2 — exercises the model-axis shardings."""
+    return build_mesh(MeshConfig(data=4, model=2))
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    return single_device_mesh()
+
+
+@pytest.fixture(autouse=True)
+def _default_mesh(mesh8):
+    set_default_mesh(mesh8)
+    yield
+    set_default_mesh(None)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def hospital_table(rng):
+    """Synthetic hospital-event table matching the reference schema
+    (mllearnforhospitalnetwork.py:64-72), with a known linear LOS signal."""
+    n = 400
+    admission = rng.integers(0, 50, n)
+    occupancy = rng.integers(20, 400, n)
+    emergency = rng.integers(0, 30, n)
+    season = rng.uniform(0.5, 1.5, n)
+    noise = rng.normal(0, 0.1, n)
+    los = (
+        0.05 * admission + 0.01 * occupancy + 0.08 * emergency + 1.5 * season + noise
+    )
+    base = np.datetime64("2025-03-31T22:00:00")
+    times = base + np.arange(n).astype("timedelta64[s]")
+    return ht.Table.from_dict(
+        {
+            "hospital_id": np.array([f"H{int(i) % 5:02d}" for i in range(n)], dtype=object),
+            "event_time": times,
+            "admission_count": admission,
+            "current_occupancy": occupancy,
+            "emergency_visits": emergency,
+            "seasonality_index": season,
+            "length_of_stay": los,
+        },
+        ht.hospital_event_schema(),
+    )

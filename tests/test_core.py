@@ -1,0 +1,94 @@
+"""Core table / schema / split tests (SURVEY.md §4 unit-test tier)."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.split import split_indices
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.config import PipelineConfig
+
+
+def test_schema_roundtrip():
+    s = ht.hospital_event_schema()
+    assert len(s) == 7
+    assert s.names[0] == "hospital_id"
+    assert s.field("length_of_stay").is_numeric
+    assert not s.field("hospital_id").is_numeric
+    assert s.numeric_names() == [
+        "admission_count",
+        "current_occupancy",
+        "emergency_visits",
+        "seasonality_index",
+        "length_of_stay",
+    ]
+
+
+def test_table_basics(hospital_table):
+    t = hospital_table
+    assert t.num_rows == 400
+    sel = t.select(["hospital_id", "length_of_stay"])
+    assert sel.schema.names == ["hospital_id", "length_of_stay"]
+    m = t.numeric_matrix(list(ht.FEATURE_COLS))
+    assert m.shape == (400, 4)
+
+
+def test_with_column_and_binarize(hospital_table):
+    t = ht.Binarizer("length_of_stay", "LOS_binary", 5.0).transform(hospital_table)
+    v = t.column("LOS_binary")
+    los = t.column("length_of_stay")
+    np.testing.assert_array_equal(v, (los > 5.0).astype(np.int64))
+
+
+def test_na_drop():
+    t = ht.Table.from_dict({"a": [1.0, np.nan, 3.0], "b": [1.0, 2.0, 3.0]})
+    assert t.na_drop().num_rows == 2
+
+
+def test_between_window(hospital_table):
+    # parity: SELECT * WHERE event_time BETWEEN start AND end (:123-128)
+    w = hospital_table.between(
+        "event_time", "2025-03-31T22:00:00", "2025-03-31T22:01:39"
+    )
+    assert w.num_rows == 100
+
+
+def test_split_deterministic(hospital_table):
+    tr1, te1 = ht.train_test_split(hospital_table, 0.7, seed=42)
+    tr2, te2 = ht.train_test_split(hospital_table, 0.7, seed=42)
+    assert tr1.num_rows == tr2.num_rows
+    np.testing.assert_array_equal(tr1.column("length_of_stay"), tr2.column("length_of_stay"))
+    assert tr1.num_rows + te1.num_rows == 400
+    assert abs(tr1.num_rows - 280) <= 1
+    idx = split_indices(100, [0.5, 0.5], seed=1)
+    assert len(np.intersect1d(idx[0], idx[1])) == 0
+
+
+def test_table_arrow_roundtrip(hospital_table):
+    pa_tbl = hospital_table.to_arrow()
+    back = ht.Table.from_arrow(pa_tbl, hospital_table.schema)
+    np.testing.assert_allclose(
+        back.column("seasonality_index"), hospital_table.column("seasonality_index")
+    )
+
+
+def test_config_parity_keys(tmp_path):
+    cfg = PipelineConfig()
+    assert cfg.los_threshold == 5.0          # :49
+    assert cfg.train_fraction == 0.7         # :139
+    assert cfg.split_seed == 42
+    assert cfg.watermark_minutes == 10.0     # :81
+    p = tmp_path / "cfg.json"
+    cfg.save_json(str(p))
+    cfg2 = PipelineConfig.from_json(str(p))
+    assert cfg2 == cfg
+    # reference camelCase spelling accepted
+    cfg3 = PipelineConfig.from_dict({"losThreshold": 6.5, "appName": "x"})
+    assert cfg3.los_threshold == 6.5 and cfg3.app_name == "x"
+
+
+def test_device_dataset_padding(mesh8):
+    x = np.arange(30, dtype=np.float64).reshape(10, 3)
+    y = np.arange(10, dtype=np.float64)
+    ds = ht.device_dataset(x, y, mesh=mesh8)
+    assert ds.n_padded == 16  # padded to multiple of 8
+    assert float(ds.count()) == 10.0

@@ -1,0 +1,76 @@
+"""LinearRegression: closed-form parity vs numpy lstsq; sharded == single-device."""
+
+import numpy as np
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import LinearRegression
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import load_model
+
+
+def _xy(rng, n=200, d=4):
+    x = rng.normal(size=(n, d))
+    w_true = np.array([1.0, -2.0, 0.5, 3.0])
+    y = x @ w_true + 0.7 + rng.normal(scale=0.01, size=n)
+    return x, y, w_true
+
+
+def test_lr_matches_lstsq(rng, mesh8):
+    x, y, w_true = _xy(rng)
+    model = LinearRegression().fit((x, y), mesh=mesh8)
+    xa = np.concatenate([x, np.ones((len(x), 1))], axis=1)
+    ref, *_ = np.linalg.lstsq(xa, y, rcond=None)
+    np.testing.assert_allclose(np.asarray(model.coefficients), ref[:4], atol=1e-3)
+    np.testing.assert_allclose(float(model.intercept), ref[4], atol=1e-3)
+
+
+def test_lr_sharded_equals_single(rng, mesh8, mesh1):
+    x, y, _ = _xy(rng, n=203)  # odd n forces padding
+    m8 = LinearRegression().fit((x, y), mesh=mesh8)
+    m1 = LinearRegression().fit((x, y), mesh=mesh1)
+    np.testing.assert_allclose(
+        np.asarray(m8.coefficients), np.asarray(m1.coefficients), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_lr_transform_and_rmse(rng, mesh8):
+    x, y, _ = _xy(rng)
+    model = LinearRegression().fit((x, y), mesh=mesh8)
+    result = model.transform((x, y), mesh=mesh8)
+    rmse = ht.RegressionEvaluator("rmse").evaluate(result)
+    assert rmse < 0.05
+    r2 = ht.RegressionEvaluator("r2").evaluate(result)
+    assert r2 > 0.99
+
+
+def test_lr_ridge_shrinks(rng, mesh8):
+    x, y, _ = _xy(rng)
+    m0 = LinearRegression(reg_param=0.0).fit((x, y), mesh=mesh8)
+    m1 = LinearRegression(reg_param=10.0).fit((x, y), mesh=mesh8)
+    assert np.linalg.norm(np.asarray(m1.coefficients)) < np.linalg.norm(
+        np.asarray(m0.coefficients)
+    )
+
+
+def test_lr_save_load_overwrite(rng, mesh8, tmp_path):
+    x, y, _ = _xy(rng)
+    model = LinearRegression().fit((x, y), mesh=mesh8)
+    path = str(tmp_path / "lr")
+    # spark-style chain: model.write().overwrite().save(path)  (:241-243)
+    model.write().overwrite().save(path)
+    model.write().overwrite().save(path)  # overwrite works
+    loaded = load_model(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded.coefficients), np.asarray(model.coefficients)
+    )
+    pred_a = model.predict_numpy(x[:5])
+    pred_b = loaded.predict_numpy(x[:5])
+    np.testing.assert_allclose(pred_a, pred_b, rtol=1e-6)
+
+
+def test_lr_on_hospital_table(hospital_table, mesh8):
+    assembler = ht.VectorAssembler(ht.FEATURE_COLS)
+    train, test = ht.train_test_split(hospital_table, 0.7, seed=42)
+    model = LinearRegression().fit(assembler.transform(train), label_col="length_of_stay", mesh=mesh8)
+    res = model.transform(assembler.transform(test), label_col="length_of_stay", mesh=mesh8)
+    rmse = ht.RegressionEvaluator("rmse").evaluate(res)
+    assert rmse < 0.2  # noise sigma is 0.1
