@@ -1,5 +1,9 @@
 from .base import Estimator, Model, PredictionResult, as_device_dataset
 from .linear_regression import LinearRegression, LinearRegressionModel
+from .kmeans import KMeans, KMeansModel
+from .gmm import GaussianMixture, GaussianMixtureModel
+from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
+from .streaming_kmeans import StreamingKMeans, StreamingKMeansModel
 
 __all__ = [
     "Estimator",
@@ -8,4 +12,12 @@ __all__ = [
     "as_device_dataset",
     "LinearRegression",
     "LinearRegressionModel",
+    "KMeans",
+    "KMeansModel",
+    "GaussianMixture",
+    "GaussianMixtureModel",
+    "BisectingKMeans",
+    "BisectingKMeansModel",
+    "StreamingKMeans",
+    "StreamingKMeansModel",
 ]

@@ -1,0 +1,130 @@
+"""BisectingKMeans — hierarchical divisive clustering (BASELINE config 4).
+
+Capability parity: ``pyspark.ml.clustering.BisectingKMeans`` (k,
+maxIter, seed, minDivisibleClusterSize; model exposes centers and can
+``computeCost``).  Spark grows the tree by repeatedly running distributed
+2-means on the rows of the cluster being split.  The TPU-native form keeps
+the *full* row-sharded array resident and bisects by **masking**: the
+subset being split is selected with a 0/1 weight vector (no gather, no
+dynamic shapes — XLA-friendly), and the inner 2-means is the same jit'd
+Lloyd step as :class:`~.kmeans.KMeans` restricted by those weights.  The
+leaf chosen for each split is the one with the largest within-cluster SSE
+(falling back to largest size), matching Spark's divisible-cluster rule.
+
+Per-hospital federation note (BASELINE config 4 "one partition per TPU
+chip"): rows land on data shards by ingest order, so hospital-partitioned
+ingest → per-chip hospital locality; the bisection math is unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from ..ops.distance import assign_clusters, normalize_rows
+from ..parallel.mesh import default_mesh
+from ..parallel.sharding import DeviceDataset
+from .base import Estimator, Model, as_device_dataset
+from .kmeans import KMeans, KMeansModel
+
+
+@jax.jit
+def _masked_assign_cost(x, w, centers):
+    assign, mind2 = assign_clusters(x, centers)
+    return assign, jnp.sum(mind2 * w)
+
+
+@register_model("BisectingKMeansModel")
+@dataclass
+class BisectingKMeansModel(KMeansModel):
+    def _artifacts(self):
+        name, meta, arrays = super()._artifacts()
+        return ("BisectingKMeansModel", meta, arrays)
+
+
+@dataclass(frozen=True)
+class BisectingKMeans(Estimator):
+    k: int = 4
+    max_iter: int = 20                    # Lloyd iterations per bisection (Spark default)
+    seed: int = 0
+    min_divisible_cluster_size: float = 1.0  # rows (>=1) or fraction (<1), Spark semantics
+    distance_measure: str = "euclidean"
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> BisectingKMeansModel:
+        mesh = mesh or default_mesh()
+        ds: DeviceDataset = as_device_dataset(data, mesh=mesh)
+        x = ds.x.astype(jnp.float32)
+        if self.distance_measure == "cosine":
+            # train in the same geometry predict uses: unit sphere
+            x = normalize_rows(x) * ds.w[:, None]
+        n_total = float(jax.device_get(jnp.sum(ds.w)))
+        if n_total == 0:
+            raise ValueError("BisectingKMeans fit on an empty dataset")
+        min_size = (
+            self.min_divisible_cluster_size
+            if self.min_divisible_cluster_size >= 1
+            else self.min_divisible_cluster_size * n_total
+        )
+
+        # assignment: leaf id per row; root center = weighted mean (on device)
+        assign = jnp.zeros((ds.n_padded,), jnp.int32)
+        root = np.asarray(
+            jax.device_get(
+                jnp.sum(x * ds.w[:, None], axis=0) / jnp.maximum(jnp.sum(ds.w), 1.0)
+            ),
+            dtype=np.float32,
+        )
+        if self.distance_measure == "cosine":
+            root = root / max(float(np.linalg.norm(root)), 1e-12)
+        centers: list[np.ndarray] = [root]
+        sse = {0: float(jax.device_get(_masked_assign_cost(x, ds.w, jnp.asarray(centers[0])[None])[1]))}
+        sizes = {0: n_total}
+        rng = np.random.default_rng(self.seed)
+
+        while len(centers) < self.k:
+            # pick the divisible leaf with the largest SSE
+            candidates = [c for c in sse if sizes[c] >= max(min_size, 2)]
+            if not candidates:
+                break
+            target = max(candidates, key=lambda c: (sse[c], sizes[c]))
+            mask = (assign == target).astype(x.dtype) * ds.w
+
+            # inner 2-means on the masked subset (x is already normalized in
+            # cosine mode; the inner fit re-normalizes idempotently and keeps
+            # its centroids on the sphere)
+            sub = KMeans(
+                k=2,
+                max_iter=self.max_iter,
+                seed=int(rng.integers(2**31 - 1)),
+                distance_measure=self.distance_measure,
+            )
+            sub_model = sub.fit(DeviceDataset(x=x, y=ds.y, w=mask), mesh=mesh)
+            c2 = jnp.asarray(sub_model.cluster_centers, jnp.float32)
+            sub_assign, _ = _masked_assign_cost(x, mask, c2)
+
+            new_id = len(centers)
+            in_target = assign == target
+            assign = jnp.where(in_target & (sub_assign == 1), new_id, assign)
+            centers[target] = sub_model.cluster_centers[0]
+            centers.append(sub_model.cluster_centers[1])
+
+            for cid, cen in ((target, centers[target]), (new_id, centers[new_id])):
+                m = (assign == cid).astype(x.dtype) * ds.w
+                _, cost = _masked_assign_cost(x, m, jnp.asarray(cen)[None])
+                sse[cid] = float(jax.device_get(cost))
+                sizes[cid] = float(jax.device_get(jnp.sum(m)))
+
+        all_centers = np.stack(centers).astype(np.float32)
+        total_cost = sum(sse.values())
+        counts = np.array([sizes[i] for i in range(len(centers))])
+        return BisectingKMeansModel(
+            cluster_centers=all_centers,
+            distance_measure=self.distance_measure,
+            training_cost=total_cost,
+            n_iter=len(centers) - 1,
+            cluster_sizes=counts,
+        )

@@ -1,0 +1,328 @@
+"""KMeans — the north-star workload (BASELINE.json: k=256 Lloyd loop on a
+TPU mesh at ≥10× Spark-CPU throughput).
+
+Capability parity: ``pyspark.ml.clustering.KMeans`` (named by the BASELINE
+configs; the reference script itself trains only supervised models —
+SURVEY.md §0 scope note).  Spark's implementation runs Lloyd iterations as
+RDD jobs: per-partition assignment + center sums combined via
+``treeAggregate`` (SURVEY.md §3.3).  The TPU-native design maps one Lloyd
+iteration onto the mesh as a single jit'd ``shard_map``:
+
+- **data axis**: rows are sharded; each device scans its rows in fixed-size
+  chunks (``lax.scan`` — static shapes, VMEM-friendly) computing the
+  (chunk, k) distance matrix as one MXU matmul (ops/distance.py).
+- **model axis**: for large k the *centroid* axis is sharded — each model
+  shard scores only its k/m centroids, a cross-shard ``all_gather`` of the
+  per-shard minima (m scalars per row, tiny) resolves the global argmin,
+  and each shard accumulates sums only for its own centroids.  This is the
+  classical-ML analogue of tensor parallelism (SURVEY.md §2C).
+- Center sums/counts are ``psum``'d over the data axis — the
+  ``treeAggregate`` replacement, riding ICI.
+
+Empty clusters keep their previous center (Spark behavior).  Convergence:
+max centroid movement < tol, or max_iter (Spark defaults 20, 1e-4).
+Initialization: ``k-means++`` on a host-side sample (Spark's default is
+k-means|| — a distributed approximation of the same objective; on TPU the
+sample fits on host so the exact sequential form is used) or ``random``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..io.model_io import register_model
+from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
+from ..parallel.sharding import DeviceDataset
+from .base import Estimator, Model, PredictionResult, as_device_dataset
+
+_BIG = jnp.float32(1e30)
+
+
+def _chunked(n_loc: int, target: int) -> tuple[int, int]:
+    """(n_chunks, chunk) covering n_loc with static shapes."""
+    chunk = min(max(target, 1), n_loc) if n_loc > 0 else 1
+    n_chunks = -(-n_loc // chunk) if n_loc > 0 else 1
+    return n_chunks, chunk
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
+def _make_train_step(
+    mesh: Mesh, n_loc: int, k_pad: int, d: int, chunk_rows: int, cosine: bool = False
+):
+    """One full Lloyd iteration as a shard_map over (data, model)."""
+    n_chunks, chunk = _chunked(n_loc, chunk_rows)
+    pad_to = n_chunks * chunk
+    m = mesh.shape[MODEL_AXIS]
+    k_loc = k_pad // m
+
+    def shard_fn(x, w, centers, c_valid):
+        # x: (n_loc, d) data-shard; centers: (k_loc, d) model-shard;
+        # c_valid: (k_loc,) 1.0 for real centroids, 0.0 for k-padding.
+        my_m = lax.axis_index(MODEL_AXIS)
+        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
+        wp = jnp.pad(w, (0, pad_to - n_loc))
+        xc = xp.reshape(n_chunks, chunk, d)
+        wc = wp.reshape(n_chunks, chunk)
+        c_sq = sq_norms(centers)
+
+        def body(carry, inputs):
+            sums, counts, cost = carry
+            xb, wb = inputs
+            d2 = pairwise_sqdist(xb, centers, c_sq=c_sq)
+            d2 = jnp.where(c_valid[None, :] > 0, d2, _BIG)
+            loc_min = jnp.min(d2, axis=1)
+            loc_arg = jnp.argmin(d2, axis=1).astype(jnp.int32)
+            # Resolve global argmin across the model axis: m scalars/row.
+            all_min = lax.all_gather(loc_min, MODEL_AXIS)        # (m, chunk)
+            owner = jnp.argmin(all_min, axis=0).astype(jnp.int32)  # (chunk,)
+            g_min = jnp.min(all_min, axis=0)
+            mine = (owner == my_m) & (wb > 0)
+            onehot = jax.nn.one_hot(loc_arg, k_loc, dtype=xb.dtype)
+            onehot = onehot * (mine.astype(xb.dtype) * wb)[:, None]
+            sums = sums + onehot.T @ xb
+            counts = counts + jnp.sum(onehot, axis=0)
+            cost = cost + jnp.sum(g_min * wb)
+            return (sums, counts, cost), None
+
+        init = jax.tree.map(
+            lambda z: lax.pcast(z, (DATA_AXIS, MODEL_AXIS), to="varying"),
+            (
+                jnp.zeros((k_loc, d), x.dtype),
+                jnp.zeros((k_loc,), x.dtype),
+                jnp.zeros((), x.dtype),
+            ),
+        )
+        (sums, counts, cost), _ = lax.scan(body, init, (xc, wc))
+        sums = lax.psum(sums, DATA_AXIS)
+        counts = lax.psum(counts, DATA_AXIS)
+        # cost is numerically identical on every model shard (it is built
+        # from the global per-row minima); pmax collapses the model-axis
+        # variance so it can be emitted replicated.
+        cost = lax.pmax(lax.psum(cost, DATA_AXIS), MODEL_AXIS)
+        new_centers = jnp.where(
+            (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], centers
+        )
+        if cosine:
+            # Spark's CosineDistanceMeasure re-normalizes the centroid after
+            # every update; without this the ||c||² term in the distance
+            # stops ordering by cosine similarity.
+            from ..ops.distance import normalize_rows
+
+            new_centers = normalize_rows(new_centers)
+        move = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1) * c_valid)
+        move = lax.pmax(move, MODEL_AXIS)
+        return new_centers, counts, cost, move
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
+            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P()),
+        )
+    )
+
+
+def _kmeans_pp_init(sample: np.ndarray, k: int, seed: int) -> np.ndarray:
+    """Greedy k-means++ on a host-side sample: at each step draw
+    ``2 + ⌊log k⌋`` D²-weighted candidates and keep the one minimizing the
+    resulting potential (the variant sklearn uses; materially better local
+    optima than single-draw ++ when clusters are close)."""
+    rng = np.random.default_rng(seed)
+    n = sample.shape[0]
+    if n == 0:
+        raise ValueError("cannot initialize k-means on an empty dataset")
+    n_trials = 2 + int(np.log(max(k, 2)))
+    centers = np.empty((k, sample.shape[1]), dtype=np.float64)
+    idx = int(rng.integers(n))
+    centers[0] = sample[idx]
+    d2 = np.sum((sample - centers[0]) ** 2, axis=1)
+    for i in range(1, k):
+        total = d2.sum()
+        if total <= 0:
+            centers[i:] = sample[rng.integers(n, size=k - i)]
+            break
+        # replace=False requires at least `size` nonzero-probability entries
+        # (duplicate-heavy data can leave just one distinct far point)
+        cand = rng.choice(
+            n,
+            size=min(n_trials, n, int(np.count_nonzero(d2))),
+            p=d2 / total,
+            replace=False,
+        )
+        # candidate-wise new potentials: (t, n) min against current d2
+        cand_d2 = np.minimum(
+            d2[None, :],
+            ((sample[None, :, :] - sample[cand][:, None, :]) ** 2).sum(axis=2),
+        )
+        best = int(np.argmin(cand_d2.sum(axis=1)))
+        centers[i] = sample[cand[best]]
+        d2 = cand_d2[best]
+    return centers
+
+
+def _lloyd_refine(sample: np.ndarray, centers: np.ndarray, iters: int = 10) -> np.ndarray:
+    """A few host-side Lloyd iterations to polish an init (numpy; used for
+    initialization only — the sample is bounded)."""
+    centers = centers.copy()
+    for _ in range(iters):
+        d2 = (
+            (sample * sample).sum(axis=1)[:, None]
+            - 2.0 * sample @ centers.T
+            + (centers * centers).sum(axis=1)[None, :]
+        )
+        assign = np.argmin(d2, axis=1)
+        for j in range(centers.shape[0]):
+            m = assign == j
+            if m.any():
+                centers[j] = sample[m].mean(axis=0)
+    return centers
+
+
+@jax.jit
+def _predict_fn(x, centers):
+    from ..ops.distance import assign_clusters
+
+    return assign_clusters(x, centers)[0]
+
+
+@register_model("KMeansModel")
+@dataclass
+class KMeansModel(Model):
+    cluster_centers: np.ndarray          # (k, d)
+    distance_measure: str = "euclidean"
+    training_cost: float = 0.0           # final inertia (Spark summary.trainingCost)
+    n_iter: int = 0
+    cluster_sizes: np.ndarray | None = None
+
+    @property
+    def k(self) -> int:
+        return self.cluster_centers.shape[0]
+
+    def _prep(self, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.float32)
+        return normalize_rows(x) if self.distance_measure == "cosine" else x
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        centers = jnp.asarray(self.cluster_centers, jnp.float32)
+        return _predict_fn(self._prep(x), centers)
+
+    def compute_cost(self, data, mesh=None) -> float:
+        """Sum of squared distances to nearest center (Spark computeCost)."""
+        ds = as_device_dataset(data, mesh=mesh)
+        x = self._prep(ds.x)
+        centers = jnp.asarray(self.cluster_centers, jnp.float32)
+        d2 = pairwise_sqdist(x, centers)
+        return float(jnp.sum(jnp.min(d2, axis=1) * ds.w))
+
+    def _artifacts(self):
+        return (
+            "KMeansModel",
+            {
+                "distance_measure": self.distance_measure,
+                "training_cost": self.training_cost,
+                "n_iter": self.n_iter,
+            },
+            {
+                "cluster_centers": np.asarray(self.cluster_centers),
+                "cluster_sizes": (
+                    np.asarray(self.cluster_sizes)
+                    if self.cluster_sizes is not None
+                    else np.zeros((self.k,))
+                ),
+            },
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            cluster_centers=arrays["cluster_centers"],
+            distance_measure=params.get("distance_measure", "euclidean"),
+            training_cost=float(params.get("training_cost", 0.0)),
+            n_iter=int(params.get("n_iter", 0)),
+            cluster_sizes=arrays.get("cluster_sizes"),
+        )
+
+
+@dataclass(frozen=True)
+class KMeans(Estimator):
+    k: int = 8
+    max_iter: int = 20            # Spark default
+    tol: float = 1e-4             # Spark default
+    seed: int = 0
+    init_mode: str = "k-means++"  # or "random"
+    distance_measure: str = "euclidean"  # or "cosine"
+    chunk_rows: int = 16384
+    init_sample_size: int = 65536
+
+    def _init_centers(self, ds: DeviceDataset, mesh: Mesh) -> np.ndarray:
+        # Host-side init on a bounded sample of valid rows (only the sample
+        # crosses the device→host boundary).
+        from ..parallel.sharding import sample_valid_rows
+
+        valid = sample_valid_rows(ds, self.init_sample_size, self.seed)
+        if valid.shape[0] == 0:
+            raise ValueError("k-means fit on an empty dataset")
+        rng = np.random.default_rng(self.seed)
+        if self.distance_measure == "cosine":
+            norms = np.sqrt(np.maximum((valid * valid).sum(axis=1), 1e-12))
+            valid = valid / norms[:, None]
+        if self.init_mode == "random":
+            pick = rng.choice(valid.shape[0], size=min(self.k, valid.shape[0]), replace=False)
+            centers = valid[pick]
+            if centers.shape[0] < self.k:  # fewer distinct rows than k
+                extra = valid[rng.integers(valid.shape[0], size=self.k - centers.shape[0])]
+                centers = np.concatenate([centers, extra])
+            return centers
+        return _kmeans_pp_init(valid, self.k, self.seed)
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> KMeansModel:
+        mesh = mesh or default_mesh()
+        ds = as_device_dataset(data, mesh=mesh)
+        x = ds.x.astype(jnp.float32)
+        if self.distance_measure == "cosine":
+            x = normalize_rows(x) * ds.w[:, None]
+        centers0 = self._init_centers(DeviceDataset(x, ds.y, ds.w), mesh)
+
+        m = mesh.shape[MODEL_AXIS]
+        k_pad = -(-self.k // m) * m
+        d = x.shape[1]
+        cen = np.zeros((k_pad, d), dtype=np.float32)
+        cen[: self.k] = centers0
+        c_valid = np.zeros((k_pad,), dtype=np.float32)
+        c_valid[: self.k] = 1.0
+        centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+        c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+
+        n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+        step = _make_train_step(
+            mesh, n_loc, k_pad, d, self.chunk_rows, self.distance_measure == "cosine"
+        )
+
+        cost = 0.0
+        counts = None
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            centers, counts, cost_dev, move = step(x, ds.w, centers, c_valid_dev)
+            if float(move) <= self.tol * self.tol:
+                break
+        final = np.asarray(jax.device_get(centers))[: self.k]
+        sizes = np.asarray(jax.device_get(counts))[: self.k] if counts is not None else None
+        return KMeansModel(
+            cluster_centers=final,
+            distance_measure=self.distance_measure,
+            training_cost=float(cost_dev) if it else 0.0,
+            n_iter=it,
+            cluster_sizes=sizes,
+        )

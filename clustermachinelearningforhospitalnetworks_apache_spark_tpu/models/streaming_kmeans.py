@@ -1,0 +1,172 @@
+"""StreamingKMeans — incremental k-means over micro-batches (BASELINE
+config 5: "StreamingKMeans on HL7/FHIR admission micro-batches").
+
+Capability parity: ``pyspark.mllib.clustering.StreamingKMeans`` — the
+forgetful update rule with a decay factor (or half-life in batches/points):
+
+    cₜ₊₁ = (cₜ·nₜ·α + Σ_{batch} x) / (nₜ·α + mₜ)
+    nₜ₊₁ = nₜ·α + mₜ
+
+Each micro-batch update is one jit'd assignment pass (the same MXU distance
+matmul as batch KMeans) plus the decayed merge — constant work per batch,
+no growth with stream length.  Dying clusters (decayed count below a
+threshold) are re-seeded by splitting the largest cluster, as Spark does.
+
+Plugs into the streaming micro-batch driver (streaming/microbatch.py) as a
+``foreachBatch``-style consumer — the working version of the reference's
+dead incremental-training hook (``mllearnforhospitalnetwork.py:87-106``,
+SURVEY.md C6/D2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from ..ops.distance import assign_clusters
+from ..parallel.mesh import default_mesh
+from ..parallel.sharding import DeviceDataset
+from .base import Model, as_device_dataset
+from .kmeans import KMeansModel
+
+
+@jax.jit
+def _batch_stats(x, w, centers):
+    assign, mind2 = assign_clusters(x, centers)
+    onehot = jax.nn.one_hot(assign, centers.shape[0], dtype=x.dtype) * w[:, None]
+    sums = onehot.T @ x
+    counts = jnp.sum(onehot, axis=0)
+    cost = jnp.sum(mind2 * w)
+    return sums, counts, cost
+
+
+@register_model("StreamingKMeansModel")
+@dataclass
+class StreamingKMeansModel(KMeansModel):
+    cluster_weights: np.ndarray | None = None  # decayed nₜ per cluster
+
+    def _artifacts(self):
+        name, meta, arrays = super()._artifacts()
+        arrays["cluster_weights"] = (
+            np.asarray(self.cluster_weights)
+            if self.cluster_weights is not None
+            else np.zeros((self.k,))
+        )
+        return ("StreamingKMeansModel", meta, arrays)
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        m = super().from_artifacts(params, arrays)
+        m.cluster_weights = arrays.get("cluster_weights")
+        return m
+
+
+@dataclass
+class StreamingKMeans:
+    """Stateful estimator: ``update(batch)`` per micro-batch.
+
+    decay_factor=1.0 → all history weighted equally; 0.0 → only the latest
+    batch.  ``half_life`` (in points or batches) overrides decay_factor,
+    matching Spark's ``setHalfLife``.
+    """
+
+    k: int = 8
+    decay_factor: float = 1.0
+    half_life: float | None = None
+    time_unit: str = "batches"  # or "points"
+    seed: int = 0
+    _centers: np.ndarray | None = field(default=None, repr=False)
+    _weights: np.ndarray | None = field(default=None, repr=False)
+    _steps: int = field(default=0, repr=False)
+
+    def set_initial_centers(self, centers: np.ndarray, weights: np.ndarray | None = None):
+        self._centers = np.asarray(centers, dtype=np.float32)
+        self._weights = (
+            np.asarray(weights, dtype=np.float64)
+            if weights is not None
+            else np.zeros((self._centers.shape[0],), dtype=np.float64)
+        )
+        return self
+
+    def set_random_centers(self, dim: int, weight: float = 0.0):
+        rng = np.random.default_rng(self.seed)
+        return self.set_initial_centers(
+            rng.normal(size=(self.k, dim)), np.full((self.k,), weight)
+        )
+
+    @property
+    def latest_model(self) -> StreamingKMeansModel:
+        if self._centers is None:
+            raise ValueError("StreamingKMeans has no centers yet; call update or set_*")
+        return StreamingKMeansModel(
+            cluster_centers=self._centers.copy(),
+            n_iter=self._steps,
+            cluster_weights=self._weights.copy(),
+        )
+
+    def update(self, batch, mesh=None) -> StreamingKMeansModel:
+        mesh = mesh or default_mesh()
+        ds = as_device_dataset(batch, mesh=mesh)
+        x = ds.x.astype(jnp.float32)
+        if self._centers is None:
+            # lazily init from the first batch: k-means++ seeding + short
+            # Lloyd refinement (raw ++ points alone are a poor init when two
+            # clusters are close)
+            from ..parallel.sharding import sample_valid_rows
+            from .kmeans import _kmeans_pp_init, _lloyd_refine
+
+            host = sample_valid_rows(
+                DeviceDataset(x, ds.y, ds.w), 65536, self.seed
+            )
+            self.set_initial_centers(
+                _lloyd_refine(host, _kmeans_pp_init(host, self.k, self.seed), iters=10)
+            )
+        sums, counts, _ = _batch_stats(x, ds.w, jnp.asarray(self._centers))
+        sums = np.asarray(jax.device_get(sums), dtype=np.float64)
+        counts = np.asarray(jax.device_get(counts), dtype=np.float64)
+
+        m = counts.sum()
+        if self.half_life is not None:
+            if self.time_unit == "points":
+                alpha = 0.5 ** (m / self.half_life) if self.half_life > 0 else 0.0
+            else:
+                alpha = 0.5 ** (1.0 / self.half_life) if self.half_life > 0 else 0.0
+        else:
+            alpha = self.decay_factor
+
+        decayed = self._weights * alpha
+        new_w = decayed + counts
+        safe = np.maximum(new_w, 1e-12)
+        self._centers = (
+            (self._centers * decayed[:, None] + sums) / safe[:, None]
+        ).astype(np.float32)
+        self._weights = new_w
+        self._steps += 1
+        self._reseed_dying(x_host=None)
+        return self.latest_model
+
+    def _reseed_dying(self, x_host, threshold_ratio: float = 1e-8):
+        """Split the heaviest cluster to replace any effectively-dead one
+        (Spark's dying-cluster rule)."""
+        total = self._weights.sum()
+        if total <= 0:
+            return
+        dead = np.where(self._weights < threshold_ratio * total)[0]
+        if len(dead) == 0:
+            return
+        rng = np.random.default_rng(self.seed + self._steps)
+        for idx in dead:
+            big = int(np.argmax(self._weights))
+            if big == idx:
+                continue
+            jitter = 1e-4 * (np.abs(self._centers[big]) + 1e-4)
+            self._centers[idx] = self._centers[big] + rng.normal(size=jitter.shape) * jitter
+            self._weights[idx] = self._weights[big] / 2
+            self._weights[big] = self._weights[big] / 2
+
+    def predict(self, x):
+        return self.latest_model.predict(x)

@@ -1,0 +1,3 @@
+from .distance import assign_clusters, normalize_rows, pairwise_sqdist, sq_norms
+
+__all__ = ["assign_clusters", "normalize_rows", "pairwise_sqdist", "sq_norms"]

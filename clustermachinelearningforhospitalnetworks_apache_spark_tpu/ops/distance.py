@@ -1,0 +1,55 @@
+"""Pairwise-distance primitives.
+
+The Lloyd-assignment step of every clustering estimator (the hottest loop
+in the framework, per the BASELINE north star: "pairwise-distance matmul,
+argmin assignment") reduces to one MXU matmul: using
+
+    ||x − c||² = ||x||² − 2·x·cᵀ + ||c||²
+
+the (n, k) distance matrix is a single ``x @ cᵀ`` plus rank-1 row/column
+corrections, which XLA fuses with the following argmin.  MLlib's
+``fastSquaredDistance`` does the same trick scalar-by-scalar on the JVM;
+here it is batched onto the systolic array.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sq_norms(x: jax.Array) -> jax.Array:
+    return jnp.sum(x * x, axis=-1)
+
+
+def pairwise_sqdist(
+    x: jax.Array,
+    centers: jax.Array,
+    x_sq: jax.Array | None = None,
+    c_sq: jax.Array | None = None,
+    precision=lax.Precision.HIGHEST,
+) -> jax.Array:
+    """(n, d), (k, d) → (n, k) squared Euclidean distances (clamped ≥ 0)."""
+    if x_sq is None:
+        x_sq = sq_norms(x)
+    if c_sq is None:
+        c_sq = sq_norms(centers)
+    cross = jnp.dot(x, centers.T, precision=precision)
+    d2 = x_sq[:, None] - 2.0 * cross + c_sq[None, :]
+    return jnp.maximum(d2, 0.0)
+
+
+def normalize_rows(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Unit-normalize rows — cosine distance reduces to Euclidean on the
+    sphere (Spark's ``distanceMeasure="cosine"`` path)."""
+    n = jnp.sqrt(jnp.maximum(sq_norms(x), eps))
+    return x / n[:, None]
+
+
+def assign_clusters(
+    x: jax.Array, centers: jax.Array, c_sq: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """→ (argmin index (n,), min squared distance (n,))."""
+    d2 = pairwise_sqdist(x, centers, c_sq=c_sq)
+    return jnp.argmin(d2, axis=1).astype(jnp.int32), jnp.min(d2, axis=1)

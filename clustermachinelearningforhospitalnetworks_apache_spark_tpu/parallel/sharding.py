@@ -112,3 +112,22 @@ def device_dataset(
 def unpad(values: jax.Array, n: int) -> np.ndarray:
     """Fetch a row-aligned device result back to host and strip padding."""
     return np.asarray(jax.device_get(values))[:n]
+
+
+def sample_valid_rows(ds: DeviceDataset, size: int, seed: int) -> np.ndarray:
+    """Fetch a uniform sample of ≤``size`` valid rows to host.
+
+    Transfers only the weight vector plus the sampled rows (a device gather)
+    — not the full O(n·d) dataset; estimator init paths use this so a fit on
+    BASELINE-scale data doesn't stall on a host transfer before its first
+    device iteration.
+    """
+    w = np.asarray(jax.device_get(ds.w))
+    valid_idx = np.flatnonzero(w > 0)
+    if valid_idx.size == 0:
+        return np.empty((0, ds.n_features), dtype=np.float64)
+    if valid_idx.size > size:
+        rng = np.random.default_rng(seed)
+        valid_idx = np.sort(rng.choice(valid_idx, size=size, replace=False))
+    rows = jnp.take(ds.x, jnp.asarray(valid_idx), axis=0)
+    return np.asarray(jax.device_get(rows), dtype=np.float64)

@@ -1,0 +1,157 @@
+"""GaussianMixture, BisectingKMeans, StreamingKMeans tests."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+    BisectingKMeans,
+    GaussianMixture,
+    StreamingKMeans,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import load_model
+
+
+def _blobs(rng, n=600, k=3, d=4, spread=0.2, scale=4.0):
+    centers = rng.normal(scale=scale, size=(k, d))
+    labels = rng.integers(0, k, n)
+    x = centers[labels] + rng.normal(scale=spread, size=(n, d))
+    return x.astype(np.float64), labels, centers
+
+
+# ---------------------------------------------------------------- GMM
+def test_gmm_recovers_components(rng, mesh8):
+    x, labels, true_centers = _blobs(rng)
+    model = GaussianMixture(k=3, seed=0).fit(x, mesh=mesh8)
+    assert model.weights.shape == (3,)
+    np.testing.assert_allclose(model.weights.sum(), 1.0, atol=1e-5)
+    dist = np.linalg.norm(true_centers[:, None] - model.means[None], axis=2)
+    assert dist.min(axis=1).max() < 0.3
+    # responsibilities are near-deterministic on well-separated blobs
+    proba = np.asarray(model.predict_proba(ht.device_dataset(x, mesh=mesh8).x))
+    valid = proba[: len(x)]
+    assert (valid.max(axis=1) > 0.95).mean() > 0.95
+
+
+def test_gmm_loglik_improves(rng, mesh8):
+    x, _, _ = _blobs(rng, n=400)
+    m1 = GaussianMixture(k=3, seed=0, max_iter=1).fit(x, mesh=mesh8)
+    m20 = GaussianMixture(k=3, seed=0, max_iter=40).fit(x, mesh=mesh8)
+    assert m20.log_likelihood >= m1.log_likelihood - 1e-6
+
+
+def test_gmm_sklearn_parity(rng, mesh8):
+    from sklearn.mixture import GaussianMixture as SK
+
+    x, _, _ = _blobs(rng, n=500, k=3)
+    ours = GaussianMixture(k=3, seed=0, max_iter=100).fit(x, mesh=mesh8)
+    sk = SK(n_components=3, random_state=0, n_init=3).fit(x)
+    # mean per-sample log-likelihood should be close
+    assert abs(ours.log_likelihood - sk.score(x)) < 0.25
+
+
+def test_gmm_save_load(rng, mesh8, tmp_path):
+    x, _, _ = _blobs(rng, n=200)
+    model = GaussianMixture(k=2, seed=0).fit(x, mesh=mesh8)
+    model.save(str(tmp_path / "gmm"))
+    loaded = load_model(str(tmp_path / "gmm"))
+    np.testing.assert_allclose(loaded.means, model.means)
+    np.testing.assert_allclose(loaded.covariances, model.covariances)
+
+
+# ---------------------------------------------------- BisectingKMeans
+def test_bisecting_recovers_blobs(rng, mesh8):
+    x, labels, true_centers = _blobs(rng, k=4)
+    model = BisectingKMeans(k=4, seed=0).fit(x, mesh=mesh8)
+    assert model.cluster_centers.shape[0] == 4
+    dist = np.linalg.norm(true_centers[:, None] - model.cluster_centers[None], axis=2)
+    assert dist.min(axis=1).max() < 0.3
+    assert model.cluster_sizes.sum() == len(x)
+
+
+def test_bisecting_hierarchy_cost_decreases(rng, mesh8):
+    x, _, _ = _blobs(rng, k=4)
+    m2 = BisectingKMeans(k=2, seed=0).fit(x, mesh=mesh8)
+    m4 = BisectingKMeans(k=4, seed=0).fit(x, mesh=mesh8)
+    assert m4.training_cost < m2.training_cost
+
+
+def test_bisecting_min_divisible(rng, mesh8):
+    x, _, _ = _blobs(rng, n=100, k=2)
+    # min size larger than any cluster → no split beyond the root
+    model = BisectingKMeans(k=4, seed=0, min_divisible_cluster_size=1000).fit(x, mesh=mesh8)
+    assert model.cluster_centers.shape[0] == 1
+
+
+# ---------------------------------------------------- StreamingKMeans
+def test_streaming_kmeans_converges_on_stream(rng, mesh8):
+    x, labels, true_centers = _blobs(rng, n=2000, k=3)
+    sk = StreamingKMeans(k=3, decay_factor=1.0, seed=0)
+    for i in range(0, 2000, 250):
+        sk.update(x[i : i + 250], mesh=mesh8)
+    model = sk.latest_model
+    dist = np.linalg.norm(true_centers[:, None] - model.cluster_centers[None], axis=2)
+    assert dist.min(axis=1).max() < 0.3
+    assert model.n_iter == 8
+
+
+def test_streaming_kmeans_decay_forgets(rng, mesh8):
+    d = 3
+    old = rng.normal(size=(300, d)) + np.array([10.0, 0, 0])
+    new = rng.normal(size=(300, d)) + np.array([-10.0, 0, 0])
+    # full memory: centers stay influenced by old data
+    s_full = StreamingKMeans(k=1, decay_factor=1.0, seed=0)
+    s_full.update(old, mesh=mesh8)
+    s_full.update(new, mesh=mesh8)
+    # zero memory: centers jump to the new batch
+    s_zero = StreamingKMeans(k=1, decay_factor=0.0, seed=0)
+    s_zero.update(old, mesh=mesh8)
+    s_zero.update(new, mesh=mesh8)
+    assert abs(s_full.latest_model.cluster_centers[0, 0] - 0.0) < 1.0
+    assert abs(s_zero.latest_model.cluster_centers[0, 0] + 10.0) < 1.0
+
+
+def test_streaming_kmeans_half_life(rng, mesh8):
+    s = StreamingKMeans(k=1, half_life=1.0, time_unit="batches", seed=0)
+    s.update(np.zeros((100, 2)) + 4.0, mesh=mesh8)
+    s.update(np.zeros((100, 2)) - 4.0, mesh=mesh8)
+    # half-life 1 batch → old weight halved: center = (4*0.5*100 + -4*100)/(150)
+    np.testing.assert_allclose(
+        s.latest_model.cluster_centers[0, 0], (4 * 50 - 4 * 100) / 150, atol=1e-4
+    )
+
+
+def test_streaming_kmeans_save_load(rng, mesh8, tmp_path):
+    x, _, _ = _blobs(rng, n=300, k=2)
+    s = StreamingKMeans(k=2, seed=0)
+    s.update(x, mesh=mesh8)
+    s.latest_model.save(str(tmp_path / "skm"))
+    loaded = load_model(str(tmp_path / "skm"))
+    np.testing.assert_allclose(loaded.cluster_centers, s.latest_model.cluster_centers)
+    assert loaded.cluster_weights is not None
+
+
+def test_bisecting_cosine_fit_predict_consistent(rng, mesh8):
+    """Cosine geometry honored during training: predictions on the training
+    data match the training partition sizes (regression: fit used euclidean
+    while predict normalized)."""
+    a = rng.normal(size=(100, 3)) * 0.05 + np.array([1.0, 0, 0])
+    b = rng.normal(size=(100, 3)) * 0.05 + np.array([0, 1.0, 0])
+    x = np.concatenate([a * 1.0, b * 5.0])
+    model = BisectingKMeans(k=2, seed=0, distance_measure="cosine").fit(x, mesh=mesh8)
+    pred = model.predict_numpy(x)
+    sizes = np.sort(np.bincount(pred, minlength=2))
+    np.testing.assert_array_equal(sizes, np.sort(model.cluster_sizes.astype(int)))
+    assert set(np.bincount(pred, minlength=2)) == {100}
+
+
+def test_gmm_close_blobs_regression(rng, mesh8):
+    """5 blobs with one close pair (regression: global-covariance init made
+    EM merge the close pair)."""
+    rng2 = np.random.default_rng(42)
+    tc = rng2.normal(scale=4.0, size=(5, 4))
+    labels = rng2.integers(0, 5, 2000)
+    x = tc[labels] + rng2.normal(scale=0.25, size=(2000, 4))
+    gm = GaussianMixture(k=5, seed=0).fit(x, mesh=mesh8)
+    err = np.linalg.norm(tc[:, None] - gm.means[None], axis=2).min(axis=1).max()
+    assert err < 0.2

@@ -1,0 +1,122 @@
+"""KMeans: convergence on blobs vs sklearn, sharded-equals-single, cosine,
+2-D (data×model) mesh, save/load (SURVEY.md §4 unit + distributed tiers)."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import KMeans
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import load_model
+
+
+def _blobs(rng, n=600, k=4, d=5, spread=0.15):
+    centers = rng.normal(scale=3.0, size=(k, d))
+    labels = rng.integers(0, k, n)
+    x = centers[labels] + rng.normal(scale=spread, size=(n, d))
+    return x.astype(np.float64), labels, centers
+
+
+def test_kmeans_recovers_blobs(rng, mesh8):
+    x, labels, true_centers = _blobs(rng)
+    model = KMeans(k=4, seed=0).fit(x, mesh=mesh8)
+    assert model.n_iter >= 1
+    # every true center is within spread of a learned center
+    dist = np.linalg.norm(true_centers[:, None, :] - model.cluster_centers[None], axis=2)
+    assert dist.min(axis=1).max() < 0.2
+    # assignments respect the blob structure: same-blob rows share a cluster
+    pred = model.predict_numpy(x)
+    for b in range(4):
+        vals, counts = np.unique(pred[labels == b], return_counts=True)
+        assert counts.max() / counts.sum() > 0.99
+
+
+def test_kmeans_matches_sklearn_inertia(rng, mesh8):
+    from sklearn.cluster import KMeans as SK
+
+    x, _, _ = _blobs(rng, n=500, k=3)
+    ours = KMeans(k=3, seed=1, max_iter=50).fit(x, mesh=mesh8)
+    sk = SK(n_clusters=3, n_init=10, random_state=0).fit(x)
+    assert ours.training_cost <= sk.inertia_ * 1.05
+
+
+def test_kmeans_sharded_equals_single(rng, mesh8, mesh1):
+    x, _, _ = _blobs(rng, n=333)  # force padding
+    m8 = KMeans(k=4, seed=3).fit(x, mesh=mesh8)
+    m1 = KMeans(k=4, seed=3).fit(x, mesh=mesh1)
+    # same init (host-side, mesh-independent) → identical trajectories
+    c8 = m8.cluster_centers[np.lexsort(m8.cluster_centers.T)]
+    c1 = m1.cluster_centers[np.lexsort(m1.cluster_centers.T)]
+    np.testing.assert_allclose(c8, c1, rtol=1e-4, atol=1e-4)
+
+
+def test_kmeans_model_axis_sharding(rng, mesh42):
+    """data=4 × model=2 mesh: centroid axis sharded; k=6 pads to 6 (div by 2)."""
+    x, _, _ = _blobs(rng, n=400, k=6, d=4)
+    model = KMeans(k=6, seed=0).fit(x, mesh=mesh42)
+    assert model.cluster_centers.shape == (6, 4)
+    sil = ht.ClusteringEvaluator().evaluate(x, model.predict_numpy(x), k=6)
+    assert sil > 0.6
+
+
+def test_kmeans_model_axis_k_padding(rng, mesh42):
+    """k=5 not divisible by model=2 → internal padding must stay inert."""
+    x, _, _ = _blobs(rng, n=300, k=5, d=3)
+    model = KMeans(k=5, seed=0).fit(x, mesh=mesh42)
+    assert model.cluster_centers.shape == (5, 3)
+    assert np.isfinite(model.cluster_centers).all()
+    assert model.cluster_sizes.sum() == 300
+
+
+def test_kmeans_cosine(rng, mesh8):
+    # two direction-clusters at different magnitudes
+    a = rng.normal(size=(100, 3)) * 0.05 + np.array([1.0, 0, 0])
+    b = rng.normal(size=(100, 3)) * 0.05 + np.array([0, 1.0, 0])
+    x = np.concatenate([a * 1.0, b * 5.0])
+    model = KMeans(k=2, seed=0, distance_measure="cosine").fit(x, mesh=None)
+    pred = model.predict_numpy(x)
+    assert len(set(pred[:100])) == 1 and len(set(pred[100:])) == 1
+    assert pred[0] != pred[150]
+
+
+def test_kmeans_silhouette_parity_sklearn(rng, mesh8):
+    from sklearn.metrics import silhouette_score
+
+    x, _, _ = _blobs(rng, n=300, k=3)
+    model = KMeans(k=3, seed=0).fit(x, mesh=mesh8)
+    pred = model.predict_numpy(x)
+    ours = ht.ClusteringEvaluator().evaluate(x, pred, k=3)
+    ref = silhouette_score(x, pred, metric="sqeuclidean")
+    np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+
+def test_kmeans_save_load(rng, mesh8, tmp_path):
+    x, _, _ = _blobs(rng, n=200, k=3)
+    model = KMeans(k=3, seed=0).fit(x, mesh=mesh8)
+    model.write().overwrite().save(str(tmp_path / "km"))
+    loaded = load_model(str(tmp_path / "km"))
+    np.testing.assert_allclose(loaded.cluster_centers, model.cluster_centers)
+    np.testing.assert_array_equal(loaded.predict_numpy(x), model.predict_numpy(x))
+    assert loaded.n_iter == model.n_iter
+
+
+def test_kmeans_compute_cost(rng, mesh8):
+    x, _, _ = _blobs(rng, n=200, k=3)
+    model = KMeans(k=3, seed=0, max_iter=50).fit(x, mesh=mesh8)
+    cost = model.compute_cost(x, mesh=mesh8)
+    np.testing.assert_allclose(cost, model.training_cost, rtol=0.05)
+
+
+def test_kmeans_init_duplicate_heavy(rng, mesh8):
+    """Duplicate-heavy data: fewer distinct points than ++ candidate trials
+    (regression: rng.choice(replace=False) needs enough nonzero-p entries)."""
+    x = np.concatenate([np.zeros((50, 3)), np.ones((1, 3))])
+    model = KMeans(k=3, seed=0).fit(x, mesh=mesh8)
+    assert np.isfinite(model.cluster_centers).all()
+
+
+def test_kmeans_cosine_centroids_unit_norm(rng, mesh8):
+    """Cosine mode keeps centroids on the unit sphere after every update."""
+    x = rng.normal(size=(200, 4)) + np.array([3.0, 0, 0, 0])
+    model = KMeans(k=3, seed=0, distance_measure="cosine").fit(x, mesh=mesh8)
+    norms = np.linalg.norm(model.cluster_centers, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
