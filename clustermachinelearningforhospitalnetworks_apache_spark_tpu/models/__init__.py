@@ -4,6 +4,14 @@ from .kmeans import KMeans, KMeansModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
 from .streaming_kmeans import StreamingKMeans, StreamingKMeansModel
+from .tree import (
+    DecisionTreeClassifier,
+    DecisionTreeModel,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+    RandomForestModel,
+    RandomForestRegressor,
+)
 
 __all__ = [
     "Estimator",
@@ -20,4 +28,10 @@ __all__ = [
     "BisectingKMeansModel",
     "StreamingKMeans",
     "StreamingKMeansModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeModel",
+    "DecisionTreeRegressor",
+    "RandomForestClassifier",
+    "RandomForestModel",
+    "RandomForestRegressor",
 ]

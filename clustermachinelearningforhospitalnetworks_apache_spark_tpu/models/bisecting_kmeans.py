@@ -28,7 +28,7 @@ from ..io.model_io import register_model
 from ..ops.distance import assign_clusters, normalize_rows
 from ..parallel.mesh import default_mesh
 from ..parallel.sharding import DeviceDataset
-from .base import Estimator, Model, as_device_dataset
+from .base import Estimator, as_device_dataset
 from .kmeans import KMeans, KMeansModel
 
 

@@ -24,7 +24,7 @@ from jax.scipy.special import logsumexp
 from ..io.model_io import register_model
 from ..parallel.mesh import default_mesh
 from ..parallel.sharding import DeviceDataset
-from .base import Estimator, Model, PredictionResult, as_device_dataset
+from .base import Estimator, Model, as_device_dataset
 from .kmeans import _kmeans_pp_init, _lloyd_refine
 
 
@@ -64,7 +64,8 @@ class GaussianMixtureModel(Model):
     weights: np.ndarray      # (k,)
     means: np.ndarray        # (k, d)
     covariances: np.ndarray  # (k, d, d)
-    log_likelihood: float = 0.0
+    log_likelihood: float = 0.0      # TOTAL (Spark summary.logLikelihood)
+    avg_log_likelihood: float = 0.0  # per-row mean (sklearn .score parity)
     n_iter: int = 0
 
     @property
@@ -97,7 +98,11 @@ class GaussianMixtureModel(Model):
     def _artifacts(self):
         return (
             "GaussianMixtureModel",
-            {"log_likelihood": self.log_likelihood, "n_iter": self.n_iter},
+            {
+                "log_likelihood": self.log_likelihood,
+                "avg_log_likelihood": self.avg_log_likelihood,
+                "n_iter": self.n_iter,
+            },
             {
                 "weights": np.asarray(self.weights),
                 "means": np.asarray(self.means),
@@ -112,6 +117,7 @@ class GaussianMixtureModel(Model):
             means=arrays["means"],
             covariances=arrays["covariances"],
             log_likelihood=float(params.get("log_likelihood", 0.0)),
+            avg_log_likelihood=float(params.get("avg_log_likelihood", 0.0)),
             n_iter=int(params.get("n_iter", 0)),
         )
 
@@ -144,18 +150,13 @@ class GaussianMixture(Estimator):
         # k-means++ seeding + short Lloyd refinement (sklearn's init_params=
         # "kmeans" equivalent) — raw ++ points alone leave EM in visibly
         # worse local optima on close blob pairs.
-        means = _lloyd_refine(
-            valid, _kmeans_pp_init(valid, self.k, self.seed), iters=10
-        ).astype(np.float32)
+        means64, assign0 = _lloyd_refine(
+            valid, _kmeans_pp_init(valid, self.k, self.seed), iters=10, return_assign=True
+        )
+        means = means64.astype(np.float32)
         # Per-cluster diagonal covariance + cluster-share weights from the
         # init assignment (global variance spans the blob spread and makes
         # the first E-step responsibilities near-uniform, collapsing means).
-        d2 = (
-            (valid * valid).sum(axis=1)[:, None]
-            - 2.0 * valid @ means.T.astype(np.float64)
-            + (means.astype(np.float64) ** 2).sum(axis=1)[None, :]
-        )
-        assign0 = np.argmin(d2, axis=1)
         covs = np.empty((self.k, d, d), dtype=np.float32)
         weights = np.empty((self.k,), dtype=np.float32)
         global_var = np.maximum(valid.var(axis=0), self.reg_covar)
@@ -187,7 +188,7 @@ class GaussianMixture(Estimator):
             )
             covs_d = covs_d + self.reg_covar * eye[None]
             weights_d = nk / jnp.sum(nk)
-            ll = float(ll_dev) / max(n, 1.0)  # mean per-row log-likelihood
+            ll = float(ll_dev)  # TOTAL log-likelihood — Spark applies tol here
             if abs(ll - prev_ll) < self.tol:
                 prev_ll = ll
                 break
@@ -198,5 +199,6 @@ class GaussianMixture(Estimator):
             means=np.asarray(jax.device_get(means_d)),
             covariances=np.asarray(jax.device_get(covs_d)),
             log_likelihood=ll,
+            avg_log_likelihood=ll / max(n, 1.0),
             n_iter=it,
         )

@@ -29,7 +29,7 @@ sample fits on host so the exact sequential form is used) or ``random``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +41,7 @@ from ..io.model_io import register_model
 from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
-from .base import Estimator, Model, PredictionResult, as_device_dataset
+from .base import Estimator, Model, as_device_dataset
 
 _BIG = jnp.float32(1e30)
 
@@ -51,9 +51,6 @@ def _chunked(n_loc: int, target: int) -> tuple[int, int]:
     chunk = min(max(target, 1), n_loc) if n_loc > 0 else 1
     n_chunks = -(-n_loc // chunk) if n_loc > 0 else 1
     return n_chunks, chunk
-
-
-from functools import lru_cache
 
 
 @lru_cache(maxsize=64)
@@ -117,8 +114,6 @@ def _make_train_step(
             # Spark's CosineDistanceMeasure re-normalizes the centroid after
             # every update; without this the ||c||² term in the distance
             # stops ordering by cosine similarity.
-            from ..ops.distance import normalize_rows
-
             new_centers = normalize_rows(new_centers)
         move = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1) * c_valid)
         move = lax.pmax(move, MODEL_AXIS)
@@ -172,21 +167,31 @@ def _kmeans_pp_init(sample: np.ndarray, k: int, seed: int) -> np.ndarray:
     return centers
 
 
-def _lloyd_refine(sample: np.ndarray, centers: np.ndarray, iters: int = 10) -> np.ndarray:
+def _host_sqdist(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(n, d), (k, d) → (n, k) squared distances — host-side counterpart of
+    ops.distance.pairwise_sqdist, shared by every host init path."""
+    return (
+        (a * a).sum(axis=1)[:, None]
+        - 2.0 * a @ b.T
+        + (b * b).sum(axis=1)[None, :]
+    )
+
+
+def _lloyd_refine(
+    sample: np.ndarray, centers: np.ndarray, iters: int = 10, return_assign: bool = False
+):
     """A few host-side Lloyd iterations to polish an init (numpy; used for
     initialization only — the sample is bounded)."""
     centers = centers.copy()
+    assign = np.zeros(sample.shape[0], dtype=np.int64)
     for _ in range(iters):
-        d2 = (
-            (sample * sample).sum(axis=1)[:, None]
-            - 2.0 * sample @ centers.T
-            + (centers * centers).sum(axis=1)[None, :]
-        )
-        assign = np.argmin(d2, axis=1)
+        assign = np.argmin(_host_sqdist(sample, centers), axis=1)
         for j in range(centers.shape[0]):
             m = assign == j
             if m.any():
                 centers[j] = sample[m].mean(axis=0)
+    if return_assign:
+        return centers, np.argmin(_host_sqdist(sample, centers), axis=1)
     return centers
 
 
@@ -310,19 +315,21 @@ class KMeans(Estimator):
             mesh, n_loc, k_pad, d, self.chunk_rows, self.distance_measure == "cosine"
         )
 
-        cost = 0.0
-        counts = None
         it = 0
         for it in range(1, self.max_iter + 1):
-            centers, counts, cost_dev, move = step(x, ds.w, centers, c_valid_dev)
+            centers, _, _, move = step(x, ds.w, centers, c_valid_dev)
             if float(move) <= self.tol * self.tol:
                 break
+        # One extra assignment pass so cost/sizes describe the RETURNED
+        # centers, not the pre-update ones (Spark's summary.trainingCost is
+        # the final model's cost).
+        _, counts, cost_dev, _ = step(x, ds.w, centers, c_valid_dev)
         final = np.asarray(jax.device_get(centers))[: self.k]
-        sizes = np.asarray(jax.device_get(counts))[: self.k] if counts is not None else None
+        sizes = np.asarray(jax.device_get(counts))[: self.k]
         return KMeansModel(
             cluster_centers=final,
             distance_measure=self.distance_measure,
-            training_cost=float(cost_dev) if it else 0.0,
+            training_cost=float(cost_dev),
             n_iter=it,
             cluster_sizes=sizes,
         )

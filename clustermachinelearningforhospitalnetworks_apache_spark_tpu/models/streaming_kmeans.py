@@ -141,15 +141,18 @@ class StreamingKMeans:
         decayed = self._weights * alpha
         new_w = decayed + counts
         safe = np.maximum(new_w, 1e-12)
-        self._centers = (
-            (self._centers * decayed[:, None] + sums) / safe[:, None]
+        merged = (self._centers * decayed[:, None] + sums) / safe[:, None]
+        # A cluster with no mass this step and no retained history keeps its
+        # old center rather than collapsing to zero (Spark's λ=0 behavior).
+        self._centers = np.where(
+            new_w[:, None] > 1e-12, merged, self._centers
         ).astype(np.float32)
         self._weights = new_w
         self._steps += 1
-        self._reseed_dying(x_host=None)
+        self._reseed_dying()
         return self.latest_model
 
-    def _reseed_dying(self, x_host, threshold_ratio: float = 1e-8):
+    def _reseed_dying(self, threshold_ratio: float = 1e-8):
         """Split the heaviest cluster to replace any effectively-dead one
         (Spark's dying-cluster rule)."""
         total = self._weights.sum()

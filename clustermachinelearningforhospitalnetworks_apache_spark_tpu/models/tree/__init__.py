@@ -1,0 +1,26 @@
+from .decision_tree import (
+    DecisionTreeClassifier,
+    DecisionTreeModel,
+    DecisionTreeRegressor,
+)
+from .random_forest import (
+    RandomForestClassifier,
+    RandomForestModel,
+    RandomForestRegressor,
+)
+from .engine import GrownForest, grow_forest, predict_forest
+from .binning import digitize, quantile_thresholds
+
+__all__ = [
+    "DecisionTreeClassifier",
+    "DecisionTreeModel",
+    "DecisionTreeRegressor",
+    "RandomForestClassifier",
+    "RandomForestModel",
+    "RandomForestRegressor",
+    "GrownForest",
+    "grow_forest",
+    "predict_forest",
+    "digitize",
+    "quantile_thresholds",
+]

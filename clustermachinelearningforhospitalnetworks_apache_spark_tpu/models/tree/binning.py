@@ -1,0 +1,43 @@
+"""Quantile binning for histogram-based tree training.
+
+MLlib's tree trainer first discretizes every continuous feature into at
+most ``maxBins`` quantile bins (one pass of approximate quantiles), then
+trains entirely on bin indices (reference path: ``RandomForest.run`` behind
+``mllearnforhospitalnetwork.py:150-158,183-190``; SURVEY.md §3.3).  Same
+design here: thresholds come from a host-side sample, rows are digitized
+once on device (vmapped ``searchsorted``), and every later level touches
+only the (n, d) int32 bin matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantile_thresholds(sample: np.ndarray, max_bins: int) -> np.ndarray:
+    """(d, max_bins-1) split thresholds per feature.
+
+    Bin b holds values in (thr[b-1], thr[b]]; going right means
+    ``value > thr[split_bin]``.  Duplicate quantiles (low-cardinality
+    features) are padded with +inf so the extra bins are simply never
+    populated.
+    """
+    n, d = sample.shape
+    out = np.full((d, max_bins - 1), np.inf, dtype=np.float64)
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    for f in range(d):
+        t = np.unique(np.quantile(sample[:, f], qs))
+        out[f, : t.size] = t
+    return out
+
+
+@jax.jit
+def digitize(x: jax.Array, thresholds: jax.Array) -> jax.Array:
+    """(n, d) float features → (n, d) int32 bin ids in [0, max_bins)."""
+
+    def one(col, thr):
+        return jnp.searchsorted(thr, col, side="left").astype(jnp.int32)
+
+    return jax.vmap(one, in_axes=(1, 0), out_axes=1)(x, thresholds)

@@ -1,0 +1,164 @@
+"""DecisionTreeRegressor / DecisionTreeClassifier.
+
+Parity with ``pyspark.ml.regression.DecisionTreeRegressor`` (reference
+``mllearnforhospitalnetwork.py:151-153``) and ``pyspark.ml.classification.
+DecisionTreeClassifier`` (``:183-186``), including ``featureImportances``
+(``:228-231``).  A decision tree is the single-tree case of the level-order
+histogram engine (engine.py); Spark defaults maxDepth=5, maxBins=32,
+minInstancesPerNode=1, minInfoGain=0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...io.model_io import register_model
+from ..base import Estimator, Model, as_device_dataset
+from .engine import GrownForest, grow_forest, predict_forest
+
+
+@dataclass
+class _TreeEnsembleModel(Model):
+    """Shared prediction/persistence machinery for single trees and forests."""
+
+    split_feat: np.ndarray
+    threshold: np.ndarray
+    value: np.ndarray
+    feature_importances: np.ndarray
+    max_depth: int
+    task: str = "regression"
+    num_classes: int = 2
+
+    @property
+    def num_trees(self) -> int:
+        return self.split_feat.shape[0]
+
+    @property
+    def total_num_nodes(self) -> int:
+        """Count of populated nodes across trees (split nodes + their leaves)."""
+        splits = (self.split_feat >= 0).sum()
+        return int(2 * splits + self.num_trees)
+
+    def _tree_outputs(self, x: jax.Array) -> jax.Array:
+        return predict_forest(
+            x.astype(jnp.float32),
+            jnp.asarray(self.split_feat),
+            jnp.asarray(self.threshold),
+            jnp.asarray(self.value),
+        )  # (T, n, V)
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        out = jnp.mean(self._tree_outputs(x), axis=0)  # (n, V)
+        if self.task == "regression":
+            return out[:, 0]
+        return jnp.argmax(out, axis=1).astype(jnp.float32)
+
+    def predict_proba(self, x: jax.Array) -> jax.Array:
+        if self.task != "classification":
+            raise ValueError("predict_proba is classification-only")
+        return jnp.mean(self._tree_outputs(x), axis=0)
+
+    # persistence ------------------------------------------------------
+    def _meta(self) -> dict:
+        return {
+            "task": self.task,
+            "num_classes": self.num_classes,
+            "max_depth": self.max_depth,
+        }
+
+    def _arrays(self) -> dict:
+        return {
+            "split_feat": self.split_feat,
+            "threshold": self.threshold,
+            "value": self.value,
+            "feature_importances": self.feature_importances,
+        }
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            split_feat=arrays["split_feat"],
+            threshold=arrays["threshold"],
+            value=arrays["value"],
+            feature_importances=arrays["feature_importances"],
+            max_depth=int(params["max_depth"]),
+            task=params["task"],
+            num_classes=int(params.get("num_classes", 2)),
+        )
+
+
+def _from_grown(cls, grown: GrownForest, task: str, num_classes: int, **extra):
+    imp = grown.importances.mean(axis=0)
+    s = imp.sum()
+    return cls(
+        split_feat=grown.split_feat,
+        threshold=grown.threshold,
+        value=grown.value,
+        feature_importances=imp / s if s > 0 else imp,
+        max_depth=grown.max_depth,
+        task=task,
+        num_classes=num_classes,
+        **extra,
+    )
+
+
+@register_model("DecisionTreeModel")
+@dataclass
+class DecisionTreeModel(_TreeEnsembleModel):
+    def _artifacts(self):
+        return ("DecisionTreeModel", self._meta(), self._arrays())
+
+
+@dataclass(frozen=True)
+class _TreeParams:
+    max_depth: int = 5
+    max_bins: int = 32
+    min_instances_per_node: int = 1
+    min_info_gain: float = 0.0
+    seed: int = 0
+    label_col: str = "length_of_stay"
+    features_col: str = "features"
+
+
+@dataclass(frozen=True)
+class DecisionTreeRegressor(Estimator, _TreeParams):
+    def fit(self, data, label_col: str | None = None, mesh=None) -> DecisionTreeModel:
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        grown = grow_forest(
+            ds,
+            task="regression",
+            num_trees=1,
+            max_depth=self.max_depth,
+            max_bins=self.max_bins,
+            min_instances_per_node=self.min_instances_per_node,
+            min_info_gain=self.min_info_gain,
+            seed=self.seed,
+            mesh=mesh,
+        )
+        return _from_grown(DecisionTreeModel, grown, "regression", 2)
+
+
+@dataclass(frozen=True)
+class DecisionTreeClassifier(Estimator, _TreeParams):
+    num_classes: int = 2
+    label_col: str = "LOS_binary"
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> DecisionTreeModel:
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        grown = grow_forest(
+            ds,
+            task="classification",
+            num_classes=self.num_classes,
+            num_trees=1,
+            max_depth=self.max_depth,
+            max_bins=self.max_bins,
+            min_instances_per_node=self.min_instances_per_node,
+            min_info_gain=self.min_info_gain,
+            seed=self.seed,
+            mesh=mesh,
+        )
+        return _from_grown(DecisionTreeModel, grown, "classification", self.num_classes)

@@ -1,0 +1,351 @@
+"""Level-order histogram tree growth — the distributed-trees engine.
+
+This is the TPU redesign of MLlib's ``RandomForest.findBestSplits`` loop
+(exercised by the reference's DT/RF fits, ``mllearnforhospitalnetwork.py:
+150-158,183-190``; SURVEY.md §3.3 "the hottest path"):
+
+    Spark                                   here
+    -----                                   ----
+    executors build per-node label          one jit'd shard_map: scatter-add
+    histograms per feature-bin over         per-shard histograms over the
+    their row partitions                    (node, feature, bin) lattice
+    treeAggregate combines them             lax.psum over the data axis
+    driver selects best splits,             host argmax over the (tiny)
+    broadcasts next node set                histogram tensor between steps
+
+Irregular tree control flow is made XLA-friendly (SURVEY.md §7 hard part 1)
+by **fixed-depth level-order growth with a padded node frontier**: every
+level processes all 2^t heap slots (empty nodes contribute zero mass), so
+shapes are static and the per-level device work is one scan + scatter.
+
+The same engine trains a whole forest at once: trees are a leading vmap
+axis (the "expert-parallel" analogue of SURVEY.md §2C — per-tree Poisson
+bootstrap weights differ, the bin matrix is shared).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...parallel.mesh import DATA_AXIS, default_mesh
+from .binning import digitize, quantile_thresholds
+
+
+# --------------------------------------------------------------------- hist
+@lru_cache(maxsize=64)
+def _make_level_hist(mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int):
+    """jit'd: per-(tree, level-node, feature, bin) stat histograms.
+
+    binned: (n, d) int32 — shared across trees
+    stats:  (T, n, S) float32 — per-row stat vector (already includes the
+            per-tree bootstrap/validity weight)
+    pos:    (T, n) int32 — row's position within the level frontier,
+            -1 for rows parked on leaves / out of tree
+    → (T, level_nodes, d, B, S), psum'd over the data axis.
+    """
+
+    def shard_fn(binned, stats, pos):
+        n_loc = binned.shape[0]
+        feat_ids = jax.lax.broadcasted_iota(jnp.int32, (n_loc, d), 1)
+
+        def per_tree(stats_t, pos_t):
+            active = pos_t >= 0
+            safe_pos = jnp.where(active, pos_t, 0)
+            flat = (
+                safe_pos[:, None] * (d * B) + feat_ids * B + binned
+            )  # (n_loc, d)
+            upd = jnp.broadcast_to(
+                (stats_t * active[:, None].astype(stats_t.dtype))[:, None, :],
+                (n_loc, d, S),
+            )
+            hist = jnp.zeros((level_nodes * d * B, S), stats_t.dtype)
+            hist = hist.at[flat.reshape(-1)].add(upd.reshape(-1, S))
+            return hist.reshape(level_nodes, d, B, S)
+
+        h = jax.vmap(per_tree)(stats, pos)
+        return lax.psum(h, DATA_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS, None), P(None, DATA_AXIS)),
+            out_specs=P(),
+        )
+    )
+
+
+@jax.jit
+def _advance_rows(binned, node_id, split_feat, split_bin):
+    """Move every active row to its child heap slot.
+
+    node_id: (T, n) current heap ids (-1 = parked on a leaf)
+    split_feat/split_bin: (T, total_nodes) — feat -1 marks a leaf node.
+    go right ⇔ bin > split_bin[node].
+    """
+
+    def per_tree(nid, sf, sb):
+        active = nid >= 0
+        safe = jnp.where(active, nid, 0)
+        f = sf[safe]
+        is_split = f >= 0
+        fb = jnp.take_along_axis(
+            binned, jnp.maximum(f, 0)[:, None], axis=1
+        )[:, 0]
+        right = (fb > sb[safe]).astype(jnp.int32)
+        child = 2 * safe + 1 + right
+        return jnp.where(active & is_split, child, jnp.where(active, -1, nid))
+
+    return jax.vmap(per_tree, in_axes=(0, 0, 0))(node_id, split_feat, split_bin)
+
+
+# ----------------------------------------------------------- split selection
+def _best_splits_regression(hist: np.ndarray, min_instances: int):
+    """hist: (T, nodes, d, B, 3) with stats (w, wy, wy²).
+    Returns per (T, node): gain, feat, bin, plus child/parent aggregates."""
+    cum = hist.cumsum(axis=3)                       # prefix over bins
+    total = cum[:, :, :, -1:, :]                    # (T,nodes,d,1,3)
+    wl, sl, ql = cum[..., 0], cum[..., 1], cum[..., 2]
+    wt, st, qt = total[..., 0], total[..., 1], total[..., 2]
+    wr, sr, qr = wt - wl, st - sl, qt - ql
+
+    def sse(w, s, q):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.where(w > 0, q - s * s / np.maximum(w, 1e-12), 0.0)
+
+    gain = sse(wt, st, qt) - sse(wl, sl, ql) - sse(wr, sr, qr)  # (T,nodes,d,B)
+    valid = (wl >= min_instances) & (wr >= min_instances)
+    gain = np.where(valid, gain, -np.inf)
+    gain[..., -1] = -np.inf  # last bin: empty right child by construction
+    return gain
+
+
+def _best_splits_classification(hist: np.ndarray, min_instances: int):
+    """hist: (T, nodes, d, B, C) per-class weighted counts. Gini gain."""
+    cum = hist.cumsum(axis=3)
+    total = cum[:, :, :, -1:, :]
+    left, right = cum, total - cum
+    wl = left.sum(-1)
+    wr = right.sum(-1)
+    wt = total.sum(-1)
+
+    def gini(counts, w):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.where(
+                w > 0, w - (counts * counts).sum(-1) / np.maximum(w, 1e-12), 0.0
+            )
+
+    gain = gini(total, wt) - gini(left, wl) - gini(right, wr)
+    valid = (wl >= min_instances) & (wr >= min_instances)
+    gain = np.where(valid, gain, -np.inf)
+    gain[..., -1] = -np.inf
+    return gain
+
+
+# ------------------------------------------------------------------- output
+@dataclass
+class GrownForest:
+    """Flat heap-layout ensemble (T trees × (2^(depth+1)-1) nodes)."""
+
+    split_feat: np.ndarray      # (T, total) int32, -1 = leaf
+    split_bin: np.ndarray       # (T, total) int32
+    threshold: np.ndarray       # (T, total) float32 — real-valued split point
+    value: np.ndarray           # (T, total, V) float32 — leaf prediction stats
+    importances: np.ndarray     # (T, d)
+    max_depth: int
+    bin_thresholds: np.ndarray  # (d, B-1)
+
+
+def grow_forest(
+    ds,
+    *,
+    task: str,                      # "regression" | "classification"
+    num_classes: int = 2,
+    num_trees: int = 1,
+    max_depth: int = 5,
+    max_bins: int = 32,
+    min_instances_per_node: int = 1,
+    min_info_gain: float = 0.0,
+    feature_subset_size: int | None = None,   # per-node; None = all features
+    bootstrap: bool = False,
+    subsampling_rate: float = 1.0,
+    seed: int = 0,
+    mesh: Mesh | None = None,
+    init_sample_size: int = 65536,
+) -> GrownForest:
+    """Train ``num_trees`` trees level-by-level on the sharded dataset."""
+    from ...parallel.sharding import sample_valid_rows
+
+    mesh = mesh or default_mesh()
+    n_pad = ds.n_padded
+    d = ds.n_features
+    T = num_trees
+    B = max_bins
+    rng = np.random.default_rng(seed)
+
+    # 1. binning (host-sample thresholds, device digitize)
+    sample = sample_valid_rows(ds, init_sample_size, seed)
+    if sample.shape[0] == 0:
+        raise ValueError("tree fit on an empty dataset")
+    thr = quantile_thresholds(sample, B)
+    binned = digitize(ds.x.astype(jnp.float32), jnp.asarray(thr, jnp.float32))
+
+    # 2. per-tree row weights: validity × (Poisson bootstrap | 1)
+    if bootstrap:
+        boot = rng.poisson(subsampling_rate, size=(T, n_pad)).astype(np.float32)
+    else:
+        boot = np.ones((T, n_pad), dtype=np.float32)
+    w_tree = jnp.asarray(boot) * ds.w[None, :].astype(jnp.float32)
+
+    # 3. per-row stat vectors
+    if task == "regression":
+        S = 3
+        y = ds.y.astype(jnp.float32)
+        stats = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)  # (n, 3)
+        stats = w_tree[:, :, None] * stats[None, :, :]
+    else:
+        S = num_classes
+        onehot = jax.nn.one_hot(ds.y.astype(jnp.int32), num_classes, dtype=jnp.float32)
+        stats = w_tree[:, :, None] * onehot[None, :, :]
+
+    total_nodes = 2 ** (max_depth + 1) - 1
+    split_feat = np.full((T, total_nodes), -1, dtype=np.int32)
+    split_bin = np.zeros((T, total_nodes), dtype=np.int32)
+    node_stats = np.zeros((T, total_nodes, S), dtype=np.float64)
+    importances = np.zeros((T, d), dtype=np.float64)
+
+    node_id = jnp.zeros((T, n_pad), jnp.int32)  # all rows start at the root
+
+    for depth in range(max_depth + 1):
+        level_nodes = 1 << depth
+        level_base = level_nodes - 1
+        pos = jnp.where(node_id >= 0, node_id - level_base, -1)
+        pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
+        hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T)
+        hist = np.asarray(jax.device_get(hist_fn(binned, stats, pos)), dtype=np.float64)
+        # (T, level_nodes, d, B, S)
+
+        # record node aggregates (same for every feature; use feature 0)
+        agg = hist[:, :, 0, :, :].sum(axis=2)  # (T, level_nodes, S)
+        node_stats[:, level_base : level_base + level_nodes] = agg
+
+        if depth == max_depth:
+            break  # leaves at the depth cap
+
+        if task == "regression":
+            gain = _best_splits_regression(hist, min_instances_per_node)
+        else:
+            gain = _best_splits_classification(hist, min_instances_per_node)
+
+        # per-(tree, node) feature subset (host-side mask, Spark's
+        # featureSubsetStrategy applied at split-selection time)
+        if feature_subset_size is not None and feature_subset_size < d:
+            mask = np.zeros((T, level_nodes, d), dtype=bool)
+            for t in range(T):
+                for p in range(level_nodes):
+                    mask[t, p, rng.choice(d, feature_subset_size, replace=False)] = True
+            gain = np.where(mask[..., None], gain, -np.inf)
+
+        flat = gain.reshape(T, level_nodes, d * B)
+        best = flat.argmax(axis=2)
+        best_gain = np.take_along_axis(flat, best[..., None], axis=2)[..., 0]
+        best_feat = (best // B).astype(np.int32)
+        best_bin = (best % B).astype(np.int32)
+
+        node_w = agg.sum(-1) if task == "classification" else agg[..., 0]
+        do_split = (
+            np.isfinite(best_gain)
+            & (best_gain > min_info_gain)
+            & (node_w >= 2 * min_instances_per_node)
+        )
+        sl = slice(level_base, level_base + level_nodes)
+        split_feat[:, sl] = np.where(do_split, best_feat, -1)
+        split_bin[:, sl] = np.where(do_split, best_bin, 0)
+        for t in range(T):
+            np.add.at(
+                importances[t],
+                best_feat[t][do_split[t]],
+                best_gain[t][do_split[t]],
+            )
+
+        if not do_split.any():
+            break
+        node_id = _advance_rows(
+            binned, node_id, jnp.asarray(split_feat), jnp.asarray(split_bin)
+        )
+
+    # 4. leaf/threshold materialization
+    threshold = np.zeros((T, total_nodes), dtype=np.float32)
+    valid_split = split_feat >= 0
+    f_idx = np.maximum(split_feat, 0)
+    b_idx = np.minimum(split_bin, B - 2)
+    threshold[valid_split] = thr[f_idx, b_idx][valid_split].astype(np.float32)
+
+    if task == "regression":
+        w = node_stats[..., 0]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mean = np.where(w > 0, node_stats[..., 1] / np.maximum(w, 1e-12), 0.0)
+        value = mean[..., None].astype(np.float32)  # (T, total, 1)
+    else:
+        w = node_stats.sum(-1, keepdims=True)
+        value = np.where(
+            w > 0, node_stats / np.maximum(w, 1e-12), 1.0 / num_classes
+        ).astype(np.float32)  # (T, total, C) class probabilities
+
+    # propagate values down so un-populated heap slots predict their parent
+    for parent in range(total_nodes // 2):
+        for child in (2 * parent + 1, 2 * parent + 2):
+            empty = (
+                node_stats[:, child].sum(-1) <= 0
+                if task == "classification"
+                else node_stats[:, child, 0] <= 0
+            )
+            value[:, child][empty] = value[:, parent][empty]
+
+    tot_imp = importances.sum(axis=1, keepdims=True)
+    importances = np.where(tot_imp > 0, importances / np.maximum(tot_imp, 1e-12), 0.0)
+
+    return GrownForest(
+        split_feat=split_feat,
+        split_bin=split_bin,
+        threshold=threshold,
+        value=value,
+        importances=importances,
+        max_depth=max_depth,
+        bin_thresholds=thr,
+    )
+
+
+# ------------------------------------------------------------------ predict
+@jax.jit
+def predict_forest(x, split_feat, threshold, value):
+    """Vectorized ensemble traversal.
+
+    x: (n, d); split_feat/threshold: (T, total); value: (T, total, V)
+    → (T, n, V) per-tree predictions (caller aggregates).
+    """
+
+    def per_tree(sf, th, val):
+        n = x.shape[0]
+        node = jnp.zeros((n,), jnp.int32)
+        depth = int(np.log2(sf.shape[0] + 1)) - 1
+
+        def body(_, node):
+            f = sf[node]
+            is_split = f >= 0
+            xv = jnp.take_along_axis(x, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+            right = (xv > th[node]).astype(jnp.int32)
+            child = 2 * node + 1 + right
+            return jnp.where(is_split, child, node)
+
+        node = lax.fori_loop(0, depth, body, node)
+        return val[node]
+
+    return jax.vmap(per_tree)(split_feat, threshold, value)

@@ -1,0 +1,102 @@
+"""RandomForestRegressor / RandomForestClassifier.
+
+Parity with ``pyspark.ml.regression.RandomForestRegressor`` (reference
+``mllearnforhospitalnetwork.py:156-158``) and ``...classification.
+RandomForestClassifier`` (``:187-190``), incl. ``featureImportances``
+(``:232-235``).  Spark defaults: numTrees=20, maxDepth=5, subsamplingRate
+=1.0 with Poisson bootstrap, featureSubsetStrategy "onethird" (regression)
+/ "sqrt" (classification).  All trees train simultaneously — the tree axis
+is a vmap dimension of the histogram engine (tree-axis parallelism, the EP
+analogue of SURVEY.md §2C), so a 20-tree forest costs one level-order pass,
+not twenty.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...io.model_io import register_model
+from ..base import Estimator, as_device_dataset
+from .decision_tree import _from_grown, _TreeEnsembleModel, _TreeParams
+from .engine import grow_forest
+
+
+def _subset_size(strategy: str, d: int, task: str) -> int | None:
+    if strategy == "auto":
+        strategy = "onethird" if task == "regression" else "sqrt"
+    if strategy == "all":
+        return None
+    if strategy == "sqrt":
+        return max(1, int(math.sqrt(d)))
+    if strategy == "onethird":
+        return max(1, d // 3)
+    if strategy == "log2":
+        return max(1, int(math.log2(d)))
+    raise ValueError(f"unknown featureSubsetStrategy {strategy!r}")
+
+
+@register_model("RandomForestModel")
+@dataclass
+class RandomForestModel(_TreeEnsembleModel):
+    def _artifacts(self):
+        return ("RandomForestModel", self._meta(), self._arrays())
+
+
+@dataclass(frozen=True)
+class RandomForestRegressor(Estimator, _TreeParams):
+    num_trees: int = 20
+    subsampling_rate: float = 1.0
+    feature_subset_strategy: str = "auto"
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> RandomForestModel:
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        grown = grow_forest(
+            ds,
+            task="regression",
+            num_trees=self.num_trees,
+            max_depth=self.max_depth,
+            max_bins=self.max_bins,
+            min_instances_per_node=self.min_instances_per_node,
+            min_info_gain=self.min_info_gain,
+            feature_subset_size=_subset_size(
+                self.feature_subset_strategy, ds.n_features, "regression"
+            ),
+            bootstrap=True,
+            subsampling_rate=self.subsampling_rate,
+            seed=self.seed,
+            mesh=mesh,
+        )
+        return _from_grown(RandomForestModel, grown, "regression", 2)
+
+
+@dataclass(frozen=True)
+class RandomForestClassifier(Estimator, _TreeParams):
+    num_trees: int = 20
+    num_classes: int = 2
+    subsampling_rate: float = 1.0
+    feature_subset_strategy: str = "auto"
+    label_col: str = "LOS_binary"
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> RandomForestModel:
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        grown = grow_forest(
+            ds,
+            task="classification",
+            num_classes=self.num_classes,
+            num_trees=self.num_trees,
+            max_depth=self.max_depth,
+            max_bins=self.max_bins,
+            min_instances_per_node=self.min_instances_per_node,
+            min_info_gain=self.min_info_gain,
+            feature_subset_size=_subset_size(
+                self.feature_subset_strategy, ds.n_features, "classification"
+            ),
+            bootstrap=True,
+            subsampling_rate=self.subsampling_rate,
+            seed=self.seed,
+            mesh=mesh,
+        )
+        return _from_grown(RandomForestModel, grown, "classification", self.num_classes)

@@ -120,3 +120,11 @@ def test_kmeans_cosine_centroids_unit_norm(rng, mesh8):
     model = KMeans(k=3, seed=0, distance_measure="cosine").fit(x, mesh=mesh8)
     norms = np.linalg.norm(model.cluster_centers, axis=1)
     np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
+def test_kmeans_training_cost_is_final(rng, mesh8):
+    """training_cost describes the returned centers, not the pre-update ones
+    (regression: cost was one Lloyd step stale)."""
+    x, _, _ = _blobs(rng, n=300, k=3)
+    m = KMeans(k=3, seed=0, max_iter=1).fit(x, mesh=mesh8)
+    np.testing.assert_allclose(m.training_cost, m.compute_cost(x, mesh=mesh8), rtol=1e-4)

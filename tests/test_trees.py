@@ -1,0 +1,137 @@
+"""Decision tree / random forest tests: exact-split recovery, sklearn
+parity, sharded-equals-single, classification pipeline parity."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import load_model
+
+
+def test_tree_recovers_axis_aligned_split(rng, mesh8):
+    """A clean split on a low-cardinality feature must be found exactly.
+
+    (Low cardinality is required: quantile binning — like Spark's maxBins —
+    places thresholds on quantile edges, so a boundary inside a dense
+    continuous region is only recovered to bin granularity; with ≤ max_bins
+    distinct values every value is its own bin edge.)"""
+    x = rng.uniform(0, 1, size=(500, 3))
+    x[:, 1] = rng.choice([0.2, 0.4, 0.7, 0.9], size=500)
+    y = np.where(x[:, 1] > 0.6, 5.0, 1.0)
+    model = DecisionTreeRegressor(max_depth=2, seed=0).fit((x, y), mesh=mesh8)
+    pred = model.predict_numpy(x)
+    np.testing.assert_allclose(pred, y, atol=1e-4)
+    # importance concentrated on feature 1
+    assert model.feature_importances[1] > 0.99
+
+
+def test_tree_regression_sklearn_parity(rng, mesh8):
+    from sklearn.tree import DecisionTreeRegressor as SK
+
+    x = rng.uniform(-2, 2, size=(800, 4))
+    y = np.sin(x[:, 0]) + 0.5 * (x[:, 2] > 0) + 0.1 * rng.normal(size=800)
+    ours = DecisionTreeRegressor(max_depth=5, max_bins=64, seed=0).fit((x, y), mesh=mesh8)
+    sk = SK(max_depth=5, random_state=0).fit(x, y)
+    our_mse = np.mean((ours.predict_numpy(x) - y) ** 2)
+    sk_mse = np.mean((sk.predict(x) - y) ** 2)
+    # binned splits vs exact splits: allow a modest gap
+    assert our_mse <= sk_mse * 1.3 + 1e-3
+
+
+def test_tree_classifier_binary(rng, mesh8):
+    x = rng.uniform(0, 1, size=(600, 4))
+    x[:, 0] = rng.choice([0.1, 0.3, 0.6, 0.8], size=600)
+    x[:, 3] = rng.choice([0.2, 0.4, 0.7, 0.9], size=600)
+    # AND target (greedy-splittable; XOR has zero marginal root gain and
+    # defeats any greedy tree, Spark's included)
+    y = ((x[:, 0] > 0.5) & (x[:, 3] > 0.5)).astype(np.int64)
+    model = DecisionTreeClassifier(max_depth=3, seed=0).fit((x, y), mesh=mesh8)
+    acc = (model.predict_numpy(x) == y).mean()
+    assert acc > 0.97
+    proba = np.asarray(model.predict_proba(ht.device_dataset(x, mesh=mesh8).x))[: len(x)]
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_tree_sharded_equals_single(rng, mesh8, mesh1):
+    x = rng.uniform(0, 1, size=(257, 4))
+    y = 2.0 * x[:, 0] + (x[:, 1] > 0.3) * 3.0
+    m8 = DecisionTreeRegressor(max_depth=4, seed=0).fit((x, y), mesh=mesh8)
+    m1 = DecisionTreeRegressor(max_depth=4, seed=0).fit((x, y), mesh=mesh1)
+    np.testing.assert_array_equal(m8.split_feat, m1.split_feat)
+    np.testing.assert_allclose(m8.threshold, m1.threshold, atol=1e-6)
+    np.testing.assert_allclose(
+        m8.predict_numpy(x), m1.predict_numpy(x), atol=1e-5
+    )
+
+
+def test_forest_beats_single_tree(rng, mesh8):
+    x = rng.uniform(-2, 2, size=(800, 4))
+    y = np.sin(2 * x[:, 0]) * np.cos(x[:, 1]) + 0.05 * rng.normal(size=800)
+    xt = rng.uniform(-2, 2, size=(400, 4))
+    yt = np.sin(2 * xt[:, 0]) * np.cos(xt[:, 1])
+    tree = DecisionTreeRegressor(max_depth=6, max_bins=64, seed=0).fit((x, y), mesh=mesh8)
+    # subset="all" isolates the bagging effect (the default "onethird" on a
+    # 4-feature problem forces 1-feature nodes, which hurts when one feature
+    # dominates — faithful to Spark's default, but not what we assert here)
+    forest = RandomForestRegressor(
+        num_trees=20, max_depth=6, max_bins=64, seed=0, feature_subset_strategy="all"
+    ).fit((x, y), mesh=mesh8)
+    t_mse = np.mean((tree.predict_numpy(xt) - yt) ** 2)
+    f_mse = np.mean((forest.predict_numpy(xt) - yt) ** 2)
+    assert f_mse < t_mse * 1.1  # ensemble at least comparable, usually better
+    assert forest.num_trees == 20
+
+
+def test_forest_classifier_accuracy(rng, mesh8):
+    x = rng.uniform(0, 1, size=(800, 4))
+    y = ((x[:, 0] + x[:, 1] > 1.0)).astype(np.int64)
+    model = RandomForestClassifier(num_trees=10, max_depth=5, seed=0).fit(
+        (x, y), mesh=mesh8
+    )
+    acc = (model.predict_numpy(x) == y).mean()
+    assert acc > 0.95
+    imp = model.feature_importances
+    assert imp[0] + imp[1] > 0.9
+    np.testing.assert_allclose(imp.sum(), 1.0, atol=1e-6)
+
+
+def test_tree_save_load(rng, mesh8, tmp_path):
+    x = rng.uniform(0, 1, size=(300, 4))
+    y = np.where(x[:, 2] > 0.4, 2.0, -1.0)
+    model = DecisionTreeRegressor(max_depth=3, seed=0).fit((x, y), mesh=mesh8)
+    model.write().overwrite().save(str(tmp_path / "dt"))
+    loaded = load_model(str(tmp_path / "dt"))
+    np.testing.assert_allclose(loaded.predict_numpy(x), model.predict_numpy(x))
+    forest = RandomForestClassifier(num_trees=5, seed=0).fit(
+        (x, (y > 0).astype(np.int64)), mesh=mesh8
+    )
+    forest.save(str(tmp_path / "rf"))
+    lf = load_model(str(tmp_path / "rf"))
+    np.testing.assert_array_equal(lf.predict_numpy(x), forest.predict_numpy(x))
+
+
+def test_tree_constant_labels(rng, mesh8):
+    """Pure node: no split, predicts the constant."""
+    x = rng.uniform(0, 1, size=(100, 3))
+    y = np.full(100, 7.0)
+    model = DecisionTreeRegressor(max_depth=3, seed=0).fit((x, y), mesh=mesh8)
+    np.testing.assert_allclose(model.predict_numpy(x), 7.0, atol=1e-5)
+    assert (model.split_feat[0] == -1).all()
+
+
+def test_tree_min_instances(rng, mesh8):
+    x = rng.uniform(0, 1, size=(100, 2))
+    y = x[:, 0]
+    strict = DecisionTreeRegressor(max_depth=6, min_instances_per_node=40, seed=0).fit(
+        (x, y), mesh=mesh8
+    )
+    loose = DecisionTreeRegressor(max_depth=6, min_instances_per_node=1, seed=0).fit(
+        (x, y), mesh=mesh8
+    )
+    assert (strict.split_feat >= 0).sum() < (loose.split_feat >= 0).sum()
