@@ -39,7 +39,8 @@ from .evaluation import (
 )
 from .parallel import build_mesh, default_mesh, device_dataset, use_mesh
 from .io import load_model, read_csv, read_csv_dir, write_csv
-from . import models
+from .session import Session
+from . import models, streaming, pipeline, utils, viz
 
 __all__ = [
     "__version__",
@@ -69,4 +70,9 @@ __all__ = [
     "read_csv_dir",
     "write_csv",
     "models",
+    "streaming",
+    "pipeline",
+    "utils",
+    "viz",
+    "Session",
 ]

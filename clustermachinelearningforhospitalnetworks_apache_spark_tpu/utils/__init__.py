@@ -1,0 +1,15 @@
+from .logging import Logger, configure_logging, get_logger
+from .metrics import MetricsRegistry, StageTiming, global_metrics
+from .profiling import block_until_ready, capture_trace, trace_annotation
+
+__all__ = [
+    "Logger",
+    "configure_logging",
+    "get_logger",
+    "MetricsRegistry",
+    "StageTiming",
+    "global_metrics",
+    "block_until_ready",
+    "capture_trace",
+    "trace_annotation",
+]

@@ -1,0 +1,77 @@
+"""Metrics registry: counters, gauges, stage timers, throughput.
+
+Feeds the BASELINE throughput metric (records/sec/chip) and the per-stage
+wall-clock accounting the reference entirely lacks (SURVEY.md §5 —
+tracing/metrics are listed as absent upstream and required here).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+@dataclass
+class StageTiming:
+    name: str
+    seconds: float
+    rows: int | None = None
+
+    @property
+    def rows_per_sec(self) -> float | None:
+        if self.rows is None or self.seconds <= 0:
+            return None
+        return self.rows / self.seconds
+
+
+@dataclass
+class MetricsRegistry:
+    counters: dict[str, float] = field(default_factory=dict)
+    gauges: dict[str, float] = field(default_factory=dict)
+    timings: list[StageTiming] = field(default_factory=list)
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def set(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    @contextmanager
+    def stage(self, name: str, rows: int | None = None) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timings.append(
+                StageTiming(name=name, seconds=time.perf_counter() - t0, rows=rows)
+            )
+
+    def time_stage(self, name: str, fn, *args, rows: int | None = None, **kw):
+        with self.stage(name, rows=rows):
+            return fn(*args, **kw)
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "stages": [
+                {
+                    "name": t.name,
+                    "seconds": round(t.seconds, 6),
+                    "rows": t.rows,
+                    "rows_per_sec": None
+                    if t.rows_per_sec is None
+                    else round(t.rows_per_sec, 1),
+                }
+                for t in self.timings
+            ],
+        }
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def global_metrics() -> MetricsRegistry:
+    return _GLOBAL

@@ -1,0 +1,36 @@
+"""Profiling hooks over ``jax.profiler``.
+
+SURVEY.md §5: the reference has no tracing at all; here every pipeline
+stage can be wrapped in a named trace annotation, and a whole run can be
+captured to a Perfetto/TensorBoard trace directory for MXU/HBM analysis.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator
+
+import jax
+
+
+@contextmanager
+def trace_annotation(name: str) -> Iterator[None]:
+    """Named region visible in the device trace (no-op cost when idle)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextmanager
+def capture_trace(log_dir: str) -> Iterator[None]:
+    """Capture a full device+host trace into ``log_dir`` (open with
+    TensorBoard's profile plugin or Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def block_until_ready(tree):
+    """Barrier helper so stage timings measure device work, not dispatch."""
+    return jax.block_until_ready(tree)

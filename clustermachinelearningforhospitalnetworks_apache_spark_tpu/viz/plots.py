@@ -1,0 +1,63 @@
+"""Diagnostic plots.
+
+Parity with the reference's matplotlib section (``mllearnforhospital
+network.py:204-223``): a predicted-vs-actual scatter with the y=x line and
+a residual scatter with the zero line.  The reference blocks on
+``plt.show()`` (Appendix A D6 — needs a display on a cluster driver); here
+figures are written to PNG files under an output directory.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import matplotlib
+
+matplotlib.use("Agg")  # headless: write files, never open a display
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def plot_predicted_vs_actual(
+    actual: np.ndarray,
+    predicted: np.ndarray,
+    out_dir: str,
+    label: str = "length_of_stay",
+    filename: str = "predicted_vs_actual.png",
+) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, filename)
+    fig, ax = plt.subplots(figsize=(8, 6))
+    ax.scatter(actual, predicted, alpha=0.5, s=12)
+    lo = float(min(np.min(actual), np.min(predicted)))
+    hi = float(max(np.max(actual), np.max(predicted)))
+    ax.plot([lo, hi], [lo, hi], "r--", linewidth=1.5)  # y = x (:212)
+    ax.set_xlabel(f"actual {label}")
+    ax.set_ylabel(f"predicted {label}")
+    ax.set_title("Predicted vs Actual")
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def plot_residuals(
+    actual: np.ndarray,
+    predicted: np.ndarray,
+    out_dir: str,
+    filename: str = "residuals.png",
+) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, filename)
+    residuals = np.asarray(actual) - np.asarray(predicted)
+    fig, ax = plt.subplots(figsize=(8, 6))
+    ax.scatter(predicted, residuals, alpha=0.5, s=12)
+    ax.axhline(0.0, color="r", linestyle="--", linewidth=1.5)  # zero line (:221)
+    ax.set_xlabel("predicted")
+    ax.set_ylabel("residual (actual − predicted)")
+    ax.set_title("Residuals")
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

@@ -36,6 +36,11 @@ import pytest  # noqa: E402
 jax.config.update("jax_numpy_rank_promotion", "raise")
 
 import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht  # noqa: E402
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.logging import (  # noqa: E402
+    configure_logging,
+)
+
+configure_logging(level="warning")
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

@@ -1,0 +1,209 @@
+"""End-to-end pipeline parity test: CSV drops → stream → window → 5 models →
+metrics → plots → saved artifacts → report (the whole reference script)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.config import (
+    MeshConfig,
+    PipelineConfig,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import write_csv
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.pipeline import run_pipeline
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.session import (
+    Session,
+    parse_duration_minutes,
+)
+
+
+def _make_input(dirpath, n=600, seed=5):
+    rng = np.random.default_rng(seed)
+    os.makedirs(dirpath, exist_ok=True)
+    base = np.datetime64("2025-03-31T22:00:00")
+    for part in range(3):
+        m = n // 3
+        adm = rng.integers(0, 50, m)
+        occ = rng.integers(20, 400, m)
+        emer = rng.integers(0, 30, m)
+        sea = rng.uniform(0.5, 1.5, m)
+        los = 3.0 + 0.01 * occ + 0.08 * emer + rng.normal(0, 0.15, m)
+        t = ht.Table.from_dict(
+            {
+                "hospital_id": np.array([f"H{i%4:02d}" for i in range(m)], dtype=object),
+                "event_time": base
+                + (part * m + np.arange(m)).astype("timedelta64[s]"),
+                "admission_count": adm,
+                "current_occupancy": occ,
+                "emergency_visits": emer,
+                "seasonality_index": sea,
+                "length_of_stay": los,
+            },
+            ht.hospital_event_schema(),
+        )
+        write_csv(t, os.path.join(dirpath, f"drop_{part}.csv"))
+
+
+@pytest.fixture
+def pipeline_cfg(tmp_path):
+    _make_input(str(tmp_path / "incoming"))
+    return PipelineConfig(
+        input_path=str(tmp_path / "incoming"),
+        checkpoint_location=str(tmp_path / "ckpt"),
+        model_save_path=str(tmp_path / "models"),
+        plot_dir=str(tmp_path / "plots"),
+        training_window_start="2025-03-31 22:00:00",
+        training_window_end="2025-03-31 23:00:00",
+        mesh=MeshConfig(data=8, model=1),
+    )
+
+
+def test_full_pipeline(pipeline_cfg):
+    result = run_pipeline(pipeline_cfg)
+    # all five reference models present (:146-158, :183-190)
+    assert set(result.regression_rmse) == {
+        "LinearRegression",
+        "DecisionTreeRegressor",
+        "RandomForestRegressor",
+    }
+    assert set(result.classification_accuracy) == {
+        "DecisionTreeClassifier",
+        "RandomForestClassifier",
+    }
+    # signal is learnable: linear data → LR near noise floor 0.15
+    assert result.regression_rmse["LinearRegression"] < 0.3
+    for acc in result.classification_accuracy.values():
+        assert acc > 0.9
+    # importances for the four tree models (:228-235 + classifiers)
+    assert len(result.feature_importances) == 4
+    # artifacts on disk with the reference layout (:241-243 + D7 superset)
+    for name, path in result.model_paths.items():
+        assert os.path.isdir(path), name
+        loaded = ht.load_model(path)
+        assert loaded is not None
+    assert os.path.basename(result.model_paths["LinearRegression"]) == "lr"
+    # plots written as files (D6)
+    assert os.path.exists(result.plot_paths["predicted_vs_actual"])
+    assert os.path.exists(result.plot_paths["residuals"])
+    # report text carries the metrics (:245-255)
+    assert "OPERATIONAL INSIGHTS" in result.report
+    assert "RMSE" in result.report and "accuracy" in result.report
+
+
+def test_pipeline_resume_is_idempotent(pipeline_cfg):
+    """Re-running over the same checkpoint must not duplicate table rows."""
+    r1 = run_pipeline(pipeline_cfg, make_plots=False, save_models=False)
+    r2 = run_pipeline(pipeline_cfg, make_plots=False, save_models=False)
+    assert r1.training_rows == r2.training_rows
+
+
+def test_session_sql_and_builder(tmp_path):
+    spark = (
+        Session.builder.app_name("t").mesh(MeshConfig(data=8, model=1)).get_or_create()
+    )
+    t = ht.Table.from_dict(
+        {
+            "event_time": np.datetime64("2025-01-01T00:00:00")
+            + np.arange(10).astype("timedelta64[m]"),
+            "v": np.arange(10).astype(float),
+        }
+    )
+    spark.register_table("events", t)
+    out = spark.sql(
+        "SELECT * FROM events WHERE event_time BETWEEN "
+        "'2025-01-01 00:02:00' AND '2025-01-01 00:05:00'"
+    )
+    assert out.num_rows == 4
+    with pytest.raises(ValueError):
+        spark.sql("SELECT count(*) FROM events")
+    with pytest.raises(KeyError):
+        spark.table("nope")
+    spark.stop()
+
+
+def test_parse_duration():
+    assert parse_duration_minutes("10 minutes") == 10.0
+    assert parse_duration_minutes("1 hour") == 60.0
+    assert parse_duration_minutes("30 seconds") == 0.5
+    with pytest.raises(ValueError):
+        parse_duration_minutes("fortnight")
+
+
+def test_fluent_streaming_api(tmp_path):
+    """The reference's exact chain shape (:75-82, :111-115) works."""
+    _make_input(str(tmp_path / "in"), n=90)
+    spark = Session(
+        PipelineConfig(
+            checkpoint_location=str(tmp_path / "ck"),
+            mesh=MeshConfig(data=8, model=1),
+        )
+    )
+    seen = []
+    q = (
+        spark.read_stream.schema(ht.hospital_event_schema())
+        .csv(str(tmp_path / "in"))
+        .with_watermark("event_time", "10 minutes")
+        .write_stream.foreach_batch(lambda df, bid: seen.append((bid, df.num_rows)))
+        .output_mode("append")
+        .option("checkpointLocation", str(tmp_path / "ck"))
+        .table("hospital_unbounded_table")
+    )
+    infos = q.process_available()
+    assert sum(i.num_appended_rows for i in infos) == 90
+    assert sum(n for _, n in seen) == 90
+    assert spark.table("hospital_unbounded_table").num_rows == 90
+    assert q.last_progress is not None
+
+
+def test_session_get_or_create_reuses_active(tmp_path):
+    s1 = Session.builder.app_name("one").mesh(MeshConfig(data=8, model=1)).get_or_create()
+    s2 = Session.builder.app_name("two").get_or_create()
+    assert s2 is s1  # Spark semantics: active session reused
+    s1.stop()
+    s3 = Session.builder.app_name("three").mesh(MeshConfig(data=8, model=1)).get_or_create()
+    assert s3 is not s1
+    s3.stop()
+
+
+def test_run_pipeline_uses_session_config(pipeline_cfg):
+    """run_pipeline(session=...) without config must honor the session's
+    config (regression: it silently used defaults)."""
+    spark = Session(pipeline_cfg)
+    result = run_pipeline(session=spark, make_plots=False, save_models=False)
+    assert result.training_rows > 0
+    spark.stop()
+
+
+def test_headerless_stream_option(tmp_path):
+    """option('header','false') must reach the CSV reader (regression)."""
+    import os
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import write_csv
+
+    os.makedirs(tmp_path / "in", exist_ok=True)
+    t = ht.Table.from_dict(
+        {
+            "hospital_id": np.array(["H0", "H1"], dtype=object),
+            "event_time": np.datetime64("2025-03-31T22:00:00")
+            + np.arange(2).astype("timedelta64[s]"),
+            "admission_count": [1, 2],
+            "current_occupancy": [10, 20],
+            "emergency_visits": [0, 1],
+            "seasonality_index": [1.0, 1.1],
+            "length_of_stay": [3.0, 4.0],
+        },
+        ht.hospital_event_schema(),
+    )
+    write_csv(t, str(tmp_path / "in" / "x.csv"), header=False)
+    spark = Session(PipelineConfig(mesh=MeshConfig(data=8, model=1)))
+    q = (
+        spark.read_stream.schema(ht.hospital_event_schema())
+        .option("header", "false")
+        .csv(str(tmp_path / "in"))
+        .write_stream.option("checkpointLocation", str(tmp_path / "ck"))
+        .start()  # Spark-style no-arg start (regression: used to TypeError)
+    )
+    infos = q.process_available()
+    assert sum(i.num_appended_rows for i in infos) == 2
+    spark.stop()
