@@ -1,0 +1,142 @@
+"""Benchmark driver — north-star workload from BASELINE.json.
+
+Measures KMeans k=256 Lloyd-iteration throughput (patient-records/sec/chip)
+on synthetic patient-encounter rows (BASELINE config 2: 10M rows,
+StandardScaler + VectorAssembler features), using the framework's sharded
+shard_map Lloyd step — the TPU-native replacement for Spark MLlib's
+``KMeans.fit`` treeAggregate loop (reference mllearnforhospitalnetwork.py
+delegates all training to pyspark.ml; SURVEY.md §3.3).
+
+The baseline denominator (Spark-CPU) cannot be run here (no JVM/Spark in
+the image), so a conservative proxy is measured in-process: a NumPy/BLAS
+Lloyd iteration on the same workload shape, single host.  Real Spark adds
+JVM/Py4J/shuffle overhead on top of BLAS, so ``vs_baseline`` understates
+the true ratio vs Spark-CPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def _make_data(n: int, d: int, k: int, seed: int = 0) -> np.ndarray:
+    """Clustered synthetic patient-encounter features, standardized
+    (BASELINE config 2 applies StandardScaler before KMeans)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0.0, 4.0, size=(k, d))
+    assign = rng.integers(0, k, size=n)
+    x = centers[assign] + rng.normal(0.0, 1.0, size=(n, d))
+    x = (x - x.mean(axis=0)) / x.std(axis=0)
+    return x.astype(np.float32)
+
+
+def _cpu_lloyd_throughput(x: np.ndarray, k: int, iters: int = 2) -> float:
+    """NumPy/BLAS Lloyd iterations — the Spark-CPU stand-in denominator."""
+    n, d = x.shape
+    rng = np.random.default_rng(0)
+    centers = x[rng.choice(n, size=k, replace=False)].astype(np.float64)
+    xd = x.astype(np.float64)
+    x_sq = (xd * xd).sum(axis=1)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c_sq = (centers * centers).sum(axis=1)
+        # chunked to bound the (n, k) distance matrix
+        sums = np.zeros((k, d))
+        counts = np.zeros((k,))
+        chunk = 262144
+        for s in range(0, n, chunk):
+            xb = xd[s : s + chunk]
+            d2 = x_sq[s : s + chunk, None] - 2.0 * (xb @ centers.T) + c_sq[None, :]
+            a = np.argmin(d2, axis=1)
+            np.add.at(counts, a, 1.0)
+            np.add.at(sums, a, xb)
+        nz = counts > 0
+        centers[nz] = sums[nz] / counts[nz, None]
+    dt = time.perf_counter() - t0
+    return n * iters / dt
+
+
+def main() -> None:
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+        KMeans,
+        _make_train_step,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+        build_mesh,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    k = 256
+    d = 8
+    n = int(os.environ.get("BENCH_ROWS", 10_000_000 if on_tpu else 400_000))
+    timed_iters = int(os.environ.get("BENCH_ITERS", 10 if on_tpu else 3))
+
+    mesh = build_mesh()
+    n_chips = len(jax.devices())
+
+    x = _make_data(n, d, k)
+    ds = device_dataset(x, mesh=mesh)
+
+    # Random init (init quality is irrelevant to throughput measurement).
+    rng = np.random.default_rng(1)
+    m = mesh.shape[MODEL_AXIS]
+    k_pad = -(-k // m) * m
+    cen = np.zeros((k_pad, d), dtype=np.float32)
+    cen[:k] = x[rng.choice(n, size=k, replace=False)]
+    c_valid = np.zeros((k_pad,), dtype=np.float32)
+    c_valid[:k] = 1.0
+    centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+
+    est = KMeans(k=k)
+    n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+    step = _make_train_step(mesh, n_loc, k_pad, d, est.chunk_rows)
+
+    # Warm-up: compile + one execution.
+    centers, _, _, _ = step(ds.x, ds.w, centers, c_valid_dev)
+    jax.block_until_ready(centers)
+
+    t0 = time.perf_counter()
+    for _ in range(timed_iters):
+        centers, counts, cost, move = step(ds.x, ds.w, centers, c_valid_dev)
+    jax.block_until_ready(centers)
+    dt = time.perf_counter() - t0
+    tpu_records_per_sec = n * timed_iters / dt
+    per_chip = tpu_records_per_sec / n_chips
+
+    # CPU (Spark-CPU proxy) denominator on a bounded sample, same shape.
+    cpu_n = min(n, 400_000)
+    cpu_thr = _cpu_lloyd_throughput(x[:cpu_n], k)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"KMeans k={k} Lloyd records/sec/chip ({n} rows, d={d}, {platform})",
+                "value": round(per_chip, 1),
+                "unit": "records/sec/chip",
+                "vs_baseline": round(per_chip / cpu_thr, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,17 @@ from .parallel import build_mesh, default_mesh, device_dataset, use_mesh
 from .io import load_model, read_csv, read_csv_dir, write_csv
 from .session import Session
 from . import models, streaming, pipeline, utils, viz
+from .models import (
+    BisectingKMeans,
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GaussianMixture,
+    KMeans,
+    LinearRegression,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    StreamingKMeans,
+)
 
 __all__ = [
     "__version__",
@@ -75,4 +86,13 @@ __all__ = [
     "utils",
     "viz",
     "Session",
+    "BisectingKMeans",
+    "DecisionTreeClassifier",
+    "DecisionTreeRegressor",
+    "GaussianMixture",
+    "KMeans",
+    "LinearRegression",
+    "RandomForestClassifier",
+    "RandomForestRegressor",
+    "StreamingKMeans",
 ]

@@ -30,7 +30,6 @@ import numpy as np
 from ..config import PipelineConfig
 from ..core.schema import FEATURE_COLS, LABEL_COL, hospital_event_schema
 from ..core.split import train_test_split
-from ..core.table import Table
 from ..evaluation import MulticlassClassificationEvaluator, RegressionEvaluator
 from ..features import Binarizer, VectorAssembler
 from ..models import (
@@ -68,7 +67,25 @@ def run_pipeline(
     make_plots: bool = True,
 ) -> PipelineResult:
     cfg = config or (session.config if session is not None else PipelineConfig())
+    owns_session = session is None
     spark = session or Session(cfg)
+    try:
+        return _run(cfg, spark, drain_stream, save_models, make_plots)
+    finally:
+        # §12 "stop" (:258): release the active-session slot / default mesh
+        # only for a session this call created — a caller-provided session
+        # stays theirs to stop.
+        if owns_session:
+            spark.stop()
+
+
+def _run(
+    cfg: PipelineConfig,
+    spark: Session,
+    drain_stream: bool,
+    save_models: bool,
+    make_plots: bool,
+) -> PipelineResult:
     metrics = spark.metrics
     schema = hospital_event_schema()
 
@@ -101,9 +118,15 @@ def run_pipeline(
             "training_window_start/end"
         )
 
-    # §6: features + seed-42 70/30 split (:134-139)
+    # §6: features + seed-42 70/30 split (:134-139).  The LOS_binary label
+    # (§8, :176-177) is derived *before* the split — same seed and row count
+    # mean the reference's second split (:180) partitions identically, so
+    # one split + one assembly pass serves both stages.
     assembler = VectorAssembler(FEATURE_COLS)
-    train_t, test_t = train_test_split(training_df, cfg.train_fraction, cfg.split_seed)
+    binarizer = Binarizer(LABEL_COL, "LOS_binary", cfg.los_threshold)
+    train_t, test_t = train_test_split(
+        binarizer.transform(training_df), cfg.train_fraction, cfg.split_seed
+    )
     train = assembler.transform(train_t)
     test = assembler.transform(test_t)
 
@@ -116,7 +139,7 @@ def run_pipeline(
     }
     reg_models: dict[str, Any] = {}
     rmse: dict[str, float] = {}
-    predictions: dict[str, Any] = {}
+    lr_preds = None  # only LinearRegression's predictions are plotted (:204)
     for name, est in regressors.items():
         with metrics.stage(f"fit:{name}", rows=train_t.num_rows):
             model = est.fit(train, label_col=LABEL_COL, mesh=spark.mesh)
@@ -124,16 +147,11 @@ def run_pipeline(
             preds = model.transform(test, label_col=LABEL_COL, mesh=spark.mesh)
             rmse[name] = reg_eval.evaluate(preds)
         reg_models[name] = model
-        predictions[name] = preds
+        if name == "LinearRegression":
+            lr_preds = preds
         log.info("regressor evaluated", model=name, rmse=rmse[name])
 
-    # §8: LOS binarization + two classifiers + accuracy (:176-198)
-    binarizer = Binarizer(LABEL_COL, "LOS_binary", cfg.los_threshold)
-    btrain_t, btest_t = train_test_split(
-        binarizer.transform(training_df), cfg.train_fraction, cfg.split_seed
-    )
-    btrain = assembler.transform(btrain_t)
-    btest = assembler.transform(btest_t)
+    # §8: two classifiers on the pre-binarized label + accuracy (:176-198)
     cls_eval = MulticlassClassificationEvaluator("accuracy", label_col="LOS_binary")
     classifiers = {
         "DecisionTreeClassifier": DecisionTreeClassifier(),
@@ -142,9 +160,9 @@ def run_pipeline(
     cls_models: dict[str, Any] = {}
     accuracy: dict[str, float] = {}
     for name, est in classifiers.items():
-        with metrics.stage(f"fit:{name}", rows=btrain_t.num_rows):
-            model = est.fit(btrain, label_col="LOS_binary", mesh=spark.mesh)
-        preds = model.transform(btest, label_col="LOS_binary", mesh=spark.mesh)
+        with metrics.stage(f"fit:{name}", rows=train_t.num_rows):
+            model = est.fit(train, label_col="LOS_binary", mesh=spark.mesh)
+        preds = model.transform(test, label_col="LOS_binary", mesh=spark.mesh)
         accuracy[name] = cls_eval.evaluate(preds)
         cls_models[name] = model
         log.info("classifier evaluated", model=name, accuracy=accuracy[name])
@@ -152,7 +170,7 @@ def run_pipeline(
     # §9: plots → PNG files (:204-223, D6 fixed)
     plot_paths: dict[str, str] = {}
     if make_plots:
-        lr_pred, lr_actual = predictions["LinearRegression"].to_numpy()
+        lr_pred, lr_actual = lr_preds.to_numpy()
         plot_paths["predicted_vs_actual"] = plot_predicted_vs_actual(
             lr_actual, lr_pred, cfg.plot_dir
         )

@@ -62,8 +62,9 @@ class Session:
         self.config = config or PipelineConfig()
         self.mesh = mesh if mesh is not None else build_mesh(self.config.mesh)
         # Remember what we displaced so stop() can restore it rather than
-        # nulling the process-wide default out from under another session.
+        # nulling the process-wide state out from under another session.
         self._prev_default_mesh = _mesh_mod._DEFAULT_MESH
+        self._prev_active_session = _ACTIVE_SESSION
         set_default_mesh(self.mesh)
         self.metrics = MetricsRegistry()
         self._tables: dict[str, Any] = {}
@@ -146,9 +147,14 @@ class Session:
 
     def stop(self) -> None:
         global _ACTIVE_SESSION
-        set_default_mesh(self._prev_default_mesh)
+        from .parallel import mesh as _mesh_mod
+
+        # Restore displaced process-wide state, but only if it is still
+        # ours — a non-LIFO stop must not clobber another live session's.
+        if _mesh_mod._DEFAULT_MESH is self.mesh:
+            set_default_mesh(self._prev_default_mesh)
         if _ACTIVE_SESSION is self:
-            _ACTIVE_SESSION = None
+            _ACTIVE_SESSION = self._prev_active_session
         log.info("session stopped", app=self.config.app_name)
 
 
@@ -204,7 +210,6 @@ class StreamWriter:
     frame: StreamingFrame
     _foreach: Callable[[Table, int], None] | None = None
     _options: dict[str, str] = field(default_factory=dict)
-    _mode: str = "append"
 
     def foreach_batch(self, fn: Callable[[Table, int], None]) -> "StreamWriter":
         self._foreach = fn

@@ -11,32 +11,10 @@ that is the exactly-once recipe, reproduced here with two JSON-line logs.
 
 from __future__ import annotations
 
-import json
 import os
 from dataclasses import dataclass
 
-
-def _append_line(path: str, obj: dict) -> None:
-    with open(path, "a") as f:
-        f.write(json.dumps(obj) + "\n")
-        f.flush()
-        os.fsync(f.fileno())
-
-
-def _read_lines(path: str) -> list[dict]:
-    if not os.path.exists(path):
-        return []
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    # torn write from a crash mid-line: ignore the tail
-                    break
-    return out
+from .wal import append_line as _append_line, read_lines as _read_lines
 
 
 @dataclass

@@ -6,19 +6,20 @@ Replaces the reference's Delta-table streaming sink (``writeStream...
 micro-batch is one Parquet part file plus one JSON line in ``_commits.log``.
 Readers only see committed parts, appends are idempotent per batch id
 (part files are named by batch id and rewritten on replay), and the log is
-written via rename for atomicity — giving the same exactly-once append
-semantics Delta's transaction log provides, scaled to this pipeline's
-needs.
+fsync-appended with torn-tail repair (streaming/wal.py) so a crash at any
+byte boundary loses at most the in-flight batch's commit line — giving the
+same exactly-once append semantics Delta's transaction log provides,
+scaled to this pipeline's needs.
 """
 
 from __future__ import annotations
 
-import json
 import os
 from dataclasses import dataclass
 
 from ..core.schema import Schema
 from ..core.table import Table
+from .wal import append_line, read_lines
 
 COMMIT_LOG = "_commits.log"
 
@@ -56,25 +57,13 @@ class UnboundedTable:
         os.replace(tmp, path)
 
     def _append_commit(self, entry: dict) -> None:
-        log = os.path.join(self.path, COMMIT_LOG)
-        with open(log, "a") as f:
-            f.write(json.dumps(entry) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        append_line(os.path.join(self.path, COMMIT_LOG), entry)
 
     # -------------------------------------------------------------- read
     def committed_batches(self) -> dict[int, dict]:
-        log = os.path.join(self.path, COMMIT_LOG)
         out: dict[int, dict] = {}
-        if not os.path.exists(log):
-            return out
-        with open(log) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                e = json.loads(line)
-                out[int(e["batch_id"])] = e  # later replay wins
+        for e in read_lines(os.path.join(self.path, COMMIT_LOG)):
+            out[int(e["batch_id"])] = e  # later replay wins
         return out
 
     def read(self) -> Table:

@@ -1,0 +1,57 @@
+"""Shared JSON-lines write-ahead-log helpers.
+
+One durability policy for both streaming logs (the checkpoint's
+offsets/commits WAL and the unbounded table's commit log): appends are
+fsync'd, a torn tail left by a crash mid-write is repaired by starting the
+next append on a fresh line, and readers skip unparseable lines instead of
+failing — so a crash at any byte boundary costs at most the uncommitted
+entry that was being written, never previously-committed entries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def append_line(path: str, obj: dict) -> None:
+    """Durably append one JSON entry.
+
+    If the file's last byte is not a newline (torn tail from a crash
+    mid-append), a newline is written first so the new entry never merges
+    into the torn one.
+    """
+    lead = ""
+    try:
+        with open(path, "rb") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) != b"\n":
+                lead = "\n"
+    except OSError:
+        pass  # missing file, or empty file (seek before start): no repair
+    with open(path, "a") as f:
+        f.write(lead + json.dumps(obj) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def read_lines(path: str) -> list[dict]:
+    """Read all parseable entries; skip torn/corrupt lines.
+
+    With :func:`append_line`'s repair, corruption is confined to single
+    lines, so skipping (not stopping at) a bad line cannot drop valid
+    later entries.
+    """
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

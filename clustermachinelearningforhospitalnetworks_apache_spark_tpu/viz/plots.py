@@ -13,10 +13,10 @@ import os
 
 import numpy as np
 
-import matplotlib
-
-matplotlib.use("Agg")  # headless: write files, never open a display
-import matplotlib.pyplot as plt  # noqa: E402
+# Figures are built directly (not via pyplot), so saving PNGs never
+# touches the process-global backend — importing this package must not
+# break a user's own interactive plt.show().
+from matplotlib.figure import Figure
 
 
 def plot_predicted_vs_actual(
@@ -28,7 +28,8 @@ def plot_predicted_vs_actual(
 ) -> str:
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, filename)
-    fig, ax = plt.subplots(figsize=(8, 6))
+    fig = Figure(figsize=(8, 6))
+    ax = fig.add_subplot(111)
     ax.scatter(actual, predicted, alpha=0.5, s=12)
     lo = float(min(np.min(actual), np.min(predicted)))
     hi = float(max(np.max(actual), np.max(predicted)))
@@ -38,7 +39,6 @@ def plot_predicted_vs_actual(
     ax.set_title("Predicted vs Actual")
     fig.tight_layout()
     fig.savefig(path, dpi=120)
-    plt.close(fig)
     return path
 
 
@@ -51,7 +51,8 @@ def plot_residuals(
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, filename)
     residuals = np.asarray(actual) - np.asarray(predicted)
-    fig, ax = plt.subplots(figsize=(8, 6))
+    fig = Figure(figsize=(8, 6))
+    ax = fig.add_subplot(111)
     ax.scatter(predicted, residuals, alpha=0.5, s=12)
     ax.axhline(0.0, color="r", linestyle="--", linewidth=1.5)  # zero line (:221)
     ax.set_xlabel("predicted")
@@ -59,5 +60,4 @@ def plot_residuals(
     ax.set_title("Residuals")
     fig.tight_layout()
     fig.savefig(path, dpi=120)
-    plt.close(fig)
     return path
