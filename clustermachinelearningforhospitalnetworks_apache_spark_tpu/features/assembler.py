@@ -58,7 +58,15 @@ class AssembledTable:
         return self.table.column(name).astype(np.float64)
 
     def to_device(self, label_col: str | None = None, mesh=None):
+        from ..core.schema import LABEL_COL
         from ..parallel.sharding import device_dataset
 
+        # The label rides along by default (Spark's transform output keeps
+        # the label column next to `prediction`, reference :148,:163): fall
+        # back to the canonical LOS label when the table carries it, so
+        # `model.transform(assembled)` → evaluator never silently compares
+        # against zeros.
+        if label_col is None and LABEL_COL in self.table.schema:
+            label_col = LABEL_COL
         y = self.label(label_col) if label_col else None
         return device_dataset(self.features, y, mesh=mesh)

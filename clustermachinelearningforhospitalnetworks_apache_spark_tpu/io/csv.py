@@ -18,7 +18,7 @@ import numpy as np
 
 from ..core.schema import Schema, TIMESTAMP, STRING
 from ..core.table import Table
-from .native import native_read_csv, native_available
+from .native import native_read_table, native_available
 
 
 def read_csv(path: str, schema: Schema, header: bool = True, engine: str = "auto") -> Table:
@@ -28,7 +28,7 @@ def read_csv(path: str, schema: Schema, header: bool = True, engine: str = "auto
     """
     if engine in ("auto", "native") and native_available():
         try:
-            return _from_string_columns(native_read_csv(path, len(schema), header), schema)
+            return _read_native(path, schema, header)
         except Exception:
             if engine == "native":
                 raise
@@ -50,6 +50,30 @@ def read_csv_dir(path: str, schema: Schema, header: bool = True) -> Table:
     if not files:
         return Table.empty(schema)
     return Table.concat([read_csv(f, schema, header) for f in files])
+
+
+def _read_native(path: str, schema: Schema, header: bool) -> Table:
+    """C++ scan shim: one pass over the file yields float64/int64-ns/str
+    column buffers directly — no per-cell Python (the Tungsten-scan
+    replacement, native/csv_scan.cpp)."""
+    kinds = [
+        2 if f.dtype == STRING else (1 if f.dtype == TIMESTAMP else 0) for f in schema
+    ]
+    num, ts, strs, rows = native_read_table(path, kinds, header)
+    data = {}
+    ji = jt = js = 0
+    for f, kind in zip(schema, kinds):
+        if kind == 2:
+            data[f.name] = strs[js]
+            js += 1
+        elif kind == 1:
+            # int64-min sentinel from the shim views directly as numpy NaT
+            data[f.name] = ts[:, jt].copy().view("datetime64[ns]")
+            jt += 1
+        else:
+            data[f.name] = num[:, ji].copy()
+            ji += 1
+    return Table.from_dict(data, schema)
 
 
 def _read_arrow(path: str, schema: Schema, header: bool) -> Table:

@@ -3,26 +3,50 @@
 The reference's host-side data plane (Tungsten CSV scan codegen + the
 streaming file source's directory listing, SURVEY.md E1/E2) is replaced by
 ``native/csv_scan.cpp`` — built with ``make -C native`` into
-``libcsv_scan.so``.  Everything degrades gracefully to pure Python when the
-shared library hasn't been built (e.g. fresh checkout, CI without a
-toolchain).
+``libcsv_scan.so``.  The loader auto-builds on first use when a toolchain
+is present; everything degrades gracefully to pure Python when the shared
+library can't be built (fresh checkout, no g++).
+
+pybind11 is not available in the image, so the boundary is a plain C ABI:
+numeric cells cross as a float64 matrix, timestamps as int64 nanoseconds,
+strings as one concatenated byte buffer plus a prefix-offsets array.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-from typing import List
+import subprocess
+from typing import List, Tuple
 
 import numpy as np
 
 _LIB = None
 _TRIED = False
 
+_KIND_NUM, _KIND_TS, _KIND_STR = 0, 1, 2
+
+
+def _native_dir() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "native")
+
 
 def _lib_path() -> str:
-    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    return os.path.join(here, "native", "libcsv_scan.so")
+    return os.path.join(_native_dir(), "libcsv_scan.so")
+
+
+def _try_build(force: bool = False) -> bool:
+    """Build the shim once if the source is present and build isn't disabled."""
+    src = os.path.join(_native_dir(), "csv_scan.cpp")
+    if not os.path.exists(src) or os.environ.get("CMLHN_NO_NATIVE_BUILD"):
+        return False
+    try:
+        cmd = ["make", "-C", _native_dir()] + (["-B"] if force else [])
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(_lib_path())
+    except (OSError, subprocess.TimeoutExpired):
+        return False
 
 
 def _load():
@@ -31,26 +55,66 @@ def _load():
         return _LIB
     _TRIED = True
     path = _lib_path()
-    if not os.path.exists(path):
+    if not os.path.exists(path) and not _try_build():
         return None
     try:
-        lib = ctypes.CDLL(path)
-        lib.csv_count_rows.restype = ctypes.c_long
-        lib.csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.csv_parse_numeric.restype = ctypes.c_long
-        lib.csv_parse_numeric.argtypes = [
-            ctypes.c_char_p,          # path
-            ctypes.c_int,             # header (0/1)
-            ctypes.c_int,             # ncols
-            ctypes.POINTER(ctypes.c_int),     # numeric column indices
-            ctypes.c_int,             # n numeric
-            ctypes.POINTER(ctypes.c_double),  # out buffer (rows*n_numeric)
-            ctypes.c_long,            # capacity rows
-        ]
-        _LIB = lib
-    except OSError:
+        _LIB = _bind(path)
+    except (OSError, AttributeError):
+        # Stale .so from an older revision (missing symbols) or a broken
+        # binary: force a rebuild once, then degrade to pure Python.
         _LIB = None
+        if _try_build(force=True):
+            try:
+                _LIB = _bind(path)
+            except (OSError, AttributeError):
+                _LIB = None
     return _LIB
+
+
+def _bind(path: str):
+    """CDLL + symbol signatures; raises AttributeError on a stale library."""
+    lib = ctypes.CDLL(path)
+    lib.csv_count_rows.restype = ctypes.c_long
+    lib.csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.csv_parse_numeric.restype = ctypes.c_long
+    lib.csv_parse_numeric.argtypes = [
+        ctypes.c_char_p,                  # path
+        ctypes.c_int,                     # header (0/1)
+        ctypes.c_int,                     # ncols
+        ctypes.POINTER(ctypes.c_int),     # numeric column indices
+        ctypes.c_int,                     # n numeric
+        ctypes.POINTER(ctypes.c_double),  # out buffer (rows*n_numeric)
+        ctypes.c_long,                    # capacity rows
+    ]
+    lib.csv_parse_table.restype = ctypes.c_long
+    lib.csv_parse_table.argtypes = [
+        ctypes.c_char_p,                  # path
+        ctypes.c_int,                     # header
+        ctypes.c_int,                     # ncols
+        ctypes.POINTER(ctypes.c_int),     # kinds per column
+        ctypes.POINTER(ctypes.c_double),  # out numeric
+        ctypes.POINTER(ctypes.c_int64),   # out timestamps (ns)
+        ctypes.c_char_p,                  # out string bytes
+        ctypes.POINTER(ctypes.c_int64),   # string prefix offsets
+        ctypes.c_long,                    # capacity rows
+        ctypes.c_int64,                   # capacity string bytes
+    ]
+    lib.csv_size.restype = ctypes.c_long
+    lib.csv_size.argtypes = [
+        ctypes.c_char_p,                  # path
+        ctypes.c_int,                     # header
+        ctypes.c_int,                     # ncols
+        ctypes.POINTER(ctypes.c_int),     # kinds (nullable)
+        ctypes.POINTER(ctypes.c_int64),   # out string bytes (nullable)
+    ]
+    lib.dir_list.restype = ctypes.c_long
+    lib.dir_list.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_long,
+    ]
+    return lib
 
 
 def native_available() -> bool:
@@ -59,10 +123,15 @@ def native_available() -> bool:
 
 def native_count_rows(path: str, header: bool = True) -> int:
     lib = _load()
-    return int(lib.csv_count_rows(path.encode(), 1 if header else 0))
+    n = int(lib.csv_count_rows(path.encode(), 1 if header else 0))
+    if n < 0:
+        raise OSError(f"csv_count_rows({path}) failed: {n}")
+    return n
 
 
-def native_parse_numeric(path: str, col_indices: List[int], ncols: int, header: bool = True) -> np.ndarray:
+def native_parse_numeric(
+    path: str, col_indices: List[int], ncols: int, header: bool = True
+) -> np.ndarray:
     """Parse the given numeric columns of a CSV into a float64 matrix."""
     lib = _load()
     nrows = native_count_rows(path, header)
@@ -78,11 +147,100 @@ def native_parse_numeric(path: str, col_indices: List[int], ncols: int, header: 
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         nrows,
     )
-    return out[: max(int(got), 0)]
+    if got < 0:
+        raise OSError(f"csv_parse_numeric({path}) failed: {got}")
+    return out[: int(got)]
 
 
-def native_read_csv(path: str, ncols: int, header: bool = True):
-    """Full-table native read is only used for all-numeric schemas; string/
-    timestamp columns route through the arrow/numpy engines.  Raise to let
-    read_csv fall through when unsupported."""
-    raise NotImplementedError("native engine parses numeric projections only")
+def native_read_table(
+    path: str, kinds: List[int], header: bool = True
+) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray], int]:
+    """Full typed parse.
+
+    ``kinds[i]`` per CSV column: 0 numeric, 1 timestamp, 2 string.
+    Returns ``(numeric (rows, n_num) f64, ts (rows, n_ts) i64-ns,
+    string_columns [n_str arrays of object], rows)``.
+    """
+    lib = _load()
+    ncols = len(kinds)
+    n_num = sum(1 for k in kinds if k == _KIND_NUM)
+    n_ts = sum(1 for k in kinds if k == _KIND_TS)
+    n_str = sum(1 for k in kinds if k == _KIND_STR)
+    kinds_c = (ctypes.c_int * ncols)(*kinds)
+
+    # One sizing pass yields both the row count and the exact string-byte
+    # total, so the whole read is two passes over the file.
+    str_bytes = ctypes.c_int64(0)
+    nrows = int(
+        lib.csv_size(
+            path.encode(),
+            1 if header else 0,
+            ncols,
+            kinds_c if n_str else None,
+            ctypes.byref(str_bytes) if n_str else None,
+        )
+    )
+    if nrows < 0:
+        raise OSError(f"csv_size({path}) failed: {nrows}")
+    cap_bytes = int(str_bytes.value)
+
+    cap_rows = max(nrows, 1)
+    out_num = np.empty((cap_rows, max(n_num, 1)), dtype=np.float64)
+    out_ts = np.empty((cap_rows, max(n_ts, 1)), dtype=np.int64)
+    out_str = ctypes.create_string_buffer(max(cap_bytes, 1))
+    offsets = np.zeros((cap_rows * max(n_str, 1) + 1,), dtype=np.int64)
+
+    got = lib.csv_parse_table(
+        path.encode(),
+        1 if header else 0,
+        ncols,
+        kinds_c,
+        out_num.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) if n_num else None,
+        out_ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if n_ts else None,
+        out_str if n_str else None,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) if n_str else None,
+        cap_rows,
+        cap_bytes,
+    )
+    if got < 0:
+        raise OSError(f"csv_parse_table({path}) failed: {got}")
+    rows = int(got)
+
+    str_cols: List[np.ndarray] = []
+    if n_str:
+        raw = out_str.raw
+        flat = offsets[: rows * n_str + 1]
+        cells = [
+            raw[flat[i] : flat[i + 1]].decode("utf-8", errors="replace")
+            for i in range(rows * n_str)
+        ]
+        for j in range(n_str):
+            str_cols.append(np.array(cells[j::n_str], dtype=object))
+    return out_num[:rows, :n_num], out_ts[:rows, :n_ts], str_cols, rows
+
+
+def native_dir_list(path: str, suffix: str = ".csv") -> List[Tuple[int, int, str]]:
+    """List files under ``path`` ending in ``suffix`` → [(mtime_ns, size, name)].
+    The native counterpart of the streaming file source's os.scandir poll."""
+    lib = _load()
+    cap = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(cap)
+        n = int(lib.dir_list(path.encode(), suffix.encode(), buf, cap))
+        if n == -2:
+            cap *= 4
+            if cap > (1 << 28):
+                raise OSError(f"dir_list({path}): listing exceeds {cap} bytes")
+            continue
+        if n < 0:
+            raise OSError(f"dir_list({path}) failed: {n}")
+        # Records are NUL-framed (a POSIX filename cannot contain NUL), so
+        # names with newlines or tabs cannot corrupt the parse — the name is
+        # everything after the second tab.
+        out: List[Tuple[int, int, str]] = []
+        for rec in buf.raw.split(b"\0"):
+            if not rec:
+                break  # every record is non-empty; first empty = end of data
+            mtime_s, size_s, name = rec.decode("utf-8", errors="replace").split("\t", 2)
+            out.append((int(mtime_s), int(size_s), name))
+        return out

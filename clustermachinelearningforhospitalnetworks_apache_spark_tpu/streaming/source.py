@@ -31,11 +31,19 @@ class FileStreamSource:
     def list_files(self) -> list[str]:
         if not os.path.isdir(self.path):
             return []
-        entries = []
-        with os.scandir(self.path) as it:
-            for e in it:
-                if e.is_file() and e.name.endswith(self.glob_suffix):
-                    entries.append((e.stat().st_mtime_ns, e.name, e.path))
+        from ..io.native import native_available, native_dir_list
+
+        if native_available():
+            entries = [
+                (mtime_ns, name, os.path.join(self.path, name))
+                for mtime_ns, _size, name in native_dir_list(self.path, self.glob_suffix)
+            ]
+        else:
+            entries = []
+            with os.scandir(self.path) as it:
+                for e in it:
+                    if e.is_file() and e.name.endswith(self.glob_suffix):
+                        entries.append((e.stat().st_mtime_ns, e.name, e.path))
         entries.sort()
         return [p for _, _, p in entries]
 

@@ -1,0 +1,412 @@
+// Native host-side data plane: CSV scan + directory watch.
+//
+// TPU-native replacement for the host half of the reference's ingest stack:
+// Spark Tungsten's generated CSV scan and the Structured Streaming file
+// source's directory listing (reference mllearnforhospitalnetwork.py:74-82
+// delegates both to the JVM; SURVEY.md E1/E2).  Exposed as a plain C ABI
+// consumed via ctypes from
+// clustermachinelearningforhospitalnetworks_apache_spark_tpu/io/native.py —
+// no pybind11 in the image, so the boundary is raw buffers.
+//
+// Build: make -C native     (g++ -O3 -shared -fPIC)
+//
+// Conventions
+//   - RFC-4180-ish CSV: comma-separated, double-quote quoting, "" escapes a
+//     quote inside a quoted field, \r\n or \n line ends.
+//   - Numeric parse failures and empty fields yield NaN (matching the
+//     framework's numpy fallback parser in io/csv.py).
+//   - Timestamps are "YYYY-MM-DD[ T]HH:MM:SS[.frac]" -> int64 ns since the
+//     Unix epoch; empty/invalid -> INT64_MIN (numpy NaT).
+//   - All functions return a row/entry count >= 0, or a negative errno-style
+//     code: -1 cannot open, -2 output capacity exceeded, -3 bad arguments.
+
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <dirent.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// File slurp
+// ---------------------------------------------------------------------------
+bool slurp(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  if (sz < 0) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(sz));
+  size_t got = sz ? std::fread(&(*out)[0], 1, static_cast<size_t>(sz), f) : 0;
+  std::fclose(f);
+  out->resize(got);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// CSV tokenizer: walks one record, invoking `emit(col_idx, begin, len)` per
+// field.  Returns the offset just past the record's line terminator.
+// ---------------------------------------------------------------------------
+struct FieldSpan {
+  const char* begin;
+  size_t len;
+  bool quoted;  // if true, may contain "" escapes that need unescaping
+};
+
+size_t parse_record(const std::string& buf, size_t pos, std::vector<FieldSpan>* fields) {
+  fields->clear();
+  const size_t n = buf.size();
+  size_t field_start = pos;
+  bool in_quotes = false;
+  bool quoted_field = false;
+  size_t i = pos;
+  for (; i < n; ++i) {
+    char c = buf[i];
+    if (in_quotes) {
+      if (c == '"') {
+        if (i + 1 < n && buf[i + 1] == '"') {
+          ++i;  // escaped quote
+        } else {
+          in_quotes = false;
+        }
+      }
+      continue;
+    }
+    if (c == '"') {
+      in_quotes = true;
+      quoted_field = true;
+    } else if (c == ',') {
+      fields->push_back({buf.data() + field_start, i - field_start, quoted_field});
+      field_start = i + 1;
+      quoted_field = false;
+    } else if (c == '\n') {
+      size_t end = i;
+      if (end > field_start && buf[end - 1] == '\r') --end;
+      fields->push_back({buf.data() + field_start, end - field_start, quoted_field});
+      return i + 1;
+    }
+  }
+  // Final record without trailing newline.
+  if (field_start < n || !fields->empty() || quoted_field) {
+    size_t end = n;
+    if (end > field_start && buf[end - 1] == '\r') --end;
+    fields->push_back({buf.data() + field_start, end - field_start, quoted_field});
+  }
+  return n;
+}
+
+// Strip surrounding quotes and collapse "" -> " into `scratch` if needed;
+// returns (ptr, len) of the logical field text.
+const char* field_text(const FieldSpan& f, size_t* len, std::string* scratch) {
+  const char* p = f.begin;
+  size_t l = f.len;
+  if (l >= 2 && p[0] == '"' && p[l - 1] == '"') {
+    p += 1;
+    l -= 2;
+  }
+  if (f.quoted && memchr(p, '"', l) != nullptr) {
+    scratch->clear();
+    for (size_t i = 0; i < l; ++i) {
+      scratch->push_back(p[i]);
+      if (p[i] == '"' && i + 1 < l && p[i + 1] == '"') ++i;
+    }
+    *len = scratch->size();
+    return scratch->data();
+  }
+  *len = l;
+  return p;
+}
+
+double parse_double(const char* p, size_t len) {
+  if (len == 0) return NAN;
+  // strtod needs NUL termination; fields are short, copy to a stack buffer.
+  char tmp[64];
+  if (len >= sizeof(tmp)) return NAN;
+  std::memcpy(tmp, p, len);
+  tmp[len] = '\0';
+  char* end = nullptr;
+  errno = 0;
+  double v = std::strtod(tmp, &end);
+  while (end && *end == ' ') ++end;
+  if (end == tmp || (end && *end != '\0')) return NAN;
+  return v;
+}
+
+// Days from civil date (Howard Hinnant's algorithm) -> days since 1970-01-01.
+int64_t days_from_civil(int64_t y, int64_t m, int64_t d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const int64_t yoe = y - era * 400;
+  const int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + doe - 719468;
+}
+
+const int64_t kNaT = INT64_MIN;
+
+int64_t parse_timestamp_ns(const char* p, size_t len) {
+  // "YYYY-MM-DD[ T]HH:MM[:SS[.frac]]" — date-only, minute-, second-, and
+  // sub-second-resolution forms, matching what numpy.datetime64 accepts in
+  // the fallback engines (io/csv.py).
+  if (len < 10) return kNaT;
+  auto digit = [&](size_t i) -> int {
+    char c = p[i];
+    return (c >= '0' && c <= '9') ? c - '0' : -1;
+  };
+  auto num = [&](size_t i, size_t n_digits, int64_t* out) -> bool {
+    int64_t v = 0;
+    for (size_t j = 0; j < n_digits; ++j) {
+      int d = digit(i + j);
+      if (d < 0) return false;
+      v = v * 10 + d;
+    }
+    *out = v;
+    return true;
+  };
+  int64_t yr, mo, dy;
+  if (!num(0, 4, &yr) || p[4] != '-' || !num(5, 2, &mo) || p[7] != '-' || !num(8, 2, &dy))
+    return kNaT;
+  if (mo < 1 || mo > 12 || dy < 1 || dy > 31) return kNaT;
+  int64_t hh = 0, mi = 0, ss = 0, frac_ns = 0;
+  if (len > 10) {
+    if ((p[10] != ' ' && p[10] != 'T') || len < 16) return kNaT;
+    if (!num(11, 2, &hh) || p[13] != ':' || !num(14, 2, &mi)) return kNaT;
+    if (len > 16) {
+      if (p[16] != ':' || len < 19 || !num(17, 2, &ss)) return kNaT;
+    }
+    if (len > 19 && p[19] == '.') {
+      int64_t scale = 100000000;  // first fractional digit = 1e8 ns
+      for (size_t i = 20; i < len && scale > 0; ++i) {
+        int d = digit(i);
+        if (d < 0) break;
+        frac_ns += d * scale;
+        scale /= 10;
+      }
+    }
+  }
+  int64_t days = days_from_civil(yr, mo, dy);
+  return ((days * 86400 + hh * 3600 + mi * 60 + ss) * 1000000000LL) + frac_ns;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count data rows (excluding the header when header != 0).
+long csv_count_rows(const char* path, int header) {
+  std::string buf;
+  if (!slurp(path, &buf)) return -1;
+  long rows = 0;
+  bool in_quotes = false;
+  bool line_has_content = false;
+  for (char c : buf) {
+    if (c == '"') in_quotes = !in_quotes;
+    if (c == '\n' && !in_quotes) {
+      if (line_has_content) ++rows;
+      line_has_content = false;
+    } else if (c != '\r') {
+      line_has_content = true;
+    }
+  }
+  if (line_has_content) ++rows;
+  if (header && rows > 0) --rows;
+  return rows;
+}
+
+// Parse the given columns as float64 into `out` (row-major rows x n_numeric).
+// Missing/invalid fields become NaN.  Returns rows written.
+long csv_parse_numeric(const char* path, int header, int ncols, const int* col_idx,
+                       int n_numeric, double* out, long cap_rows) {
+  if (!col_idx || !out || n_numeric <= 0 || ncols <= 0) return -3;
+  std::string buf;
+  if (!slurp(path, &buf)) return -1;
+  std::vector<FieldSpan> fields;
+  std::string scratch;
+  size_t pos = 0;
+  long row = 0;
+  bool first = true;
+  while (pos < buf.size()) {
+    pos = parse_record(buf, pos, &fields);
+    if (fields.empty() || (fields.size() == 1 && fields[0].len == 0)) continue;
+    if (first && header) {
+      first = false;
+      continue;
+    }
+    first = false;
+    if (row >= cap_rows) return -2;
+    for (int j = 0; j < n_numeric; ++j) {
+      int c = col_idx[j];
+      double v = NAN;
+      if (c >= 0 && static_cast<size_t>(c) < fields.size()) {
+        size_t len;
+        const char* txt = field_text(fields[c], &len, &scratch);
+        v = parse_double(txt, len);
+      }
+      out[row * n_numeric + j] = v;
+    }
+    ++row;
+  }
+  return row;
+}
+
+// Full typed-table parse.  kinds[i] per CSV column: 0 = numeric (float64 out),
+// 1 = timestamp (int64 ns out), 2 = string (bytes + offsets out).  Outputs are
+// row-major over the columns of each kind, in column order.  String cells are
+// concatenated into out_str; str_offsets has rows*n_str+1 prefix offsets.
+long csv_parse_table(const char* path, int header, int ncols, const int* kinds,
+                     double* out_num, int64_t* out_ts, char* out_str,
+                     int64_t* str_offsets, long cap_rows, int64_t cap_str_bytes) {
+  if (!kinds || ncols <= 0) return -3;
+  int n_num = 0, n_ts = 0, n_str = 0;
+  for (int i = 0; i < ncols; ++i) {
+    if (kinds[i] == 0) ++n_num;
+    else if (kinds[i] == 1) ++n_ts;
+    else if (kinds[i] == 2) ++n_str;
+    else return -3;
+  }
+  if ((n_num && !out_num) || (n_ts && !out_ts) || (n_str && (!out_str || !str_offsets)))
+    return -3;
+  std::string buf;
+  if (!slurp(path, &buf)) return -1;
+  std::vector<FieldSpan> fields;
+  std::string scratch;
+  size_t pos = 0;
+  long row = 0;
+  int64_t str_pos = 0;
+  bool first = true;
+  if (n_str) str_offsets[0] = 0;
+  while (pos < buf.size()) {
+    pos = parse_record(buf, pos, &fields);
+    if (fields.empty() || (fields.size() == 1 && fields[0].len == 0)) continue;
+    if (first && header) {
+      first = false;
+      continue;
+    }
+    first = false;
+    if (row >= cap_rows) return -2;
+    int ji = 0, jt = 0, js = 0;
+    for (int c = 0; c < ncols; ++c) {
+      size_t len = 0;
+      const char* txt = nullptr;
+      if (static_cast<size_t>(c) < fields.size()) {
+        txt = field_text(fields[c], &len, &scratch);
+      }
+      switch (kinds[c]) {
+        case 0:
+          out_num[row * n_num + ji++] = txt ? parse_double(txt, len) : NAN;
+          break;
+        case 1:
+          out_ts[row * n_ts + jt++] = txt ? parse_timestamp_ns(txt, len) : kNaT;
+          break;
+        case 2: {
+          if (str_pos + static_cast<int64_t>(len) > cap_str_bytes) return -2;
+          if (len) std::memcpy(out_str + str_pos, txt, len);
+          str_pos += static_cast<int64_t>(len);
+          str_offsets[row * n_str + js + 1] = str_pos;
+          ++js;
+          break;
+        }
+      }
+    }
+    ++row;
+  }
+  return row;
+}
+
+// Single sizing pass: data-row count and total bytes of all string-column
+// fields, so the caller can allocate exact buffers before csv_parse_table.
+// kinds may be NULL when only the row count is needed.
+long csv_size(const char* path, int header, int ncols, const int* kinds,
+              int64_t* out_str_bytes) {
+  std::string buf;
+  if (!slurp(path, &buf)) return -1;
+  std::vector<FieldSpan> fields;
+  std::string scratch;
+  size_t pos = 0;
+  long rows = 0;
+  int64_t total = 0;
+  bool first = true;
+  while (pos < buf.size()) {
+    pos = parse_record(buf, pos, &fields);
+    if (fields.empty() || (fields.size() == 1 && fields[0].len == 0)) continue;
+    if (first && header) {
+      first = false;
+      continue;
+    }
+    first = false;
+    ++rows;
+    if (kinds) {
+      for (int c = 0; c < ncols && static_cast<size_t>(c) < fields.size(); ++c) {
+        if (kinds[c] == 2) {
+          size_t len;
+          field_text(fields[c], &len, &scratch);
+          total += static_cast<int64_t>(len);
+        }
+      }
+    }
+  }
+  if (out_str_bytes) *out_str_bytes = total;
+  return rows;
+}
+
+// List regular files under `path` whose names end with `suffix`, writing
+// NUL-terminated "mtime_ns\tsize\tname" records into `out` (the streaming
+// file source's native directory watch).  NUL is the one byte a POSIX
+// filename cannot contain, so names with newlines/tabs cannot corrupt the
+// framing (the name is the final field).  Returns the number of entries,
+// or -2 if `cap` is too small (caller retries with a bigger buffer).
+long dir_list(const char* path, const char* suffix, char* out, long cap) {
+  if (!path || !out || cap <= 0) return -3;
+  DIR* d = opendir(path);
+  if (!d) return -1;
+  size_t suffix_len = suffix ? std::strlen(suffix) : 0;
+  std::string base(path);
+  if (!base.empty() && base.back() != '/') base.push_back('/');
+  long count = 0;
+  long used = 0;
+  char rec[4352];
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    size_t nlen = std::strlen(e->d_name);
+    if (suffix_len && (nlen < suffix_len ||
+                       std::memcmp(e->d_name + nlen - suffix_len, suffix, suffix_len) != 0))
+      continue;
+    std::string full = base + e->d_name;
+    struct stat st;
+    if (stat(full.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) continue;
+    int64_t mtime_ns =
+        static_cast<int64_t>(st.st_mtime) * 1000000000LL +
+#if defined(__APPLE__)
+        static_cast<int64_t>(st.st_mtimespec.tv_nsec);
+#else
+        static_cast<int64_t>(st.st_mtim.tv_nsec);
+#endif
+    int rl = std::snprintf(rec, sizeof(rec), "%lld\t%lld\t%s",
+                           static_cast<long long>(mtime_ns),
+                           static_cast<long long>(st.st_size), e->d_name);
+    if (rl < 0 || rl >= static_cast<int>(sizeof(rec))) continue;
+    if (used + rl + 1 > cap) {
+      closedir(d);
+      return -2;
+    }
+    std::memcpy(out + used, rec, rl + 1);  // include the terminating NUL
+    used += rl + 1;
+    ++count;
+  }
+  closedir(d);
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,165 @@
+"""Native C++ scan/watch shim (native/csv_scan.cpp via io/native.py).
+
+The shim replaces the host half of the reference's ingest stack — Spark
+Tungsten's generated CSV scan + the streaming file source's directory
+listing (mllearnforhospitalnetwork.py:74-82; SURVEY.md E1/E2).  Tests
+assert byte-for-byte agreement with the pure-Python engines so the fast
+path can never silently change semantics.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.schema import (
+    FLOAT,
+    INT,
+    STRING,
+    TIMESTAMP,
+    Schema,
+    hospital_event_schema,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import native
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.csv import (
+    read_csv,
+    write_csv,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.source import (
+    FileStreamSource,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native shim not built (no toolchain)"
+)
+
+
+CSV = """hospital_id,event_time,admission_count,current_occupancy,emergency_visits,seasonality_index,length_of_stay
+H00,2025-03-31 22:00:00,5,120,3,1.05,4.5
+H01,2025-03-31 22:00:01,7,200,1,0.95,6.25
+H02,2025-03-31 22:00:02.500,2,80,0,1.20,3.0
+"""
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    p = tmp_path / "events.csv"
+    p.write_text(CSV)
+    return str(p)
+
+
+def test_count_rows(csv_file):
+    assert native.native_count_rows(csv_file, header=True) == 3
+    assert native.native_count_rows(csv_file, header=False) == 4
+
+
+def test_parse_numeric_projection(csv_file):
+    out = native.native_parse_numeric(csv_file, [2, 3, 6], ncols=7)
+    np.testing.assert_allclose(
+        out, [[5, 120, 4.5], [7, 200, 6.25], [2, 80, 3.0]]
+    )
+
+
+def test_full_table_matches_numpy_engine(csv_file):
+    schema = hospital_event_schema()
+    t_native = read_csv(csv_file, schema, engine="native")
+    t_numpy = read_csv(csv_file, schema, engine="numpy")
+    assert list(t_native.columns["hospital_id"]) == list(t_numpy.columns["hospital_id"])
+    np.testing.assert_array_equal(
+        t_native.columns["event_time"], t_numpy.columns["event_time"]
+    )
+    for c in ("admission_count", "current_occupancy", "emergency_visits",
+              "seasonality_index", "length_of_stay"):
+        np.testing.assert_allclose(t_native.columns[c], t_numpy.columns[c])
+
+
+def test_fractional_timestamp(csv_file):
+    schema = hospital_event_schema()
+    t = read_csv(csv_file, schema, engine="native")
+    assert t.columns["event_time"][2] == np.datetime64("2025-03-31T22:00:02.500")
+
+
+def test_quoted_fields_and_escapes(tmp_path):
+    p = tmp_path / "q.csv"
+    p.write_text(
+        'name,value\n"Smith, John",1.5\n"say ""hi""",2.5\n'
+    )
+    schema = Schema([("name", STRING), ("value", FLOAT)])
+    t = read_csv(str(p), schema, engine="native")
+    assert list(t.columns["name"]) == ['Smith, John', 'say "hi"']
+    np.testing.assert_allclose(t.columns["value"], [1.5, 2.5])
+
+
+def test_invalid_and_empty_numerics_are_nan_then_droppable(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a,b\n1,2\n,3\nx,4\n")
+    schema = Schema([("a", FLOAT), ("b", FLOAT)])
+    t = read_csv(str(p), schema, engine="native")
+    assert np.isnan(t.columns["a"][1]) and np.isnan(t.columns["a"][2])
+    dropped = t.na_drop()
+    assert len(dropped) == 1
+
+
+def test_empty_and_bad_timestamp_is_nat(tmp_path):
+    p = tmp_path / "ts.csv"
+    p.write_text("t,v\n2025-01-02 03:04:05,1\n,2\nnot-a-time,3\n")
+    schema = Schema([("t", TIMESTAMP), ("v", INT)])
+    t = read_csv(str(p), schema, engine="native")
+    col = t.columns["t"]
+    assert col[0] == np.datetime64("2025-01-02T03:04:05")
+    assert np.isnat(col[1]) and np.isnat(col[2])
+
+
+def test_minute_resolution_and_date_only_timestamps(tmp_path):
+    p = tmp_path / "res.csv"
+    p.write_text("t,v\n2025-03-31 22:00,1\n2025-03-31,2\n2025-03-31T22:05,3\n")
+    schema = Schema([("t", TIMESTAMP), ("v", INT)])
+    t_native = read_csv(str(p), schema, engine="native")
+    t_numpy = read_csv(str(p), schema, engine="numpy")
+    np.testing.assert_array_equal(t_native.columns["t"], t_numpy.columns["t"])
+
+
+def test_dir_list_odd_filenames(tmp_path):
+    (tmp_path / "a\tb.csv").write_text("x\n1\n")
+    (tmp_path / "plain.csv").write_text("x\n1\n")
+    entries = native.native_dir_list(str(tmp_path), ".csv")
+    names = sorted(name for _, _, name in entries)
+    assert names == ["a\tb.csv", "plain.csv"]
+
+
+def test_roundtrip_through_write_csv(tmp_path, hospital_table):
+    p = tmp_path / "round.csv"
+    write_csv(hospital_table, str(p))
+    back = read_csv(str(p), hospital_table.schema, engine="native")
+    assert len(back) == len(hospital_table)
+    np.testing.assert_allclose(
+        back.columns["length_of_stay"], hospital_table.columns["length_of_stay"]
+    )
+    np.testing.assert_array_equal(
+        back.columns["event_time"], hospital_table.columns["event_time"]
+    )
+
+
+def test_native_dir_list_matches_scandir(tmp_path):
+    for i in range(3):
+        (tmp_path / f"f{i}.csv").write_text("a\n1\n")
+    (tmp_path / "skip.txt").write_text("x")
+    os.mkdir(tmp_path / "sub.csv")  # directories must be excluded
+    entries = native.native_dir_list(str(tmp_path), ".csv")
+    names = sorted(name for _, _, name in entries)
+    assert names == ["f0.csv", "f1.csv", "f2.csv"]
+    for mtime_ns, size, name in entries:
+        st = os.stat(tmp_path / name)
+        assert size == st.st_size
+        assert mtime_ns == st.st_mtime_ns
+
+
+def test_stream_source_uses_native_listing(tmp_path):
+    src = FileStreamSource(str(tmp_path), hospital_event_schema())
+    assert src.poll() == []
+    (tmp_path / "a.csv").write_text(CSV)
+    batch = src.poll()
+    assert [os.path.basename(f) for f in batch] == ["a.csv"]
+    tbl = src.read_files(batch)
+    assert len(tbl) == 3
