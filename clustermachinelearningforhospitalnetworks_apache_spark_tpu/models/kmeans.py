@@ -46,6 +46,29 @@ from .base import Estimator, Model, as_device_dataset
 _BIG = jnp.float32(1e30)
 
 
+def _finalize_lloyd(sums, counts, cost, centers, c_valid, cosine: bool):
+    """Shared tail of both step builders: combine per-shard stats over the
+    data axis, apply the centroid update (empty clusters keep their previous
+    center — Spark behavior), and compute the convergence movement."""
+    sums = lax.psum(sums, DATA_AXIS)
+    counts = lax.psum(counts, DATA_AXIS)
+    # cost is numerically identical on every model shard (built from the
+    # global per-row minima); pmax collapses the model-axis variance so it
+    # can be emitted replicated.
+    cost = lax.pmax(lax.psum(cost, DATA_AXIS), MODEL_AXIS)
+    new_centers = jnp.where(
+        (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], centers
+    )
+    if cosine:
+        # Spark's CosineDistanceMeasure re-normalizes the centroid after
+        # every update; without this the ||c||² term in the distance
+        # stops ordering by cosine similarity.
+        new_centers = normalize_rows(new_centers)
+    move = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1) * c_valid)
+    move = lax.pmax(move, MODEL_AXIS)
+    return new_centers, counts, cost, move
+
+
 def _chunked(n_loc: int, target: int) -> tuple[int, int]:
     """(n_chunks, chunk) covering n_loc with static shapes."""
     chunk = min(max(target, 1), n_loc) if n_loc > 0 else 1
@@ -101,23 +124,7 @@ def _make_train_step(
             ),
         )
         (sums, counts, cost), _ = lax.scan(body, init, (xc, wc))
-        sums = lax.psum(sums, DATA_AXIS)
-        counts = lax.psum(counts, DATA_AXIS)
-        # cost is numerically identical on every model shard (it is built
-        # from the global per-row minima); pmax collapses the model-axis
-        # variance so it can be emitted replicated.
-        cost = lax.pmax(lax.psum(cost, DATA_AXIS), MODEL_AXIS)
-        new_centers = jnp.where(
-            (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], centers
-        )
-        if cosine:
-            # Spark's CosineDistanceMeasure re-normalizes the centroid after
-            # every update; without this the ||c||² term in the distance
-            # stops ordering by cosine similarity.
-            new_centers = normalize_rows(new_centers)
-        move = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1) * c_valid)
-        move = lax.pmax(move, MODEL_AXIS)
-        return new_centers, counts, cost, move
+        return _finalize_lloyd(sums, counts, cost, centers, c_valid, cosine)
 
     return jax.jit(
         jax.shard_map(
@@ -125,6 +132,49 @@ def _make_train_step(
             mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
             out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P()),
+        )
+    )
+
+
+@lru_cache(maxsize=64)
+def _make_train_step_fused(mesh: Mesh, k_pad: int, cosine: bool):
+    """Lloyd iteration with the Pallas fused stats kernel per data shard
+    (ops/pallas_kernels.py) — one VMEM-resident pass producing center
+    sums/counts/cost without materializing the (rows, k) distance or
+    one-hot matrices in HBM.  Requires the model axis to be 1 (the
+    single-chip / pure-DP case, which includes the BASELINE bench)."""
+    from ..ops.pallas_kernels import fused_lloyd_stats
+
+    def shard_fn(x, w, centers, c_valid):
+        # The kernel's operands must agree on their varying mesh axes
+        # (x varies over data, centers over model): pcast each to varying
+        # over whichever axes it doesn't already vary on.
+        def vary_both(z):
+            missing = tuple(
+                a for a in (DATA_AXIS, MODEL_AXIS) if a not in jax.typeof(z).vma
+            )
+            return lax.pcast(z, missing, to="varying") if missing else z
+
+        x, w, centers, c_valid = (
+            vary_both(x), vary_both(w), vary_both(centers), vary_both(c_valid)
+        )
+        # block_rows=None → the kernel's VMEM-aware auto block size (the
+        # estimator's chunk_rows targets the XLA scan path and overflows
+        # scoped VMEM if forced on the kernel).
+        sums, counts, cost = fused_lloyd_stats(x, w, centers, c_valid)
+        return _finalize_lloyd(sums, counts, cost, centers, c_valid, cosine)
+
+    # check_vma=False: the pallas_call blocks shard_map's static
+    # replication inference (interpret mode discards the vma annotations);
+    # the psum/pmax calls above establish the replication the out_specs
+    # promise, exactly as in the checked scan path.
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
+            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P()),
+            check_vma=False,
         )
     )
 
@@ -219,8 +269,12 @@ class KMeansModel(Model):
         x = x.astype(jnp.float32)
         return normalize_rows(x) if self.distance_measure == "cosine" else x
 
-    def predict(self, x: jax.Array) -> jax.Array:
+    def predict(self, x: jax.Array, use_pallas: bool = False) -> jax.Array:
         centers = jnp.asarray(self.cluster_centers, jnp.float32)
+        if use_pallas:
+            from ..ops.pallas_kernels import fused_assign
+
+            return fused_assign(self._prep(x), centers)[0]
         return _predict_fn(self._prep(x), centers)
 
     def compute_cost(self, data, mesh=None) -> float:
@@ -270,6 +324,10 @@ class KMeans(Estimator):
     distance_measure: str = "euclidean"  # or "cosine"
     chunk_rows: int = 16384
     init_sample_size: int = 65536
+    # Pallas fused Lloyd kernel (ops/pallas_kernels.py), opt-in; requires
+    # model axis 1.  None/False = the XLA scan path, which measures faster
+    # at this workload's shapes (kernel docstring has the numbers).
+    use_pallas: bool | None = None
 
     def _init_centers(self, ds: DeviceDataset, mesh: Mesh) -> np.ndarray:
         # Host-side init on a bounded sample of valid rows (only the sample
@@ -311,9 +369,23 @@ class KMeans(Estimator):
         c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
 
         n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
-        step = _make_train_step(
-            mesh, n_loc, k_pad, d, self.chunk_rows, self.distance_measure == "cosine"
-        )
+        cosine = self.distance_measure == "cosine"
+        if self.use_pallas is not None:
+            fused = self.use_pallas
+            if fused and m != 1:
+                raise ValueError(
+                    "use_pallas=True requires a model axis of 1 (the fused "
+                    f"kernel owns the whole centroid set); got model={m}"
+                )
+        else:
+            # auto = XLA scan path: measured faster than the Pallas kernel
+            # at this workload's shapes (see ops/pallas_kernels.py docstring
+            # for the numbers); the kernel stays opt-in.
+            fused = False
+        if fused:
+            step = _make_train_step_fused(mesh, k_pad, cosine)
+        else:
+            step = _make_train_step(mesh, n_loc, k_pad, d, self.chunk_rows, cosine)
 
         it = 0
         for it in range(1, self.max_iter + 1):

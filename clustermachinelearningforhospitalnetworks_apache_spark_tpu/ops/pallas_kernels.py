@@ -1,0 +1,264 @@
+"""Pallas TPU kernels for the clustering hot loop.
+
+SURVEY.md §3.3 names the Lloyd assignment/accumulation step as "the kernel
+to own (Pallas)": Spark MLlib runs it row-by-row on the JVM inside
+``treeAggregate`` (reference ``mllearnforhospitalnetwork.py`` delegates
+every ``KMeans.fit``-style call to that machinery).  The XLA fallback in
+``models/kmeans.py`` already batches it onto the MXU, but materializes the
+``(rows, k)`` distance matrix **and** a same-shaped one-hot in HBM between
+the matmul and the ``segment_sum``.  The fused kernel here keeps one row
+block resident in VMEM and produces the per-block sufficient statistics
+directly:
+
+    HBM traffic per block:  x  in   (B·d floats)
+                            sums/counts out  (k·d + k, once per pass)
+
+instead of ``B·d + 2·B·k`` — for the BASELINE north star (k=256, d≈8)
+that is a ~65× cut in bytes moved, turning an HBM-bound loop compute-bound.
+
+Two entry points:
+
+``fused_lloyd_stats``  — one pass over a row shard: weighted center sums,
+                         counts, total cost.  Drives the KMeans fit when
+                         ``KMeans(use_pallas=True)`` (model axis must be 1).
+``fused_assign``       — distance+argmin only (opt-in predict path).
+
+Both run in interpreter mode off-TPU so the CPU test mesh exercises the
+exact kernel code path (tests/test_pallas.py).
+
+**Status (measured, v5e single chip, k=256 d=8 n=10M, 2026-07-29):** the
+XLA ``lax.scan`` path in models/kmeans.py sustains ~270M records/s/chip;
+this kernel ~112M (block 2048; ≥4096 exceeds VMEM), and the gap is
+VPU-chain/overhead-bound, not matmul-precision-bound (DEFAULT-precision
+matmuls measure *slower*, 83M).  At d=8 the workload is too skinny for a
+hand-scheduled win — XLA's fusion already keeps the (rows, k)
+intermediates out of HBM inside the scan body.  The kernels therefore stay
+**opt-in** (``use_pallas=True``): correct, TPU-compiled, parity-tested,
+and the starting point for wide-d workloads where the fused accumulation
+should pay off.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG = 1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # backend not initialized yet
+        return False
+
+
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct whose ``vma`` (varying-across-mesh-axes set, checked
+    by shard_map in JAX ≥0.9) is the union of the operands' — so the kernels
+    compose with shard_map without the caller threading axis names in."""
+    vma = None
+    for op in operands:
+        v = getattr(jax.typeof(op), "vma", None)
+        if v:
+            vma = v if vma is None else vma | v
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
+def _pick_block_rows(n: int, k: int, d: int, requested: int | None) -> int:
+    """Auto block size: largest power-of-two whose VMEM-resident buffers
+    (padded x block + the (B, k) distance/one-hot intermediates) stay
+    within budget.  An explicit ``requested`` is honored as-is (clamped to
+    ≥8) — callers tuning for a specific chip own the VMEM math."""
+    if requested is not None:
+        return max(requested, 8)
+    # ~4 live (B, k) f32 intermediates (cross, d2, one-hot, compare) plus
+    # the padded x block; 10 MB budget picks 2048 at k=256/d=8, which is
+    # the largest block that compiles on v5e (4096 exceeds scoped VMEM).
+    budget = 10 * 1024 * 1024
+    b = 8192
+    while b > 8 and 4 * b * (max(d, 128) + 4 * max(k, 128)) > budget:
+        b //= 2
+    return max(b, 8)
+
+
+def _lloyd_kernel(x_ref, w_ref, c_ref, cvalid_ref, sums_ref, counts_ref, cost_ref):
+    """Grid dim 0 walks row blocks; outputs revisit block (0, 0) every step
+    (TPU grid is sequential per core), so they act as VMEM accumulators."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        cost_ref[:] = jnp.zeros_like(cost_ref)
+
+    x = x_ref[:]                      # (B, d)
+    w = w_ref[:]                      # (B, 1)
+    c = c_ref[:]                      # (k, d)
+    cvalid = cvalid_ref[:]            # (1, k)
+
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)          # (B, 1)
+    c_sq = jnp.sum(c * c, axis=1, keepdims=True)          # (k, 1)
+    # precision=HIGHEST matches ops/distance.py — without it the MXU runs
+    # bf16-truncated passes on TPU and near-tied argmins flip vs XLA.
+    cross = jnp.dot(
+        x, c.T, precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )                                                     # MXU (B, k)
+    d2 = x_sq - 2.0 * cross + c_sq.T
+    d2 = jnp.maximum(d2, 0.0)
+    d2 = jnp.where(cvalid > 0.0, d2, _BIG)
+
+    min_d2 = jnp.min(d2, axis=1, keepdims=True)           # (B, 1)
+    assign = jnp.argmin(d2, axis=1)                       # (B,)
+
+    k = c.shape[0]
+    onehot = (
+        lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1) == assign[:, None]
+    ).astype(jnp.float32) * w                             # (B, k), weighted
+    sums_ref[:] += jnp.dot(
+        onehot.T, x, precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True).T     # (k, 1)
+    # (1, 1)-shaped store — Mosaic cannot store scalars to VMEM
+    cost_ref[:] += jnp.sum(min_d2 * w, axis=(0, 1), keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _lloyd_call(x, w, centers, c_valid, *, block_rows: int, interpret: bool):
+    n, d = x.shape
+    k = centers.shape[0]
+    grid = (n // block_rows,)
+    sums, counts, cost = pl.pallas_call(
+        _lloyd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((k, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            _out_struct((k, d), jnp.float32, x, w, centers, c_valid),
+            _out_struct((k, 1), jnp.float32, x, w, centers, c_valid),
+            _out_struct((1, 1), jnp.float32, x, w, centers, c_valid),
+        ],
+        interpret=interpret,
+    )(x, w, centers, c_valid)
+    return sums, counts[:, 0], cost[0, 0]
+
+
+def fused_lloyd_stats(
+    x: jax.Array,
+    w: jax.Array,
+    centers: jax.Array,
+    c_valid: jax.Array,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+):
+    """One fused pass: → (sums (k, d), counts (k,), cost ()).
+
+    ``x`` (n, d) rows with validity/frequency weights ``w`` (n,);
+    ``centers`` (k, d); ``c_valid`` (k,) 1.0 for live centroids (padding
+    slots score +inf and never attract rows).  Rows are processed in
+    VMEM-resident blocks; n is padded internally to a block multiple with
+    w=0 so any n is accepted.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    x = x.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    centers = centers.astype(jnp.float32)
+    n, d = x.shape
+    k = centers.shape[0]
+    b = _pick_block_rows(n, k, d, block_rows)
+    pad = (-n) % b
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        w = jnp.pad(w, (0, pad))
+    return _lloyd_call(
+        x, w[:, None], centers, c_valid.astype(jnp.float32)[None, :],
+        block_rows=b, interpret=bool(interpret),
+    )
+
+
+def _assign_kernel(x_ref, c_ref, cvalid_ref, out_ref, d2_ref):
+    x = x_ref[:]
+    c = c_ref[:]
+    cvalid = cvalid_ref[:]
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)
+    c_sq = jnp.sum(c * c, axis=1, keepdims=True)
+    cross = jnp.dot(
+        x, c.T, precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    d2 = jnp.maximum(x_sq - 2.0 * cross + c_sq.T, 0.0)
+    d2 = jnp.where(cvalid > 0.0, d2, _BIG)
+    out_ref[:] = jnp.argmin(d2, axis=1, keepdims=True).astype(jnp.int32)
+    d2_ref[:] = jnp.min(d2, axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _assign_call(x, centers, c_valid, *, block_rows: int, interpret: bool):
+    n, d = x.shape
+    k = centers.shape[0]
+    assign, d2 = pl.pallas_call(
+        _assign_kernel,
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            _out_struct((n, 1), jnp.int32, x, centers, c_valid),
+            _out_struct((n, 1), jnp.float32, x, centers, c_valid),
+        ],
+        interpret=interpret,
+    )(x, centers, c_valid)
+    return assign[:, 0], d2[:, 0]
+
+
+def fused_assign(
+    x: jax.Array,
+    centers: jax.Array,
+    c_valid: jax.Array | None = None,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+):
+    """Fused distance+argmin: → (assignment (n,) int32, min-sq-dist (n,))."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    x = x.astype(jnp.float32)
+    centers = centers.astype(jnp.float32)
+    n, d = x.shape
+    k = centers.shape[0]
+    if c_valid is None:
+        c_valid = jnp.ones((k,), jnp.float32)
+    b = _pick_block_rows(n, k, d, block_rows)
+    pad = (-n) % b
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    a, d2 = _assign_call(
+        x, centers, c_valid.astype(jnp.float32)[None, :],
+        block_rows=b, interpret=bool(interpret),
+    )
+    return a[:n], d2[:n]
