@@ -1,8 +1,10 @@
 from .csv import read_csv, read_csv_dir, write_csv
+from .fit_checkpoint import FitCheckpointer
 from .model_io import load_model, register_model, save_model
 from .native import native_available
 
 __all__ = [
+    "FitCheckpointer",
     "read_csv",
     "read_csv_dir",
     "write_csv",

@@ -130,8 +130,17 @@ class GaussianMixture(Estimator):
     seed: int = 0
     reg_covar: float = 1e-6
     init_sample_size: int = 65536
+    # Mid-training checkpointing (io/fit_checkpoint.py): commit EM state
+    # (means, covariances, weights, log-likelihood) every N iterations so a
+    # preempted fit resumes from the last commit.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 5
 
-    def fit(self, data, label_col: str | None = None, mesh=None) -> GaussianMixtureModel:
+    def fit(
+        self, data, label_col: str | None = None, mesh=None, on_iteration=None
+    ) -> GaussianMixtureModel:
+        """``on_iteration(it, log_likelihood)`` (optional) fires after every
+        EM step — progress reporting and fault-injection hooks."""
         mesh = mesh or default_mesh()
         ds: DeviceDataset = as_device_dataset(data, mesh=mesh)
         x = ds.x.astype(jnp.float32)
@@ -141,43 +150,73 @@ class GaussianMixture(Estimator):
         if n == 0:
             raise ValueError("GaussianMixture fit on an empty dataset")
 
-        # Init on a bounded host sample (only the sample leaves the device).
-        from ..parallel.sharding import sample_valid_rows
+        ckpt = None
+        resumed = None
+        if self.checkpoint_dir:
+            from ..io.fit_checkpoint import FitCheckpointer
 
-        valid = sample_valid_rows(
-            DeviceDataset(x, ds.y, w), self.init_sample_size, self.seed
-        )
-        # k-means++ seeding + short Lloyd refinement (sklearn's init_params=
-        # "kmeans" equivalent) — raw ++ points alone leave EM in visibly
-        # worse local optima on close blob pairs.
-        means64, assign0 = _lloyd_refine(
-            valid, _kmeans_pp_init(valid, self.k, self.seed), iters=10, return_assign=True
-        )
-        means = means64.astype(np.float32)
-        # Per-cluster diagonal covariance + cluster-share weights from the
-        # init assignment (global variance spans the blob spread and makes
-        # the first E-step responsibilities near-uniform, collapsing means).
-        covs = np.empty((self.k, d, d), dtype=np.float32)
-        weights = np.empty((self.k,), dtype=np.float32)
-        global_var = np.maximum(valid.var(axis=0), self.reg_covar)
-        for j in range(self.k):
-            mask = assign0 == j
-            weights[j] = max(mask.mean(), 1e-6)
-            if mask.sum() >= 2:
-                covs[j] = np.diag(np.maximum(valid[mask].var(axis=0), self.reg_covar))
-            else:
-                covs[j] = np.diag(global_var)
-        weights = weights / weights.sum()
+            signature = {
+                "estimator": "GaussianMixture", "k": self.k, "d": d,
+                "n_padded": ds.n_padded, "seed": self.seed,
+                "reg_covar": self.reg_covar, "tol": self.tol,
+            }
+            ckpt = FitCheckpointer(self.checkpoint_dir, signature)
+            resumed = ckpt.resume()
+
+        start_it = 1
+        prev_ll = -np.inf
+        if resumed is not None:
+            step0, arrays, extra = resumed
+            means = arrays["means"].astype(np.float32)
+            covs = arrays["covariances"].astype(np.float32)
+            weights = arrays["weights"].astype(np.float32)
+            prev_ll = float(extra.get("prev_ll", -np.inf))
+            start_it = step0 + 1
+        else:
+            # Init on a bounded host sample (only the sample leaves the
+            # device).
+            from ..parallel.sharding import sample_valid_rows
+
+            valid = sample_valid_rows(
+                DeviceDataset(x, ds.y, w), self.init_sample_size, self.seed
+            )
+            # k-means++ seeding + short Lloyd refinement (sklearn's
+            # init_params="kmeans" equivalent) — raw ++ points alone leave
+            # EM in visibly worse local optima on close blob pairs.
+            means64, assign0 = _lloyd_refine(
+                valid, _kmeans_pp_init(valid, self.k, self.seed), iters=10,
+                return_assign=True,
+            )
+            means = means64.astype(np.float32)
+            # Per-cluster diagonal covariance + cluster-share weights from
+            # the init assignment (global variance spans the blob spread and
+            # makes the first E-step responsibilities near-uniform,
+            # collapsing means).
+            covs = np.empty((self.k, d, d), dtype=np.float32)
+            weights = np.empty((self.k,), dtype=np.float32)
+            global_var = np.maximum(valid.var(axis=0), self.reg_covar)
+            for j in range(self.k):
+                mask = assign0 == j
+                weights[j] = max(mask.mean(), 1e-6)
+                if mask.sum() >= 2:
+                    covs[j] = np.diag(
+                        np.maximum(valid[mask].var(axis=0), self.reg_covar)
+                    )
+                else:
+                    covs[j] = np.diag(global_var)
+            weights = weights / weights.sum()
 
         means_d = jnp.asarray(means)
         covs_d = jnp.asarray(covs)
         weights_d = jnp.asarray(weights)
         eye = jnp.eye(d, dtype=jnp.float32)
 
-        prev_ll = -np.inf
-        ll = 0.0
-        it = 0
-        for it in range(1, self.max_iter + 1):
+        # A resume that lands past max_iter skips the loop entirely — seed
+        # ll from the checkpoint so the returned model reports the real
+        # likelihood, not 0.0.
+        ll = prev_ll if np.isfinite(prev_ll) else 0.0
+        it = start_it - 1
+        for it in range(start_it, self.max_iter + 1):
             chols = jnp.linalg.cholesky(covs_d + self.reg_covar * eye[None])
             resp, ll_dev = _e_step(x, w, jnp.log(weights_d), means_d, chols)
             nk, sums, outer = _m_step_stats(x, resp)
@@ -189,6 +228,18 @@ class GaussianMixture(Estimator):
             covs_d = covs_d + self.reg_covar * eye[None]
             weights_d = nk / jnp.sum(nk)
             ll = float(ll_dev)  # TOTAL log-likelihood — Spark applies tol here
+            if ckpt is not None and it % max(self.checkpoint_every, 1) == 0:
+                ckpt.save(
+                    it,
+                    {
+                        "means": np.asarray(jax.device_get(means_d)),
+                        "covariances": np.asarray(jax.device_get(covs_d)),
+                        "weights": np.asarray(jax.device_get(weights_d)),
+                    },
+                    extra={"prev_ll": ll},
+                )
+            if on_iteration is not None:
+                on_iteration(it, ll)
             if abs(ll - prev_ll) < self.tol:
                 prev_ll = ll
                 break

@@ -328,6 +328,11 @@ class KMeans(Estimator):
     # model axis 1.  None/False = the XLA scan path, which measures faster
     # at this workload's shapes (kernel docstring has the numbers).
     use_pallas: bool | None = None
+    # Mid-training checkpointing (io/fit_checkpoint.py): every
+    # checkpoint_every iterations the centroid state is committed so a
+    # preempted fit resumes from the last commit instead of restarting.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 5
 
     def _init_centers(self, ds: DeviceDataset, mesh: Mesh) -> np.ndarray:
         # Host-side init on a bounded sample of valid rows (only the sample
@@ -350,19 +355,51 @@ class KMeans(Estimator):
             return centers
         return _kmeans_pp_init(valid, self.k, self.seed)
 
-    def fit(self, data, label_col: str | None = None, mesh=None) -> KMeansModel:
+    def fit(
+        self, data, label_col: str | None = None, mesh=None, on_iteration=None
+    ) -> KMeansModel:
+        """``on_iteration(it, cost, move)`` (optional) fires after every
+        Lloyd step — progress reporting, early aborts, and the fault-
+        injection hooks the checkpoint tests use."""
         mesh = mesh or default_mesh()
         ds = as_device_dataset(data, mesh=mesh)
         x = ds.x.astype(jnp.float32)
         if self.distance_measure == "cosine":
             x = normalize_rows(x) * ds.w[:, None]
-        centers0 = self._init_centers(DeviceDataset(x, ds.y, ds.w), mesh)
 
         m = mesh.shape[MODEL_AXIS]
         k_pad = -(-self.k // m) * m
         d = x.shape[1]
-        cen = np.zeros((k_pad, d), dtype=np.float32)
-        cen[: self.k] = centers0
+
+        ckpt = None
+        resumed = None
+        if self.checkpoint_dir:
+            from ..io.fit_checkpoint import FitCheckpointer
+
+            signature = {
+                "estimator": "KMeans", "k": self.k, "d": d,
+                "k_pad": k_pad,  # depends on the mesh's model axis
+                "n_padded": ds.n_padded, "seed": self.seed,
+                "init_mode": self.init_mode,
+                "distance_measure": self.distance_measure, "tol": self.tol,
+            }
+            ckpt = FitCheckpointer(self.checkpoint_dir, signature)
+            resumed = ckpt.resume()
+
+        start_it = 1
+        if resumed is not None:
+            step0, arrays, _ = resumed
+            cen = arrays["centers"].astype(np.float32)
+            if cen.shape != (k_pad, d):
+                raise ValueError(
+                    f"checkpointed centers shape {cen.shape} does not match "
+                    f"this mesh's padded layout {(k_pad, d)}"
+                )
+            start_it = step0 + 1
+        else:
+            centers0 = self._init_centers(DeviceDataset(x, ds.y, ds.w), mesh)
+            cen = np.zeros((k_pad, d), dtype=np.float32)
+            cen[: self.k] = centers0
         c_valid = np.zeros((k_pad,), dtype=np.float32)
         c_valid[: self.k] = 1.0
         centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
@@ -387,9 +424,13 @@ class KMeans(Estimator):
         else:
             step = _make_train_step(mesh, n_loc, k_pad, d, self.chunk_rows, cosine)
 
-        it = 0
-        for it in range(1, self.max_iter + 1):
-            centers, _, _, move = step(x, ds.w, centers, c_valid_dev)
+        it = start_it - 1
+        for it in range(start_it, self.max_iter + 1):
+            centers, _, cost_it, move = step(x, ds.w, centers, c_valid_dev)
+            if ckpt is not None and it % max(self.checkpoint_every, 1) == 0:
+                ckpt.save(it, {"centers": np.asarray(jax.device_get(centers))})
+            if on_iteration is not None:
+                on_iteration(it, float(cost_it), float(move))
             if float(move) <= self.tol * self.tol:
                 break
         # One extra assignment pass so cost/sizes describe the RETURNED
