@@ -48,6 +48,7 @@ from .models import (
     GaussianMixture,
     KMeans,
     LinearRegression,
+    LogisticRegression,
     RandomForestClassifier,
     RandomForestRegressor,
     StreamingKMeans,
@@ -92,6 +93,7 @@ __all__ = [
     "GaussianMixture",
     "KMeans",
     "LinearRegression",
+    "LogisticRegression",
     "RandomForestClassifier",
     "RandomForestRegressor",
     "StreamingKMeans",

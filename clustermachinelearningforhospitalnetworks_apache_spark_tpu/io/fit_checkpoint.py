@@ -23,6 +23,7 @@ signature raises instead of silently continuing the wrong run.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
@@ -30,6 +31,21 @@ import shutil
 import numpy as np
 
 COMMIT_FILE = "COMMIT"
+
+
+def data_fingerprint(x, w=None, sample: int = 1024) -> str:
+    """Cheap deterministic identity for a (possibly sharded) dataset: hash
+    of an evenly-strided row sample.  Estimators put this in the checkpoint
+    signature so resuming against *different data of the same shape* raises
+    instead of silently continuing the previous run's trajectory."""
+    import jax
+
+    n = x.shape[0]
+    idx = np.linspace(0, max(n - 1, 0), num=min(sample, n), dtype=np.int64)
+    h = hashlib.sha1(np.ascontiguousarray(np.asarray(jax.device_get(x[idx]))).tobytes())
+    if w is not None:
+        h.update(np.ascontiguousarray(np.asarray(jax.device_get(w[idx]))).tobytes())
+    return h.hexdigest()[:16]
 
 
 def _fsync_dir(path: str) -> None:

@@ -1,5 +1,6 @@
 from .base import Estimator, Model, PredictionResult, as_device_dataset
 from .linear_regression import LinearRegression, LinearRegressionModel
+from .logistic_regression import LogisticRegression, LogisticRegressionModel
 from .kmeans import KMeans, KMeansModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
@@ -20,6 +21,8 @@ __all__ = [
     "as_device_dataset",
     "LinearRegression",
     "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
     "KMeans",
     "KMeansModel",
     "GaussianMixture",

@@ -153,10 +153,11 @@ class GaussianMixture(Estimator):
         ckpt = None
         resumed = None
         if self.checkpoint_dir:
-            from ..io.fit_checkpoint import FitCheckpointer
+            from ..io.fit_checkpoint import FitCheckpointer, data_fingerprint
 
             signature = {
                 "estimator": "GaussianMixture", "k": self.k, "d": d,
+                "data": data_fingerprint(x, w),
                 "n_padded": ds.n_padded, "seed": self.seed,
                 "reg_covar": self.reg_covar, "tol": self.tol,
             }

@@ -374,11 +374,12 @@ class KMeans(Estimator):
         ckpt = None
         resumed = None
         if self.checkpoint_dir:
-            from ..io.fit_checkpoint import FitCheckpointer
+            from ..io.fit_checkpoint import FitCheckpointer, data_fingerprint
 
             signature = {
                 "estimator": "KMeans", "k": self.k, "d": d,
                 "k_pad": k_pad,  # depends on the mesh's model axis
+                "data": data_fingerprint(x, ds.w),
                 "n_padded": ds.n_padded, "seed": self.seed,
                 "init_mode": self.init_mode,
                 "distance_measure": self.distance_measure, "tol": self.tol,

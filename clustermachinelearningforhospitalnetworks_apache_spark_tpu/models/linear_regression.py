@@ -29,33 +29,41 @@ from ..parallel.sharding import DeviceDataset
 from .base import Estimator, Model, as_device_dataset
 
 
+def standardized_design(x, w, reg_param, fit_intercept: bool, standardize: bool):
+    """Shared GLM preamble (LinearRegression + LogisticRegression): the
+    intercept-augmented design matrix and the Spark-semantics ridge vector
+    (L2 on *standardized* coefficients, intercept unpenalized).
+
+    → (xa, ridge, nfeat, n) — traceable inside a jitted fit."""
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    wcol = w[:, None]
+    mean = jnp.sum(x * wcol, axis=0) / n
+    var = jnp.sum(x * x * wcol, axis=0) / n - mean * mean
+    std = jnp.sqrt(jnp.maximum(var, 1e-12))
+    scale = std if standardize else jnp.ones_like(std)
+    if fit_intercept:
+        xa = jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+    else:
+        xa = x
+    nfeat = x.shape[1]
+    ridge = jnp.zeros((xa.shape[1],), x.dtype).at[:nfeat].set(
+        reg_param * n * scale * scale
+    )
+    return xa, ridge, nfeat, n
+
+
 @partial(jax.jit, static_argnames=("fit_intercept", "standardize"))
 def _wls_fit(x, y, w, reg_param, fit_intercept: bool, standardize: bool):
     x = x.astype(jnp.float32)
     y = y.astype(jnp.float32)
     w = w.astype(jnp.float32)
-    n = jnp.maximum(jnp.sum(w), 1.0)
-    wcol = w[:, None]
-
-    # Per-feature scale for Spark-style standardized regularization.
-    mean = jnp.sum(x * wcol, axis=0) / n
-    var = jnp.sum(x * x * wcol, axis=0) / n - mean * mean
-    std = jnp.sqrt(jnp.maximum(var, 1e-12))
-    scale = std if standardize else jnp.ones_like(std)
-
-    if fit_intercept:
-        xa = jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
-    else:
-        xa = x
+    xa, ridge, nfeat, _ = standardized_design(x, w, reg_param, fit_intercept, standardize)
     d = xa.shape[1]
+    wcol = w[:, None]
     # Gram + moments: the treeAggregate replacement — one matmul each,
     # cross-shard reduction is an XLA psum.
-    gram = (xa * wcol).T @ xa
+    gram = (xa * wcol).T @ xa + jnp.diag(ridge)
     mom = (xa * wcol).T @ y
-    ridge = jnp.zeros((d,), x.dtype)
-    nfeat = x.shape[1]
-    ridge = ridge.at[:nfeat].set(reg_param * n * scale * scale)
-    gram = gram + jnp.diag(ridge)
     theta = jnp.linalg.solve(
         gram + 1e-8 * jnp.eye(d, dtype=x.dtype), mom
     )

@@ -1,0 +1,136 @@
+"""LogisticRegression — sharded Newton/IRLS binary classifier.
+
+The reference's dead incremental-training hook names LogisticRegression as
+its intended per-batch model (``mllearnforhospitalnetwork.py:93`` comment;
+SURVEY.md C6/D2) but only ever defines a LinearRegression — this module
+supplies the intended capability, with ``pyspark.ml.classification
+.LogisticRegression`` semantics (binary, L2 ``reg_param``, standardized
+regularization, intercept unpenalized).
+
+MLlib trains this with L-BFGS over ``treeAggregate``'d gradients.  At the
+reference's feature width (d=4) the TPU-native shape is better served by
+full Newton/IRLS: each iteration is one jit'd pass over the row-sharded
+dataset building the (d+1) gradient and (d+1)² Hessian — two MXU matmuls
+whose cross-shard reduction lowers to ``psum`` — followed by a tiny
+on-device solve.  Convergence is quadratic, typically <10 iterations,
+i.e. fewer passes over HBM than L-BFGS would take.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..io.model_io import register_model
+from ..parallel.sharding import DeviceDataset
+from .base import Estimator, Model, as_device_dataset
+from .linear_regression import standardized_design
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter"))
+def _irls_fit(x, y, w, reg_param, tol, fit_intercept: bool, standardize: bool, max_iter: int):
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    xa, ridge, nfeat, _ = standardized_design(x, w, reg_param, fit_intercept, standardize)
+    d = xa.shape[1]
+
+    def newton_step(theta):
+        z = xa @ theta
+        p = jax.nn.sigmoid(z)
+        grad = xa.T @ (w * (p - y)) + ridge * theta
+        # IRLS weights, floored so the Hessian stays meaningful when the
+        # classes separate perfectly and p saturates to 0/1.
+        r = jnp.maximum(w * p * (1.0 - p), 1e-10 * w)
+        hess = (xa * r[:, None]).T @ xa + jnp.diag(ridge)
+        # Trace-scaled jitter: keeps the f32 solve finite under exact
+        # feature collinearity (relative bias ~1e-6, invisible otherwise).
+        jitter = 1e-6 * jnp.trace(hess) / d + 1e-8
+        delta = jnp.linalg.solve(hess + jitter * jnp.eye(d, dtype=x.dtype), grad)
+        # Damped Newton: cap the step so separable data walks the margin
+        # out gradually instead of overshooting into saturation.
+        dmax = jnp.max(jnp.abs(delta))
+        delta = delta * jnp.minimum(1.0, 20.0 / (dmax + 1e-30))
+        return theta - delta, jnp.max(jnp.abs(delta))
+
+    def cond(carry):
+        _, it, dmax = carry
+        return (it < max_iter) & (dmax > tol)
+
+    def body(carry):
+        theta, it, _ = carry
+        theta, dmax = newton_step(theta)
+        return theta, it + 1, dmax
+
+    theta0 = jnp.zeros((d,), x.dtype)
+    theta, n_iter, _ = lax.while_loop(cond, body, (theta0, 0, jnp.float32(jnp.inf)))
+    coef = theta[:nfeat]
+    intercept = theta[nfeat] if fit_intercept else jnp.zeros((), x.dtype)
+    return coef, intercept, n_iter
+
+
+@register_model("LogisticRegressionModel")
+@dataclass
+class LogisticRegressionModel(Model):
+    coefficients: jax.Array
+    intercept: jax.Array
+    threshold: float = 0.5
+    n_iter: int = 0
+
+    def predict_raw(self, x: jax.Array) -> jax.Array:
+        """Log-odds (Spark's rawPrediction margin)."""
+        return x.astype(jnp.float32) @ self.coefficients + self.intercept
+
+    def predict_proba(self, x: jax.Array) -> jax.Array:
+        """P(class = 1)."""
+        return jax.nn.sigmoid(self.predict_raw(x))
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        return (self.predict_proba(x) > self.threshold).astype(jnp.float32)
+
+    def _artifacts(self):
+        return (
+            "LogisticRegressionModel",
+            {"threshold": self.threshold, "n_iter": self.n_iter},
+            {
+                "coefficients": np.asarray(self.coefficients),
+                "intercept": np.asarray(self.intercept),
+            },
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            coefficients=jnp.asarray(arrays["coefficients"]),
+            intercept=jnp.asarray(arrays["intercept"]),
+            threshold=float(params.get("threshold", 0.5)),
+            n_iter=int(params.get("n_iter", 0)),
+        )
+
+
+@dataclass(frozen=True)
+class LogisticRegression(Estimator):
+    features_col: str = "features"
+    label_col: str = "LOS_binary"
+    reg_param: float = 0.0
+    max_iter: int = 100        # Spark default
+    tol: float = 1e-6          # Spark default
+    threshold: float = 0.5     # Spark default
+    fit_intercept: bool = True
+    standardize: bool = True
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> LogisticRegressionModel:
+        ds: DeviceDataset = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        coef, intercept, n_iter = _irls_fit(
+            ds.x, ds.y, ds.w, jnp.float32(self.reg_param), jnp.float32(self.tol),
+            self.fit_intercept, self.standardize, self.max_iter,
+        )
+        return LogisticRegressionModel(
+            coefficients=coef, intercept=intercept,
+            threshold=self.threshold, n_iter=int(n_iter),
+        )

@@ -116,6 +116,18 @@ def test_gmm_preempt_resume_exact(tmp_path, rng, mesh8):
     )
 
 
+def test_different_data_same_shape_refuses_resume(tmp_path, rng, mesh8):
+    """The signature's data fingerprint catches 'same shape, different
+    rows' — resuming Monday's trajectory on Tuesday's batch must raise."""
+    x1 = _blobs(rng)
+    x2 = _blobs(rng)  # fresh draw, identical shape
+    ckdir = str(tmp_path / "km3")
+    est = KMeans(k=4, seed=0, max_iter=5, checkpoint_dir=ckdir, checkpoint_every=1)
+    est.fit(x1, mesh=mesh8)
+    with pytest.raises(ValueError, match="signature mismatch"):
+        est.fit(x2, mesh=mesh8)
+
+
 def test_kmeans_checkpoint_noop_when_converged(tmp_path, rng, mesh8):
     """Resuming a checkpoint of an already-converged fit returns the same
     model without re-running the trajectory."""
