@@ -60,7 +60,9 @@ class StandardScaler:
     with_std: bool = True
 
     def fit(self, data) -> StandardScalerModel:
-        """``data``: DeviceDataset (sharded) or host ndarray."""
+        """``data``: DeviceDataset (sharded), AssembledTable, or ndarray."""
+        if hasattr(data, "to_device"):  # AssembledTable
+            data = data.to_device()
         if isinstance(data, DeviceDataset):
             mean, std, _ = _moments(data.x, data.w)
             mean, std = np.asarray(mean), np.asarray(std)
@@ -69,3 +71,15 @@ class StandardScaler:
             mean = x.mean(axis=0)
             std = x.std(axis=0)
         return StandardScalerModel(mean, std, self.with_mean, self.with_std)
+
+    def fit_transform(self, data):
+        """Fit then transform in one call.  A DeviceDataset (or
+        AssembledTable) comes back as a DeviceDataset with the feature
+        matrix scaled and labels/weights carried through; an ndarray comes
+        back as an ndarray."""
+        if hasattr(data, "to_device"):
+            data = data.to_device()
+        model = self.fit(data)
+        if isinstance(data, DeviceDataset):
+            return DeviceDataset(model.transform(data.x), data.y, data.w)
+        return model.transform(np.asarray(data, dtype=np.float64))
