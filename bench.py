@@ -65,7 +65,8 @@ def _cpu_lloyd_throughput(x: np.ndarray, k: int, iters: int = 2) -> float:
     return n * iters / dt
 
 
-def main() -> None:
+def _bench_kmeans_lloyd(k: int, default_rows: int) -> dict:
+    """Config 1/2: Lloyd-iteration throughput at the given k."""
     import jax
 
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
@@ -84,9 +85,8 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
-    k = 256
     d = 8
-    n = int(os.environ.get("BENCH_ROWS", 10_000_000 if on_tpu else 400_000))
+    n = int(os.environ.get("BENCH_ROWS", default_rows if on_tpu else 400_000))
     timed_iters = int(os.environ.get("BENCH_ITERS", 10 if on_tpu else 3))
 
     mesh = build_mesh()
@@ -114,28 +114,198 @@ def main() -> None:
     centers, _, _, _ = step(ds.x, ds.w, centers, c_valid_dev)
     jax.block_until_ready(centers)
 
-    t0 = time.perf_counter()
-    for _ in range(timed_iters):
-        centers, counts, cost, move = step(ds.x, ds.w, centers, c_valid_dev)
-    jax.block_until_ready(centers)
-    dt = time.perf_counter() - t0
-    tpu_records_per_sec = n * timed_iters / dt
-    per_chip = tpu_records_per_sec / n_chips
+    # Median of 3 timing windows — the chip is shared, single windows drift.
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(timed_iters):
+            centers, counts, cost, move = step(ds.x, ds.w, centers, c_valid_dev)
+        jax.block_until_ready(centers)
+        rates.append(n * timed_iters / (time.perf_counter() - t0))
+    per_chip = float(np.median(rates)) / n_chips
 
     # CPU (Spark-CPU proxy) denominator on a bounded sample, same shape.
+    # Best-of-2 (fastest CPU run) keeps the reported ratio conservative.
     cpu_n = min(n, 400_000)
-    cpu_thr = _cpu_lloyd_throughput(x[:cpu_n], k)
+    cpu_thr = max(_cpu_lloyd_throughput(x[:cpu_n], k) for _ in range(2))
 
-    print(
-        json.dumps(
-            {
-                "metric": f"KMeans k={k} Lloyd records/sec/chip ({n} rows, d={d}, {platform})",
-                "value": round(per_chip, 1),
-                "unit": "records/sec/chip",
-                "vs_baseline": round(per_chip / cpu_thr, 2),
-            }
-        )
+    return {
+        "metric": f"KMeans k={k} Lloyd records/sec/chip ({n} rows, d={d}, {platform})",
+        "value": round(per_chip, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(per_chip / cpu_thr, 2),
+    }
+
+
+def _cpu_gmm_throughput(x: np.ndarray, k: int, iters: int = 2) -> float:
+    """NumPy EM iteration (diag-free full covariance E+M) — CPU proxy."""
+    n, d = x.shape
+    rng = np.random.default_rng(0)
+    means = x[rng.choice(n, size=k, replace=False)].astype(np.float64)
+    covs = np.stack([np.eye(d)] * k)
+    logw = np.full(k, -np.log(k))
+    xd = x.astype(np.float64)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        logp = np.empty((n, k))
+        for j in range(k):
+            L = np.linalg.cholesky(covs[j])
+            diff = xd - means[j]
+            sol = np.linalg.solve(L, diff.T)
+            logp[:, j] = (
+                logw[j]
+                - 0.5 * (sol * sol).sum(axis=0)
+                - np.log(np.diag(L)).sum()
+                - 0.5 * d * np.log(2 * np.pi)
+            )
+        m = logp.max(axis=1, keepdims=True)
+        resp = np.exp(logp - m)
+        resp /= resp.sum(axis=1, keepdims=True)
+        nk = resp.sum(axis=0) + 1e-9
+        means = (resp.T @ xd) / nk[:, None]
+        for j in range(k):
+            diff = xd - means[j]
+            covs[j] = (resp[:, j][:, None] * diff).T @ diff / nk[j] + 1e-6 * np.eye(d)
+        logw = np.log(nk / nk.sum())
+    return n * iters / (time.perf_counter() - t0)
+
+
+def _bench_gmm(k: int = 32) -> dict:
+    """Config 3: GaussianMixture EM-iteration throughput."""
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.gmm import (
+        GaussianMixture,
     )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        build_mesh,
+    )
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    d = 8
+    n = int(os.environ.get("BENCH_ROWS", 2_000_000 if on_tpu else 100_000))
+    iters = int(os.environ.get("BENCH_ITERS", 10 if on_tpu else 3))
+    mesh = build_mesh()
+    n_chips = len(jax.devices())
+    x = _make_data(n, d, k)
+
+    est = GaussianMixture(k=k, max_iter=iters, tol=0.0, seed=0)
+    # warm-up at the SAME shape — a different row count compiles a
+    # different executable, which would land in the timed region
+    GaussianMixture(k=k, max_iter=1, tol=0.0, seed=0).fit(x, mesh=mesh)
+    t0 = time.perf_counter()
+    model = est.fit(x, mesh=mesh)
+    dt = time.perf_counter() - t0
+    per_chip = n * model.n_iter / dt / n_chips
+
+    cpu_n = min(n, 100_000)
+    cpu_thr = _cpu_gmm_throughput(x[:cpu_n], k)
+    return {
+        "metric": f"GaussianMixture k={k} EM records/sec/chip ({n} rows, d={d}, {platform})",
+        "value": round(per_chip, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(per_chip / cpu_thr, 2),
+    }
+
+
+def _bench_bisecting(k: int = 8) -> dict:
+    """Config 4: BisectingKMeans fit throughput (per-hospital federation
+    shape — hierarchical splits over the shared mesh)."""
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.bisecting_kmeans import (
+        BisectingKMeans,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        build_mesh,
+    )
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    d = 8
+    n = int(os.environ.get("BENCH_ROWS", 2_000_000 if on_tpu else 100_000))
+    mesh = build_mesh()
+    n_chips = len(jax.devices())
+    x = _make_data(n, d, k)
+
+    est = BisectingKMeans(k=k, seed=0)
+    BisectingKMeans(k=2, seed=0).fit(x, mesh=mesh)  # same-shape warm-up
+    t0 = time.perf_counter()
+    est.fit(x, mesh=mesh)
+    dt = time.perf_counter() - t0
+    per_chip = n / dt / n_chips
+
+    # Charge the CPU proxy the same shape of work the TPU fit runs: (k-1)
+    # bisections × max_iter k=2 Lloyd iterations over the full data.
+    inner = est.max_iter * (k - 1)
+    cpu_n = min(n, 200_000)
+    cpu_thr = _cpu_lloyd_throughput(x[:cpu_n], 2, iters=inner) / inner
+    return {
+        "metric": f"BisectingKMeans k={k} fit records/sec/chip ({n} rows, d={d}, {platform})",
+        "value": round(per_chip, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(per_chip / cpu_thr, 2),
+    }
+
+
+def _bench_streaming(k: int = 16) -> dict:
+    """Config 5: StreamingKMeans micro-batch update throughput."""
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.streaming_kmeans import (
+        StreamingKMeans,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        build_mesh,
+    )
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    d = 8
+    batch = int(os.environ.get("BENCH_ROWS", 1_000_000 if on_tpu else 50_000)) // 10
+    mesh = build_mesh()
+    n_chips = len(jax.devices())
+    x = _make_data(batch * 12, d, k)
+    batches = [x[i * batch : (i + 1) * batch] for i in range(12)]
+
+    sk = StreamingKMeans(k=k, half_life=5.0, seed=0)
+    sk.update(batches[0], mesh=mesh)
+    sk.update(batches[1], mesh=mesh)  # warm-up both code paths
+    t0 = time.perf_counter()
+    for b in batches[2:]:
+        sk.update(b, mesh=mesh)
+    dt = time.perf_counter() - t0
+    per_chip = batch * 10 / dt / n_chips
+
+    cpu_thr = _cpu_lloyd_throughput(x[: min(len(x), 400_000)], k, iters=1)
+    return {
+        "metric": f"StreamingKMeans k={k} update records/sec/chip (10× {batch}-row batches, {platform})",
+        "value": round(per_chip, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(per_chip / cpu_thr, 2),
+    }
+
+
+CONFIGS = {
+    # BASELINE.json configs; the driver runs the default (north star).
+    "kmeans256": lambda: _bench_kmeans_lloyd(256, 10_000_000),  # config 2
+    "kmeans8": lambda: _bench_kmeans_lloyd(8, 10_000_000),      # config 1
+    "gmm32": lambda: _bench_gmm(32),                            # config 3
+    "bisecting": lambda: _bench_bisecting(8),                   # config 4
+    "streaming": lambda: _bench_streaming(16),                  # config 5
+}
+
+
+def main() -> None:
+    name = os.environ.get("BENCH_CONFIG", "kmeans256")
+    if name == "all":
+        for key in CONFIGS:
+            print(json.dumps(CONFIGS[key]()))
+        return
+    if name not in CONFIGS:
+        raise SystemExit(f"unknown BENCH_CONFIG {name!r}; one of {sorted(CONFIGS)} or 'all'")
+    print(json.dumps(CONFIGS[name]()))
 
 
 if __name__ == "__main__":

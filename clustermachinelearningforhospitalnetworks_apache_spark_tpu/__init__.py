@@ -34,6 +34,7 @@ from .features import (
 )
 from .evaluation import (
     ClusteringEvaluator,
+    BinaryClassificationEvaluator,
     MulticlassClassificationEvaluator,
     RegressionEvaluator,
 )
@@ -71,6 +72,7 @@ __all__ = [
     "StringIndexer",
     "VectorAssembler",
     "ClusteringEvaluator",
+    "BinaryClassificationEvaluator",
     "MulticlassClassificationEvaluator",
     "RegressionEvaluator",
     "build_mesh",

@@ -1,8 +1,10 @@
+from .binary import BinaryClassificationEvaluator
 from .regression import RegressionEvaluator
 from .classification import MulticlassClassificationEvaluator
 from .clustering import ClusteringEvaluator, inertia
 
 __all__ = [
+    "BinaryClassificationEvaluator",
     "RegressionEvaluator",
     "MulticlassClassificationEvaluator",
     "ClusteringEvaluator",

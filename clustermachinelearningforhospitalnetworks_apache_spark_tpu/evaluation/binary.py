@@ -1,0 +1,103 @@
+"""BinaryClassificationEvaluator — areaUnderROC / areaUnderPR.
+
+Parity with ``pyspark.ml.evaluation.BinaryClassificationEvaluator`` (not
+exercised by the reference script, but the natural companion to the
+LogisticRegression it intended at ``mllearnforhospitalnetwork.py:93`` —
+SURVEY.md C6/D2).  Spark computes both areas on the JVM by sorting
+score/label pairs per partition and combining; here each metric is one
+jit'd device computation: sort, grouped cumulative weights, closed-form
+area.
+
+- **ROC AUC** uses the exact probabilistic form
+  ``P(s⁺ > s⁻) + ½·P(s⁺ = s⁻)`` over weighted pairs, evaluated with
+  ``searchsorted`` against cumulative negative weight — exact under ties,
+  no curve discretization.
+- **PR AUC** is the trapezoidal area of the precision-recall curve over
+  distinct thresholds (Spark's ``areaUnderPR``), with within-tie points
+  collapsed to their threshold-block edge so tied scores contribute a
+  single curve point.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _roc_auc(scores, labels, weights):
+    s = scores.astype(jnp.float32)
+    y = labels.astype(jnp.float32)
+    w = weights.astype(jnp.float32)
+    order = jnp.argsort(s)
+    ss, ys, ws = s[order], y[order], w[order]
+    cw_neg = jnp.cumsum(ws * (1.0 - ys))               # inclusive, ascending
+    total_neg = cw_neg[-1]
+    # strictly-below / equal negative mass per element, tie-exact
+    left = jnp.searchsorted(ss, ss, side="left")
+    right = jnp.searchsorted(ss, ss, side="right")
+    below = jnp.where(left > 0, cw_neg[jnp.maximum(left - 1, 0)], 0.0)
+    upto = cw_neg[right - 1]
+    equal = upto - below
+    pos_mass = ws * ys
+    total_pos = jnp.sum(pos_mass)
+    num = jnp.sum(pos_mass * (below + 0.5 * equal))
+    return num / jnp.maximum(total_pos * total_neg, 1e-30)
+
+
+@jax.jit
+def _pr_auc(scores, labels, weights):
+    s = scores.astype(jnp.float32)
+    y = labels.astype(jnp.float32)
+    w = weights.astype(jnp.float32)
+    order = jnp.argsort(-s)                             # descending
+    ss, ys, ws = s[order], y[order], w[order]
+    tp = jnp.cumsum(ws * ys)
+    fp = jnp.cumsum(ws * (1.0 - ys))
+    # collapse tie blocks: every point takes its block-end cumulative
+    edge = jnp.searchsorted(-ss, -ss, side="right") - 1
+    tp_e, fp_e = tp[edge], fp[edge]
+    total_pos = tp[-1]
+    recall = tp_e / jnp.maximum(total_pos, 1e-30)
+    precision = tp_e / jnp.maximum(tp_e + fp_e, 1e-30)
+    # anchor at (recall=0, precision of the highest-score block) — Spark's
+    # first curve point
+    r = jnp.concatenate([jnp.zeros((1,)), recall])
+    p = jnp.concatenate([precision[:1], precision])
+    return jnp.sum((r[1:] - r[:-1]) * 0.5 * (p[1:] + p[:-1]))
+
+
+@dataclass(frozen=True)
+class BinaryClassificationEvaluator:
+    """``metric_name``: areaUnderROC (default, Spark parity) or areaUnderPR.
+
+    ``evaluate`` accepts either a ``PredictionResult`` whose ``prediction``
+    column holds *scores* — produced by
+    ``LogisticRegressionModel.transform_proba`` (NOT plain ``transform``,
+    whose predictions are hard 0/1 labels and would degenerate AUC to an
+    accuracy-shaped number) — or explicit ``(scores, labels[, weights])``
+    arrays (probabilities or margins; AUC is rank-based).
+    """
+
+    metric_name: str = "areaUnderROC"
+
+    def evaluate(self, predictions, labels=None, weights=None) -> float:
+        if labels is None:
+            scores = predictions.prediction
+            labels_ = predictions.label
+            weights_ = predictions.weight
+        else:
+            scores = jnp.asarray(predictions)
+            labels_ = jnp.asarray(labels)
+            weights_ = (
+                jnp.asarray(weights)
+                if weights is not None
+                else jnp.ones_like(labels_, dtype=jnp.float32)
+            )
+        if self.metric_name == "areaUnderROC":
+            return float(_roc_auc(scores, labels_, weights_))
+        if self.metric_name == "areaUnderPR":
+            return float(_pr_auc(scores, labels_, weights_))
+        raise ValueError(f"unknown metric {self.metric_name!r}")

@@ -38,6 +38,24 @@ def _masked_assign_cost(x, w, centers):
     return assign, jnp.sum(mind2 * w)
 
 
+@jax.jit
+def _split_stats(x, mask, c2):
+    """One fused device call per completed bisection: child assignment plus
+    both children's SSE and sizes (replaces three separate full-data
+    passes — each call costs a host→device dispatch round trip, which
+    dominates wall-clock on remote-attached chips)."""
+    assign, mind2 = assign_clusters(x, c2)
+    m0 = mask * (assign == 0)
+    m1 = mask * (assign == 1)
+    return (
+        assign,
+        jnp.sum(mind2 * m0),
+        jnp.sum(mind2 * m1),
+        jnp.sum(m0),
+        jnp.sum(m1),
+    )
+
+
 @register_model("BisectingKMeansModel")
 @dataclass
 class BisectingKMeansModel(KMeansModel):
@@ -85,6 +103,29 @@ class BisectingKMeans(Estimator):
         sizes = {0: n_total}
         rng = np.random.default_rng(self.seed)
 
+        # One cached Lloyd step serves every bisection (k=2 padded to the
+        # model axis); driving it directly skips KMeans.fit's host-side
+        # init sampling — the per-split host↔device round trips that
+        # dominated wall-clock on remote-attached chips.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+        from .kmeans import _make_train_loop
+
+        m_axis = mesh.shape[MODEL_AXIS]
+        k_pad = -(-2 // m_axis) * m_axis
+        n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+        cosine = self.distance_measure == "cosine"
+        # Whole inner 2-means as one device computation (single host sync
+        # per bisection instead of one per Lloyd iteration).
+        loop = _make_train_loop(
+            mesh, n_loc, k_pad, x.shape[1], KMeans().chunk_rows, cosine,
+            self.max_iter, 1e-8,
+        )
+        c_valid = np.zeros((k_pad,), np.float32)
+        c_valid[:2] = 1.0
+        c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+
         while len(centers) < self.k:
             # pick the divisible leaf with the largest SSE
             candidates = [c for c in sse if sizes[c] >= max(min_size, 2)]
@@ -93,30 +134,34 @@ class BisectingKMeans(Estimator):
             target = max(candidates, key=lambda c: (sse[c], sizes[c]))
             mask = (assign == target).astype(x.dtype) * ds.w
 
-            # inner 2-means on the masked subset (x is already normalized in
-            # cosine mode; the inner fit re-normalizes idempotently and keeps
-            # its centroids on the sphere)
-            sub = KMeans(
-                k=2,
-                max_iter=self.max_iter,
-                seed=int(rng.integers(2**31 - 1)),
-                distance_measure=self.distance_measure,
-            )
-            sub_model = sub.fit(DeviceDataset(x=x, y=ds.y, w=mask), mesh=mesh)
-            c2 = jnp.asarray(sub_model.cluster_centers, jnp.float32)
-            sub_assign, _ = _masked_assign_cost(x, mask, c2)
+            # inner 2-means, initialized Spark-style from the parent center
+            # ± an RMS-radius perturbation (no data sampling needed)
+            parent = centers[target].astype(np.float64)
+            radius = np.sqrt(max(sse[target], 1e-12) / max(sizes[target], 1.0))
+            direction = rng.normal(size=parent.shape)
+            direction *= radius / max(np.linalg.norm(direction), 1e-12)
+            cen0 = np.zeros((k_pad, x.shape[1]), np.float32)
+            cen0[0] = parent + 0.5 * direction
+            cen0[1] = parent - 0.5 * direction
+            if cosine:
+                norms = np.linalg.norm(cen0[:2], axis=1, keepdims=True)
+                cen0[:2] = cen0[:2] / np.maximum(norms, 1e-12)
+            c2 = jax.device_put(cen0, NamedSharding(mesh, P(MODEL_AXIS, None)))
+            c2, _, _, _ = loop(x, mask, c2, c_valid_dev)
 
+            sub_assign, sse0, sse1, n0, n1 = _split_stats(x, mask, c2[:2])
             new_id = len(centers)
             in_target = assign == target
             assign = jnp.where(in_target & (sub_assign == 1), new_id, assign)
-            centers[target] = sub_model.cluster_centers[0]
-            centers.append(sub_model.cluster_centers[1])
-
-            for cid, cen in ((target, centers[target]), (new_id, centers[new_id])):
-                m = (assign == cid).astype(x.dtype) * ds.w
-                _, cost = _masked_assign_cost(x, m, jnp.asarray(cen)[None])
-                sse[cid] = float(jax.device_get(cost))
-                sizes[cid] = float(jax.device_get(jnp.sum(m)))
+            # ONE host sync per bisection: everything the split decision
+            # needs comes back in a single batched transfer.
+            c2_host, s0, s1, z0, z1 = jax.device_get((c2, sse0, sse1, n0, n1))
+            centers[target] = np.asarray(c2_host)[0]
+            centers.append(np.asarray(c2_host)[1])
+            sse[target] = float(s0)
+            sse[new_id] = float(s1)
+            sizes[target] = float(z0)
+            sizes[new_id] = float(z1)
 
         all_centers = np.stack(centers).astype(np.float32)
         total_cost = sum(sse.values())

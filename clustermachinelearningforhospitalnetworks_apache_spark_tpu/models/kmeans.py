@@ -179,6 +179,46 @@ def _make_train_step_fused(mesh: Mesh, k_pad: int, cosine: bool):
     )
 
 
+@lru_cache(maxsize=64)
+def _make_train_loop(
+    mesh: Mesh,
+    n_loc: int,
+    k_pad: int,
+    d: int,
+    chunk_rows: int,
+    cosine: bool,
+    max_iter: int,
+    tol_sq: float,
+):
+    """The whole Lloyd loop as ONE device computation: ``lax.while_loop``
+    around the shard-mapped step, plus a final stats pass on the converged
+    centers.  A Python-side loop syncs the host on ``move`` every
+    iteration — one blocking round trip per step, which dominates
+    wall-clock on remote-attached chips; this version syncs once per fit.
+    Used whenever no per-iteration host hook (checkpoint/on_iteration) is
+    installed."""
+    step = _make_train_step(mesh, n_loc, k_pad, d, chunk_rows, cosine)
+
+    def loop(x, w, centers, c_valid):
+        def cond(carry):
+            it, _, move = carry
+            return (it < max_iter) & (move > tol_sq)
+
+        def body(carry):
+            it, cen, _ = carry
+            new_cen, _, _, move = step(x, w, cen, c_valid)
+            return it + 1, new_cen, move
+
+        it, cen, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), centers, jnp.float32(jnp.inf))
+        )
+        # final assignment pass: cost/sizes describe the RETURNED centers
+        _, counts, cost, _ = step(x, w, cen, c_valid)
+        return cen, counts, cost, it
+
+    return jax.jit(loop)
+
+
 def _kmeans_pp_init(sample: np.ndarray, k: int, seed: int) -> np.ndarray:
     """Greedy k-means++ on a host-side sample: at each step draw
     ``2 + ⌊log k⌋`` D²-weighted candidates and keep the one minimizing the
@@ -322,7 +362,8 @@ class KMeans(Estimator):
     seed: int = 0
     init_mode: str = "k-means++"  # or "random"
     distance_measure: str = "euclidean"  # or "cosine"
-    chunk_rows: int = 16384
+    # 32768 measured fastest on v5e across a 8k-256k sweep (k=256, d=8)
+    chunk_rows: int = 32768
     init_sample_size: int = 65536
     # Pallas fused Lloyd kernel (ops/pallas_kernels.py), opt-in; requires
     # model axis 1.  None/False = the XLA scan path, which measures faster
@@ -425,19 +466,29 @@ class KMeans(Estimator):
         else:
             step = _make_train_step(mesh, n_loc, k_pad, d, self.chunk_rows, cosine)
 
-        it = start_it - 1
-        for it in range(start_it, self.max_iter + 1):
-            centers, _, cost_it, move = step(x, ds.w, centers, c_valid_dev)
-            if ckpt is not None and it % max(self.checkpoint_every, 1) == 0:
-                ckpt.save(it, {"centers": np.asarray(jax.device_get(centers))})
-            if on_iteration is not None:
-                on_iteration(it, float(cost_it), float(move))
-            if float(move) <= self.tol * self.tol:
-                break
-        # One extra assignment pass so cost/sizes describe the RETURNED
-        # centers, not the pre-update ones (Spark's summary.trainingCost is
-        # the final model's cost).
-        _, counts, cost_dev, _ = step(x, ds.w, centers, c_valid_dev)
+        if ckpt is None and on_iteration is None and not fused:
+            # Fast path: the whole Lloyd loop is one device computation
+            # (single host sync per fit instead of one per iteration).
+            loop = _make_train_loop(
+                mesh, n_loc, k_pad, d, self.chunk_rows, cosine,
+                self.max_iter - (start_it - 1), float(self.tol * self.tol),
+            )
+            centers, counts, cost_dev, it_dev = loop(x, ds.w, centers, c_valid_dev)
+            it = (start_it - 1) + int(it_dev)
+        else:
+            it = start_it - 1
+            for it in range(start_it, self.max_iter + 1):
+                centers, _, cost_it, move = step(x, ds.w, centers, c_valid_dev)
+                if ckpt is not None and it % max(self.checkpoint_every, 1) == 0:
+                    ckpt.save(it, {"centers": np.asarray(jax.device_get(centers))})
+                if on_iteration is not None:
+                    on_iteration(it, float(cost_it), float(move))
+                if float(move) <= self.tol * self.tol:
+                    break
+            # One extra assignment pass so cost/sizes describe the RETURNED
+            # centers, not the pre-update ones (Spark's summary.trainingCost
+            # is the final model's cost).
+            _, counts, cost_dev, _ = step(x, ds.w, centers, c_valid_dev)
         final = np.asarray(jax.device_get(centers))[: self.k]
         sizes = np.asarray(jax.device_get(counts))[: self.k]
         return KMeansModel(

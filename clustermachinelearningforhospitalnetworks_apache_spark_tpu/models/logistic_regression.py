@@ -93,6 +93,18 @@ class LogisticRegressionModel(Model):
     def predict(self, x: jax.Array) -> jax.Array:
         return (self.predict_proba(x) > self.threshold).astype(jnp.float32)
 
+    def transform_proba(self, data, label_col: str | None = None, mesh=None):
+        """Like ``transform`` but the prediction column holds P(class=1)
+        instead of hard labels — the score input
+        BinaryClassificationEvaluator (AUC) needs, mirroring Spark's
+        ``probability``/``rawPrediction`` columns."""
+        from .base import PredictionResult, as_device_dataset
+
+        ds = as_device_dataset(data, label_col=label_col, mesh=mesh)
+        return PredictionResult(
+            prediction=self.predict_proba(ds.x), label=ds.y, weight=ds.w
+        )
+
     def _artifacts(self):
         return (
             "LogisticRegressionModel",

@@ -21,6 +21,7 @@ SURVEY.md C6/D2).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,70 @@ def _batch_stats(x, w, centers):
     counts = jnp.sum(onehot, axis=0)
     cost = jnp.sum(mind2 * w)
     return sums, counts, cost
+
+
+@lru_cache(maxsize=32)
+def _make_update_step(k: int, alpha_mode: str, alpha_param: float):
+    """One jitted device step per micro-batch: assignment stats, decayed
+    merge, and the dying-cluster reseed — no host synchronization.  The
+    stream state (centers, weights) stays on device between batches; only
+    ``latest_model`` pulls it to host.
+
+    Weights are carried as a Kahan (value, compensation) pair: JAX on TPU
+    has no f64, and with decay 1.0 a single f32 accumulator stops growing
+    once a cluster passes 2²⁴ points — the compensated sum keeps absorbing
+    per-batch counts exactly."""
+
+    def step(x, w, centers, w_hi, w_lo, key):
+        sums, counts, _ = _batch_stats(x, w, centers)
+        m = jnp.sum(counts)
+        if alpha_mode == "points":
+            alpha = jnp.where(
+                m > 0, jnp.float32(0.5) ** (m / alpha_param), jnp.float32(1.0)
+            ) if alpha_param > 0 else jnp.float32(0.0)
+        elif alpha_mode == "batches":
+            alpha = jnp.float32(0.5 ** (1.0 / alpha_param)) if alpha_param > 0 else jnp.float32(0.0)
+        else:  # fixed decay factor
+            alpha = jnp.float32(alpha_param)
+
+        # decay both limbs, then Kahan-add this batch's counts
+        hi, lo = w_hi * alpha, w_lo * alpha
+        add = counts + lo
+        new_hi = hi + add
+        new_lo = (hi - new_hi) + add           # exact residual of the add
+        decayed = hi + lo
+        new_w = new_hi + new_lo
+        safe = jnp.maximum(new_w, 1e-12)
+        merged = (centers * decayed[:, None] + sums) / safe[:, None]
+        # A cluster with no mass this step and no retained history keeps
+        # its old center rather than collapsing to zero (Spark λ=0).
+        centers = jnp.where(new_w[:, None] > 1e-12, merged, centers)
+        # Dying-cluster reseed (Spark rule): walk clusters in index order,
+        # splitting the current-heaviest for each effectively-dead one.
+        # Touched entries collapse their Kahan pair (hi=split, lo=0).
+        def body(i, carry):
+            cen, hi, lo, key = carry
+            eff = hi + lo
+            total = jnp.sum(eff)
+            big = jnp.argmax(eff)
+            act = (eff[i] < 1e-8 * total) & (big != i) & (total > 0)
+            key, sub = jax.random.split(key)
+            jitter = 1e-4 * (jnp.abs(cen[big]) + 1e-4)
+            noise = jax.random.normal(sub, cen[big].shape, cen.dtype) * jitter
+            cen = cen.at[i].set(jnp.where(act, cen[big] + noise, cen[i]))
+            wb = eff[big]
+            hi = hi.at[i].set(jnp.where(act, wb / 2, hi[i]))
+            lo = lo.at[i].set(jnp.where(act, 0.0, lo[i]))
+            hi = hi.at[big].set(jnp.where(act, wb / 2, hi[big]))
+            lo = lo.at[big].set(jnp.where(act, 0.0, lo[big]))
+            return cen, hi, lo, key
+
+        centers, new_hi, new_lo, _ = jax.lax.fori_loop(
+            0, k, body, (centers, new_hi, new_lo, key)
+        )
+        return centers, new_hi, new_lo
+
+    return jax.jit(step)
 
 
 @register_model("StreamingKMeansModel")
@@ -81,15 +146,20 @@ class StreamingKMeans:
     seed: int = 0
     _centers: np.ndarray | None = field(default=None, repr=False)
     _weights: np.ndarray | None = field(default=None, repr=False)
+    _weights_lo: np.ndarray | None = field(default=None, repr=False)
     _steps: int = field(default=0, repr=False)
 
     def set_initial_centers(self, centers: np.ndarray, weights: np.ndarray | None = None):
-        self._centers = np.asarray(centers, dtype=np.float32)
+        # Stream state lives on device between batches (jnp arrays);
+        # latest_model pulls it to host on demand.  Weights are a Kahan
+        # (value, compensation) pair — see _make_update_step.
+        self._centers = jnp.asarray(np.asarray(centers), jnp.float32)
         self._weights = (
-            np.asarray(weights, dtype=np.float64)
+            jnp.asarray(np.asarray(weights), jnp.float32)
             if weights is not None
-            else np.zeros((self._centers.shape[0],), dtype=np.float64)
+            else jnp.zeros((self._centers.shape[0],), jnp.float32)
         )
+        self._weights_lo = jnp.zeros_like(self._weights)
         return self
 
     def set_random_centers(self, dim: int, weight: float = 0.0):
@@ -102,13 +172,20 @@ class StreamingKMeans:
     def latest_model(self) -> StreamingKMeansModel:
         if self._centers is None:
             raise ValueError("StreamingKMeans has no centers yet; call update or set_*")
+        cen, hi, lo = jax.device_get(
+            (self._centers, self._weights, self._weights_lo)
+        )
         return StreamingKMeansModel(
-            cluster_centers=self._centers.copy(),
+            cluster_centers=np.asarray(cen, dtype=np.float32),
             n_iter=self._steps,
-            cluster_weights=self._weights.copy(),
+            cluster_weights=np.asarray(hi, dtype=np.float64)
+            + np.asarray(lo, dtype=np.float64),
         )
 
-    def update(self, batch, mesh=None) -> StreamingKMeansModel:
+    def update(self, batch, mesh=None) -> "StreamingKMeans":
+        """Consume one micro-batch; returns ``self`` for chaining.  The
+        updated state stays on device — read ``latest_model`` to
+        materialize it (one host transfer)."""
         mesh = mesh or default_mesh()
         ds = as_device_dataset(batch, mesh=mesh)
         x = ds.x.astype(jnp.float32)
@@ -125,51 +202,21 @@ class StreamingKMeans:
             self.set_initial_centers(
                 _lloyd_refine(host, _kmeans_pp_init(host, self.k, self.seed), iters=10)
             )
-        sums, counts, _ = _batch_stats(x, ds.w, jnp.asarray(self._centers))
-        sums = np.asarray(jax.device_get(sums), dtype=np.float64)
-        counts = np.asarray(jax.device_get(counts), dtype=np.float64)
-
-        m = counts.sum()
         if self.half_life is not None:
-            if self.time_unit == "points":
-                alpha = 0.5 ** (m / self.half_life) if self.half_life > 0 else 0.0
-            else:
-                alpha = 0.5 ** (1.0 / self.half_life) if self.half_life > 0 else 0.0
+            if self.time_unit not in ("points", "batches"):
+                raise ValueError(
+                    f"time_unit must be 'points' or 'batches', got {self.time_unit!r}"
+                )
+            mode, param = self.time_unit, float(self.half_life)
         else:
-            alpha = self.decay_factor
-
-        decayed = self._weights * alpha
-        new_w = decayed + counts
-        safe = np.maximum(new_w, 1e-12)
-        merged = (self._centers * decayed[:, None] + sums) / safe[:, None]
-        # A cluster with no mass this step and no retained history keeps its
-        # old center rather than collapsing to zero (Spark's λ=0 behavior).
-        self._centers = np.where(
-            new_w[:, None] > 1e-12, merged, self._centers
-        ).astype(np.float32)
-        self._weights = new_w
+            mode, param = "decay", float(self.decay_factor)
+        step = _make_update_step(self.k, mode, param)
+        key = jax.random.fold_in(jax.random.key(self.seed), self._steps)
+        self._centers, self._weights, self._weights_lo = step(
+            x, ds.w, self._centers, self._weights, self._weights_lo, key
+        )
         self._steps += 1
-        self._reseed_dying()
-        return self.latest_model
-
-    def _reseed_dying(self, threshold_ratio: float = 1e-8):
-        """Split the heaviest cluster to replace any effectively-dead one
-        (Spark's dying-cluster rule)."""
-        total = self._weights.sum()
-        if total <= 0:
-            return
-        dead = np.where(self._weights < threshold_ratio * total)[0]
-        if len(dead) == 0:
-            return
-        rng = np.random.default_rng(self.seed + self._steps)
-        for idx in dead:
-            big = int(np.argmax(self._weights))
-            if big == idx:
-                continue
-            jitter = 1e-4 * (np.abs(self._centers[big]) + 1e-4)
-            self._centers[idx] = self._centers[big] + rng.normal(size=jitter.shape) * jitter
-            self._weights[idx] = self._weights[big] / 2
-            self._weights[big] = self._weights[big] / 2
+        return self
 
     def predict(self, x):
         return self.latest_model.predict(x)

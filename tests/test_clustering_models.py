@@ -95,6 +95,24 @@ def test_streaming_kmeans_converges_on_stream(rng, mesh8):
     assert model.n_iter == 8
 
 
+def test_streaming_kmeans_weights_survive_f32_saturation(mesh8):
+    """Kahan-compensated weights: with decay 1.0, per-batch counts keep
+    accumulating even after a cluster passes 2^24 points (where a plain
+    f32 accumulator would stop growing)."""
+    s = StreamingKMeans(k=1, decay_factor=1.0, seed=0)
+    s.set_initial_centers(np.zeros((1, 2)), np.array([2.0**24]))
+    for _ in range(4):
+        s.update(np.zeros((1000, 2)), mesh=mesh8)
+    w = float(s.latest_model.cluster_weights[0])
+    assert w == pytest.approx(2.0**24 + 4000, rel=1e-9)
+
+
+def test_streaming_kmeans_bad_time_unit_raises(mesh8):
+    s = StreamingKMeans(k=2, half_life=5.0, time_unit="batch")  # typo'd unit
+    with pytest.raises(ValueError, match="time_unit"):
+        s.update(np.zeros((10, 2)), mesh=mesh8)
+
+
 def test_streaming_kmeans_decay_forgets(rng, mesh8):
     d = 3
     old = rng.normal(size=(300, d)) + np.array([10.0, 0, 0])
