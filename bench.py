@@ -65,6 +65,21 @@ def _cpu_lloyd_throughput(x: np.ndarray, k: int, iters: int = 2) -> float:
     return n * iters / dt
 
 
+def _bench_setup(default_rows: int, default_iters: int = 10):
+    """Shared preamble for every config: platform, sizes from env, mesh."""
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        build_mesh,
+    )
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    n = int(os.environ.get("BENCH_ROWS", default_rows if on_tpu else 400_000))
+    iters = int(os.environ.get("BENCH_ITERS", default_iters if on_tpu else 3))
+    return platform, on_tpu, n, iters, build_mesh(), len(jax.devices())
+
+
 def _bench_kmeans_lloyd(k: int, default_rows: int) -> dict:
     """Config 1/2: Lloyd-iteration throughput at the given k."""
     import jax
@@ -76,21 +91,14 @@ def _bench_kmeans_lloyd(k: int, default_rows: int) -> dict:
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
         DATA_AXIS,
         MODEL_AXIS,
-        build_mesh,
     )
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
         device_dataset,
     )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
     d = 8
-    n = int(os.environ.get("BENCH_ROWS", default_rows if on_tpu else 400_000))
-    timed_iters = int(os.environ.get("BENCH_ITERS", 10 if on_tpu else 3))
-
-    mesh = build_mesh()
-    n_chips = len(jax.devices())
+    platform, on_tpu, n, timed_iters, mesh, n_chips = _bench_setup(default_rows)
 
     x = _make_data(n, d, k)
     ds = device_dataset(x, mesh=mesh)
@@ -181,19 +189,15 @@ def _bench_gmm(k: int = 32) -> dict:
         build_mesh,
     )
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
     d = 8
-    n = int(os.environ.get("BENCH_ROWS", 2_000_000 if on_tpu else 100_000))
-    iters = int(os.environ.get("BENCH_ITERS", 10 if on_tpu else 3))
-    mesh = build_mesh()
-    n_chips = len(jax.devices())
+    platform, on_tpu, n, iters, mesh, n_chips = _bench_setup(2_000_000)
     x = _make_data(n, d, k)
 
     est = GaussianMixture(k=k, max_iter=iters, tol=0.0, seed=0)
-    # warm-up at the SAME shape — a different row count compiles a
-    # different executable, which would land in the timed region
-    GaussianMixture(k=k, max_iter=1, tol=0.0, seed=0).fit(x, mesh=mesh)
+    # warm-up with the SAME estimator (max_iter is a static jit arg of the
+    # device EM loop — a different value compiles a different executable,
+    # which would land in the timed region); also warms the init path
+    est.fit(x, mesh=mesh)
     t0 = time.perf_counter()
     model = est.fit(x, mesh=mesh)
     dt = time.perf_counter() - t0
@@ -221,12 +225,8 @@ def _bench_bisecting(k: int = 8) -> dict:
         build_mesh,
     )
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
     d = 8
-    n = int(os.environ.get("BENCH_ROWS", 2_000_000 if on_tpu else 100_000))
-    mesh = build_mesh()
-    n_chips = len(jax.devices())
+    platform, on_tpu, n, _, mesh, n_chips = _bench_setup(2_000_000)
     x = _make_data(n, d, k)
 
     est = BisectingKMeans(k=k, seed=0)
@@ -260,12 +260,9 @@ def _bench_streaming(k: int = 16) -> dict:
         build_mesh,
     )
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
     d = 8
-    batch = int(os.environ.get("BENCH_ROWS", 1_000_000 if on_tpu else 50_000)) // 10
-    mesh = build_mesh()
-    n_chips = len(jax.devices())
+    platform, on_tpu, rows, _, mesh, n_chips = _bench_setup(1_000_000)
+    batch = rows // 10
     x = _make_data(batch * 12, d, k)
     batches = [x[i * batch : (i + 1) * batch] for i in range(12)]
 

@@ -19,6 +19,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.scipy.special import logsumexp
 
 from ..io.model_io import register_model
@@ -56,6 +57,46 @@ def _m_step_stats(x, resp):
     sums = resp.T @ x                                   # (k, d)
     outer = jnp.einsum("nk,nd,ne->kde", resp, x, x)     # (k, d, d)
     return nk, sums, outer
+
+
+def _em_iteration(x, w, means, covs, weights, reg_covar, eye):
+    """One full EM iteration (shared by the host loop and the device
+    loop) → (means, covs, weights, total log-likelihood)."""
+    chols = jnp.linalg.cholesky(covs + reg_covar * eye[None])
+    resp, ll = _e_step(x, w, jnp.log(weights), means, chols)
+    nk, sums, outer = _m_step_stats(x, resp)
+    nk = jnp.maximum(nk, 1e-6)
+    means = sums / nk[:, None]
+    covs = outer / nk[:, None, None] - jnp.einsum("kd,ke->kde", means, means)
+    covs = covs + reg_covar * eye[None]
+    weights = nk / jnp.sum(nk)
+    return means, covs, weights, ll
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _em_loop(x, w, means, covs, weights, reg_covar, tol, eye, max_iter: int):
+    """The whole EM fit as one device computation (lax.while_loop) — a
+    single host sync per fit; the Python loop in ``fit`` is kept only when
+    checkpoint/on_iteration hooks need the host each iteration.
+    Convergence matches the host loop: |ll_t − ll_{t−1}| < tol."""
+
+    def cond(carry):
+        it, _, _, _, prev_ll, ll = carry
+        return (it < max_iter) & (jnp.abs(ll - prev_ll) >= tol)
+
+    def body(carry):
+        it, means, covs, weights, _, ll = carry
+        means, covs, weights, new_ll = _em_iteration(
+            x, w, means, covs, weights, reg_covar, eye
+        )
+        return it + 1, means, covs, weights, ll, new_ll
+
+    init = (
+        jnp.int32(0), means, covs, weights,
+        jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+    )
+    it, means, covs, weights, _, ll = lax.while_loop(cond, body, init)
+    return means, covs, weights, ll, it
 
 
 @register_model("GaussianMixtureModel")
@@ -217,34 +258,39 @@ class GaussianMixture(Estimator):
         # likelihood, not 0.0.
         ll = prev_ll if np.isfinite(prev_ll) else 0.0
         it = start_it - 1
-        for it in range(start_it, self.max_iter + 1):
-            chols = jnp.linalg.cholesky(covs_d + self.reg_covar * eye[None])
-            resp, ll_dev = _e_step(x, w, jnp.log(weights_d), means_d, chols)
-            nk, sums, outer = _m_step_stats(x, resp)
-            nk = jnp.maximum(nk, 1e-6)
-            means_d = sums / nk[:, None]
-            covs_d = outer / nk[:, None, None] - jnp.einsum(
-                "kd,ke->kde", means_d, means_d
+        if ckpt is None and on_iteration is None and start_it <= self.max_iter:
+            # Fast path: the whole EM fit is one device computation
+            # (single host sync instead of one per iteration).
+            means_d, covs_d, weights_d, ll_dev, it_dev = _em_loop(
+                x, w, means_d, covs_d, weights_d,
+                jnp.float32(self.reg_covar), jnp.float32(self.tol), eye,
+                self.max_iter - (start_it - 1),
             )
-            covs_d = covs_d + self.reg_covar * eye[None]
-            weights_d = nk / jnp.sum(nk)
-            ll = float(ll_dev)  # TOTAL log-likelihood — Spark applies tol here
-            if ckpt is not None and it % max(self.checkpoint_every, 1) == 0:
-                ckpt.save(
-                    it,
-                    {
-                        "means": np.asarray(jax.device_get(means_d)),
-                        "covariances": np.asarray(jax.device_get(covs_d)),
-                        "weights": np.asarray(jax.device_get(weights_d)),
-                    },
-                    extra={"prev_ll": ll},
+            ll = float(ll_dev)
+            it = (start_it - 1) + int(it_dev)
+        else:
+            for it in range(start_it, self.max_iter + 1):
+                means_d, covs_d, weights_d, ll_dev = _em_iteration(
+                    x, w, means_d, covs_d, weights_d,
+                    jnp.float32(self.reg_covar), eye,
                 )
-            if on_iteration is not None:
-                on_iteration(it, ll)
-            if abs(ll - prev_ll) < self.tol:
+                ll = float(ll_dev)  # TOTAL log-likelihood — Spark tol here
+                if ckpt is not None and it % max(self.checkpoint_every, 1) == 0:
+                    ckpt.save(
+                        it,
+                        {
+                            "means": np.asarray(jax.device_get(means_d)),
+                            "covariances": np.asarray(jax.device_get(covs_d)),
+                            "weights": np.asarray(jax.device_get(weights_d)),
+                        },
+                        extra={"prev_ll": ll},
+                    )
+                if on_iteration is not None:
+                    on_iteration(it, ll)
+                if abs(ll - prev_ll) < self.tol:
+                    prev_ll = ll
+                    break
                 prev_ll = ll
-                break
-            prev_ll = ll
 
         return GaussianMixtureModel(
             weights=np.asarray(jax.device_get(weights_d)),
