@@ -43,62 +43,79 @@ from .binning import digitize, quantile_thresholds
 def _make_level_hist(mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int):
     """jit'd: per-(tree, level-node, feature, bin) stat histograms.
 
-    binned: (n, d) int32 — shared across trees
-    stats:  (T, n, S) float32 — per-row stat vector (already includes the
-            per-tree bootstrap/validity weight)
-    pos:    (T, n) int32 — row's position within the level frontier,
-            -1 for rows parked on leaves / out of tree
+    All row-major inputs are TRANSPOSED so the huge row axis is the lane
+    (last) dimension — a trailing S=3 or d=8 axis would be tile-padded to
+    128 lanes in HBM, a 16-40× inflation that OOMs at BASELINE scale
+    (f32[T, n, S] at T=20, n=2M allocates 20 GB padded).
+
+    binned_t: (d, n) int32 — shared across trees
+    base_t:   (S, n) float32 — per-row stat vector WITHOUT tree weights
+    w_tree:   (T, n) float32 — per-tree bootstrap/validity weights
+    pos:      (T, n) int32 — row's position within the level frontier,
+              -1 for rows parked on leaves / out of tree
     → (T, level_nodes, d, B, S), psum'd over the data axis.
     """
 
-    def shard_fn(binned, stats, pos):
-        n_loc = binned.shape[0]
-        feat_ids = jax.lax.broadcasted_iota(jnp.int32, (n_loc, d), 1)
-
-        def per_tree(stats_t, pos_t):
+    def shard_fn(binned_t, base_t, w_tree, pos):
+        # Trees are a sequential lax.scan, NOT vmap: scatter throughput is
+        # serial either way, and a batched (T, S, n) stats tensor gets
+        # hoisted by XLA into one 20 GB pathological-layout HBM buffer at
+        # BASELINE scale — per-tree it is a 64 MB transient.
+        def per_tree(carry, tree_in):
+            w_t, pos_t = tree_in
             active = pos_t >= 0
             safe_pos = jnp.where(active, pos_t, 0)
-            flat = (
-                safe_pos[:, None] * (d * B) + feat_ids * B + binned
-            )  # (n_loc, d)
-            upd = jnp.broadcast_to(
-                (stats_t * active[:, None].astype(stats_t.dtype))[:, None, :],
-                (n_loc, d, S),
-            )
-            hist = jnp.zeros((level_nodes * d * B, S), stats_t.dtype)
-            hist = hist.at[flat.reshape(-1)].add(upd.reshape(-1, S))
-            return hist.reshape(level_nodes, d, B, S)
+            # (S, n_loc): S rides the sublane axis (pads 3→8, not →128)
+            stats_t = base_t * (w_t * active.astype(base_t.dtype))[None, :]
 
-        h = jax.vmap(per_tree)(stats, pos)
+            def per_feature(c, binned_f):
+                flat = safe_pos * B + binned_f              # (n_loc,)
+                h = jnp.zeros((S, level_nodes * B), base_t.dtype)
+                h = h.at[:, flat].add(stats_t)              # updates (S, n_loc)
+                return c, h
+
+            _, hist = lax.scan(per_feature, 0, binned_t)    # (d, S, LN*B)
+            # tiny output tensor: reorder to (level_nodes, d, B, S)
+            return carry, jnp.transpose(
+                hist.reshape(d, S, level_nodes, B), (2, 0, 3, 1)
+            )
+
+        _, h = lax.scan(per_tree, 0, (w_tree, pos))
         return lax.psum(h, DATA_AXIS)
 
     return jax.jit(
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS, None), P(None, DATA_AXIS)),
+            in_specs=(
+                P(None, DATA_AXIS),
+                P(None, DATA_AXIS),
+                P(None, DATA_AXIS),
+                P(None, DATA_AXIS),
+            ),
             out_specs=P(),
         )
     )
 
 
 @jax.jit
-def _advance_rows(binned, node_id, split_feat, split_bin):
+def _advance_rows(binned_t, node_id, split_feat, split_bin):
     """Move every active row to its child heap slot.
 
+    binned_t: (d, n) int32 (row axis last — see _make_level_hist)
     node_id: (T, n) current heap ids (-1 = parked on a leaf)
     split_feat/split_bin: (T, total_nodes) — feat -1 marks a leaf node.
     go right ⇔ bin > split_bin[node].
     """
+    n = binned_t.shape[1]
+    rows = jnp.arange(n)
 
     def per_tree(nid, sf, sb):
         active = nid >= 0
         safe = jnp.where(active, nid, 0)
         f = sf[safe]
         is_split = f >= 0
-        fb = jnp.take_along_axis(
-            binned, jnp.maximum(f, 0)[:, None], axis=1
-        )[:, 0]
+        fb = binned_t[jnp.maximum(f, 0), rows]
         right = (fb > sb[safe]).astype(jnp.int32)
         child = 2 * safe + 1 + right
         return jnp.where(active & is_split, child, jnp.where(active, -1, nid))
@@ -195,7 +212,9 @@ def grow_forest(
     if sample.shape[0] == 0:
         raise ValueError("tree fit on an empty dataset")
     thr = quantile_thresholds(sample, B)
-    binned = digitize(ds.x.astype(jnp.float32), jnp.asarray(thr, jnp.float32))
+    # row axis LAST on every big device array (lane dim) — trailing d/S
+    # axes would tile-pad to 128 lanes in HBM (see _make_level_hist)
+    binned_t = digitize(ds.x.astype(jnp.float32), jnp.asarray(thr, jnp.float32)).T
 
     # 2. per-tree row weights: validity × (Poisson bootstrap | 1)
     if bootstrap:
@@ -204,16 +223,17 @@ def grow_forest(
         boot = np.ones((T, n_pad), dtype=np.float32)
     w_tree = jnp.asarray(boot) * ds.w[None, :].astype(jnp.float32)
 
-    # 3. per-row stat vectors
+    # 3. per-row base stat vectors (S, n); per-tree weighting happens
+    # inside the histogram kernel
     if task == "regression":
         S = 3
         y = ds.y.astype(jnp.float32)
-        stats = jnp.stack([jnp.ones_like(y), y, y * y], axis=1)  # (n, 3)
-        stats = w_tree[:, :, None] * stats[None, :, :]
+        base_t = jnp.stack([jnp.ones_like(y), y, y * y], axis=0)  # (3, n)
     else:
         S = num_classes
-        onehot = jax.nn.one_hot(ds.y.astype(jnp.int32), num_classes, dtype=jnp.float32)
-        stats = w_tree[:, :, None] * onehot[None, :, :]
+        base_t = jax.nn.one_hot(
+            ds.y.astype(jnp.int32), num_classes, dtype=jnp.float32, axis=0
+        )  # (C, n)
 
     total_nodes = 2 ** (max_depth + 1) - 1
     split_feat = np.full((T, total_nodes), -1, dtype=np.int32)
@@ -229,7 +249,9 @@ def grow_forest(
         pos = jnp.where(node_id >= 0, node_id - level_base, -1)
         pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
         hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T)
-        hist = np.asarray(jax.device_get(hist_fn(binned, stats, pos)), dtype=np.float64)
+        hist = np.asarray(
+            jax.device_get(hist_fn(binned_t, base_t, w_tree, pos)), dtype=np.float64
+        )
         # (T, level_nodes, d, B, S)
 
         # record node aggregates (same for every feature; use feature 0)
@@ -278,7 +300,7 @@ def grow_forest(
         if not do_split.any():
             break
         node_id = _advance_rows(
-            binned, node_id, jnp.asarray(split_feat), jnp.asarray(split_bin)
+            binned_t, node_id, jnp.asarray(split_feat), jnp.asarray(split_bin)
         )
 
     # 4. leaf/threshold materialization
