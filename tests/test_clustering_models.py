@@ -76,6 +76,49 @@ def test_bisecting_hierarchy_cost_decreases(rng, mesh8):
     assert m4.training_cost < m2.training_cost
 
 
+def test_bisecting_sequential_beats_level_on_budget_trap(rng, mesh8):
+    """k below the level fan-out: strict level-order (Spark semantics) can
+    waste budget halving a pure cluster; sequential largest-SSE (sklearn
+    biggest_inertia) must recover all 4 true centers tightly."""
+    x, _, true_centers = _blobs(rng, n=2000, k=4, spread=0.3, scale=5.0)
+    seq = BisectingKMeans(k=4, seed=0, strategy="sequential").fit(x, mesh=mesh8)
+    assert seq.cluster_centers.shape[0] == 4
+    dist = np.linalg.norm(true_centers[:, None] - seq.cluster_centers[None], axis=2)
+    assert dist.min(axis=1).max() < 0.3
+    lvl = BisectingKMeans(k=4, seed=0, strategy="level").fit(x, mesh=mesh8)
+    assert seq.training_cost <= lvl.training_cost + 1e-3
+
+
+def test_bisecting_strategy_validation(rng, mesh8):
+    x, _, _ = _blobs(rng, n=100)
+    with pytest.raises(ValueError, match="strategy"):
+        BisectingKMeans(k=2, strategy="zigzag").fit(x, mesh=mesh8)
+
+
+def test_bisecting_duplicate_points_terminate(rng, mesh8):
+    """k larger than the number of distinct points: splits of duplicate-only
+    clusters fail gracefully and the fit terminates with 2 clusters."""
+    x = np.repeat(np.array([[0.0, 0.0], [5.0, 5.0]]), 50, axis=0)
+    for strategy in ("level", "sequential"):
+        m = BisectingKMeans(k=4, seed=0, strategy=strategy).fit(x, mesh=mesh8)
+        assert m.cluster_centers.shape[0] == 2
+        assert m.cluster_sizes.sum() == len(x)
+
+
+def test_bisecting_large_offset_data(rng, mesh8):
+    """Unstandardized data whose mean dwarfs its spread: the root-SSE /
+    distance math must not cancel in f32 (regression: a moment-formula root
+    SSE collapsed the seeding radius and returned 1 cluster)."""
+    x, _, true_centers = _blobs(rng, n=1000, k=2, spread=0.2, scale=2.0)
+    x = x + 1.0e4
+    model = BisectingKMeans(k=2, seed=0).fit(x, mesh=mesh8)
+    assert model.cluster_centers.shape[0] == 2
+    dist = np.linalg.norm(
+        (true_centers + 1.0e4)[:, None] - model.cluster_centers[None], axis=2
+    )
+    assert dist.min(axis=1).max() < 1.0
+
+
 def test_bisecting_min_divisible(rng, mesh8):
     x, _, _ = _blobs(rng, n=100, k=2)
     # min size larger than any cluster → no split beyond the root
