@@ -4,29 +4,40 @@ Capability parity: ``pyspark.ml.clustering.GaussianMixture`` (fit/transform,
 ``weights``, ``gaussians`` (mean+cov), ``summary.logLikelihood``; defaults
 maxIter=100, tol=0.01, full covariance).  Spark distributes the E-step and
 accumulates the M-step sufficient statistics (Σr, Σr·x, Σr·xxᵀ) per
-partition with ``treeAggregate``; here both steps are one jit'd pass over
-the row-sharded dataset — responsibilities come from a batched
-Cholesky-based log-pdf, the moment accumulations are einsums contracting
-the sharded row axis (XLA inserts the psum), and the (k,d,d) refit happens
-replicated on every device.
+partition with ``treeAggregate``.
+
+The TPU-native fit is ONE jitted shard_map program: a ``lax.while_loop``
+over EM iterations, each iteration a row-chunked ``lax.scan`` over the data
+shard that accumulates exactly the Spark sufficient statistics — (nk,
+Σr·x, Σr·xxᵀ, log-likelihood) — and ``psum``s them over the mesh's data
+axis.  The (n, k) responsibility matrix exists only one chunk at a time in
+VMEM-sized transients (the BASELINE 10M-row table would need an n·k HBM
+tensor otherwise), the moment contraction is an MXU matmul of the (chunk,
+k) responsibilities against the (chunk, d·d) row outer products, and the
+(k, d, d) refit runs replicated on every device.  One host sync per fit.
+
+Rows are recentered around the init-sample mean inside the scan (fused
+into the chunk read): the covariance refit ``Σr·xxᵀ/nk − μμᵀ`` cancels
+catastrophically in f32 when the data mean dwarfs the spread.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.scipy.special import logsumexp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..io.model_io import register_model
-from ..parallel.mesh import default_mesh
+from ..parallel.mesh import DATA_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
 from .base import Estimator, Model, as_device_dataset
-from .kmeans import _kmeans_pp_init, _lloyd_refine
+from .kmeans import _chunked, _kmeans_pp_init, _lloyd_refine
 
 
 def _chol_log_pdf(x, mean, chol):
@@ -41,7 +52,8 @@ def _chol_log_pdf(x, mean, chol):
 
 @partial(jax.jit, static_argnames=())
 def _e_step(x, w, log_weights, means, chols):
-    # (n,k) component log-densities via vmap over components.
+    """Full-table responsibilities — model-side scoring only (``score``,
+    ``predict_proba``); the fit path never materializes (n, k)."""
     log_pdf = jax.vmap(lambda m, L: _chol_log_pdf(x, m, L))(means, chols).T
     log_resp_un = log_pdf + log_weights[None, :]
     log_norm = logsumexp(log_resp_un, axis=1)
@@ -50,53 +62,110 @@ def _e_step(x, w, log_weights, means, chols):
     return resp, log_likelihood
 
 
-@partial(jax.jit, static_argnames=())
-def _m_step_stats(x, resp):
-    # Sufficient statistics; contraction over the sharded row axis.
-    nk = jnp.sum(resp, axis=0)                          # (k,)
-    sums = resp.T @ x                                   # (k, d)
-    outer = jnp.einsum("nk,nd,ne->kde", resp, x, x)     # (k, d, d)
-    return nk, sums, outer
+@lru_cache(maxsize=32)
+def _make_em_loop(
+    mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int, max_iter: int
+):
+    """The whole EM fit as one jitted shard_map computation.
 
+    max_iter=1 doubles as the single-step builder for the host-hook path
+    (checkpointing / on_iteration callbacks need the host every step).
+    Convergence: |ll_t − ll_{t−1}| < tol, Spark semantics on the TOTAL
+    log-likelihood.
+    """
+    n_chunks, chunk = _chunked(n_loc, chunk_rows)
+    pad_to = n_chunks * chunk
 
-def _em_iteration(x, w, means, covs, weights, reg_covar, eye):
-    """One full EM iteration (shared by the host loop and the device
-    loop) → (means, covs, weights, total log-likelihood)."""
-    chols = jnp.linalg.cholesky(covs + reg_covar * eye[None])
-    resp, ll = _e_step(x, w, jnp.log(weights), means, chols)
-    nk, sums, outer = _m_step_stats(x, resp)
-    nk = jnp.maximum(nk, 1e-6)
-    means = sums / nk[:, None]
-    covs = outer / nk[:, None, None] - jnp.einsum("kd,ke->kde", means, means)
-    covs = covs + reg_covar * eye[None]
-    weights = nk / jnp.sum(nk)
-    return means, covs, weights, ll
+    def em_pass(x_c, w_c, shift, logw, means, chols):
+        """Chunk-scan E+M sufficient statistics, psum'd over the data axis:
+        (nk, Σr·x, Σr·xxᵀ, ll)."""
 
+        def body(carry, inputs):
+            nk, sums, outer, ll = carry
+            xb, wb = inputs
+            xb = xb - shift[None, :]
+            log_pdf = jax.vmap(lambda m, L: _chol_log_pdf(xb, m, L))(means, chols).T
+            log_resp_un = log_pdf + logw[None, :]
+            log_norm = logsumexp(log_resp_un, axis=1)
+            resp = jnp.exp(log_resp_un - log_norm[:, None]) * wb[:, None]  # (c, k)
+            nk = nk + jnp.sum(resp, axis=0)
+            sums = sums + jnp.dot(
+                resp.T, xb, precision=lax.Precision.HIGHEST
+            )
+            # (chunk, d·d) row outer products against (chunk, k) resp —
+            # an MXU matmul instead of an (n, k, d, d)-shaped einsum.
+            xx = (xb[:, :, None] * xb[:, None, :]).reshape(-1, d * d)
+            outer = outer + jnp.dot(
+                resp.T, xx, precision=lax.Precision.HIGHEST
+            ).reshape(k, d, d)
+            ll = ll + jnp.sum(log_norm * wb)
+            return (nk, sums, outer, ll), None
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def _em_loop(x, w, means, covs, weights, reg_covar, tol, eye, max_iter: int):
-    """The whole EM fit as one device computation (lax.while_loop) — a
-    single host sync per fit; the Python loop in ``fit`` is kept only when
-    checkpoint/on_iteration hooks need the host each iteration.
-    Convergence matches the host loop: |ll_t − ll_{t−1}| < tol."""
-
-    def cond(carry):
-        it, _, _, _, prev_ll, ll = carry
-        return (it < max_iter) & (jnp.abs(ll - prev_ll) >= tol)
-
-    def body(carry):
-        it, means, covs, weights, _, ll = carry
-        means, covs, weights, new_ll = _em_iteration(
-            x, w, means, covs, weights, reg_covar, eye
+        init = jax.tree.map(
+            lambda z: lax.pcast(z, DATA_AXIS, to="varying"),
+            (
+                jnp.zeros((k,), jnp.float32),
+                jnp.zeros((k, d), jnp.float32),
+                jnp.zeros((k, d, d), jnp.float32),
+                jnp.zeros((), jnp.float32),
+            ),
         )
-        return it + 1, means, covs, weights, ll, new_ll
+        (nk, sums, outer, ll), _ = lax.scan(body, init, (x_c, w_c))
+        return (
+            lax.psum(nk, DATA_AXIS),
+            lax.psum(sums, DATA_AXIS),
+            lax.psum(outer, DATA_AXIS),
+            lax.psum(ll, DATA_AXIS),
+        )
 
-    init = (
-        jnp.int32(0), means, covs, weights,
-        jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+    def shard_fn(x, w, shift, means, covs, weights, reg_covar, tol):
+        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
+        wp = jnp.pad(w, (0, pad_to - n_loc))
+        x_c = xp.reshape(n_chunks, chunk, d)
+        w_c = wp.reshape(n_chunks, chunk)
+        eye = jnp.eye(d, dtype=jnp.float32)
+
+        def cond(carry):
+            it, _, _, _, prev_ll, ll = carry
+            return (it < max_iter) & (jnp.abs(ll - prev_ll) >= tol)
+
+        def body(carry):
+            it, means, covs, weights, _, old_ll = carry
+            chols = jnp.linalg.cholesky(covs + reg_covar * eye[None])
+            nk, sums, outer, ll = em_pass(
+                x_c, w_c, shift, jnp.log(weights), means, chols
+            )
+            nk = jnp.maximum(nk, 1e-6)
+            means = sums / nk[:, None]
+            covs = outer / nk[:, None, None] - jnp.einsum("kd,ke->kde", means, means)
+            covs = covs + reg_covar * eye[None]
+            weights = nk / jnp.sum(nk)
+            return it + 1, means, covs, weights, old_ll, ll
+
+        init = (
+            jnp.int32(0), means, covs, weights,
+            jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+        )
+        it, means, covs, weights, _, ll = lax.while_loop(cond, body, init)
+        return means, covs, weights, ll, it
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(
+                P(DATA_AXIS, None),
+                P(DATA_AXIS),
+                P(),
+                P(),
+                P(),
+                P(),
+                P(),
+                P(),
+            ),
+            out_specs=(P(), P(), P(), P(), P()),
+        )
     )
-    it, means, covs, weights, _, ll = lax.while_loop(cond, body, init)
-    return means, covs, weights, ll, it
 
 
 @register_model("GaussianMixtureModel")
@@ -171,6 +240,9 @@ class GaussianMixture(Estimator):
     seed: int = 0
     reg_covar: float = 1e-6
     init_sample_size: int = 65536
+    # Row-chunk size for the E/M scan; the per-chunk transients (resp
+    # (chunk, k), row outer products (chunk, d²)) stay VMEM-friendly.
+    chunk_rows: int = 65536
     # Mid-training checkpointing (io/fit_checkpoint.py): commit EM state
     # (means, covariances, weights, log-likelihood) every N iterations so a
     # preempted fit resumes from the last commit.
@@ -187,7 +259,10 @@ class GaussianMixture(Estimator):
         x = ds.x.astype(jnp.float32)
         w = ds.w
         d = x.shape[1]
-        n = float(jax.device_get(jnp.sum(w)))
+        # One weight fetch serves the row count AND the init sampler (on a
+        # remote-attached chip every extra host sync costs tens of ms).
+        w_host = np.asarray(jax.device_get(w))
+        n = float(w_host.sum())
         if n == 0:
             raise ValueError("GaussianMixture fit on an empty dataset")
 
@@ -205,23 +280,32 @@ class GaussianMixture(Estimator):
             ckpt = FitCheckpointer(self.checkpoint_dir, signature)
             resumed = ckpt.resume()
 
+        # Init on a bounded host sample (only the sample leaves the
+        # device); the sample also supplies the recentering shift that
+        # keeps the f32 covariance refit stable on unstandardized data.
+        from ..parallel.sharding import sample_valid_rows
+
+        valid = sample_valid_rows(
+            DeviceDataset(x, ds.y, w), self.init_sample_size, self.seed,
+            w_host=w_host,
+        )
+        shift = valid.mean(axis=0).astype(np.float32) if valid.shape[0] else np.zeros(
+            (d,), np.float32
+        )
+
         start_it = 1
         prev_ll = -np.inf
         if resumed is not None:
             step0, arrays, extra = resumed
-            means = arrays["means"].astype(np.float32)
+            # Checkpoints store UNSHIFTED means; re-apply this fit's shift.
+            means = arrays["means"].astype(np.float32) - shift
             covs = arrays["covariances"].astype(np.float32)
             weights = arrays["weights"].astype(np.float32)
             prev_ll = float(extra.get("prev_ll", -np.inf))
             start_it = step0 + 1
         else:
-            # Init on a bounded host sample (only the sample leaves the
-            # device).
-            from ..parallel.sharding import sample_valid_rows
-
-            valid = sample_valid_rows(
-                DeviceDataset(x, ds.y, w), self.init_sample_size, self.seed
-            )
+            # Init runs in SHIFTED coordinates, like the EM loop itself.
+            valid = valid - shift
             # k-means++ seeding + short Lloyd refinement (sklearn's
             # init_params="kmeans" equivalent) — raw ++ points alone leave
             # EM in visibly worse local optima on close blob pairs.
@@ -251,7 +335,8 @@ class GaussianMixture(Estimator):
         means_d = jnp.asarray(means)
         covs_d = jnp.asarray(covs)
         weights_d = jnp.asarray(weights)
-        eye = jnp.eye(d, dtype=jnp.float32)
+        shift_d = jnp.asarray(shift)
+        n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
 
         # A resume that lands past max_iter skips the loop entirely — seed
         # ll from the checkpoint so the returned model reports the real
@@ -261,25 +346,33 @@ class GaussianMixture(Estimator):
         if ckpt is None and on_iteration is None and start_it <= self.max_iter:
             # Fast path: the whole EM fit is one device computation
             # (single host sync instead of one per iteration).
-            means_d, covs_d, weights_d, ll_dev, it_dev = _em_loop(
-                x, w, means_d, covs_d, weights_d,
-                jnp.float32(self.reg_covar), jnp.float32(self.tol), eye,
+            loop = _make_em_loop(
+                mesh, n_loc, self.k, d, self.chunk_rows,
                 self.max_iter - (start_it - 1),
+            )
+            means_d, covs_d, weights_d, ll_dev, it_dev = loop(
+                x, w, shift_d, means_d, covs_d, weights_d,
+                jnp.float32(self.reg_covar), jnp.float32(self.tol),
             )
             ll = float(ll_dev)
             it = (start_it - 1) + int(it_dev)
         else:
+            # Host-hook path: one EM iteration per device call (the
+            # max_iter=1 loop never re-enters its while body).
+            step = _make_em_loop(mesh, n_loc, self.k, d, self.chunk_rows, 1)
             for it in range(start_it, self.max_iter + 1):
-                means_d, covs_d, weights_d, ll_dev = _em_iteration(
-                    x, w, means_d, covs_d, weights_d,
-                    jnp.float32(self.reg_covar), eye,
+                means_d, covs_d, weights_d, ll_dev, _ = step(
+                    x, w, shift_d, means_d, covs_d, weights_d,
+                    jnp.float32(self.reg_covar), jnp.float32(-jnp.inf),
                 )
                 ll = float(ll_dev)  # TOTAL log-likelihood — Spark tol here
                 if ckpt is not None and it % max(self.checkpoint_every, 1) == 0:
                     ckpt.save(
                         it,
                         {
-                            "means": np.asarray(jax.device_get(means_d)),
+                            # stored UNSHIFTED so any later fit (whose
+                            # sample shift may differ) resumes correctly
+                            "means": np.asarray(jax.device_get(means_d)) + shift,
                             "covariances": np.asarray(jax.device_get(covs_d)),
                             "weights": np.asarray(jax.device_get(weights_d)),
                         },
@@ -294,7 +387,7 @@ class GaussianMixture(Estimator):
 
         return GaussianMixtureModel(
             weights=np.asarray(jax.device_get(weights_d)),
-            means=np.asarray(jax.device_get(means_d)),
+            means=np.asarray(jax.device_get(means_d)) + shift,
             covariances=np.asarray(jax.device_get(covs_d)),
             log_likelihood=ll,
             avg_log_likelihood=ll / max(n, 1.0),

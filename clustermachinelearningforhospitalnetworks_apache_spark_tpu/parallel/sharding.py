@@ -114,15 +114,18 @@ def unpad(values: jax.Array, n: int) -> np.ndarray:
     return np.asarray(jax.device_get(values))[:n]
 
 
-def sample_valid_rows(ds: DeviceDataset, size: int, seed: int) -> np.ndarray:
+def sample_valid_rows(
+    ds: DeviceDataset, size: int, seed: int, w_host: np.ndarray | None = None
+) -> np.ndarray:
     """Fetch a uniform sample of ≤``size`` valid rows to host.
 
     Transfers only the weight vector plus the sampled rows (a device gather)
     — not the full O(n·d) dataset; estimator init paths use this so a fit on
     BASELINE-scale data doesn't stall on a host transfer before its first
-    device iteration.
+    device iteration.  Pass ``w_host`` when the caller already fetched the
+    weights (saves one host↔device round trip).
     """
-    w = np.asarray(jax.device_get(ds.w))
+    w = w_host if w_host is not None else np.asarray(jax.device_get(ds.w))
     valid_idx = np.flatnonzero(w > 0)
     if valid_idx.size == 0:
         return np.empty((0, ds.n_features), dtype=np.float64)

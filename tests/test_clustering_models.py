@@ -59,6 +59,24 @@ def test_gmm_save_load(rng, mesh8, tmp_path):
     np.testing.assert_allclose(loaded.covariances, model.covariances)
 
 
+def test_gmm_large_offset_covariances(rng, mesh8):
+    """Unstandardized data whose mean dwarfs its spread: the chunked E/M
+    scan recenters rows so the f32 covariance refit Σr·xxᵀ/nk − μμᵀ keeps
+    its signal (regression guard for the moment-formula cancellation)."""
+    x, labels, _ = _blobs(rng, n=900, k=2, spread=0.5, scale=3.0)
+    m0 = GaussianMixture(k=2, seed=0).fit(x, mesh=mesh8)
+    m1 = GaussianMixture(k=2, seed=0).fit(x + 1.0e4, mesh=mesh8)
+    assert np.all(np.isfinite(m1.covariances))
+    # same fit up to the translation: match components by weight ordering
+    o0, o1 = np.argsort(m0.weights), np.argsort(m1.weights)
+    np.testing.assert_allclose(
+        m1.means[o1] - 1.0e4, m0.means[o0], rtol=0, atol=0.05
+    )
+    np.testing.assert_allclose(
+        m1.covariances[o1], m0.covariances[o0], rtol=0.2, atol=0.05
+    )
+
+
 # ---------------------------------------------------- BisectingKMeans
 def test_bisecting_recovers_blobs(rng, mesh8):
     x, labels, true_centers = _blobs(rng, k=4)
