@@ -16,6 +16,13 @@ area.
   distinct thresholds (Spark's ``areaUnderPR``), with within-tie points
   collapsed to their threshold-block edge so tied scores contribute a
   single curve point.
+
+Precision note: scores are ranked in float32 on device, so float64 scores
+that are distinct but collide when cast to f32 merge into one tie block —
+AUC can differ from the exact float64 (Spark/sklearn) value at the ~1e-5
+level on near-duplicate scores.  That tolerance is intentional (f32 is the
+TPU-native compute width); rank on host in float64 if exact parity on such
+inputs matters.
 """
 
 from __future__ import annotations

@@ -80,6 +80,25 @@ class FitCheckpointer:
         self.signature = signature
         self.keep = max(keep, 1)
         os.makedirs(path, exist_ok=True)
+        self._recover_crashed_save()
+
+    def _recover_crashed_save(self) -> None:
+        """Repair the directory after a crash mid-``save``: restore any
+        displaced committed step whose replacement never landed, then drop
+        leftover staging dirs."""
+        for name in os.listdir(self.path):
+            if name.startswith(".old-step-"):
+                step_dir = os.path.join(self.path, name.replace(".old-", "", 1))
+                old_dir = os.path.join(self.path, name)
+                if not os.path.exists(step_dir):
+                    # crash between displacing the old step and installing
+                    # the new one — the displaced copy is the real state
+                    os.replace(old_dir, step_dir)
+                else:
+                    shutil.rmtree(old_dir, ignore_errors=True)
+        for name in os.listdir(self.path):
+            if name.startswith(".tmp-step-"):
+                shutil.rmtree(os.path.join(self.path, name), ignore_errors=True)
 
     # -- write ----------------------------------------------------------
     def save(self, step: int, arrays: dict, extra: dict | None = None) -> None:
@@ -100,8 +119,15 @@ class FitCheckpointer:
         _atomic_write_json(
             os.path.join(tmp_dir, "meta.json"), {"step": step, "extra": extra or {}}
         )
+        old_dir = None
         if os.path.exists(step_dir):
-            shutil.rmtree(step_dir)
+            # Re-save of an already-committed step: displace rather than
+            # delete, so a crash before the new COMMIT lands still leaves a
+            # resumable copy (restored by _recover_crashed_save).
+            old_dir = os.path.join(self.path, f".old-step-{step}")
+            if os.path.exists(old_dir):
+                shutil.rmtree(old_dir)
+            os.replace(step_dir, old_dir)
         os.replace(tmp_dir, step_dir)
         _fsync_dir(self.path)
         # the commit point — everything above is invisible until this lands
@@ -109,15 +135,23 @@ class FitCheckpointer:
             os.path.join(self.path, COMMIT_FILE),
             {"step": step, "signature": self.signature},
         )
+        if old_dir is not None:
+            shutil.rmtree(old_dir, ignore_errors=True)
         self._prune(keep_latest=step)
 
     def _prune(self, keep_latest: int) -> None:
-        steps = sorted(self._committed_steps())
+        # Orphan step dirs from a crash after os.replace but before COMMIT
+        # are newer than the commit point: never count them toward ``keep``
+        # (that could evict a genuinely committed older step) — delete them.
+        for s in self._step_dirs():
+            if s > keep_latest:
+                shutil.rmtree(os.path.join(self.path, f"step-{s}"), ignore_errors=True)
+        steps = sorted(s for s in self._step_dirs() if s <= keep_latest)
         for s in steps[: -self.keep] if len(steps) > self.keep else []:
             if s != keep_latest:
                 shutil.rmtree(os.path.join(self.path, f"step-{s}"), ignore_errors=True)
 
-    def _committed_steps(self) -> list[int]:
+    def _step_dirs(self) -> list[int]:
         out = []
         for name in os.listdir(self.path):
             if name.startswith("step-"):

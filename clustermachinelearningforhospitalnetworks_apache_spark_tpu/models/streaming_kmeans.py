@@ -185,7 +185,13 @@ class StreamingKMeans:
     def update(self, batch, mesh=None) -> "StreamingKMeans":
         """Consume one micro-batch; returns ``self`` for chaining.  The
         updated state stays on device — read ``latest_model`` to
-        materialize it (one host transfer)."""
+        materialize it (one host transfer).
+
+        .. note:: prior to round 1's device-resident rework this returned a
+           ``StreamingKMeansModel``; callers doing
+           ``model = sk.update(batch)`` must now read ``sk.latest_model``
+           for ``cluster_centers``/``cluster_weights`` (the estimator
+           itself has no such attributes)."""
         mesh = mesh or default_mesh()
         ds = as_device_dataset(batch, mesh=mesh)
         x = ds.x.astype(jnp.float32)

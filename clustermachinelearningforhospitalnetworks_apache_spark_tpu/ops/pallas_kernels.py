@@ -184,6 +184,14 @@ def fused_lloyd_stats(
     centers = centers.astype(jnp.float32)
     n, d = x.shape
     k = centers.shape[0]
+    if n == 0:
+        # empty grid would skip the kernel's i==0 init and return
+        # uninitialized output buffers
+        return (
+            jnp.zeros((k, d), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        )
     b = _pick_block_rows(n, k, d, block_rows)
     pad = (-n) % b
     if pad:
@@ -252,6 +260,8 @@ def fused_assign(
     k = centers.shape[0]
     if c_valid is None:
         c_valid = jnp.ones((k,), jnp.float32)
+    if n == 0:
+        return jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32)
     b = _pick_block_rows(n, k, d, block_rows)
     pad = (-n) % b
     if pad:

@@ -36,7 +36,7 @@ def test_roundtrip_and_prune(tmp_path):
     step, arrays, extra = ck.resume()
     assert step == 6 and extra == {"ll": 9.0}
     np.testing.assert_array_equal(arrays["x"], np.full((3,), 6))
-    assert sorted(ck._committed_steps()) == [4, 6]  # pruned to keep=2
+    assert sorted(ck._step_dirs()) == [4, 6]  # pruned to keep=2
 
 
 def test_signature_mismatch_raises(tmp_path):
@@ -59,6 +59,42 @@ def test_torn_save_invisible(tmp_path):
     step, arrays, _ = FitCheckpointer(path, {"k": 4}).resume()
     assert step == 3
     np.testing.assert_array_equal(arrays["x"], np.ones(2))
+
+
+def test_resave_crash_window_recovers(tmp_path):
+    """Re-saving the committed step displaces the old dir instead of
+    deleting it, so a crash after the displace but before the new dir
+    lands still leaves a resumable copy (restored on next construction)."""
+    import os
+    import shutil
+
+    path = str(tmp_path / "ck")
+    ck = FitCheckpointer(path, {"k": 4})
+    ck.save(3, {"x": np.ones(2)})
+    # simulate the crash window inside a re-save of step 3: the committed
+    # dir has been displaced aside, the replacement never landed
+    os.replace(os.path.join(path, "step-3"), os.path.join(path, ".old-step-3"))
+    step, arrays, _ = FitCheckpointer(path, {"k": 4}).resume()
+    assert step == 3
+    np.testing.assert_array_equal(arrays["x"], np.ones(2))
+
+
+def test_orphan_step_dirs_not_counted_committed(tmp_path):
+    """A step dir newer than COMMIT (crash between rename and COMMIT) must
+    not count toward ``keep`` or evict genuinely committed steps."""
+    import os
+
+    path = str(tmp_path / "ck")
+    ck = FitCheckpointer(path, {"k": 4}, keep=2)
+    ck.save(1, {"x": np.full((2,), 1.0)})
+    ck.save(2, {"x": np.full((2,), 2.0)})
+    # orphan from a crashed future save: dir exists, COMMIT still at 2
+    os.makedirs(os.path.join(path, "step-9"))
+    ck.save(3, {"x": np.full((2,), 3.0)})
+    # keep=2 retains {2, 3}; the orphan is gone and step-2 survived
+    assert sorted(ck._step_dirs()) == [2, 3]
+    step, arrays, _ = ck.resume()
+    assert step == 3
 
 
 # --- estimator fault-injection tier ------------------------------------
