@@ -266,6 +266,112 @@ def _bench_bisecting(k: int = 8) -> dict:
     }
 
 
+def _cpu_rf_throughput(x: np.ndarray, y: np.ndarray, T: int, depth: int, B: int) -> float:
+    """NumPy level-order histogram random forest — the Spark-CPU stand-in.
+
+    Mirrors MLlib's RandomForest.findBestSplits: quantile binning, per-node
+    per-feature per-bin stat histograms (``np.bincount`` — C speed, far
+    faster than Spark's JVM treeAggregate path, keeping the ratio
+    conservative), best-split selection, level advance."""
+    n, d = x.shape
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    thr = np.quantile(x, np.linspace(0, 1, B + 1)[1:-1], axis=0).T  # (d, B-1)
+    binned = np.stack(
+        [np.searchsorted(thr[f], x[:, f], side="left") for f in range(d)]
+    )
+    w_tree = rng.poisson(1.0, size=(T, n)).astype(np.float64)
+    base = np.stack([np.ones(n), y, y * y])
+    node = np.zeros((T, n), np.int64)
+    for dep in range(depth + 1):
+        ln = 1 << dep
+        base_id = ln - 1
+        best_feat = np.zeros((T, ln), np.int64)
+        best_bin = np.zeros((T, ln), np.int64)
+        for t in range(T):
+            pos = node[t] - base_id
+            act = (pos >= 0) & (pos < ln)
+            hist = np.zeros((ln, d, B, 3))
+            idx = pos[act] * B
+            for f in range(d):
+                flat = idx + binned[f, act]
+                for s in range(3):
+                    hist[:, f, :, s] = np.bincount(
+                        flat, weights=base[s, act] * w_tree[t, act],
+                        minlength=ln * B,
+                    ).reshape(ln, B)
+            if dep == depth:
+                continue
+            cum = hist.cumsum(axis=2)
+            wt, st, qt = (cum[:, :, -1:, s] for s in range(3))  # (ln, d, 1)
+            wl, sl, ql = cum[..., 0], cum[..., 1], cum[..., 2]  # (ln, d, B)
+            wr, sr, qr = wt - wl, st - sl, qt - ql
+
+            def sse(w, s, q):
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    return np.where(w > 0, q - s * s / np.maximum(w, 1e-12), 0.0)
+
+            gain = sse(wt, st, qt) - sse(wl, sl, ql) - sse(wr, sr, qr)
+            gain[..., -1] = -np.inf
+            flat_g = gain.reshape(ln, d * B)
+            b = flat_g.argmax(axis=1)
+            best_feat[t] = b // B
+            best_bin[t] = b % B
+        if dep == depth:
+            break
+        for t in range(T):
+            pos = node[t] - base_id
+            act = (pos >= 0) & (pos < ln)
+            p = np.where(act, pos, 0)
+            f = best_feat[t][p]
+            fb = binned[f, np.arange(n)]
+            child = 2 * (base_id + p) + 1 + (fb > best_bin[t][p])
+            node[t] = np.where(act, child, node[t])
+    return n / (time.perf_counter() - t0)
+
+
+def _bench_random_forest(T: int = 20, depth: int = 5) -> dict:
+    """Config 6 (reference hot path): RandomForestRegressor fit throughput
+    — the reference's own hottest fit (``rf.fit``,
+    mllearnforhospitalnetwork.py:156-158; SURVEY.md §3.3 calls it "the
+    hottest path")."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        RandomForestRegressor,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+
+    d = 8
+    platform, on_tpu, n, _, mesh, n_chips = _bench_setup(2_000_000)
+    rng = np.random.default_rng(0)
+    x = _make_data(n, d, 16)
+    y = (x @ rng.normal(size=(d,)) + rng.normal(0.0, 0.3, size=n)).astype(np.float32)
+    ds = device_dataset(x, y, mesh=mesh)
+
+    est = RandomForestRegressor(
+        num_trees=T, max_depth=depth, feature_subset_strategy="all", seed=0
+    )
+    est.fit(ds, mesh=mesh)  # warm-up: per-level executables
+    t0 = time.perf_counter()
+    est.fit(ds, mesh=mesh)
+    per_chip = n / (time.perf_counter() - t0) / n_chips
+
+    cpu_n = min(n, 100_000)
+    cpu_thr = _cpu_rf_throughput(
+        x[:cpu_n].astype(np.float64), y[:cpu_n].astype(np.float64), T, depth, 32
+    )
+    return {
+        "metric": (
+            f"RandomForest T={T} depth={depth} fit records/sec/chip "
+            f"({n} rows, d={d}, {platform})"
+        ),
+        "value": round(per_chip, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(per_chip / cpu_thr, 2),
+    }
+
+
 def _bench_streaming(k: int = 16) -> dict:
     """Config 5: StreamingKMeans micro-batch update throughput."""
     import jax
@@ -308,6 +414,7 @@ CONFIGS = {
     "gmm32": lambda: _bench_gmm(32),                            # config 3
     "bisecting": lambda: _bench_bisecting(8),                   # config 4
     "streaming": lambda: _bench_streaming(16),                  # config 5
+    "rf20": lambda: _bench_random_forest(20, 5),                # reference hot path
 }
 
 

@@ -73,6 +73,13 @@ class FitCheckpointer:
 
     ``keep`` commits are retained (≥1) so a crash *during* save never
     destroys the only resumable state.
+
+    **Single-writer**: a checkpoint directory belongs to one live fit at a
+    time (the resume-after-preemption model — the previous owner is dead
+    by the time the successor constructs this).  Construction repairs
+    leftovers from a crashed save, which would race a concurrent writer;
+    two simultaneous fits on one directory were never supported (their
+    interleaved saves would corrupt each other regardless).
     """
 
     def __init__(self, path: str, signature: dict, keep: int = 2):

@@ -5,8 +5,8 @@ most ``maxBins`` quantile bins (one pass of approximate quantiles), then
 trains entirely on bin indices (reference path: ``RandomForest.run`` behind
 ``mllearnforhospitalnetwork.py:150-158,183-190``; SURVEY.md §3.3).  Same
 design here: thresholds come from a host-side sample, rows are digitized
-once on device (vmapped ``searchsorted``), and every later level touches
-only the (n, d) int32 bin matrix.
+once on device (a fused compare-and-sum over the threshold axis), and every
+later level touches only the (n, d) int32 bin matrix.
 """
 
 from __future__ import annotations
@@ -35,9 +35,17 @@ def quantile_thresholds(sample: np.ndarray, max_bins: int) -> np.ndarray:
 
 @jax.jit
 def digitize(x: jax.Array, thresholds: jax.Array) -> jax.Array:
-    """(n, d) float features → (n, d) int32 bin ids in [0, max_bins)."""
+    """(n, d) float features → (n, d) int32 bin ids in [0, max_bins).
 
-    def one(col, thr):
-        return jnp.searchsorted(thr, col, side="left").astype(jnp.int32)
-
-    return jax.vmap(one, in_axes=(1, 0), out_axes=1)(x, thresholds)
+    bin = #{thresholds strictly below the value} — a broadcast
+    compare-and-sum over the (small) threshold axis, which XLA fuses into
+    one VPU pass; ``searchsorted`` lowered to a per-element binary-search
+    loop that measured ~0.7 s at BASELINE scale (2M×8, 31 thresholds).
+    Semantics match ``searchsorted(side="left")``: ties go left (bin b
+    holds values in (thr[b-1], thr[b]]).
+    """
+    # (n, d, B-1) compare, fused into the sum — thresholds are +inf-padded
+    # for low-cardinality features, which compares False and never counts
+    return (x[:, :, None] > thresholds[None, :, :]).sum(
+        axis=2, dtype=jnp.int32
+    )

@@ -39,6 +39,12 @@ from .binning import digitize, quantile_thresholds
 
 
 # --------------------------------------------------------------------- hist
+#: rows per scan step of the histogram contraction — sized so the masked
+#: stats chunk (T·LN·S, CHUNK) stays a few-MB transient (fusable / cheap)
+#: while each matmul's K dimension is deep enough to saturate the MXU.
+_HIST_CHUNK = 8192
+
+
 @lru_cache(maxsize=64)
 def _make_level_hist(mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int):
     """jit'd: per-(tree, level-node, feature, bin) stat histograms.
@@ -48,122 +54,243 @@ def _make_level_hist(mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: in
     128 lanes in HBM, a 16-40× inflation that OOMs at BASELINE scale
     (f32[T, n, S] at T=20, n=2M allocates 20 GB padded).
 
+    The histogram is computed as a **one-hot contraction on the MXU**, not
+    a scatter-add: scatter with duplicate indices serializes on TPU (the
+    round-1 scatter version measured 76k rows/s for the 20-tree BASELINE
+    forest — ~3000× below the KMeans path).  Per row-chunk:
+
+        stats[(t,p,s), c] = [pos_t(c)=p] · w_t(c) · base_s(c)   (masked stats)
+        binoh[f, c, b]    = [binned_f(c)=b]                      (bin one-hot)
+        hist[(t,p,s), f, b] += einsum("mc,fcb->mfb", stats, binoh)
+
+    Every FLOP lands on the MXU with K=chunk deep and M=T·LN·S wide (≥128
+    from level 2 of a 20-tree forest), so the whole level is a handful of
+    dense matmuls — the same trick as Spark MLlib's treeAggregate'd
+    histograms, but shaped for a systolic array instead of a shuffle.
+
     binned_t: (d, n) int32 — shared across trees
     base_t:   (S, n) float32 — per-row stat vector WITHOUT tree weights
     w_tree:   (T, n) float32 — per-tree bootstrap/validity weights
     pos:      (T, n) int32 — row's position within the level frontier,
-              -1 for rows parked on leaves / out of tree
+              -1 for rows parked on leaves / out of tree (matches no node
+              one-hot slot, so such rows contribute zero mass)
     → (T, level_nodes, d, B, S), psum'd over the data axis.
+
+    Split *selection* happens on device too (`_make_level_step`): only the
+    (T, LN)-shaped winners cross to the host between levels, ~15 KB instead
+    of the full histogram — host↔device latency was a measured per-level
+    cost on tunneled chips.
     """
 
     def shard_fn(binned_t, base_t, w_tree, pos):
-        # Trees are a sequential lax.scan, NOT vmap: scatter throughput is
-        # serial either way, and a batched (T, S, n) stats tensor gets
-        # hoisted by XLA into one 20 GB pathological-layout HBM buffer at
-        # BASELINE scale — per-tree it is a 64 MB transient.
-        def per_tree(carry, tree_in):
-            w_t, pos_t = tree_in
-            active = pos_t >= 0
-            safe_pos = jnp.where(active, pos_t, 0)
-            # (S, n_loc): S rides the sublane axis (pads 3→8, not →128)
-            stats_t = base_t * (w_t * active.astype(base_t.dtype))[None, :]
+        n_loc = binned_t.shape[1]
+        chunk = min(_HIST_CHUNK, max(n_loc, 1))
+        pad = (-n_loc) % chunk
+        if pad:
+            binned_t = jnp.pad(binned_t, ((0, 0), (0, pad)))
+            base_t = jnp.pad(base_t, ((0, 0), (0, pad)))
+            w_tree = jnp.pad(w_tree, ((0, 0), (0, pad)))
+            # padding rows match no frontier slot → zero contribution
+            pos = jnp.pad(pos, ((0, 0), (0, pad)), constant_values=-1)
+        nchunks = (n_loc + pad) // chunk
 
-            def per_feature(c, binned_f):
-                flat = safe_pos * B + binned_f              # (n_loc,)
-                h = jnp.zeros((S, level_nodes * B), base_t.dtype)
-                h = h.at[:, flat].add(stats_t)              # updates (S, n_loc)
-                return c, h
+        nodes = jnp.arange(level_nodes, dtype=pos.dtype)
+        bins = jnp.arange(B, dtype=binned_t.dtype)
+        M = T * level_nodes * S
 
-            _, hist = lax.scan(per_feature, 0, binned_t)    # (d, S, LN*B)
-            # tiny output tensor: reorder to (level_nodes, d, B, S)
-            return carry, jnp.transpose(
-                hist.reshape(d, S, level_nodes, B), (2, 0, 3, 1)
+        def chunk_body(acc, i):
+            sl = i * chunk
+            binned_c = lax.dynamic_slice_in_dim(binned_t, sl, chunk, axis=1)
+            base_c = lax.dynamic_slice_in_dim(base_t, sl, chunk, axis=1)
+            w_c = lax.dynamic_slice_in_dim(w_tree, sl, chunk, axis=1)
+            pos_c = lax.dynamic_slice_in_dim(pos, sl, chunk, axis=1)
+
+            node_oh = (pos_c[:, None, :] == nodes[None, :, None]).astype(
+                base_c.dtype
+            ) * w_c[:, None, :]                                   # (T, LN, C)
+            stats = (
+                node_oh[:, :, None, :] * base_c[None, None, :, :]
+            ).reshape(M, chunk)                                   # (M, C)
+            binoh = (binned_c[:, :, None] == bins[None, None, :]).astype(
+                base_c.dtype
+            )                                                     # (d, C, B)
+            # f32-exact accumulation: split decisions are compared against
+            # exhaustive search in tests, so bf16-truncated passes are out
+            h = jnp.einsum(
+                "mc,fcb->mfb", stats, binoh,
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
             )
+            return acc + h, None
 
-        _, h = lax.scan(per_tree, 0, (w_tree, pos))
+        # the carry must be marked varying over the mesh axis the body's
+        # shard-local slices vary over
+        acc = lax.pcast(jnp.zeros((M, d, B), jnp.float32), (DATA_AXIS,), to="varying")
+        acc, _ = lax.scan(chunk_body, acc, jnp.arange(nchunks))
+        h = jnp.transpose(
+            acc.reshape(T, level_nodes, S, d, B), (0, 1, 3, 4, 2)
+        )  # (T, LN, d, B, S)
         return lax.psum(h, DATA_AXIS)
 
-    return jax.jit(
-        jax.shard_map(
-            shard_fn,
-            mesh=mesh,
-            in_specs=(
-                P(None, DATA_AXIS),
-                P(None, DATA_AXIS),
-                P(None, DATA_AXIS),
-                P(None, DATA_AXIS),
-            ),
-            out_specs=P(),
-        )
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, DATA_AXIS),
+            P(None, DATA_AXIS),
+            P(None, DATA_AXIS),
+            P(None, DATA_AXIS),
+        ),
+        out_specs=P(),
     )
 
 
+@lru_cache(maxsize=64)
+def _make_level_step(
+    mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int, task: str
+):
+    """jit'd level step: sharded histogram + on-device split selection.
+
+    → (agg (T,LN,S), best_gain, best_feat, best_bin, do_split — all (T,LN)).
+    Every split decision (gain argmax, min-instances, min-gain, node-mass
+    gates) is made on device so levels chain with **zero host round trips**;
+    the host fetches all levels' tiny winner tensors once, after the whole
+    forest's device timeline has been dispatched (the per-level blocking
+    device_get measured ~70 ms each on tunneled chips).
+
+    ``feat_mask`` (T, LN, d) zero-masks features outside the per-node
+    random subset (Spark's featureSubsetStrategy); ``min_inst`` /
+    ``min_gain`` are dynamic scalars (no recompile when they change).
+    """
+    hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T)
+    neg_inf = jnp.float32(-jnp.inf)
+
+    def step(binned_t, base_t, w_tree, pos, feat_mask, min_inst, min_gain):
+        hist = hist_fn(binned_t, base_t, w_tree, pos)  # (T, LN, d, B, S)
+        agg = hist[:, :, 0, :, :].sum(axis=2)          # (T, LN, S)
+
+        cum = jnp.cumsum(hist, axis=3)
+        total = cum[:, :, :, -1:, :]
+        if task == "regression":
+            wl, sl, ql = cum[..., 0], cum[..., 1], cum[..., 2]
+            wt, st, qt = total[..., 0], total[..., 1], total[..., 2]
+            wr, sr, qr = wt - wl, st - sl, qt - ql
+
+            def sse(w, s, q):
+                return jnp.where(w > 0, q - s * s / jnp.maximum(w, 1e-12), 0.0)
+
+            gain = sse(wt, st, qt) - sse(wl, sl, ql) - sse(wr, sr, qr)
+            node_w = agg[..., 0]
+        else:
+            left, right = cum, total - cum
+            wl, wr = left.sum(-1), right.sum(-1)
+            wt = total.sum(-1)
+
+            def gini(counts, w):
+                return jnp.where(
+                    w > 0,
+                    w - (counts * counts).sum(-1) / jnp.maximum(w, 1e-12),
+                    0.0,
+                )
+
+            gain = gini(total, wt) - gini(left, wl) - gini(right, wr)
+            node_w = agg.sum(-1)
+
+        valid = (wl >= min_inst) & (wr >= min_inst)
+        gain = jnp.where(valid & (feat_mask[..., None] > 0), gain, neg_inf)
+        # last bin: empty right child by construction
+        gain = gain.at[..., -1].set(neg_inf)
+
+        flat = gain.reshape(T, level_nodes, d * B)
+        best = jnp.argmax(flat, axis=2)
+        best_gain = jnp.take_along_axis(flat, best[..., None], axis=2)[..., 0]
+        do_split = (
+            jnp.isfinite(best_gain)
+            & (best_gain > min_gain)
+            & (node_w >= 2.0 * min_inst)
+        )
+        return (
+            agg,
+            best_gain,
+            (best // B).astype(jnp.int32),
+            (best % B).astype(jnp.int32),
+            do_split,
+        )
+
+    return jax.jit(step)
+
+
+#: _advance_level unrolls a select chain over the level frontier; past this
+#: width fall back to a (small-table) gather to bound HLO size.
+_ADVANCE_UNROLL_MAX = 64
+
+
 @jax.jit
-def _advance_rows(binned_t, node_id, split_feat, split_bin):
-    """Move every active row to its child heap slot.
+def _advance_level(binned_t, node_id, pos, feat, bin_, do_split, level_base):
+    """Move rows on the current frontier to their child heap slots.
 
     binned_t: (d, n) int32 (row axis last — see _make_level_hist)
-    node_id: (T, n) current heap ids (-1 = parked on a leaf)
-    split_feat/split_bin: (T, total_nodes) — feat -1 marks a leaf node.
+    node_id:  (T, n) current heap ids (-1 = parked on a leaf)
+    pos:      (T, n) frontier position (-1 = not on this level)
+    feat/bin_/do_split: (T, LN) this level's device-selected splits
     go right ⇔ bin > split_bin[node].
+
+    Lookups are unrolled select chains, not gathers — a (d, n) gather with
+    per-element indices measured ~1.2 s/level at BASELINE scale on TPU,
+    and even 63-entry table gathers measured ~0.9 s; select lanes are pure
+    vectorized VPU work (~ms).  Consumes the level step's *device* outputs,
+    so the level chain never syncs with the host.
     """
-    n = binned_t.shape[1]
-    rows = jnp.arange(n)
+    d = binned_t.shape[0]
+    LN = feat.shape[1]
+    feat_eff = jnp.where(do_split, feat, -1)            # (T, LN)
 
-    def per_tree(nid, sf, sb):
-        active = nid >= 0
-        safe = jnp.where(active, nid, 0)
-        f = sf[safe]
-        is_split = f >= 0
-        fb = binned_t[jnp.maximum(f, 0), rows]
-        right = (fb > sb[safe]).astype(jnp.int32)
-        child = 2 * safe + 1 + right
-        return jnp.where(active & is_split, child, jnp.where(active, -1, nid))
+    f = jnp.full_like(node_id, -1)
+    b = jnp.zeros_like(node_id)
+    if LN <= _ADVANCE_UNROLL_MAX:
+        for p in range(LN):
+            sel = pos == p
+            f = jnp.where(sel, feat_eff[:, p][:, None], f)
+            b = jnp.where(sel, bin_[:, p][:, None], b)
+    else:
+        safe = jnp.where(pos >= 0, pos, 0)
+        f = jnp.where(
+            pos >= 0, jnp.take_along_axis(feat_eff, safe, axis=1), f
+        )
+        b = jnp.where(pos >= 0, jnp.take_along_axis(bin_, safe, axis=1), b)
 
-    return jax.vmap(per_tree, in_axes=(0, 0, 0))(node_id, split_feat, split_bin)
-
-
-# ----------------------------------------------------------- split selection
-def _best_splits_regression(hist: np.ndarray, min_instances: int):
-    """hist: (T, nodes, d, B, 3) with stats (w, wy, wy²).
-    Returns per (T, node): gain, feat, bin, plus child/parent aggregates."""
-    cum = hist.cumsum(axis=3)                       # prefix over bins
-    total = cum[:, :, :, -1:, :]                    # (T,nodes,d,1,3)
-    wl, sl, ql = cum[..., 0], cum[..., 1], cum[..., 2]
-    wt, st, qt = total[..., 0], total[..., 1], total[..., 2]
-    wr, sr, qr = wt - wl, st - sl, qt - ql
-
-    def sse(w, s, q):
-        with np.errstate(divide="ignore", invalid="ignore"):
-            return np.where(w > 0, q - s * s / np.maximum(w, 1e-12), 0.0)
-
-    gain = sse(wt, st, qt) - sse(wl, sl, ql) - sse(wr, sr, qr)  # (T,nodes,d,B)
-    valid = (wl >= min_instances) & (wr >= min_instances)
-    gain = np.where(valid, gain, -np.inf)
-    gain[..., -1] = -np.inf  # last bin: empty right child by construction
-    return gain
+    is_split = f >= 0
+    if d <= _ADVANCE_UNROLL_MAX:
+        fb = jnp.zeros_like(node_id)
+        for fi in range(d):                              # static unroll
+            fb = jnp.where(f == fi, binned_t[fi][None, :], fb)
+    else:
+        # wide feature sets: bounded-HLO gather beats a d-stage select chain
+        n = binned_t.shape[1]
+        fb = binned_t[jnp.maximum(f, 0), jnp.arange(n)[None, :]]
+    right = (fb > b).astype(jnp.int32)
+    child = 2 * (level_base + pos) + 1 + right
+    active = pos >= 0
+    return jnp.where(active & is_split, child, jnp.where(active, -1, node_id))
 
 
-def _best_splits_classification(hist: np.ndarray, min_instances: int):
-    """hist: (T, nodes, d, B, C) per-class weighted counts. Gini gain."""
-    cum = hist.cumsum(axis=3)
-    total = cum[:, :, :, -1:, :]
-    left, right = cum, total - cum
-    wl = left.sum(-1)
-    wr = right.sum(-1)
-    wt = total.sum(-1)
+@lru_cache(maxsize=16)
+def _make_bootstrap(mesh: Mesh, T: int, n_pad: int, rate: float):
+    """jit'd device-side Poisson bootstrap draw, sharded over the data axis.
 
-    def gini(counts, w):
-        with np.errstate(divide="ignore", invalid="ignore"):
-            return np.where(
-                w > 0, w - (counts * counts).sum(-1) / np.maximum(w, 1e-12), 0.0
-            )
+    Host numpy Poisson + transfer measured 2.4 s + 5.9 s for (20, 2M)
+    weights on a tunneled chip; on-device generation is milliseconds and
+    moves nothing.
+    """
+    from jax.sharding import NamedSharding
 
-    gain = gini(total, wt) - gini(left, wl) - gini(right, wr)
-    valid = (wl >= min_instances) & (wr >= min_instances)
-    gain = np.where(valid, gain, -np.inf)
-    gain[..., -1] = -np.inf
-    return gain
+    def draw(seed):
+        key = jax.random.key(seed)
+        return jax.random.poisson(key, rate, shape=(T, n_pad)).astype(jnp.float32)
+
+    return jax.jit(
+        draw, out_shardings=NamedSharding(mesh, P(None, DATA_AXIS))
+    )
 
 
 # ------------------------------------------------------------------- output
@@ -216,12 +343,13 @@ def grow_forest(
     # axes would tile-pad to 128 lanes in HBM (see _make_level_hist)
     binned_t = digitize(ds.x.astype(jnp.float32), jnp.asarray(thr, jnp.float32)).T
 
-    # 2. per-tree row weights: validity × (Poisson bootstrap | 1)
+    # 2. per-tree row weights: validity × (Poisson bootstrap | 1), drawn
+    # on device (host draws + the (T, n) transfer dwarf the training time)
     if bootstrap:
-        boot = rng.poisson(subsampling_rate, size=(T, n_pad)).astype(np.float32)
+        boot = _make_bootstrap(mesh, T, n_pad, float(subsampling_rate))(seed)
+        w_tree = boot * ds.w[None, :].astype(jnp.float32)
     else:
-        boot = np.ones((T, n_pad), dtype=np.float32)
-    w_tree = jnp.asarray(boot) * ds.w[None, :].astype(jnp.float32)
+        w_tree = jnp.broadcast_to(ds.w.astype(jnp.float32)[None, :], (T, n_pad))
 
     # 3. per-row base stat vectors (S, n); per-tree weighting happens
     # inside the histogram kernel
@@ -243,50 +371,56 @@ def grow_forest(
 
     node_id = jnp.zeros((T, n_pad), jnp.int32)  # all rows start at the root
 
+    # Dispatch the whole level chain to the device without a single host
+    # sync: the level step selects splits on device, _advance_level consumes
+    # its device outputs directly, and the (tiny) per-level winner tensors
+    # are fetched once at the end.  Per-level blocking device_gets measured
+    # ~70 ms each on tunneled chips — 6 levels of them cost more than the
+    # histograms themselves.
+    min_inst = jnp.float32(min_instances_per_node)
+    min_gain = jnp.float32(min_info_gain)
+    level_out = []
     for depth in range(max_depth + 1):
         level_nodes = 1 << depth
         level_base = level_nodes - 1
         pos = jnp.where(node_id >= 0, node_id - level_base, -1)
         pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
-        hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T)
-        hist = np.asarray(
-            jax.device_get(hist_fn(binned_t, base_t, w_tree, pos)), dtype=np.float64
-        )
-        # (T, level_nodes, d, B, S)
 
-        # record node aggregates (same for every feature; use feature 0)
-        agg = hist[:, :, 0, :, :].sum(axis=2)  # (T, level_nodes, S)
-        node_stats[:, level_base : level_base + level_nodes] = agg
-
-        if depth == max_depth:
-            break  # leaves at the depth cap
-
-        if task == "regression":
-            gain = _best_splits_regression(hist, min_instances_per_node)
-        else:
-            gain = _best_splits_classification(hist, min_instances_per_node)
-
-        # per-(tree, node) feature subset (host-side mask, Spark's
-        # featureSubsetStrategy applied at split-selection time)
+        # per-(tree, node) feature subset (host-drawn mask, Spark's
+        # featureSubsetStrategy, applied at split-selection time on device)
         if feature_subset_size is not None and feature_subset_size < d:
-            mask = np.zeros((T, level_nodes, d), dtype=bool)
+            mask_np = np.zeros((T, level_nodes, d), dtype=np.float32)
             for t in range(T):
                 for p in range(level_nodes):
-                    mask[t, p, rng.choice(d, feature_subset_size, replace=False)] = True
-            gain = np.where(mask[..., None], gain, -np.inf)
+                    mask_np[t, p, rng.choice(d, feature_subset_size, replace=False)] = 1.0
+            mask = jnp.asarray(mask_np)
+        else:
+            mask = jnp.ones((T, level_nodes, d), jnp.float32)
 
-        flat = gain.reshape(T, level_nodes, d * B)
-        best = flat.argmax(axis=2)
-        best_gain = np.take_along_axis(flat, best[..., None], axis=2)[..., 0]
-        best_feat = (best // B).astype(np.int32)
-        best_bin = (best % B).astype(np.int32)
-
-        node_w = agg.sum(-1) if task == "classification" else agg[..., 0]
-        do_split = (
-            np.isfinite(best_gain)
-            & (best_gain > min_info_gain)
-            & (node_w >= 2 * min_instances_per_node)
+        step_fn = _make_level_step(mesh, level_nodes, d, B, S, T, task)
+        agg_d, gain_d, feat_d, bin_d, split_d = step_fn(
+            binned_t, base_t, w_tree, pos, mask, min_inst, min_gain
         )
+        level_out.append((agg_d, gain_d, feat_d, bin_d, split_d))
+        if depth < max_depth:
+            node_id = _advance_level(
+                binned_t, node_id, pos, feat_d, bin_d, split_d, level_base
+            )
+
+    # one host fetch for every level's winners
+    for depth, fetched in enumerate(jax.device_get(level_out)):
+        agg, best_gain, best_feat, best_bin, do_split = (
+            np.asarray(fetched[0], np.float64),
+            np.asarray(fetched[1], np.float64),
+            np.asarray(fetched[2], np.int32),
+            np.asarray(fetched[3], np.int32),
+            np.asarray(fetched[4], bool),
+        )
+        level_nodes = 1 << depth
+        level_base = level_nodes - 1
+        node_stats[:, level_base : level_base + level_nodes] = agg
+        if depth == max_depth:
+            break
         sl = slice(level_base, level_base + level_nodes)
         split_feat[:, sl] = np.where(do_split, best_feat, -1)
         split_bin[:, sl] = np.where(do_split, best_bin, 0)
@@ -296,12 +430,6 @@ def grow_forest(
                 best_feat[t][do_split[t]],
                 best_gain[t][do_split[t]],
             )
-
-        if not do_split.any():
-            break
-        node_id = _advance_rows(
-            binned_t, node_id, jnp.asarray(split_feat), jnp.asarray(split_bin)
-        )
 
     # 4. leaf/threshold materialization
     threshold = np.zeros((T, total_nodes), dtype=np.float32)
