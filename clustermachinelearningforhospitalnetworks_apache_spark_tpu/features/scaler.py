@@ -19,6 +19,15 @@ import numpy as np
 from ..parallel.sharding import DeviceDataset
 
 
+def _is_assembled(data) -> bool:
+    """True for AssembledTable.  A bare ``hasattr(data, "to_device")``
+    misfires on numpy≥2 ndarrays, whose array-API ``to_device`` method
+    takes a device argument."""
+    from .assembler import AssembledTable
+
+    return isinstance(data, AssembledTable)
+
+
 @jax.jit
 def _moments(x: jax.Array, w: jax.Array):
     wcol = w[:, None]
@@ -61,7 +70,7 @@ class StandardScaler:
 
     def fit(self, data) -> StandardScalerModel:
         """``data``: DeviceDataset (sharded), AssembledTable, or ndarray."""
-        if hasattr(data, "to_device"):  # AssembledTable
+        if _is_assembled(data):
             data = data.to_device()
         if isinstance(data, DeviceDataset):
             mean, std, _ = _moments(data.x, data.w)
@@ -77,7 +86,7 @@ class StandardScaler:
         AssembledTable) comes back as a DeviceDataset with the feature
         matrix scaled and labels/weights carried through; an ndarray comes
         back as an ndarray."""
-        if hasattr(data, "to_device"):
+        if _is_assembled(data):
             data = data.to_device()
         model = self.fit(data)
         if isinstance(data, DeviceDataset):

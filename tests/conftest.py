@@ -25,9 +25,12 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
-import sys
+try:  # installed copy (pip install -e .) takes precedence
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu  # noqa: F401
+except ImportError:  # running from a raw checkout
+    import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
