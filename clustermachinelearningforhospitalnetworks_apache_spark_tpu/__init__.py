@@ -50,6 +50,7 @@ from .models import (
     KMeans,
     LinearRegression,
     LogisticRegression,
+    MultinomialLogisticRegressionModel,
     RandomForestClassifier,
     RandomForestRegressor,
     StreamingKMeans,
@@ -96,6 +97,7 @@ __all__ = [
     "KMeans",
     "LinearRegression",
     "LogisticRegression",
+    "MultinomialLogisticRegressionModel",
     "RandomForestClassifier",
     "RandomForestRegressor",
     "StreamingKMeans",

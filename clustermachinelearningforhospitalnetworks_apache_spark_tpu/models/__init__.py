@@ -1,6 +1,10 @@
 from .base import Estimator, Model, PredictionResult, as_device_dataset
 from .linear_regression import LinearRegression, LinearRegressionModel
-from .logistic_regression import LogisticRegression, LogisticRegressionModel
+from .logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+    MultinomialLogisticRegressionModel,
+)
 from .kmeans import KMeans, KMeansModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
@@ -23,6 +27,7 @@ __all__ = [
     "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "MultinomialLogisticRegressionModel",
     "KMeans",
     "KMeansModel",
     "GaussianMixture",

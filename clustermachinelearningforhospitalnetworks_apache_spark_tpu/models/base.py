@@ -89,6 +89,41 @@ class Model:
         raise NotImplementedError
 
 
+class ClusteringModel(Model):
+    """Model base for the clustering family, adding Spark's DataFrame-style
+    ``transform``: an :class:`AssembledTable` comes back as its source
+    :class:`Table` with a ``prediction`` column appended (and, for
+    probabilistic models, a ``probability`` column holding the assigned
+    component's posterior — Spark's ``probability`` is the full K-vector,
+    which a columnar table carries via :meth:`predict_proba` instead).
+    This is the composition pattern the reference applies to supervised
+    models (``model.transform(test_data)``, ``mllearnforhospitalnetwork
+    .py:148,157``), extended to the clustering estimators so they plug
+    into the same Table pipeline.
+
+    Non-table inputs keep the base behavior (sharded
+    :class:`PredictionResult`)."""
+
+    def transform(self, data: Any, label_col: str | None = None, mesh=None):
+        if isinstance(data, AssembledTable):
+            n = len(data)
+            ds = as_device_dataset(data.features, mesh=mesh)
+            if hasattr(self, "predict_proba"):
+                # one posterior pass, argmax + assigned-component gather on
+                # device — only two length-n vectors cross to host
+                p = self.predict_proba(ds.x)
+                pred_d = jnp.argmax(p, axis=1)
+                assigned = jnp.take_along_axis(p, pred_d[:, None], axis=1)[:, 0]
+                pred = np.asarray(unpad(pred_d, n)).astype(np.int32)
+                out = data.table.with_column("prediction", pred, dtype="int")
+                return out.with_column(
+                    "probability", np.asarray(unpad(assigned, n)), dtype="float"
+                )
+            pred = np.asarray(unpad(self.predict(ds.x), n)).astype(np.int32)
+            return data.table.with_column("prediction", pred, dtype="int")
+        return super().transform(data, label_col=label_col, mesh=mesh)
+
+
 @dataclass
 class _Writer:
     model: Model

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..io.model_io import register_model
 from ..parallel.mesh import DATA_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
-from .base import Estimator, Model, as_device_dataset
+from .base import ClusteringModel, Estimator, Model, as_device_dataset
 from .kmeans import _chunked, _kmeans_pp_init, _lloyd_refine
 
 
@@ -170,7 +170,7 @@ def _make_em_loop(
 
 @register_model("GaussianMixtureModel")
 @dataclass
-class GaussianMixtureModel(Model):
+class GaussianMixtureModel(ClusteringModel):
     weights: np.ndarray      # (k,)
     means: np.ndarray        # (k, d)
     covariances: np.ndarray  # (k, d, d)

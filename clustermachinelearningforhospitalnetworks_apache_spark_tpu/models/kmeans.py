@@ -41,7 +41,7 @@ from ..io.model_io import register_model
 from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
-from .base import Estimator, Model, as_device_dataset
+from .base import ClusteringModel, Estimator, Model, as_device_dataset
 
 _BIG = jnp.float32(1e30)
 
@@ -294,7 +294,7 @@ def _predict_fn(x, centers):
 
 @register_model("KMeansModel")
 @dataclass
-class KMeansModel(Model):
+class KMeansModel(ClusteringModel):
     cluster_centers: np.ndarray          # (k, d)
     distance_measure: str = "euclidean"
     training_cost: float = 0.0           # final inertia (Spark summary.trainingCost)

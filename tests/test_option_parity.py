@@ -1,0 +1,202 @@
+"""Spark-MLlib estimator *option* parity (VERDICT round-1 gaps):
+
+- LinearRegression ``elasticNetParam`` (L1/elastic-net via FISTA on the
+  sharded Gram) vs sklearn's coordinate-descent ElasticNet/Lasso,
+- LogisticRegression ``family="multinomial"`` (softmax Newton) vs sklearn,
+- DataFrame-style ``transform`` on clustering models (prediction /
+  probability columns on the Table pipeline, reference pattern
+  ``mllearnforhospitalnetwork.py:148,157``).
+"""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def _reg_data(rng, n=2000, d=6):
+    x = rng.normal(size=(n, d))
+    beta = np.array([3.0, -2.0, 0.0, 0.0, 1.5, 0.0])
+    y = x @ beta + 0.3 * rng.normal(size=n) + 1.0
+    return x.astype(np.float32), y.astype(np.float32), beta
+
+
+# --- elastic net -------------------------------------------------------
+
+
+def test_lasso_matches_sklearn(rng, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y, _ = _reg_data(rng)
+    lam = 0.1
+    ours = ht.LinearRegression(
+        reg_param=lam, elastic_net_param=1.0, standardize=False, tol=1e-8,
+        max_iter=2000,
+    ).fit((x, y), mesh=mesh8)
+    ref = sk.Lasso(alpha=lam, tol=1e-10, max_iter=50000).fit(x, y)
+    np.testing.assert_allclose(
+        np.asarray(ours.coefficients), ref.coef_, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        float(ours.intercept), ref.intercept_, atol=2e-3
+    )
+    # the true-zero coefficients are driven to exactly zero
+    assert np.all(np.asarray(ours.coefficients)[[2, 3, 5]] == 0.0)
+
+
+def test_elastic_net_matches_sklearn(rng, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y, _ = _reg_data(rng)
+    lam, alpha = 0.2, 0.5
+    ours = ht.LinearRegression(
+        reg_param=lam, elastic_net_param=alpha, standardize=False, tol=1e-8,
+        max_iter=2000,
+    ).fit((x, y), mesh=mesh8)
+    ref = sk.ElasticNet(alpha=lam, l1_ratio=alpha, tol=1e-10, max_iter=50000).fit(x, y)
+    np.testing.assert_allclose(np.asarray(ours.coefficients), ref.coef_, atol=2e-3)
+    np.testing.assert_allclose(float(ours.intercept), ref.intercept_, atol=2e-3)
+
+
+def test_elastic_net_zero_alpha_is_ridge(rng, mesh8):
+    """elasticNetParam=0 keeps the closed-form ridge path byte-compatible."""
+    x, y, _ = _reg_data(rng)
+    a = ht.LinearRegression(reg_param=0.3).fit((x, y), mesh=mesh8)
+    b = ht.LinearRegression(reg_param=0.3, elastic_net_param=0.0).fit((x, y), mesh=mesh8)
+    np.testing.assert_array_equal(
+        np.asarray(a.coefficients), np.asarray(b.coefficients)
+    )
+
+
+def test_elastic_net_standardized_penalty(rng, mesh8):
+    """standardize=True penalizes scaled coefficients (Spark semantics):
+    a feature on a 100x scale keeps a 100x-smaller coefficient, which pure
+    raw-scale L1 would kill entirely."""
+    n = 4000
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    x[:, 1] /= 100.0                      # same signal, tiny scale
+    y = (x[:, 0] + 100.0 * x[:, 1] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    m = ht.LinearRegression(
+        reg_param=0.05, elastic_net_param=1.0, standardize=True, max_iter=3000
+    ).fit((x, y), mesh=mesh8)
+    c = np.asarray(m.coefficients)
+    assert c[1] > 10.0 * c[0] > 0.0       # both survive, scale-adjusted
+
+
+# --- multinomial logistic regression -----------------------------------
+
+
+def _cls_data(rng, n=3000, d=4, k=3):
+    centers = rng.normal(scale=2.0, size=(k, d))
+    y = rng.integers(0, k, n)
+    x = centers[y] + rng.normal(size=(n, d))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_multinomial_matches_sklearn(rng, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y, = _cls_data(rng)
+    ours = ht.LogisticRegression(family="multinomial", tol=1e-8).fit((x, y), mesh=mesh8)
+    assert isinstance(ours, ht.MultinomialLogisticRegressionModel)
+    ref = sk.LogisticRegression(penalty=None, tol=1e-10, max_iter=2000).fit(x, y.astype(int))
+    p_ours = np.asarray(ours.predict_proba(x))
+    p_ref = ref.predict_proba(x)
+    np.testing.assert_allclose(p_ours, p_ref, atol=2e-3)
+    assert (np.asarray(ours.predict(x)) == ref.predict(x)).mean() > 0.999
+
+
+def test_family_auto_dispatch(rng, mesh8):
+    x, y = _cls_data(rng, k=3)
+    m3 = ht.LogisticRegression(family="auto").fit((x, y), mesh=mesh8)
+    assert isinstance(m3, ht.MultinomialLogisticRegressionModel)
+    assert m3.num_classes == 3
+    xb, yb = _cls_data(rng, k=2)
+    m2 = ht.LogisticRegression(family="auto").fit((xb, yb), mesh=mesh8)
+    assert not isinstance(m2, ht.MultinomialLogisticRegressionModel)
+    with pytest.raises(ValueError, match="family"):
+        ht.LogisticRegression(family="ovr").fit((x, y), mesh=mesh8)
+    # Spark parity: binomial on >2 classes raises instead of fitting garbage
+    with pytest.raises(ValueError, match="binomial"):
+        ht.LogisticRegression(family="binomial").fit((x, y), mesh=mesh8)
+
+
+def test_multinomial_regularized_and_weighted(rng, mesh8):
+    """L2'd multinomial still separates; sharded fit == single-device fit."""
+    x, y = _cls_data(rng)
+    a = ht.LogisticRegression(family="multinomial", reg_param=0.01).fit(
+        (x, y), mesh=mesh8
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (
+        single_device_mesh,
+    )
+
+    b = ht.LogisticRegression(family="multinomial", reg_param=0.01).fit(
+        (x, y), mesh=single_device_mesh()
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.coefficient_matrix), np.asarray(b.coefficient_matrix), atol=1e-4
+    )
+    assert (np.asarray(a.predict(x)) == y).mean() > 0.9
+
+
+def test_multinomial_save_load(tmp_path, rng, mesh8):
+    x, y = _cls_data(rng)
+    m = ht.LogisticRegression(family="multinomial").fit((x, y), mesh=mesh8)
+    m.write().overwrite().save(str(tmp_path / "mlr"))
+    m2 = ht.load_model(str(tmp_path / "mlr"))
+    np.testing.assert_allclose(
+        np.asarray(m.predict_proba(x[:64])), np.asarray(m2.predict_proba(x[:64])),
+        atol=1e-6,
+    )
+
+
+# --- clustering Table transform ----------------------------------------
+
+
+def _clustered_table(rng, n=600):
+    centers = np.array([[0.0, 0.0], [8.0, 8.0], [0.0, 8.0]])
+    a = rng.integers(0, 3, n)
+    x = centers[a] + rng.normal(scale=0.5, size=(n, 2))
+    tab = ht.Table.from_dict(
+        {"f0": x[:, 0], "f1": x[:, 1]},
+        ht.Schema([("f0", "float"), ("f1", "float")]),
+    )
+    return tab, x.astype(np.float32)
+
+
+def test_kmeans_table_transform(rng, mesh8):
+    tab, x = _clustered_table(rng)
+    asm = ht.VectorAssembler(["f0", "f1"]).transform(tab)
+    km = ht.KMeans(k=3, seed=0).fit(asm.features, mesh=mesh8)
+    out = km.transform(asm)
+    assert isinstance(out, ht.Table)
+    assert "prediction" in out.schema
+    assert out.num_rows == tab.num_rows
+    np.testing.assert_array_equal(
+        out["prediction"], np.asarray(km.predict_numpy(x)).astype(np.int32)
+    )
+    # non-table input keeps the sharded PredictionResult contract
+    res = km.transform((x, np.zeros(len(x), np.float32)), mesh=mesh8)
+    assert hasattr(res, "prediction") and hasattr(res, "weight")
+
+
+def test_gmm_table_transform_probability(rng, mesh8):
+    tab, x = _clustered_table(rng)
+    asm = ht.VectorAssembler(["f0", "f1"]).transform(tab)
+    gm = ht.GaussianMixture(k=3, seed=0, max_iter=50).fit(asm.features, mesh=mesh8)
+    out = gm.transform(asm)
+    assert "prediction" in out.schema and "probability" in out.schema
+    p = out["probability"]
+    assert np.all((p >= 0.0) & (p <= 1.0 + 1e-6))
+    # well-separated blobs: assigned-component posterior is near 1
+    assert np.median(p) > 0.99
+
+
+def test_bisecting_streaming_table_transform(rng, mesh8):
+    tab, x = _clustered_table(rng)
+    asm = ht.VectorAssembler(["f0", "f1"]).transform(tab)
+    bk = ht.BisectingKMeans(k=3, seed=0).fit(asm.features, mesh=mesh8)
+    out = bk.transform(asm)
+    assert "prediction" in out.schema and out.num_rows == tab.num_rows
+    sk = ht.StreamingKMeans(k=3, seed=0, half_life=5.0)
+    sk.update(x, mesh=mesh8)
+    out2 = sk.latest_model.transform(asm)
+    assert "prediction" in out2.schema
