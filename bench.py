@@ -157,9 +157,46 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     cpu_n = min(n, 400_000)
     cpu_thr = max(_cpu_lloyd_throughput(x[:cpu_n], k) for _ in range(2))
 
+    # Silhouette on the full table, computed on the mesh (BASELINE's
+    # "silhouette parity" metric) — assignments and the two-pass reduction
+    # stay device-resident; nothing of size n crosses to host and no
+    # (n, k) distance matrix lands in HBM (chunked shard_map assign).
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.evaluation.clustering import (
+        ClusteringEvaluator,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.ops.distance import (
+        assign_clusters,
+    )
+    from jax import lax
+
+    cen_live = jax.device_put(
+        np.asarray(jax.device_get(centers))[:k], NamedSharding(mesh, P())
+    )
+
+    def _assign_shard(xs, cen):
+        n_loc = xs.shape[0]
+        c = min(65536, max(n_loc, 1))
+        pad = (-n_loc) % c
+        if pad:
+            xs = jax.numpy.pad(xs, ((0, pad), (0, 0)))
+        out = lax.map(
+            lambda xc: assign_clusters(xc, cen)[0],
+            xs.reshape(-1, c, xs.shape[1]),
+        )
+        return out.reshape(-1)[:n_loc]
+
+    assign = jax.jit(
+        jax.shard_map(
+            _assign_shard, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P()), out_specs=P(DATA_AXIS),
+        )
+    )(ds.x, cen_live)
+    sil = ClusteringEvaluator().evaluate(ds, assign, k=k)
+
     src = "bundled-CSV, " if bundled else ""
     return {
-        "metric": f"KMeans k={k} Lloyd records/sec/chip ({src}{n} rows, d={d}, {platform})",
+        "metric": f"KMeans k={k} Lloyd records/sec/chip "
+                  f"({src}{n} rows, d={d}, {platform}, silhouette={sil:.3f})",
         "value": round(per_chip, 1),
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),

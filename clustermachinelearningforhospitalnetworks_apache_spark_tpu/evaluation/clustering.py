@@ -1,13 +1,20 @@
-"""ClusteringEvaluator — silhouette score.
+"""ClusteringEvaluator — silhouette score, mesh-resident.
 
 The BASELINE north star requires "silhouette-score parity vs Spark-CPU"
 (BASELINE.json).  Spark's ``ClusteringEvaluator`` computes the
 **squared-Euclidean silhouette** in O(n·k) using per-cluster sufficient
-statistics (no O(n²) pairwise matrix); the same formulation is used here as
-one jit'd pass over the sharded rows:
+statistics (no O(n²) pairwise matrix); the same formulation runs here as a
+two-pass ``shard_map`` over the row-sharded dataset:
 
     Σ_{q∈C} ||p-q||² = N_C·||p||² − 2·p·Y_C + Ψ_C,
     with Y_C = Σ_{q∈C} q  and  Ψ_C = Σ_{q∈C} ||q||².
+
+Pass 1 accumulates (N_C, Y_C, Ψ_C) per shard in row chunks and ``psum``s
+them; pass 2 scores rows chunk-by-chunk against the global stats — so the
+evaluator accepts the sharded :class:`DeviceDataset` the model was fit on
+and never materializes an (n, k) tensor in HBM nor gathers features to the
+host (the round-1 version round-tripped the whole dataset through
+``np.asarray``).
 
 a(p) divides by N_C−1 (self excluded), b(p) is the min over other
 clusters dividing by N_C, s(p) = (b−a)/max(a,b); singleton clusters score 0
@@ -17,55 +24,158 @@ clusters dividing by N_C, s(p) = (b−a)/max(a,b); singleton clusters score 0
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, default_mesh
+from ..parallel.sharding import DeviceDataset, device_dataset, shard_rows
+
+#: rows per scan step — bounds the (chunk, k) distance tile in VMEM/HBM
+_SIL_CHUNK = 8192
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _silhouette_sums(x: jax.Array, assign: jax.Array, w: jax.Array, k: int):
-    wcol = w[:, None]
-    onehot = jax.nn.one_hot(assign, k, dtype=x.dtype) * wcol      # (n, k)
-    counts = jnp.sum(onehot, axis=0)                               # N_C
-    y = onehot.T @ x                                               # (k, d) Y_C
-    sq = jnp.sum(x * x, axis=1)                                    # ||p||²
-    psi = onehot.T @ sq                                            # Ψ_C
+@lru_cache(maxsize=32)
+def _make_silhouette(mesh: Mesh, k: int, chunk: int):
+    """jit'd sharded two-pass silhouette: (x, assign, w) → (Σ s·w, Σ w)."""
 
-    # total squared distance from each point to every member of each cluster
-    tot = counts[None, :] * sq[:, None] - 2.0 * (x @ y.T) + psi[None, :]  # (n, k)
-    tot = jnp.maximum(tot, 0.0)
+    def shard_fn(x, assign, w):
+        n_loc = x.shape[0]
+        c = min(chunk, max(n_loc, 1))
+        pad = (-n_loc) % c
+        if pad:
+            x = jnp.pad(x, ((0, pad), (0, 0)))
+            assign = jnp.pad(assign, (0, pad))
+            w = jnp.pad(w, (0, pad))          # pad rows carry w=0 → inert
+        nchunks = (n_loc + pad) // c
 
-    own = jax.nn.one_hot(assign, k, dtype=bool)
-    n_own = jnp.sum(jnp.where(own, counts[None, :], 0.0), axis=1)
-    a = jnp.sum(jnp.where(own, tot, 0.0), axis=1) / jnp.maximum(n_own - 1.0, 1.0)
-    b = jnp.min(
-        jnp.where(own | (counts[None, :] == 0), jnp.inf, tot / jnp.maximum(counts[None, :], 1.0)),
-        axis=1,
+        def slices(i):
+            sl = i * c
+            return (
+                lax.dynamic_slice_in_dim(x, sl, c, axis=0),
+                lax.dynamic_slice_in_dim(assign, sl, c, axis=0),
+                lax.dynamic_slice_in_dim(w, sl, c, axis=0),
+            )
+
+        # ---- pass 1: per-cluster sufficient statistics ----
+        def p1(carry, i):
+            counts, y, psi = carry
+            xc, ac, wc = slices(i)
+            oh = jax.nn.one_hot(ac, k, dtype=x.dtype) * wc[:, None]   # (c, k)
+            return (
+                counts + jnp.sum(oh, axis=0),
+                y + oh.T @ xc,
+                psi + oh.T @ jnp.sum(xc * xc, axis=1),
+            ), None
+
+        init1 = lax.pcast(
+            (
+                jnp.zeros((k,), x.dtype),
+                jnp.zeros((k, x.shape[1]), x.dtype),
+                jnp.zeros((k,), x.dtype),
+            ),
+            (DATA_AXIS,),
+            to="varying",
+        )
+        (counts, y, psi), _ = lax.scan(p1, init1, jnp.arange(nchunks))
+        counts = lax.psum(counts, DATA_AXIS)
+        y = lax.psum(y, DATA_AXIS)
+        psi = lax.psum(psi, DATA_AXIS)
+
+        # ---- pass 2: score rows against the global stats ----
+        def p2(carry, i):
+            s_sum, w_sum = carry
+            xc, ac, wc = slices(i)
+            sq = jnp.sum(xc * xc, axis=1)
+            tot = counts[None, :] * sq[:, None] - 2.0 * (xc @ y.T) + psi[None, :]
+            tot = jnp.maximum(tot, 0.0)                                # (c, k)
+            own = jax.nn.one_hot(ac, k, dtype=bool)
+            n_own = jnp.sum(jnp.where(own, counts[None, :], 0.0), axis=1)
+            a = jnp.sum(jnp.where(own, tot, 0.0), axis=1) / jnp.maximum(
+                n_own - 1.0, 1.0
+            )
+            b = jnp.min(
+                jnp.where(
+                    own | (counts[None, :] == 0),
+                    jnp.inf,
+                    tot / jnp.maximum(counts[None, :], 1.0),
+                ),
+                axis=1,
+            )
+            s = jnp.where(
+                n_own > 1.0, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0
+            )
+            s = jnp.where(jnp.isfinite(s), s, 0.0)
+            return (s_sum + jnp.sum(s * wc), w_sum + jnp.sum(wc)), None
+
+        init2 = lax.pcast(
+            (jnp.zeros((), x.dtype), jnp.zeros((), x.dtype)),
+            (DATA_AXIS,),
+            to="varying",
+        )
+        (s_sum, w_sum), _ = lax.scan(p2, init2, jnp.arange(nchunks))
+        return lax.psum(s_sum, DATA_AXIS), lax.psum(w_sum, DATA_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P()),
+        )
     )
-    s = jnp.where(n_own > 1.0, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
-    s = jnp.where(jnp.isfinite(s), s, 0.0)
-    return jnp.sum(s * w), jnp.sum(w)
 
 
 @dataclass(frozen=True)
 class ClusteringEvaluator:
     """metricName="silhouette", distanceMeasure="squaredEuclidean" (Spark's
-    default evaluator configuration)."""
+    default evaluator configuration).
+
+    ``evaluate`` accepts the sharded :class:`DeviceDataset` a model was fit
+    on (with device-resident assignments from ``model.predict``) or plain
+    host arrays; either way the reduction runs on the mesh.
+    """
 
     metric_name: str = "silhouette"
 
-    def evaluate(self, features, assignments, k: int | None = None, weights=None) -> float:
-        x = jnp.asarray(np.asarray(features), jnp.float32)
-        assign = jnp.asarray(np.asarray(assignments), jnp.int32)
-        w = (
-            jnp.asarray(np.asarray(weights), jnp.float32)
-            if weights is not None
-            else jnp.ones((x.shape[0],), jnp.float32)
+    def evaluate(
+        self, features, assignments, k: int | None = None, weights=None, mesh=None
+    ) -> float:
+        if isinstance(features, DeviceDataset):
+            ds = features
+            m = getattr(ds.x.sharding, "mesh", None) or mesh or default_mesh()
+        else:
+            m = mesh or default_mesh()
+            ds = device_dataset(np.asarray(features), mesh=m)
+        n_pad = ds.n_padded
+
+        if isinstance(assignments, jax.Array) and assignments.shape[0] == n_pad:
+            assign = assignments.astype(jnp.int32)
+        else:
+            a_host = np.asarray(assignments).astype(np.int32).reshape(-1)
+            ap = np.zeros((n_pad,), np.int32)
+            ap[: a_host.shape[0]] = a_host
+            assign = shard_rows(ap, m)
+
+        w = ds.w
+        if weights is not None:
+            w_host = np.asarray(weights, dtype=np.float32).reshape(-1)
+            wp = np.zeros((n_pad,), np.float32)
+            wp[: w_host.shape[0]] = w_host
+            w = shard_rows(wp, m)
+
+        if k is None:
+            k = int(jax.device_get(jnp.max(jnp.where(w > 0, assign, 0)))) + 1
+
+        s_sum, n = jax.device_get(
+            _make_silhouette(m, int(k), _SIL_CHUNK)(
+                ds.x.astype(jnp.float32), assign, w.astype(jnp.float32)
+            )
         )
-        k = int(k if k is not None else int(np.asarray(assignments).max()) + 1)
-        s_sum, n = jax.device_get(_silhouette_sums(x, assign, w, k))
         return float(s_sum / max(float(n), 1.0))
 
 

@@ -17,19 +17,25 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
 
 
-@jax.jit
-def _reg_sums(pred: jax.Array, label: jax.Array, w: jax.Array):
+def _local_sums(args):
+    """Per-shard sufficient statistics — the treeAggregate ``seqOp``."""
+    pred, label, w = args
     err = (pred - label) * w
-    n = jnp.sum(w)
     return {
-        "n": n,
+        "n": jnp.sum(w),
         "sq_err": jnp.sum(err * err),
         "abs_err": jnp.sum(jnp.abs(err)),
         "label_sum": jnp.sum(label * w),
         "label_sq": jnp.sum(label * label * w),
     }
+
+
+@jax.jit
+def _reg_sums(pred: jax.Array, label: jax.Array, w: jax.Array):
+    return _local_sums((pred, label, w))
 
 
 @dataclass(frozen=True)
@@ -43,6 +49,18 @@ class RegressionEvaluator:
         ``.label``, ``.weight`` device arrays) or explicit arrays."""
         if labels is None:
             pred, label, w = predictions.prediction, predictions.label, predictions.weight
+            mesh = getattr(getattr(pred, "sharding", None), "mesh", None)
+            if isinstance(mesh, Mesh):
+                # sharded prediction columns take the explicit treeAggregate
+                # path: per-shard seqOp + psum over the data axis — the
+                # literal analogue of Spark's one-job-per-evaluate
+                # (SURVEY.md §3.4)
+                from ..parallel.collectives import tree_aggregate
+
+                s = jax.device_get(
+                    tree_aggregate(_local_sums, (pred, label, w), mesh=mesh)
+                )
+                return self._finish(s)
         else:
             pred = jnp.asarray(np.asarray(predictions), dtype=jnp.float32)
             label = jnp.asarray(np.asarray(labels), dtype=jnp.float32)
@@ -52,6 +70,9 @@ class RegressionEvaluator:
                 else jnp.ones_like(label)
             )
         s = jax.device_get(_reg_sums(pred, label, w))
+        return self._finish(s)
+
+    def _finish(self, s) -> float:
         n = max(float(s["n"]), 1.0)
         mse = float(s["sq_err"]) / n
         if self.metric_name == "rmse":

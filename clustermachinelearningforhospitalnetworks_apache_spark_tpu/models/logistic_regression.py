@@ -81,7 +81,7 @@ def _irls_fit(x, y, w, reg_param, tol, fit_intercept: bool, standardize: bool, m
 def _multinomial_fit(
     x, y, w, reg_param, tol,
     num_classes: int, fit_intercept: bool, standardize: bool, max_iter: int,
-    chunk: int = 65536,
+    chunk: int,
 ):
     """Softmax (multinomial) regression via damped Newton.
 
@@ -314,10 +314,17 @@ class LogisticRegression(Estimator):
                 f"{num_classes}; use family='multinomial'"
             )
         if family == "multinomial":
+            # bound the Hessian-factor transient: the per-chunk e tensor is
+            # chunk·K²·D floats, so the chunk shrinks as K²·D grows (same
+            # rule as every other chunked path's tile budget)
+            k = max(num_classes, 2)
+            dd = ds.n_features + (1 if self.fit_intercept else 0)
+            chunk = int(min(65536, max(256, (1 << 25) // max(1, k * k * dd))))
             coef, intercept, n_iter = _multinomial_fit(
                 ds.x, ds.y, ds.w, jnp.float32(self.reg_param),
-                jnp.float32(self.tol), max(num_classes, 2),
+                jnp.float32(self.tol), k,
                 self.fit_intercept, self.standardize, self.max_iter,
+                chunk,
             )
             return MultinomialLogisticRegressionModel(
                 coefficient_matrix=coef, intercept_vector=intercept,

@@ -50,16 +50,37 @@ def build_hybrid_mesh(dcn_hosts: int, model: int = 1) -> Mesh:
     Uses ``mesh_utils.create_hybrid_device_mesh`` so that the intra-host
     portion of the data axis rides ICI and only the host portion crosses
     DCN — the layout that keeps ``psum`` traffic on the fast interconnect
-    (SURVEY.md §2D).
-    """
-    from jax.experimental import mesh_utils
+    (SURVEY.md §2D).  The axis names are the same (data, model) every
+    estimator already shards over; only the device ORDER changes (host-
+    major), so host-boundary traffic is the all-reduce's top level.
 
+    With fewer live processes than ``dcn_hosts`` (tests, the driver's
+    virtual-device dryrun), the host-major order is emulated by grouping
+    the flat device list — same mesh shape, same collectives, no DCN.
+    """
     n = jax.device_count()
     per_host = n // dcn_hosts
-    dev = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(per_host // model, model),
-        dcn_mesh_shape=(dcn_hosts, 1),
-    )
+    if per_host < 1 or per_host % model != 0:
+        raise ValueError(
+            f"{n} devices cannot split into {dcn_hosts} hosts × model={model}"
+        )
+    if jax.process_count() == dcn_hosts:
+        from jax.experimental import mesh_utils
+
+        # hosts are the DCN granules (process_is_granule): a single-slice
+        # multi-host pod has one slice but dcn_hosts processes, so slice
+        # granularity would reject the exact deployment this targets
+        dev = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_host // model, model),
+            dcn_mesh_shape=(dcn_hosts, 1),
+            process_is_granule=True,
+        )
+    else:
+        # emulated host-major order: tests, virtual-device dryruns, or a
+        # process count that doesn't match the requested host granularity
+        dev = np.asarray(jax.devices()[: dcn_hosts * per_host]).reshape(
+            dcn_hosts * (per_host // model), model
+        )
     return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
 
 

@@ -128,3 +128,30 @@ def test_kmeans_training_cost_is_final(rng, mesh8):
     x, _, _ = _blobs(rng, n=300, k=3)
     m = KMeans(k=3, seed=0, max_iter=1).fit(x, mesh=mesh8)
     np.testing.assert_allclose(m.training_cost, m.compute_cost(x, mesh=mesh8), rtol=1e-4)
+
+
+def test_silhouette_mesh_resident_device_inputs(rng, mesh8):
+    """The evaluator consumes the sharded DeviceDataset + device-resident
+    assignments (no host gather) and agrees with the host-array path and
+    sklearn."""
+    from sklearn.metrics import silhouette_score
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+
+    centers = np.array([[0.0, 0.0, 0.0], [6.0, 6.0, 0.0], [0.0, 6.0, 6.0]])
+    a = rng.integers(0, 3, 700)
+    x = (centers[a] + rng.normal(scale=0.6, size=(700, 3))).astype(np.float32)
+
+    ds = device_dataset(x, mesh=mesh8)
+    model = ht.KMeans(k=3, seed=0).fit(ds, mesh=mesh8)
+    assign_dev = model.predict(ds.x)          # sharded, padded like ds
+    ev = ht.ClusteringEvaluator()
+    on_mesh = ev.evaluate(ds, assign_dev, k=3)
+    on_host = ev.evaluate(x, np.asarray(model.predict_numpy(x)), k=3)
+    ref = silhouette_score(
+        x, np.asarray(model.predict_numpy(x)), metric="sqeuclidean"
+    )
+    assert abs(on_mesh - on_host) < 1e-5
+    assert abs(on_mesh - ref) < 1e-4
