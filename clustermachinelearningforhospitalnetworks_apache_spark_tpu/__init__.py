@@ -38,7 +38,14 @@ from .evaluation import (
     MulticlassClassificationEvaluator,
     RegressionEvaluator,
 )
-from .parallel import build_mesh, default_mesh, device_dataset, use_mesh
+from .parallel import (
+    FederatedDataset,
+    build_mesh,
+    default_mesh,
+    device_dataset,
+    federated_dataset,
+    use_mesh,
+)
 from .io import load_model, read_csv, read_csv_dir, write_csv
 from .session import Session
 from . import models, streaming, pipeline, utils, viz
@@ -77,6 +84,8 @@ __all__ = [
     "MulticlassClassificationEvaluator",
     "RegressionEvaluator",
     "build_mesh",
+    "FederatedDataset",
+    "federated_dataset",
     "default_mesh",
     "device_dataset",
     "use_mesh",

@@ -145,6 +145,16 @@ class ClusteringEvaluator:
     def evaluate(
         self, features, assignments, k: int | None = None, weights=None, mesh=None
     ) -> float:
+        from ..parallel.federation import FederatedDataset
+
+        # row_order maps padded device slot -> original row index; identity
+        # layout (device_dataset) fills the first n slots, a federated
+        # layout permutes rows per hospital placement — host-side
+        # assignments/weights must be scattered accordingly
+        row_order = None
+        if isinstance(features, FederatedDataset):
+            row_order = features.row_order
+            features = features.data
         if isinstance(features, DeviceDataset):
             ds = features
             m = getattr(ds.x.sharding, "mesh", None) or mesh or default_mesh()
@@ -153,20 +163,24 @@ class ClusteringEvaluator:
             ds = device_dataset(np.asarray(features), mesh=m)
         n_pad = ds.n_padded
 
+        def _host_to_slots(values, dtype, fill=0):
+            v = np.asarray(values).astype(dtype).reshape(-1)
+            out = np.full((n_pad,), fill, dtype=dtype)
+            if row_order is None:
+                out[: v.shape[0]] = v
+            else:
+                live = row_order >= 0
+                out[live] = v[row_order[live]]
+            return shard_rows(out, m)
+
         if isinstance(assignments, jax.Array) and assignments.shape[0] == n_pad:
             assign = assignments.astype(jnp.int32)
         else:
-            a_host = np.asarray(assignments).astype(np.int32).reshape(-1)
-            ap = np.zeros((n_pad,), np.int32)
-            ap[: a_host.shape[0]] = a_host
-            assign = shard_rows(ap, m)
+            assign = _host_to_slots(assignments, np.int32)
 
         w = ds.w
         if weights is not None:
-            w_host = np.asarray(weights, dtype=np.float32).reshape(-1)
-            wp = np.zeros((n_pad,), np.float32)
-            wp[: w_host.shape[0]] = w_host
-            w = shard_rows(wp, m)
+            w = _host_to_slots(weights, np.float32)
 
         if k is None:
             k = int(jax.device_get(jnp.max(jnp.where(w > 0, assign, 0)))) + 1

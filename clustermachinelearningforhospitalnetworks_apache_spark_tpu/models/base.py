@@ -24,8 +24,12 @@ from ..parallel.sharding import DeviceDataset, device_dataset, unpad
 
 def as_device_dataset(data: Any, label_col: str | None = None, mesh=None) -> DeviceDataset:
     """Coerce (DeviceDataset | AssembledTable | (X, y) | X) to a sharded dataset."""
+    from ..parallel.federation import FederatedDataset
+
     if isinstance(data, DeviceDataset):
         return data
+    if isinstance(data, FederatedDataset):
+        return data.data
     if isinstance(data, AssembledTable):
         return data.to_device(label_col=label_col, mesh=mesh)
     if isinstance(data, tuple) and len(data) == 2:

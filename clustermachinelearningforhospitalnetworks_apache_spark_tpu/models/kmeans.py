@@ -315,7 +315,14 @@ class KMeansModel(ClusteringModel):
             from ..ops.pallas_kernels import fused_assign
 
             return fused_assign(self._prep(x), centers)[0]
-        return _predict_fn(self._prep(x), centers)
+        xp = self._prep(x)
+        if xp.shape[0] * self.k > (1 << 24):
+            # big inputs: chunked path — no (n, k) distance matrix in HBM,
+            # shard-local under shard_map when x is mesh-sharded
+            from ..ops.distance import assign_clusters_chunked
+
+            return assign_clusters_chunked(xp, centers)
+        return _predict_fn(xp, centers)
 
     def compute_cost(self, data, mesh=None) -> float:
         """Sum of squared distances to nearest center (Spark computeCost)."""

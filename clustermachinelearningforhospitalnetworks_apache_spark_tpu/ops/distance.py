@@ -53,3 +53,48 @@ def assign_clusters(
     """→ (argmin index (n,), min squared distance (n,))."""
     d2 = pairwise_sqdist(x, centers, c_sq=c_sq)
     return jnp.argmin(d2, axis=1).astype(jnp.int32), jnp.min(d2, axis=1)
+
+
+#: rows per tile of the chunked assignment — bounds the (chunk, k) distance
+#: tile so no (n, k) matrix lands in HBM at BASELINE scale
+ASSIGN_CHUNK = 65536
+
+
+def _assign_chunked_local(x: jax.Array, centers: jax.Array, chunk: int):
+    """Chunked (lax.map) assignment over a *local* array — (n, chunk·k)
+    tiles instead of one (n, k) matrix."""
+    n, d = x.shape
+    c = min(chunk, max(n, 1))
+    pad = (-n) % c
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    a = lax.map(lambda xc: assign_clusters(xc, centers)[0], x.reshape(-1, c, d))
+    return a.reshape(-1)[:n]
+
+
+def assign_clusters_chunked(
+    x: jax.Array, centers: jax.Array, chunk: int = ASSIGN_CHUNK
+) -> jax.Array:
+    """Assignment without an (n, k) HBM intermediate — at 10M rows × k=256
+    the full distance matrix is ~10 GB, which the Lloyd training step
+    already avoids via its row-chunked scan; this is the matching predict
+    path.  A mesh-sharded ``x`` is processed shard-locally under
+    ``shard_map`` (assignment is embarrassingly row-parallel); anything
+    else goes through one jitted chunked scan."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = getattr(getattr(x, "sharding", None), "mesh", None)
+    if isinstance(mesh, Mesh):
+        from ..parallel.mesh import DATA_AXIS
+
+        return jax.jit(
+            jax.shard_map(
+                lambda xs, cen: _assign_chunked_local(xs, cen, chunk),
+                mesh=mesh,
+                in_specs=(P(DATA_AXIS, None), P()),
+                out_specs=P(DATA_AXIS),
+            )
+        )(x, jax.device_put(centers, NamedSharding(mesh, P())))
+    return jax.jit(_assign_chunked_local, static_argnames=("chunk",))(
+        x, centers, chunk=chunk
+    )

@@ -18,10 +18,14 @@ from .sharding import (
     unpad,
 )
 from .collectives import global_sum, tree_aggregate
+from .federation import FederatedDataset, federated_dataset, place_hospitals
 from . import distributed
 
 __all__ = [
     "DATA_AXIS",
+    "FederatedDataset",
+    "federated_dataset",
+    "place_hospitals",
     "MODEL_AXIS",
     "build_mesh",
     "build_hybrid_mesh",

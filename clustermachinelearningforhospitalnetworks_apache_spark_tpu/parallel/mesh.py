@@ -64,6 +64,7 @@ def build_hybrid_mesh(dcn_hosts: int, model: int = 1) -> Mesh:
         raise ValueError(
             f"{n} devices cannot split into {dcn_hosts} hosts × model={model}"
         )
+    n_slices = len({getattr(d, "slice_index", 0) for d in jax.devices()})
     if jax.process_count() == dcn_hosts:
         from jax.experimental import mesh_utils
 
@@ -75,9 +76,27 @@ def build_hybrid_mesh(dcn_hosts: int, model: int = 1) -> Mesh:
             dcn_mesh_shape=(dcn_hosts, 1),
             process_is_granule=True,
         )
+    elif n_slices == dcn_hosts:
+        from jax.experimental import mesh_utils
+
+        # multi-slice deployment: slices are the DCN granules
+        dev = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_host // model, model),
+            dcn_mesh_shape=(dcn_hosts, 1),
+        )
     else:
         # emulated host-major order: tests, virtual-device dryruns, or a
-        # process count that doesn't match the requested host granularity
+        # granularity matching neither processes nor slices
+        if jax.process_count() > 1:
+            import warnings
+
+            warnings.warn(
+                f"build_hybrid_mesh(dcn_hosts={dcn_hosts}) matches neither "
+                f"process_count={jax.process_count()} nor n_slices={n_slices}; "
+                "falling back to flat device order (no topology-aware DCN "
+                "layout)",
+                stacklevel=2,
+            )
         dev = np.asarray(jax.devices()[: dcn_hosts * per_host]).reshape(
             dcn_hosts * (per_host // model), model
         )

@@ -1,0 +1,115 @@
+"""Per-hospital federation (SURVEY.md §2C federation row; reference
+``hospital_id`` at mllearnforhospitalnetwork.py:65): explicit hospital →
+shard placement, shard locality, and fit-equality with the unpartitioned
+layout."""
+
+import numpy as np
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (
+    DATA_AXIS,
+    device_dataset,
+    federated_dataset,
+    place_hospitals,
+)
+
+
+def _hospital_data(rng, n=1200, n_hosp=11):
+    ids = np.array([f"H{rng.integers(0, n_hosp):02d}" for _ in range(n)], dtype=object)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x @ np.array([1.0, -0.5, 2.0, 0.0]) + 0.1 * rng.normal(size=n)).astype(
+        np.float32
+    )
+    return x, y, ids
+
+
+def test_placement_deterministic_and_balanced(rng):
+    _, _, ids = _hospital_data(rng)
+    p1 = place_hospitals(ids, 8)
+    p2 = place_hospitals(ids, 8)
+    assert p1 == p2
+    counts = np.unique(ids, return_counts=True)
+    load = np.zeros(8)
+    for h, c in zip(*counts):
+        load[p1[h]] += c
+    # LPT bound: max load ≤ mean + largest hospital
+    assert load.max() <= load.mean() + counts[1].max()
+
+
+def test_hospital_rows_land_on_one_shard(rng, mesh8):
+    x, y, ids = _hospital_data(rng)
+    fd = federated_dataset(x, ids, y, mesh=mesh8)
+    n_shards = mesh8.shape[DATA_AXIS]
+    shard_len = fd.n_padded // n_shards
+    # every original row's slot maps to the shard its hospital was placed on
+    for slot, row in enumerate(fd.row_order):
+        if row >= 0:
+            assert slot // shard_len == fd.hospital_to_shard[ids[row]]
+    # all rows present exactly once
+    present = sorted(r for r in fd.row_order if r >= 0)
+    assert present == list(range(len(x)))
+
+
+def test_federated_fit_equals_unpartitioned(rng, mesh8):
+    """The federated layout trains the same model as the ingest-order
+    layout (reductions are permutation-invariant)."""
+    x, y, ids = _hospital_data(rng)
+    fd = federated_dataset(x, ids, y, mesh=mesh8)
+    plain = device_dataset(x, y, mesh=mesh8)
+
+    m_fed = ht.LinearRegression().fit(fd, mesh=mesh8)
+    m_plain = ht.LinearRegression().fit(plain, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(m_fed.coefficients), np.asarray(m_plain.coefficients), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(m_fed.intercept), float(m_plain.intercept), atol=1e-4
+    )
+
+    r_fed = ht.RegressionEvaluator("rmse").evaluate(m_fed.transform(fd, mesh=mesh8))
+    r_plain = ht.RegressionEvaluator("rmse").evaluate(
+        m_plain.transform(plain, mesh=mesh8)
+    )
+    assert abs(r_fed - r_plain) < 1e-5
+
+
+def test_federated_from_assembled_table(rng, hospital_table, mesh8):
+    asm = ht.VectorAssembler(ht.FEATURE_COLS).transform(hospital_table)
+    fd = federated_dataset(asm, mesh=mesh8)
+    assert fd.n_rows == hospital_table.num_rows
+    assert set(fd.hospital_to_shard) == set(hospital_table["hospital_id"])
+    # label rode along from the source table
+    m = ht.LinearRegression().fit(fd, mesh=mesh8)
+    assert np.isfinite(np.asarray(m.coefficients)).all()
+
+
+def test_bisecting_on_federated_layout(rng, mesh8):
+    """BASELINE config 4 shape: hierarchical clustering over the federated
+    layout matches the plain layout's tree (same seed, same data)."""
+    centers = np.array([[0.0, 0.0], [9.0, 9.0], [0.0, 9.0], [9.0, 0.0]])
+    a = rng.integers(0, 4, 900)
+    x = (centers[a] + rng.normal(scale=0.4, size=(900, 2))).astype(np.float32)
+    ids = np.array([f"H{v}" for v in rng.integers(0, 5, 900)], dtype=object)
+    fd = federated_dataset(x, ids, mesh=mesh8)
+    bk = ht.BisectingKMeans(k=4, seed=0).fit(fd, mesh=mesh8)
+    assert bk.cluster_centers.shape == (4, 2)
+    pred = np.asarray(bk.predict_numpy(x))
+    # recovered the 4 true blobs
+    assert len(np.unique(pred)) == 4
+
+
+def test_silhouette_on_federated_layout(rng, mesh8):
+    """Host-order assignments are scattered through row_order, so the
+    federated evaluator result equals the plain-layout result."""
+    centers = np.array([[0.0, 0.0], [9.0, 9.0], [0.0, 9.0]])
+    a = rng.integers(0, 3, 700)
+    x = (centers[a] + rng.normal(scale=0.5, size=(700, 2))).astype(np.float32)
+    ids = np.array([f"H{v}" for v in rng.integers(0, 6, 700)], dtype=object)
+
+    fd = federated_dataset(x, ids, mesh=mesh8)
+    km = ht.KMeans(k=3, seed=0).fit(fd, mesh=mesh8)
+    pred_host = np.asarray(km.predict_numpy(x))       # original row order
+
+    s_fed = ht.ClusteringEvaluator().evaluate(fd, pred_host, k=3)
+    s_plain = ht.ClusteringEvaluator().evaluate(x, pred_host, k=3)
+    assert abs(s_fed - s_plain) < 1e-5
