@@ -430,9 +430,11 @@ def _bench_streaming(k: int = 16) -> dict:
     sk = StreamingKMeans(k=k, half_life=5.0, seed=0)
     sk.update(batches[0], mesh=mesh)
     sk.update(batches[1], mesh=mesh)  # warm-up both code paths
+    jax.block_until_ready(sk._centers)
     t0 = time.perf_counter()
     for b in batches[2:]:
         sk.update(b, mesh=mesh)
+    jax.block_until_ready(sk._centers)   # the timed region ends on device
     dt = time.perf_counter() - t0
     per_chip = batch * 10 / dt / n_chips
 

@@ -12,6 +12,7 @@ weights so padding never biases a reduction.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +20,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, MODEL_AXIS, default_mesh
+
+
+@lru_cache(maxsize=32)
+def _pad_fill_fns(mesh: Mesh, n_pad: int, dtype_name: str):
+    """jit'd on-device constructors for the padding companions of a
+    transferred design matrix: the 0/1 validity step and a zero label
+    column.  Creating these on device instead of shipping them saves a
+    third of the ingest bytes per micro-batch — on tunneled chips the
+    host→device link is the streaming bottleneck."""
+    dtype = jnp.dtype(dtype_name)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    # one program covers both companions: n=0 yields the all-zeros label
+    # column, n=n_valid the 0/1 validity step
+    return jax.jit(
+        lambda n: (jnp.arange(n_pad) < n).astype(dtype), out_shardings=sharding
+    )
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:
@@ -97,16 +114,25 @@ def device_dataset(
     n = x.shape[0]
     n_shards = mesh.shape[DATA_AXIS]
     n_pad = pad_rows(n, n_shards)
-    xp = np.zeros((n_pad, x.shape[1]), dtype=np.dtype(dtype.dtype) if hasattr(dtype, "dtype") else dtype)
+    # np.dtype() handles numpy scalar types and dtype instances; jnp scalar
+    # types (jnp.float32) expose the equivalent via their .dtype attribute
+    try:
+        np_dtype = np.dtype(dtype)
+    except TypeError:
+        np_dtype = np.dtype(dtype.dtype)
+    xp = np.zeros((n_pad, x.shape[1]), dtype=np_dtype)
     xp[:n] = x
-    w = np.zeros((n_pad,), dtype=xp.dtype)
-    w[:n] = 1.0
-    yp = np.zeros((n_pad,), dtype=xp.dtype)
+    # only the feature matrix (and a real label column) cross the link;
+    # the validity step and an absent label are built on device
+    fill_fn = _pad_fill_fns(mesh, n_pad, np_dtype.name)
+    w = fill_fn(np.int64(n))
     if y is not None:
+        yp = np.zeros((n_pad,), dtype=np_dtype)
         yp[:n] = np.asarray(y).reshape(-1)
-    return DeviceDataset(
-        x=shard_rows(xp, mesh), y=shard_rows(yp, mesh), w=shard_rows(w, mesh)
-    )
+        y_dev = shard_rows(yp, mesh)
+    else:
+        y_dev = fill_fn(np.int64(0))
+    return DeviceDataset(x=shard_rows(xp, mesh), y=y_dev, w=w)
 
 
 def unpad(values: jax.Array, n: int) -> np.ndarray:

@@ -1,0 +1,135 @@
+"""Multi-controller runtime (SURVEY.md §2D distributed comm backend): a
+REAL two-process CPU cluster — each process runs the same SPMD program,
+``parallel.distributed.initialize`` wires them through the coordinator, and
+a sharded LinearRegression fit reduces across process boundaries (the DCN
+path of a pod slice, emulated with the CPU collectives transport).
+
+This is the test Spark gets by spinning up local-cluster mode; here it
+proves the framework's control plane works beyond one process, not just on
+the in-process virtual mesh the rest of the suite uses.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import importlib.util
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)   # 2 local devices / process
+
+    # The runtime must be wired BEFORE anything touches the XLA backend —
+    # importing the package materializes jnp constants, so load the
+    # bootstrap module standalone (it only imports os/dataclasses/jax).
+    spec = importlib.util.spec_from_file_location(
+        "distributed_standalone",
+        os.path.join(
+            @@REPO@@,
+            "clustermachinelearningforhospitalnetworks_apache_spark_tpu",
+            "parallel",
+            "distributed.py",
+        ),
+    )
+    distributed = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = distributed   # dataclass needs the module registered
+    spec.loader.exec_module(distributed)
+
+    ctx = distributed.initialize(
+        coordinator_address=@@COORD@@,
+        num_processes=2,
+        process_id=int(os.environ["PROC_ID"]),
+    )
+    sys.path.insert(0, @@REPO@@)
+    assert ctx.num_processes == 2, ctx
+    assert ctx.global_devices == 4, ctx
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        DATA_AXIS, build_mesh,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.config import MeshConfig
+
+    mesh = build_mesh(MeshConfig(data=4, model=1))
+
+    # every controller materializes the same global rows, each holds its
+    # local shards (multi-controller SPMD: jax.make_array_from_callback)
+    rng = np.random.default_rng(0)
+    n, d = 64, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([1.0, -2.0, 0.5], np.float32)
+    y = (x @ beta + 0.25).astype(np.float32)
+
+    sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    xg = jax.make_array_from_callback((n, d), sh, lambda idx: x[idx])
+    sh1 = NamedSharding(mesh, P(DATA_AXIS))
+    yg = jax.make_array_from_callback((n,), sh1, lambda idx: y[idx])
+    wg = jax.make_array_from_callback(
+        (n,), sh1, lambda idx: np.ones((n,), np.float32)[idx]
+    )
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.linear_regression import (
+        _wls_fit,
+    )
+    coef, intercept = _wls_fit(xg, yg, wg, jnp.float32(0.0), True, True)
+    coef = np.asarray(jax.device_get(coef))
+    np.testing.assert_allclose(coef, beta, atol=1e-3)
+    np.testing.assert_allclose(float(intercept), 0.25, atol=1e-3)
+    print(f"proc {ctx.process_id}: OK coef={coef.round(3).tolist()}")
+    """
+)
+
+
+def test_two_process_cluster_fit(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(
+        _WORKER.replace("@@REPO@@", repr(repo)).replace(
+            "@@COORD@@", repr(f"127.0.0.1:{port}")
+        )
+    )
+
+    # strip the image's sitecustomize (PYTHONPATH) — it initializes the XLA
+    # backend at interpreter start, which must not happen before
+    # jax.distributed.initialize in the workers
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH")
+    }
+    procs = []
+    for pid in (0, 1):
+        e = dict(env, PROC_ID=str(pid), JAX_PLATFORMS="cpu")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=e,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+        assert f"proc {pid}: OK" in out, out
