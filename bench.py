@@ -390,9 +390,23 @@ def _bench_random_forest(T: int = 20, depth: int = 5) -> dict:
     est = RandomForestRegressor(
         num_trees=T, max_depth=depth, feature_subset_strategy="all", seed=0
     )
-    est.fit(ds, mesh=mesh)  # warm-up: per-level executables
+    # BENCH_TREE_PALLAS=1 measures the fused Pallas histogram kernel
+    # instead of the XLA one-hot-contraction scan (same split results,
+    # parity-tested) — the A/B the kernel's docstring numbers come from.
+    if os.environ.get("BENCH_TREE_PALLAS", "").lower() in ("1", "true", "yes"):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+            grow_forest,
+        )
+
+        fit = lambda: grow_forest(
+            ds, task="regression", num_trees=T, max_depth=depth,
+            bootstrap=True, seed=0, mesh=mesh, use_pallas=True,
+        )
+    else:
+        fit = lambda: est.fit(ds, mesh=mesh)
+    fit()  # warm-up: per-level executables
     t0 = time.perf_counter()
-    est.fit(ds, mesh=mesh)
+    fit()
     per_chip = n / (time.perf_counter() - t0) / n_chips
 
     cpu_n = min(n, 100_000)

@@ -46,7 +46,10 @@ _HIST_CHUNK = 8192
 
 
 @lru_cache(maxsize=64)
-def _make_level_hist(mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int):
+def _make_level_hist(
+    mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int,
+    use_pallas: bool = False,
+):
     """jit'd: per-(tree, level-node, feature, bin) stat histograms.
 
     All row-major inputs are TRANSPOSED so the huge row axis is the lane
@@ -83,6 +86,11 @@ def _make_level_hist(mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: in
     """
 
     def shard_fn(binned_t, base_t, w_tree, pos):
+        if use_pallas:
+            from ...ops.pallas_kernels import fused_level_hist
+
+            h = fused_level_hist(binned_t, base_t, w_tree, pos, level_nodes, B)
+            return lax.psum(h, DATA_AXIS)
         n_loc = binned_t.shape[1]
         chunk = min(_HIST_CHUNK, max(n_loc, 1))
         pad = (-n_loc) % chunk
@@ -142,12 +150,25 @@ def _make_level_hist(mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: in
             P(None, DATA_AXIS),
         ),
         out_specs=P(),
+        # interpret-mode pallas_call's internal block slicing mixes varying
+        # operands with unvarying grid indices, which the vma checker
+        # rejects (jax suggests this exact workaround); compiled TPU runs
+        # keep the checker on
+        check_vma=not (use_pallas and _hist_interpret()),
     )
+
+
+def _hist_interpret() -> bool:
+    """True when fused_level_hist would run in interpreter mode (off-TPU)."""
+    from ...ops.pallas_kernels import _on_tpu
+
+    return not _on_tpu()
 
 
 @lru_cache(maxsize=64)
 def _make_level_step(
-    mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int, task: str
+    mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int, task: str,
+    use_pallas: bool = False,
 ):
     """jit'd level step: sharded histogram + on-device split selection.
 
@@ -162,7 +183,7 @@ def _make_level_step(
     random subset (Spark's featureSubsetStrategy); ``min_inst`` /
     ``min_gain`` are dynamic scalars (no recompile when they change).
     """
-    hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T)
+    hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T, use_pallas)
     neg_inf = jnp.float32(-jnp.inf)
 
     def step(binned_t, base_t, w_tree, pos, feat_mask, min_inst, min_gain):
@@ -323,8 +344,13 @@ def grow_forest(
     seed: int = 0,
     mesh: Mesh | None = None,
     init_sample_size: int = 65536,
+    use_pallas: bool = False,
 ) -> GrownForest:
-    """Train ``num_trees`` trees level-by-level on the sharded dataset."""
+    """Train ``num_trees`` trees level-by-level on the sharded dataset.
+
+    ``use_pallas`` routes the level histograms through the fused
+    bin-and-accumulate kernel (ops/pallas_kernels.fused_level_hist)
+    instead of the XLA one-hot-contraction scan."""
     from ...parallel.sharding import sample_valid_rows
 
     mesh = mesh or default_mesh()
@@ -397,7 +423,7 @@ def grow_forest(
         else:
             mask = jnp.ones((T, level_nodes, d), jnp.float32)
 
-        step_fn = _make_level_step(mesh, level_nodes, d, B, S, T, task)
+        step_fn = _make_level_step(mesh, level_nodes, d, B, S, T, task, use_pallas)
         agg_d, gain_d, feat_d, bin_d, split_d = step_fn(
             binned_t, base_t, w_tree, pos, mask, min_inst, min_gain
         )

@@ -271,3 +271,116 @@ def fused_assign(
         block_rows=b, interpret=bool(interpret),
     )
     return a[:n], d2[:n]
+
+
+# ------------------------------------------------------------- tree hist
+def _hist_kernel(binned_ref, base_ref, w_ref, pos_ref, out_ref, *, LN, S, B, d):
+    """Fused bin-and-accumulate for one (tree, row-block) grid step.
+
+    Grid is (T, row blocks); the output block is indexed by tree only, so
+    the row-block axis (innermost, sequential on TPU) accumulates into the
+    same VMEM-resident (LN·S, d·B) tile.  Per step: build the masked stats
+    (LN·S, C) and the per-feature bin one-hots in VMEM, then d small MXU
+    matmuls — the stats transient never touches HBM, which is the entire
+    point vs. the XLA scan formulation (SURVEY.md §7 hard-part 1).
+    """
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    pos = pos_ref[0, :]                                   # (C,) int32
+    w = w_ref[0, :]                                       # (C,)
+    base = base_ref[:]                                    # (S, C)
+    c = pos.shape[0]
+
+    node_iota = lax.broadcasted_iota(jnp.int32, (LN, c), 0)
+    node_oh = (pos[None, :] == node_iota).astype(base.dtype) * w[None, :]
+    stats = (node_oh[:, None, :] * base[None, :, :]).reshape(LN * S, c)
+
+    binned = binned_ref[:]                                # (d, C) int32
+    bin_iota = lax.broadcasted_iota(jnp.int32, (c, B), 1)
+    for f in range(d):                                    # static unroll
+        binoh = (binned[f][:, None] == bin_iota).astype(base.dtype)
+        out_ref[0, :, f * B : (f + 1) * B] += jnp.dot(
+            stats, binoh,
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("level_nodes", "S", "B", "block_rows", "interpret")
+)
+def _hist_call(binned_t, base_t, w_tree, pos, *, level_nodes, S, B, block_rows, interpret):
+    d, n = binned_t.shape
+    T = w_tree.shape[0]
+    kernel = functools.partial(_hist_kernel, LN=level_nodes, S=S, B=B, d=d)
+    out = pl.pallas_call(
+        kernel,
+        grid=(T, n // block_rows),
+        in_specs=[
+            pl.BlockSpec((d, block_rows), lambda t, i: (0, i)),
+            pl.BlockSpec((S, block_rows), lambda t, i: (0, i)),
+            pl.BlockSpec((1, block_rows), lambda t, i: (t, i)),
+            pl.BlockSpec((1, block_rows), lambda t, i: (t, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, level_nodes * S, d * B), lambda t, i: (t, 0, 0)
+        ),
+        out_shape=_out_struct(
+            (T, level_nodes * S, d * B), jnp.float32,
+            binned_t, base_t, w_tree, pos,
+        ),
+        interpret=interpret,
+    )(binned_t, base_t, w_tree, pos)
+    # (T, LN·S, d·B) → (T, LN, S, d, B) → (T, LN, d, B, S)
+    return jnp.transpose(
+        out.reshape(T, level_nodes, S, d, B), (0, 1, 3, 4, 2)
+    )
+
+
+def fused_level_hist(
+    binned_t: jax.Array,
+    base_t: jax.Array,
+    w_tree: jax.Array,
+    pos: jax.Array,
+    level_nodes: int,
+    B: int,
+    block_rows: int | None = None,
+    interpret: bool | None = None,
+):
+    """Per-(tree, frontier-node, feature, bin) stat histograms, fused.
+
+    Same contract as the XLA scan inside
+    ``models.tree.engine._make_level_hist`` (shard-local part): inputs are
+    row-transposed shards, padding rows carry ``pos=-1``/``w=0``.
+    → (T, level_nodes, d, B, S) float32.
+
+    Opt-in via ``grow_forest(use_pallas=True)``; interpreter mode on CPU
+    so the test mesh runs the exact kernel code path.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    d, n = binned_t.shape
+    S = base_t.shape[0]
+    if n == 0:
+        T = w_tree.shape[0]
+        return jnp.zeros((T, level_nodes, d, B, S), jnp.float32)
+    if block_rows is None:
+        # stats (LN·S, C) is the big VMEM tenant; keep it ≲2 MB
+        block_rows = 2048
+        while block_rows > 128 and 4 * level_nodes * S * block_rows > (2 << 20):
+            block_rows //= 2
+    pad = (-n) % block_rows
+    if pad:
+        binned_t = jnp.pad(binned_t, ((0, 0), (0, pad)))
+        base_t = jnp.pad(base_t, ((0, 0), (0, pad)))
+        w_tree = jnp.pad(w_tree, ((0, 0), (0, pad)))
+        pos = jnp.pad(pos, ((0, 0), (0, pad)), constant_values=-1)
+    return _hist_call(
+        binned_t, base_t, w_tree, pos,
+        level_nodes=level_nodes, S=S, B=B,
+        block_rows=block_rows, interpret=bool(interpret),
+    )

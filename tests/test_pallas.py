@@ -97,3 +97,52 @@ def test_use_pallas_rejected_on_model_sharded_mesh(rng, mesh42):
     x = rng.normal(size=(200, 4))
     with pytest.raises(ValueError, match="model axis"):
         KMeans(k=4, use_pallas=True).fit(x, mesh=mesh42)
+
+
+def test_fused_level_hist_matches_xla_scan(rng, mesh8):
+    """The fused bin-and-accumulate kernel (interpret mode on CPU) produces
+    the exact histograms of the XLA one-hot-contraction scan, through the
+    full forest fit."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+        grow_forest,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+
+    n, d = 3000, 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 2] * 2.0 + 0.3 * rng.normal(size=n)).astype(np.float32)
+    ds = device_dataset(x, y, mesh=mesh8)
+    kw = dict(
+        task="regression", num_trees=3, max_depth=4, max_bins=16,
+        bootstrap=True, seed=0, mesh=mesh8,
+    )
+    a = grow_forest(ds, **kw)
+    b = grow_forest(ds, use_pallas=True, **kw)
+    np.testing.assert_array_equal(a.split_feat, b.split_feat)
+    np.testing.assert_array_equal(a.split_bin, b.split_bin)
+    np.testing.assert_allclose(a.value, b.value, atol=1e-5)
+    np.testing.assert_allclose(a.importances, b.importances, atol=1e-6)
+
+
+def test_fused_level_hist_classification_parity(rng, mesh8):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+        grow_forest,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+
+    n, d = 2000, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + x[:, 3] > 0).astype(np.float32)
+    ds = device_dataset(x, y, mesh=mesh8)
+    kw = dict(
+        task="classification", num_classes=2, num_trees=2, max_depth=3,
+        max_bins=8, seed=1, mesh=mesh8,
+    )
+    a = grow_forest(ds, **kw)
+    b = grow_forest(ds, use_pallas=True, **kw)
+    np.testing.assert_array_equal(a.split_feat, b.split_feat)
+    np.testing.assert_allclose(a.value, b.value, atol=1e-5)
