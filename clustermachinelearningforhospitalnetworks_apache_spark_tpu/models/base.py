@@ -112,19 +112,24 @@ class ClusteringModel(Model):
         if isinstance(data, AssembledTable):
             n = len(data)
             ds = as_device_dataset(data.features, mesh=mesh)
-            if hasattr(self, "predict_proba"):
-                # one posterior pass, argmax + assigned-component gather on
-                # device — only two length-n vectors cross to host
+            assigned = None
+            if hasattr(self, "predict_assigned"):
+                # fused chunked argmax+posterior — no (n, k) tensor in HBM,
+                # only two length-n vectors cross to host
+                pred_d, assigned = self.predict_assigned(ds.x)
+            elif hasattr(self, "predict_proba"):
                 p = self.predict_proba(ds.x)
                 pred_d = jnp.argmax(p, axis=1)
                 assigned = jnp.take_along_axis(p, pred_d[:, None], axis=1)[:, 0]
-                pred = np.asarray(unpad(pred_d, n)).astype(np.int32)
-                out = data.table.with_column("prediction", pred, dtype="int")
-                return out.with_column(
+            else:
+                pred_d = self.predict(ds.x)
+            pred = np.asarray(unpad(pred_d, n)).astype(np.int32)
+            out = data.table.with_column("prediction", pred, dtype="int")
+            if assigned is not None:
+                out = out.with_column(
                     "probability", np.asarray(unpad(assigned, n)), dtype="float"
                 )
-            pred = np.asarray(unpad(self.predict(ds.x), n)).astype(np.int32)
-            return data.table.with_column("prediction", pred, dtype="int")
+            return out
         return super().transform(data, label_col=label_col, mesh=mesh)
 
 

@@ -168,6 +168,42 @@ def _make_em_loop(
     )
 
 
+def _predict_assigned_local(xs, logw, means, chols, *, chunk):
+    """Shard-local fused argmax+posterior over row chunks."""
+    n = xs.shape[0]
+    c = min(chunk, max(n, 1))
+    pad = (-n) % c
+    if pad:
+        xs = jnp.pad(xs, ((0, pad), (0, 0)))
+
+    def one(xc):
+        log_pdf = jax.vmap(lambda m, L: _chol_log_pdf(xc, m, L))(means, chols).T
+        log_resp = log_pdf + logw[None, :]
+        pred = jnp.argmax(log_resp, axis=1)
+        assigned = jnp.exp(jnp.max(log_resp, axis=1) - logsumexp(log_resp, axis=1))
+        return pred.astype(jnp.int32), assigned
+
+    preds, probs = lax.map(one, xs.reshape(-1, c, xs.shape[1]))
+    return preds.reshape(-1)[:n], probs.reshape(-1)[:n]
+
+
+@lru_cache(maxsize=32)
+def _make_predict_assigned(mesh: Mesh | None, chunk: int):
+    """Cached compiled wrapper (jit caches on the function object, so a
+    per-call closure would retrace and recompile every call)."""
+    local = partial(_predict_assigned_local, chunk=chunk)
+    if mesh is None:
+        return jax.jit(local)
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(), P(), P()),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        )
+    )
+
+
 @register_model("GaussianMixtureModel")
 @dataclass
 class GaussianMixtureModel(ClusteringModel):
@@ -196,7 +232,37 @@ class GaussianMixtureModel(ClusteringModel):
         return jnp.exp(log_resp - logsumexp(log_resp, axis=1)[:, None])
 
     def predict(self, x: jax.Array) -> jax.Array:
+        if x.shape[0] * self.k > (1 << 24):
+            return self.predict_assigned(x)[0]
         return jnp.argmax(self.predict_proba(x), axis=1).astype(jnp.int32)
+
+    def predict_assigned(
+        self, x: jax.Array, chunk: int = 65536
+    ) -> tuple[jax.Array, jax.Array]:
+        """→ (component (n,) int32, assigned-component posterior (n,)).
+
+        The fused, chunked form of ``argmax(predict_proba)`` — per chunk
+        only a (chunk, k) responsibility tile exists, so no (n, k) tensor
+        lands in HBM at BASELINE scale (the same rule as the KMeans
+        chunked assign and the training E-step's row scan).  Mesh-sharded
+        inputs run shard-locally under ``shard_map``.
+        """
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        logw, means, chols = self._device_params()
+        mesh = getattr(getattr(x, "sharding", None), "mesh", None)
+        mesh = mesh if isinstance(mesh, Mesh) else None
+        fn = _make_predict_assigned(mesh, chunk)
+        xf = x.astype(jnp.float32)
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            return fn(
+                xf,
+                jax.device_put(logw, rep),
+                jax.device_put(means, rep),
+                jax.device_put(chols, rep),
+            )
+        return fn(xf, logw, means, chols)
 
     def score(self, data, mesh=None) -> float:
         """Mean per-row log-likelihood."""

@@ -245,3 +245,33 @@ def test_streaming_kmeans_empty_batch_keeps_centers(rng, mesh8):
     before = s.latest_model.cluster_centers.copy()
     s.update(np.zeros((0, 2)), mesh=mesh8)
     np.testing.assert_allclose(s.latest_model.cluster_centers, before)
+
+
+def test_gmm_predict_assigned_matches_proba(rng, mesh8):
+    """Chunked fused argmax+posterior == argmax over the full (n, k)
+    responsibility matrix, including on sharded inputs."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+        unpad,
+    )
+
+    centers = np.array([[0.0, 0.0], [6.0, 0.0], [0.0, 6.0]])
+    a = rng.integers(0, 3, 901)
+    x = (centers[a] + rng.normal(scale=0.5, size=(901, 2))).astype(np.float32)
+    gm = ht.GaussianMixture(k=3, seed=0, max_iter=40).fit(x, mesh=mesh8)
+
+    import jax.numpy as jnp
+
+    p = np.asarray(gm.predict_proba(jnp.asarray(x)))
+    pred_c, prob_c = gm.predict_assigned(jnp.asarray(x), chunk=128)
+    np.testing.assert_array_equal(np.asarray(pred_c), p.argmax(1))
+    np.testing.assert_allclose(
+        np.asarray(prob_c), p[np.arange(len(x)), p.argmax(1)], atol=1e-5
+    )
+
+    ds = device_dataset(x, mesh=mesh8)
+    pred_s, prob_s = gm.predict_assigned(ds.x, chunk=128)
+    np.testing.assert_array_equal(unpad(pred_s, len(x)), p.argmax(1))
+    np.testing.assert_allclose(
+        unpad(prob_s, len(x)), p[np.arange(len(x)), p.argmax(1)], atol=1e-5
+    )
