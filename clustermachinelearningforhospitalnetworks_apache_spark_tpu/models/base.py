@@ -62,6 +62,19 @@ class Estimator:
         raise NotImplementedError
 
 
+def check_features(x, expected: int, model_name: str) -> None:
+    """Friendly feature-width validation at the model's front door — a
+    mismatched matrix otherwise surfaces as a raw XLA dot-dimension
+    TypeError deep inside jit."""
+    got = x.shape[-1] if getattr(x, "ndim", 0) >= 2 else None
+    if got is not None and got != expected:
+        raise ValueError(
+            f"{model_name} was trained on {expected} features but the input "
+            f"has {got} (shape {tuple(x.shape)}); assemble the same feature "
+            "columns used at fit time"
+        )
+
+
 class Model:
     """Base: subclasses implement ``predict(x) -> jax.Array`` on device."""
 

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..io.model_io import register_model
 from ..parallel.mesh import DATA_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
-from .base import ClusteringModel, Estimator, Model, as_device_dataset
+from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
 from .kmeans import _chunked, _kmeans_pp_init, _lloyd_refine
 
 
@@ -225,6 +225,7 @@ class GaussianMixtureModel(ClusteringModel):
         return logw, means, chols
 
     def predict_proba(self, x: jax.Array) -> jax.Array:
+        check_features(x, self.means.shape[1], "GaussianMixtureModel")
         logw, means, chols = self._device_params()
         x = x.astype(jnp.float32)
         log_pdf = jax.vmap(lambda m, L: _chol_log_pdf(x, m, L))(means, chols).T
@@ -249,6 +250,7 @@ class GaussianMixtureModel(ClusteringModel):
         """
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+        check_features(x, self.means.shape[1], "GaussianMixtureModel")
         logw, means, chols = self._device_params()
         mesh = getattr(getattr(x, "sharding", None), "mesh", None)
         mesh = mesh if isinstance(mesh, Mesh) else None

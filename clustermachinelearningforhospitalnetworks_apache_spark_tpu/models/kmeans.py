@@ -41,7 +41,7 @@ from ..io.model_io import register_model
 from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
-from .base import ClusteringModel, Estimator, Model, as_device_dataset
+from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
 
 _BIG = jnp.float32(1e30)
 
@@ -310,6 +310,7 @@ class KMeansModel(ClusteringModel):
         return normalize_rows(x) if self.distance_measure == "cosine" else x
 
     def predict(self, x: jax.Array, use_pallas: bool = False) -> jax.Array:
+        check_features(x, self.cluster_centers.shape[1], type(self).__name__)
         centers = jnp.asarray(self.cluster_centers, jnp.float32)
         if use_pallas:
             from ..ops.pallas_kernels import fused_assign

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..io.model_io import register_model
 from ..parallel.sharding import DeviceDataset
-from .base import Estimator, Model, as_device_dataset
+from .base import Estimator, Model, as_device_dataset, check_features
 
 
 def weighted_moments(x, w):
@@ -166,6 +166,7 @@ class LinearRegressionModel(Model):
     intercept: jax.Array
 
     def predict(self, x: jax.Array) -> jax.Array:
+        check_features(x, self.coefficients.shape[0], "LinearRegressionModel")
         return x.astype(jnp.float32) @ self.coefficients + self.intercept
 
     def _artifacts(self):

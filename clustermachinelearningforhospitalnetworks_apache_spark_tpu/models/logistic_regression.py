@@ -28,7 +28,7 @@ from jax import lax
 
 from ..io.model_io import register_model
 from ..parallel.sharding import DeviceDataset
-from .base import Estimator, Model, as_device_dataset
+from .base import Estimator, Model, as_device_dataset, check_features
 from .linear_regression import standardized_design
 
 
@@ -195,6 +195,9 @@ class MultinomialLogisticRegressionModel(Model):
 
     def predict_raw(self, x: jax.Array) -> jax.Array:
         """(n, K) class margins."""
+        check_features(
+            x, self.coefficient_matrix.shape[1], "MultinomialLogisticRegressionModel"
+        )
         return (
             x.astype(jnp.float32) @ self.coefficient_matrix.T
             + self.intercept_vector[None, :]
@@ -235,6 +238,7 @@ class LogisticRegressionModel(Model):
 
     def predict_raw(self, x: jax.Array) -> jax.Array:
         """Log-odds (Spark's rawPrediction margin)."""
+        check_features(x, self.coefficients.shape[0], "LogisticRegressionModel")
         return x.astype(jnp.float32) @ self.coefficients + self.intercept
 
     def predict_proba(self, x: jax.Array) -> jax.Array:

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...io.model_io import register_model
-from ..base import Estimator, Model, as_device_dataset
+from ..base import Estimator, Model, as_device_dataset, check_features
 from .engine import GrownForest, grow_forest, predict_forest
 
 
@@ -44,6 +44,9 @@ class _TreeEnsembleModel(Model):
         return int(2 * splits + self.num_trees)
 
     def _tree_outputs(self, x: jax.Array) -> jax.Array:
+        # a narrower matrix would silently traverse with clipped feature
+        # indices instead of erroring
+        check_features(x, self.feature_importances.shape[-1], type(self).__name__)
         return predict_forest(
             x.astype(jnp.float32),
             jnp.asarray(self.split_feat),

@@ -92,3 +92,23 @@ def test_device_dataset_padding(mesh8):
     ds = ht.device_dataset(x, y, mesh=mesh8)
     assert ds.n_padded == 16  # padded to multiple of 8
     assert float(ds.count()) == 10.0
+
+
+def test_wrong_feature_width_raises_friendly(rng, mesh8):
+    """Predicting with a mismatched feature matrix raises a ValueError
+    naming the model and widths, not a raw XLA dot-dimension error."""
+    import pytest
+
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (x @ np.ones(4)).astype(np.float32)
+    bad = x[:, :3]
+    models = [
+        ht.LinearRegression().fit((x, y), mesh=mesh8),
+        ht.LogisticRegression(max_iter=3).fit((x, (y > 0).astype(np.float32)), mesh=mesh8),
+        ht.KMeans(k=3, seed=0, max_iter=3).fit(x, mesh=mesh8),
+        ht.GaussianMixture(k=2, seed=0, max_iter=3).fit(x, mesh=mesh8),
+        ht.DecisionTreeRegressor(max_depth=2, seed=0).fit((x, y), mesh=mesh8),
+    ]
+    for m in models:
+        with pytest.raises(ValueError, match="features"):
+            m.predict_numpy(bad)
