@@ -1,0 +1,119 @@
+"""Regenerate the bundled hospital-patient CSV (BASELINE config 1 data).
+
+Deterministic: re-running always produces the identical file, so the
+committed ``data/hospital_patients.csv`` can be audited/rebuilt with
+
+    python tools/make_bundled_csv.py
+
+Shape: 20,000 rows in the reference's 7-field streaming schema
+(``mllearnforhospitalnetwork.py:64-72``), drawn from 8 latent operating
+regimes (e.g. "winter surge at a large hospital" vs "summer baseline at a
+clinic") so that KMeans k=8 on the 4 standardized reference features
+(``:134``) recovers well-separated clusters — the "script default"
+clustering workload of BASELINE config 1.  ``length_of_stay`` is a noisy
+linear+interaction function of the features so the reference's supervised
+task (LOS regression / LOS>5 classification, ``:146-158,:176-190``) is
+also learnable from the same table.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+N_ROWS = 20_000
+N_REGIMES = 8
+SEED = 20260614  # reference snapshot date
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "data", "hospital_patients.csv")
+
+# Regime centers in (admission_count, current_occupancy, emergency_visits,
+# seasonality_index) — spread so the standardized clusters are separable
+# (silhouette ≈ 0.92 standardized / 0.70 raw at k=8) but not degenerate.
+_CENTERS = np.array(
+    [
+        #  adm   occ    emerg  season
+        [ 12.0,  80.0,   5.0, 0.80],   # small clinic, off-season
+        [ 18.0, 140.0,   9.0, 1.15],   # small clinic, flu season
+        [ 45.0, 260.0,  18.0, 0.85],   # regional, baseline
+        [ 60.0, 340.0,  30.0, 1.25],   # regional, winter surge
+        [ 95.0, 520.0,  42.0, 0.90],   # metro, baseline
+        [120.0, 640.0,  70.0, 1.30],   # metro, epidemic load
+        [ 30.0, 420.0,  12.0, 1.00],   # long-stay/rehab facility
+        [ 75.0, 210.0,  55.0, 1.10],   # trauma center (ED-heavy)
+    ]
+)
+_SPREAD = np.array([3.5, 22.0, 3.0, 0.045])  # per-feature regime noise (std)
+
+_HOSPITALS_PER_REGIME = 3  # 24 distinct hospital_ids
+
+
+def make_table(rng: np.random.Generator) -> dict[str, np.ndarray]:
+    regime = rng.integers(0, N_REGIMES, size=N_ROWS)
+    feats = _CENTERS[regime] + rng.normal(0.0, 1.0, (N_ROWS, 4)) * _SPREAD
+
+    adm = np.clip(np.rint(feats[:, 0]), 1, None).astype(np.int64)
+    occ = np.clip(np.rint(feats[:, 1]), 5, None).astype(np.int64)
+    emerg = np.clip(np.rint(feats[:, 2]), 0, None).astype(np.int64)
+    season = np.clip(np.round(feats[:, 3], 4), 0.5, 1.6)
+
+    # LOS: base + occupancy pressure + ED mix + seasonal load + noise;
+    # centered near the reference's 5.0-day classification threshold (:49).
+    los = (
+        1.8
+        + 0.006 * occ
+        + 0.030 * emerg
+        + 2.2 * (season - 1.0)
+        + 0.00004 * occ * emerg
+        + rng.normal(0.0, 0.55, N_ROWS)
+    )
+    los = np.clip(np.round(los, 2), 0.5, None)
+
+    # IDs are "<site>-<unit>" (e.g. H03-B): the site prefix groups the
+    # units of one operating regime, matching the per-site rollup in
+    # examples/federated_bisecting.py.
+    hosp = np.array(
+        [f"H{r:02d}-{chr(ord('A') + i)}" for r in range(N_REGIMES)
+         for i in range(_HOSPITALS_PER_REGIME)]
+    )
+    hospital_id = hosp[regime * _HOSPITALS_PER_REGIME
+                       + rng.integers(0, _HOSPITALS_PER_REGIME, size=N_ROWS)]
+
+    # Event times: spread over the reference's training window day
+    # (2025-03-31, CONFIG trainingWindowStart :45) at second granularity.
+    base = np.datetime64("2025-03-31T00:00:00")
+    offsets = np.sort(rng.integers(0, 24 * 3600, size=N_ROWS))
+    event_time = base + offsets.astype("timedelta64[s]")
+
+    return {
+        "hospital_id": hospital_id,
+        "event_time": event_time,
+        "admission_count": adm,
+        "current_occupancy": occ,
+        "emergency_visits": emerg,
+        "seasonality_index": season,
+        "length_of_stay": los,
+    }
+
+
+def main() -> None:
+    rng = np.random.default_rng(SEED)
+    cols = make_table(rng)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    names = list(cols)
+    with open(OUT, "w", newline="\n") as f:
+        f.write(",".join(names) + "\n")
+        et = np.datetime_as_string(cols["event_time"], unit="s")
+        for i in range(N_ROWS):
+            f.write(
+                f"{cols['hospital_id'][i]},{et[i]},"
+                f"{cols['admission_count'][i]},{cols['current_occupancy'][i]},"
+                f"{cols['emergency_visits'][i]},{cols['seasonality_index'][i]:.4f},"
+                f"{cols['length_of_stay'][i]:.2f}\n"
+            )
+    print(f"wrote {N_ROWS} rows -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
