@@ -49,6 +49,7 @@ from .parallel import (
 from .io import load_model, read_csv, read_csv_dir, write_csv
 from .session import Session
 from . import models, streaming, pipeline, utils, viz
+from .pipeline import Pipeline, PipelineModel, load_pipeline_model
 from .models import (
     BisectingKMeans,
     DecisionTreeClassifier,
@@ -90,6 +91,9 @@ __all__ = [
     "device_dataset",
     "use_mesh",
     "load_model",
+    "load_pipeline_model",
+    "Pipeline",
+    "PipelineModel",
     "read_csv",
     "read_csv_dir",
     "write_csv",

@@ -15,14 +15,37 @@ from typing import Sequence
 import numpy as np
 
 from ..core.table import Table
+from ..io.model_io import register_model
 
 
+@register_model("StringIndexerModel")
 @dataclass(frozen=True)
 class StringIndexerModel:
     input_col: str
     output_col: str
     labels: tuple[str, ...]
     handle_invalid: str = "error"  # "error" | "keep" | "skip"
+
+    def _artifacts(self):
+        return (
+            "StringIndexerModel",
+            {
+                "input_col": self.input_col,
+                "output_col": self.output_col,
+                "labels": list(self.labels),
+                "handle_invalid": self.handle_invalid,
+            },
+            {},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            params["input_col"],
+            params["output_col"],
+            tuple(params["labels"]),
+            params.get("handle_invalid", "error"),
+        )
 
     def transform(self, table: Table) -> Table:
         lut = {v: i for i, v in enumerate(self.labels)}

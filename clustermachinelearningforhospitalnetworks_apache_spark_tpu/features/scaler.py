@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..io.model_io import register_model
 from ..parallel.sharding import DeviceDataset
 
 
@@ -39,6 +40,7 @@ def _moments(x: jax.Array, w: jax.Array):
     return mean, jnp.sqrt(jnp.maximum(var, 0.0)), n
 
 
+@register_model("StandardScalerModel")
 @dataclass(frozen=True)
 class StandardScalerModel:
     mean: np.ndarray
@@ -46,14 +48,43 @@ class StandardScalerModel:
     with_mean: bool = True
     with_std: bool = True
 
+    def _artifacts(self):
+        return (
+            "StandardScalerModel",
+            {"with_mean": self.with_mean, "with_std": self.with_std},
+            {"mean": np.asarray(self.mean), "std": np.asarray(self.std)},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            arrays["mean"],
+            arrays["std"],
+            bool(params.get("with_mean", True)),
+            bool(params.get("with_std", True)),
+        )
+
     def transform(self, x):
+        if _is_assembled(x):
+            # AssembledTable in → AssembledTable out (scaled features, source
+            # table kept) so scaler stages compose inside a Pipeline chain.
+            from dataclasses import replace
+
+            return replace(x, features=self.transform(x.features))
+        if isinstance(x, DeviceDataset):
+            return self.transform_dataset(x)
         xp = jnp if isinstance(x, jax.Array) else np
         out = x
+        # explicit [None, :] broadcasts keep jax_numpy_rank_promotion="raise"
+        # (the test sanitizer) happy on 2-D inputs
+        expand = getattr(out, "ndim", 1) == 2
         if self.with_mean:
-            out = out - xp.asarray(self.mean, dtype=out.dtype)
+            mean = xp.asarray(self.mean, dtype=out.dtype)
+            out = out - (mean[None, :] if expand else mean)
         if self.with_std:
             safe = xp.where(xp.asarray(self.std) > 0, xp.asarray(self.std), 1.0)
-            out = out / safe.astype(out.dtype)
+            safe = safe.astype(out.dtype)
+            out = out / (safe[None, :] if expand else safe)
         return out
 
     def transform_dataset(self, ds: DeviceDataset) -> DeviceDataset:

@@ -29,6 +29,11 @@ _REGISTRY: dict[str, Callable[[dict, dict], Any]] = {}
 METADATA_FILE = "metadata.json"
 ARRAYS_FILE = "arrays.npz"
 
+#: model_class tag of the composite pipeline artifact (pipeline/ml_pipeline
+#: .py) — defined here so load_model and PipelineModel share one constant
+#: without an import cycle.
+PIPELINE_CLASS = "PipelineModel"
+
 
 def register_model(name: str):
     """Class decorator: register a ``from_artifacts(metadata, arrays)``
@@ -42,27 +47,45 @@ def register_model(name: str):
     return deco
 
 
-def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarray], overwrite: bool = True) -> None:
+def prepare_artifact_dir(path: str, overwrite: bool) -> None:
+    """Overwrite-or-fail semantics shared by every artifact writer."""
     if os.path.exists(path):
         if not overwrite:
             raise FileExistsError(f"{path} exists and overwrite=False")
         shutil.rmtree(path)
     os.makedirs(path, exist_ok=True)
-    meta = {
-        "model_class": name,
-        "framework_version": __version__,
-        "params": metadata,
-    }
+
+
+def write_metadata(path: str, meta: dict) -> None:
+    """Atomic metadata.json write (tmp file + rename)."""
     tmp = path + ".tmp_meta"
     with open(tmp, "w") as f:
         json.dump(meta, f, indent=2, default=_json_default)
     os.replace(tmp, os.path.join(path, METADATA_FILE))
+
+
+def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarray], overwrite: bool = True) -> None:
+    prepare_artifact_dir(path, overwrite)
+    write_metadata(
+        path,
+        {
+            "model_class": name,
+            "framework_version": __version__,
+            "params": metadata,
+        },
+    )
     np.savez(os.path.join(path, ARRAYS_FILE), **{k: np.asarray(v) for k, v in arrays.items()})
 
 
 def load_model(path: str) -> Any:
     with open(os.path.join(path, METADATA_FILE)) as f:
         meta = json.load(f)
+    if meta.get("model_class") == PIPELINE_CLASS:
+        # composite artifact (pipeline/ml_pipeline.py layout): delegate so
+        # load_model works uniformly on anything save()d by the framework
+        from ..pipeline.ml_pipeline import PipelineModel
+
+        return PipelineModel.load(path, _meta=meta)
     arrays_path = os.path.join(path, ARRAYS_FILE)
     arrays: dict[str, np.ndarray] = {}
     if os.path.exists(arrays_path):

@@ -1,3 +1,10 @@
 from .hospital_pipeline import PipelineResult, run_pipeline
+from .ml_pipeline import Pipeline, PipelineModel, load_pipeline_model
 
-__all__ = ["PipelineResult", "run_pipeline"]
+__all__ = [
+    "Pipeline",
+    "PipelineModel",
+    "PipelineResult",
+    "load_pipeline_model",
+    "run_pipeline",
+]

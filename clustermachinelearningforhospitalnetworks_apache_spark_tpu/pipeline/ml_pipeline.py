@@ -1,0 +1,161 @@
+"""Pipeline / PipelineModel — composable stage chains.
+
+Parity with ``pyspark.ml.Pipeline``: the standard MLlib composition API a
+Spark user reaches for to bundle feature stages and an estimator into one
+fit/transform/save unit.  The reference wires its stages by hand
+(``mllearnforhospitalnetwork.py:134-158`` — assemble, split, fit,
+transform), but any Spark user migrating real code expects ``Pipeline`` to
+exist; this is the Table-native version of that contract.
+
+A *stage* is anything with ``fit`` (estimator — its fitted result replaces
+it in the ``PipelineModel``) or, failing that, ``transform`` (pure
+transformer, carried through as-is).  Data flows through whatever each
+stage produces — ``Table`` → ``AssembledTable`` → ``DeviceDataset`` — so
+the chain stays zero-copy on the mesh once features are device-resident.
+
+Persistence mirrors Spark's layout: one directory per stage
+(``stages/<i>_<ClassName>``) plus a pipeline-level ``metadata.json``;
+every stage round-trips through the same registry as standalone models
+(``io/model_io.py``), so ``load_pipeline_model`` rebuilds the exact chain.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+from ..io.model_io import (
+    METADATA_FILE,
+    PIPELINE_CLASS as _PIPELINE_CLASS,
+    load_model,
+    prepare_artifact_dir,
+    save_model,
+    write_metadata,
+)
+from ..version import __version__
+
+
+def _accepts(fn, name: str) -> bool:
+    try:
+        return name in inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # builtins / C callables
+        return False
+
+
+def _call_stage(fn, data, label_col, mesh):
+    kwargs = {}
+    if label_col is not None and _accepts(fn, "label_col"):
+        kwargs["label_col"] = label_col
+    if mesh is not None and _accepts(fn, "mesh"):
+        kwargs["mesh"] = mesh
+    return fn(data, **kwargs)
+
+
+@dataclass(frozen=True)
+class Pipeline:
+    """Ordered stages; ``fit`` threads the data through them, fitting each
+    estimator stage on the output of everything before it."""
+
+    stages: Sequence[Any]
+
+    def fit(self, data: Any, label_col: str | None = None, mesh=None) -> "PipelineModel":
+        fitted: list[Any] = []
+        cur = data
+        last = len(self.stages) - 1
+        for i, stage in enumerate(self.stages):
+            if hasattr(stage, "fit"):
+                model = _call_stage(stage.fit, cur, label_col, mesh)
+            elif hasattr(stage, "transform"):
+                model = stage
+            else:
+                raise TypeError(
+                    f"pipeline stage {i} ({type(stage).__name__}) has neither "
+                    "fit nor transform"
+                )
+            fitted.append(model)
+            if i < last:
+                cur = _call_stage(model.transform, cur, label_col, mesh)
+        return PipelineModel(tuple(fitted))
+
+
+@dataclass(frozen=True)
+class PipelineModel:
+    """The fitted chain: every stage is now a transformer."""
+
+    stages: tuple[Any, ...]
+
+    def transform(self, data: Any, label_col: str | None = None, mesh=None):
+        cur = data
+        for stage in self.stages:
+            cur = _call_stage(stage.transform, cur, label_col, mesh)
+        return cur
+
+    def _validate_persistable(self, prefix: str = "stage") -> None:
+        """Recursive pre-save check (nested pipelines included) so a failed
+        save can never destroy a previously saved artifact."""
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, PipelineModel):
+                stage._validate_persistable(prefix=f"{prefix} {i} → stage")
+            elif not hasattr(stage, "_artifacts"):
+                raise TypeError(
+                    f"{prefix} {i} ({type(stage).__name__}) is not persistable "
+                    "(no _artifacts); register it with io.model_io"
+                )
+
+    # persistence -------------------------------------------------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        # Validate the whole stage tree BEFORE touching the target path.
+        self._validate_persistable()
+        prepare_artifact_dir(path, overwrite)
+        os.makedirs(os.path.join(path, "stages"))
+        dirs = []
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, PipelineModel):
+                # nested pipeline: recurse into its composite layout;
+                # load_model dispatches on model_class so the round-trip
+                # is uniform
+                d = f"{i}_{_PIPELINE_CLASS}"
+                stage.save(os.path.join(path, "stages", d))
+            else:
+                name, meta, arrays = stage._artifacts()
+                d = f"{i}_{name}"
+                save_model(os.path.join(path, "stages", d), name, meta, arrays)
+            dirs.append(d)
+        write_metadata(
+            path,
+            {
+                "model_class": _PIPELINE_CLASS,
+                "framework_version": __version__,
+                "stage_dirs": dirs,
+            },
+        )
+
+    def write(self):
+        from ..models.base import _Writer
+
+        return _Writer(self)
+
+    @classmethod
+    def load(cls, path: str, _meta: dict | None = None) -> "PipelineModel":
+        if _meta is None:
+            with open(os.path.join(path, METADATA_FILE)) as f:
+                _meta = json.load(f)
+        meta = _meta
+        if meta.get("model_class") != _PIPELINE_CLASS:
+            raise ValueError(
+                f"{path} holds a {meta.get('model_class')!r}, not a PipelineModel; "
+                "use load_model for single-model artifacts"
+            )
+        return cls(
+            tuple(
+                load_model(os.path.join(path, "stages", d))
+                for d in meta["stage_dirs"]
+            )
+        )
+
+
+def load_pipeline_model(path: str) -> PipelineModel:
+    return PipelineModel.load(path)

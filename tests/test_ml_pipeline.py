@@ -1,0 +1,242 @@
+"""Pipeline / PipelineModel — the pyspark.ml.Pipeline composition contract:
+stage chaining through Table → AssembledTable → DeviceDataset, estimator
+stages replaced by their fitted models, full-chain persistence."""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def _split(table):
+    return ht.train_test_split(table, 0.7, 42)
+
+
+def test_supervised_pipeline_matches_manual_chain(hospital_table, mesh8):
+    train, test = _split(hospital_table)
+    pipe = ht.Pipeline(
+        [
+            ht.VectorAssembler(ht.FEATURE_COLS),
+            ht.StandardScaler(),
+            ht.LinearRegression(),
+        ]
+    )
+    pm = pipe.fit(train, mesh=mesh8)
+    assert isinstance(pm, ht.PipelineModel)
+    assert len(pm.stages) == 3
+
+    # manual chain, same stages by hand
+    asm = ht.VectorAssembler(ht.FEATURE_COLS)
+    a_train = asm.transform(train)
+    scaler = ht.StandardScaler().fit(a_train)
+    lr = ht.LinearRegression().fit(scaler.transform(a_train), mesh=mesh8)
+
+    np.testing.assert_allclose(
+        np.asarray(pm.stages[2].coefficients),
+        np.asarray(lr.coefficients),
+        rtol=1e-6,
+    )
+
+    # end-to-end transform on the raw test Table → PredictionResult
+    pred = pm.transform(test, mesh=mesh8)
+    rmse = ht.RegressionEvaluator("rmse").evaluate(pred)
+    manual = ht.RegressionEvaluator("rmse").evaluate(
+        lr.transform(scaler.transform(asm.transform(test)), mesh=mesh8)
+    )
+    np.testing.assert_allclose(rmse, manual, rtol=1e-6)
+    assert rmse < 0.2  # noise sigma 0.1 — the chain actually learned
+
+
+def test_classification_pipeline_with_binarizer(hospital_table, mesh8):
+    train, test = _split(hospital_table)
+    pipe = ht.Pipeline(
+        [
+            ht.Binarizer("length_of_stay", "LOS_binary", 5.0),
+            ht.VectorAssembler(ht.FEATURE_COLS),
+            ht.DecisionTreeClassifier(max_depth=4, label_col="LOS_binary"),
+        ]
+    )
+    pm = pipe.fit(train, label_col="LOS_binary", mesh=mesh8)
+    pred = pm.transform(test, label_col="LOS_binary", mesh=mesh8)
+    acc = ht.MulticlassClassificationEvaluator("accuracy").evaluate(pred)
+    assert acc > 0.85
+
+
+def test_clustering_pipeline_appends_prediction_column(hospital_table, mesh8):
+    pipe = ht.Pipeline(
+        [
+            ht.VectorAssembler(ht.FEATURE_COLS),
+            ht.StandardScaler(),
+            ht.KMeans(k=4, seed=0),
+        ]
+    )
+    pm = pipe.fit(hospital_table, mesh=mesh8)
+    out = pm.transform(hospital_table, mesh=mesh8)
+    # ClusteringModel.transform(AssembledTable) → source Table + prediction
+    assert isinstance(out, ht.Table)
+    assert "prediction" in out.schema
+    p = out.column("prediction")
+    assert p.shape == (len(hospital_table),)
+    assert set(np.unique(p)) <= set(range(4))
+
+
+def test_string_indexer_stage(hospital_table, mesh8):
+    pipe = ht.Pipeline(
+        [
+            ht.StringIndexer("hospital_id", "hospital_idx"),
+            ht.VectorAssembler(ht.FEATURE_COLS + ("hospital_idx",)),
+            ht.LinearRegression(),
+        ]
+    )
+    pm = pipe.fit(hospital_table, mesh=mesh8)
+    # the indexer stage was fitted into its model
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.features.indexer import (
+        StringIndexerModel,
+    )
+
+    assert isinstance(pm.stages[0], StringIndexerModel)
+    assert len(pm.stages[2].coefficients) == 5
+
+
+def test_pipeline_save_load_roundtrip(hospital_table, mesh8, tmp_path):
+    train, test = _split(hospital_table)
+    pm = ht.Pipeline(
+        [
+            ht.VectorAssembler(ht.FEATURE_COLS),
+            ht.StandardScaler(),
+            ht.LinearRegression(),
+        ]
+    ).fit(train, mesh=mesh8)
+    path = os.path.join(tmp_path, "pm")
+    pm.write().overwrite().save(path)
+
+    for loader in (ht.load_pipeline_model, ht.load_model):
+        back = loader(path)
+        assert isinstance(back, ht.PipelineModel)
+        assert [type(s).__name__ for s in back.stages] == [
+            type(s).__name__ for s in pm.stages
+        ]
+        p0, l0 = pm.transform(test, mesh=mesh8).to_numpy()
+        p1, l1 = back.transform(test, mesh=mesh8).to_numpy()
+        np.testing.assert_allclose(p0, p1, rtol=1e-6)
+        np.testing.assert_allclose(l0, l1)
+
+    with pytest.raises(FileExistsError):
+        pm.save(path, overwrite=False)
+
+
+def test_feature_stage_artifacts_roundtrip(hospital_table, tmp_path):
+    """Every feature stage persists standalone through the model registry
+    (Spark's MLWritable on feature transformers)."""
+    asm = ht.VectorAssembler(ht.FEATURE_COLS)
+    a = asm.transform(hospital_table)
+    stages = [
+        asm,
+        ht.Binarizer("length_of_stay", "LOS_binary", 5.0),
+        ht.StringIndexer("hospital_id", "idx").fit(hospital_table),
+        ht.StandardScaler().fit(a),
+    ]
+    for i, st in enumerate(stages):
+        name, meta, arrays = st._artifacts()
+        p = os.path.join(tmp_path, f"s{i}")
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.model_io import (
+            load_model as lm,
+            save_model as sm,
+        )
+
+        sm(p, name, meta, arrays)
+        back = lm(p)
+        assert type(back) is type(st)
+    # the scaler round-trips its arrays exactly
+    back = lm(os.path.join(tmp_path, "s3"))
+    np.testing.assert_allclose(back.mean, stages[3].mean)
+    np.testing.assert_allclose(back.std, stages[3].std)
+
+
+def test_device_dataset_scaler_chain(hospital_table, mesh8):
+    """The scaler stage consumes a DeviceDataset mid-chain (features scaled
+    in place on the mesh, labels/weights carried through)."""
+    a = ht.VectorAssembler(ht.FEATURE_COLS).transform(hospital_table)
+    ds = a.to_device(mesh=mesh8)
+    pm = ht.Pipeline([ht.StandardScaler(), ht.KMeans(k=3, seed=0)]).fit(
+        ds, mesh=mesh8
+    )
+    # parity with the AssembledTable route
+    pm2 = ht.Pipeline(
+        [ht.VectorAssembler(ht.FEATURE_COLS), ht.StandardScaler(), ht.KMeans(k=3, seed=0)]
+    ).fit(hospital_table, mesh=mesh8)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(pm.stages[1].cluster_centers), axis=0),
+        np.sort(np.asarray(pm2.stages[2].cluster_centers), axis=0),
+        atol=1e-5,
+    )
+
+
+def test_nested_pipeline_roundtrip(hospital_table, mesh8, tmp_path):
+    """A PipelineModel can itself be a stage of a saved pipeline (Spark
+    nests pipelines; persistence recurses into the composite layout)."""
+    train, test = _split(hospital_table)
+    feats = ht.Pipeline(
+        [ht.VectorAssembler(ht.FEATURE_COLS), ht.StandardScaler()]
+    ).fit(train)
+    outer = ht.Pipeline([feats, ht.LinearRegression()]).fit(train, mesh=mesh8)
+    path = os.path.join(tmp_path, "nested")
+    outer.save(path)
+    back = ht.load_model(path)
+    assert isinstance(back.stages[0], ht.PipelineModel)
+    p0, _ = outer.transform(test, mesh=mesh8).to_numpy()
+    p1, _ = back.transform(test, mesh=mesh8).to_numpy()
+    np.testing.assert_allclose(p0, p1, rtol=1e-6)
+
+
+def test_unpersistable_stage_raises(hospital_table, mesh8, tmp_path):
+    class Opaque:
+        def transform(self, data):
+            return data
+
+    pm = ht.Pipeline([Opaque(), ht.VectorAssembler(ht.FEATURE_COLS),
+                      ht.LinearRegression()]).fit(hospital_table, mesh=mesh8)
+    with pytest.raises(TypeError, match="not persistable"):
+        pm.save(os.path.join(tmp_path, "x"))
+
+
+def test_failed_save_preserves_existing_artifact(hospital_table, mesh8, tmp_path):
+    """Save validates all stages before touching the target path: a failed
+    overwrite never deletes the previously saved good artifact."""
+    class Opaque:
+        def transform(self, data):
+            return data
+
+    path = os.path.join(tmp_path, "pm")
+    good = ht.Pipeline(
+        [ht.VectorAssembler(ht.FEATURE_COLS), ht.LinearRegression()]
+    ).fit(hospital_table, mesh=mesh8)
+    good.save(path)
+    bad = ht.Pipeline([Opaque(), ht.VectorAssembler(ht.FEATURE_COLS),
+                       ht.LinearRegression()]).fit(hospital_table, mesh=mesh8)
+    with pytest.raises(TypeError, match="not persistable"):
+        bad.save(path, overwrite=True)
+    # the old artifact still loads
+    back = ht.load_pipeline_model(path)
+    assert len(back.stages) == 2
+
+    # validation recurses into nested pipelines: an unpersistable stage
+    # buried one level down must also fail BEFORE the old artifact is
+    # touched
+    inner = ht.Pipeline([Opaque(), ht.VectorAssembler(ht.FEATURE_COLS)]).fit(
+        hospital_table
+    )
+    nested_bad = ht.Pipeline([inner, ht.LinearRegression()]).fit(
+        hospital_table, mesh=mesh8
+    )
+    with pytest.raises(TypeError, match="not persistable"):
+        nested_bad.save(path, overwrite=True)
+    back = ht.load_pipeline_model(path)
+    assert len(back.stages) == 2
+
+
+def test_stage_without_fit_or_transform_raises(hospital_table):
+    with pytest.raises(TypeError, match="neither"):
+        ht.Pipeline([object()]).fit(hospital_table)
