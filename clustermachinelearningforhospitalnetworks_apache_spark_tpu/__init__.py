@@ -48,8 +48,15 @@ from .parallel import (
 )
 from .io import load_model, read_csv, read_csv_dir, write_csv
 from .session import Session
-from . import models, streaming, pipeline, utils, viz
+from . import models, streaming, pipeline, tuning, utils, viz
 from .pipeline import Pipeline, PipelineModel, load_pipeline_model
+from .tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
 from .models import (
     BisectingKMeans,
     DecisionTreeClassifier,
@@ -94,6 +101,12 @@ __all__ = [
     "load_pipeline_model",
     "Pipeline",
     "PipelineModel",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "ParamGridBuilder",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
+    "tuning",
     "read_csv",
     "read_csv_dir",
     "write_csv",

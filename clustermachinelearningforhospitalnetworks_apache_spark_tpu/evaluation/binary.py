@@ -90,6 +90,11 @@ class BinaryClassificationEvaluator:
 
     metric_name: str = "areaUnderROC"
 
+    @property
+    def is_larger_better(self) -> bool:
+        """Spark's ``isLargerBetter`` — both AUC metrics are."""
+        return True
+
     def evaluate(self, predictions, labels=None, weights=None) -> float:
         if labels is None:
             scores = predictions.prediction

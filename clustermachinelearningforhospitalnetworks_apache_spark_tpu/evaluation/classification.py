@@ -33,6 +33,11 @@ class MulticlassClassificationEvaluator:
     prediction_col: str = "prediction"
     num_classes: int = 2
 
+    @property
+    def is_larger_better(self) -> bool:
+        """Spark's ``isLargerBetter`` — every multiclass metric here is."""
+        return True
+
     def confusion_matrix(self, pred, label, w=None) -> np.ndarray:
         pred = jnp.asarray(pred)
         label = jnp.asarray(label)

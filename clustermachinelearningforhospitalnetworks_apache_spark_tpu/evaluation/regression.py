@@ -44,6 +44,11 @@ class RegressionEvaluator:
     label_col: str = "length_of_stay"
     prediction_col: str = "prediction"
 
+    @property
+    def is_larger_better(self) -> bool:
+        """Spark's ``isLargerBetter`` — model selection direction."""
+        return self.metric_name == "r2"
+
     def evaluate(self, predictions, labels=None, weights=None) -> float:
         """Accepts either a PredictionResult-like object (``.prediction``,
         ``.label``, ``.weight`` device arrays) or explicit arrays."""

@@ -34,6 +34,48 @@ ARRAYS_FILE = "arrays.npz"
 #: without an import cycle.
 PIPELINE_CLASS = "PipelineModel"
 
+#: Composite artifacts (directory layouts beyond metadata+arrays) register a
+#: ``(path, meta) -> model`` loader here so ``load_model`` dispatches them
+#: uniformly.  Values are import-path strings resolved lazily to avoid
+#: module cycles: "pkg.module:ClassName" → ClassName.load(path, _meta=meta).
+_COMPOSITE_LOADERS: dict[str, str] = {
+    PIPELINE_CLASS: "clustermachinelearningforhospitalnetworks_apache_spark_tpu.pipeline.ml_pipeline:PipelineModel",
+}
+
+
+def register_composite(name: str, import_path: str) -> None:
+    """Register a composite artifact class (``"pkg.module:Class"``) whose
+    ``load(path, _meta=meta)`` rebuilds it."""
+    _COMPOSITE_LOADERS[name] = import_path
+
+
+def is_composite(obj: Any) -> bool:
+    """True when ``obj`` saves through its own registered composite layout
+    (PipelineModel, CrossValidatorModel, …) rather than metadata+arrays."""
+    return type(obj).__name__ in _COMPOSITE_LOADERS and hasattr(obj, "save")
+
+
+def validate_persistable(obj: Any, label: str = "model") -> None:
+    """Raise TypeError if ``obj`` (or, recursively, anything inside a
+    composite) cannot be saved — called BEFORE touching any target path so
+    a failed save never destroys an existing artifact."""
+    deep = getattr(obj, "_validate_persistable", None)
+    if deep is not None:
+        deep()
+    elif not (hasattr(obj, "_artifacts") or is_composite(obj)):
+        raise TypeError(
+            f"{label} ({type(obj).__name__}) is not persistable "
+            "(no _artifacts); register it with io.model_io"
+        )
+
+
+def _load_composite(name: str, path: str, meta: dict) -> Any:
+    import importlib
+
+    mod_name, cls_name = _COMPOSITE_LOADERS[name].split(":")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    return cls.load(path, _meta=meta)
+
 
 def register_model(name: str):
     """Class decorator: register a ``from_artifacts(metadata, arrays)``
@@ -80,12 +122,10 @@ def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarra
 def load_model(path: str) -> Any:
     with open(os.path.join(path, METADATA_FILE)) as f:
         meta = json.load(f)
-    if meta.get("model_class") == PIPELINE_CLASS:
-        # composite artifact (pipeline/ml_pipeline.py layout): delegate so
-        # load_model works uniformly on anything save()d by the framework
-        from ..pipeline.ml_pipeline import PipelineModel
-
-        return PipelineModel.load(path, _meta=meta)
+    if meta.get("model_class") in _COMPOSITE_LOADERS:
+        # composite artifact (own directory layout): delegate so load_model
+        # works uniformly on anything save()d by the framework
+        return _load_composite(meta["model_class"], path, meta)
     arrays_path = os.path.join(path, ARRAYS_FILE)
     arrays: dict[str, np.ndarray] = {}
     if os.path.exists(arrays_path):

@@ -30,9 +30,11 @@ from typing import Any, Sequence
 from ..io.model_io import (
     METADATA_FILE,
     PIPELINE_CLASS as _PIPELINE_CLASS,
+    is_composite,
     load_model,
     prepare_artifact_dir,
     save_model,
+    validate_persistable,
     write_metadata,
 )
 from ..version import __version__
@@ -94,16 +96,10 @@ class PipelineModel:
         return cur
 
     def _validate_persistable(self, prefix: str = "stage") -> None:
-        """Recursive pre-save check (nested pipelines included) so a failed
+        """Recursive pre-save check (nested composites included) so a failed
         save can never destroy a previously saved artifact."""
         for i, stage in enumerate(self.stages):
-            if isinstance(stage, PipelineModel):
-                stage._validate_persistable(prefix=f"{prefix} {i} → stage")
-            elif not hasattr(stage, "_artifacts"):
-                raise TypeError(
-                    f"{prefix} {i} ({type(stage).__name__}) is not persistable "
-                    "(no _artifacts); register it with io.model_io"
-                )
+            validate_persistable(stage, label=f"{prefix} {i}")
 
     # persistence -------------------------------------------------------
     def save(self, path: str, overwrite: bool = True) -> None:
@@ -113,11 +109,11 @@ class PipelineModel:
         os.makedirs(os.path.join(path, "stages"))
         dirs = []
         for i, stage in enumerate(self.stages):
-            if isinstance(stage, PipelineModel):
-                # nested pipeline: recurse into its composite layout;
-                # load_model dispatches on model_class so the round-trip
-                # is uniform
-                d = f"{i}_{_PIPELINE_CLASS}"
+            if is_composite(stage):
+                # nested composite (pipeline, CV/TVS selection model, …):
+                # recurse into its own layout; load_model dispatches on
+                # model_class so the round-trip is uniform
+                d = f"{i}_{type(stage).__name__}"
                 stage.save(os.path.join(path, "stages", d))
             else:
                 name, meta, arrays = stage._artifacts()

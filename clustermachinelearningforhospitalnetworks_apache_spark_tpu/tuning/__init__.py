@@ -1,0 +1,15 @@
+from .tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
+
+__all__ = [
+    "CrossValidator",
+    "CrossValidatorModel",
+    "ParamGridBuilder",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
+]

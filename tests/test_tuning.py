@@ -1,0 +1,249 @@
+"""pyspark.ml.tuning parity: ParamGridBuilder grids, CrossValidator k-fold
+selection, TrainValidationSplit, param application to estimators and
+Pipeline stages, selection-model persistence."""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.tuning.tuning import (
+    apply_params,
+)
+
+
+def test_param_grid_builder_cartesian():
+    grid = (
+        ht.ParamGridBuilder()
+        .add_grid("reg_param", [0.0, 0.1, 1.0])
+        .add_grid("elastic_net_param", [0.0, 1.0])
+        .base_on({"max_iter": 500})
+        .build()
+    )
+    assert len(grid) == 6
+    assert all(g["max_iter"] == 500 for g in grid)
+    assert {(g["reg_param"], g["elastic_net_param"]) for g in grid} == {
+        (r, a) for r in (0.0, 0.1, 1.0) for a in (0.0, 1.0)
+    }
+    with pytest.raises(ValueError, match="empty"):
+        ht.ParamGridBuilder().add_grid("x", [])
+
+
+def test_apply_params_estimator_and_pipeline():
+    est = ht.LinearRegression()
+    out = apply_params(est, {"reg_param": 0.5})
+    assert out.reg_param == 0.5 and est.reg_param != 0.5  # copy, not mutation
+
+    pipe = ht.Pipeline(
+        [ht.VectorAssembler(ht.FEATURE_COLS), ht.StandardScaler(), ht.LinearRegression()]
+    )
+    # bare key lands on the last stage having the field
+    p2 = apply_params(pipe, {"reg_param": 0.3})
+    assert p2.stages[2].reg_param == 0.3
+    # dotted key targets an explicit stage
+    p3 = apply_params(pipe, {"1.with_mean": False})
+    assert p3.stages[1].with_mean is False
+
+    with pytest.raises(ValueError, match="no param"):
+        apply_params(est, {"nope": 1})
+    with pytest.raises(ValueError, match="no pipeline stage"):
+        apply_params(pipe, {"nope": 1})
+    with pytest.raises(ValueError, match="out of range"):
+        apply_params(pipe, {"9.reg_param": 1.0})
+
+
+def _ridge_data(rng, n=3000, d=8):
+    """Few informative dims + noise: heavy regularization should LOSE on
+    validation rmse, so the grid has a clear right answer (lam=0)."""
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([2.0, -1.0, 1.5, 0.0, 0.0, 0.5, -2.5, 1.0])
+    y = (x @ beta + 0.2 * rng.normal(size=n)).astype(np.float32)
+    return x, y
+
+
+def test_cross_validator_selects_lowest_rmse(rng, mesh8):
+    x, y = _ridge_data(rng)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 1000.0]).build()
+    cv = ht.CrossValidator(
+        estimator=ht.LinearRegression(),
+        param_maps=grid,
+        evaluator=ht.RegressionEvaluator("rmse"),
+        num_folds=3,
+        seed=7,
+    )
+    cvm = cv.fit((x, y), mesh=mesh8)
+    assert cvm.best_index == 0  # rmse is smaller-better; lam=0 wins
+    assert cvm.avg_metrics[0] < cvm.avg_metrics[1]
+    assert cvm.avg_metrics.shape == (2,)
+    assert cvm.fold_metrics.shape == (2, 3)
+    # best model was refit on the FULL data
+    pred = cvm.transform((x, y), mesh=mesh8)
+    rmse = ht.RegressionEvaluator("rmse").evaluate(pred)
+    assert rmse < 0.3
+
+
+def test_cross_validator_larger_better_metric(rng, mesh8, hospital_table):
+    """Accuracy (larger-better) flips the argbest direction."""
+    pipe = ht.Pipeline(
+        [
+            ht.Binarizer("length_of_stay", "LOS_binary", 5.0),
+            ht.VectorAssembler(ht.FEATURE_COLS),
+            ht.DecisionTreeClassifier(label_col="LOS_binary"),
+        ]
+    )
+    grid = ht.ParamGridBuilder().add_grid("max_depth", [1, 5]).build()
+    cv = ht.CrossValidator(
+        estimator=pipe,
+        param_maps=grid,
+        evaluator=ht.MulticlassClassificationEvaluator("accuracy"),
+        num_folds=2,
+        seed=3,
+    )
+    cvm = cv.fit(hospital_table, label_col="LOS_binary", mesh=mesh8)
+    # depth 5 separates the LOS signal better than a stump
+    assert cvm.best_index == 1
+    assert cvm.avg_metrics[1] >= cvm.avg_metrics[0]
+
+
+def test_cross_validator_on_assembled_table(hospital_table, mesh8):
+    asm = ht.VectorAssembler(ht.FEATURE_COLS).transform(hospital_table)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 100.0]).build()
+    cvm = ht.CrossValidator(
+        estimator=ht.LinearRegression(),
+        param_maps=grid,
+        evaluator=ht.RegressionEvaluator("rmse"),
+        num_folds=2,
+        seed=0,
+    ).fit(asm, mesh=mesh8)
+    assert cvm.best_index == 0
+
+
+def test_train_validation_split(rng, mesh8):
+    x, y = _ridge_data(rng)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 1000.0]).build()
+    tvs = ht.TrainValidationSplit(
+        estimator=ht.LinearRegression(),
+        param_maps=grid,
+        evaluator=ht.RegressionEvaluator("rmse"),
+        train_ratio=0.75,
+        seed=5,
+    )
+    m = tvs.fit((x, y), mesh=mesh8)
+    assert m.best_index == 0
+    assert m.validation_metrics.shape == (2,)
+    with pytest.raises(ValueError, match="train_ratio"):
+        ht.TrainValidationSplit(
+            ht.LinearRegression(), grid, ht.RegressionEvaluator(), train_ratio=1.5
+        ).fit((x, y))
+
+
+def test_selection_model_persistence(rng, mesh8, tmp_path):
+    x, y = _ridge_data(rng)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 10.0]).build()
+    cvm = ht.CrossValidator(
+        ht.LinearRegression(), grid, ht.RegressionEvaluator("rmse"),
+        num_folds=2, seed=1,
+    ).fit((x, y), mesh=mesh8)
+    p = os.path.join(tmp_path, "cvm")
+    cvm.write().overwrite().save(p)
+    back = ht.load_model(p)  # composite dispatch through the registry
+    assert isinstance(back, ht.CrossValidatorModel)
+    np.testing.assert_allclose(back.avg_metrics, cvm.avg_metrics)
+    assert back.best_index == cvm.best_index
+    assert back.param_maps == cvm.param_maps
+    a, _ = cvm.transform((x, y), mesh=mesh8).to_numpy()
+    b, _ = back.transform((x, y), mesh=mesh8).to_numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    tvm = ht.TrainValidationSplit(
+        ht.LinearRegression(), grid, ht.RegressionEvaluator("rmse"), seed=2
+    ).fit((x, y), mesh=mesh8)
+    p2 = os.path.join(tmp_path, "tvm")
+    tvm.save(p2)
+    back2 = ht.load_model(p2)
+    assert isinstance(back2, ht.TrainValidationSplitModel)
+    np.testing.assert_allclose(back2.validation_metrics, tvm.validation_metrics)
+
+
+def test_cross_validator_clustering_silhouette(rng, mesh8):
+    """Clustering estimators tune through ClusteringEvaluator's
+    (features, assignments) signature: the silhouette-best k wins."""
+    centers = np.array([[0, 0], [8, 8], [-8, 8]], dtype=np.float32)
+    x = np.concatenate(
+        [c + rng.normal(0, 0.4, size=(300, 2)).astype(np.float32) for c in centers]
+    )
+    grid = ht.ParamGridBuilder().add_grid("k", [2, 3]).build()
+    cvm = ht.CrossValidator(
+        estimator=ht.KMeans(seed=0),
+        param_maps=grid,
+        evaluator=ht.ClusteringEvaluator(),
+        num_folds=2,
+        seed=9,
+    ).fit(x, mesh=mesh8)
+    assert cvm.best_index == 1  # true k=3 has the higher silhouette
+    assert cvm.avg_metrics[1] > cvm.avg_metrics[0]
+
+
+def test_cv_model_as_pipeline_stage_persists(rng, mesh8, tmp_path):
+    """Spark's CV-inside-Pipeline pattern: the fitted selection model is a
+    pipeline stage and the whole thing persists through the composite
+    registry."""
+    x, y = _ridge_data(rng, n=500)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 10.0]).build()
+    cv = ht.CrossValidator(
+        ht.LinearRegression(), grid, ht.RegressionEvaluator("rmse"),
+        num_folds=2, seed=1,
+    )
+    pm = ht.Pipeline([cv]).fit((x, y), mesh=mesh8)
+    assert isinstance(pm.stages[0], ht.CrossValidatorModel)
+    p = os.path.join(tmp_path, "pm_cv")
+    pm.save(p)
+    back = ht.load_model(p)
+    assert isinstance(back.stages[0], ht.CrossValidatorModel)
+    a, _ = pm.transform((x, y), mesh=mesh8).to_numpy()
+    b, _ = back.transform((x, y), mesh=mesh8).to_numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_selection_save_preserves_existing_artifact(rng, mesh8, tmp_path):
+    """A failed selection-model save must not destroy the old artifact."""
+    import dataclasses
+
+    x, y = _ridge_data(rng, n=400)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0]).build()
+    cvm = ht.CrossValidator(
+        ht.LinearRegression(), grid, ht.RegressionEvaluator("rmse"),
+        num_folds=2,
+    ).fit((x, y), mesh=mesh8)
+    p = os.path.join(tmp_path, "cvm")
+    cvm.save(p)
+
+    class Opaque:
+        def transform(self, data):
+            return data
+
+    bad = dataclasses.replace(cvm, best_model=Opaque())
+    with pytest.raises(TypeError, match="not persistable"):
+        bad.save(p, overwrite=True)
+    assert isinstance(ht.load_model(p), ht.CrossValidatorModel)
+
+
+def test_cv_validation_errors(rng):
+    x, y = _ridge_data(rng, n=100)
+    with pytest.raises(ValueError, match="num_folds"):
+        ht.CrossValidator(
+            ht.LinearRegression(), [{}], ht.RegressionEvaluator(), num_folds=1
+        ).fit((x, y))
+    with pytest.raises(ValueError, match="param_maps"):
+        ht.CrossValidator(
+            ht.LinearRegression(), [], ht.RegressionEvaluator()
+        ).fit((x, y))
+
+
+def test_evaluator_is_larger_better_flags():
+    assert not ht.RegressionEvaluator("rmse").is_larger_better
+    assert ht.RegressionEvaluator("r2").is_larger_better
+    assert ht.MulticlassClassificationEvaluator("accuracy").is_larger_better
+    assert ht.BinaryClassificationEvaluator().is_larger_better
+    assert ht.ClusteringEvaluator().is_larger_better
