@@ -58,10 +58,11 @@ def is_composite(obj: Any) -> bool:
 def validate_persistable(obj: Any, label: str = "model") -> None:
     """Raise TypeError if ``obj`` (or, recursively, anything inside a
     composite) cannot be saved — called BEFORE touching any target path so
-    a failed save never destroys an existing artifact."""
+    a failed save never destroys an existing artifact.  ``label`` carries
+    the path context ("stage 0 → bestModel …") into the error."""
     deep = getattr(obj, "_validate_persistable", None)
     if deep is not None:
-        deep()
+        deep(prefix=f"{label} → ")
     elif not (hasattr(obj, "_artifacts") or is_composite(obj)):
         raise TypeError(
             f"{label} ({type(obj).__name__}) is not persistable "

@@ -53,7 +53,9 @@ from ..parallel.sharding import DeviceDataset
 from .base import Estimator, as_device_dataset
 from .kmeans import KMeansModel, _chunked
 
-_BIG = jnp.float32(1e30)
+# np scalar, not jnp: a module-level jnp constant would initialize
+# the backend at import time (hangs when the TPU tunnel is down)
+_BIG = np.float32(1e30)
 
 
 @lru_cache(maxsize=32)

@@ -43,7 +43,9 @@ from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
 from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
 
-_BIG = jnp.float32(1e30)
+# np scalar, not jnp: a module-level jnp constant would initialize
+# the backend at import time (hangs when the TPU tunnel is down)
+_BIG = np.float32(1e30)
 
 
 def _finalize_lloyd(sums, counts, cost, centers, c_valid, cosine: bool):

@@ -45,19 +45,17 @@ def _batch_stats(x, w, centers):
     return sums, counts, cost
 
 
-@lru_cache(maxsize=32)
-def _make_update_step(k: int, alpha_mode: str, alpha_param: float):
-    """One jitted device step per micro-batch: assignment stats, decayed
-    merge, and the dying-cluster reseed — no host synchronization.  The
-    stream state (centers, weights) stays on device between batches; only
-    ``latest_model`` pulls it to host.
+def _step_body(k: int, alpha_mode: str, alpha_param: float):
+    """The micro-batch update rule: assignment stats, decayed merge, and
+    the dying-cluster reseed.  Shared verbatim between the one-batch step
+    and the ``update_many`` scan so their results are bit-identical.
 
     Weights are carried as a Kahan (value, compensation) pair: JAX on TPU
     has no f64, and with decay 1.0 a single f32 accumulator stops growing
     once a cluster passes 2²⁴ points — the compensated sum keeps absorbing
     per-batch counts exactly."""
 
-    def step(x, w, centers, w_hi, w_lo, key):
+    def body(x, w, centers, w_hi, w_lo, key):
         sums, counts, _ = _batch_stats(x, w, centers)
         m = jnp.sum(counts)
         if alpha_mode == "points":
@@ -84,7 +82,7 @@ def _make_update_step(k: int, alpha_mode: str, alpha_param: float):
         # Dying-cluster reseed (Spark rule): walk clusters in index order,
         # splitting the current-heaviest for each effectively-dead one.
         # Touched entries collapse their Kahan pair (hi=split, lo=0).
-        def body(i, carry):
+        def reseed(i, carry):
             cen, hi, lo, key = carry
             eff = hi + lo
             total = jnp.sum(eff)
@@ -102,11 +100,73 @@ def _make_update_step(k: int, alpha_mode: str, alpha_param: float):
             return cen, hi, lo, key
 
         centers, new_hi, new_lo, _ = jax.lax.fori_loop(
-            0, k, body, (centers, new_hi, new_lo, key)
+            0, k, reseed, (centers, new_hi, new_lo, key)
         )
         return centers, new_hi, new_lo
 
+    return body
+
+
+@lru_cache(maxsize=32)
+def _make_update_step(k: int, alpha_mode: str, alpha_param: float, seed: int):
+    """One jitted device call per micro-batch — no host synchronization.
+    The stream state (centers, weights) stays on device between batches;
+    only ``latest_model`` pulls it to host.  The per-step RNG key is
+    derived INSIDE the jit (``fold_in(key(seed), step)``), so an update
+    dispatches exactly one executable — on tunneled chips every extra host
+    op is a network round trip."""
+    body = _step_body(k, alpha_mode, alpha_param)
+
+    def step(x, w, centers, w_hi, w_lo, steps):
+        key = jax.random.fold_in(jax.random.key(seed), steps)
+        return body(x, w, centers, w_hi, w_lo, key)
+
     return jax.jit(step)
+
+
+@lru_cache(maxsize=32)
+def _make_update_many(k: int, alpha_mode: str, alpha_param: float, seed: int):
+    """Backlog drain: ``lax.scan`` of the SAME per-batch body over a
+    stacked (B, n, d) batch tensor — one transfer + one dispatch for the
+    whole backlog instead of B of each, with results bit-identical to B
+    sequential ``update`` calls (each scan step folds in its own key and
+    applies its own decayed merge)."""
+    body = _step_body(k, alpha_mode, alpha_param)
+
+    def drain(xs, ws, centers, w_hi, w_lo, steps0):
+        base = jax.random.key(seed)
+
+        def scan_step(carry, xw):
+            centers, hi, lo, steps = carry
+            x, w = xw
+            key = jax.random.fold_in(base, steps)
+            centers, hi, lo = body(x, w, centers, hi, lo, key)
+            return (centers, hi, lo, steps + 1), None
+
+        (centers, w_hi, w_lo, _), _ = jax.lax.scan(
+            scan_step, (centers, w_hi, w_lo, steps0), (xs, ws)
+        )
+        return centers, w_hi, w_lo
+
+    return jax.jit(drain)
+
+
+def _host_rows(batch) -> np.ndarray:
+    """Coerce one backlog entry to a host (n, d) feature matrix — the same
+    input forms :meth:`StreamingKMeans.update` accepts (bare array,
+    ``(x, y)`` tuple, AssembledTable, DeviceDataset); clustering ignores
+    labels, and a DeviceDataset's pad rows are dropped via its weights."""
+    from ..features.assembler import AssembledTable
+
+    if isinstance(batch, DeviceDataset):
+        x = np.asarray(jax.device_get(batch.x), dtype=np.float32)
+        w = np.asarray(jax.device_get(batch.w))
+        return np.atleast_2d(x[w > 0])
+    if isinstance(batch, AssembledTable):
+        return np.atleast_2d(np.asarray(batch.features, dtype=np.float32))
+    if isinstance(batch, tuple) and len(batch) == 2:
+        return np.atleast_2d(np.asarray(batch[0], dtype=np.float32))
+    return np.atleast_2d(np.asarray(batch, dtype=np.float32))
 
 
 @register_model("StreamingKMeansModel")
@@ -194,35 +254,82 @@ class StreamingKMeans:
            itself has no such attributes)."""
         mesh = mesh or default_mesh()
         ds = as_device_dataset(batch, mesh=mesh)
-        x = ds.x.astype(jnp.float32)
-        if self._centers is None:
-            # lazily init from the first batch: k-means++ seeding + short
-            # Lloyd refinement (raw ++ points alone are a poor init when two
-            # clusters are close)
-            from ..parallel.sharding import sample_valid_rows
-            from .kmeans import _kmeans_pp_init, _lloyd_refine
+        self._ensure_centers(ds)
+        mode, param = self._alpha()
+        step = _make_update_step(self.k, mode, param, self.seed)
+        self._centers, self._weights, self._weights_lo = step(
+            ds.x, ds.w, self._centers, self._weights, self._weights_lo,
+            np.int32(self._steps),
+        )
+        self._steps += 1
+        return self
 
-            host = sample_valid_rows(
-                DeviceDataset(x, ds.y, ds.w), 65536, self.seed
-            )
-            self.set_initial_centers(
-                _lloyd_refine(host, _kmeans_pp_init(host, self.k, self.seed), iters=10)
-            )
+    def update_many(self, batches, mesh=None) -> "StreamingKMeans":
+        """Drain a backlog: apply every micro-batch's decayed update in one
+        stacked transfer + one device dispatch (``lax.scan`` over batches)
+        — the same per-batch rule as :meth:`update`, bit-identical for
+        equal-length batches (identical shapes → identical XLA reduction
+        tiling) and ulp-identical for ragged ones (shorter batches are
+        padded with inert zero-weight rows, which shifts f32 reduction
+        order only).  On tunneled chips — where each dispatch pays a
+        network round trip — this is the difference between per-batch
+        latency and compute-bound throughput.
+        """
+        mesh = mesh or default_mesh()
+        batches = [_host_rows(b) for b in batches]
+        if not batches:
+            return self
+        from ..parallel.mesh import DATA_AXIS
+        from ..parallel.sharding import pad_rows
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._centers is None:
+            first = batches[0]
+            self.update(first, mesh=mesh)
+            batches = batches[1:]
+            if not batches:
+                return self
+        n_pad = pad_rows(max(b.shape[0] for b in batches), mesh.shape[DATA_AXIS])
+        d = batches[0].shape[1]
+        B = len(batches)
+        xs = np.zeros((B, n_pad, d), dtype=np.float32)
+        ws = np.zeros((B, n_pad), dtype=np.float32)
+        for i, b in enumerate(batches):
+            xs[i, : b.shape[0]] = b
+            ws[i, : b.shape[0]] = 1.0
+        xs = jax.device_put(xs, NamedSharding(mesh, P(None, DATA_AXIS, None)))
+        ws = jax.device_put(ws, NamedSharding(mesh, P(None, DATA_AXIS)))
+        mode, param = self._alpha()
+        drain = _make_update_many(self.k, mode, param, self.seed)
+        self._centers, self._weights, self._weights_lo = drain(
+            xs, ws, self._centers, self._weights, self._weights_lo,
+            np.int32(self._steps),
+        )
+        self._steps += B
+        return self
+
+    def _ensure_centers(self, ds: DeviceDataset) -> None:
+        if self._centers is not None:
+            return
+        # lazily init from the first batch: k-means++ seeding + short
+        # Lloyd refinement (raw ++ points alone are a poor init when two
+        # clusters are close)
+        from ..parallel.sharding import sample_valid_rows
+        from .kmeans import _kmeans_pp_init, _lloyd_refine
+
+        host = sample_valid_rows(ds, 65536, self.seed)
+        self.set_initial_centers(
+            _lloyd_refine(host, _kmeans_pp_init(host, self.k, self.seed), iters=10)
+        )
+
+    def _alpha(self) -> tuple[str, float]:
         if self.half_life is not None:
             if self.time_unit not in ("points", "batches"):
                 raise ValueError(
                     f"time_unit must be 'points' or 'batches', got {self.time_unit!r}"
                 )
-            mode, param = self.time_unit, float(self.half_life)
-        else:
-            mode, param = "decay", float(self.decay_factor)
-        step = _make_update_step(self.k, mode, param)
-        key = jax.random.fold_in(jax.random.key(self.seed), self._steps)
-        self._centers, self._weights, self._weights_lo = step(
-            x, ds.w, self._centers, self._weights, self._weights_lo, key
-        )
-        self._steps += 1
-        return self
+            return self.time_unit, float(self.half_life)
+        return "decay", float(self.decay_factor)
 
     def predict(self, x):
         return self.latest_model.predict(x)

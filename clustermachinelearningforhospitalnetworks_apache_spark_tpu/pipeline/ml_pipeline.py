@@ -95,11 +95,12 @@ class PipelineModel:
             cur = _call_stage(stage.transform, cur, label_col, mesh)
         return cur
 
-    def _validate_persistable(self, prefix: str = "stage") -> None:
+    def _validate_persistable(self, prefix: str = "") -> None:
         """Recursive pre-save check (nested composites included) so a failed
-        save can never destroy a previously saved artifact."""
+        save can never destroy a previously saved artifact; ``prefix``
+        threads the nesting path into the error message."""
         for i, stage in enumerate(self.stages):
-            validate_persistable(stage, label=f"{prefix} {i}")
+            validate_persistable(stage, label=f"{prefix}stage {i}")
 
     # persistence -------------------------------------------------------
     def save(self, path: str, overwrite: bool = True) -> None:

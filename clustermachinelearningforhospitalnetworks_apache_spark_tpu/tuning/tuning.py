@@ -170,9 +170,14 @@ def _score(model, val, evaluator, label_col, mesh) -> float:
 
     if isinstance(evaluator, ClusteringEvaluator):
         # clustering models are scored (features, assignments)-style —
-        # silhouette needs the features, not a PredictionResult
+        # silhouette needs the features, not a PredictionResult; the
+        # assignment pass runs on the caller's mesh, not the process default
+        from ..models.base import as_device_dataset
+        from ..parallel.sharding import unpad
+
         x = _val_features(val)
-        assign = model.predict_numpy(x)
+        ds = as_device_dataset(x, mesh=mesh)
+        assign = np.asarray(unpad(model.predict(ds.x), x.shape[0]))
         k = getattr(model, "k", None) or getattr(
             model, "cluster_centers", np.zeros((0,))
         ).shape[0] or None
@@ -287,14 +292,25 @@ class _SelectedModel:
     def transform(self, data: Any, label_col: str | None = None, mesh=None):
         return _call_stage(self.best_model.transform, data, label_col, mesh)
 
-    def _validate_persistable(self) -> None:
-        validate_persistable(self.best_model, label="bestModel")
+    def _validate_persistable(self, prefix: str = "") -> None:
+        validate_persistable(self.best_model, label=f"{prefix}bestModel")
+        for pi, fold_models in enumerate(self._extra_models() or ()):
+            for fi, m in enumerate(fold_models):
+                validate_persistable(m, label=f"{prefix}subModel {pi}/{fi}")
+
+    def _extra_models(self):
+        return getattr(self, "sub_models", None)
 
     def save(self, path: str, overwrite: bool = True) -> None:
         # pre-validate so a failed save never destroys an existing artifact
         self._validate_persistable()
         prepare_artifact_dir(path, overwrite)
         self.best_model.save(os.path.join(path, "bestModel"))
+        subs = self._extra_models()
+        if subs:
+            for pi, fold_models in enumerate(subs):
+                for fi, m in enumerate(fold_models):
+                    m.save(os.path.join(path, "subModels", f"p{pi}", f"f{fi}"))
         write_metadata(path, {
             "model_class": self._ARTIFACT,
             "framework_version": __version__,
@@ -315,11 +331,24 @@ class _SelectedModel:
             with open(os.path.join(path, METADATA_FILE)) as f:
                 _meta = json.load(f)
         best = load_model(os.path.join(path, "bestModel"))
-        return cls._from_meta(best, _meta)
+        return cls._from_meta(best, _meta, path)
 
     @classmethod
-    def _from_meta(cls, best, meta):
+    def _from_meta(cls, best, meta, path):
         raise NotImplementedError
+
+    @staticmethod
+    def _load_sub_models(meta: dict, path: str):
+        shape = meta.get("sub_models_shape")
+        if not shape:
+            return None
+        return tuple(
+            tuple(
+                load_model(os.path.join(path, "subModels", f"p{pi}", f"f{fi}"))
+                for fi in range(shape[1])
+            )
+            for pi in range(shape[0])
+        )
 
 
 @dataclass(frozen=True)
@@ -343,10 +372,15 @@ class CrossValidatorModel(_SelectedModel):
                 if self.fold_metrics is not None
                 else None
             ),
+            "sub_models_shape": (
+                [len(self.sub_models), len(self.sub_models[0])]
+                if self.sub_models
+                else None
+            ),
         }
 
     @classmethod
-    def _from_meta(cls, best, meta):
+    def _from_meta(cls, best, meta, path):
         return cls(
             best_model=best,
             avg_metrics=np.asarray(meta["avg_metrics"]),
@@ -357,6 +391,7 @@ class CrossValidatorModel(_SelectedModel):
                 if meta.get("fold_metrics") is not None
                 else None
             ),
+            sub_models=cls._load_sub_models(meta, path),
         )
 
 
@@ -377,7 +412,7 @@ class TrainValidationSplitModel(_SelectedModel):
         }
 
     @classmethod
-    def _from_meta(cls, best, meta):
+    def _from_meta(cls, best, meta, path):
         return cls(
             best_model=best,
             validation_metrics=np.asarray(meta["validation_metrics"]),

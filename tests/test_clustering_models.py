@@ -145,6 +145,64 @@ def test_bisecting_min_divisible(rng, mesh8):
 
 
 # ---------------------------------------------------- StreamingKMeans
+def test_streaming_update_many_matches_sequential(rng, mesh8):
+    """The one-dispatch backlog drain (lax.scan over stacked batches) is
+    bit-identical to per-batch update() calls for equal-length batches
+    (same shapes → same XLA reduction tiling), and numerically identical
+    (f32 reduction-order ulps only) for ragged ones."""
+    x, _, _ = _blobs(rng, n=2400, k=3)
+    batches = [x[i : i + 300] for i in range(0, 2400, 300)]
+
+    seq = StreamingKMeans(k=3, decay_factor=0.9, seed=7)
+    for b in batches:
+        seq.update(b, mesh=mesh8)
+    many = StreamingKMeans(k=3, decay_factor=0.9, seed=7)
+    many.update_many(batches, mesh=mesh8)
+
+    ms, mm = seq.latest_model, many.latest_model
+    np.testing.assert_array_equal(ms.cluster_centers, mm.cluster_centers)
+    np.testing.assert_array_equal(ms.cluster_weights, mm.cluster_weights)
+    assert ms.n_iter == mm.n_iter == len(batches)
+
+    # ragged batches: pad-with-inert-rows changes reduction tiling, so
+    # equality is numerical (ulp-level), not bitwise; half-life "points"
+    # mode also exercises per-batch-mass-dependent alpha
+    sizes = [300, 250, 300, 250, 300, 250]
+    offs = np.cumsum([0] + sizes)
+    ragged = [x[offs[i] : offs[i + 1]] for i in range(len(sizes))]
+    seq2 = StreamingKMeans(k=3, half_life=500.0, time_unit="points", seed=3)
+    for b in ragged:
+        seq2.update(b, mesh=mesh8)
+    many2 = StreamingKMeans(k=3, half_life=500.0, time_unit="points", seed=3)
+    many2.update_many(ragged[:3], mesh=mesh8).update_many(ragged[3:], mesh=mesh8)
+    np.testing.assert_allclose(
+        seq2.latest_model.cluster_centers,
+        many2.latest_model.cluster_centers,
+        rtol=1e-5,
+    )
+
+    # empty backlog is a no-op
+    st = many.latest_model.cluster_centers.copy()
+    many.update_many([], mesh=mesh8)
+    np.testing.assert_array_equal(many.latest_model.cluster_centers, st)
+
+    # update_many accepts the same batch forms update() does: (x, y)
+    # tuples and DeviceDatasets drain to the same state as bare arrays
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+
+    forms = StreamingKMeans(k=3, decay_factor=0.9, seed=7)
+    forms.update_many(
+        [batches[0], (batches[1], np.zeros(len(batches[1]))),
+         device_dataset(batches[2], mesh=mesh8)] + batches[3:],
+        mesh=mesh8,
+    )
+    np.testing.assert_array_equal(
+        forms.latest_model.cluster_centers, mm.cluster_centers
+    )
+
+
 def test_streaming_kmeans_converges_on_stream(rng, mesh8):
     x, labels, true_centers = _blobs(rng, n=2000, k=3)
     sk = StreamingKMeans(k=3, decay_factor=1.0, seed=0)

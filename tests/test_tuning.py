@@ -229,6 +229,41 @@ def test_selection_save_preserves_existing_artifact(rng, mesh8, tmp_path):
     assert isinstance(ht.load_model(p), ht.CrossValidatorModel)
 
 
+def test_cv_sub_models_persist(rng, mesh8, tmp_path):
+    """collect_sub_models=True survives a save/load round-trip."""
+    x, y = _ridge_data(rng, n=400)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 5.0]).build()
+    cvm = ht.CrossValidator(
+        ht.LinearRegression(), grid, ht.RegressionEvaluator("rmse"),
+        num_folds=2, collect_sub_models=True,
+    ).fit((x, y), mesh=mesh8)
+    assert len(cvm.sub_models) == 2 and len(cvm.sub_models[0]) == 2
+    p = os.path.join(tmp_path, "cvm_sub")
+    cvm.save(p)
+    back = ht.load_model(p)
+    assert len(back.sub_models) == 2 and len(back.sub_models[0]) == 2
+    np.testing.assert_allclose(
+        np.asarray(back.sub_models[1][0].coefficients),
+        np.asarray(cvm.sub_models[1][0].coefficients),
+    )
+
+
+def test_nested_validation_error_names_path(hospital_table, mesh8, tmp_path):
+    """Deep nesting keeps the full path in the not-persistable error."""
+    class Opaque:
+        def transform(self, data):
+            return data
+
+    inner = ht.Pipeline([Opaque(), ht.VectorAssembler(ht.FEATURE_COLS)]).fit(
+        hospital_table
+    )
+    outer = ht.Pipeline([inner, ht.LinearRegression()]).fit(
+        hospital_table, mesh=mesh8
+    )
+    with pytest.raises(TypeError, match=r"stage 0 → stage 0 \(Opaque\)"):
+        outer.save(os.path.join(tmp_path, "x"))
+
+
 def test_cv_validation_errors(rng):
     x, y = _ridge_data(rng, n=100)
     with pytest.raises(ValueError, match="num_folds"):
