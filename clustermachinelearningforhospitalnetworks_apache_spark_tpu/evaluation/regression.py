@@ -21,13 +21,18 @@ from jax.sharding import Mesh
 
 
 def _local_sums(args):
-    """Per-shard sufficient statistics — the treeAggregate ``seqOp``."""
+    """Per-shard sufficient statistics — the treeAggregate ``seqOp``.
+
+    Weights multiply the per-row *squared/absolute* error (``Σ w·e²``), not
+    the error before squaring — the distinction is invisible for 0/1
+    validity weights but decides correctness for fractional ``weightCol``
+    weights (Spark's weighted RMSE is ``sqrt(Σ w e² / Σ w)``)."""
     pred, label, w = args
-    err = (pred - label) * w
+    err = pred - label
     return {
         "n": jnp.sum(w),
-        "sq_err": jnp.sum(err * err),
-        "abs_err": jnp.sum(jnp.abs(err)),
+        "sq_err": jnp.sum(err * err * w),
+        "abs_err": jnp.sum(jnp.abs(err) * w),
         "label_sum": jnp.sum(label * w),
         "label_sq": jnp.sum(label * label * w),
     }

@@ -70,7 +70,7 @@ class AssembledTable:
     def label(self, name: str) -> np.ndarray:
         return self.table.column(name).astype(np.float64)
 
-    def to_device(self, label_col: str | None = None, mesh=None):
+    def to_device(self, label_col: str | None = None, mesh=None, weight_col: str | None = None):
         from ..core.schema import LABEL_COL
         from ..parallel.sharding import device_dataset
 
@@ -82,4 +82,14 @@ class AssembledTable:
         if label_col is None and LABEL_COL in self.table.schema:
             label_col = LABEL_COL
         y = self.label(label_col) if label_col else None
-        return device_dataset(self.features, y, mesh=mesh)
+        # weight_col (Spark's weightCol): per-row sample weights folded
+        # into the validity column
+        w = None
+        if weight_col:
+            if weight_col not in self.table.schema:
+                raise KeyError(
+                    f"weight_col {weight_col!r} is not a column of the "
+                    f"table; available: {self.table.schema.names}"
+                )
+            w = self.table.column(weight_col).astype(np.float64)
+        return device_dataset(self.features, y, mesh=mesh, weights=w)

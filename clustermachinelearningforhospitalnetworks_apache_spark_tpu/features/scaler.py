@@ -90,7 +90,7 @@ class StandardScalerModel:
     def transform_dataset(self, ds: DeviceDataset) -> DeviceDataset:
         # Pad rows are zeros; re-zero them after the affine shift so they
         # stay inert for weighted reductions downstream.
-        x = self.transform(ds.x) * ds.w[:, None]
+        x = self.transform(ds.x) * (ds.w[:, None] > 0)
         return DeviceDataset(x=x, y=ds.y, w=ds.w)
 
 

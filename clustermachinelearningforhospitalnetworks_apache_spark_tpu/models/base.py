@@ -22,16 +22,33 @@ from ..features.assembler import AssembledTable
 from ..parallel.sharding import DeviceDataset, device_dataset, unpad
 
 
-def as_device_dataset(data: Any, label_col: str | None = None, mesh=None) -> DeviceDataset:
-    """Coerce (DeviceDataset | AssembledTable | (X, y) | X) to a sharded dataset."""
+def as_device_dataset(
+    data: Any, label_col: str | None = None, mesh=None, weight_col: str | None = None
+) -> DeviceDataset:
+    """Coerce (DeviceDataset | AssembledTable | (X, y[, w]) | X) to a
+    sharded dataset.  ``weight_col`` (Spark's ``weightCol``) names a table
+    column of non-negative sample weights; a 3-tuple passes them directly."""
     from ..parallel.federation import FederatedDataset
 
     if isinstance(data, DeviceDataset):
-        return data
+        return data  # weights (weight_col or explicit) are already baked in
     if isinstance(data, FederatedDataset):
         return data.data
     if isinstance(data, AssembledTable):
-        return data.to_device(label_col=label_col, mesh=mesh)
+        return data.to_device(label_col=label_col, weight_col=weight_col, mesh=mesh)
+    if weight_col is not None:
+        # a named column can only be resolved against a table — silently
+        # fitting unweighted would betray an explicitly configured weightCol
+        raise ValueError(
+            f"weight_col={weight_col!r} needs a table input to resolve the "
+            f"column; got {type(data).__name__} — pass an AssembledTable, "
+            "an (x, y, weights) tuple, or a pre-weighted DeviceDataset"
+        )
+    if isinstance(data, tuple) and len(data) == 3:
+        return device_dataset(
+            np.asarray(data[0]), np.asarray(data[1]), mesh=mesh,
+            weights=np.asarray(data[2]),
+        )
     if isinstance(data, tuple) and len(data) == 2:
         return device_dataset(np.asarray(data[0]), np.asarray(data[1]), mesh=mesh)
     return device_dataset(np.asarray(data), None, mesh=mesh)

@@ -328,15 +328,18 @@ class BisectingKMeans(Estimator):
     # the narrow 2-means level step amortizes scan overhead over bigger
     # chunks than the k=256 KMeans step's 32768 optimum).
     chunk_rows: int = 131072
+    weight_col: str | None = None  # Spark's weightCol (3.1+)
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> BisectingKMeansModel:
         mesh = mesh or default_mesh()
-        ds: DeviceDataset = as_device_dataset(data, mesh=mesh)
+        ds: DeviceDataset = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
         x = ds.x.astype(jnp.float32)
         cosine = self.distance_measure == "cosine"
         if cosine:
             # train in the same geometry predict uses: unit sphere
-            x = normalize_rows(x) * ds.w[:, None]
+            x = normalize_rows(x) * (ds.w[:, None] > 0)  # 0/1 mask, not the
+            # weight value: fractional sample weights must not rescale the
+            # unit vectors (they enter via the weighted stats instead)
         d = x.shape[1]
 
         if self.strategy not in ("level", "sequential"):

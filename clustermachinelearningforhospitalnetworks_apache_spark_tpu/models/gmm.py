@@ -316,6 +316,7 @@ class GaussianMixture(Estimator):
     # preempted fit resumes from the last commit.
     checkpoint_dir: str | None = None
     checkpoint_every: int = 5
+    weight_col: str | None = None  # Spark's weightCol (3.0+)
 
     def fit(
         self, data, label_col: str | None = None, mesh=None, on_iteration=None
@@ -323,7 +324,7 @@ class GaussianMixture(Estimator):
         """``on_iteration(it, log_likelihood)`` (optional) fires after every
         EM step — progress reporting and fault-injection hooks."""
         mesh = mesh or default_mesh()
-        ds: DeviceDataset = as_device_dataset(data, mesh=mesh)
+        ds: DeviceDataset = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
         x = ds.x.astype(jnp.float32)
         w = ds.w
         d = x.shape[1]

@@ -384,6 +384,7 @@ class KMeans(Estimator):
     # preempted fit resumes from the last commit instead of restarting.
     checkpoint_dir: str | None = None
     checkpoint_every: int = 5
+    weight_col: str | None = None  # Spark's weightCol (3.0+)
 
     def _init_centers(self, ds: DeviceDataset, mesh: Mesh) -> np.ndarray:
         # Host-side init on a bounded sample of valid rows (only the sample
@@ -413,10 +414,12 @@ class KMeans(Estimator):
         Lloyd step — progress reporting, early aborts, and the fault-
         injection hooks the checkpoint tests use."""
         mesh = mesh or default_mesh()
-        ds = as_device_dataset(data, mesh=mesh)
+        ds = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
         x = ds.x.astype(jnp.float32)
         if self.distance_measure == "cosine":
-            x = normalize_rows(x) * ds.w[:, None]
+            x = normalize_rows(x) * (ds.w[:, None] > 0)  # 0/1 mask, not the
+            # weight value: fractional sample weights must not rescale the
+            # unit vectors (they enter via the weighted stats instead)
 
         m = mesh.shape[MODEL_AXIS]
         k_pad = -(-self.k // m) * m

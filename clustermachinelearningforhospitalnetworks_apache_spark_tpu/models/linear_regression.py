@@ -202,9 +202,12 @@ class LinearRegression(Estimator):
     tol: float = 1e-6          # Spark default
     fit_intercept: bool = True
     standardize: bool = True
+    weight_col: str | None = None  # Spark's weightCol
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> LinearRegressionModel:
-        ds: DeviceDataset = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        ds: DeviceDataset = as_device_dataset(
+            data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
+        )
         if self.elastic_net_param > 0.0 and self.reg_param > 0.0:
             coef, intercept, _ = _elastic_net_fit(
                 ds.x, ds.y, ds.w,

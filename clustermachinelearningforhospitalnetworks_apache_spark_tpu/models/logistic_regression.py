@@ -297,13 +297,16 @@ class LogisticRegression(Estimator):
     fit_intercept: bool = True
     standardize: bool = True
     family: str = "auto"       # Spark default
+    weight_col: str | None = None  # Spark's weightCol
 
     def fit(self, data, label_col: str | None = None, mesh=None):
         if self.family not in ("auto", "binomial", "multinomial"):
             raise ValueError(
                 f"family must be auto|binomial|multinomial, got {self.family!r}"
             )
-        ds: DeviceDataset = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        ds: DeviceDataset = as_device_dataset(
+            data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
+        )
         family = self.family
         # one tiny sync: the class count is a static shape parameter (and
         # the binomial-on-multiclass guard Spark also enforces)

@@ -151,22 +151,30 @@ def _make_update_many(k: int, alpha_mode: str, alpha_param: float, seed: int):
     return jax.jit(drain)
 
 
-def _host_rows(batch) -> np.ndarray:
-    """Coerce one backlog entry to a host (n, d) feature matrix — the same
-    input forms :meth:`StreamingKMeans.update` accepts (bare array,
-    ``(x, y)`` tuple, AssembledTable, DeviceDataset); clustering ignores
-    labels, and a DeviceDataset's pad rows are dropped via its weights."""
+def _host_rows(batch) -> tuple[np.ndarray, np.ndarray]:
+    """Coerce one backlog entry to host ``(x, w)`` — the same input forms
+    :meth:`StreamingKMeans.update` accepts (bare array, ``(x, y[, w])``
+    tuple, AssembledTable, DeviceDataset); clustering ignores labels.  A
+    DeviceDataset's pad rows are dropped and its (possibly fractional)
+    sample weights are carried so the drain matches per-batch ``update``."""
     from ..features.assembler import AssembledTable
 
     if isinstance(batch, DeviceDataset):
         x = np.asarray(jax.device_get(batch.x), dtype=np.float32)
-        w = np.asarray(jax.device_get(batch.w))
-        return np.atleast_2d(x[w > 0])
+        w = np.asarray(jax.device_get(batch.w), dtype=np.float32)
+        keep = w > 0
+        return np.atleast_2d(x[keep]), w[keep]
     if isinstance(batch, AssembledTable):
-        return np.atleast_2d(np.asarray(batch.features, dtype=np.float32))
+        x = np.atleast_2d(np.asarray(batch.features, dtype=np.float32))
+        return x, np.ones(x.shape[0], dtype=np.float32)
+    if isinstance(batch, tuple) and len(batch) == 3:
+        x = np.atleast_2d(np.asarray(batch[0], dtype=np.float32))
+        return x, np.asarray(batch[2], dtype=np.float32).reshape(-1)
     if isinstance(batch, tuple) and len(batch) == 2:
-        return np.atleast_2d(np.asarray(batch[0], dtype=np.float32))
-    return np.atleast_2d(np.asarray(batch, dtype=np.float32))
+        x = np.atleast_2d(np.asarray(batch[0], dtype=np.float32))
+        return x, np.ones(x.shape[0], dtype=np.float32)
+    x = np.atleast_2d(np.asarray(batch, dtype=np.float32))
+    return x, np.ones(x.shape[0], dtype=np.float32)
 
 
 @register_model("StreamingKMeansModel")
@@ -284,19 +292,20 @@ class StreamingKMeans:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if self._centers is None:
-            first = batches[0]
-            self.update(first, mesh=mesh)
+            fx, fw = batches[0]
+            # 3-tuple keeps the first batch's sample weights in play
+            self.update((fx, np.zeros(fx.shape[0], np.float32), fw), mesh=mesh)
             batches = batches[1:]
             if not batches:
                 return self
-        n_pad = pad_rows(max(b.shape[0] for b in batches), mesh.shape[DATA_AXIS])
-        d = batches[0].shape[1]
+        n_pad = pad_rows(max(b.shape[0] for b, _ in batches), mesh.shape[DATA_AXIS])
+        d = batches[0][0].shape[1]
         B = len(batches)
         xs = np.zeros((B, n_pad, d), dtype=np.float32)
         ws = np.zeros((B, n_pad), dtype=np.float32)
-        for i, b in enumerate(batches):
+        for i, (b, bw) in enumerate(batches):
             xs[i, : b.shape[0]] = b
-            ws[i, : b.shape[0]] = 1.0
+            ws[i, : b.shape[0]] = bw
         xs = jax.device_put(xs, NamedSharding(mesh, P(None, DATA_AXIS, None)))
         ws = jax.device_put(ws, NamedSharding(mesh, P(None, DATA_AXIS)))
         mode, param = self._alpha()

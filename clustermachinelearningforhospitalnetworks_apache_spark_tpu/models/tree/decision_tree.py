@@ -125,12 +125,13 @@ class _TreeParams:
     seed: int = 0
     label_col: str = "length_of_stay"
     features_col: str = "features"
+    weight_col: str | None = None  # Spark's weightCol
 
 
 @dataclass(frozen=True)
 class DecisionTreeRegressor(Estimator, _TreeParams):
     def fit(self, data, label_col: str | None = None, mesh=None) -> DecisionTreeModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
         grown = grow_forest(
             ds,
             task="regression",
@@ -151,7 +152,7 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
     label_col: str = "LOS_binary"
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> DecisionTreeModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
         grown = grow_forest(
             ds,
             task="classification",

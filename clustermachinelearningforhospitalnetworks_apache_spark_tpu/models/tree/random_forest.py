@@ -52,7 +52,7 @@ class RandomForestRegressor(Estimator, _TreeParams):
     feature_subset_strategy: str = "auto"
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> RandomForestModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
         grown = grow_forest(
             ds,
             task="regression",
@@ -81,7 +81,7 @@ class RandomForestClassifier(Estimator, _TreeParams):
     label_col: str = "LOS_binary"
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> RandomForestModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh)
+        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
         grown = grow_forest(
             ds,
             task="classification",

@@ -102,12 +102,18 @@ def device_dataset(
     y: np.ndarray | None = None,
     mesh: Mesh | None = None,
     dtype=jnp.float32,
+    weights: np.ndarray | None = None,
 ) -> DeviceDataset:
     """Pad + shard a host design matrix onto the mesh.
 
     The TPU-native replacement for ``VectorAssembler.transform`` feeding a
     distributed DataFrame into ``.fit`` (reference ``:136-139``): one host →
     device transfer, after which every estimator step stays on device.
+
+    ``weights`` (Spark's ``weightCol``): optional non-negative per-row
+    sample weights, folded into the validity column — every estimator
+    reduction is already ``w``-weighted, so fractional weights flow through
+    fits and evaluators with no further plumbing (pad rows stay 0).
     """
     mesh = mesh or default_mesh()
     x = np.atleast_2d(np.asarray(x))
@@ -122,10 +128,22 @@ def device_dataset(
         np_dtype = np.dtype(dtype.dtype)
     xp = np.zeros((n_pad, x.shape[1]), dtype=np_dtype)
     xp[:n] = x
-    # only the feature matrix (and a real label column) cross the link;
-    # the validity step and an absent label are built on device
+    # only the feature matrix (and a real label/weight column) cross the
+    # link; the validity step and an absent label are built on device
     fill_fn = _pad_fill_fns(mesh, n_pad, np_dtype.name)
-    w = fill_fn(np.int64(n))
+    if weights is not None:
+        wh = np.asarray(weights, dtype=np.float64).reshape(-1)
+        if wh.shape[0] != n:
+            raise ValueError(
+                f"weights length {wh.shape[0]} != number of rows {n}"
+            )
+        if np.any(wh < 0):
+            raise ValueError("sample weights must be non-negative")
+        wp = np.zeros((n_pad,), dtype=np_dtype)
+        wp[:n] = wh
+        w = shard_rows(wp, mesh)
+    else:
+        w = fill_fn(np.int64(n))
     if y is not None:
         yp = np.zeros((n_pad,), dtype=np_dtype)
         yp[:n] = np.asarray(y).reshape(-1)

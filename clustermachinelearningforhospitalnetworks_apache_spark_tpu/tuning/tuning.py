@@ -134,7 +134,7 @@ def apply_params(estimator: Any, params: Mapping[str, Any]) -> Any:
 def _num_rows(data: Any) -> int:
     if isinstance(data, AssembledTable):
         return len(data)
-    if isinstance(data, tuple) and len(data) == 2:
+    if isinstance(data, tuple) and len(data) in (2, 3):
         return int(np.asarray(data[0]).shape[0])
     if hasattr(data, "num_rows"):
         return int(data.num_rows)
@@ -143,15 +143,14 @@ def _num_rows(data: Any) -> int:
 
 def _row_subset(data: Any, keep: np.ndarray) -> Any:
     """Host-side row filter for the supported fit inputs (Table,
-    AssembledTable, (x, y), bare array) — fold subsets are staged to the
-    mesh by the estimator's own ``fit``."""
+    AssembledTable, (x, y[, w]), bare array) — fold subsets are staged to
+    the mesh by the estimator's own ``fit``."""
     if isinstance(data, AssembledTable):
         return dataclasses.replace(
             data, table=data.table.mask(keep), features=data.features[keep]
         )
-    if isinstance(data, tuple) and len(data) == 2:
-        x, y = (np.asarray(a) for a in data)
-        return (x[keep], y[keep])
+    if isinstance(data, tuple) and len(data) in (2, 3):
+        return tuple(np.asarray(a)[keep] for a in data)
     if hasattr(data, "mask"):
         return data.mask(keep)
     return np.asarray(data)[keep]

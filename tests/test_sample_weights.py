@@ -1,0 +1,202 @@
+"""Sample weights (Spark's ``weightCol``): integer-weight fits must equal
+row-duplication fits, sklearn parity holds with fractional weights, and
+weights thread from Table columns through fit → transform → evaluate."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+    device_dataset,
+)
+
+
+def _weighted_problem(rng, n=800, d=4):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([1.5, -2.0, 0.5, 3.0])
+    y = (x @ beta + 0.1 * rng.normal(size=n)).astype(np.float32)
+    w = rng.integers(1, 4, size=n).astype(np.float64)  # integer weights
+    rep = np.repeat(np.arange(n), w.astype(int))
+    return x, y, w, x[rep], y[rep]
+
+
+def test_linear_regression_weight_equals_duplication(rng, mesh8):
+    x, y, w, xd, yd = _weighted_problem(rng)
+    m_w = ht.LinearRegression().fit((x, y, w), mesh=mesh8)
+    m_d = ht.LinearRegression().fit((xd, yd), mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(m_w.coefficients), np.asarray(m_d.coefficients), atol=1e-4
+    )
+    np.testing.assert_allclose(float(m_w.intercept), float(m_d.intercept), atol=1e-4)
+
+
+def test_linear_regression_weights_match_sklearn(rng, mesh8):
+    sk = pytest.importorskip("sklearn.linear_model")
+    x, y, _, _, _ = _weighted_problem(rng)
+    w = rng.uniform(0.1, 5.0, size=len(x))  # fractional
+    ours = ht.LinearRegression().fit((x, y, w), mesh=mesh8)
+    ref = sk.LinearRegression().fit(x, y, sample_weight=w)
+    np.testing.assert_allclose(np.asarray(ours.coefficients), ref.coef_, atol=1e-3)
+    np.testing.assert_allclose(float(ours.intercept), ref.intercept_, atol=1e-3)
+
+
+def test_logistic_regression_weight_equals_duplication(rng, mesh8):
+    x, y0, w, xd, _ = _weighted_problem(rng)
+    yb = (y0 > np.median(y0)).astype(np.float32)
+    ybd = np.repeat(yb, w.astype(int))
+    m_w = ht.LogisticRegression(reg_param=1e-3).fit((x, yb, w), mesh=mesh8)
+    m_d = ht.LogisticRegression(reg_param=1e-3).fit((xd, ybd), mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(m_w.coefficients), np.asarray(m_d.coefficients), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_tree_zero_weight_rows_are_inert(rng, mesh8):
+    """Trees: zero-weight rows influence neither the quantile bins (the
+    binning sampler filters w>0) nor the split histograms — the fit equals
+    one on the truncated data exactly.  (Exact integer-weight/duplication
+    parity does not hold for trees by design: like Spark's findSplits, the
+    quantile binning is unweighted; weights enter the impurity stats.)"""
+    x, y, _, _, _ = _weighted_problem(rng)
+    n_keep = 500
+    w = np.r_[np.ones(n_keep), np.zeros(len(x) - n_keep)]
+    m_w = ht.DecisionTreeRegressor(max_depth=4, seed=0).fit((x, y, w), mesh=mesh8)
+    m_t = ht.DecisionTreeRegressor(max_depth=4, seed=0).fit(
+        (x[:n_keep], y[:n_keep]), mesh=mesh8
+    )
+    probe = rng.normal(size=(500, 4)).astype(np.float32)
+    # identical splits; leaf values may differ by f32 reduction-order ulps
+    # (the two datasets pad to different row counts)
+    np.testing.assert_allclose(
+        m_w.predict_numpy(probe), m_t.predict_numpy(probe), rtol=1e-6
+    )
+    # integer weights shift the histograms exactly like duplication when
+    # the bins agree: duplicating every row uniformly (w=2) is a no-op
+    m_2 = ht.DecisionTreeRegressor(max_depth=4, seed=0).fit(
+        (x, y, 2.0 * np.ones(len(x))), mesh=mesh8
+    )
+    m_1 = ht.DecisionTreeRegressor(max_depth=4, seed=0).fit((x, y), mesh=mesh8)
+    np.testing.assert_allclose(
+        m_2.predict_numpy(probe), m_1.predict_numpy(probe), atol=1e-5
+    )
+
+
+def test_kmeans_k1_weighted_mean(rng, mesh8):
+    """k=1 KMeans converges to the weighted mean — exact closed form."""
+    x = rng.normal(size=(500, 3)).astype(np.float32)
+    w = rng.uniform(0.0, 2.0, size=500)
+    m = ht.KMeans(k=1, max_iter=5, seed=0).fit(
+        ht.device_dataset(x, mesh=mesh8, weights=w), mesh=mesh8
+    )
+    expect = (x * w[:, None]).sum(axis=0) / w.sum()
+    np.testing.assert_allclose(
+        np.asarray(m.cluster_centers[0]), expect, atol=1e-4
+    )
+
+
+def test_weight_col_through_table_pipeline(hospital_table, mesh8):
+    """weightCol by name: a Table column threads through AssembledTable →
+    fit → transform → weighted evaluator."""
+    n = len(hospital_table)
+    rng = np.random.default_rng(5)
+    w = rng.integers(1, 3, size=n).astype(np.float64)
+    tab = hospital_table.with_column("case_weight", w, dtype="float")
+    asm = ht.VectorAssembler(ht.FEATURE_COLS).transform(tab)
+
+    m = ht.LinearRegression(weight_col="case_weight").fit(asm, mesh=mesh8)
+    # duplication reference through plain arrays
+    x = asm.features
+    y = tab.column("length_of_stay").astype(np.float64)
+    rep = np.repeat(np.arange(n), w.astype(int))
+    m_d = ht.LinearRegression().fit((x[rep], y[rep]), mesh=mesh8)
+    np.testing.assert_allclose(
+        np.asarray(m.coefficients), np.asarray(m_d.coefficients), atol=1e-4
+    )
+
+    # transform carries the weights into the PredictionResult, so the
+    # evaluator computes the weighted metric
+    ds = asm.to_device(weight_col="case_weight", mesh=mesh8)
+    pred = m.transform(ds, mesh=mesh8)
+    rmse_w = ht.RegressionEvaluator("rmse").evaluate(pred)
+    pd, ld = m_d.transform((x[rep], y[rep]), mesh=mesh8).to_numpy()
+    rmse_d = float(np.sqrt(np.mean((pd - ld) ** 2)))
+    np.testing.assert_allclose(rmse_w, rmse_d, rtol=1e-5)
+
+
+def test_clustering_weight_col(hospital_table, mesh8):
+    """KMeans honors weightCol: zero-weight rows don't pull centroids."""
+    n = len(hospital_table)
+    tab = hospital_table.with_column(
+        "case_weight", np.r_[np.ones(n - 50), np.zeros(50)], dtype="float"
+    )
+    asm = ht.VectorAssembler(ht.FEATURE_COLS).transform(tab)
+    m_w = ht.KMeans(k=3, seed=0, weight_col="case_weight").fit(asm, mesh=mesh8)
+    m_t = ht.KMeans(k=3, seed=0).fit(asm.features[: n - 50], mesh=mesh8)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(m_w.cluster_centers), axis=0),
+        np.sort(np.asarray(m_t.cluster_centers), axis=0),
+        atol=2e-3,
+    )
+
+
+def test_weight_col_on_non_table_input_raises(rng, mesh8):
+    """An explicitly configured weightCol must never silently produce an
+    unweighted fit: non-table inputs raise."""
+    x, y, _, _, _ = _weighted_problem(rng, n=100)
+    with pytest.raises(ValueError, match="weight_col"):
+        ht.LinearRegression(weight_col="case_weight").fit((x, y), mesh=mesh8)
+    # but a pre-weighted DeviceDataset passes through untouched
+    ds = device_dataset(x, y, mesh=mesh8, weights=np.ones(len(x)))
+    ht.LinearRegression(weight_col="case_weight").fit(ds, mesh=mesh8)
+
+
+def test_streaming_drain_carries_fractional_weights(rng, mesh8):
+    """update_many must honor fractional DeviceDataset weights exactly
+    like sequential update() calls."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        StreamingKMeans,
+    )
+
+    x = rng.normal(size=(1200, 3)).astype(np.float32)
+    w = rng.uniform(0.1, 2.0, size=1200).astype(np.float32)
+    batches = [
+        device_dataset(x[i : i + 400], mesh=mesh8, weights=w[i : i + 400])
+        for i in range(0, 1200, 400)
+    ]
+    seq = StreamingKMeans(k=3, decay_factor=0.9, seed=2)
+    for b in batches:
+        seq.update(b, mesh=mesh8)
+    many = StreamingKMeans(k=3, decay_factor=0.9, seed=2)
+    many.update_many(batches, mesh=mesh8)
+    np.testing.assert_allclose(
+        seq.latest_model.cluster_centers,
+        many.latest_model.cluster_centers,
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        seq.latest_model.cluster_weights,
+        many.latest_model.cluster_weights,
+        rtol=1e-5,
+    )
+
+
+def test_tuning_accepts_weighted_tuples(rng, mesh8):
+    x, y, w, _, _ = _weighted_problem(rng, n=600)
+    grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 500.0]).build()
+    cvm = ht.CrossValidator(
+        ht.LinearRegression(), grid, ht.RegressionEvaluator("rmse"),
+        num_folds=2, seed=1,
+    ).fit((x, y, w), mesh=mesh8)
+    assert cvm.best_index == 0
+    tvm = ht.TrainValidationSplit(
+        ht.LinearRegression(), grid, ht.RegressionEvaluator("rmse"), seed=1
+    ).fit((x, y, w), mesh=mesh8)
+    assert tvm.best_index == 0
+
+
+def test_weight_validation():
+    x = np.ones((10, 2))
+    with pytest.raises(ValueError, match="non-negative"):
+        device_dataset(x, weights=-np.ones(10))
+    with pytest.raises(ValueError, match="length"):
+        device_dataset(x, weights=np.ones(7))
