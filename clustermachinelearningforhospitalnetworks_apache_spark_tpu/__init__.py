@@ -28,10 +28,16 @@ from .core import (
 )
 from .features import (
     Binarizer,
+    Bucketizer,
+    Imputer,
+    MinMaxScaler,
+    OneHotEncoder,
+    PCA,
     StandardScaler,
     StringIndexer,
     VectorAssembler,
 )
+from .stat import Correlation, Summarizer
 from .evaluation import (
     ClusteringEvaluator,
     BinaryClassificationEvaluator,
@@ -84,8 +90,15 @@ __all__ = [
     "random_split",
     "train_test_split",
     "Binarizer",
+    "Bucketizer",
+    "Correlation",
+    "Imputer",
+    "MinMaxScaler",
+    "OneHotEncoder",
+    "PCA",
     "StandardScaler",
     "StringIndexer",
+    "Summarizer",
     "VectorAssembler",
     "ClusteringEvaluator",
     "BinaryClassificationEvaluator",

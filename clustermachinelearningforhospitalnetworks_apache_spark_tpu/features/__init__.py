@@ -2,6 +2,11 @@ from .assembler import AssembledTable, VectorAssembler
 from .scaler import StandardScaler, StandardScalerModel
 from .indexer import StringIndexer, StringIndexerModel
 from .binarizer import Binarizer
+from .bucketizer import Bucketizer
+from .imputer import Imputer, ImputerModel
+from .minmax import MinMaxScaler, MinMaxScalerModel
+from .onehot import OneHotEncoder, OneHotEncoderModel
+from .pca import PCA, PCAModel
 
 __all__ = [
     "AssembledTable",
@@ -11,4 +16,13 @@ __all__ = [
     "StringIndexer",
     "StringIndexerModel",
     "Binarizer",
+    "Bucketizer",
+    "Imputer",
+    "ImputerModel",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
+    "PCA",
+    "PCAModel",
 ]

@@ -1,0 +1,82 @@
+"""Bucketizer — continuous column → bucket index by split points.
+
+Parity with ``pyspark.ml.feature.Bucketizer``: ``splits`` is a strictly
+increasing list of n+1 boundaries defining n buckets; values land in
+``[splits[i], splits[i+1])`` (the last bucket is closed on both ends).
+``handle_invalid``: "error" raises on out-of-range/NaN, "keep" routes them
+to an extra bucket n, "skip" drops the rows — the same vocabulary as
+StringIndexer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from ..core.table import Table
+from ..io.model_io import register_model
+
+
+@register_model("Bucketizer")
+@dataclass(frozen=True)
+class Bucketizer:
+    splits: Sequence[float]
+    input_col: str = ""
+    output_col: str = ""
+    handle_invalid: str = "error"  # "error" | "keep" | "skip"
+
+    def __post_init__(self):
+        s = np.asarray(self.splits, dtype=np.float64)
+        if s.ndim != 1 or s.size < 3:
+            raise ValueError("splits needs >=3 boundaries (>=2 buckets)")
+        if not np.all(np.diff(s) > 0):
+            raise ValueError("splits must be strictly increasing")
+        if self.handle_invalid not in ("error", "keep", "skip"):
+            raise ValueError(
+                f"handle_invalid must be error|keep|skip, got {self.handle_invalid!r}"
+            )
+
+    def _artifacts(self):
+        return (
+            "Bucketizer",
+            {
+                "splits": list(map(float, self.splits)),
+                "input_col": self.input_col,
+                "output_col": self.output_col,
+                "handle_invalid": self.handle_invalid,
+            },
+            {},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            tuple(params["splits"]), params["input_col"],
+            params["output_col"], params.get("handle_invalid", "error"),
+        )
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.splits) - 1
+
+    def transform(self, table: Table) -> Table:
+        s = np.asarray(self.splits, dtype=np.float64)
+        v = table.column(self.input_col).astype(np.float64)
+        idx = np.searchsorted(s, v, side="right") - 1
+        # top boundary is inclusive (Spark: last bucket closed both ends)
+        idx[v == s[-1]] = self.num_buckets - 1
+        invalid = np.isnan(v) | (v < s[0]) | (v > s[-1])
+        if invalid.any():
+            if self.handle_invalid == "error":
+                bad = v[invalid][0]
+                raise ValueError(
+                    f"value {bad!r} in {self.input_col!r} is outside the "
+                    f"split range [{s[0]}, {s[-1]}] (handle_invalid='error')"
+                )
+            idx[invalid] = self.num_buckets  # "keep": extra bucket
+        out = table.with_column(self.output_col, idx.astype(np.int64), dtype="int")
+        if self.handle_invalid == "skip" and invalid.any():
+            out = out.mask(~invalid)
+        return out

@@ -1,0 +1,85 @@
+"""MinMaxScaler — per-feature rescale to [min, max].
+
+Parity with ``pyspark.ml.feature.MinMaxScaler``: fit finds per-column
+(min, max) over the data, transform maps linearly onto
+``[min_out, max_out]``; a constant column maps every value to the midpoint
+``(min_out + max_out) / 2`` (Spark's rule).  The fit is one fused, jit'd
+masked min/max reduction over the sharded rows — pad/zero-weight rows are
+excluded via ±inf masking, the same way the mean/std scaler excludes them
+by weighting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from ..ops.reductions import moment_stats
+from ..parallel.sharding import DeviceDataset
+from .scaler import _is_assembled
+
+
+@register_model("MinMaxScalerModel")
+@dataclass(frozen=True)
+class MinMaxScalerModel:
+    data_min: np.ndarray
+    data_max: np.ndarray
+    min_out: float = 0.0
+    max_out: float = 1.0
+
+    def _artifacts(self):
+        return (
+            "MinMaxScalerModel",
+            {"min_out": self.min_out, "max_out": self.max_out},
+            {"data_min": np.asarray(self.data_min), "data_max": np.asarray(self.data_max)},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            arrays["data_min"], arrays["data_max"],
+            float(params.get("min_out", 0.0)), float(params.get("max_out", 1.0)),
+        )
+
+    def transform(self, x):
+        if _is_assembled(x):
+            return replace(x, features=self.transform(x.features))
+        if isinstance(x, DeviceDataset):
+            scaled = self.transform(x.x) * (x.w[:, None] > 0)
+            return DeviceDataset(x=scaled, y=x.y, w=x.w)
+        xp = jnp if isinstance(x, jax.Array) else np
+        lo = xp.asarray(self.data_min, dtype=x.dtype)
+        hi = xp.asarray(self.data_max, dtype=x.dtype)
+        span = hi - lo
+        out_span = self.max_out - self.min_out
+        # constant column → midpoint (Spark rule); guard the 0-div first
+        safe = xp.where(span > 0, span, 1.0)
+        scaled = (x - lo[None, :]) / safe[None, :] * out_span + self.min_out
+        mid = 0.5 * (self.min_out + self.max_out)
+        return xp.where((span > 0)[None, :], scaled, mid)
+
+
+@dataclass(frozen=True)
+class MinMaxScaler:
+    min_out: float = 0.0   # Spark's min
+    max_out: float = 1.0   # Spark's max
+
+    def fit(self, data) -> MinMaxScalerModel:
+        if _is_assembled(data):
+            data = data.to_device()
+        if isinstance(data, DeviceDataset):
+            s = moment_stats(data.x, data.w)
+            lo, hi = np.asarray(s["min"]), np.asarray(s["max"])
+        else:
+            x = np.asarray(data, dtype=np.float64)
+            lo, hi = x.min(axis=0), x.max(axis=0)
+        return MinMaxScalerModel(lo, hi, self.min_out, self.max_out)
+
+    def fit_transform(self, data):
+        # transform the ORIGINAL container so the return type matches
+        # fit(data).transform(data) (AssembledTable in → AssembledTable out)
+        return self.fit(data).transform(data)

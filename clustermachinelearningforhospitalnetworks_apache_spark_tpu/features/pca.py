@@ -1,0 +1,102 @@
+"""PCA — project features onto the top-k principal components.
+
+Parity with ``pyspark.ml.feature.PCA``.  TPU shape: the (d, d) scatter
+matrix is one weighted, jit'd ``XᵀWX`` reduction over the sharded rows
+(the same psum'd-Gram pattern as LinearRegression's normal equations) —
+rows never leave the mesh; only the tiny (d, d) matrix comes to host for
+the eigendecomposition (d = feature count, small for tabular data; Spark
+likewise solves the covariance eigenproblem on the driver via Breeze).
+
+Sign convention: each component's largest-|loading| entry is made
+positive, so results are deterministic and comparable across runs
+(eigenvectors are sign-ambiguous).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from ..ops.reductions import host_moments
+from ..parallel.sharding import DeviceDataset
+from .scaler import _is_assembled
+
+
+@register_model("PCAModel")
+@dataclass(frozen=True)
+class PCAModel:
+    components: np.ndarray        # (d, k) — columns are principal axes
+    explained_variance: np.ndarray  # (k,)
+    mean: np.ndarray              # (d,) — centering vector
+
+    @property
+    def k(self) -> int:
+        return self.components.shape[1]
+
+    def _artifacts(self):
+        return (
+            "PCAModel",
+            {},
+            {
+                "components": np.asarray(self.components),
+                "explained_variance": np.asarray(self.explained_variance),
+                "mean": np.asarray(self.mean),
+            },
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(arrays["components"], arrays["explained_variance"], arrays["mean"])
+
+    def transform(self, x):
+        if _is_assembled(x):
+            return replace(x, features=self.transform(x.features))
+        if isinstance(x, DeviceDataset):
+            proj = self.transform(x.x) * (x.w[:, None] > 0)
+            return DeviceDataset(x=proj, y=x.y, w=x.w)
+        xp = jnp if isinstance(x, jax.Array) else np
+        c = xp.asarray(self.components, dtype=x.dtype)
+        m = xp.asarray(self.mean, dtype=x.dtype)
+        return (x - m[None, :]) @ c
+
+
+@dataclass(frozen=True)
+class PCA:
+    k: int
+
+    def fit(self, data) -> PCAModel:
+        if _is_assembled(data):
+            data = data.to_device()
+        if isinstance(data, DeviceDataset):
+            s = host_moments(data.x, data.w)
+            n, s1, s2 = s["n"], s["s1"], s["xtx"]
+        else:
+            x = np.asarray(data, dtype=np.float64)
+            n = float(x.shape[0])
+            s1 = x.sum(axis=0)
+            s2 = x.T @ x
+        d = s1.shape[0]
+        if not 1 <= self.k <= d:
+            raise ValueError(f"k must be in [1, {d}], got {self.k}")
+        n = max(float(n), 1.0)
+        mean = s1 / n
+        cov = s2 / n - np.outer(mean, mean)
+        # unbiased (n-1) normalization, matching sklearn/Spark
+        cov = cov * (n / max(n - 1.0, 1.0))
+        evals, evecs = np.linalg.eigh(cov)       # ascending
+        order = np.argsort(evals)[::-1][: self.k]
+        comps = evecs[:, order]
+        evals = np.maximum(evals[order], 0.0)
+        # deterministic sign: largest-|loading| entry positive per component
+        flip = np.sign(comps[np.argmax(np.abs(comps), axis=0), np.arange(self.k)])
+        comps = comps * np.where(flip == 0, 1.0, flip)[None, :]
+        return PCAModel(comps, evals, mean)
+
+    def fit_transform(self, data):
+        # transform the ORIGINAL container so the return type matches
+        # fit(data).transform(data) (AssembledTable in → AssembledTable out)
+        return self.fit(data).transform(data)

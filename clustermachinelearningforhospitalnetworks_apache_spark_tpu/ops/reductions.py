@@ -1,0 +1,43 @@
+"""Shared weighted column reductions.
+
+One fused, jit'd pass producing every per-column sufficient statistic the
+feature stages and ``ml.stat`` consume (Σw, Σw·x, Σw·x², Σw·x xᵀ, masked
+min/max, L1, non-zero count).  Centralized so the masked-±sentinel idiom
+and any future numeric fixes live in exactly one place; stages that need a
+subset still pay only one pass (the extra O(n·d) column stats are
+negligible next to the O(n·d²) Gram the heavy users already need).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: finite sentinel for masked min/max — ±inf would poison a sum-based
+#: fusion and NaN-propagate through where() on some backends
+_MASK_BIG = np.float32(3.4e38)
+
+
+@jax.jit
+def moment_stats(x: jax.Array, w: jax.Array) -> dict[str, jax.Array]:
+    """Fused single pass over a weighted, padded row shard (pad rows w=0)."""
+    wcol = w[:, None]
+    valid = wcol > 0
+    big = jnp.asarray(_MASK_BIG, x.dtype)
+    return {
+        "n": jnp.sum(w),
+        "count": jnp.sum((w > 0).astype(x.dtype)),
+        "s1": jnp.sum(x * wcol, axis=0),
+        "s2": jnp.sum(x * x * wcol, axis=0),
+        "xtx": (x * wcol).T @ x,
+        "min": jnp.min(jnp.where(valid, x, big), axis=0),
+        "max": jnp.max(jnp.where(valid, x, -big), axis=0),
+        "l1": jnp.sum(jnp.abs(x) * wcol, axis=0),
+        "nnz": jnp.sum(((x != 0) & valid).astype(x.dtype) * wcol, axis=0),
+    }
+
+
+def host_moments(x: jax.Array, w: jax.Array) -> dict[str, np.ndarray]:
+    """moment_stats fetched to host as float64."""
+    return {k: np.asarray(v, dtype=np.float64) for k, v in moment_stats(x, w).items()}

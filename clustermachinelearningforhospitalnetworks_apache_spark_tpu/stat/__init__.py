@@ -1,0 +1,3 @@
+from .stat import Correlation, Summarizer, SummaryStats
+
+__all__ = ["Correlation", "Summarizer", "SummaryStats"]

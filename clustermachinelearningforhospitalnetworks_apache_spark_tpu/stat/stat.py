@@ -1,0 +1,126 @@
+"""Statistics — ``pyspark.ml.stat`` parity (Correlation, Summarizer).
+
+Spark computes these as one distributed aggregation job per call
+(``Correlation.corr``, ``Summarizer.metrics(...)``); here each is a single
+fused, jit'd weighted reduction over the sharded rows — the (d, d) moment
+matrix / per-column stat vector is the only thing that reaches the host.
+Spearman ranks are computed host-side (a global sort is a host operation
+for tabular d ≪ n data, as in Spark where ranking is a shuffle).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from ..features.assembler import AssembledTable
+from ..ops.reductions import host_moments
+from ..parallel.sharding import DeviceDataset
+
+
+def _as_xw(data, mesh=None):
+    """(x, w) pair on device for any accepted feature container."""
+    from ..models.base import as_device_dataset
+
+    ds = as_device_dataset(data, mesh=mesh)
+    return ds.x, ds.w
+
+
+class Correlation:
+    """``Correlation.corr(features, method="pearson"|"spearman")`` → (d, d)
+    matrix, mirroring ``pyspark.ml.stat.Correlation``."""
+
+    @staticmethod
+    def corr(data, method: str = "pearson", mesh=None) -> np.ndarray:
+        if method not in ("pearson", "spearman"):
+            raise ValueError(f"method must be pearson|spearman, got {method!r}")
+        if method == "spearman":
+            x = _host_features(data)
+            # average ranks (ties averaged), then Pearson of the ranks —
+            # scipy.stats.spearmanr's definition
+            ranks = np.empty_like(x, dtype=np.float64)
+            for j in range(x.shape[1]):
+                ranks[:, j] = _avg_rank(x[:, j])
+            return np.corrcoef(ranks, rowvar=False)
+        x, w = _as_xw(data, mesh=mesh)
+        s = host_moments(x, w)
+        n = max(s["n"], 1.0)
+        mean = s["s1"] / n
+        cov = s["xtx"] / n - np.outer(mean, mean)
+        std = np.sqrt(np.maximum(np.diag(cov), 0.0))
+        denom = np.outer(std, std)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            r = cov / denom
+        r[denom == 0] = np.nan  # constant column: undefined, Spark yields NaN
+        np.fill_diagonal(r, 1.0)
+        return np.clip(r, -1.0, 1.0)
+
+
+def _host_features(data) -> np.ndarray:
+    if isinstance(data, AssembledTable):
+        return np.asarray(data.features, dtype=np.float64)
+    if isinstance(data, DeviceDataset):
+        x = np.asarray(jax.device_get(data.x), dtype=np.float64)
+        w = np.asarray(jax.device_get(data.w))
+        return x[w > 0]
+    return np.asarray(data, dtype=np.float64)
+
+
+def _avg_rank(v: np.ndarray) -> np.ndarray:
+    order = np.argsort(v, kind="mergesort")
+    ranks = np.empty(len(v), dtype=np.float64)
+    sv = v[order]
+    # average rank over tie runs
+    i = 0
+    while i < len(sv):
+        j = i
+        while j + 1 < len(sv) and sv[j + 1] == sv[i]:
+            j += 1
+        ranks[order[i : j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    return ranks
+
+
+@dataclass(frozen=True)
+class SummaryStats:
+    """Per-column summary, all metrics from one fused device pass."""
+
+    count: float
+    weight_sum: float
+    mean: np.ndarray
+    variance: np.ndarray   # unbiased (Σw-1 denominator), Spark convention
+    std: np.ndarray
+    min: np.ndarray
+    max: np.ndarray
+    norm_l1: np.ndarray
+    norm_l2: np.ndarray
+    num_non_zeros: np.ndarray
+
+
+class Summarizer:
+    """``Summarizer.summary(features[, weights])`` — the
+    ``pyspark.ml.stat.Summarizer`` metric set in one reduction."""
+
+    @staticmethod
+    def summary(data, mesh=None) -> SummaryStats:
+        x, w = _as_xw(data, mesh=mesh)
+        s = host_moments(x, w)
+        n = max(s["n"], 1.0)
+        mean = s["s1"] / n
+        biased = np.maximum(s["s2"] / n - mean * mean, 0.0)
+        bessel = n / max(n - 1.0, 1.0)
+        var = biased * bessel
+        return SummaryStats(
+            count=float(s["count"]),
+            weight_sum=float(s["n"]),
+            mean=mean,
+            variance=var,
+            std=np.sqrt(var),
+            min=s["min"],
+            max=s["max"],
+            norm_l1=s["l1"],
+            norm_l2=np.sqrt(s["s2"]),
+            num_non_zeros=s["nnz"],
+        )

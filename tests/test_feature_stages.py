@@ -1,0 +1,266 @@
+"""New feature stages + ml.stat: MinMaxScaler, Bucketizer, OneHotEncoder,
+Imputer, PCA (sklearn/scipy parity), Correlation, Summarizer — plus
+artifact round-trips and Pipeline composition."""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.model_io import (
+    load_model,
+    save_model,
+)
+
+
+# ------------------------------------------------------------ MinMax
+def test_minmax_matches_sklearn(rng, mesh8):
+    sk = pytest.importorskip("sklearn.preprocessing")
+    x = rng.normal(size=(500, 4)).astype(np.float32) * [1, 10, 0.1, 5]
+    ours = ht.MinMaxScaler().fit(x).transform(x)
+    ref = sk.MinMaxScaler().fit_transform(x)
+    np.testing.assert_allclose(ours, ref, atol=1e-6)
+    # custom range + device path
+    ds = ht.device_dataset(x, mesh=mesh8)
+    m = ht.MinMaxScaler(min_out=-1.0, max_out=1.0).fit(ds)
+    out = m.transform(ds)
+    ref2 = sk.MinMaxScaler(feature_range=(-1, 1)).fit_transform(x)
+    got = np.asarray(out.x)[: len(x)]
+    np.testing.assert_allclose(got, ref2, atol=1e-5)
+
+
+def test_minmax_constant_column_midpoint(mesh8):
+    x = np.c_[np.ones(64), np.arange(64.0)].astype(np.float32)
+    out = ht.MinMaxScaler().fit(x).transform(x)
+    np.testing.assert_allclose(out[:, 0], 0.5)  # Spark midpoint rule
+    assert out[:, 1].min() == 0.0 and out[:, 1].max() == 1.0
+
+
+# ------------------------------------------------------------ Bucketizer
+def test_bucketizer(hospital_table):
+    b = ht.Bucketizer(
+        splits=[-np.inf, 2.0, 5.0, np.inf],
+        input_col="length_of_stay",
+        output_col="los_bucket",
+    )
+    out = b.transform(hospital_table)
+    los = hospital_table.column("length_of_stay")
+    expect = np.searchsorted([2.0, 5.0], los, side="right")
+    np.testing.assert_array_equal(out.column("los_bucket"), expect)
+
+
+def test_bucketizer_validation_and_invalid_handling(hospital_table):
+    with pytest.raises(ValueError, match="strictly increasing"):
+        ht.Bucketizer([0.0, 0.0, 1.0], "a", "b")
+    with pytest.raises(ValueError, match=">=3"):
+        ht.Bucketizer([0.0, 1.0], "a", "b")
+    bounded = ht.Bucketizer([0.0, 4.0, 6.0], "length_of_stay", "bk")
+    with pytest.raises(ValueError, match="outside the split range"):
+        bounded.transform(hospital_table)  # LOS exceeds 6 somewhere
+    keep = ht.Bucketizer([0.0, 4.0, 6.0], "length_of_stay", "bk", "keep")
+    out = keep.transform(hospital_table)
+    assert out.column("bk").max() == 2  # extra bucket
+    skip = ht.Bucketizer([0.0, 4.0, 6.0], "length_of_stay", "bk", "skip")
+    out2 = skip.transform(hospital_table)
+    assert len(out2) < len(hospital_table)
+    assert out2.column("bk").max() <= 1
+    # top boundary inclusive
+    b2 = ht.Bucketizer([0.0, 1.0, 2.0], "v", "bk")
+    tab = ht.Table.from_dict({"v": np.array([0.0, 1.0, 2.0])},
+                             ht.Schema([("v", "float")]))
+    np.testing.assert_array_equal(b2.transform(tab).column("bk"), [0, 1, 1])
+
+
+# ------------------------------------------------------------ OneHot
+def test_one_hot_encoder(hospital_table):
+    idx = ht.StringIndexer("hospital_id", "hid").fit(hospital_table)
+    tab = idx.transform(hospital_table)
+    enc = ht.OneHotEncoder(["hid"]).fit(tab)
+    out = enc.transform(tab)
+    k = len(idx.labels)
+    names = enc.output_names(0)
+    assert len(names) == k - 1  # drop_last
+    codes = tab.column("hid")
+    for i, nm in enumerate(names):
+        np.testing.assert_array_equal(out.column(nm), (codes == i).astype(int))
+    # keep-all variant + assembler composition
+    enc2 = ht.OneHotEncoder(["hid"], drop_last=False).fit(tab)
+    out2 = enc2.transform(tab)
+    mat = ht.VectorAssembler(enc2.output_names(0)).transform_matrix(out2)
+    np.testing.assert_allclose(mat.sum(axis=1), 1.0)
+
+
+def test_one_hot_invalid_handling(hospital_table):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.features import (
+        OneHotEncoderModel,
+    )
+
+    idx = ht.StringIndexer("hospital_id", "hid").fit(hospital_table)
+    tab = idx.transform(hospital_table)
+    small = OneHotEncoderModel(("hid",), ("v",), (2,), True, "error")
+    with pytest.raises(ValueError, match="outside"):
+        small.transform(tab)
+
+    rows_bad = tab.column("hid") >= 2
+    # Spark keep semantics: the invalid bucket is an EXTRA last category.
+    # dropLast=False → invalid rows get their own indicator column...
+    keep_all = OneHotEncoderModel(("hid",), ("v",), (2,), False, "keep")
+    out = keep_all.transform(tab)
+    assert keep_all.output_names(0) == ["v_0", "v_1", "v_2"]
+    np.testing.assert_array_equal(out.column("v_2"), rows_bad.astype(int))
+    # ...and dropLast=True drops the invalid bucket, so every VALID
+    # category keeps its indicator (code 1 stays distinguishable) while
+    # invalid rows encode all-zeros
+    keep_drop = OneHotEncoderModel(("hid",), ("v",), (2,), True, "keep")
+    out2 = keep_drop.transform(tab)
+    assert keep_drop.output_names(0) == ["v_0", "v_1"]
+    codes = tab.column("hid")
+    np.testing.assert_array_equal(out2.column("v_1"), (codes == 1).astype(int))
+    assert (out2.column("v_0")[rows_bad] == 0).all()
+    assert (out2.column("v_1")[rows_bad] == 0).all()
+
+    with pytest.raises(ValueError, match="no 'skip'"):
+        ht.OneHotEncoder(["hid"], handle_invalid="skip")
+
+
+# ------------------------------------------------------------ Imputer
+def test_imputer_strategies():
+    v = np.array([1.0, 2.0, np.nan, 4.0, np.nan, 2.0])
+    tab = ht.Table.from_dict({"v": v}, ht.Schema([("v", "float")]))
+    mean = ht.Imputer(["v"]).fit(tab).transform(tab).column("v")
+    np.testing.assert_allclose(mean[[2, 4]], np.nanmean(v))
+    med = ht.Imputer(["v"], strategy="median").fit(tab).transform(tab).column("v")
+    np.testing.assert_allclose(med[[2, 4]], 2.0)
+    mode = ht.Imputer(["v"], strategy="mode").fit(tab).transform(tab).column("v")
+    np.testing.assert_allclose(mode[[2, 4]], 2.0)
+    # sentinel missing value + separate output col
+    t2 = ht.Table.from_dict({"v": np.array([1.0, -999.0, 3.0])},
+                            ht.Schema([("v", "float")]))
+    m = ht.Imputer(["v"], ["v_f"], missing_value=-999.0).fit(t2)
+    out = m.transform(t2)
+    np.testing.assert_allclose(out.column("v_f"), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(out.column("v"), [1.0, -999.0, 3.0])
+    with pytest.raises(ValueError, match="strategy"):
+        ht.Imputer(["v"], strategy="zero").fit(t2)
+
+
+# ------------------------------------------------------------ PCA
+def test_pca_matches_sklearn(rng, mesh8):
+    skd = pytest.importorskip("sklearn.decomposition")
+    x = (rng.normal(size=(600, 5)) @ rng.normal(size=(5, 5))).astype(np.float32)
+    ours = ht.PCA(k=3).fit(x)
+    ref = skd.PCA(n_components=3).fit(np.asarray(x, dtype=np.float64))
+    # align sign per component before comparing
+    for j in range(3):
+        a = ours.components[:, j]
+        b = ref.components_[j]
+        if np.dot(a, b) < 0:
+            b = -b
+        np.testing.assert_allclose(a, b, atol=2e-4)
+    np.testing.assert_allclose(
+        ours.explained_variance, ref.explained_variance_, rtol=1e-3
+    )
+    # device path equals host path
+    ds = ht.device_dataset(x, mesh=mesh8)
+    m2 = ht.PCA(k=3).fit(ds)
+    np.testing.assert_allclose(m2.components, ours.components, atol=1e-3)
+    proj = m2.transform(ds)
+    np.testing.assert_allclose(
+        np.asarray(proj.x)[: len(x)],
+        ours.transform(np.asarray(x, dtype=np.float64)),
+        atol=2e-3,
+    )
+    with pytest.raises(ValueError, match="k must be"):
+        ht.PCA(k=9).fit(x)
+
+
+# ------------------------------------------------------------ stat
+def test_correlation_pearson_spearman(rng, mesh8):
+    stats = pytest.importorskip("scipy.stats")
+    x = rng.normal(size=(400, 4))
+    x[:, 1] = 0.7 * x[:, 0] + 0.3 * x[:, 1]
+    r = ht.Correlation.corr(x.astype(np.float32), mesh=mesh8)
+    np.testing.assert_allclose(r, np.corrcoef(x, rowvar=False), atol=1e-4)
+    rs = ht.Correlation.corr(x, method="spearman")
+    ref, _ = stats.spearmanr(x)
+    np.testing.assert_allclose(rs, ref, atol=1e-10)
+    with pytest.raises(ValueError, match="method"):
+        ht.Correlation.corr(x, method="kendall")
+
+
+def test_correlation_constant_column_nan(mesh8):
+    x = np.c_[np.ones(64), np.arange(64.0)].astype(np.float32)
+    r = ht.Correlation.corr(x, mesh=mesh8)
+    assert np.isnan(r[0, 1]) and np.isnan(r[1, 0])
+    assert r[0, 0] == 1.0 and r[1, 1] == 1.0
+
+
+def test_summarizer(rng, mesh8):
+    x = rng.normal(size=(300, 3)).astype(np.float32)
+    x[5, 0] = 0.0
+    w = rng.uniform(0.5, 2.0, size=300)
+    s = ht.Summarizer.summary(ht.device_dataset(x, mesh=mesh8, weights=w), mesh=mesh8)
+    wsum = w.sum()
+    mean = (x * w[:, None]).sum(0) / wsum
+    np.testing.assert_allclose(s.mean, mean, rtol=1e-4)
+    biased = (w[:, None] * (x - mean) ** 2).sum(0) / wsum
+    np.testing.assert_allclose(
+        s.variance, biased * wsum / (wsum - 1), rtol=1e-3
+    )
+    np.testing.assert_allclose(s.min, x.min(0), rtol=1e-6)
+    np.testing.assert_allclose(s.max, x.max(0), rtol=1e-6)
+    np.testing.assert_allclose(s.norm_l1, (np.abs(x) * w[:, None]).sum(0), rtol=1e-4)
+    np.testing.assert_allclose(
+        s.norm_l2, np.sqrt((x * x * w[:, None]).sum(0)), rtol=1e-4
+    )
+    assert s.count == 300
+    np.testing.assert_allclose(s.weight_sum, wsum, rtol=1e-5)
+
+
+# ----------------------------------------------- persistence + pipelines
+def test_new_stage_artifacts_roundtrip(hospital_table, rng, tmp_path):
+    x = rng.normal(size=(100, 4)).astype(np.float32)
+    idx = ht.StringIndexer("hospital_id", "hid").fit(hospital_table)
+    tab = idx.transform(hospital_table)
+    stages = [
+        ht.MinMaxScaler(min_out=-2.0).fit(x),
+        ht.Bucketizer([0.0, 1.0, 2.0], "length_of_stay", "bk", "keep"),
+        ht.OneHotEncoder(["hid"]).fit(tab),
+        ht.Imputer(["length_of_stay"]).fit(hospital_table),
+        ht.PCA(k=2).fit(x),
+    ]
+    for i, st in enumerate(stages):
+        name, meta, arrays = st._artifacts()
+        p = os.path.join(tmp_path, f"s{i}")
+        save_model(p, name, meta, arrays)
+        back = load_model(p)
+        assert type(back) is type(st)
+    pca_back = load_model(os.path.join(tmp_path, "s4"))
+    np.testing.assert_allclose(pca_back.components, stages[4].components)
+
+
+def test_new_stages_compose_in_pipeline(hospital_table, mesh8, tmp_path):
+    """Imputer/Bucketizer/OneHot run as Table stages, MinMax/PCA as
+    feature-matrix stages, all inside one fitted, persisted Pipeline."""
+    pipe = ht.Pipeline(
+        [
+            ht.Imputer(["length_of_stay"]),
+            ht.StringIndexer("hospital_id", "hid"),
+            ht.OneHotEncoder(["hid"]),
+            ht.VectorAssembler(ht.FEATURE_COLS),
+            ht.MinMaxScaler(),
+            ht.PCA(k=3),
+            ht.LinearRegression(),
+        ]
+    )
+    pm = pipe.fit(hospital_table, mesh=mesh8)
+    pred = pm.transform(hospital_table, mesh=mesh8)
+    rmse = ht.RegressionEvaluator("rmse").evaluate(pred)
+    assert np.isfinite(rmse)
+    p = os.path.join(tmp_path, "pm")
+    pm.save(p)
+    back = ht.load_model(p)
+    a, _ = pm.transform(hospital_table, mesh=mesh8).to_numpy()
+    b, _ = back.transform(hospital_table, mesh=mesh8).to_numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
