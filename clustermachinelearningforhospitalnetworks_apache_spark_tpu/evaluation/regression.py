@@ -6,8 +6,8 @@ distributed treeAggregate job per ``evaluate`` call (SURVEY.md §3.4); here
 each metric is a single fused, jit'd weighted reduction over sharded
 predictions — predictions never leave the device between fit and evaluate.
 
-Supported metrics: rmse (reference default), mse, mae, r2 — the same set
-Spark's evaluator exposes.
+Supported metrics: rmse (reference default), mse, mae, r2, var
+(explainedVariance) — the same set Spark's evaluator exposes.
 """
 
 from __future__ import annotations
@@ -35,6 +35,8 @@ def _local_sums(args):
         "abs_err": jnp.sum(jnp.abs(err) * w),
         "label_sum": jnp.sum(label * w),
         "label_sq": jnp.sum(label * label * w),
+        "pred_sum": jnp.sum(pred * w),
+        "pred_sq": jnp.sum(pred * pred * w),
     }
 
 
@@ -52,7 +54,7 @@ class RegressionEvaluator:
     @property
     def is_larger_better(self) -> bool:
         """Spark's ``isLargerBetter`` — model selection direction."""
-        return self.metric_name == "r2"
+        return self.metric_name in ("r2", "var")
 
     def evaluate(self, predictions, labels=None, weights=None) -> float:
         """Accepts either a PredictionResult-like object (``.prediction``,
@@ -94,4 +96,12 @@ class RegressionEvaluator:
         if self.metric_name == "r2":
             var = float(s["label_sq"]) / n - (float(s["label_sum"]) / n) ** 2
             return 1.0 - mse / var if var > 0 else 0.0
+        if self.metric_name == "var":
+            # Spark's explainedVariance: Σw(ŷ - ȳ)²/Σw with ȳ = label mean
+            ybar = float(s["label_sum"]) / n
+            return (
+                float(s["pred_sq"]) / n
+                - 2.0 * ybar * float(s["pred_sum"]) / n
+                + ybar * ybar
+            )
         raise ValueError(f"unknown metric {self.metric_name!r}")

@@ -218,6 +218,19 @@ class GaussianMixtureModel(ClusteringModel):
     def k(self) -> int:
         return self.means.shape[0]
 
+    @property
+    def summary(self):
+        """Spark's ``GaussianMixtureModel.summary`` surface (logLikelihood
+        / numIter); hard-assignment sizes aren't stored, so
+        ``cluster_sizes`` is None — use ``predict`` + a bincount for them."""
+        from .summary import ClusteringSummary
+
+        return ClusteringSummary(
+            k=self.k,
+            num_iter=self.n_iter,
+            log_likelihood=float(self.log_likelihood),
+        )
+
     def _device_params(self):
         means = jnp.asarray(self.means, jnp.float32)
         chols = jnp.linalg.cholesky(jnp.asarray(self.covariances, jnp.float32))

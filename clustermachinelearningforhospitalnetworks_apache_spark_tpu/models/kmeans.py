@@ -307,6 +307,24 @@ class KMeansModel(ClusteringModel):
     def k(self) -> int:
         return self.cluster_centers.shape[0]
 
+    @property
+    def summary(self):
+        """Spark's ``KMeansModel.summary`` surface (clusterSizes /
+        trainingCost / numIter) — available even after load, since the
+        stats persist with the model."""
+        from .summary import ClusteringSummary
+
+        return ClusteringSummary(
+            k=self.k,
+            num_iter=self.n_iter,
+            cluster_sizes=(
+                np.asarray(self.cluster_sizes)
+                if self.cluster_sizes is not None
+                else None
+            ),
+            training_cost=float(self.training_cost),
+        )
+
     def _prep(self, x: jax.Array) -> jax.Array:
         x = x.astype(jnp.float32)
         return normalize_rows(x) if self.distance_measure == "cosine" else x

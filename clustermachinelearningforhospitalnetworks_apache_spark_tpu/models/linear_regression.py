@@ -17,7 +17,7 @@ intercept, on standardized features when ``standardize=True``).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 
 import jax
@@ -164,6 +164,21 @@ def _wls_fit(x, y, w, reg_param, fit_intercept: bool, standardize: bool):
 class LinearRegressionModel(Model):
     coefficients: jax.Array
     intercept: jax.Array
+    _summary: object | None = field(default=None, repr=False, compare=False)
+
+    @property
+    def has_summary(self) -> bool:
+        return self._summary is not None
+
+    @property
+    def summary(self):
+        """Training summary (rmse/r2/residuals/t-values …) — fresh fits
+        only, like Spark's ``hasSummary``."""
+        if self._summary is None:
+            from .summary import summary_unavailable
+
+            raise summary_unavailable("LinearRegressionModel")
+        return self._summary
 
     def predict(self, x: jax.Array) -> jax.Array:
         check_features(x, self.coefficients.shape[0], "LinearRegressionModel")
@@ -215,8 +230,16 @@ class LinearRegression(Estimator):
                 jnp.float32(self.tol), self.fit_intercept, self.standardize,
                 self.max_iter,
             )
-            return LinearRegressionModel(coefficients=coef, intercept=intercept)
-        coef, intercept = _wls_fit(
-            ds.x, ds.y, ds.w, jnp.float32(self.reg_param), self.fit_intercept, self.standardize
+        else:
+            coef, intercept = _wls_fit(
+                ds.x, ds.y, ds.w, jnp.float32(self.reg_param), self.fit_intercept, self.standardize
+            )
+        model = LinearRegressionModel(coefficients=coef, intercept=intercept)
+        # lazy training summary (Spark: fresh fits carry .summary) — holds
+        # only references; every metric computes on first read
+        from .summary import LinearRegressionTrainingSummary
+
+        model._summary = LinearRegressionTrainingSummary(
+            model, ds, self.reg_param, self.elastic_net_param, self.fit_intercept
         )
-        return LinearRegressionModel(coefficients=coef, intercept=intercept)
+        return model

@@ -18,7 +18,7 @@ i.e. fewer passes over HBM than L-BFGS would take.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 
 import jax
@@ -235,6 +235,21 @@ class LogisticRegressionModel(Model):
     intercept: jax.Array
     threshold: float = 0.5
     n_iter: int = 0
+    _summary: object | None = field(default=None, repr=False, compare=False)
+
+    @property
+    def has_summary(self) -> bool:
+        return self._summary is not None
+
+    @property
+    def summary(self):
+        """Binary training summary (accuracy/AUC/per-label PRF) — fresh
+        fits only, like Spark's ``hasSummary``."""
+        if self._summary is None:
+            from .summary import summary_unavailable
+
+            raise summary_unavailable("LogisticRegressionModel")
+        return self._summary
 
     def predict_raw(self, x: jax.Array) -> jax.Array:
         """Log-odds (Spark's rawPrediction margin)."""
@@ -341,7 +356,11 @@ class LogisticRegression(Estimator):
             ds.x, ds.y, ds.w, jnp.float32(self.reg_param), jnp.float32(self.tol),
             self.fit_intercept, self.standardize, self.max_iter,
         )
-        return LogisticRegressionModel(
+        model = LogisticRegressionModel(
             coefficients=coef, intercept=intercept,
             threshold=self.threshold, n_iter=int(n_iter),
         )
+        from .summary import BinaryLogisticRegressionTrainingSummary
+
+        model._summary = BinaryLogisticRegressionTrainingSummary(model, ds)
+        return model

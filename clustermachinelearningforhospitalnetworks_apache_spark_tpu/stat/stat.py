@@ -64,23 +64,27 @@ def _host_features(data) -> np.ndarray:
     if isinstance(data, DeviceDataset):
         x = np.asarray(jax.device_get(data.x), dtype=np.float64)
         w = np.asarray(jax.device_get(data.w))
+        if not np.all((w == 0) | (w == 1)):
+            # the pearson path honors fractional weights via the weighted
+            # moments; ranking has no equivalent here, so silently
+            # unweighted spearman would disagree with pearson on the same
+            # data — refuse instead
+            raise ValueError(
+                "spearman correlation does not support fractional sample "
+                "weights; drop the weights or use method='pearson'"
+            )
         return x[w > 0]
     return np.asarray(data, dtype=np.float64)
 
 
 def _avg_rank(v: np.ndarray) -> np.ndarray:
-    order = np.argsort(v, kind="mergesort")
-    ranks = np.empty(len(v), dtype=np.float64)
-    sv = v[order]
-    # average rank over tie runs
-    i = 0
-    while i < len(sv):
-        j = i
-        while j + 1 < len(sv) and sv[j + 1] == sv[i]:
-            j += 1
-        ranks[order[i : j + 1]] = 0.5 * (i + j) + 1.0
-        i = j + 1
-    return ranks
+    """Average ranks with ties averaged (scipy.stats.rankdata 'average'),
+    vectorized: tie runs located via unique(return_inverse), run-average
+    ranks assigned through a cumulative-count lookup — no Python loop."""
+    _, inv, counts = np.unique(v, return_inverse=True, return_counts=True)
+    ends = np.cumsum(counts)                 # 1-based end rank of each run
+    starts = ends - counts + 1
+    return 0.5 * (starts + ends)[inv]
 
 
 @dataclass(frozen=True)

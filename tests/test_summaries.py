@@ -1,0 +1,166 @@
+"""model.summary parity (Spark TrainingSummary): lazy metrics on fresh
+fits, inference statistics on the unregularized LR path, hasSummary=False
+after load."""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def _lr_problem(rng, n=1000, d=4):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([2.0, -1.0, 0.5, 3.0])
+    y = (x @ beta + 1.5 + 0.3 * rng.normal(size=n)).astype(np.float32)
+    return x, y
+
+
+def test_linear_regression_summary_metrics(rng, mesh8):
+    x, y = _lr_problem(rng)
+    m = ht.LinearRegression().fit((x, y), mesh=mesh8)
+    assert m.has_summary
+    s = m.summary
+    assert s.num_instances == len(x)
+    # metrics agree with an explicit evaluator pass on the training data
+    pred = m.transform((x, y), mesh=mesh8)
+    np.testing.assert_allclose(
+        s.root_mean_squared_error,
+        ht.RegressionEvaluator("rmse").evaluate(pred),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        s.r2, ht.RegressionEvaluator("r2").evaluate(pred), rtol=1e-6
+    )
+    assert 0.9 < s.r2 <= 1.0
+    assert s.mean_absolute_error < 0.4
+    # explained variance ≈ label variance − noise variance on a good fit
+    assert s.explained_variance == pytest.approx(np.var(y), rel=0.1)
+    # residuals: weighted rows only, mean ~0
+    res = np.asarray(s.residuals)[: len(x)]
+    assert abs(res.mean()) < 0.05
+    assert s.degrees_of_freedom == len(x) - 5
+
+
+def test_linear_regression_inference_stats(rng, mesh8):
+    stats = pytest.importorskip("scipy.stats")
+    x, y = _lr_problem(rng, n=400)
+    m = ht.LinearRegression().fit((x, y), mesh=mesh8)
+    s = m.summary
+    # closed-form OLS reference
+    xa = np.c_[x.astype(np.float64), np.ones(len(x))]
+    beta = np.linalg.lstsq(xa, y.astype(np.float64), rcond=None)[0]
+    resid = y - xa @ beta
+    dof = len(x) - xa.shape[1]
+    sigma2 = float(resid @ resid) / dof
+    se = np.sqrt(np.diag(np.linalg.inv(xa.T @ xa)) * sigma2)
+    np.testing.assert_allclose(s.coefficient_standard_errors, se, rtol=2e-2)
+    np.testing.assert_allclose(s.t_values, beta / se, rtol=2e-2)
+    ref_p = 2 * stats.t.sf(np.abs(beta / se), dof)
+    np.testing.assert_allclose(s.p_values, ref_p, atol=1e-4)
+    # every true coefficient is significant on this clean signal
+    assert (s.p_values[:4] < 1e-6).all()
+
+
+def test_regularized_fit_raises_on_inference_stats(rng, mesh8):
+    x, y = _lr_problem(rng, n=200)
+    m = ht.LinearRegression(reg_param=0.5).fit((x, y), mesh=mesh8)
+    assert m.summary.root_mean_squared_error > 0  # metrics still fine
+    with pytest.raises(RuntimeError, match="unregularized"):
+        _ = m.summary.coefficient_standard_errors
+
+
+def test_summary_absent_after_load(rng, mesh8, tmp_path):
+    x, y = _lr_problem(rng, n=200)
+    m = ht.LinearRegression().fit((x, y), mesh=mesh8)
+    p = os.path.join(tmp_path, "lr")
+    m.write().overwrite().save(p)
+    back = ht.load_model(p)
+    assert not back.has_summary
+    with pytest.raises(RuntimeError, match="no training summary"):
+        _ = back.summary
+
+
+def test_logistic_summary(rng, mesh8):
+    x, y = _lr_problem(rng, n=1500)
+    yb = (y > np.median(y)).astype(np.float32)
+    m = ht.LogisticRegression(reg_param=1e-4).fit((x, yb), mesh=mesh8)
+    assert m.has_summary
+    s = m.summary
+    assert 0.85 < s.accuracy <= 1.0
+    assert 0.9 < s.area_under_roc <= 1.0
+    assert 0.9 < s.area_under_pr <= 1.0
+    # per-label PRF vs a hand-built confusion matrix
+    pred = m.predict_numpy(x)
+    for lbl in (0, 1):
+        tp = ((pred == lbl) & (yb == lbl)).sum()
+        prec = tp / max((pred == lbl).sum(), 1)
+        rec = tp / max((yb == lbl).sum(), 1)
+        np.testing.assert_allclose(s.precision_by_label[lbl], prec, rtol=1e-5)
+        np.testing.assert_allclose(s.recall_by_label[lbl], rec, rtol=1e-5)
+        f1 = 2 * prec * rec / (prec + rec)
+        np.testing.assert_allclose(s.f_measure_by_label[lbl], f1, rtol=1e-5)
+
+
+def test_clustering_summaries(rng, mesh8):
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], dtype=np.float32)
+    x = np.concatenate(
+        [c + rng.normal(0, 0.5, size=(200, 2)).astype(np.float32) for c in centers]
+    )
+    km = ht.KMeans(k=3, seed=0).fit(x, mesh=mesh8)
+    s = km.summary
+    assert s.k == 3 and s.num_iter >= 1
+    assert s.cluster_sizes.sum() == len(x)
+    assert s.training_cost > 0
+    assert s.log_likelihood is None
+
+    gm = ht.GaussianMixture(k=3, seed=0, max_iter=20).fit(x, mesh=mesh8)
+    gs = gm.summary
+    assert gs.k == 3 and np.isfinite(gs.log_likelihood)
+    assert gs.training_cost is None
+
+
+def test_no_intercept_inference_stats(rng, mesh8):
+    """fit_intercept=False: SEs/t/p computed on the no-intercept design,
+    no bogus intercept entry."""
+    stats = pytest.importorskip("scipy.stats")
+    x, y0 = _lr_problem(rng, n=400)
+    y = (y0 - y0.mean()).astype(np.float32)
+    m = ht.LinearRegression(fit_intercept=False).fit((x, y), mesh=mesh8)
+    s = m.summary
+    xa = x.astype(np.float64)
+    beta = np.linalg.lstsq(xa, y.astype(np.float64), rcond=None)[0]
+    resid = y - xa @ beta
+    dof = len(x) - xa.shape[1]
+    assert s.degrees_of_freedom == dof
+    sigma2 = float(resid @ resid) / dof
+    se = np.sqrt(np.diag(np.linalg.inv(xa.T @ xa)) * sigma2)
+    assert s.coefficient_standard_errors.shape == (4,)
+    np.testing.assert_allclose(s.coefficient_standard_errors, se, rtol=2e-2)
+    np.testing.assert_allclose(s.t_values, beta / se, rtol=2e-2)
+
+
+def test_var_metric_is_larger_better():
+    assert ht.RegressionEvaluator("var").is_larger_better
+
+
+def test_spearman_rejects_fractional_weights(rng, mesh8):
+    x = rng.normal(size=(100, 3)).astype(np.float32)
+    ds = ht.device_dataset(x, mesh=mesh8, weights=rng.uniform(0.1, 2.0, 100))
+    with pytest.raises(ValueError, match="fractional"):
+        ht.Correlation.corr(ds, method="spearman")
+    # 0/1 weights are fine (pad rows dropped)
+    ds2 = ht.device_dataset(x, mesh=mesh8)
+    r = ht.Correlation.corr(ds2, method="spearman")
+    assert r.shape == (3, 3)
+
+
+def test_explained_variance_evaluator(rng, mesh8):
+    """The new 'var' metric: Σw(ŷ−ȳ)²/Σw."""
+    x, y = _lr_problem(rng, n=500)
+    m = ht.LinearRegression().fit((x, y), mesh=mesh8)
+    pred = m.transform((x, y), mesh=mesh8)
+    var = ht.RegressionEvaluator("var").evaluate(pred)
+    p, l = pred.to_numpy()
+    np.testing.assert_allclose(var, np.mean((p - l.mean()) ** 2), rtol=1e-4)
