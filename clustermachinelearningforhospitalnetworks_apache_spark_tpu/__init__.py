@@ -30,14 +30,17 @@ from .features import (
     Binarizer,
     Bucketizer,
     Imputer,
+    IndexToString,
     MinMaxScaler,
+    Normalizer,
     OneHotEncoder,
     PCA,
+    PolynomialExpansion,
     StandardScaler,
     StringIndexer,
     VectorAssembler,
 )
-from .stat import Correlation, Summarizer
+from .stat import ChiSquareTest, Correlation, Summarizer
 from .evaluation import (
     ClusteringEvaluator,
     BinaryClassificationEvaluator,
@@ -91,7 +94,11 @@ __all__ = [
     "train_test_split",
     "Binarizer",
     "Bucketizer",
+    "ChiSquareTest",
     "Correlation",
+    "IndexToString",
+    "Normalizer",
+    "PolynomialExpansion",
     "Imputer",
     "MinMaxScaler",
     "OneHotEncoder",

@@ -6,6 +6,7 @@ from .bucketizer import Bucketizer
 from .imputer import Imputer, ImputerModel
 from .minmax import MinMaxScaler, MinMaxScalerModel
 from .onehot import OneHotEncoder, OneHotEncoderModel
+from .normalizer import IndexToString, Normalizer, PolynomialExpansion
 from .pca import PCA, PCAModel
 
 __all__ = [
@@ -23,6 +24,9 @@ __all__ = [
     "MinMaxScalerModel",
     "OneHotEncoder",
     "OneHotEncoderModel",
+    "IndexToString",
+    "Normalizer",
+    "PolynomialExpansion",
     "PCA",
     "PCAModel",
 ]

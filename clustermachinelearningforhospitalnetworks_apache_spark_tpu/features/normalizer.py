@@ -1,0 +1,149 @@
+"""Row-wise feature transforms: Normalizer, PolynomialExpansion,
+IndexToString.
+
+Parity with the corresponding ``pyspark.ml.feature`` stages.  All are
+stateless transformers (no fit) operating on the feature matrix
+(ndarray / device array / AssembledTable / DeviceDataset) or, for
+IndexToString, on a Table column — each is elementwise/row-local, so on
+device it fuses into whatever consumes it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.table import Table
+from ..io.model_io import register_model
+from ..parallel.sharding import DeviceDataset
+from .scaler import _is_assembled
+
+
+@register_model("Normalizer")
+@dataclass(frozen=True)
+class Normalizer:
+    """Scale each row to unit p-norm (Spark default p=2)."""
+
+    p: float = 2.0
+
+    def __post_init__(self):
+        if not self.p >= 1.0:
+            raise ValueError(f"p must be >= 1, got {self.p}")
+
+    def _artifacts(self):
+        return ("Normalizer", {"p": self.p}, {})
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(float(params.get("p", 2.0)))
+
+    def transform(self, x):
+        if _is_assembled(x):
+            return replace(x, features=self.transform(x.features))
+        if isinstance(x, DeviceDataset):
+            return DeviceDataset(
+                x=self.transform(x.x) * (x.w[:, None] > 0), y=x.y, w=x.w
+            )
+        xp = jnp if isinstance(x, jax.Array) else np
+        if self.p == 2.0:
+            norm = xp.sqrt((x * x).sum(axis=1))
+        elif self.p == 1.0:
+            norm = xp.abs(x).sum(axis=1)
+        elif np.isinf(self.p):
+            norm = xp.abs(x).max(axis=1)
+        else:
+            norm = (xp.abs(x) ** self.p).sum(axis=1) ** (1.0 / self.p)
+        safe = xp.where(norm > 0, norm, 1.0)
+        return x / safe[:, None].astype(x.dtype)
+
+
+@register_model("PolynomialExpansion")
+@dataclass(frozen=True)
+class PolynomialExpansion:
+    """All monomials of the input features up to ``degree`` (no bias
+    term), in sklearn's ``PolynomialFeatures(include_bias=False)`` column
+    order — Spark's expansion spans the same monomial space."""
+
+    degree: int = 2
+
+    def __post_init__(self):
+        if not 1 <= self.degree <= 4:
+            raise ValueError(f"degree must be in [1, 4], got {self.degree}")
+
+    def _artifacts(self):
+        return ("PolynomialExpansion", {"degree": self.degree}, {})
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(int(params.get("degree", 2)))
+
+    @staticmethod
+    def _exponents(d: int, degree: int) -> np.ndarray:
+        """(n_out, d) exponent rows, graded-lex like sklearn."""
+        from itertools import combinations_with_replacement
+
+        rows = []
+        for deg in range(1, degree + 1):
+            for combo in combinations_with_replacement(range(d), deg):
+                e = np.zeros(d, dtype=np.int64)
+                for i in combo:
+                    e[i] += 1
+                rows.append(e)
+        return np.stack(rows)
+
+    def num_outputs(self, d: int) -> int:
+        from math import comb
+
+        return comb(d + self.degree, self.degree) - 1
+
+    def transform(self, x):
+        if _is_assembled(x):
+            return replace(x, features=self.transform(x.features))
+        if isinstance(x, DeviceDataset):
+            out = self.transform(x.x) * (x.w[:, None] > 0)
+            return DeviceDataset(x=out, y=x.y, w=x.w)
+        xp = jnp if isinstance(x, jax.Array) else np
+        exps = self._exponents(x.shape[1], self.degree)
+        cols = [xp.prod(x ** xp.asarray(e, dtype=x.dtype)[None, :], axis=1) for e in exps]
+        return xp.stack(cols, axis=1)
+
+
+@register_model("IndexToString")
+@dataclass(frozen=True)
+class IndexToString:
+    """Integer codes → original labels (inverse of StringIndexer) — maps a
+    prediction column back to category strings, Spark's usual last stage."""
+
+    input_col: str
+    output_col: str
+    labels: Sequence[str]
+
+    def _artifacts(self):
+        return (
+            "IndexToString",
+            {
+                "input_col": self.input_col,
+                "output_col": self.output_col,
+                "labels": list(self.labels),
+            },
+            {},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(params["input_col"], params["output_col"], tuple(params["labels"]))
+
+    def transform(self, table: Table) -> Table:
+        codes = table.column(self.input_col).astype(np.int64)
+        lut = np.asarray(list(self.labels), dtype=object)
+        if codes.size and (codes.min() < 0 or codes.max() >= len(lut)):
+            bad = codes[(codes < 0) | (codes >= len(lut))][0]
+            raise ValueError(
+                f"code {int(bad)} in {self.input_col!r} has no label "
+                f"(0..{len(lut) - 1})"
+            )
+        return table.with_column(self.output_col, lut[codes], dtype="string")

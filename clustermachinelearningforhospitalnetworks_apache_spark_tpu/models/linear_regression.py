@@ -170,6 +170,11 @@ class LinearRegressionModel(Model):
     def has_summary(self) -> bool:
         return self._summary is not None
 
+    def release_summary(self) -> None:
+        """Drop the summary's reference to the training dataset, unpinning
+        it from device memory (see models/summary.py memory note)."""
+        self._summary = None
+
     @property
     def summary(self):
         """Training summary (rmse/r2/residuals/t-values …) — fresh fits

@@ -241,6 +241,11 @@ class LogisticRegressionModel(Model):
     def has_summary(self) -> bool:
         return self._summary is not None
 
+    def release_summary(self) -> None:
+        """Drop the summary's reference to the training dataset, unpinning
+        it from device memory (see models/summary.py memory note)."""
+        self._summary = None
+
     @property
     def summary(self):
         """Binary training summary (accuracy/AUC/per-label PRF) — fresh

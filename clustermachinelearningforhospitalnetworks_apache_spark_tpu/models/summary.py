@@ -8,6 +8,12 @@ dataset); every metric is computed on first access with one jit'd
 reduction over the mesh and cached — so fits pay nothing for summaries
 they never read (the BASELINE benches stay pure), while a migrating Spark
 user keeps the exact read-side surface.
+
+Memory note: the summary keeps the training ``DeviceDataset`` alive (and
+therefore resident in device memory) for the model's lifetime.  That's
+free when the caller holds the dataset anyway; when retaining many fitted
+models, call ``model.release_summary()`` (or drop the model) to unpin the
+data — saving a model never persists the summary.
 """
 
 from __future__ import annotations
@@ -30,15 +36,15 @@ def summary_unavailable(model_name: str):
 
 
 @partial(jax.jit, static_argnames=("fit_intercept",))
-def _xtwx_inv_diag(x: jax.Array, w: jax.Array, fit_intercept: bool):
-    """diag((X'WX)^-1), with an intercept column appended only when the
-    model actually fitted one — the covariance scaffold for coefficient
-    standard errors."""
+def _xtwx_gram(x: jax.Array, w: jax.Array, fit_intercept: bool):
+    """X'WX (intercept column appended only when the model fitted one) —
+    the device reduction; the tiny (p, p) inverse runs on host in float64
+    so collinearity can be DETECTED rather than silently producing
+    garbage f32 standard errors."""
     if fit_intercept:
         ones = jnp.ones((x.shape[0], 1), x.dtype)
         x = jnp.concatenate([x, ones], axis=1)
-    g = (x * w[:, None]).T @ x
-    return jnp.diag(jnp.linalg.inv(g))
+    return (x * w[:, None]).T @ x
 
 
 @dataclass
@@ -62,9 +68,14 @@ class LinearRegressionTrainingSummary:
         )
 
     @cached_property
-    def residuals(self) -> jax.Array:
+    def residuals(self) -> np.ndarray:
+        """Per-row label − prediction, valid rows only (pad rows dropped —
+        statistics computed on this array see exactly ``num_instances``
+        entries, like Spark's residuals column)."""
         p = self.predictions
-        return (p.label - p.prediction) * (p.weight > 0)
+        res = np.asarray(jax.device_get(p.prediction - p.label)) * -1.0
+        w = np.asarray(jax.device_get(p.weight))
+        return res[w > 0]
 
     @cached_property
     def _reg_metrics(self) -> dict[str, float]:
@@ -121,17 +132,26 @@ class LinearRegressionTrainingSummary:
     @cached_property
     def coefficient_standard_errors(self) -> np.ndarray:
         """Std errors for (coefficients..., intercept if fitted), Spark's
-        ordering."""
+        ordering.  Raises on a (near-)collinear design — e.g. the
+        dummy-variable trap of OneHotEncoder(drop_last=False) plus an
+        intercept — instead of returning f32-inverse garbage (Spark's
+        normal solver likewise errors on singular systems)."""
         self._require_unregularized()
-        diag = np.asarray(
+        g = np.asarray(
             jax.device_get(
-                _xtwx_inv_diag(
-                    self._ds.x.astype(jnp.float32), self._ds.w,
-                    self._fit_intercept,
-                )
+                _xtwx_gram(self._ds.x.astype(jnp.float32), self._ds.w,
+                           self._fit_intercept)
             ),
             dtype=np.float64,
         )
+        cond = np.linalg.cond(g)
+        if not np.isfinite(cond) or cond > 1e7:  # f32-data Gram limit
+            raise RuntimeError(
+                "design matrix is (near-)collinear (Gram condition number "
+                f"{cond:.2e}); standard errors are undefined — drop a "
+                "redundant column (e.g. OneHotEncoder(drop_last=True))"
+            )
+        diag = np.diag(np.linalg.inv(g))
         dof = max(self.degrees_of_freedom, 1)
         sigma2 = self.mean_squared_error * self.num_instances / dof
         return np.sqrt(np.maximum(diag * sigma2, 0.0))

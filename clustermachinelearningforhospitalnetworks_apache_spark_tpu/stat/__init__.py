@@ -1,3 +1,15 @@
-from .stat import Correlation, Summarizer, SummaryStats
+from .stat import (
+    ChiSquareTest,
+    ChiSquareTestResult,
+    Correlation,
+    Summarizer,
+    SummaryStats,
+)
 
-__all__ = ["Correlation", "Summarizer", "SummaryStats"]
+__all__ = [
+    "ChiSquareTest",
+    "ChiSquareTestResult",
+    "Correlation",
+    "Summarizer",
+    "SummaryStats",
+]

@@ -28,6 +28,68 @@ def _as_xw(data, mesh=None):
     return ds.x, ds.w
 
 
+@dataclass(frozen=True)
+class ChiSquareTestResult:
+    p_values: np.ndarray         # (d,)
+    degrees_of_freedom: np.ndarray  # (d,)
+    statistics: np.ndarray       # (d,)
+
+
+class ChiSquareTest:
+    """``pyspark.ml.stat.ChiSquareTest``: Pearson independence test of
+    every (categorical) feature against a categorical label.  The per-
+    feature contingency tables are tiny; they're built host-side from the
+    label/feature codes (Spark likewise collects the distinct-value
+    contingency counts to the driver)."""
+
+    @staticmethod
+    def test(features, labels) -> ChiSquareTestResult:
+        # own row extraction (NOT the spearman helper): pad rows must drop
+        # from features AND labels together, and fractional sample weights
+        # legitimately weight the contingency counts
+        if isinstance(features, DeviceDataset):
+            x = np.asarray(jax.device_get(features.x), dtype=np.float64)
+            w = np.asarray(jax.device_get(features.w), dtype=np.float64)
+        else:
+            x = _host_features(features, allow_weights=True)
+            w = np.ones(x.shape[0])
+        y = np.asarray(labels).reshape(-1)
+        if y.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"labels rows {y.shape[0]} != features rows {x.shape[0]} "
+                "(for a padded DeviceDataset pass the padded-length labels, "
+                "e.g. ds.y)"
+            )
+        keep = w > 0
+        x, y, w = x[keep], y[keep], w[keep]
+        stats_, dofs, ps = [], [], []
+        y_codes, y_inv = np.unique(y, return_inverse=True)
+        for j in range(x.shape[1]):
+            v_codes, v_inv = np.unique(x[:, j], return_inverse=True)
+            table = np.zeros((len(v_codes), len(y_codes)))
+            np.add.at(table, (v_inv, y_inv), w)
+            row = table.sum(axis=1, keepdims=True)
+            col = table.sum(axis=0, keepdims=True)
+            expect = row @ col / table.sum()
+            with np.errstate(invalid="ignore", divide="ignore"):
+                chi2 = float(np.nansum((table - expect) ** 2 / expect))
+            dof = (len(v_codes) - 1) * (len(y_codes) - 1)
+            try:
+                from scipy import stats as sps
+
+                p = float(sps.chi2.sf(chi2, dof)) if dof > 0 else 1.0
+            except ImportError:  # pragma: no cover
+                p = float("nan")
+            stats_.append(chi2)
+            dofs.append(dof)
+            ps.append(p)
+        return ChiSquareTestResult(
+            p_values=np.asarray(ps),
+            degrees_of_freedom=np.asarray(dofs),
+            statistics=np.asarray(stats_),
+        )
+
+
 class Correlation:
     """``Correlation.corr(features, method="pearson"|"spearman")`` → (d, d)
     matrix, mirroring ``pyspark.ml.stat.Correlation``."""
@@ -58,13 +120,13 @@ class Correlation:
         return np.clip(r, -1.0, 1.0)
 
 
-def _host_features(data) -> np.ndarray:
+def _host_features(data, allow_weights: bool = False) -> np.ndarray:
     if isinstance(data, AssembledTable):
         return np.asarray(data.features, dtype=np.float64)
     if isinstance(data, DeviceDataset):
         x = np.asarray(jax.device_get(data.x), dtype=np.float64)
         w = np.asarray(jax.device_get(data.w))
-        if not np.all((w == 0) | (w == 1)):
+        if not allow_weights and not np.all((w == 0) | (w == 1)):
             # the pearson path honors fractional weights via the weighted
             # moments; ranking has no equivalent here, so silently
             # unweighted spearman would disagree with pearson on the same

@@ -218,6 +218,61 @@ def test_summarizer(rng, mesh8):
     np.testing.assert_allclose(s.weight_sum, wsum, rtol=1e-5)
 
 
+# ------------------------------------------------------------ row transforms
+def test_normalizer_matches_sklearn(rng):
+    sk = pytest.importorskip("sklearn.preprocessing")
+    x = rng.normal(size=(200, 4)).astype(np.float32)
+    for p, norm in ((2.0, "l2"), (1.0, "l1"), (np.inf, "max")):
+        ours = ht.Normalizer(p=p).transform(x)
+        ref = sk.normalize(x, norm=norm)
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+    # zero rows stay zero, no NaN
+    z = np.zeros((3, 4), dtype=np.float32)
+    assert not np.isnan(ht.Normalizer().transform(z)).any()
+    with pytest.raises(ValueError, match="p must be"):
+        ht.Normalizer(p=0.5)
+
+
+def test_polynomial_expansion_matches_sklearn(rng):
+    sk = pytest.importorskip("sklearn.preprocessing")
+    x = rng.normal(size=(50, 3)).astype(np.float64)
+    pe = ht.PolynomialExpansion(degree=3)
+    ours = pe.transform(x)
+    ref = sk.PolynomialFeatures(degree=3, include_bias=False).fit_transform(x)
+    assert ours.shape[1] == pe.num_outputs(3) == ref.shape[1]
+    np.testing.assert_allclose(ours, ref, rtol=1e-10)
+    with pytest.raises(ValueError, match="degree"):
+        ht.PolynomialExpansion(degree=9)
+
+
+def test_index_to_string_roundtrip(hospital_table):
+    idx = ht.StringIndexer("hospital_id", "hid").fit(hospital_table)
+    tab = idx.transform(hospital_table)
+    back = ht.IndexToString("hid", "hospital_back", idx.labels).transform(tab)
+    assert (back.column("hospital_back") == hospital_table.column("hospital_id")).all()
+    bad = ht.IndexToString("hid", "x", idx.labels[:2])
+    with pytest.raises(ValueError, match="no label"):
+        bad.transform(tab)
+
+
+def test_chi_square_test(rng):
+    sps = pytest.importorskip("scipy.stats")
+    n = 2000
+    y = rng.integers(0, 2, size=n)
+    dependent = (y + rng.integers(0, 2, size=n) * (rng.random(n) < 0.2)).clip(0, 1)
+    independent = rng.integers(0, 3, size=n)
+    x = np.c_[dependent, independent].astype(np.float64)
+    res = ht.ChiSquareTest.test(x, y)
+    assert res.p_values[0] < 1e-10       # strongly dependent
+    assert res.p_values[1] > 0.01        # independent
+    assert res.degrees_of_freedom.tolist() == [1, 2]
+    # cross-check statistic 0 against scipy's contingency chi2
+    table = np.zeros((2, 2))
+    np.add.at(table, (dependent.astype(int), y), 1.0)
+    chi2_ref = sps.chi2_contingency(table, correction=False).statistic
+    np.testing.assert_allclose(res.statistics[0], chi2_ref, rtol=1e-10)
+
+
 # ----------------------------------------------- persistence + pipelines
 def test_new_stage_artifacts_roundtrip(hospital_table, rng, tmp_path):
     x = rng.normal(size=(100, 4)).astype(np.float32)

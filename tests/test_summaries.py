@@ -37,10 +37,14 @@ def test_linear_regression_summary_metrics(rng, mesh8):
     assert s.mean_absolute_error < 0.4
     # explained variance ≈ label variance − noise variance on a good fit
     assert s.explained_variance == pytest.approx(np.var(y), rel=0.1)
-    # residuals: weighted rows only, mean ~0
-    res = np.asarray(s.residuals)[: len(x)]
+    # residuals: exactly n entries (pad rows dropped), mean ~0
+    res = s.residuals
+    assert res.shape == (len(x),)
     assert abs(res.mean()) < 0.05
     assert s.degrees_of_freedom == len(x) - 5
+    # releasing the summary unpins the dataset and flips has_summary
+    m.release_summary()
+    assert not m.has_summary
 
 
 def test_linear_regression_inference_stats(rng, mesh8):
@@ -139,6 +143,34 @@ def test_no_intercept_inference_stats(rng, mesh8):
     assert s.coefficient_standard_errors.shape == (4,)
     np.testing.assert_allclose(s.coefficient_standard_errors, se, rtol=2e-2)
     np.testing.assert_allclose(s.t_values, beta / se, rtol=2e-2)
+
+
+def test_collinear_design_raises_on_standard_errors(rng, mesh8):
+    """Dummy-variable trap: exactly collinear columns + intercept — the
+    fit succeeds (jittered solve) but inference stats refuse."""
+    x0 = rng.normal(size=(300, 2)).astype(np.float32)
+    x = np.c_[x0, x0[:, 0] + x0[:, 1]]  # third col = sum of first two
+    y = (x0 @ np.array([1.0, 2.0]) + 0.1 * rng.normal(size=300)).astype(np.float32)
+    m = ht.LinearRegression().fit((x, y), mesh=mesh8)
+    assert np.isfinite(m.summary.root_mean_squared_error)
+    with pytest.raises(RuntimeError, match="collinear"):
+        _ = m.summary.coefficient_standard_errors
+
+
+def test_chi_square_on_device_dataset(rng, mesh8):
+    """Padded DeviceDataset + fractional weights: pad rows drop from
+    features and labels together; weights scale the contingency counts."""
+    n = 1001  # not a multiple of 8 — forces padding
+    y = rng.integers(0, 2, size=n).astype(np.float64)
+    x = np.c_[y, rng.integers(0, 3, size=n)].astype(np.float64)
+    w = rng.integers(1, 3, size=n).astype(np.float64)
+    ds = ht.device_dataset(x, y, mesh=mesh8, weights=w)
+    res = ht.ChiSquareTest.test(ds, np.asarray(ds.y))
+    # integer weights ≡ duplication
+    rep = np.repeat(np.arange(n), w.astype(int))
+    ref = ht.ChiSquareTest.test(x[rep], y[rep])
+    np.testing.assert_allclose(res.statistics, ref.statistics, rtol=1e-6)
+    assert res.p_values[0] < 1e-10 and res.p_values[1] > 0.001
 
 
 def test_var_metric_is_larger_better():
