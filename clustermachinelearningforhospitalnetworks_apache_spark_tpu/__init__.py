@@ -36,6 +36,7 @@ from .features import (
     OneHotEncoder,
     PCA,
     PolynomialExpansion,
+    QuantileDiscretizer,
     StandardScaler,
     StringIndexer,
     VectorAssembler,
@@ -68,6 +69,7 @@ from .tuning import (
 )
 from .models import (
     BisectingKMeans,
+    NaiveBayes,
     DecisionTreeClassifier,
     DecisionTreeRegressor,
     GaussianMixture,
@@ -99,6 +101,7 @@ __all__ = [
     "IndexToString",
     "Normalizer",
     "PolynomialExpansion",
+    "QuantileDiscretizer",
     "Imputer",
     "MinMaxScaler",
     "OneHotEncoder",
@@ -143,6 +146,7 @@ __all__ = [
     "KMeans",
     "LinearRegression",
     "LogisticRegression",
+    "NaiveBayes",
     "MultinomialLogisticRegressionModel",
     "RandomForestClassifier",
     "RandomForestRegressor",

@@ -3,6 +3,7 @@ from .scaler import StandardScaler, StandardScalerModel
 from .indexer import StringIndexer, StringIndexerModel
 from .binarizer import Binarizer
 from .bucketizer import Bucketizer
+from .discretizer import QuantileDiscretizer
 from .imputer import Imputer, ImputerModel
 from .minmax import MinMaxScaler, MinMaxScalerModel
 from .onehot import OneHotEncoder, OneHotEncoderModel
@@ -18,6 +19,7 @@ __all__ = [
     "StringIndexerModel",
     "Binarizer",
     "Bucketizer",
+    "QuantileDiscretizer",
     "Imputer",
     "ImputerModel",
     "MinMaxScaler",

@@ -6,6 +6,7 @@ from .logistic_regression import (
     MultinomialLogisticRegressionModel,
 )
 from .kmeans import KMeans, KMeansModel
+from .naive_bayes import NaiveBayes, NaiveBayesModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
 from .streaming_kmeans import StreamingKMeans, StreamingKMeansModel
@@ -29,6 +30,8 @@ __all__ = [
     "LogisticRegressionModel",
     "MultinomialLogisticRegressionModel",
     "KMeans",
+    "NaiveBayes",
+    "NaiveBayesModel",
     "KMeansModel",
     "GaussianMixture",
     "GaussianMixtureModel",

@@ -66,6 +66,14 @@ class ChiSquareTest:
         y_codes, y_inv = np.unique(y, return_inverse=True)
         for j in range(x.shape[1]):
             v_codes, v_inv = np.unique(x[:, j], return_inverse=True)
+            if len(v_codes) > 10_000:
+                # Spark's guard: a (near-)continuous feature makes the
+                # chi-square approximation meaningless (expected counts ~1)
+                raise ValueError(
+                    f"feature {j} has {len(v_codes)} distinct values "
+                    "(>10000); chi-square needs categorical features — "
+                    "discretize first (QuantileDiscretizer/Bucketizer)"
+                )
             table = np.zeros((len(v_codes), len(y_codes)))
             np.add.at(table, (v_inv, y_inv), w)
             row = table.sum(axis=1, keepdims=True)

@@ -1,0 +1,139 @@
+"""NaiveBayes (multinomial + gaussian) vs sklearn; QuantileDiscretizer."""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def test_multinomial_nb_matches_sklearn(rng, mesh8):
+    sknb = pytest.importorskip("sklearn.naive_bayes")
+    # count-like features from two different multinomial profiles
+    n, d = 1500, 6
+    y = rng.integers(0, 3, size=n)
+    profiles = rng.dirichlet(np.ones(d), size=3)
+    x = np.stack([rng.multinomial(40, profiles[c]) for c in y]).astype(np.float32)
+
+    ours = ht.NaiveBayes(smoothing=1.0).fit((x, y.astype(np.float32)), mesh=mesh8)
+    ref = sknb.MultinomialNB(alpha=1.0).fit(x, y)
+    np.testing.assert_allclose(ours.pi, ref.class_log_prior_, atol=1e-6)
+    np.testing.assert_allclose(ours.theta, ref.feature_log_prob_, atol=1e-5)
+    np.testing.assert_array_equal(ours.predict_numpy(x), ref.predict(x))
+
+
+def test_gaussian_nb_matches_sklearn(rng, mesh8):
+    sknb = pytest.importorskip("sklearn.naive_bayes")
+    n, d = 1200, 4
+    y = rng.integers(0, 2, size=n)
+    centers = np.array([[0, 0, 0, 0], [2, -1, 1, 3]], dtype=np.float64)
+    x = (centers[y] + rng.normal(0, 1.0, size=(n, d))).astype(np.float32)
+
+    ours = ht.NaiveBayes(model_type="gaussian", var_smoothing=1e-9).fit(
+        (x, y.astype(np.float32)), mesh=mesh8
+    )
+    ref = sknb.GaussianNB(var_smoothing=1e-9).fit(x, y)
+    np.testing.assert_allclose(ours.theta, ref.theta_, atol=1e-4)
+    np.testing.assert_allclose(ours.sigma, ref.var_, rtol=1e-3)
+    agree = (ours.predict_numpy(x) == ref.predict(x)).mean()
+    assert agree > 0.999
+    # probabilities match too
+    np.testing.assert_allclose(
+        np.asarray(ours.predict_proba(ht.device_dataset(x, mesh=mesh8).x))[: n],
+        ref.predict_proba(x),
+        atol=1e-4,
+    )
+
+
+def test_nb_weighted_equals_duplication(rng, mesh8):
+    n, d = 600, 5
+    y = rng.integers(0, 2, size=n).astype(np.float32)
+    x = np.abs(rng.normal(size=(n, d))).astype(np.float32)
+    w = rng.integers(1, 4, size=n).astype(np.float64)
+    rep = np.repeat(np.arange(n), w.astype(int))
+    m_w = ht.NaiveBayes().fit((x, y, w), mesh=mesh8)
+    m_d = ht.NaiveBayes().fit((x[rep], y[rep]), mesh=mesh8)
+    np.testing.assert_allclose(m_w.theta, m_d.theta, atol=1e-5)
+    np.testing.assert_allclose(m_w.pi, m_d.pi, atol=1e-6)
+
+
+def test_nb_validation_and_persistence(rng, mesh8, tmp_path):
+    x = rng.normal(size=(100, 3)).astype(np.float32)  # has negatives
+    y = rng.integers(0, 2, size=100).astype(np.float32)
+    with pytest.raises(ValueError, match="non-negative"):
+        ht.NaiveBayes().fit((x, y), mesh=mesh8)
+    with pytest.raises(ValueError, match="model_type"):
+        ht.NaiveBayes(model_type="bernoulli").fit((np.abs(x), y), mesh=mesh8)
+    m = ht.NaiveBayes(model_type="gaussian").fit((x, y), mesh=mesh8)
+    p = os.path.join(tmp_path, "nb")
+    m.write().overwrite().save(p)
+    back = ht.load_model(p)
+    np.testing.assert_array_equal(back.predict_numpy(x), m.predict_numpy(x))
+
+
+def test_nb_in_pipeline_with_evaluator(hospital_table, mesh8):
+    pipe = ht.Pipeline(
+        [
+            ht.Binarizer("length_of_stay", "LOS_binary", 5.0),
+            ht.VectorAssembler(ht.FEATURE_COLS),
+            ht.NaiveBayes(model_type="gaussian", label_col="LOS_binary"),
+        ]
+    )
+    train, test = ht.train_test_split(hospital_table, 0.7, 42)
+    pm = pipe.fit(train, label_col="LOS_binary", mesh=mesh8)
+    acc = ht.MulticlassClassificationEvaluator("accuracy").evaluate(
+        pm.transform(test, label_col="LOS_binary", mesh=mesh8)
+    )
+    assert acc > 0.8
+
+
+def test_gaussian_nb_large_mean_stability(rng, mesh8):
+    """Globally-centered stats survive features whose mean dwarfs the
+    within-class std (e.g. a year column) — the naive E[x²]−mean² form
+    in f32 would produce garbage variances here."""
+    sknb = pytest.importorskip("sklearn.naive_bayes")
+    n = 2000
+    y = rng.integers(0, 2, size=n)
+    year = (2023.0 + y + rng.normal(0, 0.5, size=n)).astype(np.float32)
+    other = (y * 2 + rng.normal(0, 1.0, size=n)).astype(np.float32)
+    x = np.c_[year, other].astype(np.float32)
+    ours = ht.NaiveBayes(model_type="gaussian").fit((x, y.astype(np.float32)), mesh=mesh8)
+    ref = sknb.GaussianNB().fit(np.asarray(x, np.float64), y)
+    np.testing.assert_allclose(ours.sigma, ref.var_, rtol=5e-3)
+    agree = (ours.predict_numpy(x) == ref.predict(x)).mean()
+    assert agree > 0.999
+
+
+def test_chi_square_rejects_continuous_features(rng):
+    x = rng.normal(size=(20000, 1))
+    y = rng.integers(0, 2, size=20000)
+    with pytest.raises(ValueError, match="distinct values"):
+        ht.ChiSquareTest.test(x, y)
+
+
+def test_quantile_discretizer_boundary_at_max():
+    """A quantile boundary equal to the column max is a VALID split
+    (closed top bucket) — Spark produces two buckets here."""
+    tab = ht.Table.from_dict(
+        {"v": np.array([1.0, 2.0, 2.0, 2.0])}, ht.Schema([("v", "float")])
+    )
+    bk = ht.QuantileDiscretizer(2, "v", "q").fit(tab)
+    out = bk.transform(tab)
+    np.testing.assert_array_equal(out.column("q"), [0, 1, 1, 1])
+
+
+def test_quantile_discretizer(hospital_table):
+    qd = ht.QuantileDiscretizer(4, "length_of_stay", "los_q")
+    bk = qd.fit(hospital_table)
+    out = bk.transform(hospital_table)
+    counts = np.bincount(out.column("los_q"), minlength=4)
+    # quartiles: roughly equal occupancy
+    assert counts.min() > 0.15 * len(hospital_table)
+    assert bk.num_buckets == 4
+    # constant column cannot be discretized
+    tab = ht.Table.from_dict({"c": np.ones(50)}, ht.Schema([("c", "float")]))
+    with pytest.raises(ValueError, match="too few distinct"):
+        ht.QuantileDiscretizer(3, "c", "cq").fit(tab)
+    with pytest.raises(ValueError, match="num_buckets"):
+        ht.QuantileDiscretizer(1, "c", "cq")
