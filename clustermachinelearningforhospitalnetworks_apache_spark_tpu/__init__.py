@@ -69,6 +69,8 @@ from .tuning import (
 )
 from .models import (
     BisectingKMeans,
+    GBTClassifier,
+    GBTRegressor,
     NaiveBayes,
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -143,6 +145,8 @@ __all__ = [
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "GaussianMixture",
+    "GBTClassifier",
+    "GBTRegressor",
     "KMeans",
     "LinearRegression",
     "LogisticRegression",

@@ -39,6 +39,11 @@ class QuantileDiscretizer:
         # be empty); a boundary at the max is valid — the closed top bucket
         # holds exactly the max values, matching Spark on skewed columns
         inner = inner[inner > v.min()]
+        if inner.size == 0:
+            # heavily skewed column (e.g. 80% zeros): every quantile sits
+            # at the min, but a multi-bucket split can still exist — fall
+            # back to interior unique-value boundaries
+            inner = np.unique(v)[1:][: self.num_buckets - 1]
         splits = np.concatenate([[-np.inf], inner, [np.inf]])
         if len(splits) < 3:
             raise ValueError(

@@ -11,6 +11,9 @@ from .gmm import GaussianMixture, GaussianMixtureModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
 from .streaming_kmeans import StreamingKMeans, StreamingKMeansModel
 from .tree import (
+    GBTClassifier,
+    GBTModel,
+    GBTRegressor,
     DecisionTreeClassifier,
     DecisionTreeModel,
     DecisionTreeRegressor,
@@ -30,6 +33,9 @@ __all__ = [
     "LogisticRegressionModel",
     "MultinomialLogisticRegressionModel",
     "KMeans",
+    "GBTClassifier",
+    "GBTModel",
+    "GBTRegressor",
     "NaiveBayes",
     "NaiveBayesModel",
     "KMeansModel",

@@ -33,8 +33,10 @@ def _count_sums(x: jax.Array, y: jax.Array, w: jax.Array, k: int):
     onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype) * w[:, None]
     counts = jnp.sum(onehot, axis=0)                 # (k,)
     s1 = onehot.T @ x                                # (k, d)
-    has_neg = jnp.any(jnp.where(w[:, None] > 0, x, 0.0) < 0)
-    return counts, s1, has_neg
+    # ~(x >= 0) catches BOTH negatives and NaN in one reduction — a NaN
+    # would otherwise pass a `< 0` check and silently poison theta
+    bad = jnp.any(~(jnp.where(w[:, None] > 0, x, 0.0) >= 0))
+    return counts, s1, bad
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -137,11 +139,12 @@ class NaiveBayes(Estimator):
         w_host = np.asarray(jax.device_get(ds.w))
         k = int(y_host[w_host > 0].max()) + 1 if np.any(w_host > 0) else 1
         if self.model_type == "multinomial":
-            counts, s1, has_neg = _count_sums(x, ds.y, ds.w, k)
-            if bool(jax.device_get(has_neg)):
+            counts, s1, bad = _count_sums(x, ds.y, ds.w, k)
+            if bool(jax.device_get(bad)):
                 raise ValueError(
-                    "multinomial NaiveBayes requires non-negative features "
-                    "(counts); use model_type='gaussian' for real-valued data"
+                    "multinomial NaiveBayes requires non-negative, non-NaN "
+                    "features (counts); use model_type='gaussian' for "
+                    "real-valued data"
                 )
             counts = np.asarray(counts, dtype=np.float64)
             s1 = np.asarray(s1, dtype=np.float64)
@@ -161,6 +164,11 @@ class NaiveBayes(Estimator):
         nk = np.maximum(counts[:, None], 1e-12)
         mean_c = s1c / nk
         var = s2c / nk - mean_c * mean_c
+        if not np.isfinite(mean_c).all() or not np.isfinite(var).all():
+            raise ValueError(
+                "gaussian NaiveBayes saw NaN/Inf features; clean or impute "
+                "first (features/imputer.py)"
+            )
         # sklearn-style portion-of-largest-variance floor
         floor = self.var_smoothing * max(float(var.max()), 1e-12)
         var = np.maximum(var, floor)

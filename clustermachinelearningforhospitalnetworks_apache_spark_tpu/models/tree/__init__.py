@@ -9,9 +9,13 @@ from .random_forest import (
     RandomForestRegressor,
 )
 from .engine import GrownForest, grow_forest, predict_forest
+from .gbt import GBTClassifier, GBTModel, GBTRegressor
 from .binning import digitize, quantile_thresholds
 
 __all__ = [
+    "GBTClassifier",
+    "GBTModel",
+    "GBTRegressor",
     "DecisionTreeClassifier",
     "DecisionTreeModel",
     "DecisionTreeRegressor",

@@ -345,12 +345,19 @@ def grow_forest(
     mesh: Mesh | None = None,
     init_sample_size: int = 65536,
     use_pallas: bool = False,
+    bin_thresholds: np.ndarray | None = None,
+    binned_t: jax.Array | None = None,
 ) -> GrownForest:
     """Train ``num_trees`` trees level-by-level on the sharded dataset.
 
     ``use_pallas`` routes the level histograms through the fused
     bin-and-accumulate kernel (ops/pallas_kernels.fused_level_hist)
-    instead of the XLA one-hot-contraction scan."""
+    instead of the XLA one-hot-contraction scan.  ``bin_thresholds``
+    ((d, max_bins-1), from ``binning.quantile_thresholds``) skips the
+    sampling/quantile pass; ``binned_t`` ((d, n_pad) int32, requires
+    ``bin_thresholds``) additionally skips the device digitize — callers
+    that train many ensembles on the same feature matrix (GBT boosting
+    rounds) bin once and reuse both."""
     from ...parallel.sharding import sample_valid_rows
 
     mesh = mesh or default_mesh()
@@ -360,14 +367,27 @@ def grow_forest(
     B = max_bins
     rng = np.random.default_rng(seed)
 
-    # 1. binning (host-sample thresholds, device digitize)
-    sample = sample_valid_rows(ds, init_sample_size, seed)
-    if sample.shape[0] == 0:
-        raise ValueError("tree fit on an empty dataset")
-    thr = quantile_thresholds(sample, B)
+    # 1. binning (host-sample thresholds, device digitize) — or reuse the
+    # caller's precomputed thresholds
+    if bin_thresholds is not None:
+        thr = np.asarray(bin_thresholds, dtype=np.float64)
+        if thr.shape != (d, B - 1):
+            raise ValueError(
+                f"bin_thresholds shape {thr.shape} != ({d}, {B - 1})"
+            )
+    else:
+        sample = sample_valid_rows(ds, init_sample_size, seed)
+        if sample.shape[0] == 0:
+            raise ValueError("tree fit on an empty dataset")
+        thr = quantile_thresholds(sample, B)
     # row axis LAST on every big device array (lane dim) — trailing d/S
     # axes would tile-pad to 128 lanes in HBM (see _make_level_hist)
-    binned_t = digitize(ds.x.astype(jnp.float32), jnp.asarray(thr, jnp.float32)).T
+    if binned_t is None:
+        binned_t = digitize(ds.x.astype(jnp.float32), jnp.asarray(thr, jnp.float32)).T
+    elif bin_thresholds is None:
+        raise ValueError("binned_t requires the matching bin_thresholds")
+    elif binned_t.shape != (d, n_pad):
+        raise ValueError(f"binned_t shape {binned_t.shape} != ({d}, {n_pad})")
 
     # 2. per-tree row weights: validity × (Poisson bootstrap | 1), drawn
     # on device (host draws + the (T, n) transfer dwarf the training time)

@@ -1,0 +1,105 @@
+"""GBTRegressor / GBTClassifier: boosting beats a single tree, tracks
+sklearn's GradientBoosting on the same hyperparameters, persists, and
+composes with Pipelines and weights."""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def _nonlinear(rng, n=3000, d=5):
+    x = rng.uniform(-2, 2, size=(n, d)).astype(np.float32)
+    y = (
+        np.sin(2 * x[:, 0]) * 2
+        + x[:, 1] ** 2
+        - 1.5 * x[:, 2]
+        + 0.1 * rng.normal(size=n)
+    ).astype(np.float32)
+    return x, y
+
+
+def test_gbt_regressor_beats_single_tree(rng, mesh8):
+    x, y = _nonlinear(rng)
+    tree = ht.DecisionTreeRegressor(max_depth=4).fit((x, y), mesh=mesh8)
+    gbt = ht.GBTRegressor(max_iter=30, max_depth=4, step_size=0.2).fit(
+        (x, y), mesh=mesh8
+    )
+    probe_x, probe_y = _nonlinear(rng, n=1000)
+    rmse = ht.RegressionEvaluator("rmse")
+    r_tree = rmse.evaluate(tree.transform((probe_x, probe_y), mesh=mesh8))
+    r_gbt = rmse.evaluate(gbt.transform((probe_x, probe_y), mesh=mesh8))
+    assert r_gbt < 0.7 * r_tree
+    assert gbt.num_trees == 30
+    assert gbt.feature_importances.shape == (5,)
+    # the three real features dominate the importances
+    assert gbt.feature_importances[[0, 1, 2]].sum() > 0.9
+
+
+def test_gbt_regressor_tracks_sklearn(rng, mesh8):
+    ske = pytest.importorskip("sklearn.ensemble")
+    x, y = _nonlinear(rng)
+    ours = ht.GBTRegressor(max_iter=40, max_depth=3, step_size=0.1).fit(
+        (x, y), mesh=mesh8
+    )
+    ref = ske.GradientBoostingRegressor(
+        n_estimators=40, max_depth=3, learning_rate=0.1
+    ).fit(x, y)
+    px, py = _nonlinear(rng, n=1000)
+    r_ours = float(np.sqrt(np.mean((ours.predict_numpy(px) - py) ** 2)))
+    r_ref = float(np.sqrt(np.mean((ref.predict(px) - py) ** 2)))
+    # histogram binning vs exact splits: allow 25% slack, not parity
+    assert r_ours < 1.25 * r_ref
+
+
+def test_gbt_classifier(rng, mesh8):
+    x, y = _nonlinear(rng)
+    yb = (y > np.median(y)).astype(np.float32)
+    gbt = ht.GBTClassifier(max_iter=25, max_depth=3, label_col=None or "y").fit(
+        (x, yb), mesh=mesh8
+    )
+    acc = ht.MulticlassClassificationEvaluator("accuracy").evaluate(
+        gbt.transform((x, yb), mesh=mesh8)
+    )
+    assert acc > 0.9
+    # probabilities are calibrated-ish: mean ≈ base rate
+    p = np.asarray(gbt.predict_proba(ht.device_dataset(x, mesh=mesh8).x))[: len(x)]
+    assert abs(p.mean() - yb.mean()) < 0.05
+    # margin sign == prediction
+    raw = np.asarray(gbt.predict_raw(ht.device_dataset(x, mesh=mesh8).x))[: len(x)]
+    np.testing.assert_array_equal(gbt.predict_numpy(x), (raw > 0).astype(np.float32))
+    with pytest.raises(ValueError, match="binary"):
+        ht.GBTClassifier(max_iter=2).fit((x, y), mesh=mesh8)  # continuous labels
+
+
+def test_gbt_persistence_and_pipeline(hospital_table, mesh8, tmp_path):
+    pipe = ht.Pipeline(
+        [ht.VectorAssembler(ht.FEATURE_COLS),
+         ht.GBTRegressor(max_iter=30, max_depth=3, step_size=0.3)]
+    )
+    train, test = ht.train_test_split(hospital_table, 0.7, 42)
+    pm = pipe.fit(train, mesh=mesh8)
+    rmse = ht.RegressionEvaluator("rmse").evaluate(pm.transform(test, mesh=mesh8))
+    assert rmse < 0.8
+    p = os.path.join(tmp_path, "gbt_pipe")
+    pm.save(p)
+    back = ht.load_model(p)
+    a, _ = pm.transform(test, mesh=mesh8).to_numpy()
+    b, _ = back.transform(test, mesh=mesh8).to_numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_gbt_weighted_zero_rows_inert(rng, mesh8):
+    x, y = _nonlinear(rng, n=1200)
+    keep = 800
+    w = np.r_[np.ones(keep), np.zeros(len(x) - keep)]
+    m_w = ht.GBTRegressor(max_iter=8, max_depth=3, seed=1).fit((x, y, w), mesh=mesh8)
+    m_t = ht.GBTRegressor(max_iter=8, max_depth=3, seed=1).fit(
+        (x[:keep], y[:keep]), mesh=mesh8
+    )
+    px, _ = _nonlinear(rng, n=300)
+    np.testing.assert_allclose(
+        m_w.predict_numpy(px), m_t.predict_numpy(px), rtol=1e-5, atol=1e-5
+    )
