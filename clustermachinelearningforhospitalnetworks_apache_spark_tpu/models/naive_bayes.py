@@ -49,8 +49,11 @@ def _gaussian_stats(x: jax.Array, y: jax.Array, w: jax.Array, k: int):
     the f32 sums lose nothing that matters.  One extra cheap global-mean
     reduction buys f64-two-pass-quality variances."""
     n = jnp.maximum(jnp.sum(w), 1.0)
-    gmean = jnp.sum(x * w[:, None], axis=0) / n
-    xc = x - gmean[None, :]
+    # mask invalid rows BEFORE any product with x: a NaN in a w=0 row
+    # would otherwise poison gmean/s2c (w=0 rows are contractually inert)
+    xm = jnp.where(w[:, None] > 0, x, 0.0)
+    gmean = jnp.sum(xm * w[:, None], axis=0) / n
+    xc = xm - gmean[None, :]
     onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype) * w[:, None]
     counts = jnp.sum(onehot, axis=0)
     s1c = onehot.T @ xc

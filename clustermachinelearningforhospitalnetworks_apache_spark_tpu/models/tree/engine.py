@@ -375,6 +375,9 @@ def grow_forest(
             raise ValueError(
                 f"bin_thresholds shape {thr.shape} != ({d}, {B - 1})"
             )
+        # the sampling path's empty-dataset guard must survive the fast path
+        if float(jax.device_get(ds.count())) == 0.0:
+            raise ValueError("tree fit on an empty dataset")
     else:
         sample = sample_valid_rows(ds, init_sample_size, seed)
         if sample.shape[0] == 0:

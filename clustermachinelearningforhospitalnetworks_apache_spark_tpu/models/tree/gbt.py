@@ -26,7 +26,7 @@ classification "logistic" on labels y∈{0,1} — F is half the log-odds
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -160,9 +160,10 @@ class _GBTParams:
         def residual(f):
             if loss == "squared":
                 return y - f
-            # −∂/∂F log(1+e^(−2y±F)) = 2(y01 − σ(2F)) — the factor 2 is
-            # part of Spark's ±1-margin LogLoss gradient
-            return 2.0 * (y - jax.nn.sigmoid(2.0 * f))
+            # Spark's mllib LogLoss: loss = 2·log(1+e^(−2y±F)), gradient
+            # −4y±/(1+e^(2y±F)) ⇒ pseudo-residual 4(y01 − σ(2F)).  The
+            # factor matters for stepSize parity with Spark.
+            return 4.0 * (y - jax.nn.sigmoid(2.0 * f))
 
         @jax.jit
         def advance(f, sf, th, val):

@@ -105,6 +105,22 @@ def test_gaussian_nb_large_mean_stability(rng, mesh8):
     assert agree > 0.999
 
 
+def test_gaussian_nb_nan_in_zero_weight_rows_inert(rng, mesh8):
+    """w=0 rows are contractually inert — a NaN there must not poison the
+    gaussian moments or trip the NaN guard; a NaN in a VALID row raises."""
+    x = rng.normal(size=(200, 3)).astype(np.float32)
+    y = rng.integers(0, 2, size=200).astype(np.float32)
+    xz = x.copy()
+    xz[-20:] = np.nan
+    w = np.r_[np.ones(180), np.zeros(20)]
+    m = ht.NaiveBayes(model_type="gaussian").fit((xz, y, w), mesh=mesh8)
+    ref = ht.NaiveBayes(model_type="gaussian").fit((x[:180], y[:180]), mesh=mesh8)
+    np.testing.assert_allclose(m.theta, ref.theta, atol=1e-5)
+    bad_w = np.ones(200)
+    with pytest.raises(ValueError, match="NaN"):
+        ht.NaiveBayes(model_type="gaussian").fit((xz, y, bad_w), mesh=mesh8)
+
+
 def test_chi_square_rejects_continuous_features(rng):
     x = rng.normal(size=(20000, 1))
     y = rng.integers(0, 2, size=20000)
