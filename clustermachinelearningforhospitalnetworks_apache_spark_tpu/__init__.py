@@ -50,6 +50,7 @@ from .evaluation import (
 )
 from .parallel import (
     FederatedDataset,
+    HostDataset,
     build_mesh,
     default_mesh,
     device_dataset,
@@ -118,6 +119,7 @@ __all__ = [
     "RegressionEvaluator",
     "build_mesh",
     "FederatedDataset",
+    "HostDataset",
     "federated_dataset",
     "default_mesh",
     "device_dataset",

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..io.model_io import register_model
 from ..parallel.mesh import DATA_AXIS, default_mesh
+from ..parallel.outofcore import add_stats as _gmm_add_stats
 from ..parallel.sharding import DeviceDataset
 from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
 from .kmeans import _chunked, _kmeans_pp_init, _lloyd_refine
@@ -62,24 +63,12 @@ def _e_step(x, w, log_weights, means, chols):
     return resp, log_likelihood
 
 
-@lru_cache(maxsize=32)
-def _make_em_loop(
-    mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int, max_iter: int
-):
-    """The whole EM fit as one jitted shard_map computation.
-
-    max_iter=1 doubles as the single-step builder for the host-hook path
-    (checkpointing / on_iteration callbacks need the host every step).
-    Convergence: |ll_t − ll_{t−1}| < tol, Spark semantics on the TOTAL
-    log-likelihood.
-    """
-    n_chunks, chunk = _chunked(n_loc, chunk_rows)
-    pad_to = n_chunks * chunk
+def _em_pass_builder(k: int, d: int):
+    """Chunk-scan E-step sufficient statistics (nk, Σr·x, Σr·xxᵀ, ll),
+    psum'd over the data axis — shared by the fused resident EM loop and
+    the out-of-core block-stats step."""
 
     def em_pass(x_c, w_c, shift, logw, means, chols):
-        """Chunk-scan E+M sufficient statistics, psum'd over the data axis:
-        (nk, Σr·x, Σr·xxᵀ, ll)."""
-
         def body(carry, inputs):
             nk, sums, outer, ll = carry
             xb, wb = inputs
@@ -118,6 +107,38 @@ def _make_em_loop(
             lax.psum(ll, DATA_AXIS),
         )
 
+    return em_pass
+
+
+def _m_step_rule(nk, sums, outer, reg_covar):
+    """The one copy of the M-step refit (means/covs/weights from
+    accumulated sufficient statistics) — shared by the fused resident loop
+    body and the out-of-core :func:`_gmm_m_step`."""
+    d = sums.shape[1]
+    eye = jnp.eye(d, dtype=jnp.float32)
+    nk = jnp.maximum(nk, 1e-6)
+    means = sums / nk[:, None]
+    covs = outer / nk[:, None, None] - jnp.einsum("kd,ke->kde", means, means)
+    covs = covs + reg_covar * eye[None]
+    weights = nk / jnp.sum(nk)
+    return means, covs, weights
+
+
+@lru_cache(maxsize=32)
+def _make_em_loop(
+    mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int, max_iter: int
+):
+    """The whole EM fit as one jitted shard_map computation.
+
+    max_iter=1 doubles as the single-step builder for the host-hook path
+    (checkpointing / on_iteration callbacks need the host every step).
+    Convergence: |ll_t − ll_{t−1}| < tol, Spark semantics on the TOTAL
+    log-likelihood.
+    """
+    n_chunks, chunk = _chunked(n_loc, chunk_rows)
+    pad_to = n_chunks * chunk
+    em_pass = _em_pass_builder(k, d)
+
     def shard_fn(x, w, shift, means, covs, weights, reg_covar, tol):
         xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
         wp = jnp.pad(w, (0, pad_to - n_loc))
@@ -135,11 +156,7 @@ def _make_em_loop(
             nk, sums, outer, ll = em_pass(
                 x_c, w_c, shift, jnp.log(weights), means, chols
             )
-            nk = jnp.maximum(nk, 1e-6)
-            means = sums / nk[:, None]
-            covs = outer / nk[:, None, None] - jnp.einsum("kd,ke->kde", means, means)
-            covs = covs + reg_covar * eye[None]
-            weights = nk / jnp.sum(nk)
+            means, covs, weights = _m_step_rule(nk, sums, outer, reg_covar)
             return it + 1, means, covs, weights, old_ll, ll
 
         init = (
@@ -166,6 +183,72 @@ def _make_em_loop(
             out_specs=(P(), P(), P(), P(), P()),
         )
     )
+
+
+def _init_params(valid: np.ndarray, k: int, d: int, seed: int, reg_covar: float):
+    """EM init from a SHIFTED host sample → (means, covs, weights).
+
+    k-means++ seeding + short Lloyd refinement (sklearn's
+    init_params="kmeans" equivalent) — raw ++ points alone leave EM in
+    visibly worse local optima on close blob pairs.  Per-cluster diagonal
+    covariance + cluster-share weights from the init assignment (a global
+    variance would span the blob spread and make the first E-step
+    responsibilities near-uniform, collapsing means)."""
+    means64, assign0 = _lloyd_refine(
+        valid, _kmeans_pp_init(valid, k, seed), iters=10, return_assign=True
+    )
+    means = means64.astype(np.float32)
+    covs = np.empty((k, d, d), dtype=np.float32)
+    weights = np.empty((k,), dtype=np.float32)
+    global_var = np.maximum(valid.var(axis=0), reg_covar)
+    for j in range(k):
+        mask = assign0 == j
+        weights[j] = max(mask.mean(), 1e-6)
+        if mask.sum() >= 2:
+            covs[j] = np.diag(np.maximum(valid[mask].var(axis=0), reg_covar))
+        else:
+            covs[j] = np.diag(global_var)
+    return means, covs, weights / weights.sum()
+
+
+@lru_cache(maxsize=32)
+def _make_em_stats_step(mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int):
+    """Per-BLOCK E-step sufficient statistics (nk, Σr·x, Σr·xxᵀ, ll) —
+    the out-of-core driver accumulates these across host row blocks, then
+    applies one :func:`_gmm_m_step` per EM iteration."""
+    n_chunks, chunk = _chunked(n_loc, chunk_rows)
+    pad_to = n_chunks * chunk
+    em_pass = _em_pass_builder(k, d)
+
+    def shard_fn(x, w, shift, logw, means, chols):
+        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
+        wp = jnp.pad(w, (0, pad_to - n_loc))
+        return em_pass(
+            xp.reshape(n_chunks, chunk, d), wp.reshape(n_chunks, chunk),
+            shift, logw, means, chols,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+        )
+    )
+
+
+@jax.jit
+def _gmm_m_step(nk, sums, outer, reg_covar):
+    """M-step refit from fully-accumulated out-of-core statistics — the
+    same :func:`_m_step_rule` the fused resident loop applies."""
+    return _m_step_rule(nk, sums, outer, reg_covar)
+
+
+@jax.jit
+def _gmm_chols(covs, reg_covar):
+    d = covs.shape[-1]
+    return jnp.linalg.cholesky(covs + reg_covar * jnp.eye(d, dtype=jnp.float32)[None])
 
 
 def _predict_assigned_local(xs, logw, means, chols, *, chunk):
@@ -335,8 +418,16 @@ class GaussianMixture(Estimator):
         self, data, label_col: str | None = None, mesh=None, on_iteration=None
     ) -> GaussianMixtureModel:
         """``on_iteration(it, log_likelihood)`` (optional) fires after every
-        EM step — progress reporting and fault-injection hooks."""
+        EM step — progress reporting and fault-injection hooks.
+
+        A :class:`~..parallel.outofcore.HostDataset` input takes the
+        out-of-core path: rows stream through the device in
+        ``max_device_rows`` blocks per EM iteration."""
+        from ..parallel.outofcore import HostDataset
+
         mesh = mesh or default_mesh()
+        if isinstance(data, HostDataset):
+            return self._fit_outofcore(data, mesh, on_iteration)
         ds: DeviceDataset = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
         x = ds.x.astype(jnp.float32)
         w = ds.w
@@ -387,32 +478,9 @@ class GaussianMixture(Estimator):
             start_it = step0 + 1
         else:
             # Init runs in SHIFTED coordinates, like the EM loop itself.
-            valid = valid - shift
-            # k-means++ seeding + short Lloyd refinement (sklearn's
-            # init_params="kmeans" equivalent) — raw ++ points alone leave
-            # EM in visibly worse local optima on close blob pairs.
-            means64, assign0 = _lloyd_refine(
-                valid, _kmeans_pp_init(valid, self.k, self.seed), iters=10,
-                return_assign=True,
+            means, covs, weights = _init_params(
+                valid - shift, self.k, d, self.seed, self.reg_covar
             )
-            means = means64.astype(np.float32)
-            # Per-cluster diagonal covariance + cluster-share weights from
-            # the init assignment (global variance spans the blob spread and
-            # makes the first E-step responsibilities near-uniform,
-            # collapsing means).
-            covs = np.empty((self.k, d, d), dtype=np.float32)
-            weights = np.empty((self.k,), dtype=np.float32)
-            global_var = np.maximum(valid.var(axis=0), self.reg_covar)
-            for j in range(self.k):
-                mask = assign0 == j
-                weights[j] = max(mask.mean(), 1e-6)
-                if mask.sum() >= 2:
-                    covs[j] = np.diag(
-                        np.maximum(valid[mask].var(axis=0), self.reg_covar)
-                    )
-                else:
-                    covs[j] = np.diag(global_var)
-            weights = weights / weights.sum()
 
         means_d = jnp.asarray(means)
         covs_d = jnp.asarray(covs)
@@ -466,6 +534,67 @@ class GaussianMixture(Estimator):
                     prev_ll = ll
                     break
                 prev_ll = ll
+
+        return GaussianMixtureModel(
+            weights=np.asarray(jax.device_get(weights_d)),
+            means=np.asarray(jax.device_get(means_d)) + shift,
+            covariances=np.asarray(jax.device_get(covs_d)),
+            log_likelihood=ll,
+            avg_log_likelihood=ll / max(n, 1.0),
+            n_iter=it,
+        )
+
+    def _fit_outofcore(self, hd, mesh: Mesh, on_iteration=None) -> GaussianMixtureModel:
+        """Rows ≫ HBM: per EM iteration, stream ``max_device_rows`` blocks
+        through the mesh accumulating the SAME psum'd sufficient statistics
+        (nk, Σr·x, Σr·xxᵀ, ll) as the resident chunk scan, then apply one
+        M-step — device memory bounded by the block size."""
+        if self.checkpoint_dir:
+            raise ValueError(
+                "checkpoint_dir is not supported for HostDataset "
+                "(out-of-core) fits yet; fit resident or drop checkpointing"
+            )
+        d = hd.n_features
+        n = hd.count()
+        if n == 0:
+            raise ValueError("GaussianMixture fit on an empty dataset")
+        valid = hd.sample_rows(self.init_sample_size, self.seed)
+        shift = (
+            valid.mean(axis=0).astype(np.float32)
+            if valid.shape[0]
+            else np.zeros((d,), np.float32)
+        )
+        means, covs, weights = _init_params(
+            valid - shift, self.k, d, self.seed, self.reg_covar
+        )
+        means_d = jnp.asarray(means)
+        covs_d = jnp.asarray(covs)
+        weights_d = jnp.asarray(weights)
+        shift_d = jnp.asarray(shift)
+        reg = jnp.float32(self.reg_covar)
+
+        _, b = hd.block_shape(mesh)
+        n_loc = b // mesh.shape[DATA_AXIS]
+        step = _make_em_stats_step(mesh, n_loc, self.k, d, self.chunk_rows)
+
+        ll = 0.0
+        prev_ll = -np.inf
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            chols = _gmm_chols(covs_d, reg)
+            logw = jnp.log(weights_d)
+            tot = None
+            for blk in hd.blocks(mesh):
+                s = step(blk.x, blk.w, shift_d, logw, means_d, chols)
+                tot = s if tot is None else _gmm_add_stats(tot, s)
+            nk, sums, outer, ll_dev = tot
+            means_d, covs_d, weights_d = _gmm_m_step(nk, sums, outer, reg)
+            ll = float(ll_dev)  # TOTAL log-likelihood — Spark tol semantics
+            if on_iteration is not None:
+                on_iteration(it, ll)
+            if abs(ll - prev_ll) < self.tol:
+                break
+            prev_ll = ll
 
         return GaussianMixtureModel(
             weights=np.asarray(jax.device_get(weights_d)),

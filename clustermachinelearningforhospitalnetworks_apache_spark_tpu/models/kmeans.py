@@ -29,7 +29,7 @@ sample fits on host so the exact sequential form is used) or ``random``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..io.model_io import register_model
 from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
+from ..parallel.outofcore import add_stats as _add_stats
 from ..parallel.sharding import DeviceDataset
 from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
 
@@ -48,25 +49,32 @@ from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_fe
 _BIG = np.float32(1e30)
 
 
+def _centroid_rule(sums, counts, centers, c_valid, cosine: bool):
+    """The one copy of the centroid-update rule, shared by the resident
+    step tail (:func:`_finalize_lloyd`) and the out-of-core update
+    (:func:`_centroid_update`): empty clusters keep their previous center
+    (Spark behavior); cosine re-normalizes after every update (Spark's
+    CosineDistanceMeasure — without it the ||c||² term in the distance
+    stops ordering by cosine similarity)."""
+    new_centers = jnp.where(
+        (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], centers
+    )
+    if cosine:
+        new_centers = normalize_rows(new_centers)
+    move = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1) * c_valid)
+    return new_centers, move
+
+
 def _finalize_lloyd(sums, counts, cost, centers, c_valid, cosine: bool):
     """Shared tail of both step builders: combine per-shard stats over the
-    data axis, apply the centroid update (empty clusters keep their previous
-    center — Spark behavior), and compute the convergence movement."""
+    data axis, apply the centroid update, compute convergence movement."""
     sums = lax.psum(sums, DATA_AXIS)
     counts = lax.psum(counts, DATA_AXIS)
     # cost is numerically identical on every model shard (built from the
     # global per-row minima); pmax collapses the model-axis variance so it
     # can be emitted replicated.
     cost = lax.pmax(lax.psum(cost, DATA_AXIS), MODEL_AXIS)
-    new_centers = jnp.where(
-        (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], centers
-    )
-    if cosine:
-        # Spark's CosineDistanceMeasure re-normalizes the centroid after
-        # every update; without this the ||c||² term in the distance
-        # stops ordering by cosine similarity.
-        new_centers = normalize_rows(new_centers)
-    move = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1) * c_valid)
+    new_centers, move = _centroid_rule(sums, counts, centers, c_valid, cosine)
     move = lax.pmax(move, MODEL_AXIS)
     return new_centers, counts, cost, move
 
@@ -78,17 +86,16 @@ def _chunked(n_loc: int, target: int) -> tuple[int, int]:
     return n_chunks, chunk
 
 
-@lru_cache(maxsize=64)
-def _make_train_step(
-    mesh: Mesh, n_loc: int, k_pad: int, d: int, chunk_rows: int, cosine: bool = False
-):
-    """One full Lloyd iteration as a shard_map over (data, model)."""
+def _lloyd_shard_stats(n_loc: int, k_pad: int, d: int, chunk_rows: int, m: int):
+    """Shard-local Lloyd sufficient statistics — the chunk-scanned
+    assignment + accumulation shared by the resident train step and the
+    out-of-core block-stats step.  Returns a function
+    ``(x, w, centers, c_valid) -> (sums, counts, cost)`` (pre-psum)."""
     n_chunks, chunk = _chunked(n_loc, chunk_rows)
     pad_to = n_chunks * chunk
-    m = mesh.shape[MODEL_AXIS]
     k_loc = k_pad // m
 
-    def shard_fn(x, w, centers, c_valid):
+    def stats(x, w, centers, c_valid):
         # x: (n_loc, d) data-shard; centers: (k_loc, d) model-shard;
         # c_valid: (k_loc,) 1.0 for real centroids, 0.0 for k-padding.
         my_m = lax.axis_index(MODEL_AXIS)
@@ -126,6 +133,21 @@ def _make_train_step(
             ),
         )
         (sums, counts, cost), _ = lax.scan(body, init, (xc, wc))
+        return sums, counts, cost
+
+    return stats
+
+
+@lru_cache(maxsize=64)
+def _make_train_step(
+    mesh: Mesh, n_loc: int, k_pad: int, d: int, chunk_rows: int, cosine: bool = False
+):
+    """One full Lloyd iteration as a shard_map over (data, model)."""
+    m = mesh.shape[MODEL_AXIS]
+    stats = _lloyd_shard_stats(n_loc, k_pad, d, chunk_rows, m)
+
+    def shard_fn(x, w, centers, c_valid):
+        sums, counts, cost = stats(x, w, centers, c_valid)
         return _finalize_lloyd(sums, counts, cost, centers, c_valid, cosine)
 
     return jax.jit(
@@ -136,6 +158,48 @@ def _make_train_step(
             out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P()),
         )
     )
+
+
+@lru_cache(maxsize=64)
+def _make_stats_step(
+    mesh: Mesh, n_loc: int, k_pad: int, d: int, chunk_rows: int
+):
+    """Per-BLOCK Lloyd sufficient statistics (sums, counts, cost), psum'd
+    over the mesh but WITHOUT the centroid update — the out-of-core driver
+    accumulates these across host row blocks, then applies one
+    :func:`_centroid_update` per Lloyd iteration."""
+    m = mesh.shape[MODEL_AXIS]
+    stats = _lloyd_shard_stats(n_loc, k_pad, d, chunk_rows, m)
+
+    def shard_fn(x, w, centers, c_valid):
+        sums, counts, cost = stats(x, w, centers, c_valid)
+        sums = lax.psum(sums, DATA_AXIS)
+        counts = lax.psum(counts, DATA_AXIS)
+        cost = lax.pmax(lax.psum(cost, DATA_AXIS), MODEL_AXIS)
+        return sums, counts, cost
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
+            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P()),
+        )
+    )
+
+
+@partial(jax.jit, static_argnames=("cosine",))
+def _centroid_update(sums, counts, centers, c_valid, cosine: bool):
+    """Centroid update from fully-accumulated out-of-core stats — the same
+    :func:`_centroid_rule` the resident step applies per iteration."""
+    return _centroid_rule(sums, counts, centers, c_valid, cosine)
+
+
+@jax.jit
+def _cosine_prep(x, w):
+    """Unit rows with pad rows zeroed — the cosine-mode preprocessing the
+    resident fit applies once, applied per streamed block instead."""
+    return normalize_rows(x.astype(jnp.float32)) * (w[:, None] > 0)
 
 
 @lru_cache(maxsize=64)
@@ -404,12 +468,8 @@ class KMeans(Estimator):
     checkpoint_every: int = 5
     weight_col: str | None = None  # Spark's weightCol (3.0+)
 
-    def _init_centers(self, ds: DeviceDataset, mesh: Mesh) -> np.ndarray:
-        # Host-side init on a bounded sample of valid rows (only the sample
-        # crosses the device→host boundary).
-        from ..parallel.sharding import sample_valid_rows
-
-        valid = sample_valid_rows(ds, self.init_sample_size, self.seed)
+    def _init_from_sample(self, valid: np.ndarray) -> np.ndarray:
+        """Shared init tail: (sample of valid rows) → (k, d) start centers."""
         if valid.shape[0] == 0:
             raise ValueError("k-means fit on an empty dataset")
         rng = np.random.default_rng(self.seed)
@@ -425,13 +485,96 @@ class KMeans(Estimator):
             return centers
         return _kmeans_pp_init(valid, self.k, self.seed)
 
+    def _init_centers(self, ds: DeviceDataset, mesh: Mesh) -> np.ndarray:
+        # Host-side init on a bounded sample of valid rows (only the sample
+        # crosses the device→host boundary).
+        from ..parallel.sharding import sample_valid_rows
+
+        return self._init_from_sample(
+            sample_valid_rows(ds, self.init_sample_size, self.seed)
+        )
+
+    def _fit_outofcore(self, hd, mesh: Mesh, on_iteration=None) -> KMeansModel:
+        """Rows ≫ HBM: stream ``max_device_rows`` blocks through the mesh
+        per Lloyd iteration, accumulating the SAME psum'd sufficient
+        statistics as the resident step, then apply one centroid update —
+        device memory stays bounded by the block size while results match
+        the resident path (bit-equal when the sums are exact, e.g.
+        integer-valued features; see tests/test_outofcore.py)."""
+        if self.checkpoint_dir:
+            raise ValueError(
+                "checkpoint_dir is not supported for HostDataset "
+                "(out-of-core) fits yet; fit resident or drop checkpointing"
+            )
+        cosine = self.distance_measure == "cosine"
+        d = hd.n_features
+        m = mesh.shape[MODEL_AXIS]
+        k_pad = -(-self.k // m) * m
+
+        centers0 = self._init_from_sample(
+            hd.sample_rows(self.init_sample_size, self.seed)
+        )
+        cen = np.zeros((k_pad, d), dtype=np.float32)
+        cen[: self.k] = centers0
+        c_valid = np.zeros((k_pad,), dtype=np.float32)
+        c_valid[: self.k] = 1.0
+        centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+        c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+
+        _, b = hd.block_shape(mesh)
+        n_loc = b // mesh.shape[DATA_AXIS]
+        step = _make_stats_step(mesh, n_loc, k_pad, d, self.chunk_rows)
+
+        def prep(blk):
+            if not cosine:
+                return blk.x
+            # same rule as the resident path: unit rows, pad rows zeroed
+            return _cosine_prep(blk.x, blk.w)
+
+        def epoch(cen_dev):
+            tot = None
+            for blk in hd.blocks(mesh):
+                s = step(prep(blk), blk.w, cen_dev, c_valid_dev)
+                tot = s if tot is None else _add_stats(tot, s)
+            return tot
+
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            sums, counts, cost = epoch(centers)
+            centers, move = _centroid_update(
+                sums, counts, centers, c_valid_dev, cosine
+            )
+            if on_iteration is not None:
+                on_iteration(it, float(cost), float(move))
+            if float(move) <= self.tol * self.tol:
+                break
+        # final pass so cost/sizes describe the RETURNED centers (Spark's
+        # summary.trainingCost semantics, same as the resident path)
+        _, counts, cost = epoch(centers)
+        return KMeansModel(
+            cluster_centers=np.asarray(jax.device_get(centers))[: self.k],
+            distance_measure=self.distance_measure,
+            training_cost=float(cost),
+            n_iter=it,
+            cluster_sizes=np.asarray(jax.device_get(counts))[: self.k],
+        )
+
     def fit(
         self, data, label_col: str | None = None, mesh=None, on_iteration=None
     ) -> KMeansModel:
         """``on_iteration(it, cost, move)`` (optional) fires after every
         Lloyd step — progress reporting, early aborts, and the fault-
-        injection hooks the checkpoint tests use."""
+        injection hooks the checkpoint tests use.
+
+        A :class:`~..parallel.outofcore.HostDataset` input takes the
+        out-of-core path: rows stream through the device in
+        ``max_device_rows`` blocks (Spark's disk-backed-RDD analogue,
+        SURVEY.md §7 hard part 3)."""
+        from ..parallel.outofcore import HostDataset
+
         mesh = mesh or default_mesh()
+        if isinstance(data, HostDataset):
+            return self._fit_outofcore(data, mesh, on_iteration)
         ds = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
         x = ds.x.astype(jnp.float32)
         if self.distance_measure == "cosine":

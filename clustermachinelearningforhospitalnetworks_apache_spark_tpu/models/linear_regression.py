@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.model_io import register_model
+from ..parallel.outofcore import add_stats as _lr_add_stats
 from ..parallel.sharding import DeviceDataset
 from .base import Estimator, Model, as_device_dataset, check_features
 
@@ -63,6 +64,44 @@ def standardized_design(x, w, reg_param, fit_intercept: bool, standardize: bool)
     return xa, ridge, nfeat, n
 
 
+def _fista(g, c, l1, l2, tol, max_iter: int):
+    """FISTA proximal loop on a precomputed standardized (d, d) Gram —
+    minimizes ½β̃ᵀGβ̃ − cᵀβ̃ + l1‖β̃‖₁ + l2/2‖β̃‖².  Traceable; shared by
+    the resident elastic-net fit and the out-of-core gram path."""
+    d_feat = g.shape[0]
+
+    # Lipschitz constant of ∇f: λmax(G) + l2, via power iteration.
+    def pow_body(_, v):
+        v = g @ v
+        return v / jnp.maximum(jnp.linalg.norm(v), 1e-30)
+
+    v0 = jnp.ones((d_feat,), g.dtype) / jnp.sqrt(jnp.float32(d_feat))
+    v = jax.lax.fori_loop(0, 32, pow_body, v0)
+    lips = jnp.maximum(v @ (g @ v), 1e-12) + l2
+
+    def soft(u, t):
+        return jnp.sign(u) * jnp.maximum(jnp.abs(u) - t, 0.0)
+
+    def cond(carry):
+        _, _, _, it, delta = carry
+        return (it < max_iter) & (delta > tol)
+
+    def body(carry):
+        beta, z, t, it, _ = carry
+        grad = g @ z - c + l2 * z
+        beta_new = soft(z - grad / lips, l1 / lips)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_new = beta_new + ((t - 1.0) / t_new) * (beta_new - beta)
+        delta = jnp.max(jnp.abs(beta_new - beta))
+        return beta_new, z_new, t_new, it + 1, delta
+
+    beta0 = jnp.zeros((d_feat,), g.dtype)
+    beta, _, _, n_iter, _ = jax.lax.while_loop(
+        cond, body, (beta0, beta0, jnp.float32(1.0), 0, jnp.float32(jnp.inf))
+    )
+    return beta, n_iter
+
+
 @partial(jax.jit, static_argnames=("fit_intercept", "standardize", "max_iter"))
 def _elastic_net_fit(
     x, y, w, reg_param, en_param, tol,
@@ -99,38 +138,8 @@ def _elastic_net_fit(
     g = (xs * wcol).T @ xs / n                       # (d, d)
     c = (xs * wcol).T @ (y - yc) / n                 # (d,)
 
-    l1 = reg_param * en_param
-    l2 = reg_param * (1.0 - en_param)
-
-    # Lipschitz constant of ∇f: λmax(G) + l2, via power iteration.
-    def pow_body(_, v):
-        v = g @ v
-        return v / jnp.maximum(jnp.linalg.norm(v), 1e-30)
-
-    d_feat = x.shape[1]
-    v0 = jnp.ones((d_feat,), x.dtype) / jnp.sqrt(jnp.float32(d_feat))
-    v = jax.lax.fori_loop(0, 32, pow_body, v0)
-    lips = jnp.maximum(v @ (g @ v), 1e-12) + l2
-
-    def soft(u, t):
-        return jnp.sign(u) * jnp.maximum(jnp.abs(u) - t, 0.0)
-
-    def cond(carry):
-        _, _, _, it, delta = carry
-        return (it < max_iter) & (delta > tol)
-
-    def body(carry):
-        beta, z, t, it, _ = carry
-        grad = g @ z - c + l2 * z
-        beta_new = soft(z - grad / lips, l1 / lips)
-        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
-        z_new = beta_new + ((t - 1.0) / t_new) * (beta_new - beta)
-        delta = jnp.max(jnp.abs(beta_new - beta))
-        return beta_new, z_new, t_new, it + 1, delta
-
-    beta0 = jnp.zeros((d_feat,), x.dtype)
-    beta, _, _, n_iter, _ = jax.lax.while_loop(
-        cond, body, (beta0, beta0, jnp.float32(1.0), 0, jnp.float32(jnp.inf))
+    beta, n_iter = _fista(
+        g, c, reg_param * en_param, reg_param * (1.0 - en_param), tol, max_iter
     )
     coef = beta / scale
     intercept = (
@@ -156,6 +165,76 @@ def _wls_fit(x, y, w, reg_param, fit_intercept: bool, standardize: bool):
     )
     coef = theta[:nfeat]
     intercept = theta[nfeat] if fit_intercept else jnp.zeros((), x.dtype)
+    return coef, intercept
+
+
+@jax.jit
+def _lr_block_stats(x, y, w, shift):
+    """Per-block weighted moment/Gram statistics on SHIFTED features
+    (xs = x − shift; the shift — a host-sample mean — kills the
+    Gram-minus-mean-outer catastrophic cancellation in f32, the same trick
+    as the GMM E-step's recentering).  Reductions over the row-sharded
+    block lower to psums; the out-of-core driver sums the per-block
+    results — identical statistics to one resident pass."""
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    xs = x - shift[None, :]
+    wcol = w[:, None]
+    return (
+        jnp.sum(w),                        # Σw
+        jnp.sum(xs * wcol, axis=0),        # Σw·xs
+        jnp.sum(xs * xs * wcol, axis=0),   # Σw·xs²
+        jnp.sum(y * w),                    # Σw·y
+        (xs * wcol).T @ xs,                # XsᵀWXs
+        (xs * wcol).T @ y,                 # XsᵀWy
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("fit_intercept", "standardize", "elastic", "max_iter"),
+)
+def _lr_solve_from_stats(
+    stats, shift, reg_param, en_param, tol,
+    fit_intercept: bool, standardize: bool, elastic: bool, max_iter: int,
+):
+    """Accumulated block stats → (coef, intercept).
+
+    Solves in centered-standardized coordinates: (g + λ·I)β̃ = c for
+    ridge/OLS (algebraically identical to :func:`_wls_fit`'s augmented
+    system with Spark's unpenalized intercept), or FISTA for elastic net —
+    the same solver the resident path uses.
+    """
+    sw, sx, sxx, sy, gram, mom = stats
+    n = jnp.maximum(sw, 1.0)
+    mean_s = sx / n                       # mean of shifted features
+    var = sxx / n - mean_s * mean_s
+    std = jnp.where(var > 1e-12, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
+    scale = std if standardize else jnp.ones_like(std)
+    ybar = sy / n
+    if fit_intercept:
+        g_c = gram / n - jnp.outer(mean_s, mean_s)
+        c_c = mom / n - mean_s * ybar
+    else:  # caller guarantees shift == 0 here
+        g_c = gram / n
+        c_c = mom / n
+    g = g_c / jnp.outer(scale, scale)
+    c = c_c / scale
+    if elastic:
+        beta, _ = _fista(
+            g, c, reg_param * en_param, reg_param * (1.0 - en_param), tol, max_iter
+        )
+    else:
+        d = g.shape[0]
+        beta = jnp.linalg.solve(
+            g + (reg_param + 1e-8) * jnp.eye(d, dtype=g.dtype), c
+        )
+    coef = beta / scale
+    if fit_intercept:
+        intercept = ybar - (mean_s + shift) @ coef
+    else:
+        intercept = jnp.zeros((), g.dtype)
     return coef, intercept
 
 
@@ -225,6 +304,10 @@ class LinearRegression(Estimator):
     weight_col: str | None = None  # Spark's weightCol
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> LinearRegressionModel:
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(data, HostDataset):
+            return self._fit_outofcore(data, mesh)
         ds: DeviceDataset = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )
@@ -248,3 +331,40 @@ class LinearRegression(Estimator):
             model, ds, self.reg_param, self.elastic_net_param, self.fit_intercept
         )
         return model
+
+    def _fit_outofcore(self, hd, mesh=None) -> LinearRegressionModel:
+        """Rows ≫ HBM: accumulate the WLS/elastic-net sufficient statistics
+        (weighted moments + Gram) over streamed ``max_device_rows`` blocks
+        — one pass regardless of n — then solve on the tiny (d, d) system.
+        The training ``summary`` is unavailable on this path (it would pin
+        the full dataset on device, defeating the point); Spark's
+        disk-backed equivalent is every ``.fit`` at reference
+        ``mllearnforhospitalnetwork.py:146-148``."""
+        from ..parallel.mesh import default_mesh
+        from ..parallel.outofcore import HostDataset
+
+        mesh = mesh or default_mesh()
+        if hd.y is None:
+            raise ValueError("LinearRegression needs labels: HostDataset(y=...)")
+        if hd.n == 0:
+            raise ValueError("LinearRegression fit on an empty dataset")
+        # Recentering shift from a bounded host sample (f32 Gram stability);
+        # must be exactly 0 when there is no intercept to absorb it.
+        sample = hd.sample_rows(65536, seed=0) if self.fit_intercept else None
+        if sample is not None and sample.shape[0] > 0:
+            shift = jnp.asarray(sample.mean(axis=0), jnp.float32)
+        else:  # no intercept, or all weights zero (resident path returns
+            # finite zero coefficients there; shift=0 preserves that)
+            shift = jnp.zeros((hd.n_features,), jnp.float32)
+        tot = None
+        for blk in hd.blocks(mesh):
+            s = _lr_block_stats(blk.x, blk.y, blk.w, shift)
+            tot = s if tot is None else _lr_add_stats(tot, s)
+        elastic = self.elastic_net_param > 0.0 and self.reg_param > 0.0
+        coef, intercept = _lr_solve_from_stats(
+            tot, shift,
+            jnp.float32(self.reg_param), jnp.float32(self.elastic_net_param),
+            jnp.float32(self.tol), self.fit_intercept, self.standardize,
+            elastic, self.max_iter,
+        )
+        return LinearRegressionModel(coefficients=coef, intercept=intercept)

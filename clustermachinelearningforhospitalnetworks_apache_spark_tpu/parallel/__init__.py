@@ -19,6 +19,7 @@ from .sharding import (
 )
 from .collectives import global_sum, tree_aggregate
 from .federation import FederatedDataset, federated_dataset, place_hospitals
+from .outofcore import HostDataset
 from . import distributed
 
 __all__ = [
@@ -42,5 +43,6 @@ __all__ = [
     "unpad",
     "global_sum",
     "tree_aggregate",
+    "HostDataset",
     "distributed",
 ]

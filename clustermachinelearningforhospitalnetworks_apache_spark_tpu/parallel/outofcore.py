@@ -1,0 +1,133 @@
+"""Out-of-core datasets — rows ≫ HBM (SURVEY.md §7 hard part 3).
+
+Spark fits run over disk-backed RDD partitions of any size (every ``.fit``
+call at reference ``mllearnforhospitalnetwork.py:146-158`` streams row
+partitions from HDFS through the executors).  The TPU-native analogue keeps
+the design matrix HOST-resident — a numpy array or ``np.memmap`` — and
+streams fixed-size row blocks through the device per pass: every estimator
+that trains on sufficient statistics (KMeans, LinearRegression,
+GaussianMixture — one-pass-per-iteration algorithms) accumulates the SAME
+psum'd statistics blockwise, so the fit result matches the HBM-resident
+path while device memory stays bounded by ``max_device_rows``.
+
+Transfers are double-buffered: block *i+1*'s ``device_put`` is issued
+before block *i*'s statistics are consumed, so the host→device link and the
+MXU overlap (``jax.device_put`` is asynchronous).
+
+Blocks all share ONE static shape (the last block is zero-padded with
+``w=0`` rows, which every estimator reduction already treats as inert —
+the :class:`~.sharding.DeviceDataset` contract), so the whole fit reuses a
+single compiled executable per step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DATA_AXIS, default_mesh
+from .sharding import DeviceDataset, device_dataset, pad_rows
+
+# Pytree accumulator for per-block sufficient statistics — shared by every
+# out-of-core estimator driver (KMeans / LinearRegression / GMM).
+add_stats = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+
+
+@dataclass
+class HostDataset:
+    """A host-resident (possibly memory-mapped) design matrix streamed to
+    the mesh in ``max_device_rows``-row blocks.
+
+    ``x``: (n, d) features — ``np.ndarray`` or ``np.memmap``;
+    ``y``: optional (n,) labels; ``w``: optional (n,) non-negative sample
+    weights (Spark's ``weightCol``).  ``max_device_rows`` bounds how many
+    rows are ever resident on device at once — the knob that decouples
+    dataset size from HBM.
+    """
+
+    x: np.ndarray
+    y: np.ndarray | None = None
+    w: np.ndarray | None = None
+    max_device_rows: int = 1 << 20
+
+    def __post_init__(self):
+        if self.x.ndim != 2:
+            raise ValueError(f"HostDataset.x must be (n, d); got {self.x.shape}")
+        for name in ("y", "w"):
+            v = getattr(self, name)
+            if v is not None and v.shape[0] != self.x.shape[0]:
+                raise ValueError(
+                    f"HostDataset.{name} has {v.shape[0]} rows but x has "
+                    f"{self.x.shape[0]}"
+                )
+        if self.max_device_rows < 1:
+            raise ValueError("max_device_rows must be >= 1")
+
+    @property
+    def n(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.x.shape[1]
+
+    def count(self) -> float:
+        return float(np.sum(self.w)) if self.w is not None else float(self.n)
+
+    def block_shape(self, mesh=None) -> tuple[int, int]:
+        """(n_blocks, padded rows per block) for this mesh — every block is
+        transferred at exactly this static shape."""
+        mesh = mesh or default_mesh()
+        shards = mesh.shape[DATA_AXIS]
+        b = pad_rows(min(self.max_device_rows, max(self.n, 1)), shards)
+        return -(-self.n // b), b
+
+    def sample_rows(self, size: int, seed: int) -> np.ndarray:
+        """Uniform host-side sample of ≤``size`` valid (w>0) rows — the
+        init-path counterpart of ``sharding.sample_valid_rows`` with no
+        device round trip (the data already lives here)."""
+        if self.w is not None:
+            idx = np.flatnonzero(np.asarray(self.w) > 0)
+        else:
+            idx = np.arange(self.n)
+        if idx.size == 0:
+            return np.empty((0, self.n_features), dtype=np.float64)
+        if idx.size > size:
+            rng = np.random.default_rng(seed)
+            idx = np.sort(rng.choice(idx, size=size, replace=False))
+        return np.asarray(self.x[idx], dtype=np.float64)
+
+    def blocks(self, mesh=None, dtype=np.float32) -> Iterator[DeviceDataset]:
+        """Stream the table as double-buffered fixed-shape device blocks."""
+        mesh = mesh or default_mesh()
+        n_blocks, b = self.block_shape(mesh)
+        if n_blocks == 0:  # empty dataset: no phantom all-pad block
+            return
+
+        def make(i: int) -> DeviceDataset:
+            s = i * b
+            e = min(s + b, self.n)
+            m = e - s
+            xb = np.zeros((b, self.n_features), dtype=dtype)
+            xb[:m] = self.x[s:e]
+            wb = np.zeros((b,), dtype=dtype)
+            if self.w is not None:
+                wb[:m] = self.w[s:e]
+            else:
+                wb[:m] = 1.0
+            yb = None
+            if self.y is not None:
+                yb = np.zeros((b,), dtype=dtype)
+                yb[:m] = self.y[s:e]
+            return device_dataset(xb, yb, mesh=mesh, weights=wb)
+
+        nxt = make(0)
+        for i in range(1, n_blocks):
+            cur, nxt = nxt, make(i)  # issue i's transfer, then yield i-1
+            yield cur
+        yield nxt
