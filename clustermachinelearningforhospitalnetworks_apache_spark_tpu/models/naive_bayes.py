@@ -1,12 +1,19 @@
-"""NaiveBayes — multinomial & gaussian, one sufficient-stats pass.
+"""NaiveBayes — multinomial, bernoulli, complement & gaussian.
 
-Parity with ``pyspark.ml.classification.NaiveBayes`` (model_type
-"multinomial", Spark's default, with Laplace ``smoothing``; plus
-"gaussian", Spark 3.0+).  MLlib aggregates per-class feature sums with one
-``treeAggregate``; here the same statistics are one jit'd one-hot
-contraction over the row-sharded dataset — a (k, d) matmul on the MXU
-whose cross-shard sum lowers to a psum — so the whole fit is a single
-device pass regardless of n.
+Parity with ``pyspark.ml.classification.NaiveBayes``: the full Spark 3.x
+``modelType`` surface — "multinomial" (Spark's default, Laplace
+``smoothing``), "bernoulli" (binary features), "complement" (Rennie's CNB,
+Spark 3.0+; matches sklearn's ``ComplementNB(norm=False)``), and
+"gaussian" (Spark 3.0+).  Class priors use Spark's smoothed convention
+``pi = log(n_c + λ) − log(n + kλ)`` (MLlib applies the Laplace lambda to
+priors too, unlike sklearn).
+
+MLlib aggregates per-class feature sums with one ``treeAggregate``; here
+the same statistics are one jit'd one-hot contraction over the row-sharded
+dataset — a (k, d) matmul on the MXU whose cross-shard sum lowers to a
+psum — so the whole fit is a single device pass regardless of n (all four
+model types consume the same (counts, Σx) statistics except gaussian's
+extra Σx² pass).
 
 Prediction is a dense (n, k) log-likelihood matmul + argmax, the same
 shape as the KMeans assignment step.
@@ -26,16 +33,22 @@ from ..parallel.sharding import DeviceDataset
 from .base import Estimator, Model, as_device_dataset, check_features
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _count_sums(x: jax.Array, y: jax.Array, w: jax.Array, k: int):
-    """Per-class weighted (count, Σx) + a has-negative flag — the
-    multinomial stats, one one-hot contraction (no Σx² pass)."""
+@partial(jax.jit, static_argnames=("k", "binary"))
+def _count_sums(x: jax.Array, y: jax.Array, w: jax.Array, k: int, binary: bool = False):
+    """Per-class weighted (count, Σx) + a validity flag — the shared
+    multinomial/bernoulli/complement stats, one one-hot contraction (no
+    Σx² pass).  ``binary`` flags rows whose features aren't exactly 0/1
+    (the bernoulli contract); otherwise negatives/NaN."""
     onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype) * w[:, None]
     counts = jnp.sum(onehot, axis=0)                 # (k,)
     s1 = onehot.T @ x                                # (k, d)
-    # ~(x >= 0) catches BOTH negatives and NaN in one reduction — a NaN
-    # would otherwise pass a `< 0` check and silently poison theta
-    bad = jnp.any(~(jnp.where(w[:, None] > 0, x, 0.0) >= 0))
+    xm = jnp.where(w[:, None] > 0, x, 0.0)
+    if binary:
+        bad = jnp.any(~((xm == 0.0) | (xm == 1.0)))
+    else:
+        # ~(x >= 0) catches BOTH negatives and NaN in one reduction — a NaN
+        # would otherwise pass a `< 0` check and silently poison theta
+        bad = jnp.any(~(xm >= 0))
     return counts, s1, bad
 
 
@@ -64,10 +77,11 @@ def _gaussian_stats(x: jax.Array, y: jax.Array, w: jax.Array, k: int):
 @register_model("NaiveBayesModel")
 @dataclass
 class NaiveBayesModel(Model):
-    model_type: str                 # "multinomial" | "gaussian"
+    model_type: str                 # multinomial | bernoulli | complement | gaussian
     pi: np.ndarray                  # (k,) log class priors
-    theta: np.ndarray               # (k, d): log P(feat|class) | means
+    theta: np.ndarray               # (k, d): log P(feat|class) | means | CNB weights
     sigma: np.ndarray | None = None  # (k, d) variances (gaussian only)
+    theta2: np.ndarray | None = None  # (k, d) log(1−p) (bernoulli only)
 
     @property
     def num_classes(self) -> int:
@@ -81,6 +95,18 @@ class NaiveBayesModel(Model):
         th = jnp.asarray(self.theta, jnp.float32)
         if self.model_type == "multinomial":
             return x @ th.T + pi[None, :]
+        if self.model_type == "bernoulli":
+            # Σ_f x log p + (1−x) log(1−p) = x·(log p − log(1−p)) + Σ log(1−p).
+            # Inputs are binarized (x≠0 → 1) like sklearn BernoulliNB —
+            # raw counts scored against the fit-time 0/1 contract would be
+            # silent garbage (Spark raises instead; delta documented).
+            xb = (x != 0.0).astype(jnp.float32)
+            th2 = jnp.asarray(self.theta2, jnp.float32)
+            return xb @ (th - th2).T + (pi + jnp.sum(th2, axis=1))[None, :]
+        if self.model_type == "complement":
+            # Rennie's CNB: score by (negated) complement weights; priors
+            # don't enter the multi-class argmax (sklearn ComplementNB)
+            return x @ th.T
         var = jnp.asarray(self.sigma, jnp.float32)
         # Σ_d [ -0.5 log(2πσ²) - (x-μ)²/(2σ²) ], expanded so it's matmuls.
         # Everything is shifted by the across-class mean first: with raw
@@ -108,6 +134,8 @@ class NaiveBayesModel(Model):
         arrays = {"pi": np.asarray(self.pi), "theta": np.asarray(self.theta)}
         if self.sigma is not None:
             arrays["sigma"] = np.asarray(self.sigma)
+        if self.theta2 is not None:
+            arrays["theta2"] = np.asarray(self.theta2)
         return ("NaiveBayesModel", {"model_type": self.model_type}, arrays)
 
     @classmethod
@@ -117,22 +145,25 @@ class NaiveBayesModel(Model):
             pi=arrays["pi"],
             theta=arrays["theta"],
             sigma=arrays.get("sigma"),
+            theta2=arrays.get("theta2"),
         )
 
 
 @dataclass(frozen=True)
 class NaiveBayes(Estimator):
-    model_type: str = "multinomial"   # Spark's default
-    smoothing: float = 1.0            # Laplace (multinomial)
+    model_type: str = "multinomial"   # Spark's default; also bernoulli |
+    # complement | gaussian (the full Spark 3.x modelType surface)
+    smoothing: float = 1.0            # Laplace λ (multinomial/bernoulli/complement)
     var_smoothing: float = 1e-9       # gaussian variance floor, sklearn-style
     label_col: str = "LOS_binary"
     features_col: str = "features"
     weight_col: str | None = None
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> NaiveBayesModel:
-        if self.model_type not in ("multinomial", "gaussian"):
+        if self.model_type not in ("multinomial", "bernoulli", "complement", "gaussian"):
             raise ValueError(
-                f"model_type must be multinomial|gaussian, got {self.model_type!r}"
+                "model_type must be multinomial|bernoulli|complement|"
+                f"gaussian, got {self.model_type!r}"
             )
         ds: DeviceDataset = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
@@ -141,28 +172,53 @@ class NaiveBayes(Estimator):
         y_host = np.asarray(jax.device_get(ds.y))
         w_host = np.asarray(jax.device_get(ds.w))
         k = int(y_host[w_host > 0].max()) + 1 if np.any(w_host > 0) else 1
-        if self.model_type == "multinomial":
-            counts, s1, bad = _count_sums(x, ds.y, ds.w, k)
+        sm = self.smoothing
+
+        def spark_pi(counts: np.ndarray) -> np.ndarray:
+            """MLlib's smoothed priors: log(n_c + λ) − log(n + kλ)."""
+            return np.log(counts + sm) - np.log(counts.sum() + k * sm)
+
+        if self.model_type in ("multinomial", "bernoulli", "complement"):
+            counts, s1, bad = _count_sums(
+                x, ds.y, ds.w, k, binary=self.model_type == "bernoulli"
+            )
             if bool(jax.device_get(bad)):
+                if self.model_type == "bernoulli":
+                    raise ValueError(
+                        "bernoulli NaiveBayes requires 0/1 features; "
+                        "binarize first (features/binarizer.py)"
+                    )
                 raise ValueError(
-                    "multinomial NaiveBayes requires non-negative, non-NaN "
-                    "features (counts); use model_type='gaussian' for "
-                    "real-valued data"
+                    f"{self.model_type} NaiveBayes requires non-negative, "
+                    "non-NaN features (counts); use model_type='gaussian' "
+                    "for real-valued data"
                 )
             counts = np.asarray(counts, dtype=np.float64)
             s1 = np.asarray(s1, dtype=np.float64)
-            pi = np.log(
-                np.maximum(counts, 1e-300) / max(counts.sum(), 1e-300)
-            )
-            sm = self.smoothing
-            theta = np.log(
-                (s1 + sm) / (s1.sum(axis=1, keepdims=True) + sm * s1.shape[1])
-            )
-            return NaiveBayesModel("multinomial", pi, theta)
+            pi = spark_pi(counts)
+            if self.model_type == "multinomial":
+                theta = np.log(
+                    (s1 + sm) / (s1.sum(axis=1, keepdims=True) + sm * s1.shape[1])
+                )
+                return NaiveBayesModel("multinomial", pi, theta)
+            if self.model_type == "bernoulli":
+                # P(f=1 | c) = (doc count with f, in c + λ) / (n_c + 2λ)
+                p = (s1 + sm) / (counts[:, None] + 2.0 * sm)
+                return NaiveBayesModel(
+                    "bernoulli", pi, np.log(p), theta2=np.log1p(-p)
+                )
+            # complement (Rennie's CNB, sklearn ComplementNB norm=False):
+            # per class, feature mass from every OTHER class's rows
+            comp = s1.sum(axis=0, keepdims=True) - s1 + sm          # (k, d)
+            theta = -(np.log(comp) - np.log(comp.sum(axis=1, keepdims=True)))
+            return NaiveBayesModel("complement", pi, theta)
         counts, s1c, s2c, gmean = (
             np.asarray(a, dtype=np.float64)
             for a in _gaussian_stats(x, ds.y, ds.w, k)
         )
+        # gaussian priors are UNSMOOTHED — Spark's trainGaussianImpl uses
+        # log(weightSum) − log(n) (λ applies only to the discrete models),
+        # which is also sklearn GaussianNB's convention
         pi = np.log(np.maximum(counts, 1e-300) / max(counts.sum(), 1e-300))
         nk = np.maximum(counts[:, None], 1e-12)
         mean_c = s1c / nk

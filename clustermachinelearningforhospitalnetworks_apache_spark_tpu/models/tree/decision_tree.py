@@ -32,6 +32,10 @@ class _TreeEnsembleModel(Model):
     max_depth: int
     task: str = "regression"
     num_classes: int = 2
+    # categorical (unordered-set) splits: per-node left-set bitmask +
+    # per-feature flags; both None for all-continuous ensembles
+    split_catmask: np.ndarray | None = None
+    cat_arities: np.ndarray | None = None
 
     @property
     def num_trees(self) -> int:
@@ -47,11 +51,17 @@ class _TreeEnsembleModel(Model):
         # a narrower matrix would silently traverse with clipped feature
         # indices instead of erroring
         check_features(x, self.feature_importances.shape[-1], type(self).__name__)
+        cat_mask = cat_flags = None
+        if self.split_catmask is not None:
+            cat_mask = jnp.asarray(self.split_catmask, jnp.uint32)
+            cat_flags = jnp.asarray(np.asarray(self.cat_arities) > 0)
         return predict_forest(
             x.astype(jnp.float32),
             jnp.asarray(self.split_feat),
             jnp.asarray(self.threshold),
             jnp.asarray(self.value),
+            cat_mask,
+            cat_flags,
         )  # (T, n, V)
 
     def predict(self, x: jax.Array) -> jax.Array:
@@ -74,12 +84,16 @@ class _TreeEnsembleModel(Model):
         }
 
     def _arrays(self) -> dict:
-        return {
+        arrays = {
             "split_feat": self.split_feat,
             "threshold": self.threshold,
             "value": self.value,
             "feature_importances": self.feature_importances,
         }
+        if self.split_catmask is not None:
+            arrays["split_catmask"] = self.split_catmask
+            arrays["cat_arities"] = np.asarray(self.cat_arities)
+        return arrays
 
     @classmethod
     def from_artifacts(cls, params, arrays):
@@ -91,6 +105,8 @@ class _TreeEnsembleModel(Model):
             max_depth=int(params["max_depth"]),
             task=params["task"],
             num_classes=int(params.get("num_classes", 2)),
+            split_catmask=arrays.get("split_catmask"),
+            cat_arities=arrays.get("cat_arities"),
         )
 
 
@@ -105,6 +121,8 @@ def _from_grown(cls, grown: GrownForest, task: str, num_classes: int, **extra):
         max_depth=grown.max_depth,
         task=task,
         num_classes=num_classes,
+        split_catmask=grown.split_catmask,
+        cat_arities=grown.cat_arities,
         **extra,
     )
 
@@ -126,6 +144,10 @@ class _TreeParams:
     label_col: str = "length_of_stay"
     features_col: str = "features"
     weight_col: str | None = None  # Spark's weightCol
+    # MLlib's categoricalFeaturesInfo: feature index → arity.  Marked
+    # columns hold StringIndexer-style category ids and are split as
+    # unordered sets (engine.py); arity ≤ min(32, max_bins).
+    categorical_features: dict[int, int] | None = None
 
 
 @dataclass(frozen=True)
@@ -142,6 +164,7 @@ class DecisionTreeRegressor(Estimator, _TreeParams):
             min_info_gain=self.min_info_gain,
             seed=self.seed,
             mesh=mesh,
+            categorical_features=self.categorical_features,
         )
         return _from_grown(DecisionTreeModel, grown, "regression", 2)
 
@@ -164,5 +187,6 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
             min_info_gain=self.min_info_gain,
             seed=self.seed,
             mesh=mesh,
+            categorical_features=self.categorical_features,
         )
         return _from_grown(DecisionTreeModel, grown, "classification", self.num_classes)

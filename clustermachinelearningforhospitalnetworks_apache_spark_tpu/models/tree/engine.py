@@ -168,27 +168,66 @@ def _hist_interpret() -> bool:
 @lru_cache(maxsize=64)
 def _make_level_step(
     mesh: Mesh, level_nodes: int, d: int, B: int, S: int, T: int, task: str,
-    use_pallas: bool = False,
+    use_pallas: bool = False, cat_arities: tuple[int, ...] | None = None,
 ):
     """jit'd level step: sharded histogram + on-device split selection.
 
-    → (agg (T,LN,S), best_gain, best_feat, best_bin, do_split — all (T,LN)).
-    Every split decision (gain argmax, min-instances, min-gain, node-mass
-    gates) is made on device so levels chain with **zero host round trips**;
-    the host fetches all levels' tiny winner tensors once, after the whole
-    forest's device timeline has been dispatched (the per-level blocking
-    device_get measured ~70 ms each on tunneled chips).
+    → (agg (T,LN,S), best_gain, best_feat, best_bin, do_split, catmask —
+    all (T,LN)).  Every split decision (gain argmax, min-instances,
+    min-gain, node-mass gates) is made on device so levels chain with
+    **zero host round trips**; the host fetches all levels' tiny winner
+    tensors once, after the whole forest's device timeline has been
+    dispatched (the per-level blocking device_get measured ~70 ms each on
+    tunneled chips).
 
     ``feat_mask`` (T, LN, d) zero-masks features outside the per-node
     random subset (Spark's featureSubsetStrategy); ``min_inst`` /
     ``min_gain`` are dynamic scalars (no recompile when they change).
+
+    ``cat_arities`` (static, len d; 0 = continuous) marks categorical
+    features, whose bins ARE category ids.  MLlib splits indexed
+    categoricals as **unordered sets**; the classical trick makes that a
+    prefix scan: per node, sort a categorical feature's bins by their
+    label mean (regression) / mean class index (binary: P(class 1)), then
+    the best subset split is some prefix of that order — exact for
+    regression and binary classification (Breiman), the standard heuristic
+    for multiclass.  Continuous features keep the natural bin order, so
+    one shared cumsum serves both; the winning prefix is emitted as a
+    uint32 category bitmask (left child ⇔ bit set; arity ≤ 32, Spark's
+    VectorIndexer maxCategories default).
     """
     hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T, use_pallas)
     neg_inf = jnp.float32(-jnp.inf)
+    any_cat = cat_arities is not None and any(a > 0 for a in cat_arities)
+    if any_cat:
+        is_cat_np = np.asarray([a > 0 for a in cat_arities], dtype=bool)
 
     def step(binned_t, base_t, w_tree, pos, feat_mask, min_inst, min_gain):
         hist = hist_fn(binned_t, base_t, w_tree, pos)  # (T, LN, d, B, S)
         agg = hist[:, :, 0, :, :].sum(axis=2)          # (T, LN, S)
+
+        if any_cat:
+            # per-(node, feature) bin ordering: label mean for regression
+            # (stats [w, Σy, Σy²]), mean class index for classification
+            # (== P(class 1) when binary); empty bins sort last (+inf) so
+            # unpopulated categories always land in the RIGHT child —
+            # matching prediction's unseen-category rule.
+            w_bin = hist[..., 0] if task == "regression" else hist.sum(-1)
+            if task == "regression":
+                s_bin = hist[..., 1]
+            else:
+                cls = jnp.arange(S, dtype=jnp.float32)
+                s_bin = (hist * cls[None, None, None, None, :]).sum(-1)
+            key = jnp.where(
+                w_bin > 0, s_bin / jnp.maximum(w_bin, 1e-12), jnp.inf
+            )
+            natural = jnp.arange(B, dtype=jnp.float32)
+            is_cat_f = jnp.asarray(is_cat_np)
+            key = jnp.where(
+                is_cat_f[None, None, :, None], key, natural[None, None, None, :]
+            )
+            order = jnp.argsort(key, axis=3, stable=True)      # (T, LN, d, B)
+            hist = jnp.take_along_axis(hist, order[..., None], axis=3)
 
         cum = jnp.cumsum(hist, axis=3)
         total = cum[:, :, :, -1:, :]
@@ -230,13 +269,26 @@ def _make_level_step(
             & (best_gain > min_gain)
             & (node_w >= 2.0 * min_inst)
         )
-        return (
-            agg,
-            best_gain,
-            (best // B).astype(jnp.int32),
-            (best % B).astype(jnp.int32),
-            do_split,
-        )
+        best_feat = (best // B).astype(jnp.int32)
+        best_bin = (best % B).astype(jnp.int32)
+        if any_cat:
+            # winning feature's sorted-bin order → uint32 bitmask of the
+            # left-child category prefix (positions ≤ best_bin).  Valid
+            # categorical winners only ever have nonempty (bin < arity ≤
+            # 32) categories in the prefix, so every consumed shift < 32.
+            ord_win = jnp.take_along_axis(
+                order, best_feat[..., None, None], axis=2
+            )[:, :, 0, :].astype(jnp.uint32)                  # (T, LN, B)
+            take = jnp.arange(B)[None, None, :] <= best_bin[..., None]
+            bits = jnp.where(
+                take,
+                jnp.left_shift(jnp.uint32(1), jnp.minimum(ord_win, jnp.uint32(31))),
+                jnp.uint32(0),
+            )
+            catmask = jnp.sum(bits, axis=-1, dtype=jnp.uint32)  # distinct bits
+        else:
+            catmask = jnp.zeros(best_bin.shape, jnp.uint32)
+        return agg, best_gain, best_feat, best_bin, do_split, catmask
 
     return jax.jit(step)
 
@@ -247,14 +299,19 @@ _ADVANCE_UNROLL_MAX = 64
 
 
 @jax.jit
-def _advance_level(binned_t, node_id, pos, feat, bin_, do_split, level_base):
+def _advance_level(
+    binned_t, node_id, pos, feat, bin_, do_split, level_base,
+    catmask=None, cat_flags=None,
+):
     """Move rows on the current frontier to their child heap slots.
 
     binned_t: (d, n) int32 (row axis last — see _make_level_hist)
     node_id:  (T, n) current heap ids (-1 = parked on a leaf)
     pos:      (T, n) frontier position (-1 = not on this level)
     feat/bin_/do_split: (T, LN) this level's device-selected splits
-    go right ⇔ bin > split_bin[node].
+    go right ⇔ bin > split_bin[node] (continuous) or the row's category
+    bit is NOT in ``catmask`` (categorical winners; ``cat_flags`` (d,)
+    bool marks categorical features — both None on all-continuous fits).
 
     Lookups are unrolled select chains, not gathers — a (d, n) gather with
     per-element indices measured ~1.2 s/level at BASELINE scale on TPU,
@@ -268,17 +325,24 @@ def _advance_level(binned_t, node_id, pos, feat, bin_, do_split, level_base):
 
     f = jnp.full_like(node_id, -1)
     b = jnp.zeros_like(node_id)
+    cm = jnp.zeros(node_id.shape, jnp.uint32)
     if LN <= _ADVANCE_UNROLL_MAX:
         for p in range(LN):
             sel = pos == p
             f = jnp.where(sel, feat_eff[:, p][:, None], f)
             b = jnp.where(sel, bin_[:, p][:, None], b)
+            if catmask is not None:
+                cm = jnp.where(sel, catmask[:, p][:, None], cm)
     else:
         safe = jnp.where(pos >= 0, pos, 0)
         f = jnp.where(
             pos >= 0, jnp.take_along_axis(feat_eff, safe, axis=1), f
         )
         b = jnp.where(pos >= 0, jnp.take_along_axis(bin_, safe, axis=1), b)
+        if catmask is not None:
+            cm = jnp.where(
+                pos >= 0, jnp.take_along_axis(catmask, safe, axis=1), cm
+            )
 
     is_split = f >= 0
     if d <= _ADVANCE_UNROLL_MAX:
@@ -290,9 +354,43 @@ def _advance_level(binned_t, node_id, pos, feat, bin_, do_split, level_base):
         n = binned_t.shape[1]
         fb = binned_t[jnp.maximum(f, 0), jnp.arange(n)[None, :]]
     right = (fb > b).astype(jnp.int32)
+    if cat_flags is not None:
+        # (d,)-table lookup, same unroll-vs-gather split as fb above
+        if d <= _ADVANCE_UNROLL_MAX:
+            icat = jnp.zeros(f.shape, bool)
+            for fi in range(d):
+                icat = jnp.where(f == fi, cat_flags[fi], icat)
+        else:
+            icat = cat_flags[jnp.maximum(f, 0)]  # f==-1 rows die via is_split
+        in_left = (
+            jnp.right_shift(cm, jnp.minimum(fb, 31).astype(jnp.uint32))
+            & jnp.uint32(1)
+        ) > 0
+        right = jnp.where(icat, (~in_left).astype(jnp.int32), right)
     child = 2 * (level_base + pos) + 1 + right
     active = pos >= 0
     return jnp.where(active & is_split, child, jnp.where(active, -1, node_id))
+
+
+@lru_cache(maxsize=32)
+def _make_subset_mask(T: int, level_nodes: int, d: int, k: int):
+    """jit'd per-(tree, node) feature-subset draw (Spark's
+    featureSubsetStrategy): exactly ``k`` of ``d`` features per node,
+    uniform without replacement, as ONE device computation per level.
+
+    The host version this replaces ran T × level_nodes ``rng.choice``
+    calls between device dispatches — ~20k host RNG calls per level at
+    depth 10, T=20.  Rank-of-uniform gives the same distribution: mask
+    feature f iff rank(u[t, p, f]) < k.
+    """
+
+    def draw(seed, depth):
+        key = jax.random.fold_in(jax.random.key(seed), depth)
+        u = jax.random.uniform(key, (T, level_nodes, d))
+        ranks = jnp.argsort(jnp.argsort(u, axis=-1), axis=-1)
+        return (ranks < k).astype(jnp.float32)
+
+    return jax.jit(draw)
 
 
 @lru_cache(maxsize=16)
@@ -314,6 +412,27 @@ def _make_bootstrap(mesh: Mesh, T: int, n_pad: int, rate: float):
     )
 
 
+def bin_feature_matrix(
+    x: jax.Array, thr: np.ndarray, cat: dict[int, int] | None = None
+) -> jax.Array:
+    """(n, d) features → (d, n) int32 bin matrix (row axis last).
+
+    Continuous columns digitize against the quantile ``thr``; categorical
+    columns' bins ARE their category ids (StringIndexer output), clipped
+    to [0, arity-1].  Shared by ``grow_forest`` and GBT's bin-once path."""
+    binned = digitize(x.astype(jnp.float32), jnp.asarray(thr, jnp.float32))
+    if cat:
+        cat_idx = jnp.asarray(sorted(cat), jnp.int32)
+        hi = jnp.asarray([cat[f] - 1 for f in sorted(cat)], jnp.int32)
+        xi = jnp.clip(
+            jnp.round(x[:, np.asarray(sorted(cat))]).astype(jnp.int32),
+            0,
+            hi[None, :],
+        )
+        binned = binned.at[:, cat_idx].set(xi)
+    return binned.T
+
+
 # ------------------------------------------------------------------- output
 @dataclass
 class GrownForest:
@@ -326,6 +445,8 @@ class GrownForest:
     importances: np.ndarray     # (T, d)
     max_depth: int
     bin_thresholds: np.ndarray  # (d, B-1)
+    split_catmask: np.ndarray | None = None  # (T, total) uint32 — left-set
+    cat_arities: np.ndarray | None = None    # (d,) int32, 0 = continuous
 
 
 def grow_forest(
@@ -347,6 +468,7 @@ def grow_forest(
     use_pallas: bool = False,
     bin_thresholds: np.ndarray | None = None,
     binned_t: jax.Array | None = None,
+    categorical_features: dict[int, int] | None = None,
 ) -> GrownForest:
     """Train ``num_trees`` trees level-by-level on the sharded dataset.
 
@@ -357,7 +479,13 @@ def grow_forest(
     sampling/quantile pass; ``binned_t`` ((d, n_pad) int32, requires
     ``bin_thresholds``) additionally skips the device digitize — callers
     that train many ensembles on the same feature matrix (GBT boosting
-    rounds) bin once and reuse both."""
+    rounds) bin once and reuse both.
+
+    ``categorical_features`` maps feature index → arity (MLlib's
+    ``categoricalFeaturesInfo``, the StringIndexer-output contract the
+    reference imports at ``mllearnforhospitalnetwork.py:29``): those
+    columns hold category ids 0..arity-1 and are split as **unordered
+    sets** (see ``_make_level_step``); arity ≤ min(32, max_bins)."""
     from ...parallel.sharding import sample_valid_rows
 
     mesh = mesh or default_mesh()
@@ -365,7 +493,17 @@ def grow_forest(
     d = ds.n_features
     T = num_trees
     B = max_bins
-    rng = np.random.default_rng(seed)
+
+    cat = dict(categorical_features or {})
+    for f, arity in cat.items():
+        if not 0 <= f < d:
+            raise ValueError(f"categorical feature index {f} out of range [0, {d})")
+        if not 2 <= arity <= min(32, B):
+            raise ValueError(
+                f"categorical feature {f} arity {arity} must be in "
+                f"[2, min(32, max_bins={B})]"
+            )
+    cat_arities = tuple(cat.get(f, 0) for f in range(d)) if cat else None
 
     # 1. binning (host-sample thresholds, device digitize) — or reuse the
     # caller's precomputed thresholds
@@ -386,7 +524,7 @@ def grow_forest(
     # row axis LAST on every big device array (lane dim) — trailing d/S
     # axes would tile-pad to 128 lanes in HBM (see _make_level_hist)
     if binned_t is None:
-        binned_t = digitize(ds.x.astype(jnp.float32), jnp.asarray(thr, jnp.float32)).T
+        binned_t = bin_feature_matrix(ds.x, thr, cat)
     elif bin_thresholds is None:
         raise ValueError("binned_t requires the matching bin_thresholds")
     elif binned_t.shape != (d, n_pad):
@@ -415,8 +553,13 @@ def grow_forest(
     total_nodes = 2 ** (max_depth + 1) - 1
     split_feat = np.full((T, total_nodes), -1, dtype=np.int32)
     split_bin = np.zeros((T, total_nodes), dtype=np.int32)
+    split_catmask = np.zeros((T, total_nodes), dtype=np.uint32)
     node_stats = np.zeros((T, total_nodes, S), dtype=np.float64)
     importances = np.zeros((T, d), dtype=np.float64)
+    cat_flags_dev = (
+        jnp.asarray([a > 0 for a in cat_arities], bool) if cat else None
+    )
+    is_cat_host = np.asarray([f in cat for f in range(d)], dtype=bool)
 
     node_id = jnp.zeros((T, n_pad), jnp.int32)  # all rows start at the root
 
@@ -435,35 +578,37 @@ def grow_forest(
         pos = jnp.where(node_id >= 0, node_id - level_base, -1)
         pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
 
-        # per-(tree, node) feature subset (host-drawn mask, Spark's
-        # featureSubsetStrategy, applied at split-selection time on device)
+        # per-(tree, node) feature subset (device-drawn, Spark's
+        # featureSubsetStrategy, applied at split-selection time)
         if feature_subset_size is not None and feature_subset_size < d:
-            mask_np = np.zeros((T, level_nodes, d), dtype=np.float32)
-            for t in range(T):
-                for p in range(level_nodes):
-                    mask_np[t, p, rng.choice(d, feature_subset_size, replace=False)] = 1.0
-            mask = jnp.asarray(mask_np)
+            mask = _make_subset_mask(T, level_nodes, d, feature_subset_size)(
+                seed, depth
+            )
         else:
             mask = jnp.ones((T, level_nodes, d), jnp.float32)
 
-        step_fn = _make_level_step(mesh, level_nodes, d, B, S, T, task, use_pallas)
-        agg_d, gain_d, feat_d, bin_d, split_d = step_fn(
+        step_fn = _make_level_step(
+            mesh, level_nodes, d, B, S, T, task, use_pallas, cat_arities
+        )
+        agg_d, gain_d, feat_d, bin_d, split_d, catmask_d = step_fn(
             binned_t, base_t, w_tree, pos, mask, min_inst, min_gain
         )
-        level_out.append((agg_d, gain_d, feat_d, bin_d, split_d))
+        level_out.append((agg_d, gain_d, feat_d, bin_d, split_d, catmask_d))
         if depth < max_depth:
             node_id = _advance_level(
-                binned_t, node_id, pos, feat_d, bin_d, split_d, level_base
+                binned_t, node_id, pos, feat_d, bin_d, split_d, level_base,
+                catmask_d if cat else None, cat_flags_dev,
             )
 
     # one host fetch for every level's winners
     for depth, fetched in enumerate(jax.device_get(level_out)):
-        agg, best_gain, best_feat, best_bin, do_split = (
+        agg, best_gain, best_feat, best_bin, do_split, catmask = (
             np.asarray(fetched[0], np.float64),
             np.asarray(fetched[1], np.float64),
             np.asarray(fetched[2], np.int32),
             np.asarray(fetched[3], np.int32),
             np.asarray(fetched[4], bool),
+            np.asarray(fetched[5], np.uint32),
         )
         level_nodes = 1 << depth
         level_base = level_nodes - 1
@@ -473,6 +618,9 @@ def grow_forest(
         sl = slice(level_base, level_base + level_nodes)
         split_feat[:, sl] = np.where(do_split, best_feat, -1)
         split_bin[:, sl] = np.where(do_split, best_bin, 0)
+        split_catmask[:, sl] = np.where(
+            do_split & is_cat_host[best_feat], catmask, np.uint32(0)
+        )
         for t in range(T):
             np.add.at(
                 importances[t],
@@ -480,9 +628,10 @@ def grow_forest(
                 best_gain[t][do_split[t]],
             )
 
-    # 4. leaf/threshold materialization
+    # 4. leaf/threshold materialization (categorical nodes carry their
+    # left-set bitmask instead of a real-valued threshold)
     threshold = np.zeros((T, total_nodes), dtype=np.float32)
-    valid_split = split_feat >= 0
+    valid_split = (split_feat >= 0) & ~is_cat_host[np.maximum(split_feat, 0)]
     f_idx = np.maximum(split_feat, 0)
     b_idx = np.minimum(split_bin, B - 2)
     threshold[valid_split] = thr[f_idx, b_idx][valid_split].astype(np.float32)
@@ -519,19 +668,27 @@ def grow_forest(
         importances=importances,
         max_depth=max_depth,
         bin_thresholds=thr,
+        split_catmask=split_catmask if cat else None,
+        cat_arities=(
+            np.asarray(cat_arities, dtype=np.int32) if cat else None
+        ),
     )
 
 
 # ------------------------------------------------------------------ predict
 @jax.jit
-def predict_forest(x, split_feat, threshold, value):
+def predict_forest(x, split_feat, threshold, value, cat_mask=None, cat_flags=None):
     """Vectorized ensemble traversal.
 
     x: (n, d); split_feat/threshold: (T, total); value: (T, total, V)
     → (T, n, V) per-tree predictions (caller aggregates).
-    """
 
-    def per_tree(sf, th, val):
+    ``cat_mask`` (T, total) uint32 + ``cat_flags`` (d,) bool route
+    categorical split nodes: go LEFT iff the row's category bit is in the
+    node's left-set mask (unseen/out-of-range categories go right, Spark's
+    rule).  Both None on all-continuous ensembles (the common path)."""
+
+    def per_tree(sf, th, val, cm):
         n = x.shape[0]
         node = jnp.zeros((n,), jnp.int32)
         depth = int(np.log2(sf.shape[0] + 1)) - 1
@@ -541,10 +698,23 @@ def predict_forest(x, split_feat, threshold, value):
             is_split = f >= 0
             xv = jnp.take_along_axis(x, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
             right = (xv > th[node]).astype(jnp.int32)
+            if cat_flags is not None:
+                icat = cat_flags[jnp.maximum(f, 0)]
+                xi = jnp.clip(xv, 0, 31).astype(jnp.uint32)
+                in_left = (
+                    jnp.right_shift(cm[node], xi) & jnp.uint32(1)
+                ) > 0
+                # out-of-range category values (< 0 or ≥ 32) always go right
+                in_left = in_left & (xv >= 0) & (xv < 32)
+                right = jnp.where(icat, (~in_left).astype(jnp.int32), right)
             child = 2 * node + 1 + right
             return jnp.where(is_split, child, node)
 
         node = lax.fori_loop(0, depth, body, node)
         return val[node]
 
-    return jax.vmap(per_tree)(split_feat, threshold, value)
+    if cat_flags is None:
+        return jax.vmap(lambda sf, th, val: per_tree(sf, th, val, None))(
+            split_feat, threshold, value
+        )
+    return jax.vmap(per_tree)(split_feat, threshold, value, cat_mask)

@@ -35,13 +35,7 @@ import numpy as np
 from ...io.model_io import register_model
 from ...parallel.mesh import default_mesh
 from ..base import Estimator, Model, as_device_dataset, check_features
-from .engine import grow_forest, predict_forest
-
-
-@jax.jit
-def _tree_pred(x, sf, th, val):
-    """(n,) single-tree regression outputs from a (1, total) grown tree."""
-    return predict_forest(x, sf, th, val)[0, :, 0]
+from .engine import bin_feature_matrix, grow_forest, predict_forest
 
 
 @register_model("GBTModel")
@@ -57,6 +51,9 @@ class GBTModel(Model):
     learning_rate: float
     feature_importances: np.ndarray
     max_depth: int
+    # categorical (unordered-set) splits — None for all-continuous fits
+    split_catmask: np.ndarray | None = None
+    cat_arities: np.ndarray | None = None
 
     @property
     def num_trees(self) -> int:
@@ -64,11 +61,17 @@ class GBTModel(Model):
 
     def _raw(self, x: jax.Array) -> jax.Array:
         check_features(x, self.feature_importances.shape[-1], "GBTModel")
+        cat_mask = cat_flags = None
+        if self.split_catmask is not None:
+            cat_mask = jnp.asarray(self.split_catmask, jnp.uint32)
+            cat_flags = jnp.asarray(np.asarray(self.cat_arities) > 0)
         out = predict_forest(
             x.astype(jnp.float32),
             jnp.asarray(self.split_feat),
             jnp.asarray(self.threshold),
             jnp.asarray(self.value),
+            cat_mask,
+            cat_flags,
         )[:, :, 0]                                  # (T, n)
         return self.init + self.learning_rate * jnp.sum(out, axis=0)
 
@@ -95,12 +98,22 @@ class GBTModel(Model):
                 "learning_rate": float(self.learning_rate),
                 "max_depth": int(self.max_depth),
             },
-            {
-                "split_feat": self.split_feat,
-                "threshold": self.threshold,
-                "value": self.value,
-                "feature_importances": self.feature_importances,
-            },
+            dict(
+                {
+                    "split_feat": self.split_feat,
+                    "threshold": self.threshold,
+                    "value": self.value,
+                    "feature_importances": self.feature_importances,
+                },
+                **(
+                    {
+                        "split_catmask": self.split_catmask,
+                        "cat_arities": np.asarray(self.cat_arities),
+                    }
+                    if self.split_catmask is not None
+                    else {}
+                ),
+            ),
         )
 
     @classmethod
@@ -114,6 +127,8 @@ class GBTModel(Model):
             learning_rate=float(params["learning_rate"]),
             feature_importances=arrays["feature_importances"],
             max_depth=int(params["max_depth"]),
+            split_catmask=arrays.get("split_catmask"),
+            cat_arities=arrays.get("cat_arities"),
         )
 
 
@@ -131,23 +146,65 @@ class _GBTParams:
     features_col: str = "features"
     weight_col: str | None = None
     init_sample_size: int = 65536     # binning sample (engine default)
+    # MLlib's categoricalFeaturesInfo (see _TreeParams) — unordered-set
+    # splits on StringIndexer-style columns, shared bin matrix across rounds
+    categorical_features: dict[int, int] | None = None
+    # Spark's validationIndicatorCol/validationTol: rows where the named
+    # boolean column is true are held out of training; boosting stops when
+    # their loss stops improving (runWithValidation semantics)
+    validation_indicator_col: str | None = None
+    validation_tol: float = 0.01      # Spark default
 
-    def _boost(self, ds, mesh, loss: str):
+    def _resolve_validation(self, data, ds, mesh):
+        """validation_indicator_col → (n_pad,) float device mask (or None),
+        sharded on the SAME mesh as the dataset (not the process default)."""
+        if self.validation_indicator_col is None:
+            return None
+        from ...features.assembler import AssembledTable
+        from ...parallel.sharding import shard_rows
+
+        if not isinstance(data, AssembledTable):
+            raise ValueError(
+                f"validation_indicator_col={self.validation_indicator_col!r} "
+                "needs a table input to resolve the column; got "
+                f"{type(data).__name__} — pass an AssembledTable"
+            )
+        ind = np.asarray(
+            data.table.column(self.validation_indicator_col)
+        ).astype(bool)
+        pad = np.zeros((ds.n_padded,), np.float32)
+        pad[: ind.shape[0]] = ind
+        return shard_rows(pad, mesh)
+
+    def _boost(self, ds, mesh, loss: str, val_ind=None):
         from ...parallel.sharding import DeviceDataset, sample_valid_rows
-        from .binning import digitize, quantile_thresholds
+        from .binning import quantile_thresholds
 
         x = ds.x.astype(jnp.float32)
         y = ds.y.astype(jnp.float32)
-        w = ds.w.astype(jnp.float32)
+        w_all = ds.w.astype(jnp.float32)
+        if val_ind is not None:
+            # held-out rows train nothing (weight 0) but score every round
+            w = w_all * (1.0 - val_ind)
+            w_val = w_all * val_ind
+            if float(jax.device_get(jnp.sum(w_val))) == 0.0:
+                raise ValueError(
+                    "validation_indicator_col selected no validation rows"
+                )
+        else:
+            w = w_all
+            w_val = None
         n = jnp.maximum(jnp.sum(w), 1.0)
 
         # binning depends only on x — thresholds AND the digitized matrix
-        # are computed once and reused by every boosting round
+        # are computed once and reused by every boosting round.  The
+        # sampling/binning dataset carries the TRAINING weights only.
+        ds = DeviceDataset(x=x, y=y, w=w)
         sample = sample_valid_rows(ds, self.init_sample_size, self.seed)
         if sample.shape[0] == 0:
             raise ValueError("GBT fit on an empty dataset")
         thr = quantile_thresholds(sample, self.max_bins)
-        binned_t = digitize(x, jnp.asarray(thr, jnp.float32)).T
+        binned_t = bin_feature_matrix(x, thr, self.categorical_features)
 
         ybar = float(jax.device_get(jnp.sum(y * w) / n))
         if loss == "squared":
@@ -165,10 +222,30 @@ class _GBTParams:
             # factor matters for stepSize parity with Spark.
             return 4.0 * (y - jax.nn.sigmoid(2.0 * f))
 
-        @jax.jit
-        def advance(f, sf, th, val):
-            return f + jnp.float32(self.step_size) * _tree_pred(x, sf, th, val)
+        cat = self.categorical_features
+        cat_flags = (
+            jnp.asarray([f in cat for f in range(x.shape[1])]) if cat else None
+        )
 
+        @jax.jit
+        def advance(f, sf, th, val, cm):
+            # categorical rounds must route by the set mask here too — the
+            # residuals each later round fits depend on this prediction
+            pred = predict_forest(x, sf, th, val, cm, cat_flags)[0, :, 0]
+            return f + jnp.float32(self.step_size) * pred
+
+        @jax.jit
+        def val_err(f):
+            # mean held-out loss: squared error | Spark LogLoss 2·log(1+e^(−2y±F))
+            if loss == "squared":
+                e = (y - f) ** 2
+            else:
+                ypm = 2.0 * y - 1.0
+                e = 2.0 * jnp.log1p(jnp.exp(-2.0 * ypm * f))
+            return jnp.sum(e * w_val) / jnp.maximum(jnp.sum(w_val), 1.0)
+
+        best_err = np.inf
+        best_m = 0
         f_cur = jnp.full(y.shape, jnp.float32(f0))
         trees, importances = [], []
         for t in range(self.max_iter):
@@ -187,6 +264,7 @@ class _GBTParams:
                 mesh=mesh,
                 bin_thresholds=thr,
                 binned_t=binned_t,
+                categorical_features=self.categorical_features,
             )
             trees.append(grown)
             importances.append(grown.importances[0])
@@ -195,7 +273,25 @@ class _GBTParams:
                 jnp.asarray(grown.split_feat),
                 jnp.asarray(grown.threshold),
                 jnp.asarray(grown.value),
+                (
+                    jnp.asarray(grown.split_catmask, jnp.uint32)
+                    if cat
+                    else jnp.zeros(grown.split_feat.shape, jnp.uint32)
+                ),
             )
+            if val_ind is not None:
+                # Spark runWithValidation: stop when the best-so-far
+                # held-out error stops improving by validationTol
+                # (relative to max(err, 0.01)); keep the best-M prefix.
+                err = float(jax.device_get(val_err(f_cur)))
+                if best_err - err < self.validation_tol * max(err, 0.01):
+                    break
+                if err < best_err:
+                    best_err = err
+                    best_m = t + 1
+        if val_ind is not None and best_m > 0:
+            trees = trees[:best_m]
+            importances = importances[:best_m]
 
         imp = np.sum(importances, axis=0)
         s = imp.sum()
@@ -208,6 +304,10 @@ class _GBTParams:
             learning_rate=self.step_size,
             feature_importances=imp / s if s > 0 else imp,
             max_depth=self.max_depth,
+            split_catmask=(
+                np.concatenate([g.split_catmask for g in trees]) if cat else None
+            ),
+            cat_arities=trees[0].cat_arities if cat else None,
         )
 
 
@@ -218,7 +318,9 @@ class GBTRegressor(Estimator, _GBTParams):
         ds = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )
-        return self._boost(ds, mesh, loss="squared")
+        return self._boost(
+            ds, mesh, loss="squared", val_ind=self._resolve_validation(data, ds, mesh)
+        )
 
 
 @dataclass(frozen=True)
@@ -237,4 +339,6 @@ class GBTClassifier(Estimator, _GBTParams):
             raise ValueError(
                 f"GBTClassifier is binary (labels 0/1); got labels {uniq[:5]}"
             )
-        return self._boost(ds, mesh, loss="logistic")
+        return self._boost(
+            ds, mesh, loss="logistic", val_ind=self._resolve_validation(data, ds, mesh)
+        )

@@ -68,6 +68,7 @@ class RandomForestRegressor(Estimator, _TreeParams):
             subsampling_rate=self.subsampling_rate,
             seed=self.seed,
             mesh=mesh,
+            categorical_features=self.categorical_features,
         )
         return _from_grown(RandomForestModel, grown, "regression", 2)
 
@@ -98,5 +99,6 @@ class RandomForestClassifier(Estimator, _TreeParams):
             subsampling_rate=self.subsampling_rate,
             seed=self.seed,
             mesh=mesh,
+            categorical_features=self.categorical_features,
         )
         return _from_grown(RandomForestModel, grown, "classification", self.num_classes)

@@ -1,0 +1,180 @@
+"""Categorical (unordered-set) tree splits — MLlib categoricalFeaturesInfo.
+
+The reference imports StringIndexer (``mllearnforhospitalnetwork.py:29``,
+SURVEY.md D5 reads it as intended categorical handling); MLlib trees split
+indexed categoricals as unordered sets.  Engine contract under test
+(``_make_level_step``): per node, a categorical feature's bins are sorted
+by label mean and the best prefix of that order is the best category
+SUBSET — exact for regression and binary classification (Breiman), so a
+depth-1 split must match exhaustive subset enumeration.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+    device_dataset,
+)
+
+
+def _cat_regression_data(rng, n=2000, arity=8):
+    """Non-monotonic category→mean mapping: a threshold split on the raw
+    category id cannot isolate the high group, an unordered set can."""
+    means = np.array([0.0, 10.0, 0.5, 9.5, -0.5, 10.5, 0.0, 9.0])[:arity]
+    c = rng.integers(0, arity, size=n)
+    y = means[c] + rng.normal(0, 0.1, size=n)
+    return c.astype(np.float32)[:, None], y.astype(np.float32), means
+
+
+def _best_subset_sse(c, y, arity):
+    """Exhaustive best binary partition of categories (2^(a-1) subsets)."""
+    best = np.inf
+    total_sse_fn = lambda v: ((v - v.mean()) ** 2).sum() if v.size else 0.0
+    for r in range(1, arity):
+        for left in itertools.combinations(range(arity), r):
+            m = np.isin(c, left)
+            sse = total_sse_fn(y[m]) + total_sse_fn(y[~m])
+            best = min(best, sse)
+    return best
+
+
+class TestCategoricalRegression:
+    def test_depth1_matches_exhaustive_subset_search(self, mesh8, rng):
+        arity = 6
+        c = rng.integers(0, arity, size=512)
+        y = rng.normal(size=512) + np.array([0, 3, -2, 5, 1, -4])[c]
+        x = c.astype(np.float32)[:, None]
+        ds = device_dataset(x, y.astype(np.float32), mesh=mesh8)
+        m = ht.DecisionTreeRegressor(
+            max_depth=1, categorical_features={0: arity}
+        ).fit(ds, mesh=mesh8)
+        pred = np.asarray(m.predict_numpy(x))
+        engine_sse = ((pred - y) ** 2).sum()
+        exhaustive_sse = _best_subset_sse(c, y, arity)
+        # Breiman: sort-by-mean prefix scan is exact for regression
+        np.testing.assert_allclose(engine_sse, exhaustive_sse, rtol=1e-3)
+
+    def test_beats_continuous_treatment(self, mesh8, rng):
+        x, y, _ = _cat_regression_data(rng)
+        ds = device_dataset(x, y, mesh=mesh8)
+        cat = ht.DecisionTreeRegressor(
+            max_depth=1, categorical_features={0: 8}
+        ).fit(ds, mesh=mesh8)
+        cont = ht.DecisionTreeRegressor(max_depth=1).fit(ds, mesh=mesh8)
+        rmse = lambda m: float(
+            np.sqrt(np.mean((np.asarray(m.predict_numpy(x)) - y) ** 2))
+        )
+        # interleaved high/low means: the set split isolates the high group
+        # at depth 1, a single threshold cannot
+        assert rmse(cat) < 0.5 * rmse(cont)
+
+    def test_mixed_continuous_and_categorical(self, mesh8, rng):
+        n = 1500
+        c = rng.integers(0, 5, size=n)
+        z = rng.normal(size=n)
+        y = (np.array([0, 8, 1, 9, 0.5])[c] + 2.0 * z).astype(np.float32)
+        x = np.stack([c.astype(np.float32), z.astype(np.float32)], axis=1)
+        m = ht.DecisionTreeRegressor(
+            max_depth=4, categorical_features={0: 5}
+        ).fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        pred = np.asarray(m.predict_numpy(x))
+        assert np.sqrt(np.mean((pred - y) ** 2)) < 1.0
+        # both features matter
+        assert np.all(m.feature_importances > 0.05)
+
+    def test_unseen_category_goes_right(self, mesh8, rng):
+        x, y, _ = _cat_regression_data(rng)
+        m = ht.DecisionTreeRegressor(
+            max_depth=2, categorical_features={0: 8}
+        ).fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        # categories never seen at fit time (and out-of-range ids) predict
+        # via the right-descent path — finite, no crash (Spark's rule)
+        probe = np.array([[8.0], [31.0], [100.0], [-3.0]], np.float32)
+        out = np.asarray(m.predict_numpy(probe))
+        assert np.all(np.isfinite(out))
+
+
+class TestCategoricalClassification:
+    def test_depth1_binary_exact(self, mesh8, rng):
+        arity = 6
+        c = rng.integers(0, arity, size=800)
+        # class 1 on an id-interleaved category subset
+        y = np.isin(c, [0, 3, 5]).astype(np.float32)
+        x = c.astype(np.float32)[:, None]
+        m = ht.DecisionTreeClassifier(
+            max_depth=1, categorical_features={0: arity}
+        ).fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        pred = np.asarray(m.predict_numpy(x))
+        assert (pred == y).mean() == 1.0  # separable by one set split
+
+    def test_random_forest_categorical(self, mesh8, rng):
+        n = 1200
+        c = rng.integers(0, 7, size=n)
+        z = rng.normal(size=n)
+        y = (np.isin(c, [1, 4, 6]) ^ (z > 1.2)).astype(np.float32)
+        x = np.stack([c.astype(np.float32), z.astype(np.float32)], axis=1)
+        m = ht.RandomForestClassifier(
+            num_trees=10, max_depth=4, categorical_features={0: 7}, seed=0
+        ).fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        pred = np.asarray(m.predict_numpy(x))
+        assert (pred == y).mean() > 0.93
+
+
+class TestCategoricalGBT:
+    def test_gbt_categorical_regression(self, mesh8, rng):
+        x, y, _ = _cat_regression_data(rng, n=1500)
+        ds = device_dataset(x, y, mesh=mesh8)
+        # step_size sized so shrinkage converges within the round budget
+        # ((1-0.7^30)≈1; Spark's default 0.1 would need ~70 rounds)
+        cat = ht.GBTRegressor(
+            max_iter=30, max_depth=2, step_size=0.3,
+            categorical_features={0: 8}, seed=0,
+        ).fit(ds, mesh=mesh8)
+        pred = np.asarray(cat.predict_numpy(x))
+        assert np.sqrt(np.mean((pred - y) ** 2)) < 0.5
+
+
+class TestCategoricalPersistence:
+    def test_round_trip(self, mesh8, rng, tmp_path):
+        x, y, _ = _cat_regression_data(rng, n=600)
+        m = ht.RandomForestRegressor(
+            num_trees=5, max_depth=3, categorical_features={0: 8}, seed=0
+        ).fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        m.write().overwrite().save(str(tmp_path / "rf_cat"))
+        m2 = ht.load_model(str(tmp_path / "rf_cat"))
+        probe = rng.integers(0, 8, size=64).astype(np.float32)[:, None]
+        np.testing.assert_array_equal(
+            np.asarray(m.predict_numpy(probe)), np.asarray(m2.predict_numpy(probe))
+        )
+        assert m2.split_catmask is not None
+
+    def test_continuous_models_unchanged(self, mesh8, rng, tmp_path):
+        """No categorical spec → artifacts stay in the old shape."""
+        x = rng.normal(size=(256, 3)).astype(np.float32)
+        y = x[:, 0].astype(np.float32)
+        m = ht.DecisionTreeRegressor(max_depth=2).fit(
+            device_dataset(x, y, mesh=mesh8), mesh=mesh8
+        )
+        assert m.split_catmask is None
+        m.write().overwrite().save(str(tmp_path / "dt"))
+        assert ht.load_model(str(tmp_path / "dt")).split_catmask is None
+
+
+class TestCategoricalValidation:
+    def test_arity_bounds(self, mesh8, rng):
+        x = rng.integers(0, 3, size=(64, 1)).astype(np.float32)
+        y = rng.normal(size=64).astype(np.float32)
+        ds = device_dataset(x, y, mesh=mesh8)
+        with pytest.raises(ValueError, match="arity"):
+            ht.DecisionTreeRegressor(categorical_features={0: 40}).fit(ds, mesh=mesh8)
+        with pytest.raises(ValueError, match="arity"):
+            ht.DecisionTreeRegressor(categorical_features={0: 1}).fit(ds, mesh=mesh8)
+        with pytest.raises(ValueError, match="out of range"):
+            ht.DecisionTreeRegressor(categorical_features={5: 3}).fit(ds, mesh=mesh8)
+        with pytest.raises(ValueError, match="arity"):
+            ht.DecisionTreeRegressor(
+                max_bins=8, categorical_features={0: 16}
+            ).fit(ds, mesh=mesh8)

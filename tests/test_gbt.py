@@ -38,6 +38,76 @@ def test_gbt_regressor_beats_single_tree(rng, mesh8):
     assert gbt.feature_importances[[0, 1, 2]].sum() > 0.9
 
 
+def _assembled_with_indicator(rng, n=2400, noise=1.0):
+    """AssembledTable with a 30%-held-out validation indicator column."""
+    x = rng.uniform(-2, 2, size=(n, 3))
+    y = np.sin(2 * x[:, 0]) * 2 + x[:, 1] + noise * rng.normal(size=n)
+    is_val = (np.arange(n) % 10 < 3).astype(np.int64)
+    tab = ht.Table.from_dict(
+        {"f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "label": y, "is_val": is_val}
+    )
+    return ht.VectorAssembler(["f0", "f1", "f2"]).transform(tab), x, y, is_val
+
+
+def test_gbt_validation_early_stop(rng, mesh8):
+    """Spark's validationIndicatorCol/validationTol: noisy data → the
+    held-out loss plateaus and boosting stops before max_iter."""
+    # small noisy sample + deep trees + aggressive step = real overfitting:
+    # held-out loss bottoms out and climbs, so the stopped prefix wins
+    at, x, y, is_val = _assembled_with_indicator(rng, n=800, noise=1.5)
+    kw = dict(
+        max_iter=80, max_depth=6, step_size=0.5, label_col="label", seed=0
+    )
+    full = ht.GBTRegressor(**kw).fit(at, mesh=mesh8)
+    stopped = ht.GBTRegressor(
+        **kw, validation_indicator_col="is_val", validation_tol=1e-3
+    ).fit(at, mesh=mesh8)
+    assert stopped.num_trees < full.num_trees == 80
+    # on FRESH data (neither model saw it) the stopped prefix generalizes
+    # at least as well as the overfit 80-round model
+    px = rng.uniform(-2, 2, size=(2000, 3))
+    py = np.sin(2 * px[:, 0]) * 2 + px[:, 1]  # noiseless truth
+    err = lambda m: float(
+        np.mean((np.asarray(m.predict_numpy(px)) - py) ** 2)
+    )
+    assert err(stopped) <= err(full) * 1.05
+
+
+def test_gbt_validation_non_default_mesh(rng, mesh42):
+    """The indicator mask must land on the CALLER's mesh, not the process
+    default (mixing meshes raises an incompatible-devices error)."""
+    at, x, y, is_val = _assembled_with_indicator(rng, n=600)
+    m = ht.GBTRegressor(
+        max_iter=6, max_depth=3, label_col="label", seed=0,
+        validation_indicator_col="is_val",
+    ).fit(at, mesh=mesh42)
+    assert np.all(np.isfinite(np.asarray(m.predict_numpy(x))))
+
+
+def test_gbt_validation_classifier_and_errors(rng, mesh8):
+    at, x, y, is_val = _assembled_with_indicator(rng)
+    tab = at.table.with_column("y01", (y > 0).astype(np.int64))
+    at2 = ht.VectorAssembler(["f0", "f1", "f2"]).transform(tab)
+    m = ht.GBTClassifier(
+        max_iter=40, max_depth=3, step_size=0.3, label_col="y01", seed=0,
+        validation_indicator_col="is_val",
+    ).fit(at2, mesh=mesh8)
+    pred = np.asarray(m.predict_numpy(x))
+    assert (pred == (y > 0)).mean() > 0.8
+    # non-table input cannot resolve the column
+    with pytest.raises(ValueError, match="table input"):
+        ht.GBTRegressor(validation_indicator_col="is_val").fit(
+            (x.astype(np.float32), y.astype(np.float32)), mesh=mesh8
+        )
+    # an indicator that selects nothing is an error, not a silent no-op
+    tab0 = at.table.with_column("none_val", np.zeros(len(at.table), np.int64))
+    at0 = ht.VectorAssembler(["f0", "f1", "f2"]).transform(tab0)
+    with pytest.raises(ValueError, match="no validation rows"):
+        ht.GBTRegressor(
+            label_col="label", validation_indicator_col="none_val"
+        ).fit(at0, mesh=mesh8)
+
+
 def test_gbt_regressor_tracks_sklearn(rng, mesh8):
     ske = pytest.importorskip("sklearn.ensemble")
     x, y = _nonlinear(rng)

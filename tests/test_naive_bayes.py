@@ -17,7 +17,12 @@ def test_multinomial_nb_matches_sklearn(rng, mesh8):
     x = np.stack([rng.multinomial(40, profiles[c]) for c in y]).astype(np.float32)
 
     ours = ht.NaiveBayes(smoothing=1.0).fit((x, y.astype(np.float32)), mesh=mesh8)
-    ref = sknb.MultinomialNB(alpha=1.0).fit(x, y)
+    # Spark's MLlib smooths the priors with the Laplace λ too (pi =
+    # log(n_c+λ) − log(n+kλ)), unlike sklearn's log(n_c/n); hand sklearn
+    # that prior so every piece matches exactly
+    counts = np.bincount(y, minlength=3).astype(np.float64)
+    spark_prior = (counts + 1.0) / (counts.sum() + 3.0)
+    ref = sknb.MultinomialNB(alpha=1.0, class_prior=spark_prior).fit(x, y)
     np.testing.assert_allclose(ours.pi, ref.class_log_prior_, atol=1e-6)
     np.testing.assert_allclose(ours.theta, ref.feature_log_prob_, atol=1e-5)
     np.testing.assert_array_equal(ours.predict_numpy(x), ref.predict(x))
@@ -46,6 +51,82 @@ def test_gaussian_nb_matches_sklearn(rng, mesh8):
     )
 
 
+def test_bernoulli_nb_matches_sklearn(rng, mesh8):
+    sknb = pytest.importorskip("sklearn.naive_bayes")
+    n, d = 1200, 8
+    y = rng.integers(0, 3, size=n)
+    p = rng.uniform(0.1, 0.9, size=(3, d))
+    x = (rng.uniform(size=(n, d)) < p[y]).astype(np.float32)
+
+    ours = ht.NaiveBayes(model_type="bernoulli", smoothing=1.0).fit(
+        (x, y.astype(np.float32)), mesh=mesh8
+    )
+    counts = np.bincount(y, minlength=3).astype(np.float64)
+    spark_prior = (counts + 1.0) / (counts.sum() + 3.0)
+    ref = sknb.BernoulliNB(alpha=1.0, class_prior=spark_prior).fit(x, y)
+    np.testing.assert_allclose(ours.theta, ref.feature_log_prob_, atol=1e-5)
+    np.testing.assert_array_equal(ours.predict_numpy(x), ref.predict(x))
+
+
+def test_gaussian_nb_unsmoothed_priors_imbalanced(rng, mesh8):
+    """Spark's gaussian path does NOT Laplace-smooth priors (λ is
+    discrete-only); imbalanced classes expose any smoothing drift."""
+    sknb = pytest.importorskip("sklearn.naive_bayes")
+    y = np.concatenate([np.zeros(950), np.ones(50)]).astype(int)
+    x = (np.array([[0.0], [2.0]])[y] + rng.normal(0, 1, size=(1000, 1))).astype(
+        np.float32
+    )
+    ours = ht.NaiveBayes(model_type="gaussian").fit(
+        (x, y.astype(np.float32)), mesh=mesh8
+    )
+    ref = sknb.GaussianNB().fit(x, y)
+    np.testing.assert_allclose(ours.pi, np.log(ref.class_prior_), atol=1e-6)
+    np.testing.assert_array_equal(ours.predict_numpy(x), ref.predict(x))
+
+
+def test_bernoulli_nb_binarizes_at_predict(rng, mesh8):
+    """Non-binary inputs at PREDICT time are binarized (x≠0 → 1, sklearn
+    BernoulliNB semantics) rather than scored as raw counts."""
+    xb = (rng.uniform(size=(400, 5)) < 0.5).astype(np.float32)
+    y = (xb[:, 0] > 0).astype(np.float32)
+    m = ht.NaiveBayes(model_type="bernoulli").fit((xb, y), mesh=mesh8)
+    counts = xb * rng.integers(1, 40, size=xb.shape).astype(np.float32)
+    np.testing.assert_array_equal(m.predict_numpy(counts), m.predict_numpy(xb))
+
+
+def test_bernoulli_nb_rejects_non_binary(rng, mesh8):
+    x = rng.uniform(size=(64, 3)).astype(np.float32)
+    y = rng.integers(0, 2, size=64).astype(np.float32)
+    with pytest.raises(ValueError, match="0/1"):
+        ht.NaiveBayes(model_type="bernoulli").fit((x, y), mesh=mesh8)
+
+
+def test_complement_nb_matches_sklearn(rng, mesh8):
+    sknb = pytest.importorskip("sklearn.naive_bayes")
+    n, d = 1500, 6
+    # imbalanced classes — the regime CNB exists for
+    y = rng.choice(3, size=n, p=[0.7, 0.2, 0.1])
+    profiles = rng.dirichlet(np.ones(d), size=3)
+    x = np.stack([rng.multinomial(30, profiles[c]) for c in y]).astype(np.float32)
+
+    ours = ht.NaiveBayes(model_type="complement", smoothing=1.0).fit(
+        (x, y.astype(np.float32)), mesh=mesh8
+    )
+    ref = sknb.ComplementNB(alpha=1.0, norm=False).fit(x, y)
+    np.testing.assert_allclose(ours.theta, ref.feature_log_prob_, atol=1e-5)
+    np.testing.assert_array_equal(ours.predict_numpy(x), ref.predict(x))
+
+
+def test_bernoulli_complement_round_trip(rng, mesh8, tmp_path):
+    y = rng.integers(0, 2, size=200)
+    xb = (rng.uniform(size=(200, 4)) < 0.5).astype(np.float32)
+    for mt, x in (("bernoulli", xb), ("complement", xb * 3)):
+        m = ht.NaiveBayes(model_type=mt).fit((x, y.astype(np.float32)), mesh=mesh8)
+        m.write().overwrite().save(str(tmp_path / mt))
+        back = ht.load_model(str(tmp_path / mt))
+        np.testing.assert_array_equal(back.predict_numpy(x), m.predict_numpy(x))
+
+
 def test_nb_weighted_equals_duplication(rng, mesh8):
     n, d = 600, 5
     y = rng.integers(0, 2, size=n).astype(np.float32)
@@ -64,7 +145,7 @@ def test_nb_validation_and_persistence(rng, mesh8, tmp_path):
     with pytest.raises(ValueError, match="non-negative"):
         ht.NaiveBayes().fit((x, y), mesh=mesh8)
     with pytest.raises(ValueError, match="model_type"):
-        ht.NaiveBayes(model_type="bernoulli").fit((np.abs(x), y), mesh=mesh8)
+        ht.NaiveBayes(model_type="poisson").fit((np.abs(x), y), mesh=mesh8)
     m = ht.NaiveBayes(model_type="gaussian").fit((x, y), mesh=mesh8)
     p = os.path.join(tmp_path, "nb")
     m.write().overwrite().save(p)
