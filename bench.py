@@ -602,6 +602,77 @@ def _bench_gbt(M: int = 20, depth: int = 3) -> dict:
     }
 
 
+def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
+    """Pallas fused-Lloyd vs XLA-scan A/B at a WIDE feature count.
+
+    SURVEY.md §3.3's "own the hot loop in Pallas" decision point: at the
+    BASELINE shape (d=8) the XLA scan measured 2.4× faster on-chip (see
+    ops/pallas_kernels.py status note); d≥64 is the shape where the fused
+    VMEM accumulation should pay.  This config records the measured ratio
+    either way — ``vs_baseline`` here is kernel-vs-XLA (>1 means the
+    kernel wins), not vs Spark-CPU."""
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+        _make_train_step,
+        _make_train_step_fused,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    platform, on_tpu, n, iters, mesh, n_chips = _bench_setup(2_000_000)
+    if not on_tpu:
+        # interpret-mode pallas is orders of magnitude off; a CPU number
+        # would be noise presented as signal
+        return {
+            "metric": f"Pallas fused-Lloyd A/B k={k} d={d}",
+            "error": "requires the TPU backend (kernel runs interpret-mode on CPU)",
+        }
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError("pallas_ab needs a model-axis-1 mesh")
+    x = _make_data(n, d, k)
+    ds = device_dataset(x, mesh=mesh)
+    rng = np.random.default_rng(1)
+    cen = x[rng.choice(n, size=k, replace=False)]
+    centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    c_valid = jax.device_put(
+        np.ones((k,), np.float32), NamedSharding(mesh, P(MODEL_AXIS))
+    )
+    n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+
+    def rate(step):
+        c = centers
+        c, _, _, _ = step(ds.x, ds.w, c, c_valid)   # warm-up/compile
+        jax.block_until_ready(c)
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                c, _, _, _ = step(ds.x, ds.w, c, c_valid)
+            jax.block_until_ready(c)
+            rates.append(n * iters / (time.perf_counter() - t0))
+        return float(np.median(rates))
+
+    xla = rate(_make_train_step(mesh, n_loc, k, d, 32768))
+    fused = rate(_make_train_step_fused(mesh, k, False))
+    return {
+        "metric": (
+            f"Pallas fused-Lloyd records/sec/chip (A/B vs XLA scan, "
+            f"k={k}, d={d}, {n} rows, {platform})"
+        ),
+        "value": round(fused / n_chips, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(fused / xla, 3),
+        "xla_scan_rps_per_chip": round(xla / n_chips, 1),
+    }
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -613,6 +684,7 @@ CONFIGS = {
     "rf20": lambda: _bench_random_forest(20, 5),                # reference hot path
     "gbt20": lambda: _bench_gbt(20, 3),                         # boosted rounds
     "nb": lambda: _bench_naive_bayes(8),                        # stats pass
+    "pallas_ab": lambda: _bench_pallas_ab(64, 64),              # win-or-retire A/B
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets

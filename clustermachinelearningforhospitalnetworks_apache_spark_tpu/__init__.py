@@ -41,7 +41,14 @@ from .features import (
     StringIndexer,
     VectorAssembler,
 )
-from .stat import ChiSquareTest, Correlation, Summarizer
+from .stat import (
+    ANOVATest,
+    ChiSquareTest,
+    Correlation,
+    FValueTest,
+    KolmogorovSmirnovTest,
+    Summarizer,
+)
 from .evaluation import (
     ClusteringEvaluator,
     BinaryClassificationEvaluator,
@@ -99,7 +106,10 @@ __all__ = [
     "train_test_split",
     "Binarizer",
     "Bucketizer",
+    "ANOVATest",
     "ChiSquareTest",
+    "FValueTest",
+    "KolmogorovSmirnovTest",
     "Correlation",
     "IndexToString",
     "Normalizer",

@@ -67,13 +67,22 @@ class Bucketizer:
         idx = np.searchsorted(s, v, side="right") - 1
         # top boundary is inclusive (Spark: last bucket closed both ends)
         idx[v == s[-1]] = self.num_buckets - 1
-        invalid = np.isnan(v) | (v < s[0]) | (v > s[-1])
+        # Spark semantics: handleInvalid covers NaN ONLY — a non-NaN value
+        # outside the split range always raises, under every mode
+        out_of_range = ~np.isnan(v) & ((v < s[0]) | (v > s[-1]))
+        if out_of_range.any():
+            bad = v[out_of_range][0]
+            raise ValueError(
+                f"value {bad!r} in {self.input_col!r} is outside the split "
+                f"range [{s[0]}, {s[-1]}]; Bucketizer splits must cover the "
+                "data (use -inf/inf boundary splits for open ranges)"
+            )
+        invalid = np.isnan(v)
         if invalid.any():
             if self.handle_invalid == "error":
-                bad = v[invalid][0]
                 raise ValueError(
-                    f"value {bad!r} in {self.input_col!r} is outside the "
-                    f"split range [{s[0]}, {s[-1]}] (handle_invalid='error')"
+                    f"NaN in {self.input_col!r} (handle_invalid='error'); "
+                    "use 'keep' or 'skip'"
                 )
             idx[invalid] = self.num_buckets  # "keep": extra bucket
         out = table.with_column(self.output_col, idx.astype(np.int64), dtype="int")

@@ -50,9 +50,11 @@ class ImputerModel:
         )
 
     def _is_missing(self, v: np.ndarray) -> np.ndarray:
+        # Spark's Imputer always treats null/NaN as missing IN ADDITION to
+        # the configured sentinel — a NaN must never pass through untouched
         if np.isnan(self.missing_value):
             return np.isnan(v)
-        return v == self.missing_value
+        return np.isnan(v) | (v == self.missing_value)
 
     def transform(self, table: Table) -> Table:
         out = table
@@ -81,7 +83,13 @@ class Imputer:
         surrogates = []
         for c in self.input_cols:
             v = table.column(c).astype(np.float64)
-            miss = np.isnan(v) if np.isnan(self.missing_value) else v == self.missing_value
+            # NaN is always missing (Spark rule) — it must not pollute the
+            # surrogate mean/median either
+            miss = (
+                np.isnan(v)
+                if np.isnan(self.missing_value)
+                else np.isnan(v) | (v == self.missing_value)
+            )
             ok = v[~miss]
             if ok.size == 0:
                 raise ValueError(f"column {c!r} has no non-missing values to impute from")

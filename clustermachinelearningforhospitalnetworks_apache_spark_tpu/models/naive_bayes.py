@@ -97,10 +97,11 @@ class NaiveBayesModel(Model):
             return x @ th.T + pi[None, :]
         if self.model_type == "bernoulli":
             # Σ_f x log p + (1−x) log(1−p) = x·(log p − log(1−p)) + Σ log(1−p).
-            # Inputs are binarized (x≠0 → 1) like sklearn BernoulliNB —
-            # raw counts scored against the fit-time 0/1 contract would be
-            # silent garbage (Spark raises instead; delta documented).
-            xb = (x != 0.0).astype(jnp.float32)
+            # Inputs are binarized (x > 0 → 1) exactly like sklearn
+            # BernoulliNB(binarize=0.0) — raw counts scored against the
+            # fit-time 0/1 contract would be silent garbage (Spark raises
+            # instead; delta documented).  Negatives and NaN map to 0.
+            xb = (x > 0.0).astype(jnp.float32)
             th2 = jnp.asarray(self.theta2, jnp.float32)
             return xb @ (th - th2).T + (pi + jnp.sum(th2, axis=1))[None, :]
         if self.model_type == "complement":

@@ -112,7 +112,15 @@ class LinearRegressionTrainingSummary:
 
     @cached_property
     def num_instances(self) -> int:
-        return int(np.asarray(jax.device_get(self._ds.count())))
+        """Count of (w>0) rows — Spark's numInstances is a ROW count, not
+        the weight sum (they differ under fractional weightCol weights)."""
+        return int(np.sum(np.asarray(jax.device_get(self._ds.w)) > 0))
+
+    @cached_property
+    def weight_sum(self) -> float:
+        """Σw over valid rows (the quantity ``num_instances`` previously
+        conflated; exposed separately for weighted-fit diagnostics)."""
+        return float(np.asarray(jax.device_get(self._ds.count())))
 
     @property
     def degrees_of_freedom(self) -> int:
@@ -153,7 +161,9 @@ class LinearRegressionTrainingSummary:
             )
         diag = np.diag(np.linalg.inv(g))
         dof = max(self.degrees_of_freedom, 1)
-        sigma2 = self.mean_squared_error * self.num_instances / dof
+        # RSS = weighted mse × Σw (NOT × row count — they differ under
+        # fractional weightCol weights); dof stays a row count
+        sigma2 = self.mean_squared_error * self.weight_sum / dof
         return np.sqrt(np.maximum(diag * sigma2, 0.0))
 
     @cached_property

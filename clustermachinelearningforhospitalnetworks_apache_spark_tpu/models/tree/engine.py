@@ -413,22 +413,35 @@ def _make_bootstrap(mesh: Mesh, T: int, n_pad: int, rate: float):
 
 
 def bin_feature_matrix(
-    x: jax.Array, thr: np.ndarray, cat: dict[int, int] | None = None
+    x: jax.Array, thr: np.ndarray, cat: dict[int, int] | None = None,
+    w: jax.Array | None = None,
 ) -> jax.Array:
     """(n, d) features → (d, n) int32 bin matrix (row axis last).
 
     Continuous columns digitize against the quantile ``thr``; categorical
-    columns' bins ARE their category ids (StringIndexer output), clipped
-    to [0, arity-1].  Shared by ``grow_forest`` and GBT's bin-once path."""
+    columns' bins ARE their category ids (StringIndexer output).  A valid
+    (w>0) row whose categorical value rounds outside [0, arity) raises —
+    Spark MLlib errors on category ≥ arity too, and silently clamping
+    would train on a category the predict path routes differently
+    (train/serve skew).  Shared by ``grow_forest`` and GBT's bin-once
+    path."""
     binned = digitize(x.astype(jnp.float32), jnp.asarray(thr, jnp.float32))
     if cat:
-        cat_idx = jnp.asarray(sorted(cat), jnp.int32)
-        hi = jnp.asarray([cat[f] - 1 for f in sorted(cat)], jnp.int32)
-        xi = jnp.clip(
-            jnp.round(x[:, np.asarray(sorted(cat))]).astype(jnp.int32),
-            0,
-            hi[None, :],
-        )
+        feats = sorted(cat)
+        cat_idx = jnp.asarray(feats, jnp.int32)
+        hi = jnp.asarray([cat[f] - 1 for f in feats], jnp.int32)
+        xi = jnp.round(x[:, np.asarray(feats)]).astype(jnp.int32)
+        bad = (xi < 0) | (xi > hi[None, :])
+        if w is not None:
+            bad = bad & (w[:, None] > 0)
+        bad_feat = np.asarray(jax.device_get(jnp.any(bad, axis=0)))
+        if bad_feat.any():
+            f = feats[int(np.flatnonzero(bad_feat)[0])]
+            raise ValueError(
+                f"categorical feature {f} has values outside [0, "
+                f"{cat[f]}) — wrong arity in categorical_features, or the "
+                "column is not StringIndexer output"
+            )
         binned = binned.at[:, cat_idx].set(xi)
     return binned.T
 
@@ -524,7 +537,7 @@ def grow_forest(
     # row axis LAST on every big device array (lane dim) — trailing d/S
     # axes would tile-pad to 128 lanes in HBM (see _make_level_hist)
     if binned_t is None:
-        binned_t = bin_feature_matrix(ds.x, thr, cat)
+        binned_t = bin_feature_matrix(ds.x, thr, cat, w=ds.w)
     elif bin_thresholds is None:
         raise ValueError("binned_t requires the matching bin_thresholds")
     elif binned_t.shape != (d, n_pad):
@@ -700,12 +713,15 @@ def predict_forest(x, split_feat, threshold, value, cat_mask=None, cat_flags=Non
             right = (xv > th[node]).astype(jnp.int32)
             if cat_flags is not None:
                 icat = cat_flags[jnp.maximum(f, 0)]
-                xi = jnp.clip(xv, 0, 31).astype(jnp.uint32)
+                # ROUND like the fit-time binning (truncation would send
+                # 2.9999 down a different branch than training did); then
+                # unseen/out-of-range ids always go right (Spark's rule)
+                xr = jnp.round(xv)
+                xi = jnp.clip(xr, 0, 31).astype(jnp.uint32)
                 in_left = (
                     jnp.right_shift(cm[node], xi) & jnp.uint32(1)
                 ) > 0
-                # out-of-range category values (< 0 or ≥ 32) always go right
-                in_left = in_left & (xv >= 0) & (xv < 32)
+                in_left = in_left & (xr >= 0) & (xr < 32)
                 right = jnp.where(icat, (~in_left).astype(jnp.int32), right)
             child = 2 * node + 1 + right
             return jnp.where(is_split, child, node)

@@ -98,23 +98,20 @@ class GBTModel(Model):
                 "learning_rate": float(self.learning_rate),
                 "max_depth": int(self.max_depth),
             },
-            dict(
-                {
-                    "split_feat": self.split_feat,
-                    "threshold": self.threshold,
-                    "value": self.value,
-                    "feature_importances": self.feature_importances,
-                },
-                **(
-                    {
-                        "split_catmask": self.split_catmask,
-                        "cat_arities": np.asarray(self.cat_arities),
-                    }
-                    if self.split_catmask is not None
-                    else {}
-                ),
-            ),
+            self._tree_arrays(),
         )
+
+    def _tree_arrays(self) -> dict:
+        arrays = {
+            "split_feat": self.split_feat,
+            "threshold": self.threshold,
+            "value": self.value,
+            "feature_importances": self.feature_importances,
+        }
+        if self.split_catmask is not None:
+            arrays["split_catmask"] = self.split_catmask
+            arrays["cat_arities"] = np.asarray(self.cat_arities)
+        return arrays
 
     @classmethod
     def from_artifacts(cls, params, arrays):
@@ -204,7 +201,10 @@ class _GBTParams:
         if sample.shape[0] == 0:
             raise ValueError("GBT fit on an empty dataset")
         thr = quantile_thresholds(sample, self.max_bins)
-        binned_t = bin_feature_matrix(x, thr, self.categorical_features)
+        # the categorical range check covers ALL valid rows — a held-out
+        # validation row with a bad category id must raise too, not slip
+        # into every round's advance() as an "unseen category"
+        binned_t = bin_feature_matrix(x, thr, self.categorical_features, w=w_all)
 
         ybar = float(jax.device_get(jnp.sum(y * w) / n))
         if loss == "squared":

@@ -1,15 +1,25 @@
 from .stat import (
+    ANOVATest,
     ChiSquareTest,
     ChiSquareTestResult,
     Correlation,
+    FTestResult,
+    FValueTest,
+    KolmogorovSmirnovTest,
+    KolmogorovSmirnovTestResult,
     Summarizer,
     SummaryStats,
 )
 
 __all__ = [
+    "ANOVATest",
     "ChiSquareTest",
     "ChiSquareTestResult",
     "Correlation",
+    "FTestResult",
+    "FValueTest",
+    "KolmogorovSmirnovTest",
+    "KolmogorovSmirnovTestResult",
     "Summarizer",
     "SummaryStats",
 ]

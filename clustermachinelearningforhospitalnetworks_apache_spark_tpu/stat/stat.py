@@ -1,18 +1,23 @@
-"""Statistics — ``pyspark.ml.stat`` parity (Correlation, Summarizer).
+"""Statistics — ``pyspark.ml.stat`` parity (Correlation, Summarizer,
+ChiSquareTest, KolmogorovSmirnovTest, ANOVATest, FValueTest).
 
 Spark computes these as one distributed aggregation job per call
 (``Correlation.corr``, ``Summarizer.metrics(...)``); here each is a single
 fused, jit'd weighted reduction over the sharded rows — the (d, d) moment
 matrix / per-column stat vector is the only thing that reaches the host.
 Spearman ranks are computed host-side (a global sort is a host operation
-for tabular d ≪ n data, as in Spark where ranking is a shuffle).
+for tabular d ≪ n data, as in Spark where ranking is a shuffle).  The KS
+statistic sorts on device (one ``jnp.sort``) and reduces the ECDF gap
+there; only p-value lookups (scipy distributions) run on host.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..features.assembler import AssembledTable
@@ -155,6 +160,215 @@ def _avg_rank(v: np.ndarray) -> np.ndarray:
     ends = np.cumsum(counts)                 # 1-based end rank of each run
     starts = ends - counts + 1
     return 0.5 * (starts + ends)[inv]
+
+
+@dataclass(frozen=True)
+class KolmogorovSmirnovTestResult:
+    p_value: float
+    statistic: float
+
+
+@partial(jax.jit, static_argnames=())
+def _ks_device_stat(x, w, mean, std):
+    """One-sample KS statistic vs N(mean, std) on device.
+
+    Sorts the (padded) sample once; pad rows (w=0) are pushed to +inf so
+    they occupy the tail slots and the ECDF indices count only real rows.
+    D = max(D+, D−) over the sorted sample — one sort + one reduction.
+    """
+    n = jnp.sum(w > 0)
+    xs = jnp.sort(jnp.where(w > 0, x, jnp.inf))
+    idx = jnp.arange(xs.shape[0], dtype=jnp.float32)
+    cdf = jax.scipy.stats.norm.cdf(xs, loc=mean, scale=std)
+    valid = idx < n
+    nf = jnp.maximum(n.astype(jnp.float32), 1.0)
+    d_plus = jnp.max(jnp.where(valid, (idx + 1.0) / nf - cdf, -jnp.inf))
+    d_minus = jnp.max(jnp.where(valid, cdf - idx / nf, -jnp.inf))
+    return jnp.maximum(d_plus, d_minus), n
+
+
+class KolmogorovSmirnovTest:
+    """``pyspark.ml.stat.KolmogorovSmirnovTest.test(data, col, "norm",
+    mean, std)`` — one-sample KS against a normal distribution (the only
+    theoretical distribution Spark supports).  The sort + ECDF-gap
+    reduction runs on device; scipy supplies the exact p-value
+    (``scipy.stats.kstest`` parity)."""
+
+    @staticmethod
+    def test(
+        data, dist: str = "norm", mean: float = 0.0, std: float = 1.0, mesh=None
+    ) -> KolmogorovSmirnovTestResult:
+        if dist != "norm":
+            raise ValueError(
+                f"only the 'norm' theoretical distribution is supported "
+                f"(Spark parity), got {dist!r}"
+            )
+        if std <= 0:
+            raise ValueError(f"std must be positive, got {std}")
+        x, w = _as_xw(data, mesh=mesh)
+        if x.ndim == 2:
+            if x.shape[1] != 1:
+                raise ValueError(
+                    f"KS is a single-column test; got {x.shape[1]} columns "
+                    "— select one (Spark's sampleCol)"
+                )
+            x = x[:, 0]
+        stat, n = _ks_device_stat(
+            x.astype(jnp.float32), w, jnp.float32(mean), jnp.float32(std)
+        )
+        n = int(n)
+        if n == 0:
+            raise ValueError("KS test on an empty sample")
+        try:
+            from scipy import stats as sps
+
+            p = float(sps.kstwo.sf(float(stat), n))
+        except ImportError:  # pragma: no cover
+            p = float("nan")
+        return KolmogorovSmirnovTestResult(
+            p_value=min(max(p, 0.0), 1.0), statistic=float(stat)
+        )
+
+
+@dataclass(frozen=True)
+class FTestResult:
+    """Per-feature F-test results (ANOVATest / FValueTest)."""
+
+    p_values: np.ndarray           # (d,)
+    degrees_of_freedom: np.ndarray  # (d,)
+    f_values: np.ndarray           # (d,)
+
+
+def _padded_labels(ds, y: np.ndarray, test_name: str):
+    """Zero-pad labels to the padded row count, refusing a silent length
+    mismatch: a label vector shorter than the valid rows would count real
+    feature rows under label 0 and corrupt the statistics."""
+    n_valid = int(np.sum(np.asarray(jax.device_get(ds.w)) > 0))
+    if y.shape[0] not in (n_valid, ds.n_padded):
+        raise ValueError(
+            f"{test_name}: labels have {y.shape[0]} rows but features have "
+            f"{n_valid} valid rows (padded {ds.n_padded}) — pass one label "
+            "per feature row"
+        )
+    yp = np.zeros((ds.n_padded,), np.float32)
+    yp[: y.shape[0]] = y
+    return jnp.asarray(yp)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _anova_stats(x, y, w, k: int):
+    """Per-class (count, Σxc, Σxc²) per feature on GLOBALLY CENTERED
+    features — one one-hot contraction.  Centering kills the f32
+    ``Σx² − n·mean²`` catastrophic cancellation for features whose mean
+    dwarfs the within-class spread (a year column at n=1e6 would lose the
+    entire within-class signal below the f32 granularity of x²) — the
+    same fix as ``models/naive_bayes._gaussian_stats``.  ANOVA's F is
+    shift-invariant, so the statistics are exact."""
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    gmean = jnp.sum(x * w[:, None], axis=0) / n
+    xc = x - gmean[None, :]
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype) * w[:, None]
+    counts = jnp.sum(onehot, axis=0)          # (k,)
+    s1 = onehot.T @ xc                        # (k, d)
+    s2 = onehot.T @ (xc * xc)                 # (k, d)
+    return counts, s1, s2
+
+
+class ANOVATest:
+    """``pyspark.ml.stat.ANOVATest``: one-way ANOVA F-test of every
+    continuous feature against a categorical label.  Sufficient statistics
+    are one MXU one-hot contraction (the treeAggregate replacement); the
+    tiny (k, d) tables finish on host with scipy's F distribution
+    (``scipy.stats.f_oneway`` parity)."""
+
+    @staticmethod
+    def test(features, labels, mesh=None) -> FTestResult:
+        from ..models.base import as_device_dataset
+
+        ds = as_device_dataset(features, mesh=mesh)
+        y = np.asarray(labels).reshape(-1)
+        yp = _padded_labels(ds, y, "ANOVA")
+        k = int(y.max()) + 1 if y.size else 1
+        if k < 2:
+            raise ValueError("ANOVA needs at least 2 label classes")
+        counts, s1, s2 = (
+            np.asarray(a, np.float64)
+            for a in _anova_stats(
+                ds.x.astype(jnp.float32), jnp.asarray(yp), ds.w, k
+            )
+        )
+        n = counts.sum()
+        mean_c = s1 / np.maximum(counts[:, None], 1e-12)      # (k, d)
+        gmean = s1.sum(axis=0) / n                            # (d,)
+        ss_between = (counts[:, None] * (mean_c - gmean[None, :]) ** 2).sum(axis=0)
+        ss_within = (s2 - counts[:, None] * mean_c**2).sum(axis=0)
+        df_b, df_w = k - 1, n - k
+        with np.errstate(invalid="ignore", divide="ignore"):
+            f = (ss_between / df_b) / (ss_within / max(df_w, 1e-12))
+        try:
+            from scipy import stats as sps
+
+            p = sps.f.sf(f, df_b, df_w)
+        except ImportError:  # pragma: no cover
+            p = np.full_like(f, np.nan)
+        return FTestResult(
+            p_values=np.asarray(p),
+            degrees_of_freedom=np.full(f.shape, df_w),
+            f_values=np.asarray(f),
+        )
+
+
+class FValueTest:
+    """``pyspark.ml.stat.FValueTest``: F-test of linear dependence between
+    each feature and a CONTINUOUS label — F = r²/(1−r²)·(n−2) from the
+    per-feature Pearson correlation, computed in one fused weighted moment
+    pass over the sharded rows (sklearn ``f_regression`` parity)."""
+
+    @staticmethod
+    def test(features, labels, mesh=None) -> FTestResult:
+        from ..models.base import as_device_dataset
+
+        ds = as_device_dataset(features, mesh=mesh)
+        y = np.asarray(labels, dtype=np.float64).reshape(-1)
+        yp = _padded_labels(ds, y, "FValueTest")
+        stats = _fvalue_stats(ds.x.astype(jnp.float32), jnp.asarray(yp), ds.w)
+        sw, sxx, syy, sxy = (np.asarray(a, np.float64) for a in stats)
+        n = sw
+        cov = sxy / n
+        vx = sxx / n
+        vy = syy / n
+        with np.errstate(invalid="ignore", divide="ignore"):
+            r2 = np.clip(cov * cov / np.maximum(vx * vy, 1e-300), 0.0, 1.0)
+            f = r2 / np.maximum(1.0 - r2, 1e-300) * (n - 2)
+        try:
+            from scipy import stats as sps
+
+            p = sps.f.sf(f, 1, n - 2)
+        except ImportError:  # pragma: no cover
+            p = np.full_like(f, np.nan)
+        return FTestResult(
+            p_values=np.asarray(p),
+            degrees_of_freedom=np.full(f.shape, n - 2),
+            f_values=np.asarray(f),
+        )
+
+
+@jax.jit
+def _fvalue_stats(x, y, w):
+    """(Σw, Σw·xc², Σw·yc², Σw·xc·yc) of CENTERED columns — computing the
+    second moments on ``x − mean`` directly instead of the ``Σx² − n·mean²``
+    identity, which cancels catastrophically in f32 when a feature's mean
+    dwarfs its spread (see ``_anova_stats``)."""
+    wcol = w[:, None]
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    xc = x - (jnp.sum(x * wcol, axis=0) / n)[None, :]
+    yc = y - jnp.sum(y * w) / n
+    return (
+        jnp.sum(w),
+        jnp.sum(xc * xc * wcol, axis=0),
+        jnp.sum(yc * yc * w),
+        jnp.sum(xc * (yc * w)[:, None], axis=0),
+    )
 
 
 @dataclass(frozen=True)

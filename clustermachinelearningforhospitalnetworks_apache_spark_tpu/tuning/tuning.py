@@ -192,7 +192,15 @@ def _fit_and_score(estimator, params, train, val, evaluator, label_col, mesh):
 
 
 def _best_index(avg: np.ndarray, larger_better: bool) -> int:
-    return int(np.argmax(avg) if larger_better else np.argmin(avg))
+    """NaN-safe winner selection: np.argmax/argmin treat NaN as the
+    extremum, so one NaN-scoring (fold, param) cell — a degenerate
+    silhouette, an r2 on a pathological fold — would silently win."""
+    if np.all(np.isnan(avg)):
+        raise ValueError(
+            "every parameter map scored NaN; the metric is undefined on "
+            "this data/estimator combination"
+        )
+    return int(np.nanargmax(avg) if larger_better else np.nanargmin(avg))
 
 
 @dataclass(frozen=True)

@@ -164,6 +164,21 @@ class TestCategoricalPersistence:
 
 
 class TestCategoricalValidation:
+    def test_out_of_arity_values_raise_at_fit(self, mesh8, rng):
+        """A valid row with category id ≥ arity is a spec error (wrong
+        arity / not StringIndexer output) — raise like Spark, never train
+        on a category the predict path would route differently."""
+        x = rng.integers(0, 8, size=(128, 1)).astype(np.float32)
+        y = rng.normal(size=128).astype(np.float32)
+        with pytest.raises(ValueError, match="outside \\[0, 4\\)"):
+            ht.DecisionTreeRegressor(categorical_features={0: 4}).fit(
+                device_dataset(x, y, mesh=mesh8), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="outside"):
+            ht.GBTRegressor(max_iter=2, categorical_features={0: 4}).fit(
+                device_dataset(x, y, mesh=mesh8), mesh=mesh8
+            )
+
     def test_arity_bounds(self, mesh8, rng):
         x = rng.integers(0, 3, size=(64, 1)).astype(np.float32)
         y = rng.normal(size=64).astype(np.float32)

@@ -1,14 +1,23 @@
 """Multi-controller runtime (SURVEY.md §2D distributed comm backend): a
 REAL two-process CPU cluster — each process runs the same SPMD program,
 ``parallel.distributed.initialize`` wires them through the coordinator, and
-a sharded LinearRegression fit reduces across process boundaries (the DCN
-path of a pod slice, emulated with the CPU collectives transport).
+the fits reduce across process boundaries (the DCN path of a pod slice,
+emulated with the CPU collectives transport).
+
+Covered cross-process (round 3 broadened this beyond the WLS fit):
+- sharded LinearRegression WLS (psum'd Gram) on a 1-D data mesh;
+- a KMeans Lloyd loop on a 2-D **data×model** mesh — the model-axis
+  ``all_gather`` argmin + data-axis ``psum`` mix that breaks on real pods;
+- a level-order histogram tree fit (replicated winner tensors fetched by
+  every controller).
+Results are asserted against the same fits run in-process by the parent.
 
 This is the test Spark gets by spinning up local-cluster mode; here it
 proves the framework's control plane works beyond one process, not just on
 the in-process virtual mesh the rest of the suite uses.
 """
 
+import json
 import os
 import socket
 import subprocess
@@ -18,9 +27,29 @@ import textwrap
 import numpy as np
 import pytest
 
+
+def _problem_data():
+    """Deterministic shared problem set (parent and both workers)."""
+    rng = np.random.default_rng(0)
+    n, d = 96, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([1.0, -2.0, 0.5], np.float32)
+    y = (x @ beta + 0.25).astype(np.float32)
+    # well-separated blobs for the KMeans phase
+    blob_centers = np.array(
+        [[0, 0, 0], [10, 0, 0], [0, 10, 0], [0, 0, 10]], np.float32
+    )
+    assign = rng.integers(0, 4, size=n)
+    xk = (blob_centers[assign] + rng.normal(0, 0.5, size=(n, d))).astype(np.float32)
+    yk = (xk[:, 0] > 5).astype(np.float32) * 3.0 + xk[:, 1] * 0.1
+    init = (blob_centers + rng.normal(0, 0.3, size=(4, d))).astype(np.float32)
+    return x, y, beta, xk, yk.astype(np.float32), init
+
+
 _WORKER = textwrap.dedent(
     """
     import importlib.util
+    import json
     import os, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -48,6 +77,7 @@ _WORKER = textwrap.dedent(
         process_id=int(os.environ["PROC_ID"]),
     )
     sys.path.insert(0, @@REPO@@)
+    sys.path.insert(0, os.path.join(@@REPO@@, "tests"))
     assert ctx.num_processes == 2, ctx
     assert ctx.global_devices == 4, ctx
 
@@ -55,27 +85,23 @@ _WORKER = textwrap.dedent(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
-        DATA_AXIS, build_mesh,
+        DATA_AXIS, MODEL_AXIS, build_mesh,
     )
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.config import MeshConfig
+    from test_distributed import _problem_data
 
+    x, y, beta, xk, yk, init = _problem_data()
+    n, d = x.shape
+
+    def put(mesh, arr, spec):
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+    # ---- phase 1: WLS fit, 1-D data mesh over both processes ----------
     mesh = build_mesh(MeshConfig(data=4, model=1))
-
-    # every controller materializes the same global rows, each holds its
-    # local shards (multi-controller SPMD: jax.make_array_from_callback)
-    rng = np.random.default_rng(0)
-    n, d = 64, 3
-    x = rng.normal(size=(n, d)).astype(np.float32)
-    beta = np.array([1.0, -2.0, 0.5], np.float32)
-    y = (x @ beta + 0.25).astype(np.float32)
-
-    sh = NamedSharding(mesh, P(DATA_AXIS, None))
-    xg = jax.make_array_from_callback((n, d), sh, lambda idx: x[idx])
-    sh1 = NamedSharding(mesh, P(DATA_AXIS))
-    yg = jax.make_array_from_callback((n,), sh1, lambda idx: y[idx])
-    wg = jax.make_array_from_callback(
-        (n,), sh1, lambda idx: np.ones((n,), np.float32)[idx]
-    )
+    xg = put(mesh, x, P(DATA_AXIS, None))
+    yg = put(mesh, y, P(DATA_AXIS))
+    wg = put(mesh, np.ones((n,), np.float32), P(DATA_AXIS))
 
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.linear_regression import (
         _wls_fit,
@@ -84,9 +110,110 @@ _WORKER = textwrap.dedent(
     coef = np.asarray(jax.device_get(coef))
     np.testing.assert_allclose(coef, beta, atol=1e-3)
     np.testing.assert_allclose(float(intercept), 0.25, atol=1e-3)
+
+    # ---- phase 2: KMeans Lloyd on a 2-D data×model mesh ---------------
+    # model-axis all_gather argmin + data-axis psum, across processes
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+        _make_train_step,
+    )
+    mesh2 = build_mesh(MeshConfig(data=2, model=2))
+    xkg = put(mesh2, xk, P(DATA_AXIS, None))
+    wkg = put(mesh2, np.ones((n,), np.float32), P(DATA_AXIS))
+    cen = put(mesh2, init, P(MODEL_AXIS, None))
+    cv = put(mesh2, np.ones((4,), np.float32), P(MODEL_AXIS))
+    step = _make_train_step(mesh2, n // 2, 4, d, 32768)
+    for _ in range(5):
+        cen, counts, cost, move = step(xkg, wkg, cen, cv)
+    rep = jax.jit(lambda c: c, out_shardings=NamedSharding(mesh2, P()))
+    centers = np.asarray(jax.device_get(rep(cen)))
+    result = {
+        "centers": centers.tolist(),
+        "cost": float(cost),
+        "counts": np.asarray(jax.device_get(rep(counts))).tolist(),
+    }
+
+    # ---- phase 3: histogram tree fit across processes -----------------
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+        grow_forest,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.binning import (
+        quantile_thresholds,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        DeviceDataset,
+    )
+    thr = quantile_thresholds(xk.astype(np.float64), 16)   # host, shared
+    ykg = put(mesh2, yk, P(DATA_AXIS))
+    ds = DeviceDataset(x=xkg, y=ykg, w=wkg)
+    grown = grow_forest(
+        ds, task="regression", num_trees=1, max_depth=3, max_bins=16,
+        seed=0, mesh=mesh2, bin_thresholds=thr,
+    )
+    result["split_feat"] = grown.split_feat.tolist()
+    result["threshold"] = grown.threshold.tolist()
+    result["value"] = np.asarray(grown.value[..., 0]).tolist()
+    print("RESULT " + json.dumps(result), flush=True)
     print(f"proc {ctx.process_id}: OK coef={coef.round(3).tolist()}")
     """
 )
+
+
+def _in_process_reference():
+    """The same KMeans/tree fits on the parent's in-process virtual mesh."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.config import (
+        MeshConfig,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+        _make_train_step,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.binning import (
+        quantile_thresholds,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+        grow_forest,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+        build_mesh,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        DeviceDataset,
+    )
+
+    _, _, _, xk, yk, init = _problem_data()
+    n, d = xk.shape
+    mesh = build_mesh(MeshConfig(data=2, model=2))
+
+    def put(arr, spec):
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    xkg = put(xk, P(DATA_AXIS, None))
+    wkg = put(np.ones((n,), np.float32), P(DATA_AXIS))
+    cen = put(init, P(MODEL_AXIS, None))
+    cv = put(np.ones((4,), np.float32), P(MODEL_AXIS))
+    step = _make_train_step(mesh, n // 2, 4, d, 32768)
+    for _ in range(5):
+        cen, counts, cost, move = step(xkg, wkg, cen, cv)
+    thr = quantile_thresholds(xk.astype(np.float64), 16)
+    grown = grow_forest(
+        DeviceDataset(x=xkg, y=put(yk, P(DATA_AXIS)), w=wkg),
+        task="regression", num_trees=1, max_depth=3, max_bins=16,
+        seed=0, mesh=mesh, bin_thresholds=thr,
+    )
+    return {
+        "centers": np.asarray(jax.device_get(cen)),
+        "cost": float(cost),
+        "counts": np.asarray(jax.device_get(counts)),
+        "split_feat": grown.split_feat,
+        "threshold": grown.threshold,
+        "value": np.asarray(grown.value[..., 0]),
+    }
 
 
 def test_two_process_cluster_fit(tmp_path):
@@ -124,7 +251,7 @@ def test_two_process_cluster_fit(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=150)
+            out, _ = p.communicate(timeout=240)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -133,3 +260,29 @@ def test_two_process_cluster_fit(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"proc {pid}: OK" in out, out
+
+    # cross-process results must match the parent's in-process fits
+    results = []
+    for out in outs:
+        line = next(l for l in out.splitlines() if l.startswith("RESULT "))
+        results.append(json.loads(line[len("RESULT "):]))
+    # both controllers computed identical replicated results
+    np.testing.assert_array_equal(
+        np.asarray(results[0]["centers"]), np.asarray(results[1]["centers"])
+    )
+    ref = _in_process_reference()
+    got = results[0]
+    np.testing.assert_allclose(
+        np.asarray(got["centers"]), ref["centers"], atol=1e-4
+    )
+    np.testing.assert_allclose(got["cost"], ref["cost"], rtol=1e-4)
+    np.testing.assert_array_equal(
+        np.asarray(got["counts"]), ref["counts"]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["split_feat"]), ref["split_feat"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(got["threshold"]), ref["threshold"], atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(got["value"]), ref["value"], atol=1e-4)

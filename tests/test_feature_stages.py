@@ -58,13 +58,23 @@ def test_bucketizer_validation_and_invalid_handling(hospital_table):
     bounded = ht.Bucketizer([0.0, 4.0, 6.0], "length_of_stay", "bk")
     with pytest.raises(ValueError, match="outside the split range"):
         bounded.transform(hospital_table)  # LOS exceeds 6 somewhere
-    keep = ht.Bucketizer([0.0, 4.0, 6.0], "length_of_stay", "bk", "keep")
-    out = keep.transform(hospital_table)
-    assert out.column("bk").max() == 2  # extra bucket
-    skip = ht.Bucketizer([0.0, 4.0, 6.0], "length_of_stay", "bk", "skip")
-    out2 = skip.transform(hospital_table)
-    assert len(out2) < len(hospital_table)
-    assert out2.column("bk").max() <= 1
+    # Spark semantics: handleInvalid covers NaN ONLY; out-of-range raises
+    # under EVERY mode (cover open ranges with ±inf splits instead)
+    keep_oob = ht.Bucketizer([0.0, 4.0, 6.0], "length_of_stay", "bk", "keep")
+    with pytest.raises(ValueError, match="outside the split range"):
+        keep_oob.transform(hospital_table)
+    v = np.array([0.5, np.nan, 1.5, np.nan])
+    tab_nan = ht.Table.from_dict({"v": v}, ht.Schema([("v", "float")]))
+    with pytest.raises(ValueError, match="NaN"):
+        ht.Bucketizer([0.0, 1.0, 2.0], "v", "bk").transform(tab_nan)
+    keep = ht.Bucketizer([0.0, 1.0, 2.0], "v", "bk", "keep").transform(tab_nan)
+    np.testing.assert_array_equal(keep.column("bk"), [0, 2, 1, 2])  # extra bucket
+    skip = ht.Bucketizer([0.0, 1.0, 2.0], "v", "bk", "skip").transform(tab_nan)
+    assert len(skip) == 2 and skip.column("bk").max() <= 1
+    inf_splits = ht.Bucketizer(
+        [-np.inf, 4.0, np.inf], "length_of_stay", "bk"
+    ).transform(hospital_table)
+    assert inf_splits.column("bk").max() == 1  # open range, no error
     # top boundary inclusive
     b2 = ht.Bucketizer([0.0, 1.0, 2.0], "v", "bk")
     tab = ht.Table.from_dict({"v": np.array([0.0, 1.0, 2.0])},

@@ -92,6 +92,9 @@ def test_bernoulli_nb_binarizes_at_predict(rng, mesh8):
     m = ht.NaiveBayes(model_type="bernoulli").fit((xb, y), mesh=mesh8)
     counts = xb * rng.integers(1, 40, size=xb.shape).astype(np.float32)
     np.testing.assert_array_equal(m.predict_numpy(counts), m.predict_numpy(xb))
+    # sklearn's binarize=0.0 is x > 0: negatives map to ABSENT, not present
+    neg = xb - 2.0 * (1.0 - xb)  # 1 stays 1, 0 becomes -2
+    np.testing.assert_array_equal(m.predict_numpy(neg), m.predict_numpy(xb))
 
 
 def test_bernoulli_nb_rejects_non_binary(rng, mesh8):

@@ -1,0 +1,90 @@
+"""``jax_debug_nans`` sanitizer sweep — SURVEY.md §5's race/sanitizer row.
+
+One representative tiny fit per estimator family runs with
+``jax_debug_nans=True``: any NaN escaping a jitted computation raises
+``FloatingPointError`` at dispatch instead of silently poisoning a model.
+Set ``NAN_SWEEP=0`` to skip (e.g. when bisecting unrelated failures).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("NAN_SWEEP", "1") == "0", reason="NAN_SWEEP=0"
+)
+
+
+@pytest.fixture
+def debug_nans():
+    jax.config.update("jax_debug_nans", True)
+    yield
+    jax.config.update("jax_debug_nans", False)
+
+
+@pytest.fixture
+def tiny(rng):
+    n, d = 256, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ rng.normal(size=d) + rng.normal(0, 0.1, size=n)).astype(np.float32)
+    return x, y
+
+
+def test_regressors_nan_clean(debug_nans, tiny, mesh8):
+    x, y = tiny
+    for est in (
+        ht.LinearRegression(),
+        ht.LinearRegression(reg_param=0.1, elastic_net_param=0.5),
+        ht.DecisionTreeRegressor(max_depth=3),
+        ht.RandomForestRegressor(num_trees=3, max_depth=3),
+        ht.GBTRegressor(max_iter=3, max_depth=2),
+    ):
+        m = est.fit((x, y), mesh=mesh8)
+        assert np.all(np.isfinite(np.asarray(m.predict_numpy(x))))
+
+
+def test_classifiers_nan_clean(debug_nans, tiny, mesh8):
+    x, y = tiny
+    yb = (y > np.median(y)).astype(np.float32)
+    for est in (
+        ht.LogisticRegression(max_iter=10),
+        ht.DecisionTreeClassifier(max_depth=3),
+        ht.RandomForestClassifier(num_trees=3, max_depth=3),
+        ht.GBTClassifier(max_iter=3, max_depth=2),
+        ht.NaiveBayes(model_type="gaussian"),
+    ):
+        m = est.fit((x, yb), mesh=mesh8)
+        assert np.all(np.isfinite(np.asarray(m.predict_numpy(x))))
+
+
+def test_clustering_nan_clean(debug_nans, tiny, mesh8):
+    x, _ = tiny
+    for est in (
+        ht.KMeans(k=3, max_iter=5),
+        ht.GaussianMixture(k=2, max_iter=5),
+        ht.BisectingKMeans(k=3),
+    ):
+        m = est.fit(x, mesh=mesh8)
+        assert np.all(
+            np.isfinite(np.asarray(m.predict(ht.device_dataset(x, mesh=mesh8).x)))
+        )
+
+
+def test_streaming_and_evaluators_nan_clean(debug_nans, tiny, mesh8):
+    x, y = tiny
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.streaming_kmeans import (
+        StreamingKMeans,
+    )
+
+    sk = StreamingKMeans(k=2, seed=0)
+    sk.update(x[:128], mesh=mesh8)
+    sk.update(x[128:], mesh=mesh8)
+    m = ht.LinearRegression().fit((x, y), mesh=mesh8)
+    rmse = ht.RegressionEvaluator("rmse").evaluate(
+        m.transform((x, y), mesh=mesh8)
+    )
+    assert np.isfinite(rmse)

@@ -196,3 +196,76 @@ def test_explained_variance_evaluator(rng, mesh8):
     var = ht.RegressionEvaluator("var").evaluate(pred)
     p, l = pred.to_numpy()
     np.testing.assert_allclose(var, np.mean((p - l.mean()) ** 2), rtol=1e-4)
+
+
+# ---------------------------------------------------------------- ml.stat F/KS
+def test_kolmogorov_smirnov_matches_scipy(rng, mesh8):
+    sps = pytest.importorskip("scipy.stats")
+    x = rng.normal(1.5, 2.0, size=1000).astype(np.float32)[:, None]
+    res = ht.KolmogorovSmirnovTest.test(x, "norm", mean=1.5, std=2.0, mesh=mesh8)
+    ref = sps.kstest(x[:, 0], "norm", args=(1.5, 2.0))
+    np.testing.assert_allclose(res.statistic, ref.statistic, atol=1e-6)
+    np.testing.assert_allclose(res.p_value, ref.pvalue, atol=1e-4)
+    # a wrong null is decisively rejected
+    bad = ht.KolmogorovSmirnovTest.test(x, "norm", mean=0.0, std=1.0, mesh=mesh8)
+    assert bad.p_value < 1e-6
+    # odd row count (padding) must not bias the ECDF
+    x7 = rng.normal(size=777).astype(np.float32)[:, None]
+    res7 = ht.KolmogorovSmirnovTest.test(x7, mesh=mesh8)
+    ref7 = sps.kstest(x7[:, 0], "norm")
+    np.testing.assert_allclose(res7.statistic, ref7.statistic, atol=1e-6)
+    with pytest.raises(ValueError, match="norm"):
+        ht.KolmogorovSmirnovTest.test(x, "uniform", mesh=mesh8)
+    with pytest.raises(ValueError, match="single-column"):
+        ht.KolmogorovSmirnovTest.test(rng.normal(size=(10, 2)), mesh=mesh8)
+
+
+def test_anova_matches_scipy(rng, mesh8):
+    sps = pytest.importorskip("scipy.stats")
+    n, d, k = 900, 3, 4
+    y = rng.integers(0, k, size=n)
+    x = rng.normal(size=(n, d))
+    x[:, 0] += 0.8 * y          # feature 0 depends on the class
+    res = ht.ANOVATest.test(x.astype(np.float32), y.astype(np.float32), mesh=mesh8)
+    for j in range(d):
+        groups = [x[y == c, j] for c in range(k)]
+        ref = sps.f_oneway(*groups)
+        np.testing.assert_allclose(res.f_values[j], ref.statistic, rtol=1e-4)
+        np.testing.assert_allclose(res.p_values[j], ref.pvalue, atol=1e-6)
+    assert res.p_values[0] < 1e-10 and res.p_values[1] > 1e-4
+
+
+def test_anova_fvalue_large_mean_stable(rng, mesh8):
+    """Year-column regime (mean ≫ std): uncentered f32 Σx² loses the
+    entire within-class signal — the centered stats must stay exact."""
+    sps = pytest.importorskip("scipy.stats")
+    skf = pytest.importorskip("sklearn.feature_selection")
+    n = 4000
+    y = rng.integers(0, 2, size=n)
+    x = (2026.0 + y * 0.8 + rng.normal(0, 1.0, size=n)).astype(np.float64)[:, None]
+    ra = ht.ANOVATest.test(x.astype(np.float32), y.astype(np.float32), mesh=mesh8)
+    ref = sps.f_oneway(x[y == 0, 0], x[y == 1, 0])
+    np.testing.assert_allclose(ra.f_values[0], ref.statistic, rtol=1e-3)
+    yr = (x[:, 0] - 2026.0) * 2 + rng.normal(size=n)
+    rf = ht.FValueTest.test(x.astype(np.float32), yr.astype(np.float32), mesh=mesh8)
+    f_ref, _ = skf.f_regression(x, yr)
+    np.testing.assert_allclose(rf.f_values[0], f_ref[0], rtol=1e-3)
+
+
+def test_fvalue_matches_sklearn(rng, mesh8):
+    skf = pytest.importorskip("sklearn.feature_selection")
+    n, d = 1200, 4
+    x = rng.normal(size=(n, d))
+    y = 2.0 * x[:, 0] + 0.3 * x[:, 1] + rng.normal(size=n)
+    res = ht.FValueTest.test(x.astype(np.float32), y.astype(np.float32), mesh=mesh8)
+    f_ref, p_ref = skf.f_regression(x, y)
+    np.testing.assert_allclose(res.f_values, f_ref, rtol=2e-3)
+    np.testing.assert_allclose(res.p_values, p_ref, atol=1e-5)
+    assert res.p_values[0] < 1e-20 and res.p_values[2] > 1e-4
+    # label/feature length mismatch must raise, not zero-fill
+    with pytest.raises(ValueError, match="label"):
+        ht.FValueTest.test(x.astype(np.float32), y[:-100].astype(np.float32), mesh=mesh8)
+    with pytest.raises(ValueError, match="label"):
+        ht.ANOVATest.test(
+            x.astype(np.float32), np.zeros(n - 50, np.float32), mesh=mesh8
+        )
