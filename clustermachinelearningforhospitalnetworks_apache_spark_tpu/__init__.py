@@ -83,7 +83,9 @@ from .models import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
     GaussianMixture,
+    GeneralizedLinearRegression,
     KMeans,
+    OneVsRest,
     LinearRegression,
     LogisticRegression,
     MultinomialLogisticRegressionModel,
@@ -157,6 +159,8 @@ __all__ = [
     "DecisionTreeClassifier",
     "DecisionTreeRegressor",
     "GaussianMixture",
+    "GeneralizedLinearRegression",
+    "OneVsRest",
     "GBTClassifier",
     "GBTRegressor",
     "KMeans",

@@ -71,6 +71,11 @@ class PipelineConfig:
     # plt.show() at :215,:223 — we write PNGs instead; SURVEY.md D6).
     plot_dir: str = "./data/plots"
 
+    # Tree hyper-parameters (Spark defaults, which the reference inherits
+    # implicitly by constructing estimators bare at :150-158,:183-190).
+    tree_max_depth: int = 5
+    rf_num_trees: int = 20
+
     # ------------------------------------------------------------------
     def replace(self, **kw: Any) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)

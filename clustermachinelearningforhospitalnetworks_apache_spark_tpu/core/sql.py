@@ -1,0 +1,382 @@
+"""A small SQL subset over columnar Tables — ``Session.sql``'s engine.
+
+The reference exercises exactly one SQL shape (the windowed SELECT at
+``mllearnforhospitalnetwork.py:123-128``), but it reaches it through Spark
+SQL (SURVEY.md E1), where a projection or a per-hospital GROUP BY is the
+same one-liner.  This module covers that working set with a hand-rolled
+tokenizer + recursive-descent parser + numpy columnar executor — no
+Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
+
+    SELECT [cols | agg(col) [AS alias]] FROM t
+      [WHERE <pred> {AND|OR} ...]        predicates: = != <> < <= > >=,
+                                         BETWEEN 'a' AND 'b', parentheses
+      [GROUP BY cols]                    aggs: COUNT(*) SUM AVG MIN MAX
+      [ORDER BY col [ASC|DESC]]
+      [LIMIT n]
+
+Timestamp columns compare against their literals in datetime64 space, so
+``WHERE event_time BETWEEN '2025-03-31 22:00:00' AND '…'`` matches the
+reference byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from .table import Table
+
+_TOKEN = re.compile(
+    r"\s*(?:"
+    r"(?P<str>'(?:[^']|'')*')"
+    r"|(?P<num>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
+    r"|(?P<word>[A-Za-z_][A-Za-z_0-9]*)"
+    r"|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|\*|,)"
+    r")"
+)
+
+_AGGS = {"count", "sum", "avg", "min", "max"}
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "order", "limit",
+    "and", "or", "between", "as", "asc", "desc",
+} | _AGGS
+
+
+def _tokenize(query: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    query = query.strip()  # the token regex needs a token after \s*
+    while pos < len(query):
+        m = _TOKEN.match(query, pos)
+        if not m:
+            raise ValueError(f"SQL syntax error at: {query[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.lastgroup == "str":
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "num":
+            out.append(("num", m.group("num")))
+        elif m.lastgroup == "word":
+            w = m.group("word")
+            out.append(("kw", w.lower()) if w.lower() in _KEYWORDS else ("name", w))
+        else:
+            out.append(("op", m.group("op")))
+    return out
+
+
+@dataclass
+class _SelectItem:
+    agg: str | None      # None = plain column
+    col: str | None      # None = COUNT(*)
+    alias: str
+
+
+class _Parser:
+    def __init__(self, query: str):
+        self.toks = _tokenize(query)
+        self.i = 0
+
+    def _peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
+
+    def _next(self):
+        t = self._peek()
+        self.i += 1
+        return t
+
+    def _expect(self, kind, value=None):
+        t = self._next()
+        if t[0] != kind or (value is not None and t[1] != value):
+            raise ValueError(f"SQL: expected {value or kind}, got {t[1]!r}")
+        return t
+
+    def _accept(self, kind, value=None):
+        t = self._peek()
+        if t[0] == kind and (value is None or t[1] == value):
+            self.i += 1
+            return True
+        return False
+
+    # ---- grammar ----
+    def parse(self):
+        self._expect("kw", "select")
+        items = self._select_list()
+        self._expect("kw", "from")
+        table = self._expect("name")[1]
+        where = None
+        if self._accept("kw", "where"):
+            where = self._or_cond()
+        group = []
+        if self._accept("kw", "group"):
+            self._expect("kw", "by")
+            group = [self._expect("name")[1]]
+            while self._accept("op", ","):
+                group.append(self._expect("name")[1])
+        order = None
+        if self._accept("kw", "order"):
+            self._expect("kw", "by")
+            col = self._expect("name")[1]
+            desc = False
+            if self._accept("kw", "desc"):
+                desc = True
+            else:
+                self._accept("kw", "asc")
+            order = (col, desc)
+        limit = None
+        if self._accept("kw", "limit"):
+            limit = int(self._expect("num")[1])
+        if self._peek()[0] != "eof":
+            raise ValueError(f"SQL: unexpected trailing input {self._peek()[1]!r}")
+        return items, table, where, group, order, limit
+
+    def _select_list(self):
+        if self._accept("op", "*"):
+            return None  # SELECT *
+        items = [self._select_item()]
+        while self._accept("op", ","):
+            items.append(self._select_item())
+        return items
+
+    def _select_item(self) -> _SelectItem:
+        t = self._next()
+        if t[0] == "kw" and t[1] in _AGGS:
+            agg = t[1]
+            self._expect("op", "(")
+            if self._accept("op", "*"):
+                if agg != "count":
+                    raise ValueError(f"SQL: {agg.upper()}(*) is not defined")
+                col = None
+            else:
+                col = self._expect("name")[1]
+            self._expect("op", ")")
+            alias = f"{agg}({col or '*'})"
+        elif t[0] == "name":
+            agg, col, alias = None, t[1], t[1]
+        else:
+            raise ValueError(f"SQL: expected column or aggregate, got {t[1]!r}")
+        if self._accept("kw", "as"):
+            alias = self._expect("name")[1]
+        return _SelectItem(agg, col, alias)
+
+    def _or_cond(self):
+        left = self._and_cond()
+        while self._accept("kw", "or"):
+            left = ("or", left, self._and_cond())
+        return left
+
+    def _and_cond(self):
+        left = self._pred()
+        while self._accept("kw", "and"):
+            left = ("and", left, self._pred())
+        return left
+
+    def _pred(self):
+        if self._accept("op", "("):
+            c = self._or_cond()
+            self._expect("op", ")")
+            return c
+        col = self._expect("name")[1]
+        if self._accept("kw", "between"):
+            lo = self._literal()
+            self._expect("kw", "and")
+            hi = self._literal()
+            return ("between", col, lo, hi)
+        op = self._expect("op")[1]
+        if op not in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            raise ValueError(f"SQL: unsupported operator {op!r}")
+        return ("cmp", col, "!=" if op == "<>" else op, self._literal())
+
+    def _literal(self):
+        t = self._next()
+        if t[0] == "str":
+            return t[1]
+        if t[0] == "num":
+            return float(t[1]) if ("." in t[1] or "e" in t[1].lower()) else int(t[1])
+        raise ValueError(f"SQL: expected a literal, got {t[1]!r}")
+
+
+def _coerce(col: np.ndarray, lit: Any) -> Any:
+    """Literal → the column's comparison space (datetime64 for timestamps)."""
+    if np.issubdtype(col.dtype, np.datetime64):
+        return np.datetime64(str(lit).replace(" ", "T"))
+    if np.issubdtype(col.dtype, np.number) and isinstance(lit, str):
+        return float(lit)
+    return lit
+
+
+def _eval_cond(table: Table, cond) -> np.ndarray:
+    kind = cond[0]
+    if kind == "and":
+        return _eval_cond(table, cond[1]) & _eval_cond(table, cond[2])
+    if kind == "or":
+        return _eval_cond(table, cond[1]) | _eval_cond(table, cond[2])
+    if kind == "between":
+        _, name, lo, hi = cond
+        col = table.column(name)
+        return (col >= _coerce(col, lo)) & (col <= _coerce(col, hi))
+    _, name, op, lit = cond
+    col = table.column(name)
+    v = _coerce(col, lit)
+    if op == "=":
+        return col == v
+    if op == "!=":
+        # Spark null semantics: a null row fails EVERY comparison, and
+        # numpy's NaN != x would otherwise let it through
+        return (col != v) & ~_null_mask(col)
+    return {"<": col < v, "<=": col <= v, ">": col > v, ">=": col >= v}[op]
+
+
+def _group_codes(col: np.ndarray) -> np.ndarray:
+    """Column → integer group codes with all nulls sharing one code."""
+    if np.issubdtype(col.dtype, np.datetime64):
+        # NaT views as one fixed int64, so unique collapses every null
+        return np.unique(col.astype(np.int64), return_inverse=True)[1]
+    if np.issubdtype(col.dtype, np.floating):
+        return np.unique(col, return_inverse=True, equal_nan=True)[1]
+    return np.unique(col, return_inverse=True)[1]
+
+
+def _null_mask(vals: np.ndarray) -> np.ndarray:
+    """True where a value is this engine's null (NaN / NaT)."""
+    if np.issubdtype(vals.dtype, np.floating):
+        return np.isnan(vals)
+    if np.issubdtype(vals.dtype, np.datetime64):
+        return np.isnat(vals)
+    return np.zeros(vals.shape, bool)
+
+
+def _check_agg_dtype(vals: np.ndarray, agg: str) -> None:
+    if agg in ("sum", "avg") and not np.issubdtype(vals.dtype, np.number):
+        raise ValueError(
+            f"SQL: {agg.upper()} needs a numeric column, got {vals.dtype}"
+        )
+
+
+def _aggregate(vals: np.ndarray, agg: str) -> Any:
+    """Whole-column aggregate with Spark SQL null semantics: nulls are
+    skipped; an all-null input yields null (NaN) — COUNT counts non-null."""
+    ok = vals[~_null_mask(vals)]
+    if agg == "count":
+        return len(ok)
+    _check_agg_dtype(vals, agg)
+    if ok.size == 0:
+        return np.nan
+    f = {"sum": np.sum, "avg": np.mean, "min": np.min, "max": np.max}[agg]
+    return f(ok.astype(np.float64) if np.issubdtype(ok.dtype, np.number) else ok)
+
+
+def _grouped_aggregate(src: np.ndarray, agg: str, starts, order_idx):
+    """Per-group aggregate via one sort + ``ufunc.reduceat`` — O(n), not
+    O(groups × n) boolean scans.  Null (NaN/NaT) entries are skipped,
+    all-null groups yield null (NaN/NaT — Spark semantics)."""
+    s = src[order_idx]
+    null = _null_mask(s)
+    nn = np.add.reduceat((~null).astype(np.int64), starts)
+    if agg == "count":
+        return nn
+    _check_agg_dtype(s, agg)
+    if np.issubdtype(s.dtype, np.datetime64):
+        # reduce in int64 view (np.where cannot mix float fills into
+        # datetime64); all-null groups come back as NaT
+        si = s.astype(np.int64)
+        fill = np.iinfo(np.int64).max if agg == "min" else np.iinfo(np.int64).min
+        red = np.minimum.reduceat if agg == "min" else np.maximum.reduceat
+        out = red(np.where(null, fill, si), starts).astype(s.dtype)
+        out[nn == 0] = np.datetime64("NaT")
+        return out
+    sf = s.astype(np.float64) if np.issubdtype(s.dtype, np.number) else s
+    if agg in ("sum", "avg"):
+        total = np.add.reduceat(np.where(null, 0.0, sf), starts)
+        out = total if agg == "sum" else total / np.maximum(nn, 1)
+    elif agg == "min":
+        out = np.minimum.reduceat(np.where(null, np.inf, sf), starts)
+    else:
+        out = np.maximum.reduceat(np.where(null, -np.inf, sf), starts)
+    return np.where(nn > 0, out, np.nan)
+
+
+def execute(query: str, resolve_table) -> Table:
+    """Run a query; ``resolve_table(name) -> Table`` supplies FROM."""
+    items, name, where, group, order, limit = _Parser(query).parse()
+    t: Table = resolve_table(name)
+    if where is not None:
+        t = t.mask(_eval_cond(t, where))
+
+    if group:
+        if items is None:
+            raise ValueError("SQL: GROUP BY requires an explicit select list")
+        for it in items:
+            if it.agg is None and it.col not in group:
+                raise ValueError(
+                    f"SQL: column {it.col!r} must appear in GROUP BY or an "
+                    "aggregate"
+                )
+        keys = [t.column(g) for g in group]
+        # lexicographic group ids via np.unique over a structured view of
+        # per-column integer codes — codes (not raw values) so every null
+        # (NaN/NaT) lands in ONE group, Spark's GROUP BY rule
+        packed = np.rec.fromarrays([_group_codes(k) for k in keys])
+        uniq, inv = np.unique(packed, return_inverse=True)
+        order_idx = np.argsort(inv, kind="stable")
+        sorted_inv = inv[order_idx]
+        starts = np.r_[0, np.flatnonzero(np.diff(sorted_inv)) + 1]
+        counts = np.bincount(inv, minlength=len(uniq))
+        first_row = order_idx[starts]             # one representative/group
+        cols: dict[str, Any] = {}
+        for it in items:
+            if it.agg is None:
+                cols[it.alias] = t.column(it.col)[first_row]
+            elif it.col is None:  # COUNT(*)
+                cols[it.alias] = counts.astype(np.int64)
+            else:
+                cols[it.alias] = _grouped_aggregate(
+                    t.column(it.col), it.agg, starts, order_idx
+                )
+        t = Table.from_dict(cols)
+        items = None  # already projected to aliases
+    elif items is not None and any(it.agg is not None for it in items):
+        # whole-table aggregates collapse to one row — a bare column in the
+        # same list has no single value (Spark requires GROUP BY too)
+        for it in items:
+            if it.agg is None:
+                raise ValueError(
+                    f"SQL: column {it.col!r} cannot mix with aggregates "
+                    "without GROUP BY"
+                )
+        t = Table.from_dict(
+            {
+                it.alias: np.asarray(
+                    [len(t) if it.col is None else _aggregate(t.column(it.col), it.agg)]
+                )
+                for it in items
+            }
+        )
+        items = None  # already projected
+
+    if order is not None and len(t) > 0:
+        col, desc = order
+        # order BEFORE projection so ORDER BY may reference any source
+        # column (legal SQL); a SELECT alias resolves to its source here,
+        # and grouped results order by their output columns
+        if col not in t.columns and items is not None:
+            col = {it.alias: it.col for it in items}.get(col, col)
+        if col not in t.columns:
+            raise ValueError(
+                f"SQL: ORDER BY column {col!r} is not in the "
+                f"{'grouped result' if group else 'table'}"
+            )
+        idx = np.argsort(t.column(col), kind="stable")
+        if desc:
+            idx = idx[::-1]
+        t = t.mask(idx)  # integer fancy-indexing permutes every column
+    if items is not None:
+        # plain projection, applied after ORDER BY so sorting may use any
+        # source column; aliases materialize here
+        missing = [it.col for it in items if it.col not in t.columns]
+        if missing:
+            raise ValueError(f"SQL: unknown column {missing[0]!r}")
+        t = Table.from_dict({it.alias: t.column(it.col) for it in items})
+    if limit is not None:
+        t = t.limit(limit)
+    return t

@@ -3,9 +3,10 @@
 Parity with ``pyspark.ml.feature.Bucketizer``: ``splits`` is a strictly
 increasing list of n+1 boundaries defining n buckets; values land in
 ``[splits[i], splits[i+1])`` (the last bucket is closed on both ends).
-``handle_invalid``: "error" raises on out-of-range/NaN, "keep" routes them
-to an extra bucket n, "skip" drops the rows — the same vocabulary as
-StringIndexer.
+``handle_invalid`` covers **NaN only** (Spark semantics): "error" raises,
+"keep" routes NaN to an extra bucket n, "skip" drops those rows.  A
+non-NaN value outside the split range ALWAYS raises, under every mode —
+cover open ranges with ±inf boundary splits, exactly as in Spark.
 """
 
 from __future__ import annotations

@@ -7,7 +7,9 @@ from .logistic_regression import (
 )
 from .kmeans import KMeans, KMeansModel
 from .naive_bayes import NaiveBayes, NaiveBayesModel
+from .glm import GeneralizedLinearRegression, GeneralizedLinearRegressionModel
 from .gmm import GaussianMixture, GaussianMixtureModel
+from .one_vs_rest import OneVsRest, OneVsRestModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
 from .streaming_kmeans import StreamingKMeans, StreamingKMeansModel
 from .tree import (
@@ -27,6 +29,10 @@ __all__ = [
     "Model",
     "PredictionResult",
     "as_device_dataset",
+    "GeneralizedLinearRegression",
+    "GeneralizedLinearRegressionModel",
+    "OneVsRest",
+    "OneVsRestModel",
     "LinearRegression",
     "LinearRegressionModel",
     "LogisticRegression",

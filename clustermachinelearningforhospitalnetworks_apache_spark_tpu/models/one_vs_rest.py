@@ -1,0 +1,146 @@
+"""OneVsRest — multiclass reduction over any binary classifier.
+
+Parity with ``pyspark.ml.classification.OneVsRest``: fit one binary
+model per class (label == c → 1), predict by the highest per-class
+confidence.  Spark runs the k fits as k sequential MLlib jobs; here each
+is one of this framework's sharded fits, and the *scoring* side stays on
+the mesh — all k models score in one pass and the argmax never leaves the
+device.
+
+Works with any classifier whose model exposes ``predict_proba`` (the
+class-1 column is the confidence) or, failing that, ``predict_raw``
+(margin).  Persists as a composite artifact (one sub-directory per class
+model), the same layout machinery as PipelineModel/CrossValidatorModel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import (
+    METADATA_FILE,
+    load_model,
+    prepare_artifact_dir,
+    register_composite,
+    save_model,
+    validate_persistable,
+    write_metadata,
+)
+from ..parallel.sharding import DeviceDataset
+from ..version import __version__
+from .base import Estimator, Model, as_device_dataset
+
+_OVR_CLASS = "OneVsRestModel"
+
+
+def _confidence(model: Any, x: jax.Array) -> jax.Array:
+    """(n,) class-1 confidence from whatever surface the model has."""
+    if hasattr(model, "predict_proba"):
+        p = model.predict_proba(x)
+        return p[:, 1] if p.ndim == 2 else p
+    if hasattr(model, "predict_raw"):
+        r = model.predict_raw(x)
+        return r[:, 1] if r.ndim == 2 else r
+    raise TypeError(
+        f"{type(model).__name__} exposes neither predict_proba nor "
+        "predict_raw; OneVsRest needs a per-class confidence"
+    )
+
+
+@dataclass
+class OneVsRestModel(Model):
+    models: tuple[Any, ...]          # one binary model per class, in order
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.models)
+
+    def predict_raw(self, x: jax.Array) -> jax.Array:
+        """(n, k) per-class confidences — one device pass per class, no
+        host round trips between classes."""
+        return jnp.stack([_confidence(m, x) for m in self.models], axis=1)
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        return jnp.argmax(self.predict_raw(x), axis=1).astype(jnp.float32)
+
+    # persistence (composite: one sub-artifact per class) ----------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        for i, m in enumerate(self.models):
+            validate_persistable(m, label=f"class {i} model")
+        prepare_artifact_dir(path, overwrite)
+        os.makedirs(os.path.join(path, "models"))
+        dirs = []
+        for i, m in enumerate(self.models):
+            name, meta, arrays = m._artifacts()
+            d = f"{i}_{name}"
+            save_model(os.path.join(path, "models", d), name, meta, arrays)
+            dirs.append(d)
+        write_metadata(
+            path,
+            {
+                "model_class": _OVR_CLASS,
+                "framework_version": __version__,
+                "model_dirs": dirs,
+            },
+        )
+
+    @classmethod
+    def load(cls, path: str, _meta: dict | None = None) -> "OneVsRestModel":
+        if _meta is None:
+            with open(os.path.join(path, METADATA_FILE)) as f:
+                _meta = json.load(f)
+        return cls(
+            tuple(
+                load_model(os.path.join(path, "models", d))
+                for d in _meta["model_dirs"]
+            )
+        )
+
+
+@dataclass(frozen=True)
+class OneVsRest(Estimator):
+    classifier: Any = None            # a BINARY classifier estimator
+    label_col: str = "LOS_binary"
+    features_col: str = "features"
+    weight_col: str | None = None
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> OneVsRestModel:
+        if self.classifier is None:
+            raise ValueError("OneVsRest needs a classifier estimator")
+        ds = as_device_dataset(
+            data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
+        )
+        y_host = np.asarray(jax.device_get(ds.y))
+        w_host = np.asarray(jax.device_get(ds.w))
+        if not np.any(w_host > 0):
+            raise ValueError("OneVsRest fit on an empty dataset")
+        k = int(y_host[w_host > 0].max()) + 1
+        if k < 2:
+            raise ValueError("OneVsRest needs at least 2 classes")
+        if getattr(self.classifier, "weight_col", None) is not None:
+            raise ValueError(
+                "set weight_col on OneVsRest itself, not the inner "
+                "classifier (the one-vs-all DeviceDataset already carries "
+                "the weights)"
+            )
+        models = []
+        for c in range(k):
+            # one-vs-all labels baked into the DeviceDataset; the inner
+            # estimator's label_col is ignored for DeviceDataset inputs
+            yc = (ds.y == float(c)).astype(jnp.float32)
+            sub = DeviceDataset(x=ds.x, y=yc, w=ds.w)
+            models.append(self.classifier.fit(sub, mesh=mesh))
+        return OneVsRestModel(tuple(models))
+
+
+register_composite(
+    _OVR_CLASS,
+    "clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.one_vs_rest:OneVsRestModel",
+)

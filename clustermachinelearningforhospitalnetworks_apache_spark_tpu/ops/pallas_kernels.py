@@ -33,9 +33,15 @@ VPU-chain/overhead-bound, not matmul-precision-bound (DEFAULT-precision
 matmuls measure *slower*, 83M).  At d=8 the workload is too skinny for a
 hand-scheduled win — XLA's fusion already keeps the (rows, k)
 intermediates out of HBM inside the scan body.  The kernels therefore stay
-**opt-in** (``use_pallas=True``): correct, TPU-compiled, parity-tested,
-and the starting point for wide-d workloads where the fused accumulation
-should pay off.
+**opt-in** (``use_pallas=True``): correct, TPU-compiled, parity-tested.
+
+**Win-or-retire decision record (SURVEY §3.3):** the d=8 verdict above is
+the measured decision for the BASELINE shape — XLA owns the skinny-d
+loop.  The remaining open shape is wide-d (d≥64), where the fused VMEM
+accumulation cuts the (rows, k)+(rows, d) HBM traffic most; the
+``pallas_ab`` config in ``bench.py`` A/Bs exactly that (k=64, d=64) on
+every driver sweep, so each round's BENCH artifact records the current
+kernel-vs-XLA ratio on real hardware (``vs_baseline`` > 1 = kernel wins).
 """
 
 from __future__ import annotations

@@ -132,10 +132,13 @@ def _run(
 
     # §7: three regressors + RMSE (:146-169)
     reg_eval = RegressionEvaluator("rmse", label_col=LABEL_COL)
+    depth, ntrees = cfg.tree_max_depth, cfg.rf_num_trees
     regressors = {
         "LinearRegression": LinearRegression(),
-        "DecisionTreeRegressor": DecisionTreeRegressor(),
-        "RandomForestRegressor": RandomForestRegressor(),
+        "DecisionTreeRegressor": DecisionTreeRegressor(max_depth=depth),
+        "RandomForestRegressor": RandomForestRegressor(
+            max_depth=depth, num_trees=ntrees
+        ),
     }
     reg_models: dict[str, Any] = {}
     rmse: dict[str, float] = {}
@@ -154,8 +157,10 @@ def _run(
     # §8: two classifiers on the pre-binarized label + accuracy (:176-198)
     cls_eval = MulticlassClassificationEvaluator("accuracy", label_col="LOS_binary")
     classifiers = {
-        "DecisionTreeClassifier": DecisionTreeClassifier(),
-        "RandomForestClassifier": RandomForestClassifier(),
+        "DecisionTreeClassifier": DecisionTreeClassifier(max_depth=depth),
+        "RandomForestClassifier": RandomForestClassifier(
+            max_depth=depth, num_trees=ntrees
+        ),
     }
     cls_models: dict[str, Any] = {}
     accuracy: dict[str, float] = {}

@@ -116,27 +116,14 @@ class Session:
             raise KeyError(f"unknown table {name!r}; registered: {sorted(self._tables)}")
         return t.read() if isinstance(t, UnboundedTable) else t
 
-    _SQL_WINDOW = re.compile(
-        r"^\s*SELECT\s+\*\s+FROM\s+(\w+)\s+WHERE\s+(\w+)\s+BETWEEN\s+"
-        r"'([^']+)'\s+AND\s+'([^']+)'\s*$",
-        re.IGNORECASE,
-    )
-
     def sql(self, query: str) -> Table:
-        """The reference's one SQL shape — windowed SELECT (:123-128).
+        """SQL over registered tables (``core/sql.py``) — a real parsed
+        subset, not just the reference's windowed SELECT (:123-128):
+        projections, aggregates (COUNT/SUM/AVG/MIN/MAX), WHERE with
+        AND/OR/BETWEEN/comparisons, GROUP BY, ORDER BY, LIMIT."""
+        from .core.sql import execute
 
-        Anything beyond ``SELECT * FROM t WHERE col BETWEEN 'a' AND 'b'``
-        should use the Table API directly; the error says so.
-        """
-        m = self._SQL_WINDOW.match(query)
-        if not m:
-            raise ValueError(
-                "only the windowed form \"SELECT * FROM <table> WHERE <col> "
-                "BETWEEN '<start>' AND '<end>'\" is supported; use the Table "
-                "API (filter/between/select) for anything richer"
-            )
-        name, col, start, end = m.groups()
-        return self.table(name).between(col, start, end)
+        return execute(query, self.table)
 
     # streaming read ----------------------------------------------------
     @property

@@ -241,14 +241,21 @@ class FTestResult:
 
 def _padded_labels(ds, y: np.ndarray, test_name: str):
     """Zero-pad labels to the padded row count, refusing a silent length
-    mismatch: a label vector shorter than the valid rows would count real
-    feature rows under label 0 and corrupt the statistics."""
-    n_valid = int(np.sum(np.asarray(jax.device_get(ds.w)) > 0))
-    if y.shape[0] not in (n_valid, ds.n_padded):
+    mismatch.  Labels align POSITIONALLY with the first ``len(y)`` rows;
+    that is only sound when no valid (w>0) row lies beyond them — a label
+    vector that stops short of a valid row would count that row under
+    label 0 (or shift every later label) and corrupt the statistics."""
+    if y.shape[0] > ds.n_padded:
         raise ValueError(
-            f"{test_name}: labels have {y.shape[0]} rows but features have "
-            f"{n_valid} valid rows (padded {ds.n_padded}) — pass one label "
-            "per feature row"
+            f"{test_name}: {y.shape[0]} labels exceed the padded row count "
+            f"{ds.n_padded}"
+        )
+    w_host = np.asarray(jax.device_get(ds.w))
+    if np.any(w_host[y.shape[0]:] > 0):
+        last = int(np.flatnonzero(w_host > 0).max()) + 1
+        raise ValueError(
+            f"{test_name}: labels have {y.shape[0]} rows but valid feature "
+            f"rows extend to row {last} — pass one label per feature row"
         )
     yp = np.zeros((ds.n_padded,), np.float32)
     yp[: y.shape[0]] = y
@@ -302,7 +309,12 @@ class ANOVATest:
         gmean = s1.sum(axis=0) / n                            # (d,)
         ss_between = (counts[:, None] * (mean_c - gmean[None, :]) ** 2).sum(axis=0)
         ss_within = (s2 - counts[:, None] * mean_c**2).sum(axis=0)
-        df_b, df_w = k - 1, n - k
+        # degrees of freedom count OBSERVED classes — absent/non-contiguous
+        # label ids must not inflate df_between (scipy counts groups too)
+        k_eff = int((counts > 0).sum())
+        if k_eff < 2:
+            raise ValueError("ANOVA needs at least 2 observed label classes")
+        df_b, df_w = k_eff - 1, n - k_eff
         with np.errstate(invalid="ignore", divide="ignore"):
             f = (ss_between / df_b) / (ss_within / max(df_w, 1e-12))
         try:

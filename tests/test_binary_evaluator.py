@@ -10,6 +10,9 @@ from clustermachinelearningforhospitalnetworks_apache_spark_tpu.evaluation impor
 )
 
 
+pytestmark = pytest.mark.fast
+
+
 def test_roc_auc_matches_sklearn(rng):
     from sklearn.metrics import roc_auc_score
 

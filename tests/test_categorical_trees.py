@@ -41,6 +41,7 @@ def _best_subset_sse(c, y, arity):
     return best
 
 
+@pytest.mark.fast
 class TestCategoricalRegression:
     def test_depth1_matches_exhaustive_subset_search(self, mesh8, rng):
         arity = 6

@@ -20,6 +20,7 @@ def _blobs(rng, n=600, k=3, d=4, spread=0.2, scale=4.0):
 
 
 # ---------------------------------------------------------------- GMM
+@pytest.mark.fast
 def test_gmm_recovers_components(rng, mesh8):
     x, labels, true_centers = _blobs(rng)
     model = GaussianMixture(k=3, seed=0).fit(x, mesh=mesh8)

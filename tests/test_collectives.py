@@ -3,6 +3,7 @@ analogue) and the hybrid DCN+ICI mesh builder — both consumed by real
 paths (RegressionEvaluator's sharded reduction; multi-host mesh layout)."""
 
 import numpy as np
+import pytest
 
 import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.collectives import (
@@ -16,6 +17,9 @@ from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh im
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
     device_dataset,
 )
+
+
+pytestmark = pytest.mark.fast
 
 
 def test_tree_aggregate_matches_host_sum(rng, mesh8):

@@ -8,6 +8,7 @@ from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.split impor
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.config import PipelineConfig
 
 
+@pytest.mark.fast
 def test_schema_roundtrip():
     s = ht.hospital_event_schema()
     assert len(s) == 7
@@ -23,6 +24,7 @@ def test_schema_roundtrip():
     ]
 
 
+@pytest.mark.fast
 def test_table_basics(hospital_table):
     t = hospital_table
     assert t.num_rows == 400

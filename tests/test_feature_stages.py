@@ -15,6 +15,7 @@ from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.model_io impo
 
 
 # ------------------------------------------------------------ MinMax
+@pytest.mark.fast
 def test_minmax_matches_sklearn(rng, mesh8):
     sk = pytest.importorskip("sklearn.preprocessing")
     x = rng.normal(size=(500, 4)).astype(np.float32) * [1, 10, 0.1, 5]
@@ -50,6 +51,7 @@ def test_bucketizer(hospital_table):
     np.testing.assert_array_equal(out.column("los_bucket"), expect)
 
 
+@pytest.mark.fast
 def test_bucketizer_validation_and_invalid_handling(hospital_table):
     with pytest.raises(ValueError, match="strictly increasing"):
         ht.Bucketizer([0.0, 0.0, 1.0], "a", "b")

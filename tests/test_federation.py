@@ -4,6 +4,7 @@ shard placement, shard locality, and fit-equality with the unpartitioned
 layout."""
 
 import numpy as np
+import pytest
 
 import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (
@@ -36,6 +37,7 @@ def test_placement_deterministic_and_balanced(rng):
     assert load.max() <= load.mean() + counts[1].max()
 
 
+@pytest.mark.fast
 def test_hospital_rows_land_on_one_shard(rng, mesh8):
     x, y, ids = _hospital_data(rng)
     fd = federated_dataset(x, ids, y, mesh=mesh8)

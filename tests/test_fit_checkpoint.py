@@ -28,6 +28,7 @@ def _blobs(rng, n=800, k=4, d=5, spread=0.3):
 # --- FitCheckpointer unit tier -----------------------------------------
 
 
+@pytest.mark.fast
 def test_roundtrip_and_prune(tmp_path):
     ck = FitCheckpointer(str(tmp_path / "ck"), {"a": 1}, keep=2)
     assert ck.resume() is None

@@ -144,6 +144,7 @@ def test_gbt_classifier(rng, mesh8):
         ht.GBTClassifier(max_iter=2).fit((x, y), mesh=mesh8)  # continuous labels
 
 
+@pytest.mark.fast
 def test_gbt_persistence_and_pipeline(hospital_table, mesh8, tmp_path):
     pipe = ht.Pipeline(
         [ht.VectorAssembler(ht.FEATURE_COLS),

@@ -1,0 +1,154 @@
+"""GeneralizedLinearRegression (sharded IRLS) and OneVsRest.
+
+GLM parity targets: gaussian ≡ the WLS LinearRegression; binomial ≡ this
+framework's own Newton logistic fit; poisson/gamma vs sklearn's
+PoissonRegressor/GammaRegressor (log link, unpenalized).
+"""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+class TestGLM:
+    @pytest.mark.fast
+    def test_gaussian_equals_wls(self, rng, mesh8):
+        n, d = 2000, 4
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        beta = rng.normal(size=d)
+        y = (x @ beta + 1.0 + 0.1 * rng.normal(size=n)).astype(np.float32)
+        glm = ht.GeneralizedLinearRegression(family="gaussian").fit(
+            (x, y), mesh=mesh8
+        )
+        wls = ht.LinearRegression().fit((x, y), mesh=mesh8)
+        np.testing.assert_allclose(
+            glm.coefficients, np.asarray(wls.coefficients), rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            glm.intercept, float(wls.intercept), rtol=1e-4, atol=1e-4
+        )
+
+    def test_binomial_equals_logistic(self, rng, mesh8):
+        n, d = 3000, 3
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        p = 1 / (1 + np.exp(-(x @ [1.0, -2.0, 0.5] + 0.3)))
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        glm = ht.GeneralizedLinearRegression(family="binomial").fit(
+            (x, y), mesh=mesh8
+        )
+        logit = ht.LogisticRegression(max_iter=50).fit((x, y), mesh=mesh8)
+        np.testing.assert_allclose(
+            glm.coefficients, np.asarray(logit.coefficients), rtol=2e-3, atol=2e-3
+        )
+        # mean prediction is a probability
+        mu = np.asarray(glm.predict_numpy(x))
+        assert np.all((mu >= 0) & (mu <= 1))
+
+    def test_poisson_matches_sklearn(self, rng, mesh8):
+        sklm = pytest.importorskip("sklearn.linear_model")
+        n, d = 4000, 3
+        x = rng.normal(0, 0.5, size=(n, d)).astype(np.float32)
+        rate = np.exp(x @ [0.8, -0.5, 0.3] + 0.7)
+        y = rng.poisson(rate).astype(np.float32)
+        glm = ht.GeneralizedLinearRegression(family="poisson").fit(
+            (x, y), mesh=mesh8
+        )
+        ref = sklm.PoissonRegressor(alpha=0.0, max_iter=300).fit(x, y)
+        np.testing.assert_allclose(glm.coefficients, ref.coef_, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(glm.intercept, ref.intercept_, rtol=2e-3, atol=2e-3)
+
+    def test_gamma_log_link_matches_sklearn(self, rng, mesh8):
+        sklm = pytest.importorskip("sklearn.linear_model")
+        n, d = 4000, 2
+        x = rng.normal(0, 0.4, size=(n, d)).astype(np.float32)
+        mu = np.exp(x @ [0.6, -0.4] + 1.0)
+        y = rng.gamma(shape=4.0, scale=mu / 4.0).astype(np.float32)
+        glm = ht.GeneralizedLinearRegression(family="gamma", link="log").fit(
+            (x, y), mesh=mesh8
+        )
+        ref = sklm.GammaRegressor(alpha=0.0, max_iter=300).fit(x, y)
+        np.testing.assert_allclose(glm.coefficients, ref.coef_, rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(glm.intercept, ref.intercept_, rtol=5e-3)
+
+    def test_deviance_and_link_prediction(self, rng, mesh8):
+        x = rng.normal(size=(500, 2)).astype(np.float32)
+        y = rng.poisson(np.exp(0.5 * x[:, 0])).astype(np.float32)
+        m = ht.GeneralizedLinearRegression(family="poisson").fit((x, y), mesh=mesh8)
+        assert np.isfinite(m.deviance) and m.deviance >= 0
+        eta = np.asarray(m.predict_link(ht.device_dataset(x, mesh=mesh8).x))
+        mu = np.asarray(m.predict(ht.device_dataset(x, mesh=mesh8).x))
+        np.testing.assert_allclose(np.exp(eta), mu, rtol=1e-5)
+
+    def test_round_trip_and_validation(self, rng, mesh8, tmp_path):
+        x = np.abs(rng.normal(size=(256, 2))).astype(np.float32) + 0.1
+        y = (x[:, 0] * 2 + 0.5).astype(np.float32)
+        m = ht.GeneralizedLinearRegression(family="gamma").fit((x, y), mesh=mesh8)
+        m.write().overwrite().save(str(tmp_path / "glm"))
+        back = ht.load_model(str(tmp_path / "glm"))
+        np.testing.assert_allclose(back.predict_numpy(x), m.predict_numpy(x))
+        assert back.family == "gamma" and back.link == "inverse"
+        with pytest.raises(ValueError, match="family"):
+            ht.GeneralizedLinearRegression(family="tweedie").fit((x, y), mesh=mesh8)
+        with pytest.raises(ValueError, match="link"):
+            ht.GeneralizedLinearRegression(family="binomial", link="log").fit(
+                (x, (y > 1).astype(np.float32)), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="0/1"):
+            ht.GeneralizedLinearRegression(family="binomial").fit((x, y), mesh=mesh8)
+        with pytest.raises(ValueError, match="positive"):
+            ht.GeneralizedLinearRegression(family="gamma").fit(
+                (x, y - 10.0), mesh=mesh8
+            )
+        # gaussian + log link: log(y<=0) would silently NaN the fit
+        with pytest.raises(ValueError, match="positive"):
+            ht.GeneralizedLinearRegression(family="gaussian", link="log").fit(
+                (x, y - 10.0), mesh=mesh8
+            )
+
+
+class TestOneVsRest:
+    def test_multiclass_with_logistic(self, rng, mesh8):
+        n = 1500
+        centers = np.array([[0, 0], [4, 0], [0, 4]], np.float32)
+        y = rng.integers(0, 3, size=n)
+        x = (centers[y] + rng.normal(0, 0.8, size=(n, 2))).astype(np.float32)
+        ovr = ht.OneVsRest(classifier=ht.LogisticRegression(max_iter=20)).fit(
+            (x, y.astype(np.float32)), mesh=mesh8
+        )
+        assert ovr.num_classes == 3
+        pred = np.asarray(ovr.predict_numpy(x))
+        assert (pred == y).mean() > 0.95
+        # agrees with the native multinomial softmax fit on easy data
+        mlr = ht.LogisticRegression(family="multinomial", max_iter=20).fit(
+            (x, y.astype(np.float32)), mesh=mesh8
+        )
+        agree = (pred == np.asarray(mlr.predict_numpy(x))).mean()
+        assert agree > 0.97
+
+    def test_with_tree_classifier_and_round_trip(self, rng, mesh8, tmp_path):
+        n = 900
+        y = rng.integers(0, 3, size=n)
+        x = (y[:, None] * 2.0 + rng.normal(0, 0.4, size=(n, 2))).astype(np.float32)
+        ovr = ht.OneVsRest(
+            classifier=ht.DecisionTreeClassifier(max_depth=3)
+        ).fit((x, y.astype(np.float32)), mesh=mesh8)
+        pred = np.asarray(ovr.predict_numpy(x))
+        assert (pred == y).mean() > 0.95
+        ovr.write().overwrite().save(str(tmp_path / "ovr"))
+        back = ht.load_model(str(tmp_path / "ovr"))
+        np.testing.assert_array_equal(back.predict_numpy(x), pred)
+        assert back.num_classes == 3
+
+    def test_validation(self, rng, mesh8):
+        x = rng.normal(size=(64, 2)).astype(np.float32)
+        with pytest.raises(ValueError, match="classifier"):
+            ht.OneVsRest().fit((x, np.zeros(64, np.float32)), mesh=mesh8)
+        with pytest.raises(ValueError, match="2 classes"):
+            ht.OneVsRest(classifier=ht.LogisticRegression()).fit(
+                (x, np.zeros(64, np.float32)), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="weight_col"):
+            ht.OneVsRest(
+                classifier=ht.LogisticRegression(weight_col="w")
+            ).fit((x, np.array([0.0, 1.0] * 32, np.float32)), mesh=mesh8)

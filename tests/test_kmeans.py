@@ -16,6 +16,7 @@ def _blobs(rng, n=600, k=4, d=5, spread=0.15):
     return x.astype(np.float64), labels, centers
 
 
+@pytest.mark.fast
 def test_kmeans_recovers_blobs(rng, mesh8):
     x, labels, true_centers = _blobs(rng)
     model = KMeans(k=4, seed=0).fit(x, mesh=mesh8)

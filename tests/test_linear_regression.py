@@ -1,6 +1,7 @@
 """LinearRegression: closed-form parity vs numpy lstsq; sharded == single-device."""
 
 import numpy as np
+import pytest
 
 import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import LinearRegression
@@ -14,6 +15,7 @@ def _xy(rng, n=200, d=4):
     return x, y, w_true
 
 
+@pytest.mark.fast
 def test_lr_matches_lstsq(rng, mesh8):
     x, y, w_true = _xy(rng)
     model = LinearRegression().fit((x, y), mesh=mesh8)

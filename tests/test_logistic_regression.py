@@ -21,6 +21,7 @@ def _logit_data(rng, n=2000, d=4):
     return x, y, true_w
 
 
+@pytest.mark.fast
 def test_matches_sklearn_unregularized(rng, mesh8):
     from sklearn.linear_model import LogisticRegression as SK
 

@@ -14,6 +14,7 @@ def _split(table):
     return ht.train_test_split(table, 0.7, 42)
 
 
+@pytest.mark.fast
 def test_supervised_pipeline_matches_manual_chain(hospital_table, mesh8):
     train, test = _split(hospital_table)
     pipe = ht.Pipeline(

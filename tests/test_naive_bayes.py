@@ -8,6 +8,9 @@ import pytest
 import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
 
 
+pytestmark = pytest.mark.fast
+
+
 def test_multinomial_nb_matches_sklearn(rng, mesh8):
     sknb = pytest.importorskip("sklearn.naive_bayes")
     # count-like features from two different multinomial profiles

@@ -42,6 +42,9 @@ H02,2025-03-31 22:00:02.500,2,80,0,1.20,3.0
 """
 
 
+pytestmark = pytest.mark.fast
+
+
 @pytest.fixture
 def csv_file(tmp_path):
     p = tmp_path / "events.csv"

@@ -24,6 +24,7 @@ def _reg_data(rng, n=2000, d=6):
 # --- elastic net -------------------------------------------------------
 
 
+@pytest.mark.fast
 def test_lasso_matches_sklearn(rng, mesh8):
     sk = pytest.importorskip("sklearn.linear_model")
     x, y, _ = _reg_data(rng)

@@ -31,6 +31,7 @@ def _int_blobs(n, d, k, seed=0):
     return x.astype(np.float32)
 
 
+@pytest.mark.fast
 class TestHostDataset:
     def test_block_shape_and_iteration(self, mesh8):
         hd = HostDataset(x=np.ones((1000, 4), np.float32), max_device_rows=256)

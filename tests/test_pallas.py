@@ -25,6 +25,7 @@ def _data(n=1000, d=5, k=16, seed=0):
     return x, centers
 
 
+@pytest.mark.fast
 def test_fused_assign_matches_xla():
     x, centers = _data()
     a_ref, d2_ref = assign_clusters(jnp.asarray(x), jnp.asarray(centers))

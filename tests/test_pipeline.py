@@ -93,9 +93,14 @@ def test_full_pipeline(pipeline_cfg):
 
 
 def test_pipeline_resume_is_idempotent(pipeline_cfg):
-    """Re-running over the same checkpoint must not duplicate table rows."""
-    r1 = run_pipeline(pipeline_cfg, make_plots=False, save_models=False)
-    r2 = run_pipeline(pipeline_cfg, make_plots=False, save_models=False)
+    """Re-running over the same checkpoint must not duplicate table rows.
+
+    Tiny trees: this asserts STREAM-RESUME semantics, not model quality —
+    test_full_pipeline covers the reference's default hyper-parameters,
+    and small trees skip ~2 min of per-level compile on the 1-core CI."""
+    cfg = pipeline_cfg.replace(tree_max_depth=2, rf_num_trees=2)
+    r1 = run_pipeline(cfg, make_plots=False, save_models=False)
+    r2 = run_pipeline(cfg, make_plots=False, save_models=False)
     assert r1.training_rows == r2.training_rows
 
 
@@ -116,8 +121,10 @@ def test_session_sql_and_builder(tmp_path):
         "'2025-01-01 00:02:00' AND '2025-01-01 00:05:00'"
     )
     assert out.num_rows == 4
-    with pytest.raises(ValueError):
-        spark.sql("SELECT count(*) FROM events")
+    # aggregates are real SQL now (core/sql.py), not an error
+    assert spark.sql("SELECT count(*) AS n FROM events").column("n")[0] == 10
+    with pytest.raises(ValueError, match="SQL"):
+        spark.sql("SELECT * FROM events JOIN other")  # unsupported form
     with pytest.raises(KeyError):
         spark.table("nope")
     spark.stop()
@@ -169,8 +176,9 @@ def test_session_get_or_create_reuses_active(tmp_path):
 
 def test_run_pipeline_uses_session_config(pipeline_cfg):
     """run_pipeline(session=...) without config must honor the session's
-    config (regression: it silently used defaults)."""
-    spark = Session(pipeline_cfg)
+    config (regression: it silently used defaults).  Tiny trees — config
+    plumbing is the subject, not model quality."""
+    spark = Session(pipeline_cfg.replace(tree_max_depth=2, rf_num_trees=2))
     result = run_pipeline(session=spark, make_plots=False, save_models=False)
     assert result.training_rows > 0
     spark.stop()

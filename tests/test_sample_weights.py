@@ -20,6 +20,7 @@ def _weighted_problem(rng, n=800, d=4):
     return x, y, w, x[rep], y[rep]
 
 
+@pytest.mark.fast
 def test_linear_regression_weight_equals_duplication(rng, mesh8):
     x, y, w, xd, yd = _weighted_problem(rng)
     m_w = ht.LinearRegression().fit((x, y, w), mesh=mesh8)

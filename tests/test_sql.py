@@ -1,0 +1,183 @@
+"""Session.sql — the core/sql.py subset (SURVEY.md E1's Spark SQL row).
+
+The reference runs one windowed SELECT (``mllearnforhospitalnetwork.py:
+123-128``); Spark SQL makes projections and per-hospital GROUP BYs the
+same one-liner, so the engine must not fall off a cliff beyond that shape.
+"""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import execute
+
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture
+def session(hospital_table):
+    s = ht.Session.builder.app_name("sql-test").get_or_create()
+    s.register_table("events", hospital_table)
+    yield s
+    s.stop()
+
+
+def test_reference_windowed_select(session, hospital_table):
+    """The exact reference query shape — byte-for-byte parity target."""
+    out = session.sql(
+        "SELECT * FROM events WHERE event_time BETWEEN "
+        "'2025-03-31 22:00:00' AND '2025-03-31 22:03:00'"
+    )
+    ref = hospital_table.between(
+        "event_time", "2025-03-31 22:00:00", "2025-03-31 22:03:00"
+    )
+    assert len(out) == len(ref) > 0
+    np.testing.assert_array_equal(
+        out.column("length_of_stay"), ref.column("length_of_stay")
+    )
+
+
+def test_projection_and_comparisons(session, hospital_table):
+    out = session.sql(
+        "SELECT hospital_id, length_of_stay FROM events "
+        "WHERE length_of_stay > 5.0 AND admission_count <= 30"
+    )
+    m = (hospital_table.column("length_of_stay") > 5.0) & (
+        hospital_table.column("admission_count") <= 30
+    )
+    assert len(out) == int(m.sum())
+    assert set(f.name for f in out.schema.fields) == {"hospital_id", "length_of_stay"}
+
+
+def test_or_parens_and_equality(session, hospital_table):
+    out = session.sql(
+        "SELECT * FROM events WHERE hospital_id = 'H00' "
+        "OR (hospital_id = 'H01' AND length_of_stay < 4)"
+    )
+    hid = hospital_table.column("hospital_id")
+    los = hospital_table.column("length_of_stay")
+    expect = (hid == "H00") | ((hid == "H01") & (los < 4))
+    assert len(out) == int(expect.sum())
+
+
+def test_group_by_aggregates(session, hospital_table):
+    out = session.sql(
+        "SELECT hospital_id, COUNT(*) AS n, AVG(length_of_stay) AS mean_los, "
+        "MAX(emergency_visits) AS worst FROM events GROUP BY hospital_id "
+        "ORDER BY hospital_id"
+    )
+    hid = hospital_table.column("hospital_id")
+    los = hospital_table.column("length_of_stay")
+    ev = hospital_table.column("emergency_visits")
+    hospitals = np.unique(hid)
+    np.testing.assert_array_equal(out.column("hospital_id"), hospitals)
+    for i, h in enumerate(hospitals):
+        sel = hid == h
+        assert out.column("n")[i] == sel.sum()
+        np.testing.assert_allclose(out.column("mean_los")[i], los[sel].mean())
+        assert out.column("worst")[i] == ev[sel].max()
+
+
+def test_whole_table_aggregate_and_limit(session, hospital_table):
+    out = session.sql("SELECT COUNT(*) AS n, SUM(admission_count) AS s FROM events")
+    assert len(out) == 1
+    assert out.column("n")[0] == len(hospital_table)
+    assert out.column("s")[0] == hospital_table.column("admission_count").sum()
+    top = session.sql(
+        "SELECT * FROM events ORDER BY length_of_stay DESC LIMIT 5"
+    )
+    assert len(top) == 5
+    los = np.sort(hospital_table.column("length_of_stay"))[::-1][:5]
+    np.testing.assert_allclose(top.column("length_of_stay"), los)
+
+
+def test_errors_are_clear(session):
+    with pytest.raises(ValueError, match="SQL"):
+        session.sql("SELECT FROM events")
+    with pytest.raises(ValueError, match="GROUP BY"):
+        session.sql(
+            "SELECT hospital_id, length_of_stay FROM events GROUP BY hospital_id"
+        )
+    with pytest.raises(ValueError, match="SUM"):
+        session.sql("SELECT SUM(*) FROM events")
+    with pytest.raises(KeyError, match="unknown table"):
+        session.sql("SELECT * FROM nope")
+    with pytest.raises(ValueError, match="trailing"):
+        session.sql("SELECT * FROM events LIMIT 3 garbage")
+
+
+def test_null_semantics_in_aggregates():
+    t = ht.Table.from_dict({"g": np.array(["a", "a", "b", "b"], object),
+                            "v": np.array([1.0, np.nan, np.nan, np.nan])})
+    one = execute("SELECT AVG(v) AS m, COUNT(v) AS c FROM t", lambda n: t)
+    # Spark null semantics: nulls skipped, COUNT(col) counts non-null
+    assert one.column("m")[0] == 1.0 and one.column("c")[0] == 1
+    g = execute(
+        "SELECT g, SUM(v) AS s, COUNT(v) AS c FROM t GROUP BY g ORDER BY g",
+        lambda n: t,
+    )
+    assert g.column("s")[0] == 1.0 and g.column("c")[1] == 0
+    assert np.isnan(g.column("s")[1])  # all-null group aggregates to null
+
+
+def test_order_by_unselected_column(hospital_table):
+    out = execute(
+        "SELECT hospital_id FROM t ORDER BY length_of_stay DESC LIMIT 3",
+        lambda n: hospital_table,
+    )
+    top = np.argsort(hospital_table.column("length_of_stay"))[::-1][:3]
+    np.testing.assert_array_equal(
+        out.column("hospital_id"), hospital_table.column("hospital_id")[top]
+    )
+
+
+def test_mixed_bare_column_with_aggregate_raises(hospital_table):
+    with pytest.raises(ValueError, match="GROUP BY"):
+        execute(
+            "SELECT hospital_id, COUNT(*) FROM t", lambda n: hospital_table
+        )
+
+
+def test_timestamp_group_min_max_and_whitespace(hospital_table):
+    out = execute(
+        "SELECT hospital_id, MIN(event_time) AS first, MAX(event_time) AS last "
+        "FROM t GROUP BY hospital_id ORDER BY hospital_id  \n",  # trailing ws
+        lambda n: hospital_table,
+    )
+    hid = hospital_table.column("hospital_id")
+    ts = hospital_table.column("event_time")
+    for i, h in enumerate(np.unique(hid)):
+        assert out.column("first")[i] == ts[hid == h].min()
+        assert out.column("last")[i] == ts[hid == h].max()
+    with pytest.raises(ValueError, match="numeric"):
+        execute("SELECT SUM(event_time) FROM t", lambda n: hospital_table)
+
+
+def test_null_rows_fail_comparisons_and_group_once():
+    t = ht.Table.from_dict({"v": np.array([1.0, np.nan, 3.0, np.nan])})
+    # Spark: null fails every comparison, != included
+    ne = execute("SELECT * FROM t WHERE v != 3", lambda n: t)
+    np.testing.assert_array_equal(ne.column("v"), [1.0])
+    # Spark: all nulls form ONE group
+    g = execute(
+        "SELECT v, COUNT(*) AS c FROM t GROUP BY v", lambda n: t
+    )
+    assert len(g) == 3 and sorted(g.column("c")) == [1, 1, 2]
+
+
+def test_order_by_select_alias(hospital_table):
+    out = execute(
+        "SELECT length_of_stay AS los FROM t ORDER BY los DESC LIMIT 4",
+        lambda n: hospital_table,
+    )
+    ref = np.sort(hospital_table.column("length_of_stay"))[::-1][:4]
+    np.testing.assert_allclose(out.column("los"), ref)
+
+
+def test_execute_without_session(hospital_table):
+    out = execute(
+        "SELECT hospital_id FROM t WHERE seasonality_index >= 1.0",
+        lambda name: hospital_table,
+    )
+    assert len(out) == int((hospital_table.column("seasonality_index") >= 1.0).sum())

@@ -74,6 +74,7 @@ def test_stream_watermark_drops_late(tmp_path):
     assert info2.num_late_rows == 0 and info2.num_appended_rows == 5
 
 
+@pytest.mark.fast
 def test_stream_exactly_once_resume(tmp_path):
     """Crash between offsets and commit → replay same batch, no duplicates."""
     incoming, exec_ = _stream(tmp_path)

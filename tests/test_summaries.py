@@ -199,6 +199,7 @@ def test_explained_variance_evaluator(rng, mesh8):
 
 
 # ---------------------------------------------------------------- ml.stat F/KS
+@pytest.mark.fast
 def test_kolmogorov_smirnov_matches_scipy(rng, mesh8):
     sps = pytest.importorskip("scipy.stats")
     x = rng.normal(1.5, 2.0, size=1000).astype(np.float32)[:, None]
@@ -250,6 +251,18 @@ def test_anova_fvalue_large_mean_stable(rng, mesh8):
     rf = ht.FValueTest.test(x.astype(np.float32), yr.astype(np.float32), mesh=mesh8)
     f_ref, _ = skf.f_regression(x, yr)
     np.testing.assert_allclose(rf.f_values[0], f_ref[0], rtol=1e-3)
+
+
+def test_anova_absent_class_dof(rng, mesh8):
+    """Non-contiguous label ids (class 1 absent): dof must count OBSERVED
+    classes or F/p silently drift from scipy."""
+    sps = pytest.importorskip("scipy.stats")
+    y = np.array([0] * 30 + [2] * 30)
+    x = (rng.normal(size=60) + 0.5 * (y == 2)).astype(np.float64)[:, None]
+    res = ht.ANOVATest.test(x.astype(np.float32), y.astype(np.float32), mesh=mesh8)
+    ref = sps.f_oneway(x[y == 0, 0], x[y == 2, 0])
+    np.testing.assert_allclose(res.f_values[0], ref.statistic, rtol=1e-4)
+    np.testing.assert_allclose(res.p_values[0], ref.pvalue, atol=1e-6)
 
 
 def test_fvalue_matches_sklearn(rng, mesh8):

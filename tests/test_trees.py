@@ -31,6 +31,7 @@ def test_tree_recovers_axis_aligned_split(rng, mesh8):
     assert model.feature_importances[1] > 0.99
 
 
+@pytest.mark.fast
 def test_tree_regression_sklearn_parity(rng, mesh8):
     from sklearn.tree import DecisionTreeRegressor as SK
 

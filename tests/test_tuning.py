@@ -13,6 +13,7 @@ from clustermachinelearningforhospitalnetworks_apache_spark_tpu.tuning.tuning im
 )
 
 
+@pytest.mark.fast
 def test_param_grid_builder_cartesian():
     grid = (
         ht.ParamGridBuilder()
@@ -119,6 +120,7 @@ def test_cross_validator_on_assembled_table(hospital_table, mesh8):
     assert cvm.best_index == 0
 
 
+@pytest.mark.fast
 def test_train_validation_split(rng, mesh8):
     x, y = _ridge_data(rng)
     grid = ht.ParamGridBuilder().add_grid("reg_param", [0.0, 1000.0]).build()
