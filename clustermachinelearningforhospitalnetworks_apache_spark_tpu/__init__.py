@@ -39,7 +39,9 @@ from .features import (
     QuantileDiscretizer,
     StandardScaler,
     StringIndexer,
+    UnivariateFeatureSelector,
     VectorAssembler,
+    VectorIndexer,
 )
 from .stat import (
     ANOVATest,
@@ -84,6 +86,7 @@ from .models import (
     DecisionTreeRegressor,
     GaussianMixture,
     GeneralizedLinearRegression,
+    IsotonicRegression,
     KMeans,
     OneVsRest,
     LinearRegression,
@@ -124,7 +127,9 @@ __all__ = [
     "StandardScaler",
     "StringIndexer",
     "Summarizer",
+    "UnivariateFeatureSelector",
     "VectorAssembler",
+    "VectorIndexer",
     "ClusteringEvaluator",
     "BinaryClassificationEvaluator",
     "MulticlassClassificationEvaluator",
@@ -160,6 +165,7 @@ __all__ = [
     "DecisionTreeRegressor",
     "GaussianMixture",
     "GeneralizedLinearRegression",
+    "IsotonicRegression",
     "OneVsRest",
     "GBTClassifier",
     "GBTRegressor",

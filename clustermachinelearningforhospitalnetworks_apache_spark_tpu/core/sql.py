@@ -320,7 +320,12 @@ def execute(query: str, resolve_table) -> Table:
         uniq, inv = np.unique(packed, return_inverse=True)
         order_idx = np.argsort(inv, kind="stable")
         sorted_inv = inv[order_idx]
-        starts = np.r_[0, np.flatnonzero(np.diff(sorted_inv)) + 1]
+        # zero groups (empty source / WHERE matched nothing) → empty result
+        starts = (
+            np.r_[0, np.flatnonzero(np.diff(sorted_inv)) + 1]
+            if len(uniq)
+            else np.empty((0,), np.int64)
+        )
         counts = np.bincount(inv, minlength=len(uniq))
         first_row = order_idx[starts]             # one representative/group
         cols: dict[str, Any] = {}

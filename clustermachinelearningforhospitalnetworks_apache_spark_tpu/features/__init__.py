@@ -9,6 +9,13 @@ from .minmax import MinMaxScaler, MinMaxScalerModel
 from .onehot import OneHotEncoder, OneHotEncoderModel
 from .normalizer import IndexToString, Normalizer, PolynomialExpansion
 from .pca import PCA, PCAModel
+from .selector import (
+    ChiSqSelector,
+    UnivariateFeatureSelector,
+    UnivariateFeatureSelectorModel,
+    VectorIndexer,
+    VectorIndexerModel,
+)
 
 __all__ = [
     "AssembledTable",
@@ -31,4 +38,9 @@ __all__ = [
     "PolynomialExpansion",
     "PCA",
     "PCAModel",
+    "ChiSqSelector",
+    "UnivariateFeatureSelector",
+    "UnivariateFeatureSelectorModel",
+    "VectorIndexer",
+    "VectorIndexerModel",
 ]

@@ -1,0 +1,267 @@
+"""VectorIndexer and feature selectors — the indexing/selection tail of
+``pyspark.ml.feature``.
+
+``VectorIndexer`` (Spark): scan an assembled feature matrix, decide which
+columns are categorical (≤ ``max_categories`` distinct values), and
+re-encode those columns to category indices.  Here it additionally
+exposes the decision as a ``categorical_features`` dict — exactly the
+``{index: arity}`` spec the tree estimators consume — closing the
+StringIndexer → VectorIndexer → tree loop the reference's unused
+StringIndexer import pointed at (``mllearnforhospitalnetwork.py:29``,
+SURVEY.md D5).
+
+``UnivariateFeatureSelector`` (Spark 3.1+): pick features by a statistical
+test chosen from (featureType, labelType) — chi2 for categorical/
+categorical, ANOVA F for continuous features vs categorical label, F-value
+for continuous/continuous — reusing this framework's ``ChiSquareTest`` /
+``ANOVATest`` / ``FValueTest`` device reductions.  ``ChiSqSelector`` is
+the classic (pre-3.1) chi2-only spelling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..core.table import Table
+from ..io.model_io import register_model, save_model
+from .assembler import AssembledTable
+
+
+class _Saveable:
+    """Direct save/write sugar for stage models (same artifact layout the
+    Pipeline persistence machinery writes)."""
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        name, meta, arrays = self._artifacts()
+        save_model(path, name, meta, arrays, overwrite=overwrite)
+
+    def write(self):
+        from ..models.base import _Writer
+
+        return _Writer(self)
+
+
+def _as_matrix(data: Any) -> np.ndarray:
+    if isinstance(data, AssembledTable):
+        return np.asarray(data.features, dtype=np.float64)
+    return np.asarray(data, dtype=np.float64)
+
+
+def _rewrap(data: Any, mat: np.ndarray, cols: Sequence[str] | None = None):
+    """Return the transformed matrix in the caller's container shape."""
+    if isinstance(data, AssembledTable):
+        return AssembledTable(
+            table=data.table,
+            feature_cols=tuple(cols) if cols is not None else data.feature_cols,
+            features=mat,
+            output_col=data.output_col,
+        )
+    return mat
+
+
+# ------------------------------------------------------------ VectorIndexer
+@register_model("VectorIndexerModel")
+@dataclass(frozen=True)
+class VectorIndexerModel(_Saveable):
+    """``category_maps``: feature index → tuple of ORIGINAL values, in
+    ascending order; the value's position is its category index."""
+
+    num_features: int
+    category_maps: dict[int, tuple[float, ...]]
+    handle_invalid: str = "error"   # "error" | "keep" | "skip"
+
+    @property
+    def categorical_features(self) -> dict[int, int]:
+        """The ``{index: arity}`` spec the tree estimators accept —
+        "keep" mode reserves one extra index for unseen values."""
+        extra = 1 if self.handle_invalid == "keep" else 0
+        return {f: len(v) + extra for f, v in self.category_maps.items()}
+
+    def transform(self, data):
+        x = _as_matrix(data).copy()
+        drop = np.zeros(x.shape[0], dtype=bool)
+        for f, values in self.category_maps.items():
+            # values is ascending (np.unique at fit), so one searchsorted
+            # maps the whole column — no per-row Python loop
+            va = np.asarray(values)
+            col = x[:, f]
+            codes = np.searchsorted(va, col)
+            unseen = (codes >= va.size) | (va[np.minimum(codes, va.size - 1)] != col)
+            if unseen.any():
+                if self.handle_invalid == "error":
+                    bad = col[unseen][0]
+                    raise ValueError(
+                        f"unseen value {bad!r} in categorical feature {f} "
+                        "(handle_invalid='error')"
+                    )
+                if self.handle_invalid == "skip":
+                    drop |= unseen
+                    codes = np.where(unseen, 0, codes)
+                else:  # keep → the reserved extra category
+                    codes = np.where(unseen, va.size, codes)
+            x[:, f] = codes
+        if self.handle_invalid == "skip" and drop.any():
+            if not isinstance(data, AssembledTable):
+                return x[~drop]
+            return AssembledTable(
+                table=data.table.mask(~drop),
+                feature_cols=data.feature_cols,
+                features=x[~drop],
+                output_col=data.output_col,
+            )
+        return _rewrap(data, x)
+
+    def _artifacts(self):
+        return (
+            "VectorIndexerModel",
+            {
+                "num_features": self.num_features,
+                "handle_invalid": self.handle_invalid,
+                "category_maps": {
+                    str(k): list(map(float, v)) for k, v in self.category_maps.items()
+                },
+            },
+            {},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            num_features=int(params["num_features"]),
+            category_maps={
+                int(k): tuple(v) for k, v in params["category_maps"].items()
+            },
+            handle_invalid=params.get("handle_invalid", "error"),
+        )
+
+
+@dataclass(frozen=True)
+class VectorIndexer:
+    max_categories: int = 20        # Spark default
+    handle_invalid: str = "error"
+
+    def fit(self, data, label_col=None, mesh=None) -> VectorIndexerModel:
+        if self.handle_invalid not in ("error", "keep", "skip"):
+            raise ValueError(
+                f"handle_invalid must be error|keep|skip, got "
+                f"{self.handle_invalid!r}"
+            )
+        x = _as_matrix(data)
+        maps: dict[int, tuple[float, ...]] = {}
+        for f in range(x.shape[1]):
+            distinct = np.unique(x[:, f])
+            if distinct.size <= self.max_categories:
+                maps[f] = tuple(float(v) for v in distinct)
+        return VectorIndexerModel(
+            num_features=x.shape[1],
+            category_maps=maps,
+            handle_invalid=self.handle_invalid,
+        )
+
+
+# ------------------------------------------------- UnivariateFeatureSelector
+@register_model("UnivariateFeatureSelectorModel")
+@dataclass(frozen=True)
+class UnivariateFeatureSelectorModel(_Saveable):
+    selected: tuple[int, ...]       # ascending feature indices
+
+    def transform(self, data):
+        x = _as_matrix(data)
+        idx = list(self.selected)
+        cols = None
+        if isinstance(data, AssembledTable):
+            cols = [data.feature_cols[i] for i in idx]
+        return _rewrap(data, x[:, idx], cols)
+
+    def _artifacts(self):
+        return (
+            "UnivariateFeatureSelectorModel",
+            {"selected": list(map(int, self.selected))},
+            {},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(selected=tuple(int(i) for i in params["selected"]))
+
+
+@dataclass(frozen=True)
+class UnivariateFeatureSelector:
+    """Spark's test matrix: (featureType, labelType) → chi2 | ANOVA F |
+    F-value.  ``selection_mode``: numTopFeatures (default, Spark too),
+    percentile, fpr (p-value threshold)."""
+
+    feature_type: str = "continuous"     # "continuous" | "categorical"
+    label_type: str = "categorical"      # "continuous" | "categorical"
+    selection_mode: str = "numTopFeatures"
+    selection_threshold: float | None = None  # mode-dependent default
+    label_col: str = "LOS_binary"
+
+    def _p_values(self, x, y, mesh):
+        from ..stat import ANOVATest, ChiSquareTest, FValueTest
+
+        ft, lt = self.feature_type, self.label_type
+        if ft == "categorical" and lt == "categorical":
+            return ChiSquareTest.test(x, y).p_values
+        if ft == "continuous" and lt == "categorical":
+            return ANOVATest.test(
+                x.astype(np.float32), y.astype(np.float32), mesh=mesh
+            ).p_values
+        if ft == "continuous" and lt == "continuous":
+            return FValueTest.test(
+                x.astype(np.float32), y.astype(np.float32), mesh=mesh
+            ).p_values
+        raise ValueError(
+            "categorical features with a continuous label have no Spark "
+            "test; bucketize the label or use feature_type='continuous'"
+        )
+
+    def fit(self, data, label_col: str | None = None, mesh=None):
+        x = _as_matrix(data)
+        if isinstance(data, AssembledTable):
+            y = data.label(label_col or self.label_col)
+        else:
+            raise ValueError(
+                "UnivariateFeatureSelector needs an AssembledTable (the "
+                "label column resolves against the table)"
+            )
+        p = np.asarray(self._p_values(x, y, mesh), dtype=np.float64)
+        d = x.shape[1]
+        mode = self.selection_mode
+        if mode == "numTopFeatures":
+            top = int(self.selection_threshold or 50)
+            sel = np.sort(np.argsort(p, kind="stable")[: min(top, d)])
+        elif mode == "percentile":
+            frac = self.selection_threshold if self.selection_threshold is not None else 0.1
+            keep = max(1, int(d * float(frac)))
+            sel = np.sort(np.argsort(p, kind="stable")[:keep])
+        elif mode == "fpr":
+            alpha = self.selection_threshold if self.selection_threshold is not None else 0.05
+            sel = np.flatnonzero(p < float(alpha))
+        else:
+            raise ValueError(
+                f"selection_mode must be numTopFeatures|percentile|fpr, got "
+                f"{mode!r}"
+            )
+        return UnivariateFeatureSelectorModel(selected=tuple(int(i) for i in sel))
+
+
+@dataclass(frozen=True)
+class ChiSqSelector:
+    """Classic chi2 selector (Spark pre-3.1) — categorical features vs a
+    categorical label, top-N by p-value."""
+
+    num_top_features: int = 50
+    label_col: str = "LOS_binary"
+
+    def fit(self, data, label_col: str | None = None, mesh=None):
+        return UnivariateFeatureSelector(
+            feature_type="categorical",
+            label_type="categorical",
+            selection_mode="numTopFeatures",
+            selection_threshold=self.num_top_features,
+            label_col=label_col or self.label_col,
+        ).fit(data, label_col=label_col, mesh=mesh)

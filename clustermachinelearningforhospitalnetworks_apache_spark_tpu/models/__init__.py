@@ -8,6 +8,7 @@ from .logistic_regression import (
 from .kmeans import KMeans, KMeansModel
 from .naive_bayes import NaiveBayes, NaiveBayesModel
 from .glm import GeneralizedLinearRegression, GeneralizedLinearRegressionModel
+from .isotonic import IsotonicRegression, IsotonicRegressionModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
@@ -31,6 +32,8 @@ __all__ = [
     "as_device_dataset",
     "GeneralizedLinearRegression",
     "GeneralizedLinearRegressionModel",
+    "IsotonicRegression",
+    "IsotonicRegressionModel",
     "OneVsRest",
     "OneVsRestModel",
     "LinearRegression",

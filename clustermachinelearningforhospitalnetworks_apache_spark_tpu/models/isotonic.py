@@ -1,0 +1,142 @@
+"""IsotonicRegression — pool-adjacent-violators with device interpolation.
+
+Parity with ``pyspark.ml.regression.IsotonicRegression``: single active
+feature (``feature_index`` into the assembled vector), ``isotonic=True``
+for increasing / False for decreasing, weighted, prediction by linear
+interpolation between fitted boundaries (Spark's rule, which is also
+``jnp.interp``'s: clamp outside the boundary range).
+
+Shape notes: PAVA is inherently sequential, but its input is the
+sorted-by-x sequence of (Σwy/Σw) groups — tiny compared to the row count
+after duplicate-x pooling.  So the fit is: device → host fetch of the
+(x, y, w) triples (one transfer), host sort + duplicate pooling
+(vectorized numpy), then linear-time PAVA over the pooled blocks (the
+same split Spark makes: per-partition PAVA, then a final driver-side
+pass).  Prediction stays on device: one ``jnp.interp`` over the (b,)
+boundary tables, sharded rows in, sharded predictions out.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from .base import Estimator, Model, as_device_dataset
+
+
+def _pava(y: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Pool-adjacent-violators (increasing), linear time amortized: keep a
+    stack of monotone blocks; a new point merges backwards while it
+    violates the previous block's mean — each merge permanently removes a
+    block, so total merges ≤ n."""
+    starts: list[int] = []     # block start index
+    means: list[float] = []    # block weighted mean
+    weights: list[float] = []  # block weight
+    for i in range(y.size):
+        cs, cm, cw = i, float(y[i]), float(w[i])
+        while means and means[-1] > cm:
+            cm = (means[-1] * weights[-1] + cm * cw) / (weights[-1] + cw)
+            cw += weights[-1]
+            cs = starts[-1]
+            starts.pop(); means.pop(); weights.pop()
+        starts.append(cs)
+        means.append(cm)
+        weights.append(cw)
+    fitted = np.empty(y.size, dtype=np.float64)
+    bounds = starts + [y.size]
+    for j, mval in enumerate(means):
+        fitted[bounds[j] : bounds[j + 1]] = mval
+    return fitted
+
+
+@register_model("IsotonicRegressionModel")
+@dataclass
+class IsotonicRegressionModel(Model):
+    boundaries: np.ndarray    # (b,) ascending x values
+    predictions: np.ndarray   # (b,) fitted values at the boundaries
+    isotonic: bool = True
+    feature_index: int = 0
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        xv = x[:, self.feature_index] if x.ndim == 2 else x
+        xb = jnp.asarray(self.boundaries, jnp.float32)
+        yb = jnp.asarray(self.predictions, jnp.float32)
+        # jnp.interp clamps outside the range — Spark's boundary rule
+        return jnp.interp(xv.astype(jnp.float32), xb, yb)
+
+    def _artifacts(self):
+        return (
+            "IsotonicRegressionModel",
+            {"isotonic": bool(self.isotonic), "feature_index": int(self.feature_index)},
+            {
+                "boundaries": np.asarray(self.boundaries),
+                "predictions": np.asarray(self.predictions),
+            },
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            boundaries=arrays["boundaries"],
+            predictions=arrays["predictions"],
+            isotonic=bool(params.get("isotonic", True)),
+            feature_index=int(params.get("feature_index", 0)),
+        )
+
+
+@dataclass(frozen=True)
+class IsotonicRegression(Estimator):
+    isotonic: bool = True          # Spark default: increasing
+    feature_index: int = 0         # Spark's featureIndex
+    label_col: str = "length_of_stay"
+    features_col: str = "features"
+    weight_col: str | None = None
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> IsotonicRegressionModel:
+        ds = as_device_dataset(
+            data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
+        )
+        if not 0 <= self.feature_index < ds.n_features:
+            raise ValueError(
+                f"feature_index {self.feature_index} out of range "
+                f"[0, {ds.n_features})"
+            )
+        x = np.asarray(jax.device_get(ds.x))[:, self.feature_index].astype(np.float64)
+        y = np.asarray(jax.device_get(ds.y), dtype=np.float64)
+        w = np.asarray(jax.device_get(ds.w), dtype=np.float64)
+        valid = w > 0
+        x, y, w = x[valid], y[valid], w[valid]
+        if x.size == 0:
+            raise ValueError("isotonic fit on an empty dataset")
+
+        order = np.argsort(x, kind="stable")
+        xs, ys, ws = x[order], y[order], w[order]
+        # pool duplicate x values (weighted means) — PAVA block count then
+        # equals the number of DISTINCT x values
+        ux, first = np.unique(xs, return_index=True)
+        sums = np.add.reduceat(ys * ws, first)
+        wsum = np.add.reduceat(ws, first)
+        gy = sums / wsum
+        if not self.isotonic:
+            gy = -gy
+        fitted = _pava(gy, wsum)
+        if not self.isotonic:
+            fitted = -fitted
+        # compress runs of equal fitted values to their end-points — the
+        # (boundary, prediction) table Spark stores
+        keep = np.ones(ux.size, dtype=bool)
+        if ux.size > 2:
+            interior_same = (fitted[1:-1] == fitted[:-2]) & (
+                fitted[1:-1] == fitted[2:]
+            )
+            keep[1:-1] = ~interior_same
+        return IsotonicRegressionModel(
+            boundaries=ux[keep],
+            predictions=fitted[keep],
+            isotonic=self.isotonic,
+            feature_index=self.feature_index,
+        )

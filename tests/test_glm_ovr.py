@@ -152,3 +152,55 @@ class TestOneVsRest:
             ht.OneVsRest(
                 classifier=ht.LogisticRegression(weight_col="w")
             ).fit((x, np.array([0.0, 1.0] * 32, np.float32)), mesh=mesh8)
+
+
+class TestIsotonicRegression:
+    @pytest.mark.fast
+    def test_matches_sklearn(self, rng, mesh8):
+        ski = pytest.importorskip("sklearn.isotonic")
+        n = 2000
+        x = rng.uniform(0, 10, size=n).astype(np.float32)
+        y = (np.sqrt(x) + 0.3 * rng.normal(size=n)).astype(np.float32)
+        m = ht.IsotonicRegression().fit((x[:, None], y), mesh=mesh8)
+        ref = ski.IsotonicRegression(out_of_bounds="clip").fit(x, y)
+        probe = rng.uniform(-1, 11, size=500).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(m.predict_numpy(probe[:, None])),
+            ref.predict(probe),
+            atol=1e-4,
+        )
+
+    def test_decreasing_weighted_round_trip(self, rng, mesh8, tmp_path):
+        ski = pytest.importorskip("sklearn.isotonic")
+        n = 1200
+        x = rng.uniform(0, 5, size=n)
+        y = 5.0 - x + 0.2 * rng.normal(size=n)
+        w = rng.integers(1, 4, size=n).astype(np.float64)
+        m = ht.IsotonicRegression(isotonic=False).fit(
+            (x[:, None].astype(np.float32), y.astype(np.float32), w), mesh=mesh8
+        )
+        ref = ski.IsotonicRegression(increasing=False, out_of_bounds="clip").fit(
+            x, y, sample_weight=w
+        )
+        probe = rng.uniform(0, 5, size=300)
+        np.testing.assert_allclose(
+            np.asarray(m.predict_numpy(probe[:, None].astype(np.float32))),
+            ref.predict(probe),
+            atol=1e-4,
+        )
+        m.write().overwrite().save(str(tmp_path / "iso"))
+        back = ht.load_model(str(tmp_path / "iso"))
+        np.testing.assert_array_equal(
+            back.predict_numpy(probe[:, None].astype(np.float32)),
+            m.predict_numpy(probe[:, None].astype(np.float32)),
+        )
+
+    def test_feature_index_and_validation(self, rng, mesh8):
+        n = 400
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        y = (2 * x[:, 2] + 0.1 * rng.normal(size=n)).astype(np.float32)
+        m = ht.IsotonicRegression(feature_index=2).fit((x, y), mesh=mesh8)
+        pred = np.asarray(m.predict_numpy(x))
+        assert np.corrcoef(pred, y)[0, 1] > 0.95
+        with pytest.raises(ValueError, match="feature_index"):
+            ht.IsotonicRegression(feature_index=7).fit((x, y), mesh=mesh8)

@@ -1,0 +1,135 @@
+"""VectorIndexer + UnivariateFeatureSelector/ChiSqSelector.
+
+The StringIndexer → VectorIndexer → categorical-tree loop is the
+reference's intended categorical flow (``mllearnforhospitalnetwork.py:29``,
+SURVEY.md D5); the selectors reuse the chi2/ANOVA/F-value device tests.
+"""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+pytestmark = pytest.mark.fast
+
+
+def _mixed_table(rng, n=800):
+    ward = rng.integers(0, 4, size=n).astype(np.float64) * 2  # values {0,2,4,6}
+    sev = rng.normal(size=n)
+    los = np.array([0.0, 8.0, 1.0, 9.0])[(ward / 2).astype(int)] + sev
+    t = ht.Table.from_dict({"ward_raw": ward, "severity": sev, "los": los})
+    return ht.VectorAssembler(["ward_raw", "severity"]).transform(t)
+
+
+class TestVectorIndexer:
+    def test_detects_and_reencodes_categorical(self, rng, mesh8):
+        at = _mixed_table(rng)
+        m = ht.VectorIndexer(max_categories=10).fit(at)
+        # ward_raw has 4 distinct values → categorical; severity continuous
+        assert set(m.category_maps) == {0}
+        assert m.categorical_features == {0: 4}
+        out = m.transform(at)
+        # values {0,2,4,6} → indices {0,1,2,3}, ascending-value order
+        assert set(np.unique(out.features[:, 0])) == {0.0, 1.0, 2.0, 3.0}
+        np.testing.assert_array_equal(
+            out.features[:, 1], at.features[:, 1]  # continuous untouched
+        )
+
+    def test_feeds_categorical_trees(self, rng, mesh8):
+        at = _mixed_table(rng)
+        m = ht.VectorIndexer(max_categories=10).fit(at)
+        out = m.transform(at)
+        tree = ht.DecisionTreeRegressor(
+            max_depth=2, label_col="los",
+            categorical_features=m.categorical_features,
+        ).fit(out, mesh=mesh8)
+        pred = tree.transform(out, label_col="los", mesh=mesh8)
+        assert ht.RegressionEvaluator("rmse").evaluate(pred) < 1.5
+
+    def test_handle_invalid_modes(self, rng):
+        at = _mixed_table(rng)
+        m = ht.VectorIndexer(max_categories=10).fit(at)
+        probe = np.array([[3.0, 0.0]])  # 3 is not in {0,2,4,6}
+        with pytest.raises(ValueError, match="unseen"):
+            m.transform(probe)
+        m_keep = ht.VectorIndexer(max_categories=10, handle_invalid="keep").fit(at)
+        assert m_keep.transform(probe)[0, 0] == 4.0  # reserved extra index
+        assert m_keep.categorical_features == {0: 5}
+        m_skip = ht.VectorIndexer(max_categories=10, handle_invalid="skip").fit(at)
+        assert m_skip.transform(probe).shape[0] == 0
+
+    def test_round_trip(self, rng, tmp_path):
+        at = _mixed_table(rng)
+        m = ht.VectorIndexer(max_categories=10).fit(at)
+        m.save(str(tmp_path / "vi"))
+        back = ht.load_model(str(tmp_path / "vi"))
+        np.testing.assert_array_equal(
+            back.transform(at.features), m.transform(at.features)
+        )
+        assert back.categorical_features == m.categorical_features
+
+
+class TestUnivariateFeatureSelector:
+    def test_anova_selection(self, rng, mesh8):
+        n, d = 1000, 6
+        y = rng.integers(0, 3, size=n).astype(np.float64)
+        x = rng.normal(size=(n, d))
+        x[:, 1] += y           # informative
+        x[:, 4] += 2 * y       # most informative
+        t = ht.Table.from_dict(
+            {**{f"f{j}": x[:, j] for j in range(d)}, "cls": y}
+        )
+        at = ht.VectorAssembler([f"f{j}" for j in range(d)]).transform(t)
+        sel = ht.UnivariateFeatureSelector(
+            feature_type="continuous", label_type="categorical",
+            selection_mode="numTopFeatures", selection_threshold=2,
+            label_col="cls",
+        ).fit(at, mesh=mesh8)
+        assert set(sel.selected) == {1, 4}
+        out = sel.transform(at)
+        assert out.features.shape == (n, 2)
+        assert out.feature_cols == ("f1", "f4")
+
+    def test_fvalue_and_fpr_modes(self, rng, mesh8):
+        n, d = 1200, 5
+        x = rng.normal(size=(n, d))
+        y = 3.0 * x[:, 2] + rng.normal(size=n)
+        t = ht.Table.from_dict(
+            {**{f"f{j}": x[:, j] for j in range(d)}, "target": y}
+        )
+        at = ht.VectorAssembler([f"f{j}" for j in range(d)]).transform(t)
+        sel = ht.UnivariateFeatureSelector(
+            feature_type="continuous", label_type="continuous",
+            selection_mode="fpr", selection_threshold=1e-6,
+            label_col="target",
+        ).fit(at, mesh=mesh8)
+        assert tuple(sel.selected) == (2,)
+
+    def test_chi2_selector(self, rng, mesh8):
+        n = 900
+        y = rng.integers(0, 2, size=n).astype(np.float64)
+        f0 = y.copy()                                   # perfectly dependent
+        f1 = rng.integers(0, 3, size=n).astype(np.float64)  # independent
+        t = ht.Table.from_dict({"f0": f0, "f1": f1, "lbl": y})
+        at = ht.VectorAssembler(["f0", "f1"]).transform(t)
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.features import (
+            ChiSqSelector,
+        )
+
+        sel = ChiSqSelector(num_top_features=1, label_col="lbl").fit(at, mesh=mesh8)
+        assert tuple(sel.selected) == (0,)
+
+    def test_invalid_combination_and_round_trip(self, rng, mesh8, tmp_path):
+        at = _mixed_table(rng)
+        with pytest.raises(ValueError, match="no Spark test"):
+            ht.UnivariateFeatureSelector(
+                feature_type="categorical", label_type="continuous",
+                label_col="los",
+            ).fit(at, mesh=mesh8)
+        sel = ht.UnivariateFeatureSelector(
+            feature_type="continuous", label_type="continuous",
+            selection_mode="numTopFeatures", selection_threshold=1,
+            label_col="los",
+        ).fit(at, mesh=mesh8)
+        sel.save(str(tmp_path / "sel"))
+        assert ht.load_model(str(tmp_path / "sel")).selected == sel.selected

@@ -166,6 +166,16 @@ def test_null_rows_fail_comparisons_and_group_once():
     assert len(g) == 3 and sorted(g.column("c")) == [1, 1, 2]
 
 
+def test_group_by_empty_result(hospital_table):
+    out = execute(
+        "SELECT hospital_id, COUNT(*) AS c FROM t "
+        "WHERE length_of_stay > 1e9 GROUP BY hospital_id",
+        lambda n: hospital_table,
+    )
+    assert len(out) == 0
+    assert set(f.name for f in out.schema.fields) == {"hospital_id", "c"}
+
+
 def test_order_by_select_alias(hospital_table):
     out = execute(
         "SELECT length_of_stay AS los FROM t ORDER BY los DESC LIMIT 4",
