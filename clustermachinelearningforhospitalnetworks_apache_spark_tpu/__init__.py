@@ -90,6 +90,7 @@ from .models import (
     KMeans,
     OneVsRest,
     LinearRegression,
+    LinearSVC,
     LogisticRegression,
     MultinomialLogisticRegressionModel,
     RandomForestClassifier,
@@ -171,6 +172,7 @@ __all__ = [
     "GBTRegressor",
     "KMeans",
     "LinearRegression",
+    "LinearSVC",
     "LogisticRegression",
     "NaiveBayes",
     "MultinomialLogisticRegressionModel",

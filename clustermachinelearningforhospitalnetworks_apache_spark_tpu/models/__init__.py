@@ -9,6 +9,7 @@ from .kmeans import KMeans, KMeansModel
 from .naive_bayes import NaiveBayes, NaiveBayesModel
 from .glm import GeneralizedLinearRegression, GeneralizedLinearRegressionModel
 from .isotonic import IsotonicRegression, IsotonicRegressionModel
+from .linear_svc import LinearSVC, LinearSVCModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
@@ -34,6 +35,8 @@ __all__ = [
     "GeneralizedLinearRegressionModel",
     "IsotonicRegression",
     "IsotonicRegressionModel",
+    "LinearSVC",
+    "LinearSVCModel",
     "OneVsRest",
     "OneVsRestModel",
     "LinearRegression",

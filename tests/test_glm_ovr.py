@@ -204,3 +204,56 @@ class TestIsotonicRegression:
         assert np.corrcoef(pred, y)[0, 1] > 0.95
         with pytest.raises(ValueError, match="feature_index"):
             ht.IsotonicRegression(feature_index=7).fit((x, y), mesh=mesh8)
+
+
+class TestLinearSVC:
+    @pytest.mark.fast
+    def test_matches_sklearn_squared_hinge(self, rng, mesh8):
+        sksvm = pytest.importorskip("sklearn.svm")
+        n, d = 2000, 3
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = ((x @ [1.5, -1.0, 0.5] + 0.2) > 0).astype(np.float32)
+        lam = 0.01
+        ours = ht.LinearSVC(reg_param=lam, standardize=False).fit((x, y), mesh=mesh8)
+        # sklearn: min ½wᵀw + C Σ max(0,1−m)²  ⇔  ours (λ/2‖β‖² + MEAN
+        # loss): divide sklearn's objective by Cn → λ = 1/(Cn), i.e.
+        # C = 1/(λn)
+        ref = sksvm.LinearSVC(
+            C=1.0 / (lam * n), loss="squared_hinge", max_iter=20000, tol=1e-8
+        ).fit(x, y)
+        np.testing.assert_allclose(
+            np.asarray(ours.coefficients), ref.coef_[0], rtol=5e-2, atol=5e-3
+        )
+        pred = np.asarray(ours.predict_numpy(x))
+        agree = (pred == ref.predict(x)).mean()
+        assert agree > 0.995
+
+    def test_separable_weighted_and_round_trip(self, rng, mesh8, tmp_path):
+        n = 800
+        x = np.concatenate(
+            [rng.normal(-2, 0.5, size=(n // 2, 2)), rng.normal(2, 0.5, size=(n // 2, 2))]
+        ).astype(np.float32)
+        y = np.concatenate([np.zeros(n // 2), np.ones(n // 2)]).astype(np.float32)
+        w = rng.integers(1, 4, size=n).astype(np.float64)
+        m = ht.LinearSVC(reg_param=0.01).fit((x, y, w), mesh=mesh8)
+        assert (np.asarray(m.predict_numpy(x)) == y).mean() == 1.0
+        rep = np.repeat(np.arange(n), w.astype(int))
+        md = ht.LinearSVC(reg_param=0.01).fit((x[rep], y[rep]), mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(m.coefficients), np.asarray(md.coefficients), atol=1e-4
+        )
+        m.write().overwrite().save(str(tmp_path / "svc"))
+        back = ht.load_model(str(tmp_path / "svc"))
+        np.testing.assert_array_equal(back.predict_numpy(x), m.predict_numpy(x))
+
+    def test_validation_and_ovr_compose(self, rng, mesh8):
+        x = rng.normal(size=(300, 2)).astype(np.float32)
+        with pytest.raises(ValueError, match="binary"):
+            ht.LinearSVC().fit((x, rng.integers(0, 3, 300).astype(np.float32)), mesh=mesh8)
+        # SVC as the OneVsRest inner classifier (margin-based confidence)
+        y3 = rng.integers(0, 3, size=300)
+        x3 = (np.array([[0, 0], [6, 0], [0, 6]])[y3] + rng.normal(0, 0.7, (300, 2))).astype(np.float32)
+        ovr = ht.OneVsRest(classifier=ht.LinearSVC(reg_param=0.01)).fit(
+            (x3, y3.astype(np.float32)), mesh=mesh8
+        )
+        assert (np.asarray(ovr.predict_numpy(x3)) == y3).mean() > 0.95
