@@ -28,7 +28,7 @@ Working response z = η + (y−μ)·g'(μ); IRLS weight ω = w / (g'(μ)²·V(μ
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
+from functools import cached_property, partial
 
 import jax
 import jax.numpy as jnp
@@ -149,20 +149,276 @@ def _irls_glm(
 
     # deviance of the final fit (family-specific; Spark summary surface)
     mu = _mu_clip(family, ginv(xa @ theta))
+    deviance = jnp.sum(_unit_deviance(family, y, mu) * w)
+    return coef, intercept, it, deviance
+
+
+def _unit_deviance(family: str, y, mu):
+    """Per-row deviance contribution d(y, μ) (McCullagh & Nelder) — shared
+    by the fit's final deviance, the summary's nullDeviance (μ = intercept-
+    only mean), and ``residuals("deviance")``."""
     if family == "gaussian":
-        dev_i = (y - mu) ** 2
-    elif family == "binomial":
-        dev_i = 2.0 * (
+        return (y - mu) ** 2
+    if family == "binomial":
+        return 2.0 * (
             y * jnp.log(jnp.maximum(y, 1e-12) / mu)
             + (1.0 - y) * jnp.log(jnp.maximum(1.0 - y, 1e-12) / (1.0 - mu))
         )
-    elif family == "poisson":
+    if family == "poisson":
         ylog = jnp.where(y > 0, y * jnp.log(y / mu), 0.0)
-        dev_i = 2.0 * (ylog - (y - mu))
-    else:  # gamma
-        dev_i = 2.0 * (-jnp.log(jnp.maximum(y, 1e-12) / mu) + (y - mu) / mu)
-    deviance = jnp.sum(dev_i * w)
-    return coef, intercept, it, deviance
+        return 2.0 * (ylog - (y - mu))
+    # gamma
+    return 2.0 * (-jnp.log(jnp.maximum(y, 1e-12) / mu) + (y - mu) / mu)
+
+
+@dataclass
+class GeneralizedLinearRegressionTrainingSummary:
+    """``pyspark.ml.regression.GeneralizedLinearRegressionTrainingSummary``
+    surface: deviance / nullDeviance / dispersion / AIC / Pearson χ² and
+    per-coefficient inference (std errors, t, p) — the evaluation surface
+    the reference consumes for its regressors at
+    ``mllearnforhospitalnetwork.py:162-169``, extended to GLM families.
+
+    Lazy like the LR summary (models/summary.py): fit stores only (model,
+    dataset) references; each statistic is one device reduction on first
+    access, cached.  Inference statistics follow Spark's rules: available
+    only on unregularized fits; p-values use the normal distribution when
+    the dispersion is fixed (binomial/poisson) and Student's t otherwise.
+    """
+
+    _model: "GeneralizedLinearRegressionModel" = field(repr=False)
+    _ds: object = field(repr=False)
+    _reg_param: float = 0.0
+    _fit_intercept: bool = True
+
+    # -- shared one-pass statistics ------------------------------------
+    @cached_property
+    def _stats(self) -> dict[str, float]:
+        """ONE jitted pass over the mesh → every scalar the summary needs."""
+        m = self._model
+        fam = m.family
+        _, ginv, _ = _link_fns(m.link)
+        vfn = _variance_fn(fam)
+
+        @jax.jit
+        def stats(x, y, w):
+            x = x.astype(jnp.float32)
+            y = y.astype(jnp.float32)
+            w = w.astype(jnp.float32)
+            eta = x @ jnp.asarray(m.coefficients, jnp.float32) + jnp.float32(
+                m.intercept
+            )
+            mu = _mu_clip(fam, ginv(eta))
+            wsum = jnp.sum(w)
+            nrows = jnp.sum((w > 0).astype(jnp.float32))
+            ybar = jnp.sum(y * w) / jnp.maximum(wsum, 1e-12)
+            # intercept-only MLE is the weighted mean for EVERY link (the
+            # one-parameter score Σ wᵢ(yᵢ−μ)/(V(μ)g'(μ)) vanishes at ȳ)
+            mu0 = _mu_clip(fam, ybar * jnp.ones_like(y)) if self._fit_intercept \
+                else _mu_clip(fam, ginv(jnp.zeros_like(y)))
+            dev = jnp.sum(_unit_deviance(fam, y, mu) * w)
+            dev0 = jnp.sum(_unit_deviance(fam, y, mu0) * w)
+            pearson = jnp.sum(w * (y - mu) ** 2 / jnp.maximum(vfn(mu), 1e-12))
+            # family log-likelihood pieces (dispersion-free parts; the
+            # gaussian/gamma AIC closes over deviance/dispersion on host)
+            if fam == "binomial":
+                ll = jnp.sum(
+                    w * (y * jnp.log(mu) + (1.0 - y) * jnp.log1p(-mu))
+                )
+            elif fam == "poisson":
+                ll = jnp.sum(
+                    w * (y * jnp.log(jnp.maximum(mu, 1e-12)) - mu
+                         - jax.lax.lgamma(y + 1.0))
+                )
+            else:
+                ll = jnp.zeros(())
+            # Σ w log y, Σ w log μ and Σ w·y/μ feed the gamma AIC's
+            # host-side finish (same single pass)
+            logy = jnp.sum(jnp.where(w > 0, jnp.log(jnp.maximum(y, 1e-12)), 0.0) * w)
+            logmu = jnp.sum(jnp.where(w > 0, jnp.log(jnp.maximum(mu, 1e-12)), 0.0) * w)
+            y_over_mu = jnp.sum(w * y / jnp.maximum(mu, 1e-12))
+            return dict(
+                deviance=dev, null_deviance=dev0, pearson=pearson, ll=ll,
+                wsum=wsum, nrows=nrows, logy=logy, logmu=logmu,
+                y_over_mu=y_over_mu,
+            )
+
+        return {
+            k: float(v)
+            for k, v in jax.device_get(
+                stats(self._ds.x, self._ds.y, self._ds.w)
+            ).items()
+        }
+
+    @property
+    def deviance(self) -> float:
+        return self._stats["deviance"]
+
+    @property
+    def null_deviance(self) -> float:
+        return self._stats["null_deviance"]
+
+    @property
+    def pearson_chi_squared(self) -> float:
+        """Σ w·(y−μ)²/V(μ) — the Pearson goodness-of-fit statistic."""
+        return self._stats["pearson"]
+
+    @cached_property
+    def num_instances(self) -> int:
+        return int(self._stats["nrows"])
+
+    @property
+    def rank(self) -> int:
+        """Rank of the fitted design (full-rank solve: p [+ intercept])."""
+        return np.asarray(self._model.coefficients).shape[0] + (
+            1 if self._fit_intercept else 0
+        )
+
+    @property
+    def degrees_of_freedom(self) -> int:
+        return max(self.num_instances - self.rank, 0)
+
+    # Spark's names for (n − rank) and (n − 1 + has_intercept − 1):
+    @property
+    def residual_degree_of_freedom(self) -> int:
+        return self.degrees_of_freedom
+
+    @property
+    def residual_degree_of_freedom_null(self) -> int:
+        return max(self.num_instances - (1 if self._fit_intercept else 0), 0)
+
+    @cached_property
+    def dispersion(self) -> float:
+        """1.0 for binomial/poisson (fixed); Pearson χ²/dof otherwise —
+        Spark's (and McCullagh & Nelder's) moment estimator."""
+        if self._model.family in ("binomial", "poisson"):
+            return 1.0
+        return self.pearson_chi_squared / max(self.degrees_of_freedom, 1)
+
+    @cached_property
+    def aic(self) -> float:
+        """Akaike information criterion, Spark's per-family form:
+        ``family.aic + 2·rank`` with the dispersion parameter's +2 charged
+        inside the gaussian/gamma family terms."""
+        from scipy.special import gammaln
+
+        s = self._stats
+        fam = self._model.family
+        if fam == "gaussian":
+            # −2ℓ at the MLE σ̂² = deviance/Σw, + 2 for estimating σ²
+            fam_aic = (
+                s["wsum"] * (np.log(2.0 * np.pi * s["deviance"] / s["wsum"]) + 1.0)
+                + 2.0
+            )
+        elif fam in ("binomial", "poisson"):
+            fam_aic = -2.0 * s["ll"]
+        else:  # gamma: −2ℓ at shape a = 1/dispersion, scale = μ·dispersion
+            a = 1.0 / self.dispersion
+            # log f(y; a, θ=μ/a) = (a−1)log y − a·y/μ − a·log μ + a·log a − lnΓ(a)
+            ll = (
+                (a - 1.0) * s["logy"]
+                - a * s["y_over_mu"]
+                - a * s["logmu"]
+                + s["wsum"] * (a * np.log(a) - gammaln(a))
+            )
+            fam_aic = -2.0 * ll + 2.0
+        return float(fam_aic + 2.0 * self.rank)
+
+    # -- residuals ------------------------------------------------------
+    def residuals(self, residuals_type: str = "deviance") -> np.ndarray:
+        """Per-row residuals (valid rows only) — Spark's
+        ``residuals(residualsType)``: deviance | pearson | working |
+        response.  Weighted rows scale the deviance/pearson forms by √w."""
+        m = self._model
+        _, ginv, gprime = _link_fns(m.link)
+        vfn = _variance_fn(m.family)
+        x = self._ds.x
+        y = np.asarray(jax.device_get(self._ds.y), np.float64)
+        w = np.asarray(jax.device_get(self._ds.w), np.float64)
+        mu = np.asarray(jax.device_get(m.predict(x)), np.float64)
+        valid = w > 0
+        y, w, mu = y[valid], w[valid], mu[valid]
+        if residuals_type == "response":
+            return y - mu
+        if residuals_type == "working":
+            return (y - mu) * np.asarray(gprime(jnp.asarray(mu)))
+        if residuals_type == "pearson":
+            v = np.maximum(np.asarray(vfn(jnp.asarray(mu))), 1e-12)
+            return (y - mu) / np.sqrt(v) * np.sqrt(w)
+        if residuals_type == "deviance":
+            d = np.asarray(
+                _unit_deviance(m.family, jnp.asarray(y), jnp.asarray(mu))
+            )
+            return np.sign(y - mu) * np.sqrt(np.maximum(d, 0.0) * w)
+        raise ValueError(
+            "residuals_type must be deviance|pearson|working|response, got "
+            f"{residuals_type!r}"
+        )
+
+    # -- coefficient inference -----------------------------------------
+    def _require_unregularized(self) -> None:
+        if self._reg_param != 0.0:
+            raise RuntimeError(
+                "coefficient standard errors / t / p values are only "
+                "available for an unregularized fit (reg_param=0), "
+                "matching Spark's IRLS-solver restriction"
+            )
+
+    @cached_property
+    def coefficient_standard_errors(self) -> np.ndarray:
+        """√(diag((XᵀΩX)⁻¹)·dispersion) with Ω the IRLS weights at the
+        fitted coefficients — ordering (coefficients..., intercept), like
+        Spark.  Raises on a (near-)singular weighted Gram."""
+        self._require_unregularized()
+        m = self._model
+        _, ginv, gprime = _link_fns(m.link)
+        vfn = _variance_fn(m.family)
+        fit_intercept = self._fit_intercept
+
+        @jax.jit
+        def gram(x, w):
+            x = x.astype(jnp.float32)
+            eta = x @ jnp.asarray(m.coefficients, jnp.float32) + jnp.float32(
+                m.intercept
+            )
+            mu = _mu_clip(m.family, ginv(eta))
+            gp = gprime(mu)
+            om = w / jnp.maximum(gp * gp * vfn(mu), 1e-12)
+            xa = (
+                jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+                if fit_intercept
+                else x
+            )
+            return (xa * om[:, None]).T @ xa
+
+        g = np.asarray(jax.device_get(gram(self._ds.x, self._ds.w)), np.float64)
+        cond = np.linalg.cond(g)
+        if not np.isfinite(cond) or cond > 1e7:
+            raise RuntimeError(
+                "weighted design matrix is (near-)collinear (Gram condition "
+                f"number {cond:.2e}); standard errors are undefined"
+            )
+        return np.sqrt(np.maximum(np.diag(np.linalg.inv(g)) * self.dispersion, 0.0))
+
+    @cached_property
+    def t_values(self) -> np.ndarray:
+        self._require_unregularized()
+        beta = np.asarray(self._model.coefficients, np.float64)
+        if self._fit_intercept:
+            beta = np.r_[beta, float(self._model.intercept)]
+        return beta / self.coefficient_standard_errors
+
+    @cached_property
+    def p_values(self) -> np.ndarray:
+        """Two-sided; normal when dispersion is fixed (binomial/poisson),
+        Student's t with residual dof otherwise — Spark's rule."""
+        self._require_unregularized()
+        from scipy import stats
+
+        t = np.abs(self.t_values)
+        if self._model.family in ("binomial", "poisson"):
+            return 2.0 * stats.norm.sf(t)
+        return 2.0 * stats.t.sf(t, max(self.degrees_of_freedom, 1))
 
 
 @register_model("GeneralizedLinearRegressionModel")
@@ -174,6 +430,26 @@ class GeneralizedLinearRegressionModel(Model):
     link: str
     n_iter: int = 0
     deviance: float = 0.0
+    _summary: object | None = field(default=None, repr=False, compare=False)
+
+    @property
+    def has_summary(self) -> bool:
+        return self._summary is not None
+
+    def release_summary(self) -> None:
+        """Drop the summary's training-dataset reference (unpins device
+        memory — see models/summary.py memory note)."""
+        self._summary = None
+
+    @property
+    def summary(self) -> GeneralizedLinearRegressionTrainingSummary:
+        """Training summary (deviance/AIC/dispersion/inference) — fresh
+        fits only, like Spark's ``hasSummary``."""
+        if self._summary is None:
+            from .summary import summary_unavailable
+
+            raise summary_unavailable("GeneralizedLinearRegressionModel")
+        return self._summary
 
     def predict(self, x: jax.Array) -> jax.Array:
         """Mean prediction μ = g⁻¹(xβ + b) (Spark's prediction column)."""
@@ -270,7 +546,7 @@ class GeneralizedLinearRegression(Estimator):
             self.family, link, self.fit_intercept, self.standardize,
             self.max_iter,
         )
-        return GeneralizedLinearRegressionModel(
+        model = GeneralizedLinearRegressionModel(
             coefficients=np.asarray(jax.device_get(coef)),
             intercept=float(intercept),
             family=self.family,
@@ -278,3 +554,7 @@ class GeneralizedLinearRegression(Estimator):
             n_iter=int(it),
             deviance=float(deviance),
         )
+        model._summary = GeneralizedLinearRegressionTrainingSummary(
+            model, ds, self.reg_param, self.fit_intercept
+        )
+        return model

@@ -107,6 +107,164 @@ class TestGLM:
             )
 
 
+class TestGLMSummary:
+    """GeneralizedLinearRegressionTrainingSummary parity.
+
+    statsmodels is not in the image, so the oracle is an independent
+    NumPy reference computed in-test from the model's own fitted μ:
+    deviance / null deviance / Pearson χ² / IRLS-weighted Gram standard
+    errors use the textbook formulas (McCullagh & Nelder) directly on
+    host float64 — a different code path from the device reductions
+    under test."""
+
+    @staticmethod
+    def _np_reference(x, y, coef, intercept, family, link):
+        eta = x.astype(np.float64) @ np.asarray(coef, np.float64) + float(intercept)
+        inv = {"log": np.exp, "identity": lambda e: e,
+               "logit": lambda e: 1 / (1 + np.exp(-e)),
+               "inverse": lambda e: 1 / e}[link]
+        mu = inv(eta)
+        ybar = y.mean()
+        if family == "poisson":
+            ysafe = np.maximum(y, 1e-300)
+            dev = 2 * np.sum(np.where(y > 0, y * np.log(ysafe / mu), 0) - (y - mu))
+            dev0 = 2 * np.sum(
+                np.where(y > 0, y * np.log(ysafe / ybar), 0) - (y - ybar)
+            )
+            V = mu
+            gp = 1 / mu
+        elif family == "gamma":
+            dev = 2 * np.sum(-np.log(y / mu) + (y - mu) / mu)
+            dev0 = 2 * np.sum(-np.log(y / ybar) + (y - ybar) / ybar)
+            V = mu**2
+            gp = {"log": 1 / mu, "inverse": -1 / mu**2}[link]
+        else:  # gaussian identity
+            dev = np.sum((y - mu) ** 2)
+            dev0 = np.sum((y - ybar) ** 2)
+            V = np.ones_like(mu)
+            gp = np.ones_like(mu)
+        pearson = np.sum((y - mu) ** 2 / V)
+        xa = np.c_[x.astype(np.float64), np.ones(len(y))]
+        om = 1.0 / (gp * gp * V)
+        gram = (xa * om[:, None]).T @ xa
+        cov = np.linalg.inv(gram)
+        return dict(dev=dev, dev0=dev0, pearson=pearson,
+                    se=np.sqrt(np.diag(cov)), mu=mu)
+
+    def test_poisson_summary_vs_numpy(self, rng, mesh8):
+        n, d = 4000, 3
+        x = rng.normal(0, 0.5, size=(n, d)).astype(np.float32)
+        y = rng.poisson(np.exp(x @ [0.8, -0.5, 0.3] + 0.7)).astype(np.float32)
+        m = ht.GeneralizedLinearRegression(family="poisson").fit((x, y), mesh=mesh8)
+        s = m.summary
+        ref = self._np_reference(x, y, m.coefficients, m.intercept, "poisson", "log")
+        np.testing.assert_allclose(s.deviance, ref["dev"], rtol=1e-4)
+        np.testing.assert_allclose(s.null_deviance, ref["dev0"], rtol=1e-4)
+        np.testing.assert_allclose(s.pearson_chi_squared, ref["pearson"], rtol=1e-4)
+        assert s.dispersion == 1.0
+        np.testing.assert_allclose(
+            s.coefficient_standard_errors, ref["se"], rtol=2e-3
+        )
+        # AIC = −2ℓ + 2·rank with ℓ the exact poisson loglik
+        from scipy.special import gammaln
+
+        ll = np.sum(y * np.log(ref["mu"]) - ref["mu"] - gammaln(y + 1.0))
+        np.testing.assert_allclose(s.aic, -2 * ll + 2 * s.rank, rtol=1e-5)
+        # strong true effects → tiny p-values; t = beta/se
+        assert (s.p_values[:3] < 1e-6).all()
+        np.testing.assert_allclose(
+            s.t_values,
+            np.r_[np.asarray(m.coefficients, np.float64), m.intercept] / ref["se"],
+            rtol=2e-3,
+        )
+        assert s.num_instances == n
+        assert s.degrees_of_freedom == n - 4
+        assert s.residual_degree_of_freedom_null == n - 1
+
+    def test_gamma_summary_vs_numpy(self, rng, mesh8):
+        n, d = 4000, 2
+        x = rng.normal(0, 0.4, size=(n, d)).astype(np.float32)
+        mu = np.exp(x @ [0.6, -0.4] + 1.0)
+        y = rng.gamma(shape=4.0, scale=mu / 4.0).astype(np.float32)
+        m = ht.GeneralizedLinearRegression(family="gamma", link="log").fit(
+            (x, y), mesh=mesh8
+        )
+        s = m.summary
+        ref = self._np_reference(x, y, m.coefficients, m.intercept, "gamma", "log")
+        np.testing.assert_allclose(s.deviance, ref["dev"], rtol=1e-3)
+        np.testing.assert_allclose(s.null_deviance, ref["dev0"], rtol=1e-3)
+        # moment dispersion ≈ 1/shape = 0.25 for gamma(shape=4) noise
+        disp = ref["pearson"] / (n - 3)
+        np.testing.assert_allclose(s.dispersion, disp, rtol=1e-3)
+        np.testing.assert_allclose(
+            s.coefficient_standard_errors, ref["se"] * np.sqrt(disp), rtol=2e-3
+        )
+        assert 0.2 < s.dispersion < 0.32
+        # gamma AIC: −2·Σ log f(y; a=1/φ, scale=μφ) + 2(rank+1)
+        from scipy import stats as sps
+
+        a = 1.0 / s.dispersion
+        ll = np.sum(sps.gamma.logpdf(y, a, scale=ref["mu"] * s.dispersion))
+        np.testing.assert_allclose(s.aic, -2 * ll + 2 * (s.rank + 1), rtol=1e-4)
+
+    @pytest.mark.fast
+    def test_gaussian_summary_matches_lr(self, rng, mesh8):
+        n, d = 2000, 4
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x @ rng.normal(size=d) + 1.0 + 0.3 * rng.normal(size=n)).astype(
+            np.float32
+        )
+        glm = ht.GeneralizedLinearRegression(family="gaussian").fit(
+            (x, y), mesh=mesh8
+        )
+        lr = ht.LinearRegression().fit((x, y), mesh=mesh8)
+        s = glm.summary
+        # same unregularized gaussian model → same inference statistics
+        np.testing.assert_allclose(
+            s.coefficient_standard_errors,
+            lr.summary.coefficient_standard_errors,
+            rtol=2e-3,
+        )
+        np.testing.assert_allclose(s.p_values, lr.summary.p_values, atol=1e-6)
+        # dispersion = RSS/(n−p) = classic σ̂²
+        np.testing.assert_allclose(
+            s.dispersion, s.deviance / (n - 5), rtol=1e-6
+        )
+        # null deviance = TSS
+        np.testing.assert_allclose(
+            s.null_deviance, np.sum((y - y.mean()) ** 2), rtol=1e-4
+        )
+        # residual types
+        r = s.residuals("response")
+        np.testing.assert_allclose(
+            r, y - np.asarray(glm.predict_numpy(x)), atol=1e-5
+        )
+        np.testing.assert_allclose(s.residuals("deviance"), r, atol=1e-5)
+        np.testing.assert_allclose(s.residuals("pearson"), r, atol=1e-5)
+        np.testing.assert_allclose(s.residuals("working"), r, atol=1e-5)
+        with pytest.raises(ValueError, match="residuals_type"):
+            s.residuals("anscombe")
+
+    @pytest.mark.fast
+    def test_summary_lifecycle(self, rng, mesh8, tmp_path):
+        x = np.abs(rng.normal(size=(256, 2))).astype(np.float32) + 0.1
+        y = (x[:, 0] * 2 + 0.5).astype(np.float32)
+        m = ht.GeneralizedLinearRegression(family="gamma").fit((x, y), mesh=mesh8)
+        assert m.has_summary
+        m.write().overwrite().save(str(tmp_path / "g"))
+        back = ht.load_model(str(tmp_path / "g"))
+        assert not back.has_summary
+        with pytest.raises(RuntimeError, match="no training summary"):
+            back.summary
+        # regularized fit refuses inference stats but serves deviance
+        mr = ht.GeneralizedLinearRegression(family="gamma", reg_param=0.1).fit(
+            (x, y), mesh=mesh8
+        )
+        assert np.isfinite(mr.summary.deviance)
+        with pytest.raises(RuntimeError, match="unregularized"):
+            mr.summary.coefficient_standard_errors
+
+
 class TestOneVsRest:
     def test_multiclass_with_logistic(self, rng, mesh8):
         n = 1500
