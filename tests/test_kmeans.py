@@ -156,3 +156,24 @@ def test_silhouette_mesh_resident_device_inputs(rng, mesh8):
     )
     assert abs(on_mesh - on_host) < 1e-5
     assert abs(on_mesh - ref) < 1e-4
+
+
+@pytest.mark.fast
+def test_kmeans_bf16_precision_parity(rng, mesh8):
+    """matmul_precision="bf16" (native single-pass MXU mode, f32
+    accumulation) recovers the same clustering as exact f32 on separated
+    blobs — the parity gate behind the bench's bf16 headline A/B."""
+    x, labels, _ = _blobs(rng, n=800, k=4, d=6)
+    exact = KMeans(k=4, seed=0).fit(x, mesh=mesh8)
+    fast = KMeans(k=4, seed=0, matmul_precision="bf16").fit(x, mesh=mesh8)
+    # same partition (centers may be ulp-perturbed; match by assignment)
+    a, b = exact.predict_numpy(x), fast.predict_numpy(x)
+    remap = {}
+    for ca, cb in zip(a, b):
+        remap.setdefault(ca, cb)
+    assert np.mean([remap[ca] == cb for ca, cb in zip(a, b)]) > 0.995
+    np.testing.assert_allclose(
+        fast.training_cost, exact.training_cost, rtol=1e-2
+    )
+    with pytest.raises(ValueError, match="matmul_precision"):
+        KMeans(k=4, matmul_precision="fp8").fit(x, mesh=mesh8)
