@@ -18,7 +18,38 @@ import numpy as np
 
 from ...io.model_io import register_model
 from ..base import Estimator, Model, as_device_dataset, check_features
-from .engine import GrownForest, grow_forest, predict_forest
+from .engine import GrownForest, grow_forest, grow_forest_outofcore, predict_forest
+
+
+def _fit_grown(
+    data, label_col, weight_col, mesh, subset_strategy: str | None = None,
+    **kw,
+) -> GrownForest:
+    """Shared fit dispatch for every tree estimator: a
+    :class:`~...parallel.outofcore.HostDataset` streams rows ≫ HBM through
+    the level-order engine's out-of-core driver (same splits — see
+    ``grow_forest_outofcore``); anything else stages on the mesh.
+    ``subset_strategy`` (forests) resolves to a per-node feature count
+    once the dataset's width is known."""
+    from ...parallel.outofcore import HostDataset
+
+    def subset_kw(d: int) -> dict:
+        if subset_strategy is None:
+            return {}
+        from .random_forest import _subset_size
+
+        return {
+            "feature_subset_size": _subset_size(subset_strategy, d, kw["task"])
+        }
+
+    if isinstance(data, HostDataset):
+        if data.y is None:
+            raise ValueError("tree fit needs labels: HostDataset(y=...)")
+        return grow_forest_outofcore(
+            data, mesh=mesh, **subset_kw(data.n_features), **kw
+        )
+    ds = as_device_dataset(data, label_col, mesh=mesh, weight_col=weight_col)
+    return grow_forest(ds, mesh=mesh, **subset_kw(ds.n_features), **kw)
 
 
 @dataclass
@@ -153,9 +184,8 @@ class _TreeParams:
 @dataclass(frozen=True)
 class DecisionTreeRegressor(Estimator, _TreeParams):
     def fit(self, data, label_col: str | None = None, mesh=None) -> DecisionTreeModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
-        grown = grow_forest(
-            ds,
+        grown = _fit_grown(
+            data, label_col or self.label_col, self.weight_col, mesh,
             task="regression",
             num_trees=1,
             max_depth=self.max_depth,
@@ -163,7 +193,6 @@ class DecisionTreeRegressor(Estimator, _TreeParams):
             min_instances_per_node=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
             seed=self.seed,
-            mesh=mesh,
             categorical_features=self.categorical_features,
         )
         return _from_grown(DecisionTreeModel, grown, "regression", 2)
@@ -175,9 +204,8 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
     label_col: str = "LOS_binary"
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> DecisionTreeModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
-        grown = grow_forest(
-            ds,
+        grown = _fit_grown(
+            data, label_col or self.label_col, self.weight_col, mesh,
             task="classification",
             num_classes=self.num_classes,
             num_trees=1,
@@ -186,7 +214,6 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
             min_instances_per_node=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
             seed=self.seed,
-            mesh=mesh,
             categorical_features=self.categorical_features,
         )
         return _from_grown(DecisionTreeModel, grown, "classification", self.num_classes)

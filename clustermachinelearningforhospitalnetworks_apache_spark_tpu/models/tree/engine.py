@@ -197,13 +197,31 @@ def _make_level_step(
     VectorIndexer maxCategories default).
     """
     hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T, use_pallas)
+    select_fn = _make_select_fn(level_nodes, d, B, S, T, task, cat_arities)
+
+    def step(binned_t, base_t, w_tree, pos, feat_mask, min_inst, min_gain):
+        hist = hist_fn(binned_t, base_t, w_tree, pos)  # (T, LN, d, B, S)
+        return select_fn(hist, feat_mask, min_inst, min_gain)
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=64)
+def _make_select_fn(
+    level_nodes: int, d: int, B: int, S: int, T: int, task: str,
+    cat_arities: tuple[int, ...] | None = None,
+):
+    """jit'd on-device split selection from a level's accumulated
+    (T, LN, d, B, S) histogram — the back half of :func:`_make_level_step`,
+    exposed separately so the out-of-core driver can run the SAME selection
+    on histograms that were psum-accumulated across streamed host blocks
+    (VERDICT r3 next #4: levels are sufficient-stat passes too)."""
     neg_inf = jnp.float32(-jnp.inf)
     any_cat = cat_arities is not None and any(a > 0 for a in cat_arities)
     if any_cat:
         is_cat_np = np.asarray([a > 0 for a in cat_arities], dtype=bool)
 
-    def step(binned_t, base_t, w_tree, pos, feat_mask, min_inst, min_gain):
-        hist = hist_fn(binned_t, base_t, w_tree, pos)  # (T, LN, d, B, S)
+    def select(hist, feat_mask, min_inst, min_gain):
         agg = hist[:, :, 0, :, :].sum(axis=2)          # (T, LN, S)
 
         if any_cat:
@@ -290,7 +308,7 @@ def _make_level_step(
             catmask = jnp.zeros(best_bin.shape, jnp.uint32)
         return agg, best_gain, best_feat, best_bin, do_split, catmask
 
-    return jax.jit(step)
+    return jax.jit(select)
 
 
 #: _advance_level unrolls a select chain over the level frontier; past this
@@ -446,6 +464,106 @@ def bin_feature_matrix(
     return binned.T
 
 
+class _ForestRecorder:
+    """Host-side accumulation of per-level winners into the flat heap
+    arrays + the materialization tail (thresholds, leaf values, parent
+    propagation, importance normalization) — shared verbatim by the
+    resident and out-of-core growth drivers so both emit identical
+    :class:`GrownForest` artifacts from identical winner tensors."""
+
+    def __init__(self, T: int, d: int, S: int, max_depth: int, is_cat: np.ndarray):
+        total = 2 ** (max_depth + 1) - 1
+        self.max_depth = max_depth
+        self.is_cat = is_cat
+        self.split_feat = np.full((T, total), -1, dtype=np.int32)
+        self.split_bin = np.zeros((T, total), dtype=np.int32)
+        self.split_catmask = np.zeros((T, total), dtype=np.uint32)
+        self.node_stats = np.zeros((T, total, S), dtype=np.float64)
+        self.importances = np.zeros((T, d), dtype=np.float64)
+
+    def record_level(self, depth: int, fetched) -> None:
+        agg, best_gain, best_feat, best_bin, do_split, catmask = (
+            np.asarray(fetched[0], np.float64),
+            np.asarray(fetched[1], np.float64),
+            np.asarray(fetched[2], np.int32),
+            np.asarray(fetched[3], np.int32),
+            np.asarray(fetched[4], bool),
+            np.asarray(fetched[5], np.uint32),
+        )
+        level_nodes = 1 << depth
+        level_base = level_nodes - 1
+        self.node_stats[:, level_base : level_base + level_nodes] = agg
+        if depth == self.max_depth:
+            return
+        sl = slice(level_base, level_base + level_nodes)
+        self.split_feat[:, sl] = np.where(do_split, best_feat, -1)
+        self.split_bin[:, sl] = np.where(do_split, best_bin, 0)
+        self.split_catmask[:, sl] = np.where(
+            do_split & self.is_cat[best_feat], catmask, np.uint32(0)
+        )
+        for t in range(best_feat.shape[0]):
+            np.add.at(
+                self.importances[t],
+                best_feat[t][do_split[t]],
+                best_gain[t][do_split[t]],
+            )
+
+    def materialize(
+        self, thr: np.ndarray, task: str, num_classes: int,
+        cat_arities: tuple[int, ...] | None, B: int,
+    ) -> "GrownForest":
+        T, total = self.split_feat.shape
+        threshold = np.zeros((T, total), dtype=np.float32)
+        valid_split = (self.split_feat >= 0) & ~self.is_cat[
+            np.maximum(self.split_feat, 0)
+        ]
+        f_idx = np.maximum(self.split_feat, 0)
+        b_idx = np.minimum(self.split_bin, B - 2)
+        threshold[valid_split] = thr[f_idx, b_idx][valid_split].astype(np.float32)
+
+        node_stats = self.node_stats
+        if task == "regression":
+            w = node_stats[..., 0]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                mean = np.where(
+                    w > 0, node_stats[..., 1] / np.maximum(w, 1e-12), 0.0
+                )
+            value = mean[..., None].astype(np.float32)  # (T, total, 1)
+        else:
+            w = node_stats.sum(-1, keepdims=True)
+            value = np.where(
+                w > 0, node_stats / np.maximum(w, 1e-12), 1.0 / num_classes
+            ).astype(np.float32)  # (T, total, C) class probabilities
+
+        # propagate values down so un-populated heap slots predict their parent
+        for parent in range(total // 2):
+            for child in (2 * parent + 1, 2 * parent + 2):
+                empty = (
+                    node_stats[:, child].sum(-1) <= 0
+                    if task == "classification"
+                    else node_stats[:, child, 0] <= 0
+                )
+                value[:, child][empty] = value[:, parent][empty]
+
+        imp = self.importances
+        tot_imp = imp.sum(axis=1, keepdims=True)
+        imp = np.where(tot_imp > 0, imp / np.maximum(tot_imp, 1e-12), 0.0)
+        has_cat = cat_arities is not None and any(a > 0 for a in cat_arities)
+        return GrownForest(
+            split_feat=self.split_feat,
+            split_bin=self.split_bin,
+            threshold=threshold,
+            value=value,
+            importances=imp,
+            max_depth=self.max_depth,
+            bin_thresholds=thr,
+            split_catmask=self.split_catmask if has_cat else None,
+            cat_arities=(
+                np.asarray(cat_arities, dtype=np.int32) if has_cat else None
+            ),
+        )
+
+
 # ------------------------------------------------------------------- output
 @dataclass
 class GrownForest:
@@ -563,16 +681,11 @@ def grow_forest(
             ds.y.astype(jnp.int32), num_classes, dtype=jnp.float32, axis=0
         )  # (C, n)
 
-    total_nodes = 2 ** (max_depth + 1) - 1
-    split_feat = np.full((T, total_nodes), -1, dtype=np.int32)
-    split_bin = np.zeros((T, total_nodes), dtype=np.int32)
-    split_catmask = np.zeros((T, total_nodes), dtype=np.uint32)
-    node_stats = np.zeros((T, total_nodes, S), dtype=np.float64)
-    importances = np.zeros((T, d), dtype=np.float64)
     cat_flags_dev = (
         jnp.asarray([a > 0 for a in cat_arities], bool) if cat else None
     )
     is_cat_host = np.asarray([f in cat for f in range(d)], dtype=bool)
+    rec = _ForestRecorder(T, d, S, max_depth, is_cat_host)
 
     node_id = jnp.zeros((T, n_pad), jnp.int32)  # all rows start at the root
 
@@ -613,79 +726,192 @@ def grow_forest(
                 catmask_d if cat else None, cat_flags_dev,
             )
 
-    # one host fetch for every level's winners
+    # one host fetch for every level's winners; the shared recorder +
+    # materialization tail emits the GrownForest (same code as out-of-core)
     for depth, fetched in enumerate(jax.device_get(level_out)):
-        agg, best_gain, best_feat, best_bin, do_split, catmask = (
-            np.asarray(fetched[0], np.float64),
-            np.asarray(fetched[1], np.float64),
-            np.asarray(fetched[2], np.int32),
-            np.asarray(fetched[3], np.int32),
-            np.asarray(fetched[4], bool),
-            np.asarray(fetched[5], np.uint32),
-        )
-        level_nodes = 1 << depth
-        level_base = level_nodes - 1
-        node_stats[:, level_base : level_base + level_nodes] = agg
-        if depth == max_depth:
-            break
-        sl = slice(level_base, level_base + level_nodes)
-        split_feat[:, sl] = np.where(do_split, best_feat, -1)
-        split_bin[:, sl] = np.where(do_split, best_bin, 0)
-        split_catmask[:, sl] = np.where(
-            do_split & is_cat_host[best_feat], catmask, np.uint32(0)
-        )
-        for t in range(T):
-            np.add.at(
-                importances[t],
-                best_feat[t][do_split[t]],
-                best_gain[t][do_split[t]],
-            )
+        rec.record_level(depth, fetched)
+    return rec.materialize(thr, task, num_classes, cat_arities, B)
 
-    # 4. leaf/threshold materialization (categorical nodes carry their
-    # left-set bitmask instead of a real-valued threshold)
-    threshold = np.zeros((T, total_nodes), dtype=np.float32)
-    valid_split = (split_feat >= 0) & ~is_cat_host[np.maximum(split_feat, 0)]
-    f_idx = np.maximum(split_feat, 0)
-    b_idx = np.minimum(split_bin, B - 2)
-    threshold[valid_split] = thr[f_idx, b_idx][valid_split].astype(np.float32)
+
+@lru_cache(maxsize=16)
+def _make_block_bootstrap(mesh: Mesh, T: int, b: int, rate: float):
+    """Per-BLOCK Poisson bootstrap draw for out-of-core forests, keyed by
+    (seed, block index) so every level's re-stream of the same block draws
+    the SAME weights.  The stream differs from the resident path's single
+    (T, n_pad) draw (same distribution, different PRNG shape) — bit-equal
+    out-of-core-vs-resident checks therefore use ``bootstrap=False``."""
+    from jax.sharding import NamedSharding
+
+    def draw(seed, block_idx):
+        key = jax.random.fold_in(jax.random.key(seed), block_idx)
+        return jax.random.poisson(key, rate, shape=(T, b)).astype(jnp.float32)
+
+    return jax.jit(
+        draw, out_shardings=NamedSharding(mesh, P(None, DATA_AXIS))
+    )
+
+
+@jax.jit
+def _add_hist(a, b):
+    return a + b
+
+
+def grow_forest_outofcore(
+    hd,
+    *,
+    task: str,
+    num_classes: int = 2,
+    num_trees: int = 1,
+    max_depth: int = 5,
+    max_bins: int = 32,
+    min_instances_per_node: int = 1,
+    min_info_gain: float = 0.0,
+    feature_subset_size: int | None = None,
+    bootstrap: bool = False,
+    subsampling_rate: float = 1.0,
+    seed: int = 0,
+    mesh: Mesh | None = None,
+    init_sample_size: int = 65536,
+    categorical_features: dict[int, int] | None = None,
+    bin_thresholds: np.ndarray | None = None,
+) -> GrownForest:
+    """Rows ≫ HBM level-order growth: every tree level is ONE more
+    sufficient-statistics pass over streamed host blocks (VERDICT r3 next
+    #4).  Spark's disk-backed-RDD fits at reference
+    ``mllearnforhospitalnetwork.py:150-158`` stream partitions the same
+    way per ``findBestSplits`` level.
+
+    Per level: each block is re-binned on device against the fit-start
+    quantile thresholds, descended through the splits recorded so far
+    (replaying :func:`_advance_level` — the identical routing the resident
+    path applied incrementally), its (T, LN, d, B, S) histogram is psum'd
+    over the mesh and accumulated across blocks, and the SAME on-device
+    :func:`_make_select_fn` picks the winners.  With exact (f32-closed)
+    sums the resulting splits are bit-identical to the resident engine's;
+    device residency stays bounded by ``hd.max_device_rows``.
+    """
+    from ...parallel.mesh import default_mesh as _default_mesh
+
+    mesh = mesh or _default_mesh()
+    d = hd.n_features
+    T = num_trees
+    B = max_bins
+
+    cat = dict(categorical_features or {})
+    for f, arity in cat.items():
+        if not 0 <= f < d:
+            raise ValueError(f"categorical feature index {f} out of range [0, {d})")
+        if not 2 <= arity <= min(32, B):
+            raise ValueError(
+                f"categorical feature {f} arity {arity} must be in "
+                f"[2, min(32, max_bins={B})]"
+            )
+    cat_arities = tuple(cat.get(f, 0) for f in range(d)) if cat else None
+    cat_flags_dev = (
+        jnp.asarray([a > 0 for a in cat_arities], bool) if cat else None
+    )
+    is_cat_host = np.asarray([f in cat for f in range(d)], dtype=bool)
+
+    # 1. binning thresholds from a bounded host sample (same estimator as
+    # the resident path's sample_valid_rows → quantile_thresholds); or the
+    # caller's precomputed thresholds (GBT bins once across rounds)
+    if bin_thresholds is not None:
+        thr = np.asarray(bin_thresholds, dtype=np.float64)
+        if thr.shape != (d, B - 1):
+            raise ValueError(f"bin_thresholds shape {thr.shape} != ({d}, {B - 1})")
+        if hd.count() == 0.0:
+            raise ValueError("tree fit on an empty dataset")
+    else:
+        sample = hd.sample_rows(init_sample_size, seed)
+        if sample.shape[0] == 0:
+            raise ValueError("tree fit on an empty dataset")
+        thr = quantile_thresholds(sample, B)
 
     if task == "regression":
-        w = node_stats[..., 0]
-        with np.errstate(divide="ignore", invalid="ignore"):
-            mean = np.where(w > 0, node_stats[..., 1] / np.maximum(w, 1e-12), 0.0)
-        value = mean[..., None].astype(np.float32)  # (T, total, 1)
+        S = 3
     else:
-        w = node_stats.sum(-1, keepdims=True)
-        value = np.where(
-            w > 0, node_stats / np.maximum(w, 1e-12), 1.0 / num_classes
-        ).astype(np.float32)  # (T, total, C) class probabilities
+        S = num_classes
 
-    # propagate values down so un-populated heap slots predict their parent
-    for parent in range(total_nodes // 2):
-        for child in (2 * parent + 1, 2 * parent + 2):
-            empty = (
-                node_stats[:, child].sum(-1) <= 0
-                if task == "classification"
-                else node_stats[:, child, 0] <= 0
-            )
-            value[:, child][empty] = value[:, parent][empty]
-
-    tot_imp = importances.sum(axis=1, keepdims=True)
-    importances = np.where(tot_imp > 0, importances / np.maximum(tot_imp, 1e-12), 0.0)
-
-    return GrownForest(
-        split_feat=split_feat,
-        split_bin=split_bin,
-        threshold=threshold,
-        value=value,
-        importances=importances,
-        max_depth=max_depth,
-        bin_thresholds=thr,
-        split_catmask=split_catmask if cat else None,
-        cat_arities=(
-            np.asarray(cat_arities, dtype=np.int32) if cat else None
-        ),
+    n_blocks, b = hd.block_shape(mesh)
+    boot_fn = (
+        _make_block_bootstrap(mesh, T, b, float(subsampling_rate))
+        if bootstrap
+        else None
     )
+
+    rec = _ForestRecorder(T, d, S, max_depth, is_cat_host)
+    min_inst = jnp.float32(min_instances_per_node)
+    min_gain = jnp.float32(min_info_gain)
+
+    # per-level winners kept ON DEVICE for the descend replay (tiny)
+    winners: list[tuple] = []   # (feat, bin, do_split, catmask) per level
+
+    def block_arrays(blk, block_idx):
+        """(binned_t, base_t, w_tree) for one streamed block."""
+        binned_t = bin_feature_matrix(blk.x, thr, cat, w=blk.w)
+        if task == "regression":
+            y = blk.y.astype(jnp.float32)
+            base_t = jnp.stack([jnp.ones_like(y), y, y * y], axis=0)
+        else:
+            base_t = jax.nn.one_hot(
+                blk.y.astype(jnp.int32), num_classes, dtype=jnp.float32, axis=0
+            )
+        if boot_fn is not None:
+            w_tree = boot_fn(seed, block_idx) * blk.w[None, :].astype(jnp.float32)
+        else:
+            w_tree = jnp.broadcast_to(
+                blk.w.astype(jnp.float32)[None, :], (T, b)
+            )
+        return binned_t, base_t, w_tree
+
+    def descend(binned_t, upto_depth: int):
+        """Replay the recorded splits: rows → their heap node at
+        ``upto_depth`` (same :func:`_advance_level` the resident loop ran
+        once per level, applied per block)."""
+        node_id = jnp.zeros((T, b), jnp.int32)
+        for dep in range(upto_depth):
+            level_nodes = 1 << dep
+            level_base = level_nodes - 1
+            pos = jnp.where(node_id >= 0, node_id - level_base, -1)
+            pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
+            feat_d, bin_d, split_d, catmask_d = winners[dep]
+            node_id = _advance_level(
+                binned_t, node_id, pos, feat_d, bin_d, split_d, level_base,
+                catmask_d if cat else None, cat_flags_dev,
+            )
+        return node_id
+
+    for depth in range(max_depth + 1):
+        level_nodes = 1 << depth
+        level_base = level_nodes - 1
+        if feature_subset_size is not None and feature_subset_size < d:
+            mask = _make_subset_mask(T, level_nodes, d, feature_subset_size)(
+                seed, depth
+            )
+        else:
+            mask = jnp.ones((T, level_nodes, d), jnp.float32)
+
+        hist_fn = _make_level_hist(mesh, level_nodes, d, B, S, T)
+        hist = None
+        for i, blk in enumerate(hd.blocks(mesh)):
+            binned_t, base_t, w_tree = block_arrays(blk, i)
+            node_id = descend(binned_t, depth)
+            pos = jnp.where(node_id >= 0, node_id - level_base, -1)
+            pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
+            h = hist_fn(binned_t, base_t, w_tree, pos)
+            hist = h if hist is None else _add_hist(hist, h)
+
+        select_fn = _make_select_fn(level_nodes, d, B, S, T, task, cat_arities)
+        agg_d, gain_d, feat_d, bin_d, split_d, catmask_d = select_fn(
+            hist, mask, min_inst, min_gain
+        )
+        winners.append((feat_d, bin_d, split_d, catmask_d))
+        rec.record_level(
+            depth,
+            jax.device_get((agg_d, gain_d, feat_d, bin_d, split_d, catmask_d)),
+        )
+
+    return rec.materialize(thr, task, num_classes, cat_arities, B)
 
 
 # ------------------------------------------------------------------ predict

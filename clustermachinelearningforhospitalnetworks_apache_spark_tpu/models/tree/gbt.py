@@ -311,10 +311,124 @@ class _GBTParams:
         )
 
 
+    def _boost_outofcore(self, hd, mesh, loss: str) -> GBTModel:
+        """Rows ≫ HBM boosting (VERDICT r3 next #4): the margin column F
+        lives on the HOST (n floats — never device-resident), each round
+        grows one out-of-core tree (engine.grow_forest_outofcore) on the
+        host-computed pseudo-residuals, then F is advanced by streaming
+        blocks through the new tree only.  Quantile thresholds are
+        computed once and reused across rounds like the resident path;
+        ``validation_indicator_col`` needs a table input and is rejected
+        up front."""
+        from ...parallel.outofcore import HostDataset
+        from .binning import quantile_thresholds
+        from .engine import grow_forest_outofcore
+
+        if self.validation_indicator_col is not None:
+            raise ValueError(
+                "validation_indicator_col needs a table input to resolve "
+                "the column; out-of-core HostDataset fits train on all rows"
+            )
+        if hd.y is None:
+            raise ValueError("GBT fit needs labels: HostDataset(y=...)")
+        if hd.n == 0 or hd.count() == 0.0:
+            raise ValueError("GBT fit on an empty dataset")
+        y = np.asarray(hd.y, np.float32)
+        w = (
+            np.asarray(hd.w, np.float32)
+            if hd.w is not None
+            else np.ones((hd.n,), np.float32)
+        )
+        n = max(float(w.sum()), 1.0)
+
+        sample = hd.sample_rows(self.init_sample_size, self.seed)
+        thr = quantile_thresholds(sample, self.max_bins)
+
+        ybar = float((y * w).sum() / n)
+        if loss == "squared":
+            f0 = ybar
+        else:
+            p = min(max(ybar, 1e-6), 1.0 - 1e-6)
+            f0 = 0.5 * float(np.log(p / (1.0 - p)))
+
+        def residual(f):
+            if loss == "squared":
+                return y - f
+            return 4.0 * (y - 1.0 / (1.0 + np.exp(-2.0 * f)))
+
+        cat = self.categorical_features
+        cat_flags = (
+            jnp.asarray([f in cat for f in range(hd.n_features)]) if cat else None
+        )
+
+        f_cur = np.full((hd.n,), np.float32(f0), np.float32)
+        trees, importances = [], []
+        for t in range(self.max_iter):
+            res_hd = HostDataset(
+                hd.x, residual(f_cur).astype(np.float32), hd.w,
+                max_device_rows=hd.max_device_rows,
+            )
+            grown = grow_forest_outofcore(
+                res_hd,
+                task="regression",
+                num_trees=1,
+                max_depth=self.max_depth,
+                max_bins=self.max_bins,
+                min_instances_per_node=self.min_instances_per_node,
+                min_info_gain=self.min_info_gain,
+                bootstrap=self.subsampling_rate < 1.0,
+                subsampling_rate=self.subsampling_rate,
+                seed=self.seed + t,
+                mesh=mesh,
+                categorical_features=cat,
+                bin_thresholds=thr,
+            )
+            trees.append(grown)
+            importances.append(grown.importances[0])
+            # advance the host margin: stream blocks through the NEW tree
+            sf = jnp.asarray(grown.split_feat)
+            th = jnp.asarray(grown.threshold)
+            val = jnp.asarray(grown.value)
+            cm = (
+                jnp.asarray(grown.split_catmask, jnp.uint32)
+                if cat
+                else None
+            )
+            _, b = hd.block_shape(mesh)
+            for i, blk in enumerate(hd.blocks(mesh)):
+                pred = predict_forest(blk.x, sf, th, val, cm, cat_flags)[0, :, 0]
+                s = i * b
+                e = min(s + b, hd.n)
+                f_cur[s:e] += self.step_size * np.asarray(
+                    jax.device_get(pred)
+                )[: e - s]
+
+        imp = np.sum(importances, axis=0)
+        s = imp.sum()
+        return GBTModel(
+            task="regression" if loss == "squared" else "classification",
+            split_feat=np.concatenate([g.split_feat for g in trees]),
+            threshold=np.concatenate([g.threshold for g in trees]),
+            value=np.concatenate([g.value for g in trees]),
+            init=f0,
+            learning_rate=self.step_size,
+            feature_importances=imp / s if s > 0 else imp,
+            max_depth=self.max_depth,
+            split_catmask=(
+                np.concatenate([g.split_catmask for g in trees]) if cat else None
+            ),
+            cat_arities=trees[0].cat_arities if cat else None,
+        )
+
+
 @dataclass(frozen=True)
 class GBTRegressor(Estimator, _GBTParams):
     def fit(self, data, label_col: str | None = None, mesh=None) -> GBTModel:
+        from ...parallel.outofcore import HostDataset
+
         mesh = mesh or default_mesh()
+        if isinstance(data, HostDataset):
+            return self._boost_outofcore(data, mesh, loss="squared")
         ds = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )
@@ -328,7 +442,20 @@ class GBTClassifier(Estimator, _GBTParams):
     label_col: str = "LOS_binary"
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> GBTModel:
+        from ...parallel.outofcore import HostDataset
+
         mesh = mesh or default_mesh()
+        if isinstance(data, HostDataset):
+            if data.y is None:
+                raise ValueError("GBT fit needs labels: HostDataset(y=...)")
+            wv = np.asarray(data.w) if data.w is not None else None
+            yv = np.asarray(data.y)[wv > 0] if wv is not None else np.asarray(data.y)
+            uniq = np.unique(yv)
+            if not np.all(np.isin(uniq, [0.0, 1.0])):
+                raise ValueError(
+                    f"GBTClassifier is binary (labels 0/1); got labels {uniq[:5]}"
+                )
+            return self._boost_outofcore(data, mesh, loss="logistic")
         ds = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )

@@ -20,8 +20,7 @@ import numpy as np
 
 from ...io.model_io import register_model
 from ..base import Estimator, as_device_dataset
-from .decision_tree import _from_grown, _TreeEnsembleModel, _TreeParams
-from .engine import grow_forest
+from .decision_tree import _fit_grown, _from_grown, _TreeEnsembleModel, _TreeParams
 
 
 def _subset_size(strategy: str, d: int, task: str) -> int | None:
@@ -52,22 +51,18 @@ class RandomForestRegressor(Estimator, _TreeParams):
     feature_subset_strategy: str = "auto"
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> RandomForestModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
-        grown = grow_forest(
-            ds,
+        grown = _fit_grown(
+            data, label_col or self.label_col, self.weight_col, mesh,
             task="regression",
             num_trees=self.num_trees,
             max_depth=self.max_depth,
             max_bins=self.max_bins,
             min_instances_per_node=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
-            feature_subset_size=_subset_size(
-                self.feature_subset_strategy, ds.n_features, "regression"
-            ),
+            subset_strategy=self.feature_subset_strategy,
             bootstrap=True,
             subsampling_rate=self.subsampling_rate,
             seed=self.seed,
-            mesh=mesh,
             categorical_features=self.categorical_features,
         )
         return _from_grown(RandomForestModel, grown, "regression", 2)
@@ -82,9 +77,8 @@ class RandomForestClassifier(Estimator, _TreeParams):
     label_col: str = "LOS_binary"
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> RandomForestModel:
-        ds = as_device_dataset(data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col)
-        grown = grow_forest(
-            ds,
+        grown = _fit_grown(
+            data, label_col or self.label_col, self.weight_col, mesh,
             task="classification",
             num_classes=self.num_classes,
             num_trees=self.num_trees,
@@ -92,13 +86,10 @@ class RandomForestClassifier(Estimator, _TreeParams):
             max_bins=self.max_bins,
             min_instances_per_node=self.min_instances_per_node,
             min_info_gain=self.min_info_gain,
-            feature_subset_size=_subset_size(
-                self.feature_subset_strategy, ds.n_features, "classification"
-            ),
+            subset_strategy=self.feature_subset_strategy,
             bootstrap=True,
             subsampling_rate=self.subsampling_rate,
             seed=self.seed,
-            mesh=mesh,
             categorical_features=self.categorical_features,
         )
         return _from_grown(RandomForestModel, grown, "classification", self.num_classes)

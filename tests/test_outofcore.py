@@ -146,10 +146,70 @@ class TestKMeansOutOfCore:
             resident.cluster_centers, ooc.cluster_centers
         )
 
-    def test_checkpoint_dir_rejected(self, mesh8, tmp_path):
-        est = ht.KMeans(k=2, checkpoint_dir=str(tmp_path))
-        with pytest.raises(ValueError, match="out-of-core"):
-            est.fit(HostDataset(x=np.ones((64, 2), np.float32)), mesh=mesh8)
+    def test_checkpoint_preempt_resume_exact(self, mesh8, tmp_path):
+        """checkpoint_dir composes with out-of-core fits (VERDICT r3 #5):
+        a fit preempted between iteration-boundary commits resumes from
+        the last commit and lands bit-identically (integer-exact sums) on
+        the uninterrupted result."""
+
+        class Preempt(RuntimeError):
+            pass
+
+        x = _int_blobs(2048, 4, k=4, seed=7)
+        hd = HostDataset(x=x, max_device_rows=512)
+        base = dict(k=4, seed=0, max_iter=20, tol=0.0)
+        uninterrupted = ht.KMeans(**base).fit(
+            HostDataset(x=x, max_device_rows=512), mesh=mesh8
+        )
+
+        est = ht.KMeans(
+            checkpoint_dir=str(tmp_path / "km"), checkpoint_every=1, **base
+        )
+
+        def bomb(it, cost, move):
+            if it == 2:
+                raise Preempt()
+
+        with pytest.raises(Preempt):
+            est.fit(hd, mesh=mesh8, on_iteration=bomb)
+        seen = []
+        resumed = est.fit(
+            hd, mesh=mesh8, on_iteration=lambda it, c, m: seen.append(it)
+        )
+        assert seen[0] == 3  # resumed from the commit at it=2
+        np.testing.assert_array_equal(
+            resumed.cluster_centers, uninterrupted.cluster_centers
+        )
+        np.testing.assert_allclose(
+            resumed.training_cost, uninterrupted.training_cost, rtol=1e-6
+        )
+        # if the fit converged exactly at the preempt point, the resumed
+        # run needs one extra (no-op) iteration to observe convergence
+        assert uninterrupted.n_iter <= resumed.n_iter <= uninterrupted.n_iter + 1
+
+    def test_checkpoint_refuses_different_data(self, mesh8, tmp_path):
+        x1 = _int_blobs(512, 3, k=2, seed=1)
+        x2 = _int_blobs(512, 3, k=2, seed=2)
+        est = ht.KMeans(
+            k=2, seed=0, max_iter=3,
+            checkpoint_dir=str(tmp_path / "km2"), checkpoint_every=1,
+        )
+        est.fit(HostDataset(x=x1, max_device_rows=128), mesh=mesh8)
+        with pytest.raises(ValueError, match="signature mismatch"):
+            est.fit(HostDataset(x=x2, max_device_rows=128), mesh=mesh8)
+
+    def test_checkpoint_ooc_vs_resident_signatures_distinct(
+        self, mesh8, tmp_path
+    ):
+        """An out-of-core checkpoint must not silently resume a RESIDENT
+        fit of the same data (different storage signature)."""
+        x = _int_blobs(512, 3, k=2, seed=3)
+        ckdir = str(tmp_path / "km3")
+        est = ht.KMeans(k=2, seed=0, max_iter=3, checkpoint_dir=ckdir,
+                        checkpoint_every=1)
+        est.fit(HostDataset(x=x, max_device_rows=128), mesh=mesh8)
+        with pytest.raises(ValueError, match="signature mismatch"):
+            est.fit(device_dataset(x, mesh=mesh8), mesh=mesh8)
 
     def test_on_iteration_hook(self, mesh8):
         x = _int_blobs(512, 3, k=2)
@@ -300,3 +360,275 @@ class TestGMMOutOfCore:
             ht.GaussianMixture(k=2).fit(
                 HostDataset(x=np.empty((0, 3), np.float32)), mesh=mesh8
             )
+
+    def test_checkpoint_preempt_resume(self, mesh8, rng, tmp_path):
+        """GMM out-of-core + checkpoint_dir (VERDICT r3 #5): preempt
+        between commits, resume from the last commit, converge to the
+        uninterrupted parameters."""
+
+        class Preempt(RuntimeError):
+            pass
+
+        k, d, n = 2, 3, 1024
+        x = np.concatenate(
+            [rng.normal(0, 1, size=(n // 2, d)), rng.normal(9, 1, size=(n // 2, d))]
+        ).astype(np.float32)
+        hd = HostDataset(x=x, max_device_rows=256)
+        base = dict(k=k, seed=1, max_iter=10, tol=0.0)
+        uninterrupted = ht.GaussianMixture(**base).fit(
+            HostDataset(x=x, max_device_rows=256), mesh=mesh8
+        )
+        est = ht.GaussianMixture(
+            checkpoint_dir=str(tmp_path / "gmm"), checkpoint_every=2, **base
+        )
+
+        def bomb(it, ll):
+            if it == 4:
+                raise Preempt()
+
+        with pytest.raises(Preempt):
+            est.fit(hd, mesh=mesh8, on_iteration=bomb)
+        seen = []
+        resumed = est.fit(hd, mesh=mesh8, on_iteration=lambda it, ll: seen.append(it))
+        assert seen[0] == 5  # commit at it=4
+        np.testing.assert_allclose(resumed.means, uninterrupted.means, atol=1e-4)
+        np.testing.assert_allclose(
+            resumed.weights, uninterrupted.weights, atol=1e-5
+        )
+
+
+class TestTreesOutOfCore:
+    """grow_forest_outofcore: level-order growth as streamed sufficient-
+    stat passes (VERDICT r3 next #4).  Integer labels make the histogram
+    sums f32-exact, so splits are bit-identical to the resident engine."""
+
+    def _int_reg(self, n=4096, d=6, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.integers(0, 24, size=(n, d)).astype(np.float32)
+        y = (x @ rng.integers(1, 4, size=d)).astype(np.float32) % 23
+        return x, y
+
+    def test_dt_regressor_identical_splits(self, mesh8):
+        x, y = self._int_reg()
+        est = ht.DecisionTreeRegressor(max_depth=4, seed=3)
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=640), mesh=mesh8)
+        np.testing.assert_array_equal(res.split_feat, ooc.split_feat)
+        np.testing.assert_array_equal(res.threshold, ooc.threshold)
+        np.testing.assert_allclose(res.value, ooc.value, rtol=1e-6)
+        np.testing.assert_allclose(
+            res.feature_importances, ooc.feature_importances, rtol=1e-6
+        )
+
+    def test_dt_classifier_identical_splits(self, mesh8):
+        x, y = self._int_reg(seed=1)
+        yb = (y > np.median(y)).astype(np.float32)
+        est = ht.DecisionTreeClassifier(max_depth=4, seed=0)
+        res = est.fit(device_dataset(x, yb, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, yb, max_device_rows=512), mesh=mesh8)
+        np.testing.assert_array_equal(res.split_feat, ooc.split_feat)
+        np.testing.assert_array_equal(res.threshold, ooc.threshold)
+
+    def test_rf_bootstrap_quality(self, mesh8):
+        """Bootstrap draws differ per-block vs resident (documented), so
+        the check is statistical: the out-of-core forest predicts the
+        signal as well as the resident one."""
+        rng = np.random.default_rng(0)
+        n, d = 6000, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x @ np.array([3, -2, 1, 0, 0], np.float32)
+             + 0.1 * rng.normal(size=n)).astype(np.float32)
+        est = ht.RandomForestRegressor(num_trees=8, max_depth=5, seed=0,
+                                       feature_subset_strategy="all")
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=1024), mesh=mesh8)
+        def r2(m):
+            p = np.asarray(m.predict_numpy(x))
+            return 1 - np.sum((y - p) ** 2) / np.sum((y - y.mean()) ** 2)
+        assert r2(ooc) > 0.9
+        assert abs(r2(ooc) - r2(res)) < 0.03
+
+    def test_rf_no_bootstrap_identical(self, mesh8):
+        """subsampling off ⇒ identical weights ⇒ identical forests."""
+        x, y = self._int_reg(seed=2)
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+            grow_forest, grow_forest_outofcore,
+        )
+        kw = dict(task="regression", num_trees=4, max_depth=3,
+                  bootstrap=False, seed=5, mesh=None)
+        res = grow_forest(device_dataset(x, y, mesh=mesh8), mesh=mesh8,
+                          task="regression", num_trees=4, max_depth=3,
+                          bootstrap=False, seed=5)
+        ooc = grow_forest_outofcore(HostDataset(x, y, max_device_rows=700),
+                                    mesh=mesh8, task="regression",
+                                    num_trees=4, max_depth=3,
+                                    bootstrap=False, seed=5)
+        np.testing.assert_array_equal(res.split_feat, ooc.split_feat)
+        np.testing.assert_array_equal(res.split_bin, ooc.split_bin)
+
+    def test_feature_subset_identical(self, mesh8):
+        """The per-node feature-subset draw is keyed on (seed, depth) —
+        identical across both drivers."""
+        x, y = self._int_reg(seed=3)
+        est = ht.RandomForestRegressor(
+            num_trees=3, max_depth=3, seed=7,
+            feature_subset_strategy="sqrt", subsampling_rate=1.0,
+        )
+        # bootstrap streams differ; compare via engine with bootstrap off
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+            grow_forest, grow_forest_outofcore,
+        )
+        res = grow_forest(device_dataset(x, y, mesh=mesh8), mesh=mesh8,
+                          task="regression", num_trees=3, max_depth=3,
+                          feature_subset_size=2, bootstrap=False, seed=7)
+        ooc = grow_forest_outofcore(HostDataset(x, y, max_device_rows=512),
+                                    mesh=mesh8, task="regression",
+                                    num_trees=3, max_depth=3,
+                                    feature_subset_size=2, bootstrap=False,
+                                    seed=7)
+        np.testing.assert_array_equal(res.split_feat, ooc.split_feat)
+
+    def test_categorical_splits(self, mesh8):
+        """Unordered-set categorical splits survive the streamed path."""
+        rng = np.random.default_rng(4)
+        n = 3000
+        cat = rng.integers(0, 6, size=n).astype(np.float32)
+        x2 = rng.integers(0, 10, size=n).astype(np.float32)
+        x = np.stack([cat, x2], axis=1)
+        y = np.where(np.isin(cat, [1.0, 4.0]), 10.0, 0.0).astype(np.float32)
+        est = ht.DecisionTreeRegressor(
+            max_depth=2, seed=0, categorical_features={0: 6}
+        )
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=512), mesh=mesh8)
+        np.testing.assert_array_equal(res.split_feat, ooc.split_feat)
+        np.testing.assert_array_equal(res.split_catmask, ooc.split_catmask)
+        # the categorical root split isolates {1, 4} exactly
+        p = np.asarray(ooc.predict_numpy(x))
+        np.testing.assert_allclose(p, y, atol=1e-5)
+
+    def test_requires_labels(self, mesh8):
+        with pytest.raises(ValueError, match="labels"):
+            ht.DecisionTreeRegressor().fit(
+                HostDataset(np.ones((64, 2), np.float32)), mesh=mesh8
+            )
+
+    def test_empty_raises(self, mesh8):
+        with pytest.raises(ValueError, match="empty"):
+            ht.DecisionTreeRegressor().fit(
+                HostDataset(
+                    np.ones((8, 2), np.float32),
+                    np.ones(8, np.float32),
+                    np.zeros(8, np.float32),
+                ),
+                mesh=mesh8,
+            )
+
+
+class TestLogisticOutOfCore:
+    def test_binomial_matches_resident(self, mesh8, rng):
+        n, d = 6000, 4
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        p = 1 / (1 + np.exp(-(x @ [1.0, -2.0, 0.5, 0.3] + 0.2)))
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        est = ht.LogisticRegression(max_iter=50)
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=1000), mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(ooc.coefficients), np.asarray(res.coefficients),
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            float(ooc.intercept), float(res.intercept), rtol=1e-4, atol=1e-5
+        )
+        assert res.n_iter == ooc.n_iter
+        assert not ooc.has_summary   # OOC fits don't pin the dataset
+
+    def test_binomial_regularized_standardized(self, mesh8, rng):
+        """reg_param > 0 exercises the streamed moments → standardized-L2
+        ridge path (Spark's standardization semantics)."""
+        n, d = 4000, 3
+        x = (rng.normal(size=(n, d)) * [1.0, 10.0, 0.1]).astype(np.float32)
+        p = 1 / (1 + np.exp(-(x @ [1.0, -0.1, 5.0])))
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        est = ht.LogisticRegression(max_iter=50, reg_param=0.05)
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=640), mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(ooc.coefficients), np.asarray(res.coefficients),
+            rtol=1e-3, atol=1e-4,
+        )
+
+    def test_multinomial_matches_resident(self, mesh8, rng):
+        n, d, k = 6000, 4, 3
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        beta = rng.normal(size=(k, d))
+        y = np.argmax(x @ beta.T + rng.gumbel(size=(n, k)), axis=1).astype(
+            np.float32
+        )
+        est = ht.LogisticRegression(max_iter=50, reg_param=0.01)
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=1000), mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(ooc.coefficient_matrix),
+            np.asarray(res.coefficient_matrix),
+            rtol=1e-3, atol=1e-4,
+        )
+
+    def test_binomial_on_multiclass_raises(self, mesh8, rng):
+        x = rng.normal(size=(256, 2)).astype(np.float32)
+        y = rng.integers(0, 3, size=256).astype(np.float32)
+        with pytest.raises(ValueError, match="binomial"):
+            ht.LogisticRegression(family="binomial").fit(
+                HostDataset(x, y, max_device_rows=64), mesh=mesh8
+            )
+
+    def test_requires_labels(self, mesh8):
+        with pytest.raises(ValueError, match="labels"):
+            ht.LogisticRegression().fit(
+                HostDataset(np.ones((64, 2), np.float32)), mesh=mesh8
+            )
+
+
+class TestGBTOutOfCore:
+    def test_regressor_identical_splits(self, mesh8):
+        rng = np.random.default_rng(0)
+        n, d = 4000, 5
+        x = rng.integers(0, 30, size=(n, d)).astype(np.float32)
+        y = (x @ np.array([2, 1, 3, 1, 2], np.float32)).astype(np.float32)
+        est = ht.GBTRegressor(max_iter=5, max_depth=3, seed=0)
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=1024), mesh=mesh8)
+        np.testing.assert_array_equal(res.split_feat, ooc.split_feat)
+        np.testing.assert_allclose(
+            np.asarray(res.predict_numpy(x[:256])),
+            np.asarray(ooc.predict_numpy(x[:256])),
+            rtol=1e-5,
+        )
+
+    def test_classifier_agreement(self, mesh8):
+        rng = np.random.default_rng(1)
+        n, d = 4000, 4
+        x = rng.integers(0, 30, size=(n, d)).astype(np.float32)
+        y = ((x @ np.ones(d, np.float32)) > 58).astype(np.float32)
+        est = ht.GBTClassifier(max_iter=4, max_depth=3, seed=0)
+        res = est.fit(device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        ooc = est.fit(HostDataset(x, y, max_device_rows=1024), mesh=mesh8)
+        a = np.asarray(res.predict_numpy(x))
+        b = np.asarray(ooc.predict_numpy(x))
+        assert np.mean(a == b) > 0.999
+
+    def test_validation_col_rejected(self, mesh8):
+        with pytest.raises(ValueError, match="validation_indicator_col"):
+            ht.GBTRegressor(validation_indicator_col="v").fit(
+                HostDataset(
+                    np.ones((64, 2), np.float32), np.ones(64, np.float32)
+                ),
+                mesh=mesh8,
+            )
+
+    def test_classifier_label_validation(self, mesh8):
+        x = np.ones((64, 2), np.float32)
+        y = np.full(64, 3.0, np.float32)
+        with pytest.raises(ValueError, match="binary"):
+            ht.GBTClassifier().fit(HostDataset(x, y), mesh=mesh8)
