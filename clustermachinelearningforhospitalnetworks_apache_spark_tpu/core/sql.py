@@ -7,12 +7,19 @@ same one-liner.  This module covers that working set with a hand-rolled
 tokenizer + recursive-descent parser + numpy columnar executor — no
 Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
 
-    SELECT [cols | agg(col) [AS alias]] FROM t
+    SELECT [DISTINCT] [cols | agg(col) [AS alias]]
+      FROM t [[AS] a]
+      [[INNER|LEFT] JOIN t2 [[AS] b] ON a.key = b.key]   (single-key
+                                         equi-join, vectorized hash join)
       [WHERE <pred> {AND|OR} ...]        predicates: = != <> < <= > >=,
                                          BETWEEN 'a' AND 'b', parentheses
       [GROUP BY cols]                    aggs: COUNT(*) SUM AVG MIN MAX
+      [HAVING <pred over aggregates>]
       [ORDER BY col [ASC|DESC]]
       [LIMIT n]
+
+Columns may be qualified (``a.col``); unqualified names resolve when
+unambiguous across the joined sides (ambiguity raises, like Spark).
 
 Timestamp columns compare against their literals in datetime64 space, so
 ``WHERE event_time BETWEEN '2025-03-31 22:00:00' AND '…'`` matches the
@@ -34,7 +41,7 @@ _TOKEN = re.compile(
     r"(?P<str>'(?:[^']|'')*')"
     r"|(?P<num>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
     r"|(?P<word>[A-Za-z_][A-Za-z_0-9]*)"
-    r"|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|\*|,)"
+    r"|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|\*|,|\.)"
     r")"
 )
 
@@ -42,6 +49,7 @@ _AGGS = {"count", "sum", "avg", "min", "max"}
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "order", "limit",
     "and", "or", "between", "as", "asc", "desc",
+    "distinct", "join", "inner", "left", "on", "having",
 } | _AGGS
 
 
@@ -70,6 +78,19 @@ class _SelectItem:
     agg: str | None      # None = plain column
     col: str | None      # None = COUNT(*)
     alias: str
+
+
+@dataclass
+class _Query:
+    items: list | None   # None = SELECT *
+    distinct: bool
+    table: tuple         # (name, alias)
+    joins: list          # [(kind, (name, alias), left_key, right_key), ...]
+    where: Any
+    group: list
+    having: Any
+    order: tuple | None
+    limit: int | None
 
 
 class _Parser:
@@ -101,22 +122,43 @@ class _Parser:
     # ---- grammar ----
     def parse(self):
         self._expect("kw", "select")
+        distinct = self._accept("kw", "distinct")
         items = self._select_list()
         self._expect("kw", "from")
-        table = self._expect("name")[1]
+        table = self._table_ref()
+        joins = []
+        while True:
+            if self._accept("kw", "join") or (
+                self._accept("kw", "inner") and (self._expect("kw", "join") or True)
+            ):
+                kind = "inner"
+            elif self._accept("kw", "left"):
+                self._expect("kw", "join")
+                kind = "left"
+            else:
+                break
+            right = self._table_ref()
+            self._expect("kw", "on")
+            lk = self._name()
+            self._expect("op", "=")
+            rk = self._name()
+            joins.append((kind, right, lk, rk))
         where = None
         if self._accept("kw", "where"):
             where = self._or_cond()
         group = []
         if self._accept("kw", "group"):
             self._expect("kw", "by")
-            group = [self._expect("name")[1]]
+            group = [self._name()]
             while self._accept("op", ","):
-                group.append(self._expect("name")[1])
+                group.append(self._name())
+        having = None
+        if self._accept("kw", "having"):
+            having = self._or_cond(allow_agg=True)
         order = None
         if self._accept("kw", "order"):
             self._expect("kw", "by")
-            col = self._expect("name")[1]
+            col = self._name(allow_agg=True)
             desc = False
             if self._accept("kw", "desc"):
                 desc = True
@@ -128,7 +170,43 @@ class _Parser:
             limit = int(self._expect("num")[1])
         if self._peek()[0] != "eof":
             raise ValueError(f"SQL: unexpected trailing input {self._peek()[1]!r}")
-        return items, table, where, group, order, limit
+        return _Query(
+            items, distinct, table, joins, where, group, having, order, limit
+        )
+
+    def _table_ref(self):
+        """name [[AS] alias] → (table_name, alias)."""
+        name = self._expect("name")[1]
+        alias = name
+        if self._accept("kw", "as"):
+            alias = self._expect("name")[1]
+        elif self._peek()[0] == "name":
+            alias = self._next()[1]
+        return name, alias
+
+    def _name(self, allow_agg: bool = False) -> str:
+        """Possibly-qualified column reference → "alias.col" | "col";
+        with ``allow_agg``, also "agg(col)" / "count(*)" (HAVING/ORDER)."""
+        t = self._next()
+        if allow_agg and t[0] == "kw" and t[1] in _AGGS:
+            agg = t[1]
+            self._expect("op", "(")
+            if self._accept("op", "*"):
+                if agg != "count":
+                    raise ValueError(f"SQL: {agg.upper()}(*) is not defined")
+                col = None
+            else:
+                col = self._qual_tail(self._expect("name")[1])
+            self._expect("op", ")")
+            return f"{agg}({col or '*'})"
+        if t[0] != "name":
+            raise ValueError(f"SQL: expected a column name, got {t[1]!r}")
+        return self._qual_tail(t[1])
+
+    def _qual_tail(self, first: str) -> str:
+        if self._accept("op", "."):
+            return f"{first}.{self._expect('name')[1]}"
+        return first
 
     def _select_list(self):
         if self._accept("op", "*"):
@@ -148,35 +226,38 @@ class _Parser:
                     raise ValueError(f"SQL: {agg.upper()}(*) is not defined")
                 col = None
             else:
-                col = self._expect("name")[1]
+                col = self._qual_tail(self._expect("name")[1])
             self._expect("op", ")")
             alias = f"{agg}({col or '*'})"
         elif t[0] == "name":
-            agg, col, alias = None, t[1], t[1]
+            col = self._qual_tail(t[1])
+            # a qualified column's default output name is its UNQUALIFIED
+            # part (Spark: df.select("h.name") yields column "name")
+            agg, alias = None, col.split(".")[-1]
         else:
             raise ValueError(f"SQL: expected column or aggregate, got {t[1]!r}")
         if self._accept("kw", "as"):
             alias = self._expect("name")[1]
         return _SelectItem(agg, col, alias)
 
-    def _or_cond(self):
-        left = self._and_cond()
+    def _or_cond(self, allow_agg: bool = False):
+        left = self._and_cond(allow_agg)
         while self._accept("kw", "or"):
-            left = ("or", left, self._and_cond())
+            left = ("or", left, self._and_cond(allow_agg))
         return left
 
-    def _and_cond(self):
-        left = self._pred()
+    def _and_cond(self, allow_agg: bool = False):
+        left = self._pred(allow_agg)
         while self._accept("kw", "and"):
-            left = ("and", left, self._pred())
+            left = ("and", left, self._pred(allow_agg))
         return left
 
-    def _pred(self):
+    def _pred(self, allow_agg: bool = False):
         if self._accept("op", "("):
-            c = self._or_cond()
+            c = self._or_cond(allow_agg)
             self._expect("op", ")")
             return c
-        col = self._expect("name")[1]
+        col = self._name(allow_agg=allow_agg)
         if self._accept("kw", "between"):
             lo = self._literal()
             self._expect("kw", "and")
@@ -205,18 +286,20 @@ def _coerce(col: np.ndarray, lit: Any) -> Any:
     return lit
 
 
-def _eval_cond(table: Table, cond) -> np.ndarray:
+def _eval_cond(getcol, cond) -> np.ndarray:
+    """Evaluate a predicate tree; ``getcol(name) -> np.ndarray`` resolves
+    (possibly qualified / aggregate) column references."""
     kind = cond[0]
     if kind == "and":
-        return _eval_cond(table, cond[1]) & _eval_cond(table, cond[2])
+        return _eval_cond(getcol, cond[1]) & _eval_cond(getcol, cond[2])
     if kind == "or":
-        return _eval_cond(table, cond[1]) | _eval_cond(table, cond[2])
+        return _eval_cond(getcol, cond[1]) | _eval_cond(getcol, cond[2])
     if kind == "between":
         _, name, lo, hi = cond
-        col = table.column(name)
+        col = getcol(name)
         return (col >= _coerce(col, lo)) & (col <= _coerce(col, hi))
     _, name, op, lit = cond
-    col = table.column(name)
+    col = getcol(name)
     v = _coerce(col, lit)
     if op == "=":
         return col == v
@@ -225,6 +308,108 @@ def _eval_cond(table: Table, cond) -> np.ndarray:
         # numpy's NaN != x would otherwise let it through
         return (col != v) & ~_null_mask(col)
     return {"<": col < v, "<=": col <= v, ">": col > v, ">=": col >= v}[op]
+
+
+def _resolve_name(t: Table, name: str, aliases: set[str]) -> str:
+    """A (possibly qualified) reference → the table's actual column name.
+
+    Joined tables carry fully-qualified ``alias.col`` columns: unqualified
+    names resolve when exactly one side has the column (ambiguity raises,
+    Spark's rule); single-table queries accept ``alias.col`` for the FROM
+    alias."""
+    if name in t.columns:
+        return name
+    if "." in name:
+        qual, base = name.split(".", 1)
+        if qual in aliases and base in t.columns:
+            return base
+    else:
+        hits = [c for c in t.columns if c.endswith("." + name)]
+        if len(hits) == 1:
+            return hits[0]
+        if len(hits) > 1:
+            raise ValueError(
+                f"SQL: column {name!r} is ambiguous across {sorted(hits)}; "
+                "qualify it"
+            )
+    raise ValueError(f"SQL: unknown column {name!r}")
+
+
+def _null_fill_take(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """``col[idx]`` with idx == -1 rows becoming null (LEFT JOIN fills):
+    ints widen to float64 so NaN exists; objects get None."""
+    missing = idx < 0
+    out = col[np.maximum(idx, 0)]
+    if not missing.any():
+        return out
+    if np.issubdtype(out.dtype, np.datetime64):
+        out = out.copy()
+        out[missing] = np.datetime64("NaT")
+    elif np.issubdtype(out.dtype, np.number):
+        out = out.astype(np.float64)
+        out[missing] = np.nan
+    else:
+        out = out.astype(object)
+        out[missing] = None
+    return out
+
+
+def _equi_join(
+    lt: Table, rt: Table, lk: np.ndarray, rk: np.ndarray,
+    kind: str, r_alias: str,
+) -> Table:
+    """Vectorized single-key hash join (factorize → sort → searchsorted —
+    O((n+m)·log m), no Python per-row loop).  Null keys never match (SQL);
+    ``kind="left"`` keeps unmatched left rows with null right columns.
+    The left table's column names pass through (already qualified for
+    chained joins); the right side's get the ``r_alias.`` prefix."""
+    lnull, rnull = _null_mask(lk), _null_mask(rk)
+    lv = np.flatnonzero(~lnull)
+    rv = np.flatnonzero(~rnull)
+    try:
+        both = np.concatenate([lk[lv], rk[rv]])
+        # np.unique SORTS: mixed-type object keys (str vs int) raise here,
+        # inside the guard, instead of surfacing a raw TypeError
+        codes = np.unique(both, return_inverse=True)[1]
+    except (TypeError, np.exceptions.DTypePromotionError) as e:
+        raise ValueError(
+            f"SQL: JOIN keys have incomparable types "
+            f"({lk.dtype} vs {rk.dtype}): {e}"
+        ) from e
+    lc, rc = codes[: len(lv)], codes[len(lv):]
+    order = np.argsort(rc, kind="stable")
+    rcs = rc[order]
+    start = np.searchsorted(rcs, lc, "left")
+    end = np.searchsorted(rcs, lc, "right")
+    cnt = end - start                              # matches per valid left row
+    tot = int(cnt.sum())
+    within = np.arange(tot) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+    ri_matched = rv[order[np.repeat(start, cnt) + within]]
+
+    cnt_full = np.zeros(len(lk), np.int64)
+    cnt_full[lv] = cnt
+    out_cnt = np.maximum(cnt_full, 1) if kind == "left" else cnt_full
+    li = np.repeat(np.arange(len(lk)), out_cnt)
+    total = int(out_cnt.sum())
+    ri = np.full(total, -1, np.int64)
+    ri[np.repeat(cnt_full > 0, out_cnt)] = ri_matched
+
+    cols: dict[str, Any] = {c: lt.column(c)[li] for c in lt.columns}
+    for c in rt.columns:
+        cols[f"{r_alias}.{c}"] = _null_fill_take(rt.column(c), ri)
+    return Table.from_dict(cols)
+
+
+def _distinct_rows(t: Table) -> Table:
+    """Row-level DISTINCT via per-column group codes (nulls equal)."""
+    if len(t) == 0 or not t.columns:
+        return t
+    packed = np.rec.fromarrays([_group_codes(t.column(c)) for c in t.columns])
+    _, first = np.unique(packed, return_index=True)
+    return t.mask(np.sort(first))
+
+
+_AGG_REF = re.compile(r"^(count|sum|avg|min|max)\((.+|\*)\)$")
 
 
 def _group_codes(col: np.ndarray) -> np.ndarray:
@@ -238,11 +423,21 @@ def _group_codes(col: np.ndarray) -> np.ndarray:
 
 
 def _null_mask(vals: np.ndarray) -> np.ndarray:
-    """True where a value is this engine's null (NaN / NaT)."""
+    """True where a value is this engine's null (NaN / NaT / None in
+    object columns — LEFT JOIN writes None into unmatched object cells)."""
     if np.issubdtype(vals.dtype, np.floating):
         return np.isnan(vals)
     if np.issubdtype(vals.dtype, np.datetime64):
         return np.isnat(vals)
+    if vals.dtype == object:
+        return np.fromiter(
+            (
+                v is None or (isinstance(v, float) and v != v)
+                for v in vals
+            ),
+            bool,
+            count=len(vals),
+        )
     return np.zeros(vals.shape, bool)
 
 
@@ -297,22 +492,81 @@ def _grouped_aggregate(src: np.ndarray, agg: str, starts, order_idx):
 
 
 def execute(query: str, resolve_table) -> Table:
-    """Run a query; ``resolve_table(name) -> Table`` supplies FROM."""
-    items, name, where, group, order, limit = _Parser(query).parse()
-    t: Table = resolve_table(name)
-    if where is not None:
-        t = t.mask(_eval_cond(t, where))
+    """Run a query; ``resolve_table(name) -> Table`` supplies FROM/JOIN."""
+    q = _Parser(query).parse()
+    items = q.items
+    if items is not None:
+        # duplicate output names would silently shadow each other in the
+        # projection dict — SELECT e.id, h.id needs an AS on one of them
+        seen: set[str] = set()
+        for it in items:
+            if it.alias in seen:
+                raise ValueError(
+                    f"SQL: duplicate output column {it.alias!r}; "
+                    "disambiguate with AS"
+                )
+            seen.add(it.alias)
+    base_name, base_alias = q.table
+    t: Table = resolve_table(base_name)
+    aliases = {base_alias}
 
-    if group:
+    if q.joins:
+        # qualify the base table once; each join qualifies its right side
+        t = Table.from_dict({f"{base_alias}.{c}": t.column(c) for c in t.columns})
+        for kind, (r_name, r_alias), lk_name, rk_name in q.joins:
+            if r_alias in aliases:
+                raise ValueError(f"SQL: duplicate table alias {r_alias!r}")
+            rt = resolve_table(r_name)
+
+            def right_col(name: str):
+                """Resolve a key reference against the NEW right table."""
+                if "." in name:
+                    qual, base = name.split(".", 1)
+                    return rt.column(base) if (
+                        qual == r_alias and base in rt.columns
+                    ) else None
+                return rt.column(name) if name in rt.columns else None
+
+            def left_col(name: str):
+                try:
+                    return t.column(_resolve_name(t, name, aliases))
+                except ValueError:
+                    return None
+
+            # the ON keys may be written in either order (a.k = b.k or
+            # b.k = a.k): one side must resolve in the joined-so-far
+            # table, the other in the new right table
+            lk, rk = left_col(lk_name), right_col(rk_name)
+            if lk is None or rk is None:
+                lk, rk = left_col(rk_name), right_col(lk_name)
+            if lk is None or rk is None:
+                raise ValueError(
+                    f"SQL: JOIN ON must compare a joined column with a "
+                    f"column of {r_name!r}; got {lk_name!r} = {rk_name!r}"
+                )
+            t = _equi_join(t, rt, lk, np.asarray(rk), kind, r_alias)
+            aliases.add(r_alias)
+
+    def getcol(name: str) -> np.ndarray:
+        return t.column(_resolve_name(t, name, aliases))
+
+    if q.where is not None:
+        t = t.mask(_eval_cond(getcol, q.where))
+
+    if q.group:
         if items is None:
             raise ValueError("SQL: GROUP BY requires an explicit select list")
+        group_cols = {g: _resolve_name(t, g, aliases) for g in q.group}
         for it in items:
-            if it.agg is None and it.col not in group:
+            if it.agg is None and not (
+                it.col in q.group
+                or _resolve_name(t, it.col, aliases) in group_cols.values()
+            ):
                 raise ValueError(
                     f"SQL: column {it.col!r} must appear in GROUP BY or an "
                     "aggregate"
                 )
-        keys = [t.column(g) for g in group]
+        keys = [t.column(c) for c in group_cols.values()]
         # lexicographic group ids via np.unique over a structured view of
         # per-column integer codes — codes (not raw values) so every null
         # (NaN/NaT) lands in ONE group, Spark's GROUP BY rule
@@ -331,15 +585,61 @@ def execute(query: str, resolve_table) -> Table:
         cols: dict[str, Any] = {}
         for it in items:
             if it.agg is None:
-                cols[it.alias] = t.column(it.col)[first_row]
+                cols[it.alias] = getcol(it.col)[first_row]
             elif it.col is None:  # COUNT(*)
                 cols[it.alias] = counts.astype(np.int64)
             else:
                 cols[it.alias] = _grouped_aggregate(
-                    t.column(it.col), it.agg, starts, order_idx
+                    getcol(it.col), it.agg, starts, order_idx
                 )
-        t = Table.from_dict(cols)
+        # HAVING / ORDER BY may reference select aliases, canonical
+        # agg(col) spellings, qualified group keys, or aggregates that
+        # were never selected (computed on demand from the same
+        # sort/starts — no extra data pass)
+        canonical = {
+            f"{it.agg}({it.col or '*'})": it.alias
+            for it in items
+            if it.agg is not None
+        }
+        sel_by_col = {it.col: it.alias for it in items if it.agg is None}
+
+        def grouped_col(name: str, what: str) -> np.ndarray:
+            if name in cols:
+                return cols[name]
+            if name in canonical:
+                return cols[canonical[name]]
+            if name in sel_by_col:          # e.g. ORDER BY h.beds
+                return cols[sel_by_col[name]]
+            m = _AGG_REF.match(name)
+            if m:
+                agg, c = m.groups()
+                if c == "*":
+                    return counts.astype(np.int64)
+                return _grouped_aggregate(getcol(c), agg, starts, order_idx)
+            raise ValueError(
+                f"SQL: {what} reference {name!r} is neither an output "
+                "column nor an aggregate"
+            )
+
+        # resolve the ORDER BY column BEFORE the HAVING mask (on-demand
+        # aggregates are pre-mask length) and carry it as a hidden column
+        order_hidden = None
+        if q.order is not None and q.order[0] not in cols:
+            order_hidden = "__order_by__"
+            cols[order_hidden] = grouped_col(q.order[0], "ORDER BY")
+        grouped = Table.from_dict(cols)
+        if q.having is not None:
+            grouped = grouped.mask(
+                _eval_cond(lambda n: grouped_col(n, "HAVING"), q.having)
+            )
+        t = grouped
+        if order_hidden is not None:
+            q = _Query(
+                items, q.distinct, q.table, q.joins, q.where, q.group,
+                None, (order_hidden, q.order[1]), q.limit,
+            )
         items = None  # already projected to aliases
+        aliases = set()
     elif items is not None and any(it.agg is not None for it in items):
         # whole-table aggregates collapse to one row — a bare column in the
         # same list has no single value (Spark requires GROUP BY too)
@@ -349,28 +649,60 @@ def execute(query: str, resolve_table) -> Table:
                     f"SQL: column {it.col!r} cannot mix with aggregates "
                     "without GROUP BY"
                 )
+        src_t, src_getcol = t, getcol
+        agg_canonical = {
+            f"{it.agg}({it.col or '*'})": it.alias for it in items
+        }
         t = Table.from_dict(
             {
                 it.alias: np.asarray(
-                    [len(t) if it.col is None else _aggregate(t.column(it.col), it.agg)]
+                    [len(t) if it.col is None else _aggregate(getcol(it.col), it.agg)]
                 )
                 for it in items
             }
         )
-        items = None  # already projected
+        if q.having is not None:
+            # no GROUP BY: the whole table is one group — HAVING filters
+            # the single output row (Spark semantics)
+            def scalar_col(name: str) -> np.ndarray:
+                if name in t.columns:
+                    return t.column(name)
+                if name in agg_canonical:
+                    return t.column(agg_canonical[name])
+                m = _AGG_REF.match(name)
+                if m:
+                    agg, c = m.groups()
+                    v = (
+                        len(src_t)
+                        if c == "*"
+                        else _aggregate(src_getcol(c), agg)
+                    )
+                    return np.asarray([v])
+                raise ValueError(
+                    f"SQL: HAVING reference {name!r} is neither an output "
+                    "column nor an aggregate"
+                )
 
-    if order is not None and len(t) > 0:
-        col, desc = order
+            t = t.mask(_eval_cond(scalar_col, q.having))
+        items = None  # already projected
+        aliases = set()
+    elif q.having is not None:
+        raise ValueError("SQL: HAVING requires GROUP BY or aggregates")
+
+    if q.order is not None and len(t) > 0:
+        col, desc = q.order
         # order BEFORE projection so ORDER BY may reference any source
         # column (legal SQL); a SELECT alias resolves to its source here,
         # and grouped results order by their output columns
         if col not in t.columns and items is not None:
             col = {it.alias: it.col for it in items}.get(col, col)
-        if col not in t.columns:
+        try:
+            col = _resolve_name(t, col, aliases)
+        except ValueError:
             raise ValueError(
                 f"SQL: ORDER BY column {col!r} is not in the "
-                f"{'grouped result' if group else 'table'}"
-            )
+                f"{'grouped result' if q.group else 'table'}"
+            ) from None
         idx = np.argsort(t.column(col), kind="stable")
         if desc:
             idx = idx[::-1]
@@ -378,10 +710,16 @@ def execute(query: str, resolve_table) -> Table:
     if items is not None:
         # plain projection, applied after ORDER BY so sorting may use any
         # source column; aliases materialize here
-        missing = [it.col for it in items if it.col not in t.columns]
-        if missing:
-            raise ValueError(f"SQL: unknown column {missing[0]!r}")
-        t = Table.from_dict({it.alias: t.column(it.col) for it in items})
-    if limit is not None:
-        t = t.limit(limit)
+        t = Table.from_dict(
+            {it.alias: t.column(_resolve_name(t, it.col, aliases)) for it in items}
+        )
+    elif "__order_by__" in t.columns:
+        # drop the grouped ORDER BY carrier column
+        t = Table.from_dict(
+            {c: t.column(c) for c in t.columns if c != "__order_by__"}
+        )
+    if q.distinct:
+        t = _distinct_rows(t)
+    if q.limit is not None:
+        t = t.limit(q.limit)
     return t

@@ -191,3 +191,220 @@ def test_execute_without_session(hospital_table):
         lambda name: hospital_table,
     )
     assert len(out) == int((hospital_table.column("seasonality_index") >= 1.0).sum())
+
+
+# ---- round 4: JOIN / DISTINCT / HAVING (VERDICT r3 next #8) ----------
+
+
+@pytest.fixture
+def hospital_meta():
+    """Per-hospital metadata table — the first real JOIN a user writes
+    against this schema (reference ``mllearnforhospitalnetwork.py:65``
+    gives every event a hospital_id)."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    return Table.from_dict(
+        {
+            "hospital_id": np.array(["H00", "H01", "H02", "H99"], object),
+            "name": np.array(
+                ["General", "Mercy", "Childrens", "Closed"], object
+            ),
+            "beds": np.array([400, 150, 90, 10]),
+        }
+    )
+
+
+def test_per_hospital_join_group_having(session, hospital_table, hospital_meta):
+    """The VERDICT's target query: SELECT h.name, AVG(length_of_stay) ...
+    JOIN ... GROUP BY ... HAVING."""
+    session.register_table("hospitals", hospital_meta)
+    out = session.sql(
+        "SELECT h.name, AVG(length_of_stay) AS mean_los, COUNT(*) AS n "
+        "FROM events e JOIN hospitals h ON e.hospital_id = h.hospital_id "
+        "GROUP BY h.name HAVING COUNT(*) >= 1 ORDER BY mean_los DESC"
+    )
+    ids = hospital_table.column("hospital_id")
+    los = hospital_table.column("length_of_stay")
+    meta = {"H00": "General", "H01": "Mercy", "H02": "Childrens"}
+    expect = {}
+    for hid, nm in meta.items():
+        m = ids == hid
+        if m.any():
+            expect[nm] = np.nanmean(los[m])
+    assert len(expect) == 3 and set(out.column("name")) == set(expect)
+    got = dict(zip(out.column("name"), out.column("mean_los")))
+    for nm, v in expect.items():
+        np.testing.assert_allclose(got[nm], v, rtol=1e-12)
+    # ordered descending
+    assert list(out.column("mean_los")) == sorted(out.column("mean_los"))[::-1]
+
+
+def test_inner_join_drops_unmatched(session, hospital_table, hospital_meta):
+    session.register_table("hospitals", hospital_meta)
+    out = session.sql(
+        "SELECT e.hospital_id, h.beds FROM events e "
+        "JOIN hospitals h ON e.hospital_id = h.hospital_id"
+    )
+    matched = np.isin(
+        hospital_table.column("hospital_id"), ["H00", "H01", "H02", "H99"]
+    )
+    assert len(out) == int(matched.sum()) > 0
+    assert not np.isin(out.column("hospital_id"), ["H99"]).any()  # no events
+
+
+def test_left_join_null_fills(session, hospital_table, hospital_meta):
+    session.register_table("hospitals", hospital_meta)
+    out = session.sql(
+        "SELECT e.hospital_id, h.beds FROM events e "
+        "LEFT JOIN hospitals h ON e.hospital_id = h.hospital_id"
+    )
+    assert len(out) == len(hospital_table)  # every event row survives
+    unmatched = ~np.isin(out.column("hospital_id"), ["H00", "H01", "H02"])
+    assert unmatched.any()  # H03/H04 events have no metadata row
+    assert np.isnan(out.column("beds")[unmatched]).all()
+    assert not np.isnan(out.column("beds")[~unmatched]).any()
+
+
+def test_join_reversed_on_and_qualified_where(
+    session, hospital_table, hospital_meta
+):
+    session.register_table("hospitals", hospital_meta)
+    out = session.sql(
+        "SELECT h.name, e.length_of_stay FROM events e "
+        "JOIN hospitals h ON h.hospital_id = e.hospital_id "
+        "WHERE h.beds >= 150 AND e.length_of_stay > 0"
+    )
+    assert set(out.column("name")) == {"General", "Mercy"}
+
+
+def test_distinct(session):
+    out = session.sql("SELECT DISTINCT hospital_id FROM events")
+    ids = out.column("hospital_id")
+    assert len(ids) == len(set(ids))
+    assert set(ids) == set(
+        session.sql("SELECT hospital_id FROM events").column("hospital_id")
+    )
+
+
+def test_having_on_unselected_aggregate(session):
+    out = session.sql(
+        "SELECT hospital_id FROM events GROUP BY hospital_id "
+        "HAVING AVG(length_of_stay) > 0 AND COUNT(*) >= 2"
+    )
+    full = session.sql(
+        "SELECT hospital_id, COUNT(*) AS c FROM events GROUP BY hospital_id"
+    )
+    keep = set(
+        h for h, c in zip(full.column("hospital_id"), full.column("c")) if c >= 2
+    )
+    assert set(out.column("hospital_id")) == keep
+
+
+def test_join_errors(session, hospital_meta):
+    session.register_table("hospitals", hospital_meta)
+    with pytest.raises(ValueError, match="ambiguous"):
+        session.sql(
+            "SELECT hospital_id FROM events e "
+            "JOIN hospitals h ON e.hospital_id = h.hospital_id"
+        )
+    with pytest.raises(ValueError, match="duplicate"):
+        session.sql(
+            "SELECT * FROM events e JOIN hospitals e "
+            "ON e.hospital_id = e.hospital_id"
+        )
+    with pytest.raises(ValueError, match="JOIN ON"):
+        session.sql(
+            "SELECT * FROM events e JOIN hospitals h ON e.nope = h.nope"
+        )
+    with pytest.raises(ValueError, match="HAVING"):
+        session.sql("SELECT hospital_id FROM events HAVING COUNT(*) > 1")
+
+
+def test_having_on_whole_table_aggregates(session):
+    """No GROUP BY: the whole table is one group — HAVING filters the
+    single output row (review finding: it was silently ignored)."""
+    kept = session.sql("SELECT COUNT(*) AS n FROM events HAVING COUNT(*) > 0")
+    assert len(kept) == 1
+    dropped = session.sql(
+        "SELECT COUNT(*) AS n FROM events HAVING COUNT(*) > 999999"
+    )
+    assert len(dropped) == 0
+    # alias reference works too
+    assert len(session.sql("SELECT COUNT(*) AS n FROM events HAVING n > 0")) == 1
+
+
+def test_duplicate_output_columns_raise(session, hospital_meta):
+    session.register_table("hospitals", hospital_meta)
+    with pytest.raises(ValueError, match="duplicate output column"):
+        session.sql(
+            "SELECT e.hospital_id, h.hospital_id FROM events e "
+            "JOIN hospitals h ON e.hospital_id = h.hospital_id"
+        )
+    # disambiguated with AS: both survive
+    out = session.sql(
+        "SELECT e.hospital_id AS eid, h.hospital_id AS hid FROM events e "
+        "JOIN hospitals h ON e.hospital_id = h.hospital_id"
+    )
+    assert set(out.schema.names if hasattr(out.schema, "names") else
+               [f.name for f in out.schema.fields]) == {"eid", "hid"}
+
+
+def test_order_by_canonical_aggregate(session):
+    out = session.sql(
+        "SELECT hospital_id, COUNT(*) AS n FROM events "
+        "GROUP BY hospital_id ORDER BY COUNT(*) DESC"
+    )
+    n = out.column("n")
+    assert list(n) == sorted(n)[::-1]
+    assert "__order_by__" not in out.columns
+    # an aggregate never selected also orders (computed on demand)
+    out2 = session.sql(
+        "SELECT hospital_id FROM events GROUP BY hospital_id "
+        "ORDER BY AVG(length_of_stay) DESC LIMIT 1"
+    )
+    ref = session.sql(
+        "SELECT hospital_id, AVG(length_of_stay) AS a FROM events "
+        "GROUP BY hospital_id ORDER BY a DESC LIMIT 1"
+    )
+    assert list(out2.column("hospital_id")) == list(ref.column("hospital_id"))
+
+
+def test_order_by_qualified_group_key(session, hospital_meta):
+    session.register_table("hospitals", hospital_meta)
+    out = session.sql(
+        "SELECT h.beds, COUNT(*) AS n FROM events e "
+        "JOIN hospitals h ON e.hospital_id = h.hospital_id "
+        "GROUP BY h.beds ORDER BY h.beds DESC"
+    )
+    b = out.column("beds")
+    assert list(b) == sorted(b)[::-1]
+
+
+def test_join_after_left_join_null_keys(session, hospital_table, hospital_meta):
+    """Chained join whose key column contains LEFT-JOIN None fills: null
+    keys never match and never crash np.unique (review finding)."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    session.register_table("hospitals", hospital_meta)
+    regions = Table.from_dict(
+        {
+            "name": np.array(["General", "Mercy"], object),
+            "region": np.array(["north", "south"], object),
+        }
+    )
+    session.register_table("regions", regions)
+    out = session.sql(
+        "SELECT e.hospital_id, r.region FROM events e "
+        "LEFT JOIN hospitals h ON e.hospital_id = h.hospital_id "
+        "JOIN regions r ON h.name = r.name"
+    )
+    assert set(out.column("region")) <= {"north", "south"}
+    assert len(out) > 0
+
+
+def test_join_incomparable_key_types(session, hospital_meta):
+    session.register_table("hospitals", hospital_meta)
+    with pytest.raises(ValueError, match="incomparable"):
+        session.sql(
+            "SELECT * FROM events e JOIN hospitals h ON e.hospital_id = h.beds"
+        )
