@@ -128,9 +128,10 @@ class _Parser:
         table = self._table_ref()
         joins = []
         while True:
-            if self._accept("kw", "join") or (
-                self._accept("kw", "inner") and (self._expect("kw", "join") or True)
-            ):
+            if self._accept("kw", "join"):
+                kind = "inner"
+            elif self._accept("kw", "inner"):
+                self._expect("kw", "join")
                 kind = "inner"
             elif self._accept("kw", "left"):
                 self._expect("kw", "join")
@@ -297,17 +298,29 @@ def _eval_cond(getcol, cond) -> np.ndarray:
     if kind == "between":
         _, name, lo, hi = cond
         col = getcol(name)
-        return (col >= _coerce(col, lo)) & (col <= _coerce(col, hi))
+        valid = ~_null_mask(col)
+        out = np.zeros(len(col), bool)
+        cv = col[valid]
+        out[valid] = (cv >= _coerce(col, lo)) & (cv <= _coerce(col, hi))
+        return out
     _, name, op, lit = cond
     col = getcol(name)
     v = _coerce(col, lit)
-    if op == "=":
-        return col == v
-    if op == "!=":
-        # Spark null semantics: a null row fails EVERY comparison, and
-        # numpy's NaN != x would otherwise let it through
-        return (col != v) & ~_null_mask(col)
-    return {"<": col < v, "<=": col <= v, ">": col > v, ">=": col >= v}[op]
+    # Spark null semantics: a null row fails EVERY comparison (incl. !=);
+    # masking nulls out BEFORE comparing also keeps object columns with
+    # LEFT-JOIN None fills from raising raw TypeErrors
+    valid = ~_null_mask(col)
+    out = np.zeros(len(col), bool)
+    cv = col[valid]
+    out[valid] = {
+        "=": lambda: cv == v,
+        "!=": lambda: cv != v,
+        "<": lambda: cv < v,
+        "<=": lambda: cv <= v,
+        ">": lambda: cv > v,
+        ">=": lambda: cv >= v,
+    }[op]()
+    return out
 
 
 def _resolve_name(t: Table, name: str, aliases: set[str]) -> str:
@@ -339,6 +352,13 @@ def _null_fill_take(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """``col[idx]`` with idx == -1 rows becoming null (LEFT JOIN fills):
     ints widen to float64 so NaN exists; objects get None."""
     missing = idx < 0
+    if col.shape[0] == 0:
+        # LEFT JOIN against an empty right table: every row is a null fill
+        if np.issubdtype(col.dtype, np.datetime64):
+            return np.full(idx.shape, np.datetime64("NaT"), col.dtype)
+        if np.issubdtype(col.dtype, np.number):
+            return np.full(idx.shape, np.nan, np.float64)
+        return np.full(idx.shape, None, object)
     out = col[np.maximum(idx, 0)]
     if not missing.any():
         return out
@@ -419,6 +439,22 @@ def _group_codes(col: np.ndarray) -> np.ndarray:
         return np.unique(col.astype(np.int64), return_inverse=True)[1]
     if np.issubdtype(col.dtype, np.floating):
         return np.unique(col, return_inverse=True, equal_nan=True)[1]
+    if col.dtype == object:
+        # np.unique SORTS, which raises on the None fills LEFT JOIN
+        # writes; insertion-order factorization needs no ordering and
+        # folds every null into one code
+        codes = np.empty(len(col), np.int64)
+        seen: dict = {}
+        null_code = -1
+        for i, v in enumerate(col):
+            if v is None or (isinstance(v, float) and v != v):
+                if null_code < 0:
+                    null_code = len(seen)
+                    seen["\0__null__"] = null_code
+                codes[i] = null_code
+            else:
+                codes[i] = seen.setdefault(v, len(seen))
+        return codes
     return np.unique(col, return_inverse=True)[1]
 
 
@@ -684,6 +720,19 @@ def execute(query: str, resolve_table) -> Table:
                 )
 
             t = t.mask(_eval_cond(scalar_col, q.having))
+        if q.order is not None and q.order[0] not in t.columns:
+            # ORDER BY on a canonical aggregate spelling over the single
+            # output row: validate the reference, then drop the (no-op)
+            # ordering of one row
+            name = q.order[0]
+            if name not in agg_canonical and not _AGG_REF.match(name):
+                raise ValueError(
+                    f"SQL: ORDER BY column {name!r} is not in the table"
+                )
+            q = _Query(
+                items, q.distinct, q.table, q.joins, q.where, q.group,
+                None, None, q.limit,
+            )
         items = None  # already projected
         aliases = set()
     elif q.having is not None:
@@ -703,7 +752,21 @@ def execute(query: str, resolve_table) -> Table:
                 f"SQL: ORDER BY column {col!r} is not in the "
                 f"{'grouped result' if q.group else 'table'}"
             ) from None
-        idx = np.argsort(t.column(col), kind="stable")
+        vals = t.column(col)
+        nm = _null_mask(vals)
+        if nm.any():
+            # null-aware sort (object None would crash np.argsort):
+            # ASC → NULLS FIRST, DESC → NULLS LAST (Spark defaults; the
+            # DESC case falls out of reversing the ASC order below)
+            nonnull = np.flatnonzero(~nm)
+            idx = np.concatenate(
+                [
+                    np.flatnonzero(nm),
+                    nonnull[np.argsort(vals[nonnull], kind="stable")],
+                ]
+            )
+        else:
+            idx = np.argsort(vals, kind="stable")
         if desc:
             idx = idx[::-1]
         t = t.mask(idx)  # integer fancy-indexing permutes every column

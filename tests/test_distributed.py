@@ -152,6 +152,44 @@ _WORKER = textwrap.dedent(
     result["split_feat"] = grown.split_feat.tolist()
     result["threshold"] = grown.threshold.tolist()
     result["value"] = np.asarray(grown.value[..., 0]).tolist()
+
+    # ---- phase 4: GMM EM loop across processes (moment psums) ---------
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.gmm import (
+        _init_params, _make_em_loop,
+    )
+    shift = xk.mean(axis=0).astype(np.float32)
+    m0, c0, w0 = _init_params(
+        (xk - shift).astype(np.float64), 3, d, 0, 1e-6
+    )
+    loop = _make_em_loop(mesh, n // 4, 3, d, 65536, 5)
+    gm_means, gm_covs, gm_weights, gm_ll, _ = loop(
+        put(mesh, xk, P(DATA_AXIS, None)),
+        put(mesh, np.ones((n,), np.float32), P(DATA_AXIS)),
+        put(mesh, shift, P()),
+        put(mesh, m0, P()), put(mesh, c0, P()), put(mesh, w0, P()),
+        jnp.float32(1e-6), jnp.float32(-jnp.inf),
+    )
+    result["gmm_means"] = np.asarray(jax.device_get(gm_means)).tolist()
+    result["gmm_weights"] = np.asarray(jax.device_get(gm_weights)).tolist()
+    result["gmm_ll"] = float(gm_ll)
+
+    # ---- phase 5: multinomial logistic Hessian reductions -------------
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.logistic_regression import (
+        _multinomial_fit,
+    )
+    y3 = np.clip(
+        (xk[:, 0] > 5).astype(np.int32) + 2 * (xk[:, 1] > 5).astype(np.int32),
+        0, 2,
+    ).astype(np.float32)
+    mcoef, mint, _ = _multinomial_fit(
+        put(mesh, xk, P(DATA_AXIS, None)),
+        put(mesh, y3, P(DATA_AXIS)),
+        put(mesh, np.ones((n,), np.float32), P(DATA_AXIS)),
+        jnp.float32(0.01), jnp.float32(1e-6), 3, True, True, 30, 4096,
+    )
+    result["mlr_coef"] = np.asarray(jax.device_get(mcoef)).tolist()
+    result["mlr_intercept"] = np.asarray(jax.device_get(mint)).tolist()
+
     print("RESULT " + json.dumps(result), flush=True)
     print(f"proc {ctx.process_id}: OK coef={coef.round(3).tolist()}")
     """
@@ -206,6 +244,42 @@ def _in_process_reference():
         task="regression", num_trees=1, max_depth=3, max_bins=16,
         seed=0, mesh=mesh, bin_thresholds=thr,
     )
+    # GMM EM + multinomial logistic on the 1-D data mesh (same shapes as
+    # the workers' phases 4-5)
+    import jax.numpy as jnp
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.gmm import (
+        _init_params,
+        _make_em_loop,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.logistic_regression import (
+        _multinomial_fit,
+    )
+
+    mesh1 = build_mesh(MeshConfig(data=4, model=1))
+
+    def put1(arr, spec):
+        return jax.device_put(arr, NamedSharding(mesh1, spec))
+
+    shift = xk.mean(axis=0).astype(np.float32)
+    m0, c0, w0 = _init_params((xk - shift).astype(np.float64), 3, d, 0, 1e-6)
+    loop = _make_em_loop(mesh1, n // 4, 3, d, 65536, 5)
+    gm_means, _, gm_weights, gm_ll, _ = loop(
+        put1(xk, P(DATA_AXIS, None)),
+        put1(np.ones((n,), np.float32), P(DATA_AXIS)),
+        put1(shift, P()),
+        put1(m0, P()), put1(c0, P()), put1(w0, P()),
+        jnp.float32(1e-6), jnp.float32(-jnp.inf),
+    )
+    y3 = np.clip(
+        (xk[:, 0] > 5).astype(np.int32) + 2 * (xk[:, 1] > 5).astype(np.int32),
+        0, 2,
+    ).astype(np.float32)
+    mcoef, mint, _ = _multinomial_fit(
+        put1(xk, P(DATA_AXIS, None)),
+        put1(y3, P(DATA_AXIS)),
+        put1(np.ones((n,), np.float32), P(DATA_AXIS)),
+        jnp.float32(0.01), jnp.float32(1e-6), 3, True, True, 30, 4096,
+    )
     return {
         "centers": np.asarray(jax.device_get(cen)),
         "cost": float(cost),
@@ -213,6 +287,11 @@ def _in_process_reference():
         "split_feat": grown.split_feat,
         "threshold": grown.threshold,
         "value": np.asarray(grown.value[..., 0]),
+        "gmm_means": np.asarray(jax.device_get(gm_means)),
+        "gmm_weights": np.asarray(jax.device_get(gm_weights)),
+        "gmm_ll": float(gm_ll),
+        "mlr_coef": np.asarray(jax.device_get(mcoef)),
+        "mlr_intercept": np.asarray(jax.device_get(mint)),
     }
 
 
@@ -286,3 +365,20 @@ def test_two_process_cluster_fit(tmp_path):
         np.asarray(got["threshold"]), ref["threshold"], atol=1e-6
     )
     np.testing.assert_allclose(np.asarray(got["value"]), ref["value"], atol=1e-4)
+    # GMM moment psums and multinomial Hessian reductions crossed the
+    # process boundary and landed on the in-process trajectories
+    np.testing.assert_allclose(
+        np.asarray(got["gmm_means"]), ref["gmm_means"], atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(got["gmm_weights"]), ref["gmm_weights"], atol=1e-4
+    )
+    np.testing.assert_allclose(got["gmm_ll"], ref["gmm_ll"], rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(got["mlr_coef"]), ref["mlr_coef"], atol=2e-3
+    )
+    # intercepts are the least-pinned direction of a softmax fit; the
+    # cross-process partitioning reorders f32 accumulation slightly
+    np.testing.assert_allclose(
+        np.asarray(got["mlr_intercept"]), ref["mlr_intercept"], atol=5e-3
+    )

@@ -408,3 +408,59 @@ def test_join_incomparable_key_types(session, hospital_meta):
         session.sql(
             "SELECT * FROM events e JOIN hospitals h ON e.hospital_id = h.beds"
         )
+
+
+def test_left_join_none_fills_survive_downstream(
+    session, hospital_table, hospital_meta
+):
+    """GROUP BY / DISTINCT / ORDER BY / WHERE over the None fills a LEFT
+    JOIN writes into object columns (review findings: raw TypeErrors)."""
+    session.register_table("hospitals", hospital_meta)
+    base = (
+        "FROM events e LEFT JOIN hospitals h "
+        "ON e.hospital_id = h.hospital_id"
+    )
+    g = session.sql(f"SELECT h.name, COUNT(*) AS n {base} GROUP BY h.name")
+    # one group is the null (unmatched) bucket
+    names = list(g.column("name"))
+    assert sum(1 for v in names if v is None) == 1
+    assert sum(g.column("n")) == len(hospital_table)
+
+    d = session.sql(f"SELECT DISTINCT h.name {base}")
+    assert sum(1 for v in d.column("name") if v is None) == 1
+
+    o = session.sql(f"SELECT h.name {base} ORDER BY h.name")
+    vals = list(o.column("name"))
+    k = sum(1 for v in vals if v is None)
+    assert k > 0 and all(v is None for v in vals[:k])  # ASC: nulls first
+    o2 = session.sql(f"SELECT h.name {base} ORDER BY h.name DESC")
+    vals2 = list(o2.column("name"))
+    assert all(v is None for v in vals2[-k:])          # DESC: nulls last
+
+    w = session.sql(f"SELECT h.name {base} WHERE h.name >= 'A'")
+    assert all(v is not None for v in w.column("name"))
+
+
+def test_left_join_empty_right_table(session, hospital_table):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    empty = Table.from_dict(
+        {
+            "hospital_id": np.array([], object),
+            "beds": np.array([], np.int64),
+        }
+    )
+    session.register_table("nobody", empty)
+    out = session.sql(
+        "SELECT e.hospital_id, x.beds FROM events e "
+        "LEFT JOIN nobody x ON e.hospital_id = x.hospital_id"
+    )
+    assert len(out) == len(hospital_table)
+    assert np.isnan(out.column("beds")).all()
+
+
+def test_order_by_aggregate_without_group_by(session):
+    out = session.sql("SELECT COUNT(*) AS n FROM events ORDER BY COUNT(*)")
+    assert len(out) == 1
+    with pytest.raises(ValueError, match="ORDER BY"):
+        session.sql("SELECT COUNT(*) AS n FROM events ORDER BY nope")
