@@ -44,11 +44,28 @@ _FAMILY_LINKS = {
     "binomial": ("logit", ("logit",)),
     "poisson": ("log", ("log", "identity", "sqrt")),
     "gamma": ("inverse", ("inverse", "log", "identity")),
+    # tweedie uses POWER links (g(μ) = μ^linkPower, log when 0), selected
+    # via link_power — Spark's family="tweedie" surface
+    "tweedie": ("power", ("power",)),
 }
 
 
-def _link_fns(link: str):
-    """(g(μ), g⁻¹(η), g'(μ)) — all traceable."""
+def _link_fns(link: str, link_power: float = 0.0):
+    """(g(μ), g⁻¹(η), g'(μ)) — all traceable.  ``link="power"`` is the
+    tweedie family's μ^link_power (log when link_power == 0)."""
+    if link == "power":
+        lp = float(link_power)
+        if lp == 0.0:
+            return _link_fns("log")
+        if lp == 1.0:
+            return _link_fns("identity")
+        if lp == -1.0:
+            return _link_fns("inverse")
+        return (
+            lambda mu: mu ** lp,
+            lambda eta: jnp.maximum(eta, 1e-12) ** (1.0 / lp),
+            lambda mu: lp * mu ** (lp - 1.0),
+        )
     if link == "identity":
         return (lambda mu: mu, lambda eta: eta, lambda mu: jnp.ones_like(mu))
     if link == "log":
@@ -70,7 +87,10 @@ def _link_fns(link: str):
     raise ValueError(f"unknown link {link!r}")
 
 
-def _variance_fn(family: str):
+def _variance_fn(family: str, var_power: float = 0.0):
+    if family == "tweedie":
+        vp = float(var_power)
+        return lambda mu: mu ** vp
     return {
         "gaussian": lambda mu: jnp.ones_like(mu),
         "binomial": lambda mu: mu * (1.0 - mu),
@@ -79,54 +99,70 @@ def _variance_fn(family: str):
     }[family]
 
 
-def _mu_clip(family: str, mu):
-    """Keep μ inside the family's domain so V(μ) and g'(μ) stay finite."""
+def _mu_clip(family: str, mu, var_power: float = 0.0):
+    """Keep μ inside the family's domain so V(μ) and g'(μ) stay finite.
+    tweedie with variance_power 0 IS gaussian (μ unrestricted — clamping
+    would silently corrupt fits on negative-mean data)."""
     if family == "binomial":
         return jnp.clip(mu, 1e-6, 1.0 - 1e-6)
-    if family in ("poisson", "gamma"):
+    if family in ("poisson", "gamma") or (
+        family == "tweedie" and float(var_power) != 0.0
+    ):
         return jnp.maximum(mu, 1e-8)
     return mu
 
 
 @partial(
     jax.jit,
-    static_argnames=("family", "link", "fit_intercept", "standardize", "max_iter"),
+    static_argnames=(
+        "family", "link", "fit_intercept", "standardize", "max_iter",
+        "var_power", "link_power",
+    ),
 )
 def _irls_glm(
-    x, y, w, reg_param, tol,
+    x, y, w, offset, reg_param, tol,
     family: str, link: str, fit_intercept: bool, standardize: bool, max_iter: int,
+    var_power: float = 0.0, link_power: float = 0.0,
 ):
+    """``offset`` (n,) is Spark's offsetCol: a fixed additive term of the
+    linear predictor η = Xβ [+ b] + offset (e.g. log-exposure for poisson
+    rate models) — excluded from the solve's working response."""
     x = x.astype(jnp.float32)
     y = y.astype(jnp.float32)
     w = w.astype(jnp.float32)
+    offset = offset.astype(jnp.float32)
     xa, ridge, nfeat, _ = standardized_design(
         x, w, reg_param, fit_intercept, standardize
     )
     d = xa.shape[1]
-    g, ginv, gprime = _link_fns(link)
-    vfn = _variance_fn(family)
+    g, ginv, gprime = _link_fns(link, link_power)
+    vfn = _variance_fn(family, var_power)
 
     # μ init (Spark/statsmodels convention): nudge y into the domain.
     n = jnp.maximum(jnp.sum(w), 1.0)
     ybar = jnp.sum(y * w) / n
     if family == "binomial":
         mu0 = jnp.clip((y + 0.5) / 2.0, 1e-3, 1.0 - 1e-3)
-    elif family in ("poisson", "gamma"):
+    elif family in ("poisson", "gamma") or (
+        family == "tweedie" and var_power != 0.0
+    ):
         mu0 = jnp.maximum(y, 0.0) + 0.1 * jnp.maximum(ybar, 0.1)
     else:
         mu0 = y
-    eta0 = g(_mu_clip(family, mu0))
+    eta0 = g(_mu_clip(family, mu0, var_power))
 
     def irls_step(theta, eta):
-        mu = _mu_clip(family, ginv(eta))
+        mu = _mu_clip(family, ginv(eta), var_power)
         gp = gprime(mu)
         z = eta + (y - mu) * gp
         om = w / jnp.maximum(gp * gp * vfn(mu), 1e-12)
         gram = (xa * om[:, None]).T @ xa + jnp.diag(ridge)
-        mom = (xa * om[:, None]).T @ z
+        # the offset is a FIXED part of η: subtract it from the working
+        # response so the solve fits only Xβ (McCullagh & Nelder §4.4)
+        mom = (xa * om[:, None]).T @ (z - offset)
         jitter = 1e-7 * jnp.trace(gram) / d + 1e-9
         theta_new = jnp.linalg.solve(gram + jitter * jnp.eye(d, dtype=x.dtype), mom)
-        return theta_new, xa @ theta_new
+        return theta_new, xa @ theta_new + offset
 
     def cond(carry):
         it, theta, _, delta = carry
@@ -148,12 +184,12 @@ def _irls_glm(
     intercept = theta[nfeat] if fit_intercept else jnp.zeros((), x.dtype)
 
     # deviance of the final fit (family-specific; Spark summary surface)
-    mu = _mu_clip(family, ginv(xa @ theta))
-    deviance = jnp.sum(_unit_deviance(family, y, mu) * w)
+    mu = _mu_clip(family, ginv(xa @ theta + offset), var_power)
+    deviance = jnp.sum(_unit_deviance(family, y, mu, var_power) * w)
     return coef, intercept, it, deviance
 
 
-def _unit_deviance(family: str, y, mu):
+def _unit_deviance(family: str, y, mu, var_power: float = 0.0):
     """Per-row deviance contribution d(y, μ) (McCullagh & Nelder) — shared
     by the fit's final deviance, the summary's nullDeviance (μ = intercept-
     only mean), and ``residuals("deviance")``."""
@@ -167,6 +203,22 @@ def _unit_deviance(family: str, y, mu):
     if family == "poisson":
         ylog = jnp.where(y > 0, y * jnp.log(y / mu), 0.0)
         return 2.0 * (ylog - (y - mu))
+    if family == "tweedie":
+        p = float(var_power)
+        if p == 0.0:
+            return _unit_deviance("gaussian", y, mu)
+        if p == 1.0:
+            return _unit_deviance("poisson", y, mu)
+        if p == 2.0:
+            return _unit_deviance("gamma", y, mu)
+        # the general compound-Poisson form; y = 0 is in-domain for
+        # 1 < p < 2 (the y^(2-p) term vanishes there)
+        yp = jnp.maximum(y, 0.0)
+        return 2.0 * (
+            jnp.where(yp > 0, yp ** (2.0 - p), 0.0) / ((1.0 - p) * (2.0 - p))
+            - y * mu ** (1.0 - p) / (1.0 - p)
+            + mu ** (2.0 - p) / (2.0 - p)
+        )
     # gamma
     return 2.0 * (-jnp.log(jnp.maximum(y, 1e-12) / mu) + (y - mu) / mu)
 
@@ -190,6 +242,7 @@ class GeneralizedLinearRegressionTrainingSummary:
     _ds: object = field(repr=False)
     _reg_param: float = 0.0
     _fit_intercept: bool = True
+    _offset: object | None = field(default=None, repr=False)  # (n_pad,) or None
 
     # -- shared one-pass statistics ------------------------------------
     @cached_property
@@ -197,27 +250,62 @@ class GeneralizedLinearRegressionTrainingSummary:
         """ONE jitted pass over the mesh → every scalar the summary needs."""
         m = self._model
         fam = m.family
-        _, ginv, _ = _link_fns(m.link)
-        vfn = _variance_fn(fam)
+        _, ginv, _ = _link_fns(m.link, m.link_power)
+        vfn = _variance_fn(fam, m.variance_power)
+
+        g_link, _, _ = _link_fns(m.link, m.link_power)
+        has_offset = self._offset is not None
+        fit_intercept = self._fit_intercept
+        vfn_ = vfn
+        vp_s = m.variance_power
 
         @jax.jit
-        def stats(x, y, w):
+        def stats(x, y, w, off):
             x = x.astype(jnp.float32)
             y = y.astype(jnp.float32)
             w = w.astype(jnp.float32)
-            eta = x @ jnp.asarray(m.coefficients, jnp.float32) + jnp.float32(
-                m.intercept
+            off = off.astype(jnp.float32)
+            eta = (
+                x @ jnp.asarray(m.coefficients, jnp.float32)
+                + jnp.float32(m.intercept)
+                + off
             )
-            mu = _mu_clip(fam, ginv(eta))
+            mu = _mu_clip(fam, ginv(eta), vp_s)
             wsum = jnp.sum(w)
             nrows = jnp.sum((w > 0).astype(jnp.float32))
             ybar = jnp.sum(y * w) / jnp.maximum(wsum, 1e-12)
-            # intercept-only MLE is the weighted mean for EVERY link (the
-            # one-parameter score Σ wᵢ(yᵢ−μ)/(V(μ)g'(μ)) vanishes at ȳ)
-            mu0 = _mu_clip(fam, ybar * jnp.ones_like(y)) if self._fit_intercept \
-                else _mu_clip(fam, ginv(jnp.zeros_like(y)))
-            dev = jnp.sum(_unit_deviance(fam, y, mu) * w)
-            dev0 = jnp.sum(_unit_deviance(fam, y, mu0) * w)
+            if not has_offset:
+                # intercept-only MLE is the weighted mean for EVERY link
+                # (the one-parameter score Σ wᵢ(yᵢ−μ)/(V(μ)g'(μ))
+                # vanishes at ȳ) — one closed form, no iteration
+                mu0 = (
+                    _mu_clip(fam, ybar * jnp.ones_like(y), vp_s)
+                    if fit_intercept
+                    else _mu_clip(fam, ginv(jnp.zeros_like(y)), vp_s)
+                )
+            elif not fit_intercept:
+                mu0 = _mu_clip(fam, ginv(off), vp_s)
+            else:
+                # offset null model: η₀ = b₀ + offset has no closed form —
+                # a few scalar-IRLS sweeps converge b₀ (Spark refits the
+                # intercept-only model with the offset the same way)
+                _, ginv_, gprime_ = _link_fns(m.link, m.link_power)
+
+                def b0_step(_, b0):
+                    mu_ = _mu_clip(fam, ginv_(b0 + off), vp_s)
+                    gp_ = gprime_(mu_)
+                    om_ = w / jnp.maximum(gp_ * gp_ * vfn_(mu_), 1e-12)
+                    z_ = b0 + (y - mu_) * gp_   # working response − offset
+                    return jnp.sum(om_ * z_) / jnp.maximum(jnp.sum(om_), 1e-12)
+
+                b0 = jax.lax.fori_loop(
+                    0, 25, b0_step,
+                    g_link(_mu_clip(fam, jnp.maximum(ybar, 1e-8) * jnp.ones(()), vp_s)),
+                )
+                mu0 = _mu_clip(fam, ginv(b0 + off), vp_s)
+            vp = m.variance_power
+            dev = jnp.sum(_unit_deviance(fam, y, mu, vp) * w)
+            dev0 = jnp.sum(_unit_deviance(fam, y, mu0, vp) * w)
             pearson = jnp.sum(w * (y - mu) ** 2 / jnp.maximum(vfn(mu), 1e-12))
             # family log-likelihood pieces (dispersion-free parts; the
             # gaussian/gamma AIC closes over deviance/dispersion on host)
@@ -243,10 +331,15 @@ class GeneralizedLinearRegressionTrainingSummary:
                 y_over_mu=y_over_mu,
             )
 
+        off = (
+            self._offset
+            if self._offset is not None
+            else jnp.zeros_like(self._ds.y)
+        )
         return {
             k: float(v)
             for k, v in jax.device_get(
-                stats(self._ds.x, self._ds.y, self._ds.w)
+                stats(self._ds.x, self._ds.y, self._ds.w, off)
             ).items()
         }
 
@@ -304,6 +397,13 @@ class GeneralizedLinearRegressionTrainingSummary:
 
         s = self._stats
         fam = self._model.family
+        if fam == "tweedie":
+            # Spark's TweedieFamily likewise has no closed-form AIC
+            raise RuntimeError(
+                "AIC is not defined for the tweedie family (no closed-form "
+                "likelihood); Spark's GeneralizedLinearRegression raises "
+                "here too"
+            )
         if fam == "gaussian":
             # −2ℓ at the MLE σ̂² = deviance/Σw, + 2 for estimating σ²
             fam_aic = (
@@ -330,12 +430,14 @@ class GeneralizedLinearRegressionTrainingSummary:
         ``residuals(residualsType)``: deviance | pearson | working |
         response.  Weighted rows scale the deviance/pearson forms by √w."""
         m = self._model
-        _, ginv, gprime = _link_fns(m.link)
-        vfn = _variance_fn(m.family)
+        _, ginv, gprime = _link_fns(m.link, m.link_power)
+        vfn = _variance_fn(m.family, m.variance_power)
         x = self._ds.x
         y = np.asarray(jax.device_get(self._ds.y), np.float64)
         w = np.asarray(jax.device_get(self._ds.w), np.float64)
-        mu = np.asarray(jax.device_get(m.predict(x)), np.float64)
+        mu = np.asarray(
+            jax.device_get(m.predict(x, offset=self._offset)), np.float64
+        )
         valid = w > 0
         y, w, mu = y[valid], w[valid], mu[valid]
         if residuals_type == "response":
@@ -347,7 +449,9 @@ class GeneralizedLinearRegressionTrainingSummary:
             return (y - mu) / np.sqrt(v) * np.sqrt(w)
         if residuals_type == "deviance":
             d = np.asarray(
-                _unit_deviance(m.family, jnp.asarray(y), jnp.asarray(mu))
+                _unit_deviance(
+                    m.family, jnp.asarray(y), jnp.asarray(mu), m.variance_power
+                )
             )
             return np.sign(y - mu) * np.sqrt(np.maximum(d, 0.0) * w)
         raise ValueError(
@@ -371,17 +475,19 @@ class GeneralizedLinearRegressionTrainingSummary:
         Spark.  Raises on a (near-)singular weighted Gram."""
         self._require_unregularized()
         m = self._model
-        _, ginv, gprime = _link_fns(m.link)
-        vfn = _variance_fn(m.family)
+        _, ginv, gprime = _link_fns(m.link, m.link_power)
+        vfn = _variance_fn(m.family, m.variance_power)
         fit_intercept = self._fit_intercept
 
         @jax.jit
-        def gram(x, w):
+        def gram(x, w, off):
             x = x.astype(jnp.float32)
-            eta = x @ jnp.asarray(m.coefficients, jnp.float32) + jnp.float32(
-                m.intercept
+            eta = (
+                x @ jnp.asarray(m.coefficients, jnp.float32)
+                + jnp.float32(m.intercept)
+                + off.astype(jnp.float32)
             )
-            mu = _mu_clip(m.family, ginv(eta))
+            mu = _mu_clip(m.family, ginv(eta), m.variance_power)
             gp = gprime(mu)
             om = w / jnp.maximum(gp * gp * vfn(mu), 1e-12)
             xa = (
@@ -391,7 +497,14 @@ class GeneralizedLinearRegressionTrainingSummary:
             )
             return (xa * om[:, None]).T @ xa
 
-        g = np.asarray(jax.device_get(gram(self._ds.x, self._ds.w)), np.float64)
+        off = (
+            self._offset
+            if self._offset is not None
+            else jnp.zeros_like(self._ds.w)
+        )
+        g = np.asarray(
+            jax.device_get(gram(self._ds.x, self._ds.w, off)), np.float64
+        )
         cond = np.linalg.cond(g)
         if not np.isfinite(cond) or cond > 1e7:
             raise RuntimeError(
@@ -430,6 +543,10 @@ class GeneralizedLinearRegressionModel(Model):
     link: str
     n_iter: int = 0
     deviance: float = 0.0
+    # tweedie family parameters (Spark's variancePower/linkPower); inert
+    # (0.0) for the named-link families
+    variance_power: float = 0.0
+    link_power: float = 0.0
     _summary: object | None = field(default=None, repr=False, compare=False)
 
     @property
@@ -451,21 +568,22 @@ class GeneralizedLinearRegressionModel(Model):
             raise summary_unavailable("GeneralizedLinearRegressionModel")
         return self._summary
 
-    def predict(self, x: jax.Array) -> jax.Array:
-        """Mean prediction μ = g⁻¹(xβ + b) (Spark's prediction column)."""
-        check_features(x, np.asarray(self.coefficients).shape[0], type(self).__name__)
-        _, ginv, _ = _link_fns(self.link)
-        eta = x.astype(jnp.float32) @ jnp.asarray(self.coefficients, jnp.float32) + (
-            jnp.float32(self.intercept)
-        )
-        return ginv(eta)
+    def predict(self, x: jax.Array, offset: jax.Array | None = None) -> jax.Array:
+        """Mean prediction μ = g⁻¹(xβ + b [+ offset]) (Spark's prediction
+        column; pass the serving rows' offset when the model was fitted
+        with ``offset_col``)."""
+        _, ginv, _ = _link_fns(self.link, self.link_power)
+        return ginv(self.predict_link(x, offset))
 
-    def predict_link(self, x: jax.Array) -> jax.Array:
+    def predict_link(self, x: jax.Array, offset: jax.Array | None = None) -> jax.Array:
         """Linear predictor η (Spark's linkPrediction column)."""
         check_features(x, np.asarray(self.coefficients).shape[0], type(self).__name__)
-        return x.astype(jnp.float32) @ jnp.asarray(
+        eta = x.astype(jnp.float32) @ jnp.asarray(
             self.coefficients, jnp.float32
         ) + jnp.float32(self.intercept)
+        if offset is not None:
+            eta = eta + jnp.asarray(offset, jnp.float32)
+        return eta
 
     def _artifacts(self):
         return (
@@ -476,6 +594,8 @@ class GeneralizedLinearRegressionModel(Model):
                 "intercept": float(self.intercept),
                 "n_iter": int(self.n_iter),
                 "deviance": float(self.deviance),
+                "variance_power": float(self.variance_power),
+                "link_power": float(self.link_power),
             },
             {"coefficients": np.asarray(self.coefficients)},
         )
@@ -489,6 +609,8 @@ class GeneralizedLinearRegressionModel(Model):
             link=params["link"],
             n_iter=int(params.get("n_iter", 0)),
             deviance=float(params.get("deviance", 0.0)),
+            variance_power=float(params.get("variance_power", 0.0)),
+            link_power=float(params.get("link_power", 0.0)),
         )
 
 
@@ -504,6 +626,16 @@ class GeneralizedLinearRegression(Estimator):
     label_col: str = "length_of_stay"
     features_col: str = "features"
     weight_col: str | None = None
+    # tweedie family (Spark's variancePower/linkPower): V(μ) = μ^p with
+    # p ∈ {0} ∪ [1, ∞); link g(μ) = μ^linkPower (log when 0), defaulting
+    # to 1 − p.  Both ignored for the named-link families.
+    variance_power: float = 0.0
+    link_power: float | None = None
+    # Spark's offsetCol: a table column added VERBATIM to the linear
+    # predictor (η = Xβ + b + offset — e.g. log-exposure in poisson rate
+    # models); predictions need the serving offset passed explicitly
+    # (``model.predict(x, offset=...)``).
+    offset_col: str | None = None
 
     def fit(self, data, label_col: str | None = None, mesh=None):
         if self.family not in _FAMILY_LINKS:
@@ -517,10 +649,42 @@ class GeneralizedLinearRegression(Estimator):
             raise ValueError(
                 f"link {link!r} is not supported for family "
                 f"{self.family!r}; one of {allowed}"
+                + (" (tweedie selects its link via link_power)"
+                   if self.family == "tweedie" else "")
             )
+        vp = float(self.variance_power)
+        lp = 0.0
+        if self.family == "tweedie":
+            if not (vp == 0.0 or vp >= 1.0):
+                raise ValueError(
+                    f"variance_power must be 0 or >= 1 (Spark's tweedie "
+                    f"domain); got {vp}"
+                )
+            lp = float(self.link_power) if self.link_power is not None else 1.0 - vp
         ds = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )
+        offset = None
+        if self.offset_col is not None:
+            from ..features.assembler import AssembledTable
+            from ..parallel.sharding import shard_rows
+
+            if not isinstance(data, AssembledTable):
+                raise ValueError(
+                    f"offset_col={self.offset_col!r} needs a table input to "
+                    f"resolve the column; got {type(data).__name__}"
+                )
+            if self.offset_col not in data.table.schema:
+                raise KeyError(
+                    f"offset_col {self.offset_col!r} is not a column of the "
+                    f"table; available: {data.table.schema.names}"
+                )
+            off = np.zeros((ds.n_padded,), np.float32)
+            vals = np.asarray(
+                data.table.column(self.offset_col), np.float32
+            )
+            off[: vals.shape[0]] = vals
+            offset = shard_rows(off, mesh)
         y_host = np.asarray(jax.device_get(ds.y))
         w_host = np.asarray(jax.device_get(ds.w))
         yv = y_host[w_host > 0]
@@ -536,15 +700,29 @@ class GeneralizedLinearRegression(Estimator):
                     f"{'non-negative' if self.family == 'poisson' else 'positive'}"
                     " labels"
                 )
+        if self.family == "tweedie":
+            # 1 ≤ p < 2 admits exact zeros (compound Poisson); p ≥ 2 needs
+            # strictly positive labels (gamma-and-beyond); p = 0 is
+            # gaussian (unrestricted)
+            if vp >= 2.0 and yv.min() <= 0.0:
+                raise ValueError(
+                    f"tweedie with variance_power={vp} needs positive labels"
+                )
+            if 1.0 <= vp < 2.0 and yv.min() < 0.0:
+                raise ValueError(
+                    f"tweedie with variance_power={vp} needs non-negative "
+                    "labels"
+                )
         if self.family == "gaussian" and link == "log" and yv.min() <= 0.0:
             # η₀ = log(y) — a non-positive label would NaN the first IRLS
             # step and silently return an all-NaN model
             raise ValueError("gaussian family with log link needs positive labels")
         coef, intercept, it, deviance = _irls_glm(
             ds.x, ds.y, ds.w,
+            offset if offset is not None else jnp.zeros_like(ds.y),
             jnp.float32(self.reg_param), jnp.float32(self.tol),
             self.family, link, self.fit_intercept, self.standardize,
-            self.max_iter,
+            self.max_iter, vp, lp,
         )
         model = GeneralizedLinearRegressionModel(
             coefficients=np.asarray(jax.device_get(coef)),
@@ -553,8 +731,10 @@ class GeneralizedLinearRegression(Estimator):
             link=link,
             n_iter=int(it),
             deviance=float(deviance),
+            variance_power=vp,
+            link_power=lp,
         )
         model._summary = GeneralizedLinearRegressionTrainingSummary(
-            model, ds, self.reg_param, self.fit_intercept
+            model, ds, self.reg_param, self.fit_intercept, offset
         )
         return model
