@@ -9,13 +9,23 @@ from .minmax import MinMaxScaler, MinMaxScalerModel
 from .onehot import OneHotEncoder, OneHotEncoderModel
 from .normalizer import IndexToString, Normalizer, PolynomialExpansion
 from .pca import PCA, PCAModel
+from .robust import (
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    RobustScaler,
+    RobustScalerModel,
+)
 from .selector import (
     ChiSqSelector,
     UnivariateFeatureSelector,
     UnivariateFeatureSelectorModel,
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
     VectorIndexer,
     VectorIndexerModel,
 )
+from .sql_transformer import SQLTransformer
+from .vector_ops import ElementwiseProduct, Interaction, VectorSlicer
 
 __all__ = [
     "AssembledTable",
@@ -43,4 +53,14 @@ __all__ = [
     "UnivariateFeatureSelectorModel",
     "VectorIndexer",
     "VectorIndexerModel",
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
+    "RobustScaler",
+    "RobustScalerModel",
+    "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel",
+    "SQLTransformer",
+    "ElementwiseProduct",
+    "Interaction",
+    "VectorSlicer",
 ]

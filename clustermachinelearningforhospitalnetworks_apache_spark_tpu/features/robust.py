@@ -1,0 +1,159 @@
+"""RobustScaler and MaxAbsScaler.
+
+Parity with ``pyspark.ml.feature.RobustScaler`` (center by median, scale
+by the IQR — outlier-resistant standardization) and ``MaxAbsScaler``
+(scale to [-1, 1] by the per-column max |x|, preserving sparsity/signs).
+
+MaxAbsScaler's statistic is one fused device min/max reduction
+(``ops.reductions.moment_stats``).  RobustScaler's quantiles come from a
+bounded host sample of valid rows (the same estimator the tree binning
+uses, ``parallel.sharding.sample_valid_rows``) — Spark likewise computes
+them with approxQuantile rather than an exact distributed sort.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from ..ops.reductions import moment_stats
+from ..parallel.sharding import DeviceDataset
+from .scaler import _is_assembled
+
+
+@register_model("MaxAbsScalerModel")
+@dataclass(frozen=True)
+class MaxAbsScalerModel:
+    max_abs: np.ndarray
+
+    def _artifacts(self):
+        return ("MaxAbsScalerModel", {}, {"max_abs": np.asarray(self.max_abs)})
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(arrays["max_abs"])
+
+    def transform(self, x):
+        if _is_assembled(x):
+            return replace(x, features=self.transform(x.features))
+        if isinstance(x, DeviceDataset):
+            return DeviceDataset(
+                x=self.transform(x.x) * (x.w[:, None] > 0), y=x.y, w=x.w
+            )
+        xp = jnp if isinstance(x, jax.Array) else np
+        m = xp.asarray(self.max_abs, x.dtype)
+        safe = xp.where(m > 0, m, 1.0)   # all-zero column stays zero
+        return x / safe[None, :]
+
+
+@dataclass(frozen=True)
+class MaxAbsScaler:
+    def fit(self, data) -> MaxAbsScalerModel:
+        if _is_assembled(data):
+            data = data.to_device()
+        if isinstance(data, DeviceDataset):
+            s = moment_stats(data.x, data.w)
+            if float(s["count"]) == 0.0:
+                raise ValueError("MaxAbsScaler fit on an empty dataset")
+            lo, hi = np.asarray(s["min"], np.float64), np.asarray(s["max"], np.float64)
+        else:
+            x = np.asarray(data, np.float64)
+            if x.shape[0] == 0:
+                raise ValueError("MaxAbsScaler fit on an empty dataset")
+            lo, hi = x.min(axis=0), x.max(axis=0)
+        return MaxAbsScalerModel(np.maximum(np.abs(lo), np.abs(hi)))
+
+    def fit_transform(self, data):
+        return self.fit(data).transform(data)
+
+
+@register_model("RobustScalerModel")
+@dataclass(frozen=True)
+class RobustScalerModel:
+    median: np.ndarray     # per-column q50
+    iqr: np.ndarray        # per-column q(upper) − q(lower)
+    with_centering: bool = False
+    with_scaling: bool = True
+
+    def _artifacts(self):
+        return (
+            "RobustScalerModel",
+            {
+                "with_centering": self.with_centering,
+                "with_scaling": self.with_scaling,
+            },
+            {"median": np.asarray(self.median), "iqr": np.asarray(self.iqr)},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            arrays["median"], arrays["iqr"],
+            bool(params.get("with_centering", False)),
+            bool(params.get("with_scaling", True)),
+        )
+
+    def transform(self, x):
+        if _is_assembled(x):
+            return replace(x, features=self.transform(x.features))
+        if isinstance(x, DeviceDataset):
+            return DeviceDataset(
+                x=self.transform(x.x) * (x.w[:, None] > 0), y=x.y, w=x.w
+            )
+        xp = jnp if isinstance(x, jax.Array) else np
+        out = x
+        if self.with_centering:
+            out = out - xp.asarray(self.median, x.dtype)[None, :]
+        if self.with_scaling:
+            s = xp.asarray(self.iqr, x.dtype)
+            out = out / xp.where(s > 0, s, 1.0)[None, :]  # constant col unscaled
+        return out
+
+
+@dataclass(frozen=True)
+class RobustScaler:
+    """Spark defaults: lower=0.25, upper=0.75, withCentering=False,
+    withScaling=True."""
+
+    lower: float = 0.25
+    upper: float = 0.75
+    with_centering: bool = False
+    with_scaling: bool = True
+    sample_size: int = 65536
+
+    def __post_init__(self):
+        if not 0.0 <= self.lower < self.upper <= 1.0:
+            raise ValueError(
+                f"need 0 <= lower < upper <= 1; got ({self.lower}, {self.upper})"
+            )
+
+    def fit(self, data) -> RobustScalerModel:
+        from ..parallel.sharding import sample_valid_rows
+
+        if _is_assembled(data):
+            data = data.to_device()
+        if isinstance(data, DeviceDataset):
+            sample = sample_valid_rows(data, self.sample_size, seed=0)
+        else:
+            sample = np.asarray(data, np.float64)
+            if sample.shape[0] > self.sample_size:
+                rng = np.random.default_rng(0)
+                sample = sample[
+                    np.sort(
+                        rng.choice(sample.shape[0], self.sample_size, replace=False)
+                    )
+                ]
+        if sample.shape[0] == 0:
+            raise ValueError("RobustScaler fit on an empty dataset")
+        q = np.quantile(sample, [self.lower, 0.5, self.upper], axis=0)
+        return RobustScalerModel(
+            median=q[1], iqr=q[2] - q[0],
+            with_centering=self.with_centering, with_scaling=self.with_scaling,
+        )
+
+    def fit_transform(self, data):
+        return self.fit(data).transform(data)

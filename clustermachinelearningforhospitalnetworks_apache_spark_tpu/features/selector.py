@@ -265,3 +265,72 @@ class ChiSqSelector:
             selection_threshold=self.num_top_features,
             label_col=label_col or self.label_col,
         ).fit(data, label_col=label_col, mesh=mesh)
+
+
+# --------------------------------------------- VarianceThresholdSelector
+@register_model("VarianceThresholdSelectorModel")
+@dataclass(frozen=True)
+class VarianceThresholdSelectorModel(_Saveable):
+    selected: tuple[int, ...]
+
+    def transform(self, data):
+        from ..parallel.sharding import DeviceDataset
+
+        idx = list(self.selected)
+        if isinstance(data, DeviceDataset):
+            # column subset stays device-resident (fit accepts a
+            # DeviceDataset, so transform must too)
+            return DeviceDataset(
+                x=data.x[:, np.asarray(idx, np.int32)], y=data.y, w=data.w
+            )
+        x = _as_matrix(data)
+        cols = None
+        if isinstance(data, AssembledTable):
+            cols = [data.feature_cols[i] for i in idx]
+        return _rewrap(data, x[:, idx], cols)
+
+    def _artifacts(self):
+        return (
+            "VarianceThresholdSelectorModel",
+            {"selected": list(map(int, self.selected))},
+            {},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(selected=tuple(int(i) for i in params["selected"]))
+
+
+@dataclass(frozen=True)
+class VarianceThresholdSelector:
+    """Drop features whose SAMPLE variance is ≤ ``variance_threshold``
+    (Spark 3.1's selector; default 0 keeps everything non-constant).
+    The variance comes from one fused device moment pass."""
+
+    variance_threshold: float = 0.0
+
+    def fit(self, data, label_col: str | None = None, mesh=None):
+        from ..ops.reductions import moment_stats
+        from ..parallel.sharding import DeviceDataset
+
+        if isinstance(data, AssembledTable):
+            ds = data.to_device(mesh=mesh)
+        elif isinstance(data, DeviceDataset):
+            ds = data
+        else:
+            x = np.asarray(data, np.float64)
+            n = x.shape[0]
+            var = x.var(axis=0, ddof=1) if n > 1 else np.zeros(x.shape[1])
+            sel = np.flatnonzero(var > self.variance_threshold)
+            return VarianceThresholdSelectorModel(
+                selected=tuple(int(i) for i in sel)
+            )
+        s = {k: np.asarray(v, np.float64) for k, v in moment_stats(ds.x, ds.w).items()}
+        n = s["n"]
+        if n <= 1:
+            raise ValueError("VarianceThresholdSelector needs at least 2 rows")
+        mean = s["s1"] / n
+        # weighted SAMPLE variance (ddof=1 at unit weights — Spark's)
+        var = np.maximum(s["s2"] / n - mean * mean, 0.0) * (n / max(n - 1.0, 1.0))
+        sel = np.flatnonzero(var > self.variance_threshold)
+        return VarianceThresholdSelectorModel(selected=tuple(int(i) for i in sel))

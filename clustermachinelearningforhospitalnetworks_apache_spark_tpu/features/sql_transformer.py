@@ -1,0 +1,72 @@
+"""SQLTransformer — a pipeline stage that runs a SQL statement against
+its input table.
+
+Parity with ``pyspark.ml.feature.SQLTransformer``: the statement
+references the incoming dataset as ``__THIS__`` and the output is the
+query result (projection, filtering, grouping — the ``core/sql.py``
+subset, which includes JOINs against tables passed via ``tables``).
+Spark's arithmetic column expressions are outside the engine's grammar
+and raise a parse error rather than mis-executing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..core.table import Table
+from ..io.model_io import register_model
+
+_THIS = "__THIS__"
+
+
+@register_model("SQLTransformer")
+@dataclass(frozen=True)
+class SQLTransformer:
+    statement: str = "SELECT * FROM __THIS__"
+    # extra named tables the statement may JOIN against (not persisted —
+    # like Spark, only the statement round-trips)
+    tables: dict = field(default_factory=dict, compare=False)
+
+    def __post_init__(self):
+        if _THIS not in self.statement:
+            raise ValueError(
+                f"SQLTransformer statement must reference {_THIS}; got "
+                f"{self.statement!r}"
+            )
+
+    def _artifacts(self):
+        if self.tables:
+            # only the statement round-trips (like Spark); with no session
+            # catalog here, a reloaded JOIN stage could never resolve its
+            # extra tables — refuse loudly instead of saving a dud
+            raise ValueError(
+                "SQLTransformer with extra `tables` cannot be persisted "
+                f"(the statement references {sorted(self.tables)} which "
+                "have no catalog to reload from); inline the data or "
+                "re-attach tables after load"
+            )
+        return ("SQLTransformer", {"statement": self.statement}, {})
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(statement=params["statement"])
+
+    def transform(self, table: Table) -> Table:
+        from ..core.sql import execute
+
+        if not isinstance(table, Table):
+            raise TypeError(
+                f"SQLTransformer transforms a Table; got {type(table).__name__}"
+            )
+
+        def resolve(name: str) -> Table:
+            if name == "__this__":
+                return table
+            if name in self.tables:
+                return self.tables[name]
+            raise KeyError(
+                f"unknown table {name!r}; the statement sees {_THIS} and "
+                f"{sorted(self.tables) or 'no extra tables'}"
+            )
+
+        return execute(self.statement.replace(_THIS, "__this__"), resolve)

@@ -1,0 +1,171 @@
+"""Stateless vector transforms: VectorSlicer, ElementwiseProduct,
+Interaction.
+
+Parity with the corresponding ``pyspark.ml.feature`` stages (the
+reference's VectorAssembler at ``mllearnforhospitalnetwork.py:135-136``
+is the only feature op it uses; Spark makes these the same one-liner,
+SURVEY.md E3).  All are row-local, so on device they fuse into whatever
+consumes them.  Each accepts ndarray / device array / AssembledTable /
+DeviceDataset like the other stages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from ..parallel.sharding import DeviceDataset
+from .scaler import _is_assembled
+
+
+def _dispatch(self, x, fn, cols_fn=None):
+    """Shared container plumbing: AssembledTable / DeviceDataset / array.
+    ``cols_fn(feature_cols) -> new feature_cols`` keeps the AssembledTable
+    column names consistent with the transformed matrix width (downstream
+    selectors index ``feature_cols`` positionally)."""
+    if _is_assembled(x):
+        cols = (
+            tuple(cols_fn(x.feature_cols)) if cols_fn is not None
+            else x.feature_cols
+        )
+        return replace(x, features=fn(x.features), feature_cols=cols)
+    if isinstance(x, DeviceDataset):
+        out = fn(x.x)
+        return DeviceDataset(x=out * (x.w[:, None] > 0), y=x.y, w=x.w)
+    return fn(x)
+
+
+@register_model("VectorSlicer")
+@dataclass(frozen=True)
+class VectorSlicer:
+    """Column subset of the feature vector (Spark's ``indices`` param;
+    name-based slicing happens upstream via ``VectorAssembler`` columns)."""
+
+    indices: tuple[int, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "indices", tuple(int(i) for i in self.indices))
+        if len(self.indices) == 0:
+            raise ValueError("VectorSlicer needs at least one index")
+        if len(set(self.indices)) != len(self.indices):
+            raise ValueError(f"duplicate indices in {self.indices}")
+        if any(i < 0 for i in self.indices):
+            raise ValueError(f"negative index in {self.indices}")
+
+    def _artifacts(self):
+        return ("VectorSlicer", {"indices": list(self.indices)}, {})
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(tuple(params["indices"]))
+
+    def transform(self, x):
+        def fn(feats):
+            if max(self.indices) >= feats.shape[1]:
+                raise ValueError(
+                    f"VectorSlicer index {max(self.indices)} out of range "
+                    f"for {feats.shape[1]} features"
+                )
+            idx = np.asarray(self.indices, np.int32)
+            return feats[:, idx]
+
+        return _dispatch(
+            self, x, fn, lambda cols: tuple(cols[i] for i in self.indices)
+        )
+
+
+@register_model("ElementwiseProduct")
+@dataclass(frozen=True)
+class ElementwiseProduct:
+    """Hadamard product with a fixed scaling vector (Spark's scalingVec)."""
+
+    scaling_vec: tuple[float, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "scaling_vec", tuple(float(v) for v in self.scaling_vec)
+        )
+        if len(self.scaling_vec) == 0:
+            raise ValueError("ElementwiseProduct needs a non-empty scaling_vec")
+
+    def _artifacts(self):
+        return ("ElementwiseProduct", {"scaling_vec": list(self.scaling_vec)}, {})
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(tuple(params["scaling_vec"]))
+
+    def transform(self, x):
+        def fn(feats):
+            if feats.shape[1] != len(self.scaling_vec):
+                raise ValueError(
+                    f"ElementwiseProduct scaling_vec has "
+                    f"{len(self.scaling_vec)} entries but features have "
+                    f"{feats.shape[1]} columns"
+                )
+            xp = jnp if isinstance(feats, jax.Array) else np
+            return feats * xp.asarray(self.scaling_vec, feats.dtype)[None, :]
+
+        return _dispatch(self, x, fn)
+
+
+@register_model("Interaction")
+@dataclass(frozen=True)
+class Interaction:
+    """All pairwise products between two column groups — the two-input
+    case of Spark's ``Interaction`` (its general form crosses N assembled
+    vector columns; here the groups are index tuples into the assembled
+    feature matrix, composing with :class:`VectorSlicer` semantics).
+    Output column order is ``left-major`` (Spark's nesting order)."""
+
+    left: tuple[int, ...] = ()
+    right: tuple[int, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "left", tuple(int(i) for i in self.left))
+        object.__setattr__(self, "right", tuple(int(i) for i in self.right))
+        if not self.left or not self.right:
+            raise ValueError("Interaction needs non-empty left and right index groups")
+        if any(i < 0 for i in self.left + self.right):
+            raise ValueError(
+                f"negative index in {self.left + self.right} (numpy would "
+                "silently wrap to the wrong feature)"
+            )
+
+    def _artifacts(self):
+        return (
+            "Interaction",
+            {"left": list(self.left), "right": list(self.right)},
+            {},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(tuple(params["left"]), tuple(params["right"]))
+
+    def transform(self, x):
+        def fn(feats):
+            hi = max(max(self.left), max(self.right))
+            if hi >= feats.shape[1]:
+                raise ValueError(
+                    f"Interaction index {hi} out of range for "
+                    f"{feats.shape[1]} features"
+                )
+            li = np.asarray(self.left, np.int32)
+            ri = np.asarray(self.right, np.int32)
+            a = feats[:, li]            # (n, L)
+            b = feats[:, ri]            # (n, R)
+            prod = a[:, :, None] * b[:, None, :]  # (n, L, R)
+            return prod.reshape(feats.shape[0], len(li) * len(ri))
+
+        return _dispatch(
+            self, x, fn,
+            lambda cols: tuple(
+                f"{cols[i]}*{cols[j]}" for i in self.left for j in self.right
+            ),
+        )

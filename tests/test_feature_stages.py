@@ -331,3 +331,252 @@ def test_new_stages_compose_in_pipeline(hospital_table, mesh8, tmp_path):
     a, _ = pm.transform(hospital_table, mesh=mesh8).to_numpy()
     b, _ = back.transform(hospital_table, mesh=mesh8).to_numpy()
     np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+# ---- round 4: RobustScaler / MaxAbsScaler / vector ops / selector / SQL ----
+
+
+class TestMaxAbsScaler:
+    @pytest.mark.fast
+    def test_matches_sklearn(self, rng, mesh8):
+        from sklearn.preprocessing import MaxAbsScaler as SK
+
+        x = (rng.normal(size=(500, 4)) * [1, 10, 0.1, 5]).astype(np.float32)
+        ours = ht.MaxAbsScaler().fit(x)
+        np.testing.assert_allclose(
+            np.asarray(ours.transform(x)), SK().fit_transform(x), rtol=1e-5
+        )
+
+    def test_device_dataset_and_roundtrip(self, rng, mesh8, tmp_path):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+            load_model, save_model,
+        )
+
+        x = rng.normal(size=(256, 3)).astype(np.float32)
+        ds = ht.device_dataset(x, mesh=mesh8)
+        m = ht.MaxAbsScaler().fit(ds)
+        out = m.transform(ds)
+        assert float(np.abs(np.asarray(out.x)).max()) <= 1.0 + 1e-6
+        save_model(str(tmp_path / "mas"), *m._artifacts())
+        back = load_model(str(tmp_path / "mas"))
+        np.testing.assert_allclose(back.max_abs, m.max_abs)
+
+    def test_zero_column_stays_zero(self, mesh8):
+        x = np.zeros((32, 2), np.float32)
+        x[:, 1] = 3.0
+        out = np.asarray(ht.MaxAbsScaler().fit(x).transform(x))
+        assert np.all(out[:, 0] == 0) and np.all(out[:, 1] == 1.0)
+
+
+class TestRobustScaler:
+    def test_matches_sklearn(self, rng, mesh8):
+        from sklearn.preprocessing import RobustScaler as SK
+
+        x = rng.normal(size=(4000, 3)).astype(np.float64)
+        x[:50] *= 50  # outliers — the point of the robust statistics
+        ours = ht.RobustScaler(with_centering=True).fit(x)
+        ref = SK(with_centering=True).fit(x)
+        np.testing.assert_allclose(ours.median, ref.center_, rtol=5e-2, atol=5e-2)
+        np.testing.assert_allclose(ours.iqr, ref.scale_, rtol=5e-2)
+
+    def test_sharded_fit(self, rng, mesh8):
+        x = rng.normal(loc=5.0, size=(2048, 2)).astype(np.float32)
+        ds = ht.device_dataset(x, mesh=mesh8)
+        m = ht.RobustScaler(with_centering=True).fit(ds)
+        out = np.asarray(m.transform(x))
+        assert abs(np.median(out[:, 0])) < 0.05   # centered
+        q = np.quantile(out[:, 0], [0.25, 0.75])
+        np.testing.assert_allclose(q[1] - q[0], 1.0, atol=0.1)  # unit IQR
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="lower"):
+            ht.RobustScaler(lower=0.9, upper=0.1)
+        with pytest.raises(ValueError, match="empty"):
+            ht.RobustScaler().fit(np.empty((0, 2), np.float32))
+
+
+class TestVectorOps:
+    @pytest.mark.fast
+    def test_slicer_product_interaction(self, rng, mesh8):
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        sl = ht.VectorSlicer(indices=(2, 0))
+        np.testing.assert_array_equal(np.asarray(sl.transform(x)), x[:, [2, 0]])
+        ep = ht.ElementwiseProduct(scaling_vec=(2.0, 0.0, 1.0, -1.0))
+        np.testing.assert_allclose(
+            np.asarray(ep.transform(x)), x * np.array([2.0, 0.0, 1.0, -1.0])
+        )
+        it = ht.Interaction(left=(0, 1), right=(2, 3))
+        out = np.asarray(it.transform(x))
+        assert out.shape == (64, 4)
+        np.testing.assert_allclose(out[:, 0], x[:, 0] * x[:, 2], rtol=1e-6)
+        np.testing.assert_allclose(out[:, 3], x[:, 1] * x[:, 3], rtol=1e-6)
+
+    def test_validation_and_errors(self, rng):
+        x = np.ones((8, 3), np.float32)
+        with pytest.raises(ValueError, match="index"):
+            ht.VectorSlicer(indices=())
+        with pytest.raises(ValueError, match="duplicate"):
+            ht.VectorSlicer(indices=(1, 1))
+        with pytest.raises(ValueError, match="out of range"):
+            ht.VectorSlicer(indices=(5,)).transform(x)
+        with pytest.raises(ValueError, match="entries"):
+            ht.ElementwiseProduct(scaling_vec=(1.0,)).transform(x)
+
+    def test_device_dataset_pass_through(self, rng, mesh8):
+        x = rng.normal(size=(128, 4)).astype(np.float32)
+        ds = ht.device_dataset(x, mesh=mesh8)
+        out = ht.VectorSlicer(indices=(1, 3)).transform(ds)
+        np.testing.assert_allclose(np.asarray(out.x), x[:, [1, 3]], rtol=1e-6)
+
+
+class TestVarianceThresholdSelector:
+    def test_drops_low_variance(self, rng, mesh8):
+        n = 1024
+        x = np.stack(
+            [
+                rng.normal(0, 2.0, n),          # high variance: keep
+                np.full(n, 7.0),                 # constant: drop
+                rng.normal(0, 0.01, n),          # tiny variance: drop at 0.1
+                rng.normal(0, 1.0, n),           # keep
+            ],
+            axis=1,
+        ).astype(np.float32)
+        m = ht.VarianceThresholdSelector(variance_threshold=0.1).fit(
+            ht.device_dataset(x, mesh=mesh8)
+        )
+        assert m.selected == (0, 3)
+        np.testing.assert_array_equal(
+            np.asarray(m.transform(x)), x[:, [0, 3]]
+        )
+        # default 0 keeps everything non-constant
+        m0 = ht.VarianceThresholdSelector().fit(x)
+        assert m0.selected == (0, 2, 3)
+
+
+class TestSQLTransformer:
+    def test_statement_runs_against_this(self, mesh8):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+        t = Table.from_dict(
+            {
+                "hospital_id": np.array(["A", "B", "A"], object),
+                "los": np.array([2.0, 8.0, 4.0]),
+            }
+        )
+        st = ht.SQLTransformer(
+            statement="SELECT hospital_id, AVG(los) AS a FROM __THIS__ "
+            "GROUP BY hospital_id ORDER BY hospital_id"
+        )
+        out = st.transform(t)
+        np.testing.assert_allclose(out.column("a"), [3.0, 8.0])
+
+    def test_join_against_extra_table(self, mesh8):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+        t = Table.from_dict(
+            {"hospital_id": np.array(["A", "B"], object), "los": np.array([2.0, 8.0])}
+        )
+        meta = Table.from_dict(
+            {"hospital_id": np.array(["A", "B"], object),
+             "name": np.array(["General", "Mercy"], object)}
+        )
+        st = ht.SQLTransformer(
+            statement="SELECT m.name, e.los FROM __THIS__ e "
+            "JOIN meta m ON e.hospital_id = m.hospital_id",
+            tables={"meta": meta},
+        )
+        out = st.transform(t)
+        assert list(out.column("name")) == ["General", "Mercy"]
+
+    def test_validation(self, mesh8, tmp_path):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+            load_model, save_model,
+        )
+
+        with pytest.raises(ValueError, match="__THIS__"):
+            ht.SQLTransformer(statement="SELECT * FROM events")
+        st = ht.SQLTransformer(statement="SELECT * FROM __THIS__ LIMIT 1")
+        with pytest.raises(TypeError, match="Table"):
+            st.transform(np.ones((3, 2)))
+        save_model(str(tmp_path / "sqlt"), *st._artifacts())
+        assert load_model(str(tmp_path / "sqlt")).statement == st.statement
+
+
+def test_round4_stages_compose_in_pipeline(rng, mesh8, tmp_path):
+    """The new stages are first-class Pipeline citizens (fit/transform +
+    persistence through the composite saver)."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    n = 512
+    t = Table.from_dict(
+        {
+            "a": rng.normal(0, 3, n).astype(np.float32),
+            "b": rng.normal(5, 1, n).astype(np.float32),
+            "c": np.full(n, 2.0, np.float32),        # constant → dropped
+            "length_of_stay": rng.normal(4, 1, n).astype(np.float32),
+        }
+    )
+    pipe = ht.Pipeline(
+        [
+            ht.VectorAssembler(["a", "b", "c"]),
+            ht.VarianceThresholdSelector(variance_threshold=0.01),
+            ht.RobustScaler(with_centering=True),
+            ht.LinearRegression(),
+        ]
+    )
+    pm = pipe.fit(t, mesh=mesh8)
+    preds = pm.transform(t, mesh=mesh8)
+    assert np.isfinite(np.asarray(preds.prediction)).all()
+    pm.write().overwrite().save(str(tmp_path / "p4"))
+    back = ht.load_model(str(tmp_path / "p4"))
+    np.testing.assert_allclose(
+        np.asarray(back.transform(t, mesh=mesh8).prediction),
+        np.asarray(preds.prediction),
+        rtol=1e-6,
+    )
+
+
+def test_round4_review_fixes(rng, mesh8):
+    """Regression coverage for the review findings on the new stages."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    n = 64
+    t = Table.from_dict(
+        {
+            "a": rng.normal(size=n).astype(np.float32),
+            "b": rng.normal(size=n).astype(np.float32),
+            "c": rng.normal(size=n).astype(np.float32),
+        }
+    )
+    asm = ht.VectorAssembler(["a", "b", "c"]).transform(t)
+    # sliced AssembledTable keeps consistent feature_cols
+    sl = ht.VectorSlicer(indices=(2, 0)).transform(asm)
+    assert sl.feature_cols == ("c", "a")
+    assert sl.features.shape[1] == 2
+    it = ht.Interaction(left=(0,), right=(1, 2)).transform(asm)
+    assert it.feature_cols == ("a*b", "a*c")
+    assert it.features.shape[1] == 2
+    # negative Interaction indices raise instead of wrapping
+    with pytest.raises(ValueError, match="negative"):
+        ht.Interaction(left=(-1,), right=(0,))
+    # VarianceThresholdSelector transform accepts what fit accepts
+    x = rng.normal(size=(128, 3)).astype(np.float32)
+    ds = ht.device_dataset(x, mesh=mesh8)
+    m = ht.VarianceThresholdSelector().fit(ds)
+    out = m.transform(ds)
+    np.testing.assert_allclose(
+        np.asarray(out.x), np.asarray(ds.x)[:, list(m.selected)], rtol=1e-6
+    )
+    # MaxAbsScaler empty fit raises (not a sentinel statistic)
+    with pytest.raises(ValueError, match="empty"):
+        ht.MaxAbsScaler().fit(
+            ht.device_dataset(np.ones((8, 2), np.float32),
+                              weights=np.zeros(8, np.float32), mesh=mesh8)
+        )
+    # SQLTransformer with extra tables refuses persistence
+    st = ht.SQLTransformer(
+        statement="SELECT * FROM __THIS__ e JOIN m x ON e.a = x.a",
+        tables={"m": t},
+    )
+    with pytest.raises(ValueError, match="persist"):
+        st._artifacts()
