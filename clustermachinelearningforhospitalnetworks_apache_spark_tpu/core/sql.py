@@ -7,7 +7,9 @@ same one-liner.  This module covers that working set with a hand-rolled
 tokenizer + recursive-descent parser + numpy columnar executor — no
 Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
 
-    SELECT [DISTINCT] [cols | agg(col) [AS alias]]
+    SELECT [DISTINCT] [* [, extras] | cols | agg(col) | arithmetic
+                       expressions over cols/aggs/literals (+ - * /,
+                       parentheses, unary minus) [AS alias]]
       FROM t [[AS] a]
       [[INNER|LEFT] JOIN t2 [[AS] b] ON a.key = b.key]   (single-key
                                          equi-join, vectorized hash join)
@@ -41,7 +43,7 @@ _TOKEN = re.compile(
     r"(?P<str>'(?:[^']|'')*')"
     r"|(?P<num>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
     r"|(?P<word>[A-Za-z_][A-Za-z_0-9]*)"
-    r"|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|\*|,|\.)"
+    r"|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|\*|,|\.|\+|-|/)"
     r")"
 )
 
@@ -75,9 +77,79 @@ def _tokenize(query: str) -> list[tuple[str, str]]:
 
 @dataclass
 class _SelectItem:
-    agg: str | None      # None = plain column
-    col: str | None      # None = COUNT(*)
+    agg: str | None      # None = plain column / expression
+    col: str | None      # None = COUNT(*) / expression; "*" = star-plus
     alias: str
+    # arithmetic expression AST (("col",name) | ("lit",v) | ("agg",name) |
+    # ("neg",e) | ("bin",op,l,r)); None for the simple col/agg fast paths
+    expr: tuple | None = None
+
+
+def _expr_has_agg(e) -> bool:
+    if e is None:
+        return False
+    k = e[0]
+    if k == "agg":
+        return True
+    if k == "neg":
+        return _expr_has_agg(e[1])
+    if k == "bin":
+        return _expr_has_agg(e[2]) or _expr_has_agg(e[3])
+    return False
+
+
+def _expr_cols(e) -> list[str]:
+    """Bare (non-aggregate) column atoms of an expression."""
+    if e is None:
+        return []
+    k = e[0]
+    if k == "col":
+        return [e[1]]
+    if k == "neg":
+        return _expr_cols(e[1])
+    if k == "bin":
+        return _expr_cols(e[2]) + _expr_cols(e[3])
+    return []
+
+
+def _render_expr(e) -> str:
+    """Default output name for an un-aliased expression (Spark-style)."""
+    k = e[0]
+    if k == "col":
+        return e[1].split(".")[-1]
+    if k == "lit":
+        return str(e[1])
+    if k == "agg":
+        return e[1]
+    if k == "neg":
+        return f"-{_render_expr(e[1])}"
+    return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
+
+
+def _eval_expr(getcol, e):
+    """Evaluate an expression AST to a column (or scalar for pure-literal
+    trees).  Arithmetic follows Spark SQL: ``/`` is float division and a
+    zero divisor yields null (NaN), nulls propagate through every op."""
+    k = e[0]
+    if k == "col" or k == "agg":
+        return getcol(e[1])
+    if k == "lit":
+        return e[1]
+    if k == "neg":
+        return -_eval_expr(getcol, e[1])
+    _, op, le, re_ = e
+    lv = _eval_expr(getcol, le)
+    rv = _eval_expr(getcol, re_)
+    if op == "+":
+        return lv + rv
+    if op == "-":
+        return lv - rv
+    if op == "*":
+        return lv * rv
+    with np.errstate(divide="ignore", invalid="ignore"):
+        den = np.asarray(rv, np.float64)
+        out = np.asarray(lv, np.float64) / np.where(den == 0, np.nan, den)
+    return out
 
 
 @dataclass
@@ -211,35 +283,80 @@ class _Parser:
 
     def _select_list(self):
         if self._accept("op", "*"):
-            return None  # SELECT *
+            if not self._accept("op", ","):
+                return None  # SELECT *
+            # SELECT *, expr AS x, ... — Spark's SQLTransformer shape:
+            # the star expands at projection time, the extras append
+            items = [_SelectItem(None, "*", "*")]
+            items.append(self._select_item())
+            while self._accept("op", ","):
+                items.append(self._select_item())
+            return items
         items = [self._select_item()]
         while self._accept("op", ","):
             items.append(self._select_item())
         return items
 
     def _select_item(self) -> _SelectItem:
-        t = self._next()
-        if t[0] == "kw" and t[1] in _AGGS:
-            agg = t[1]
-            self._expect("op", "(")
-            if self._accept("op", "*"):
-                if agg != "count":
-                    raise ValueError(f"SQL: {agg.upper()}(*) is not defined")
-                col = None
-            else:
-                col = self._qual_tail(self._expect("name")[1])
-            self._expect("op", ")")
-            alias = f"{agg}({col or '*'})"
-        elif t[0] == "name":
-            col = self._qual_tail(t[1])
-            # a qualified column's default output name is its UNQUALIFIED
-            # part (Spark: df.select("h.name") yields column "name")
-            agg, alias = None, col.split(".")[-1]
+        e = self._expr()
+        # bare column / bare aggregate keep the legacy fast-path fields
+        if e[0] == "col":
+            col = e[1]
+            item = _SelectItem(None, col, col.split(".")[-1])
+        elif e[0] == "agg":
+            name = e[1]
+            agg = name.split("(", 1)[0]
+            inner = name[len(agg) + 1 : -1]
+            item = _SelectItem(agg, None if inner == "*" else inner, name)
         else:
-            raise ValueError(f"SQL: expected column or aggregate, got {t[1]!r}")
+            item = _SelectItem(None, None, _render_expr(e), expr=e)
         if self._accept("kw", "as"):
-            alias = self._expect("name")[1]
-        return _SelectItem(agg, col, alias)
+            item.alias = self._expect("name")[1]
+        return item
+
+    # ---- arithmetic expressions (SELECT items) ----
+    def _expr(self):
+        left = self._term()
+        while True:
+            if self._accept("op", "+"):
+                left = ("bin", "+", left, self._term())
+            elif self._accept("op", "-"):
+                left = ("bin", "-", left, self._term())
+            elif self._peek()[0] == "num" and self._peek()[1].startswith("-"):
+                # "a-1" tokenizes as [a][-1]: fold the sign into a binop
+                v = self._next()[1][1:]
+                lit = float(v) if ("." in v or "e" in v.lower()) else int(v)
+                left = ("bin", "-", left, ("lit", lit))
+            else:
+                return left
+
+    def _term(self):
+        left = self._factor()
+        while True:
+            if self._accept("op", "*"):
+                left = ("bin", "*", left, self._factor())
+            elif self._accept("op", "/"):
+                left = ("bin", "/", left, self._factor())
+            else:
+                return left
+
+    def _factor(self):
+        t = self._peek()
+        if t == ("op", "-"):
+            self._next()
+            return ("neg", self._factor())
+        if t == ("op", "("):
+            self._next()
+            e = self._expr()
+            self._expect("op", ")")
+            return e
+        if t[0] in ("num", "str"):
+            return ("lit", self._literal())
+        if t[0] == "kw" and t[1] in _AGGS:
+            return ("agg", self._name(allow_agg=True))
+        if t[0] == "name":
+            return ("col", self._name())
+        raise ValueError(f"SQL: expected column, literal or aggregate, got {t[1]!r}")
 
     def _or_cond(self, allow_agg: bool = False):
         left = self._and_cond(allow_agg)
@@ -594,6 +711,19 @@ def execute(query: str, resolve_table) -> Table:
             raise ValueError("SQL: GROUP BY requires an explicit select list")
         group_cols = {g: _resolve_name(t, g, aliases) for g in q.group}
         for it in items:
+            if it.col == "*":
+                raise ValueError("SQL: SELECT * cannot mix with GROUP BY")
+            if it.expr is not None:
+                for c in _expr_cols(it.expr):
+                    if not (
+                        c in q.group
+                        or _resolve_name(t, c, aliases) in group_cols.values()
+                    ):
+                        raise ValueError(
+                            f"SQL: column {c!r} inside an expression must "
+                            "appear in GROUP BY or an aggregate"
+                        )
+                continue
             if it.agg is None and not (
                 it.col in q.group
                 or _resolve_name(t, it.col, aliases) in group_cols.values()
@@ -618,9 +748,26 @@ def execute(query: str, resolve_table) -> Table:
         )
         counts = np.bincount(inv, minlength=len(uniq))
         first_row = order_idx[starts]             # one representative/group
+
+        def per_group_atom(name: str) -> np.ndarray:
+            """Expression atom in grouped context: aggregate spelling →
+            on-demand aggregate; group key → its per-group value."""
+            m = _AGG_REF.match(name)
+            if m:
+                agg, c = m.groups()
+                if c == "*":
+                    return counts.astype(np.int64)
+                return _grouped_aggregate(getcol(c), agg, starts, order_idx)
+            return getcol(name)[first_row]
+
         cols: dict[str, Any] = {}
         for it in items:
-            if it.agg is None:
+            if it.expr is not None:
+                v = _eval_expr(per_group_atom, it.expr)
+                cols[it.alias] = (
+                    np.full(len(first_row), v) if np.ndim(v) == 0 else v
+                )
+            elif it.agg is None:
                 cols[it.alias] = getcol(it.col)[first_row]
             elif it.col is None:  # COUNT(*)
                 cols[it.alias] = counts.astype(np.int64)
@@ -637,7 +784,11 @@ def execute(query: str, resolve_table) -> Table:
             for it in items
             if it.agg is not None
         }
-        sel_by_col = {it.col: it.alias for it in items if it.agg is None}
+        sel_by_col = {
+            it.col: it.alias
+            for it in items
+            if it.agg is None and it.col is not None
+        }
 
         def grouped_col(name: str, what: str) -> np.ndarray:
             if name in cols:
@@ -676,10 +827,22 @@ def execute(query: str, resolve_table) -> Table:
             )
         items = None  # already projected to aliases
         aliases = set()
-    elif items is not None and any(it.agg is not None for it in items):
+    elif items is not None and any(
+        it.agg is not None or _expr_has_agg(it.expr) for it in items
+    ):
         # whole-table aggregates collapse to one row — a bare column in the
         # same list has no single value (Spark requires GROUP BY too)
         for it in items:
+            if it.col == "*":
+                raise ValueError("SQL: SELECT * cannot mix with aggregates")
+            if it.expr is not None:
+                bare = _expr_cols(it.expr)
+                if bare:
+                    raise ValueError(
+                        f"SQL: column {bare[0]!r} cannot mix with "
+                        "aggregates without GROUP BY"
+                    )
+                continue
             if it.agg is None:
                 raise ValueError(
                     f"SQL: column {it.col!r} cannot mix with aggregates "
@@ -689,14 +852,22 @@ def execute(query: str, resolve_table) -> Table:
         agg_canonical = {
             f"{it.agg}({it.col or '*'})": it.alias for it in items
         }
-        t = Table.from_dict(
-            {
-                it.alias: np.asarray(
+        def scalar_atom(name: str):
+            m = _AGG_REF.match(name)
+            if not m:
+                raise ValueError(f"SQL: {name!r} is not an aggregate")
+            agg, c = m.groups()
+            return float(len(t)) if c == "*" else _aggregate(getcol(c), agg)
+
+        out_cols: dict[str, Any] = {}
+        for it in items:
+            if it.expr is not None:
+                out_cols[it.alias] = np.asarray([_eval_expr(scalar_atom, it.expr)])
+            else:
+                out_cols[it.alias] = np.asarray(
                     [len(t) if it.col is None else _aggregate(getcol(it.col), it.agg)]
                 )
-                for it in items
-            }
-        )
+        t = Table.from_dict(out_cols)
         if q.having is not None:
             # no GROUP BY: the whole table is one group — HAVING filters
             # the single output row (Spark semantics)
@@ -741,18 +912,28 @@ def execute(query: str, resolve_table) -> Table:
     if q.order is not None and len(t) > 0:
         col, desc = q.order
         # order BEFORE projection so ORDER BY may reference any source
-        # column (legal SQL); a SELECT alias resolves to its source here,
+        # column (legal SQL); a SELECT alias resolves to its source here
+        # (expression aliases evaluate their expression as the sort key),
         # and grouped results order by their output columns
+        vals = None
         if col not in t.columns and items is not None:
-            col = {it.alias: it.col for it in items}.get(col, col)
-        try:
-            col = _resolve_name(t, col, aliases)
-        except ValueError:
-            raise ValueError(
-                f"SQL: ORDER BY column {col!r} is not in the "
-                f"{'grouped result' if q.group else 'table'}"
-            ) from None
-        vals = t.column(col)
+            for it in items:
+                if it.alias == col and it.expr is not None:
+                    vals = np.asarray(_eval_expr(getcol, it.expr))
+                    break
+            else:
+                col = {
+                    it.alias: it.col for it in items if it.col is not None
+                }.get(col, col)
+        if vals is None:
+            try:
+                col = _resolve_name(t, col, aliases)
+            except ValueError:
+                raise ValueError(
+                    f"SQL: ORDER BY column {col!r} is not in the "
+                    f"{'grouped result' if q.group else 'table'}"
+                ) from None
+            vals = t.column(col)
         nm = _null_mask(vals)
         if nm.any():
             # null-aware sort (object None would crash np.argsort):
@@ -772,10 +953,30 @@ def execute(query: str, resolve_table) -> Table:
         t = t.mask(idx)  # integer fancy-indexing permutes every column
     if items is not None:
         # plain projection, applied after ORDER BY so sorting may use any
-        # source column; aliases materialize here
-        t = Table.from_dict(
-            {it.alias: t.column(_resolve_name(t, it.col, aliases)) for it in items}
-        )
+        # source column; star-plus expands here, expressions evaluate
+        # per row, aliases materialize
+        proj: dict[str, Any] = {}
+        for pos, it in enumerate(items):
+            if it.col == "*":
+                if pos != 0:
+                    raise ValueError("SQL: * must come first in a select list")
+                for c in t.columns:
+                    proj[c] = t.column(c)
+                continue
+            if it.alias in proj:
+                # an extra whose alias collides with a star-expanded base
+                # column would silently shadow it (the select-list dup
+                # check can't see what * expands to)
+                raise ValueError(
+                    f"SQL: duplicate output column {it.alias!r}; "
+                    "disambiguate with AS"
+                )
+            if it.expr is not None:
+                v = _eval_expr(getcol, it.expr)
+                proj[it.alias] = np.full(len(t), v) if np.ndim(v) == 0 else v
+            else:
+                proj[it.alias] = t.column(_resolve_name(t, it.col, aliases))
+        t = Table.from_dict(proj)
     elif "__order_by__" in t.columns:
         # drop the grouped ORDER BY carrier column
         t = Table.from_dict(

@@ -2,6 +2,7 @@ from .binary import BinaryClassificationEvaluator
 from .regression import RegressionEvaluator
 from .classification import MulticlassClassificationEvaluator
 from .clustering import ClusteringEvaluator, inertia
+from .ranking import MultilabelClassificationEvaluator, RankingEvaluator
 
 __all__ = [
     "BinaryClassificationEvaluator",
@@ -9,4 +10,6 @@ __all__ = [
     "MulticlassClassificationEvaluator",
     "ClusteringEvaluator",
     "inertia",
+    "MultilabelClassificationEvaluator",
+    "RankingEvaluator",
 ]

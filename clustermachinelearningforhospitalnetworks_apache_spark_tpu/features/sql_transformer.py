@@ -3,10 +3,10 @@ its input table.
 
 Parity with ``pyspark.ml.feature.SQLTransformer``: the statement
 references the incoming dataset as ``__THIS__`` and the output is the
-query result (projection, filtering, grouping — the ``core/sql.py``
-subset, which includes JOINs against tables passed via ``tables``).
-Spark's arithmetic column expressions are outside the engine's grammar
-and raise a parse error rather than mis-executing.
+query result.  The ``core/sql.py`` subset covers Spark's canonical
+SQLTransformer shapes — ``SELECT *, (v1 + v2) AS v3 FROM __THIS__``
+(star-plus projection with arithmetic expressions), filtering, grouping,
+and JOINs against tables passed via ``tables``.
 """
 
 from __future__ import annotations

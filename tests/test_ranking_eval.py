@@ -1,0 +1,143 @@
+"""RankingEvaluator + MultilabelClassificationEvaluator (the last two
+pyspark.ml.evaluation evaluators; the ragged per-row sets are padded to
+fixed-width -1-sentinel matrices so every metric is one vectorized
+membership reduction — the same padding-not-branching rule the
+estimators use for rows)."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+pytestmark = pytest.mark.fast
+
+# the example from Spark's RankingMetrics docs
+PRED = [
+    [1, 6, 2, 7, 8, 3, 9, 10, 4, 5],
+    [4, 1, 5, 6, 2, 7, 3, 8, 9, 10],
+    [1, 2, 3, 4, 5],
+]
+TRUTH = [
+    [1, 2, 3, 4, 5],
+    [1, 2, 3],
+    [],
+]
+
+
+class TestRankingEvaluator:
+    def test_mean_average_precision(self):
+        # hand-computed AP per Spark's formula:
+        # row0 hits at ranks 1,3,6,9,10 → (1/1+2/3+3/6+4/9+5/10)/5
+        ap0 = (1 + 2 / 3 + 3 / 6 + 4 / 9 + 5 / 10) / 5
+        # row1 hits at ranks 2,5,7 → (1/2+2/5+3/7)/3
+        ap1 = (1 / 2 + 2 / 5 + 3 / 7) / 3
+        expect = (ap0 + ap1 + 0.0) / 3
+        got = ht.RankingEvaluator("meanAveragePrecision").evaluate(PRED, TRUTH)
+        np.testing.assert_allclose(got, expect, rtol=1e-9)
+
+    def test_precision_and_recall_at_k(self):
+        # k=3: row0 has hits {1,2} in top 3 → 2/3; row1 {1} → 1/3; row2 0
+        p3 = ht.RankingEvaluator("precisionAtK", k=3).evaluate(PRED, TRUTH)
+        np.testing.assert_allclose(p3, (2 / 3 + 1 / 3 + 0) / 3, rtol=1e-9)
+        r3 = ht.RankingEvaluator("recallAtK", k=3).evaluate(PRED, TRUTH)
+        np.testing.assert_allclose(r3, (2 / 5 + 1 / 3 + 0) / 3, rtol=1e-9)
+
+    def test_ndcg_perfect_ranking_is_one(self):
+        pred = [[3, 1, 2], [7, 8]]
+        truth = [[1, 2, 3], [7, 8]]
+        got = ht.RankingEvaluator("ndcgAtK", k=3).evaluate(pred, truth)
+        np.testing.assert_allclose(got, 1.0, rtol=1e-9)
+
+    def test_ndcg_order_sensitivity(self):
+        best = ht.RankingEvaluator("ndcgAtK", k=2).evaluate([[1, 9]], [[1]])
+        worse = ht.RankingEvaluator("ndcgAtK", k=2).evaluate([[9, 1]], [[1]])
+        assert best == 1.0 and 0 < worse < 1.0
+        np.testing.assert_allclose(worse, (1 / np.log2(3)) / 1.0, rtol=1e-9)
+
+    def test_map_at_k(self):
+        # k=2: row0 hits rank 1 → (1/1)/min(5,2)=0.5; row1 hits rank 2 →
+        # (1/2)/min(3,2)=0.25; row2 empty → 0
+        got = ht.RankingEvaluator("meanAveragePrecisionAtK", k=2).evaluate(
+            PRED, TRUTH
+        )
+        np.testing.assert_allclose(got, (0.5 + 0.25 + 0) / 3, rtol=1e-9)
+
+    def test_validation(self):
+        ev = ht.RankingEvaluator("nope")
+        with pytest.raises(ValueError, match="metric_name"):
+            ev.evaluate([[1]], [[1]])
+        with pytest.raises(ValueError, match="rows"):
+            ht.RankingEvaluator().evaluate([[1]], [[1], [2]])
+        with pytest.raises(ValueError, match="empty"):
+            ht.RankingEvaluator().evaluate([], [])
+        with pytest.raises(ValueError, match="k"):
+            ht.RankingEvaluator(k=0).evaluate([[1]], [[1]])
+
+
+class TestMultilabelEvaluator:
+    # Spark's MultilabelMetrics doc example
+    P = [[0.0, 1.0], [0.0, 2.0], [], [2.0], [2.0, 0.0], [0.0, 1.0, 2.0], [1.0]]
+    T = [[0.0, 1.0], [0.0, 2.0], [0.0], [2.0], [2.0, 0.0], [0.0, 1.0], [1.0, 2.0]]
+
+    def _ev(self, name):
+        return ht.MultilabelClassificationEvaluator(name).evaluate(self.P, self.T)
+
+    def test_spark_doc_example_values(self):
+        # values from the Spark MultilabelMetrics documentation example
+        np.testing.assert_allclose(self._ev("subsetAccuracy"), 4 / 7, rtol=1e-9)
+        # per-row |pred|+|truth|−2·tp: 0,0,1,0,0,1,1 → Σ=3 over n·labels=21
+        np.testing.assert_allclose(self._ev("hammingLoss"), 3 / 21, rtol=1e-9)
+        np.testing.assert_allclose(
+            self._ev("accuracy"), (1 + 1 + 0 + 1 + 1 + 2 / 3 + 1 / 2) / 7, rtol=1e-9
+        )
+        np.testing.assert_allclose(
+            self._ev("precision"), (1 + 1 + 0 + 1 + 1 + 2 / 3 + 1) / 7, rtol=1e-9
+        )
+        np.testing.assert_allclose(
+            self._ev("recall"), (1 + 1 + 0 + 1 + 1 + 1 + 1 / 2) / 7, rtol=1e-9
+        )
+        # micro metrics are asserted exactly in test_micro_metrics_pool_counts
+
+    def test_micro_metrics_pool_counts(self):
+        tp = 2 + 2 + 0 + 1 + 2 + 2 + 1      # per-row intersections
+        p = sum(len(r) for r in self.P)
+        t = sum(len(r) for r in self.T)
+        np.testing.assert_allclose(self._ev("microPrecision"), tp / p, rtol=1e-9)
+        np.testing.assert_allclose(self._ev("microRecall"), tp / t, rtol=1e-9)
+        np.testing.assert_allclose(
+            self._ev("microF1Measure"), 2 * tp / (p + t), rtol=1e-9
+        )
+
+    def test_f1_and_larger_better(self):
+        f1 = self._ev("f1Measure")
+        assert 0 < f1 <= 1
+        assert not ht.MultilabelClassificationEvaluator("hammingLoss").is_larger_better
+        assert ht.MultilabelClassificationEvaluator("f1Measure").is_larger_better
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="metric_name"):
+            ht.MultilabelClassificationEvaluator("nope").evaluate([[1]], [[1]])
+        with pytest.raises(ValueError, match="empty"):
+            ht.MultilabelClassificationEvaluator().evaluate([], [])
+
+
+def test_atk_short_prediction_lists_use_k_denominators():
+    """Review regression: a row predicting fewer than k items must not
+    score a perfect AtK metric (Spark pads the denominator to k /
+    min(|truth|, k))."""
+    ndcg = ht.RankingEvaluator("ndcgAtK", k=10).evaluate([[1]], [[1, 2, 3]])
+    disc = 1.0 / np.log2(np.arange(10) + 2.0)
+    expect = disc[0] / disc[:3].sum()      # idcg over min(3, 10) slots
+    np.testing.assert_allclose(ndcg, expect, rtol=1e-9)
+    m = ht.RankingEvaluator("meanAveragePrecisionAtK", k=10).evaluate(
+        [[1]], [[1, 2, 3]]
+    )
+    np.testing.assert_allclose(m, 1.0 / 3.0, rtol=1e-9)
+
+
+def test_hamming_loss_num_labels_is_truth_only():
+    """Spark's numLabels counts distinct ground-truth labels only."""
+    got = ht.MultilabelClassificationEvaluator("hammingLoss").evaluate(
+        [[0.0, 1.0]], [[0.0]]
+    )
+    np.testing.assert_allclose(got, 1.0, rtol=1e-9)

@@ -464,3 +464,107 @@ def test_order_by_aggregate_without_group_by(session):
     assert len(out) == 1
     with pytest.raises(ValueError, match="ORDER BY"):
         session.sql("SELECT COUNT(*) AS n FROM events ORDER BY nope")
+
+
+# ---- round 4b: arithmetic expressions + star-plus (SQLTransformer shapes)
+
+
+def test_arithmetic_expressions(session, hospital_table):
+    out = session.sql(
+        "SELECT *, admission_count + emergency_visits AS load, "
+        "length_of_stay * 2 AS dlos FROM events LIMIT 5"
+    )
+    assert "load" in out.columns and "hospital_id" in out.columns
+    np.testing.assert_allclose(
+        out.column("load"),
+        (hospital_table.column("admission_count")
+         + hospital_table.column("emergency_visits"))[:5],
+    )
+    # precedence, parens, unary minus, division
+    out = session.sql(
+        "SELECT admission_count + emergency_visits * 2 AS x, "
+        "(admission_count + emergency_visits) * 2 AS y, "
+        "-seasonality_index AS ns, "
+        "length_of_stay / seasonality_index AS r FROM events LIMIT 3"
+    )
+    a = hospital_table.column("admission_count")[:3]
+    e = hospital_table.column("emergency_visits")[:3]
+    np.testing.assert_allclose(out.column("x"), a + 2 * e)
+    np.testing.assert_allclose(out.column("y"), (a + e) * 2)
+    np.testing.assert_allclose(
+        out.column("ns"), -hospital_table.column("seasonality_index")[:3]
+    )
+
+
+def test_arithmetic_over_aggregates(session):
+    grouped = session.sql(
+        "SELECT hospital_id, SUM(length_of_stay) / COUNT(*) AS mean_los, "
+        "MAX(length_of_stay) - MIN(length_of_stay) AS spread "
+        "FROM events GROUP BY hospital_id ORDER BY hospital_id"
+    )
+    ref = session.sql(
+        "SELECT hospital_id, AVG(length_of_stay) AS a FROM events "
+        "GROUP BY hospital_id ORDER BY hospital_id"
+    )
+    np.testing.assert_allclose(
+        grouped.column("mean_los"), ref.column("a"), rtol=1e-12
+    )
+    assert (grouped.column("spread") >= 0).all()
+    whole = session.sql(
+        "SELECT SUM(length_of_stay) / COUNT(*) AS m FROM events"
+    )
+    full = session.sql("SELECT AVG(length_of_stay) AS a FROM events")
+    np.testing.assert_allclose(whole.column("m"), full.column("a"), rtol=1e-12)
+
+
+def test_division_by_zero_is_null(session):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import execute
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    t = Table.from_dict({"a": np.array([1.0, 2.0]), "b": np.array([2.0, 0.0])})
+    out = execute("SELECT a / b AS q FROM t", lambda n: t)
+    assert out.column("q")[0] == 0.5 and np.isnan(out.column("q")[1])
+
+
+def test_expression_errors(session):
+    with pytest.raises(ValueError, match="GROUP BY"):
+        session.sql("SELECT length_of_stay + COUNT(*) AS z FROM events")
+    with pytest.raises(ValueError, match="mix"):
+        session.sql(
+            "SELECT *, COUNT(*) AS c FROM events GROUP BY hospital_id"
+        )
+    with pytest.raises(ValueError, match="expression"):
+        session.sql(
+            "SELECT length_of_stay + 1 AS z FROM events GROUP BY hospital_id"
+        )
+    # default rendered name for an un-aliased expression
+    out = session.sql("SELECT admission_count + 1 FROM events LIMIT 1")
+    assert list(out.columns) == ["(admission_count + 1)"]
+
+
+def test_sql_transformer_spark_canonical_shape(session, hospital_table):
+    """Spark's SQLTransformer doc example shape now runs verbatim."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    t = Table.from_dict(
+        {"id": np.array([0.0, 2.0]), "v1": np.array([1.0, 2.0]),
+         "v2": np.array([3.0, 4.0])}
+    )
+    st = ht.SQLTransformer(
+        statement="SELECT *, (v1 + v2) AS v3, (v1 * v2) AS v4 FROM __THIS__"
+    )
+    out = st.transform(t)
+    assert list(out.columns) == ["id", "v1", "v2", "v3", "v4"]
+    np.testing.assert_allclose(out.column("v3"), [4.0, 6.0])
+    np.testing.assert_allclose(out.column("v4"), [3.0, 8.0])
+
+
+def test_order_by_expression_alias_and_star_collision(session):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import execute
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    t = Table.from_dict({"a": np.array([3.0, 1.0, 2.0])})
+    out = execute("SELECT a + 1 AS x FROM t ORDER BY x DESC", lambda n: t)
+    np.testing.assert_allclose(out.column("x"), [4.0, 3.0, 2.0])
+    with pytest.raises(ValueError, match="duplicate output column"):
+        execute("SELECT *, a + 1 AS a FROM t", lambda n: t)
