@@ -850,7 +850,9 @@ def execute(query: str, resolve_table) -> Table:
                 )
         src_t, src_getcol = t, getcol
         agg_canonical = {
-            f"{it.agg}({it.col or '*'})": it.alias for it in items
+            f"{it.agg}({it.col or '*'})": it.alias
+            for it in items
+            if it.agg is not None
         }
         def scalar_atom(name: str):
             m = _AGG_REF.match(name)
@@ -919,7 +921,12 @@ def execute(query: str, resolve_table) -> Table:
         if col not in t.columns and items is not None:
             for it in items:
                 if it.alias == col and it.expr is not None:
-                    vals = np.asarray(_eval_expr(getcol, it.expr))
+                    v = _eval_expr(getcol, it.expr)
+                    # a constant expression sorts as a full-length column
+                    # (a 0-d argsort would silently keep one row)
+                    vals = (
+                        np.full(len(t), v) if np.ndim(v) == 0 else np.asarray(v)
+                    )
                     break
             else:
                 col = {

@@ -9,6 +9,7 @@ from .kmeans import KMeans, KMeansModel
 from .naive_bayes import NaiveBayes, NaiveBayesModel
 from .glm import GeneralizedLinearRegression, GeneralizedLinearRegressionModel
 from .isotonic import IsotonicRegression, IsotonicRegressionModel
+from .als import ALS, ALSModel
 from .linear_svc import LinearSVC, LinearSVCModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
@@ -27,6 +28,8 @@ from .tree import (
 )
 
 __all__ = [
+    "ALS",
+    "ALSModel",
     "Estimator",
     "Model",
     "PredictionResult",

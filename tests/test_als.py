@@ -1,0 +1,187 @@
+"""ALS collaborative filtering (pyspark.ml.recommendation parity).
+
+Oracle: an independent per-row NumPy ALS (explicit solves with
+np.linalg.solve in a Python loop) — a different code path from the
+batched padded einsum/Cholesky device implementation under test."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def _synth(rng, n_u=60, n_i=40, f=3, frac=0.35, noise=0.05):
+    U = rng.normal(0, 1, size=(n_u, f))
+    V = rng.normal(0, 1, size=(n_i, f))
+    mask = rng.uniform(size=(n_u, n_i)) < frac
+    uu, ii = np.nonzero(mask)
+    rr = ((U @ V.T)[uu, ii] + noise * rng.normal(size=len(uu))).astype(np.float32)
+    return U, V, mask, uu, ii, rr
+
+
+def _numpy_als(uu, ii, rr, n_u, n_i, rank, iters, reg, uf0, vf0):
+    """Reference ALS-WR with per-row loops (λ·n_u scaling)."""
+    uf, vf = uf0.copy(), vf0.copy()
+    for _ in range(iters):
+        for u in range(n_u):
+            sel = uu == u
+            if not sel.any():
+                uf[u] = 0
+                continue
+            y = vf[ii[sel]]
+            a = y.T @ y + reg * sel.sum() * np.eye(rank)
+            uf[u] = np.linalg.solve(a, y.T @ rr[sel])
+        for i in range(n_i):
+            sel = ii == i
+            if not sel.any():
+                vf[i] = 0
+                continue
+            y = uf[uu[sel]]
+            a = y.T @ y + reg * sel.sum() * np.eye(rank)
+            vf[i] = np.linalg.solve(a, y.T @ rr[sel])
+    return uf, vf
+
+
+class TestALSExplicit:
+    def test_recovers_low_rank_signal(self, rng):
+        U, V, mask, uu, ii, rr = _synth(rng)
+        m = ht.ALS(rank=3, max_iter=12, reg_param=0.05, seed=0).fit((uu, ii, rr))
+        rmse = np.sqrt(np.mean((m.predict(uu, ii) - rr) ** 2))
+        assert rmse < 0.15
+        # held-out pairs generalize (low-rank structure was learned, not
+        # memorized)
+        hu, hi = np.nonzero(~mask)
+        hr = (U @ V.T)[hu, hi]
+        ho = np.sqrt(np.mean((m.predict(hu, hi) - hr) ** 2))
+        assert ho < 0.5 * hr.std()
+
+    def test_matches_numpy_reference(self, rng):
+        """Same init, same iteration count → same factors (the batched
+        padded solves are algebraically the per-row normal equations)."""
+        _, _, _, uu, ii, rr = _synth(rng, n_u=25, n_i=18, f=2)
+        n_u, n_i, rank = 25, 18, 2
+        seed_rng = np.random.default_rng(7)
+        scale = 1.0 / np.sqrt(rank)
+        uf0 = seed_rng.normal(0, scale, size=(n_u, rank)).astype(np.float32)
+        vf0 = seed_rng.normal(0, scale, size=(n_i, rank)).astype(np.float32)
+
+        ref_uf, ref_vf = _numpy_als(
+            uu, ii, rr.astype(np.float64), n_u, n_i, rank, 3, 0.1,
+            uf0.astype(np.float64), vf0.astype(np.float64),
+        )
+
+        # drive the framework's half-step solvers directly from the same
+        # init (the estimator draws its own init internally)
+        import jax.numpy as jnp
+
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.als import (
+            _group_ratings, _solve_explicit,
+        )
+
+        u_idx, u_val, u_msk, u_cnt = _group_ratings(uu, ii, rr, n_u)
+        i_idx, i_val, i_msk, i_cnt = _group_ratings(ii, uu, rr, n_i)
+        uf, vf = jnp.asarray(uf0), jnp.asarray(vf0)
+        for _ in range(3):
+            uf = _solve_explicit(
+                vf, jnp.asarray(u_idx), jnp.asarray(u_val), jnp.asarray(u_msk),
+                jnp.asarray(u_cnt), jnp.float32(0.1), rank,
+            )
+            vf = _solve_explicit(
+                uf, jnp.asarray(i_idx), jnp.asarray(i_val), jnp.asarray(i_msk),
+                jnp.asarray(i_cnt), jnp.float32(0.1), rank,
+            )
+        np.testing.assert_allclose(np.asarray(uf), ref_uf, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(vf), ref_vf, rtol=2e-3, atol=2e-3)
+
+    def test_regularization_shrinks_factors(self, rng):
+        _, _, _, uu, ii, rr = _synth(rng)
+        lo = ht.ALS(rank=3, max_iter=5, reg_param=0.01, seed=0).fit((uu, ii, rr))
+        hi = ht.ALS(rank=3, max_iter=5, reg_param=10.0, seed=0).fit((uu, ii, rr))
+        assert (
+            np.linalg.norm(hi.user_factors) < np.linalg.norm(lo.user_factors)
+        )
+
+    def test_input_forms(self, rng):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+        _, _, _, uu, ii, rr = _synth(rng, n_u=12, n_i=9)
+        m1 = ht.ALS(rank=2, max_iter=3, seed=0).fit((uu, ii, rr))
+        m2 = ht.ALS(rank=2, max_iter=3, seed=0).fit(
+            np.stack([uu, ii, rr], axis=1)
+        )
+        tab = Table.from_dict(
+            {"user": uu.astype(np.int64), "item": ii.astype(np.int64),
+             "rating": rr}
+        )
+        m3 = ht.ALS(rank=2, max_iter=3, seed=0).fit(tab)
+        np.testing.assert_allclose(m1.user_factors, m2.user_factors, rtol=1e-5)
+        np.testing.assert_allclose(m1.user_factors, m3.user_factors, rtol=1e-5)
+
+
+class TestALSImplicit:
+    def test_preferred_items_rank_higher(self, rng):
+        U, V, _, _, _, _ = _synth(rng)
+        pref = U @ V.T > 1.0
+        uu, ii = np.nonzero(pref)
+        m = ht.ALS(
+            rank=3, max_iter=10, implicit_prefs=True, alpha=10.0, seed=0
+        ).fit((uu, ii, np.ones(len(uu), np.float32)))
+        s = m.user_factors @ m.item_factors.T
+        assert s[pref].mean() > s[~pref].mean() + 0.2
+
+    def test_negative_ratings_rejected(self, rng):
+        with pytest.raises(ValueError, match="non-negative"):
+            ht.ALS(implicit_prefs=True).fit(
+                (np.array([0]), np.array([0]), np.array([-1.0], np.float32))
+            )
+
+
+class TestALSModel:
+    def test_recommend_and_cold_start(self, rng):
+        _, _, _, uu, ii, rr = _synth(rng, n_u=20, n_i=15)
+        m = ht.ALS(rank=3, max_iter=5, seed=0).fit((uu, ii, rr))
+        ids, scores = m.recommend_for_all_users(4)
+        assert ids.shape == (20, 4)
+        assert np.all(np.diff(scores, axis=1) <= 1e-5)   # descending
+        # top-1 equals the argmax of the full score matrix
+        full = m.user_factors @ m.item_factors.T
+        np.testing.assert_array_equal(ids[:, 0], full.argmax(axis=1))
+        iids, _ = m.recommend_for_all_items(3)
+        assert iids.shape == (15, 3)
+        # cold start
+        p = m.predict([0, 99], [0, 0])
+        assert np.isfinite(p[0]) and np.isnan(p[1])
+        md = ht.ALS(rank=3, max_iter=2, cold_start_strategy="drop", seed=0).fit(
+            (uu, ii, rr)
+        )
+        assert len(md.predict([0, 99], [0, 0])) == 1
+
+    def test_round_trip(self, rng, tmp_path):
+        _, _, _, uu, ii, rr = _synth(rng, n_u=10, n_i=8)
+        m = ht.ALS(rank=2, max_iter=3, seed=0).fit((uu, ii, rr))
+        m.write().overwrite().save(str(tmp_path / "als"))
+        back = ht.load_model(str(tmp_path / "als"))
+        np.testing.assert_allclose(back.user_factors, m.user_factors)
+        np.testing.assert_allclose(
+            back.predict(uu[:5], ii[:5]), m.predict(uu[:5], ii[:5])
+        )
+
+    def test_validation(self, rng):
+        with pytest.raises(NotImplementedError, match="nonnegative"):
+            ht.ALS(nonnegative=True).fit(
+                (np.array([0]), np.array([0]), np.array([1.0], np.float32))
+            )
+        with pytest.raises(ValueError, match="cold_start"):
+            ht.ALS(cold_start_strategy="keep").fit(
+                (np.array([0]), np.array([0]), np.array([1.0], np.float32))
+            )
+        with pytest.raises(ValueError, match="empty"):
+            ht.ALS().fit((np.array([], np.int64),) * 2 + (np.array([], np.float32),))
+        with pytest.raises(ValueError, match="non-negative integers"):
+            ht.ALS().fit(
+                (np.array([-1]), np.array([0]), np.array([1.0], np.float32))
+            )
+        with pytest.raises(ValueError, match="columns"):
+            from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+            ht.ALS().fit(Table.from_dict({"x": np.array([1.0])}))

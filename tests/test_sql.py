@@ -568,3 +568,13 @@ def test_order_by_expression_alias_and_star_collision(session):
     np.testing.assert_allclose(out.column("x"), [4.0, 3.0, 2.0])
     with pytest.raises(ValueError, match="duplicate output column"):
         execute("SELECT *, a + 1 AS a FROM t", lambda n: t)
+
+
+def test_order_by_constant_expression_alias_keeps_all_rows(session):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import execute
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    t = Table.from_dict({"a": np.array([3.0, 1.0, 2.0])})
+    out = execute("SELECT a, 1 + 1 AS two FROM t ORDER BY two", lambda n: t)
+    assert len(out) == 3
+    np.testing.assert_allclose(out.column("two"), [2.0, 2.0, 2.0])
