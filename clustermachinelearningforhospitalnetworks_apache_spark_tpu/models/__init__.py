@@ -10,6 +10,9 @@ from .naive_bayes import NaiveBayes, NaiveBayesModel
 from .glm import GeneralizedLinearRegression, GeneralizedLinearRegressionModel
 from .isotonic import IsotonicRegression, IsotonicRegressionModel
 from .als import ALS, ALSModel
+from .mlp import MultilayerPerceptronClassifier, MultilayerPerceptronModel
+from .fm import FMClassifier, FMModel, FMRegressor
+from .aft import AFTSurvivalRegression, AFTSurvivalRegressionModel
 from .linear_svc import LinearSVC, LinearSVCModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
@@ -30,6 +33,13 @@ from .tree import (
 __all__ = [
     "ALS",
     "ALSModel",
+    "MultilayerPerceptronClassifier",
+    "MultilayerPerceptronModel",
+    "FMClassifier",
+    "FMModel",
+    "FMRegressor",
+    "AFTSurvivalRegression",
+    "AFTSurvivalRegressionModel",
     "Estimator",
     "Model",
     "PredictionResult",

@@ -1,0 +1,50 @@
+"""Shared jitted optimizer harnesses for the L-BFGS estimator families
+(MultilayerPerceptronClassifier, AFTSurvivalRegression).
+
+One copy of the ``optax.lbfgs`` loop so convergence semantics can't
+silently diverge between families: runs as a ``lax.while_loop`` with the
+Spark-style stop ``|loss_t − loss_{t−1}| ≤ tol`` (or ``max_iter``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lbfgs_minimize(loss_fn, params, max_iter: int, tol):
+    """Minimize ``loss_fn`` over the ``params`` pytree with optax L-BFGS.
+
+    → (params, final_loss, n_iter).  Traceable (call under jit); the stop
+    condition is the relative loss plateau |Δloss| ≤ tol·max(|loss|, 1).
+    """
+    import optax
+
+    opt = optax.lbfgs()
+    state = opt.init(params)
+    value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+    def cond(carry):
+        _, _, prev, loss, it = carry
+        delta = jnp.abs(prev - loss)
+        return (it < max_iter) & (
+            delta > tol * jnp.maximum(jnp.abs(loss), 1.0)
+        )
+
+    def body(carry):
+        p, st, _, prev, it = carry
+        loss, grad = value_and_grad(p, state=st)
+        updates, st = opt.update(
+            grad, st, p, value=loss, grad=grad, value_fn=loss_fn
+        )
+        p = optax.apply_updates(p, updates)
+        new_loss = loss_fn(p)
+        return (p, st, loss, new_loss, it + 1)
+
+    p, _, _, loss, it = lax.while_loop(
+        cond,
+        body,
+        (params, state, jnp.float32(jnp.inf), loss_fn(params), jnp.int32(0)),
+    )
+    return p, loss, it

@@ -1,0 +1,180 @@
+"""Factorization machines — FMRegressor / FMClassifier.
+
+Parity with ``pyspark.ml.regression.FMRegressor`` and
+``...classification.FMClassifier`` (Rendle's 2nd-order FM):
+
+    ŷ(x) = w₀ + wᵀx + ½ Σ_f [(x·V)_f² − (x²·V²)_f]
+
+The pairwise term is exactly two MXU matmuls (``X@V`` and ``X²@V²``) —
+the O(n·d·k) linear-time identity Rendle derived is literally the
+TPU-friendly form, no pairwise d² blowup.  Training is full-batch Adam
+(one jitted ``lax.scan``; Spark trains miniBatchFraction-SGD/AdamW —
+full-batch on an accelerator converges in fewer, cheaper passes), with
+squared loss (regressor) or logistic loss on ±1 labels (classifier),
+L2 ``reg_param`` on w and V (intercept unpenalized, the house rule).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import register_model
+from .base import Estimator, Model, as_device_dataset, check_features
+
+
+def _fm_raw(w0, w, v, x):
+    """(n,) FM response: bias + linear + ½((xV)² − x²V²)·1."""
+    xv = x @ v                                   # (n, k)
+    x2v2 = (x * x) @ (v * v)                     # (n, k)
+    return w0 + x @ w + 0.5 * jnp.sum(xv * xv - x2v2, axis=1)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "loss"))
+def _fit_fm(w0, w, v, x, y, wt, reg, step_size, max_iter: int, loss: str):
+    import optax
+
+    wsum = jnp.maximum(jnp.sum(wt), 1.0)
+
+    def loss_fn(params):
+        w0_, w_, v_ = params
+        raw = _fm_raw(w0_, w_, v_, x)
+        if loss == "squared":
+            per_row = (raw - y) ** 2
+        else:  # logistic on ±1 labels — softplus(−m) is the
+            # overflow-stable spelling of log(1 + e^{−m})
+            ypm = 2.0 * y - 1.0
+            per_row = jax.nn.softplus(-ypm * raw)
+        data = jnp.sum(per_row * wt) / wsum
+        return data + reg * (jnp.sum(w_ * w_) + jnp.sum(v_ * v_))
+
+    opt = optax.adam(step_size)
+    state = opt.init((w0, w, v))
+
+    def step(carry, _):
+        params, st = carry
+        l, grads = jax.value_and_grad(loss_fn)(params)
+        updates, st = opt.update(grads, st)
+        return (optax.apply_updates(params, updates), st), l
+
+    (params, _), losses = jax.lax.scan(
+        step, ((w0, w, v), state), None, length=max_iter
+    )
+    return params, losses
+
+
+@register_model("FMModel")
+@dataclass
+class FMModel(Model):
+    intercept: float
+    linear: np.ndarray            # (d,)
+    factors: np.ndarray           # (d, k)
+    task: str = "regression"      # "regression" | "classification"
+
+    @property
+    def factor_size(self) -> int:
+        return self.factors.shape[1]
+
+    def predict_raw(self, x: jax.Array) -> jax.Array:
+        check_features(x, np.asarray(self.linear).shape[0], "FMModel")
+        return _fm_raw(
+            jnp.float32(self.intercept),
+            jnp.asarray(self.linear, jnp.float32),
+            jnp.asarray(self.factors, jnp.float32),
+            jnp.asarray(x, jnp.float32),
+        )
+
+    def predict_proba(self, x: jax.Array) -> jax.Array:
+        if self.task != "classification":
+            raise ValueError("predict_proba is classification-only")
+        return jax.nn.sigmoid(self.predict_raw(x))
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        raw = self.predict_raw(x)
+        if self.task == "regression":
+            return raw
+        return (raw > 0).astype(jnp.float32)
+
+    def _artifacts(self):
+        return (
+            "FMModel",
+            {"intercept": float(self.intercept), "task": self.task},
+            {"linear": np.asarray(self.linear), "factors": np.asarray(self.factors)},
+        )
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        return cls(
+            intercept=float(params["intercept"]),
+            linear=arrays["linear"],
+            factors=arrays["factors"],
+            task=params.get("task", "regression"),
+        )
+
+
+@dataclass(frozen=True)
+class _FMParams:
+    factor_size: int = 8          # Spark default
+    max_iter: int = 100           # Spark default
+    reg_param: float = 0.0
+    step_size: float = 0.05       # full-batch Adam LR (Spark SGD: 1.0)
+    init_std: float = 0.01        # Spark default
+    seed: int = 0
+    label_col: str = "length_of_stay"
+    features_col: str = "features"
+    weight_col: str | None = None
+
+    def _fit(self, data, label_col, mesh, loss: str) -> FMModel:
+        ds = as_device_dataset(
+            data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
+        )
+        if ds.y is None:
+            raise ValueError("FM fit needs labels")
+        if self.factor_size < 1:
+            raise ValueError(f"factor_size must be >= 1, got {self.factor_size}")
+        if loss == "logistic":
+            yv = np.asarray(jax.device_get(ds.y))
+            wv = np.asarray(jax.device_get(ds.w))
+            uniq = np.unique(yv[wv > 0])
+            if not np.all(np.isin(uniq, (0.0, 1.0))):
+                raise ValueError(
+                    f"FMClassifier is binary (labels 0/1); got {uniq[:5]}"
+                )
+        rng = np.random.default_rng(self.seed)
+        d = ds.n_features
+        w0 = jnp.float32(0.0)
+        w = jnp.zeros((d,), jnp.float32)
+        v = jnp.asarray(
+            rng.normal(0, self.init_std, size=(d, self.factor_size)).astype(
+                np.float32
+            )
+        )
+        (w0, w, v), _ = _fit_fm(
+            w0, w, v, ds.x.astype(jnp.float32), ds.y.astype(jnp.float32),
+            ds.w.astype(jnp.float32), jnp.float32(self.reg_param),
+            jnp.float32(self.step_size), self.max_iter, loss,
+        )
+        return FMModel(
+            intercept=float(w0),
+            linear=np.asarray(jax.device_get(w)),
+            factors=np.asarray(jax.device_get(v)),
+            task="regression" if loss == "squared" else "classification",
+        )
+
+
+@dataclass(frozen=True)
+class FMRegressor(Estimator, _FMParams):
+    def fit(self, data, label_col: str | None = None, mesh=None) -> FMModel:
+        return self._fit(data, label_col, mesh, "squared")
+
+
+@dataclass(frozen=True)
+class FMClassifier(Estimator, _FMParams):
+    label_col: str = "LOS_binary"
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> FMModel:
+        return self._fit(data, label_col, mesh, "logistic")

@@ -1,0 +1,207 @@
+"""MultilayerPerceptronClassifier, FMRegressor/FMClassifier,
+AFTSurvivalRegression — the round-4 pyspark.ml estimator-family
+completions (classification.MLP/FM, regression.FM/AFT).
+
+Oracles: problems with known structure a linear model provably cannot
+fit (XOR for the MLP, a pure interaction term for FM) and a Weibull AFT
+draw with known coefficients under ~40% right-censoring."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+class TestMLP:
+    def test_xor_beats_linear(self, rng, mesh8):
+        n = 2000
+        x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+        y = ((x[:, 0] * x[:, 1]) > 0).astype(np.float32)
+        m = ht.MultilayerPerceptronClassifier(
+            layers=(2, 16, 2), max_iter=200, seed=0
+        ).fit(ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        acc = np.mean(np.asarray(m.predict_numpy(x)) == y)
+        assert acc > 0.95
+        lin = ht.LogisticRegression(max_iter=50).fit(
+            ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+        )
+        assert np.mean(np.asarray(lin.predict_numpy(x)) == y) < 0.7
+        proba = np.asarray(m.predict_proba(x[:16]))
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_multiclass(self, rng, mesh8):
+        n = 3000
+        x = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (np.arctan2(x[:, 1], x[:, 0]) // (2 * np.pi / 3) % 3 + 1) % 3
+        y = y.astype(np.float32)
+        m = ht.MultilayerPerceptronClassifier(
+            layers=(2, 24, 3), max_iter=300, seed=1
+        ).fit(ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        assert m.num_classes == 3
+        assert np.mean(np.asarray(m.predict_numpy(x)) == y) > 0.9
+
+    def test_round_trip_and_validation(self, rng, mesh8, tmp_path):
+        x = rng.normal(size=(256, 3)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        m = ht.MultilayerPerceptronClassifier(
+            layers=(3, 8, 2), max_iter=50, seed=0
+        ).fit(ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        m.write().overwrite().save(str(tmp_path / "mlp"))
+        back = ht.load_model(str(tmp_path / "mlp"))
+        np.testing.assert_allclose(
+            back.predict_numpy(x), m.predict_numpy(x)
+        )
+        with pytest.raises(ValueError, match="layers"):
+            ht.MultilayerPerceptronClassifier(layers=(3,)).fit(
+                ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="features"):
+            ht.MultilayerPerceptronClassifier(layers=(5, 4, 2)).fit(
+                ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="labels must be integers"):
+            ht.MultilayerPerceptronClassifier(layers=(3, 4, 2)).fit(
+                ht.device_dataset(x, y * 3, mesh=mesh8), mesh=mesh8
+            )
+        # negative and fractional labels raise too (they would silently
+        # clamp/truncate under jit)
+        with pytest.raises(ValueError, match="labels must be integers"):
+            ht.MultilayerPerceptronClassifier(layers=(3, 4, 2)).fit(
+                ht.device_dataset(x, y * 2 - 1, mesh=mesh8), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="labels must be integers"):
+            ht.MultilayerPerceptronClassifier(layers=(3, 4, 2)).fit(
+                ht.device_dataset(x, y + 0.5, mesh=mesh8), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="solver"):
+            ht.MultilayerPerceptronClassifier(layers=(3, 2), solver="gd").fit(
+                ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+            )
+
+
+class TestFM:
+    def test_interaction_signal_beats_linear(self, rng, mesh8):
+        n, d = 4000, 4
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (
+            2.0 * x[:, 0] * x[:, 1] + 0.5 * x[:, 2]
+            + 0.05 * rng.normal(size=n)
+        ).astype(np.float32)
+        fm = ht.FMRegressor(factor_size=4, max_iter=800, step_size=0.1, seed=0).fit(
+            ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+        )
+        pred = np.asarray(fm.predict_numpy(x))
+        r2 = 1 - np.sum((y - pred) ** 2) / np.sum((y - y.mean()) ** 2)
+        assert r2 > 0.95
+        lin = ht.LinearRegression().fit(ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8)
+        lr2 = 1 - np.sum(
+            (y - np.asarray(lin.predict_numpy(x))) ** 2
+        ) / np.sum((y - y.mean()) ** 2)
+        assert lr2 < 0.5    # the linear model structurally cannot fit x0*x1
+
+    def test_classifier(self, rng, mesh8):
+        n, d = 4000, 4
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        yb = ((x[:, 0] * x[:, 1] + 0.3 * x[:, 2]) > 0).astype(np.float32)
+        m = ht.FMClassifier(factor_size=4, max_iter=600, step_size=0.1, seed=0).fit(
+            ht.device_dataset(x, yb, mesh=mesh8), mesh=mesh8
+        )
+        assert np.mean(np.asarray(m.predict_numpy(x)) == yb) > 0.9
+        p = np.asarray(m.predict_proba(x[:32]))
+        assert np.all((p >= 0) & (p <= 1))
+
+    def test_round_trip_and_validation(self, rng, mesh8, tmp_path):
+        x = rng.normal(size=(256, 3)).astype(np.float32)
+        y = (x[:, 0] * x[:, 1]).astype(np.float32)
+        m = ht.FMRegressor(factor_size=2, max_iter=50, seed=0).fit(
+            ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+        )
+        m.write().overwrite().save(str(tmp_path / "fm"))
+        back = ht.load_model(str(tmp_path / "fm"))
+        np.testing.assert_allclose(
+            back.predict_numpy(x), m.predict_numpy(x), rtol=1e-6
+        )
+        assert back.factor_size == 2
+        with pytest.raises(ValueError, match="binary"):
+            ht.FMClassifier().fit(
+                ht.device_dataset(x, y * 10, mesh=mesh8), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="factor_size"):
+            ht.FMRegressor(factor_size=0).fit(
+                ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="classification-only"):
+            m.predict_proba(x)
+
+
+class TestAFT:
+    def _survival_data(self, rng, n=6000):
+        x = rng.normal(0, 0.5, size=(n, 2)).astype(np.float32)
+        eta = x @ [0.8, -0.5] + 1.0
+        sigma = 0.5
+        eps = np.log(rng.exponential(size=n))    # Gumbel-min
+        t = np.exp(eta + sigma * eps).astype(np.float32)
+        c_time = rng.exponential(np.e ** 1.5, size=n).astype(np.float32)
+        observed = (t <= c_time).astype(np.float32)
+        return x, np.minimum(t, c_time), observed, sigma
+
+    def test_recovers_weibull_parameters_under_censoring(self, rng, mesh8):
+        x, y, observed, sigma = self._survival_data(rng)
+        assert 0.3 < 1 - observed.mean() < 0.55   # real censoring happening
+        m = ht.AFTSurvivalRegression(max_iter=100).fit(
+            ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8, censor=observed
+        )
+        np.testing.assert_allclose(m.coefficients, [0.8, -0.5], atol=0.07)
+        np.testing.assert_allclose(m.intercept, 1.0, atol=0.07)
+        np.testing.assert_allclose(m.scale, sigma, atol=0.06)
+        # ignoring censoring (all observed) must bias the fit noticeably
+        biased = ht.AFTSurvivalRegression(max_iter=100).fit(
+            ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8,
+            censor=np.ones_like(observed),
+        )
+        assert abs(biased.intercept - 1.0) > abs(m.intercept - 1.0)
+
+    def test_quantiles_and_prediction(self, rng, mesh8):
+        x, y, observed, _ = self._survival_data(rng, n=2000)
+        m = ht.AFTSurvivalRegression().fit(
+            ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8, censor=observed
+        )
+        q = np.asarray(m.predict_quantiles(x[:8]))
+        assert q.shape == (8, 9)
+        assert np.all(np.diff(q, axis=1) > 0)     # monotone in p
+        # median quantile below mean for this sigma (right-skewed Weibull)
+        pred = np.asarray(m.predict_numpy(x[:8]))
+        assert np.all(q[:, 4] < pred)
+
+    def test_table_censor_col_and_validation(self, rng, mesh8, tmp_path):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+        x, y, observed, _ = self._survival_data(rng, n=1024)
+        tab = Table.from_dict(
+            {
+                "f0": x[:, 0], "f1": x[:, 1],
+                "time": y.astype(np.float32), "censor": observed,
+            }
+        )
+        asm = ht.VectorAssembler(["f0", "f1"]).transform(tab)
+        m = ht.AFTSurvivalRegression(label_col="time").fit(asm, mesh=mesh8)
+        assert np.isfinite(m.scale)
+        m.write().overwrite().save(str(tmp_path / "aft"))
+        back = ht.load_model(str(tmp_path / "aft"))
+        np.testing.assert_allclose(
+            back.predict_numpy(x[:16]), m.predict_numpy(x[:16]), rtol=1e-6
+        )
+        with pytest.raises(ValueError, match="censor"):
+            ht.AFTSurvivalRegression().fit(
+                ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8,
+                censor=observed * 3,
+            )
+        with pytest.raises(ValueError, match="positive"):
+            ht.AFTSurvivalRegression().fit(
+                ht.device_dataset(x, y - 100, mesh=mesh8), mesh=mesh8,
+                censor=observed,
+            )
+        with pytest.raises(ValueError, match="table"):
+            ht.AFTSurvivalRegression().fit(
+                ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
+            )
