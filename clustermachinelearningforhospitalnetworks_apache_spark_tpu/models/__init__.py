@@ -13,6 +13,8 @@ from .als import ALS, ALSModel
 from .mlp import MultilayerPerceptronClassifier, MultilayerPerceptronModel
 from .fm import FMClassifier, FMModel, FMRegressor
 from .aft import AFTSurvivalRegression, AFTSurvivalRegressionModel
+from .lda import LDA, LDAModel
+from .pic import PowerIterationClustering
 from .linear_svc import LinearSVC, LinearSVCModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
@@ -40,6 +42,9 @@ __all__ = [
     "FMRegressor",
     "AFTSurvivalRegression",
     "AFTSurvivalRegressionModel",
+    "LDA",
+    "LDAModel",
+    "PowerIterationClustering",
     "Estimator",
     "Model",
     "PredictionResult",

@@ -39,7 +39,9 @@ def lbfgs_minimize(loss_fn, params, max_iter: int, tol):
             grad, st, p, value=loss, grad=grad, value_fn=loss_fn
         )
         p = optax.apply_updates(p, updates)
-        new_loss = loss_fn(p)
+        # the zoom linesearch already evaluated the accepted point —
+        # reuse its cached value instead of paying an extra forward pass
+        new_loss = optax.tree_utils.tree_get(st, "value")
         return (p, st, loss, new_loss, it + 1)
 
     p, _, _, loss, it = lax.while_loop(

@@ -61,14 +61,14 @@ class AFTSurvivalRegressionModel(Model):
     quantile_probabilities: tuple = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99)
 
     def predict(self, x: jax.Array) -> jax.Array:
-        """Expected survival time E[T | x] = exp(xβ + b)·Γ(1 + σ) — the
-        Weibull AFT mean (Spark's ``prediction`` column)."""
+        """exp(xβ + b) — Spark's ``prediction`` column (the Weibull scale
+        parameter / characteristic life, NOT the distribution mean, which
+        would carry an extra Γ(1+σ) factor)."""
         check_features(x, np.asarray(self.coefficients).shape[0], type(self).__name__)
         eta = jnp.asarray(x, jnp.float32) @ jnp.asarray(
             self.coefficients, jnp.float32
         ) + jnp.float32(self.intercept)
-        gamma = jnp.exp(jax.lax.lgamma(jnp.float32(1.0 + self.scale)))
-        return jnp.exp(eta) * gamma
+        return jnp.exp(eta)
 
     def predict_quantiles(self, x: jax.Array) -> jax.Array:
         """(n, len(quantile_probabilities)) survival-time quantiles:

@@ -1,0 +1,119 @@
+"""PowerIterationClustering (``pyspark.ml.clustering.PowerIterationClustering``).
+
+Lin & Cohen's PIC: truncated power iteration of the row-normalized
+affinity matrix W = D⁻¹A converges (before the trivial all-ones
+eigenvector dominates) to a 1-D embedding in which clusters separate;
+k-means on that embedding assigns the clusters.
+
+Spark runs the iteration as pregel-style message passing over an edge
+RDD; here the (symmetrized) affinity is a dense device matrix and each
+iteration is one matvec on the MXU inside a ``lax.fori_loop`` — the
+whole power iteration is a single jitted computation.  Dense (n, n) is
+the honest trade for this estimator's scale (Spark's own docs position
+PIC for up to ~10⁵ nodes; a dense f32 10⁵² matrix is HBM-feasible on a
+v5e only to ~3·10⁴ — raise beyond that rather than silently thrash).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .base import Estimator
+
+#: dense-affinity node budget (f32 n² must fit comfortably in HBM)
+_MAX_NODES = 40_000
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _power_iterate(w_norm, v0, max_iter: int):
+    def body(_, v):
+        v = w_norm @ v
+        # L1 normalization (Lin & Cohen) keeps the iterate from vanishing
+        return v / jnp.maximum(jnp.sum(jnp.abs(v)), 1e-30)
+
+    return lax.fori_loop(0, max_iter, body, v0)
+
+
+@dataclass(frozen=True)
+class PowerIterationClustering(Estimator):
+    """Spark defaults: k 2, maxIter 20, initMode "random" (or "degree").
+    ``assign_clusters`` consumes (src, dst, weight) affinity triplets and
+    returns per-node cluster assignments — Spark's API shape (PIC is a
+    transformer-less estimator there too)."""
+
+    k: int = 2
+    max_iter: int = 20
+    init_mode: str = "random"
+    seed: int = 0
+
+    def assign_clusters(self, src, dst, weight=None, mesh=None) -> np.ndarray:
+        """(n,) cluster id per node (node ids = 0..max id)."""
+        if self.k < 2:
+            raise ValueError(f"k must be >= 2, got {self.k}")
+        if self.init_mode not in ("random", "degree"):
+            raise ValueError(
+                f"init_mode must be random|degree, got {self.init_mode!r}"
+            )
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        if src.shape != dst.shape or src.ndim != 1:
+            raise ValueError("src/dst must be equal-length 1-D id arrays")
+        if len(src) == 0:
+            raise ValueError("PowerIterationClustering on an empty affinity")
+        if src.min() < 0 or dst.min() < 0:
+            raise ValueError("node ids must be non-negative")
+        w = (
+            np.ones(len(src), np.float32)
+            if weight is None
+            else np.asarray(weight, np.float32)
+        )
+        if (w < 0).any():
+            raise ValueError("affinity weights must be non-negative")
+        n = int(max(src.max(), dst.max())) + 1
+        if n > _MAX_NODES:
+            raise ValueError(
+                f"{n} nodes exceeds the dense-affinity budget "
+                f"({_MAX_NODES}); PIC here materializes (n, n) in HBM"
+            )
+        a = np.zeros((n, n), np.float32)
+        # symmetrize (Spark requires symmetric affinities; accept either
+        # orientation and fold duplicates additively)
+        np.add.at(a, (src, dst), w)
+        np.add.at(a, (dst, src), w)
+        deg = a.sum(axis=1)
+        if (deg == 0).any():
+            isolated = int(np.flatnonzero(deg == 0)[0])
+            raise ValueError(
+                f"node {isolated} has no edges; every node needs at least "
+                "one affinity"
+            )
+        w_norm = jnp.asarray(a / deg[:, None])
+
+        rng = np.random.default_rng(self.seed)
+        if self.init_mode == "degree":
+            v0 = deg / deg.sum()
+        else:
+            v0 = rng.uniform(0, 1, size=n)
+            v0 = v0 / np.abs(v0).sum()
+        v = np.asarray(
+            jax.device_get(
+                _power_iterate(w_norm, jnp.asarray(v0, jnp.float32), self.max_iter)
+            ),
+            np.float64,
+        )
+
+        # k-means on the 1-D embedding (Lin & Cohen step 3)
+        from .kmeans import KMeans
+
+        km = KMeans(k=self.k, seed=self.seed, max_iter=40).fit(
+            v[:, None].astype(np.float32), mesh=mesh
+        )
+        return np.asarray(km.predict_numpy(v[:, None].astype(np.float32))).astype(
+            np.int64
+        )

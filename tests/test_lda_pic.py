@@ -1,0 +1,129 @@
+"""LDA (online variational Bayes) + PowerIterationClustering — the last
+two pyspark.ml.clustering members.
+
+Oracles: documents generated from known disjoint-support topics (the
+learned topic-word distributions must re-concentrate on the true
+supports) and a two-block affinity graph PIC must separate."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def _topic_docs(rng, v=30, k=3, n=300, doc_len=60):
+    topics = np.zeros((k, v))
+    span = v // k
+    for j in range(k):
+        topics[j, j * span : (j + 1) * span] = 1.0 / span
+    docs = np.zeros((n, v), np.float32)
+    zs = rng.integers(0, k, n)
+    for i in range(n):
+        words = rng.choice(v, size=doc_len, p=topics[zs[i]])
+        np.add.at(docs[i], words, 1.0)
+    return docs, zs, span
+
+
+class TestLDA:
+    def test_recovers_disjoint_topics(self, rng, mesh8):
+        docs, zs, span = _topic_docs(rng)
+        m = ht.LDA(k=3, max_iter=30, seed=0).fit(docs, mesh=mesh8)
+        learned = m.topics_matrix().T          # (k, v)
+        # every learned topic concentrates on ONE true support block
+        mass = np.zeros((3, 3))
+        for a in range(3):
+            for b in range(3):
+                mass[a, b] = learned[a, b * span : (b + 1) * span].sum()
+        assert (mass.max(axis=1) > 0.85).all()
+        # and the three topics pick three DIFFERENT supports
+        assert len(set(mass.argmax(axis=1))) == 3
+
+    def test_transform_and_perplexity(self, rng, mesh8):
+        docs, zs, span = _topic_docs(rng)
+        m = ht.LDA(k=3, max_iter=30, seed=0).fit(docs, mesh=mesh8)
+        mix = m.transform(docs)
+        assert mix.shape == (len(docs), 3)
+        np.testing.assert_allclose(mix.sum(axis=1), 1.0, atol=1e-5)
+        # dominant topic clusters agree with the generating labels
+        dom = mix.argmax(axis=1)
+        # map learned topic → true topic by majority vote, require >90%
+        agree = 0
+        for t in range(3):
+            sel = dom == t
+            if sel.any():
+                agree += (zs[sel] == np.bincount(zs[sel]).argmax()).sum()
+        assert agree / len(zs) > 0.9
+        # trained model beats an untrained one on held-out perplexity
+        untrained = ht.LDA(k=3, max_iter=0, seed=0).fit(docs, mesh=mesh8)
+        assert m.log_perplexity(docs) < untrained.log_perplexity(docs) - 0.1
+
+    def test_describe_topics_and_round_trip(self, rng, mesh8, tmp_path):
+        docs, _, span = _topic_docs(rng, n=120)
+        m = ht.LDA(k=3, max_iter=15, seed=0).fit(docs, mesh=mesh8)
+        desc = m.describe_topics(max_terms=5)
+        assert len(desc) == 3
+        for idx, wts in desc:
+            assert len(idx) == 5 and np.all(np.diff(wts) <= 1e-12)
+        m.write().overwrite().save(str(tmp_path / "lda"))
+        back = ht.load_model(str(tmp_path / "lda"))
+        np.testing.assert_allclose(back.lam, m.lam)
+        np.testing.assert_allclose(back.transform(docs[:8]), m.transform(docs[:8]))
+
+    def test_validation(self, rng, mesh8):
+        docs, _, _ = _topic_docs(rng, n=32)
+        with pytest.raises(ValueError, match="optimizer"):
+            ht.LDA(optimizer="em").fit(docs, mesh=mesh8)
+        with pytest.raises(ValueError, match="k must"):
+            ht.LDA(k=1).fit(docs, mesh=mesh8)
+        with pytest.raises(ValueError, match="non-negative"):
+            ht.LDA(k=2).fit(docs - 5.0, mesh=mesh8)
+
+
+class TestPIC:
+    def _two_blocks(self, rng, nn=60, p_in=0.6, p_out=0.02):
+        src, dst = [], []
+        for i in range(nn):
+            for j in range(i + 1, nn):
+                same = (i < nn // 2) == (j < nn // 2)
+                if rng.uniform() < (p_in if same else p_out):
+                    src.append(i)
+                    dst.append(j)
+        return np.asarray(src), np.asarray(dst)
+
+    def test_separates_blocks(self, rng, mesh8):
+        src, dst = self._two_blocks(rng)
+        a = ht.PowerIterationClustering(k=2, max_iter=15, seed=1).assign_clusters(
+            src, dst, mesh=mesh8
+        )
+        g1, g2 = a[:30], a[30:]
+        m1, m2 = np.bincount(g1).argmax(), np.bincount(g2).argmax()
+        assert m1 != m2
+        purity = (np.mean(g1 == m1) + np.mean(g2 == m2)) / 2
+        assert purity > 0.9
+
+    def test_degree_init_and_weights(self, rng, mesh8):
+        src, dst = self._two_blocks(rng)
+        w = np.ones(len(src), np.float32)
+        a = ht.PowerIterationClustering(
+            k=2, max_iter=15, seed=0, init_mode="degree"
+        ).assign_clusters(src, dst, w, mesh=mesh8)
+        assert set(np.unique(a)) == {0, 1}
+
+    def test_validation(self, rng, mesh8):
+        with pytest.raises(ValueError, match="empty"):
+            ht.PowerIterationClustering().assign_clusters(
+                np.array([], np.int64), np.array([], np.int64), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="no edges"):
+            # node 2 exists (max id) but has no edges... use id gap:
+            ht.PowerIterationClustering().assign_clusters(
+                np.array([0]), np.array([2]), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="non-negative"):
+            ht.PowerIterationClustering().assign_clusters(
+                np.array([0]), np.array([1]), np.array([-1.0]), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="init_mode"):
+            ht.PowerIterationClustering(init_mode="ones").assign_clusters(
+                np.array([0]), np.array([1]), mesh=mesh8
+            )
