@@ -25,6 +25,18 @@ from .selector import (
     VectorIndexerModel,
 )
 from .sql_transformer import SQLTransformer
+from .text import (
+    CountVectorizer,
+    CountVectorizerModel,
+    DCT,
+    HashingTF,
+    IDF,
+    IDFModel,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+)
 from .vector_ops import ElementwiseProduct, Interaction, VectorSlicer
 
 __all__ = [
@@ -60,6 +72,16 @@ __all__ = [
     "VarianceThresholdSelector",
     "VarianceThresholdSelectorModel",
     "SQLTransformer",
+    "CountVectorizer",
+    "CountVectorizerModel",
+    "DCT",
+    "HashingTF",
+    "IDF",
+    "IDFModel",
+    "NGram",
+    "RegexTokenizer",
+    "StopWordsRemover",
+    "Tokenizer",
     "ElementwiseProduct",
     "Interaction",
     "VectorSlicer",

@@ -15,6 +15,7 @@ from .fm import FMClassifier, FMModel, FMRegressor
 from .aft import AFTSurvivalRegression, AFTSurvivalRegressionModel
 from .lda import LDA, LDAModel
 from .pic import PowerIterationClustering
+from .fpm import FPGrowth, FPGrowthModel
 from .linear_svc import LinearSVC, LinearSVCModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
@@ -45,6 +46,8 @@ __all__ = [
     "LDA",
     "LDAModel",
     "PowerIterationClustering",
+    "FPGrowth",
+    "FPGrowthModel",
     "Estimator",
     "Model",
     "PredictionResult",

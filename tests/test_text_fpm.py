@@ -1,0 +1,221 @@
+"""Text feature stages (Tokenizer/RegexTokenizer/StopWordsRemover/NGram/
+CountVectorizer/HashingTF/IDF/DCT) + FPGrowth (ml.fpm).
+
+Oracles: hand-computed token/count expectations, sklearn TfidfTransformer
+agreement for the smoothed-idf formula, scipy DCT parity, and an
+exhaustive brute-force itemset enumeration for FP-growth."""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+pytestmark = pytest.mark.fast
+
+
+class TestTokenizers:
+    TEXTS = ["The cardiac ward is FULL today", "icu beds are full", ""]
+
+    def test_tokenizer_lowercases_and_splits(self):
+        toks = ht.Tokenizer().transform(self.TEXTS)
+        assert toks[0] == ["the", "cardiac", "ward", "is", "full", "today"]
+        assert toks[2] == []
+
+    def test_regex_tokenizer_both_modes(self):
+        gaps = ht.RegexTokenizer(pattern=r"\s+").transform(["a  b\tc"])
+        assert gaps[0] == ["a", "b", "c"]
+        toks = ht.RegexTokenizer(pattern=r"[a-z]+", gaps=False).transform(
+            ["a1b2 ccc"]
+        )
+        assert toks[0] == ["a", "b", "ccc"]
+        # min_token_length filters
+        long = ht.RegexTokenizer(
+            pattern=r"\s+", min_token_length=2
+        ).transform(["a bb ccc"])
+        assert long[0] == ["bb", "ccc"]
+
+    def test_stop_words_and_ngram(self):
+        toks = ht.Tokenizer().transform(self.TEXTS)
+        clean = ht.StopWordsRemover().transform(toks)
+        assert clean[0] == ["cardiac", "ward", "full", "today"]
+        cs = ht.StopWordsRemover(
+            stop_words=("The",), case_sensitive=True
+        ).transform(ht.RegexTokenizer(to_lowercase=False).transform(self.TEXTS))
+        assert cs[0][0] == "cardiac"   # exact-case "The" removed
+        bi = ht.NGram(n=2).transform(clean)
+        assert bi[0] == ["cardiac ward", "ward full", "full today"]
+        assert ht.NGram(n=9).transform(clean)[0] == []  # shorter than n
+        with pytest.raises(ValueError, match="n must"):
+            ht.NGram(n=0)
+        with pytest.raises(TypeError, match="token lists"):
+            ht.StopWordsRemover().transform(self.TEXTS)   # strings, not tokens
+
+
+class TestVectorizers:
+    DOCS = [
+        ["ward", "full", "ward"],
+        ["icu", "full"],
+        ["ward", "icu", "beds"],
+    ]
+
+    def test_count_vectorizer_counts_and_order(self):
+        m = ht.CountVectorizer().fit(self.DOCS)
+        # vocabulary ordered by descending corpus term frequency
+        assert m.vocabulary[0] == "ward"          # tf 3
+        mat = m.transform(self.DOCS)
+        v = {t: i for i, t in enumerate(m.vocabulary)}
+        assert mat[0, v["ward"]] == 2.0 and mat[0, v["full"]] == 1.0
+        assert mat.sum() == 8.0   # 3 + 2 + 3 tokens
+        # min_df in docs, binary mode
+        m2 = ht.CountVectorizer(min_df=2.0, binary=True).fit(self.DOCS)
+        assert set(m2.vocabulary) == {"ward", "full", "icu"}
+        assert ht.CountVectorizer(vocab_size=1).fit(self.DOCS).vocabulary == ("ward",)
+        b = m2.transform(self.DOCS)
+        assert set(np.unique(b)) <= {0.0, 1.0}
+
+    def test_idf_matches_sklearn_smooth(self):
+        from sklearn.feature_extraction.text import TfidfTransformer
+
+        m = ht.CountVectorizer().fit(self.DOCS)
+        tf = m.transform(self.DOCS)
+        ours = ht.IDF().fit(tf)
+        ref = TfidfTransformer(norm=None, smooth_idf=True, sublinear_tf=False).fit(tf)
+        # sklearn's smoothed idf = log((n+1)/(df+1)) + 1
+        np.testing.assert_allclose(ours.idf, ref.idf_ - 1.0, rtol=1e-6)
+        tfidf = ours.transform(tf)
+        np.testing.assert_allclose(tfidf, tf * (ref.idf_ - 1.0), rtol=1e-6)
+        with pytest.raises(ValueError, match="TF matrix"):
+            ht.IDF().fit(np.empty((0, 3)))
+
+    def test_hashing_tf_deterministic(self):
+        h = ht.HashingTF(num_features=32)
+        a = h.transform(self.DOCS)
+        b = ht.HashingTF(num_features=32).transform(self.DOCS)
+        np.testing.assert_array_equal(a, b)       # process-stable hashing
+        assert a.shape == (3, 32) and a.sum() == 8.0
+        assert set(np.unique(ht.HashingTF(num_features=32, binary=True).transform(self.DOCS))) <= {0.0, 1.0}
+
+    def test_dct_matches_scipy_and_inverts(self, rng):
+        from scipy.fft import dct as sdct
+
+        x = rng.normal(size=(5, 16)).astype(np.float32)
+        y = np.asarray(ht.DCT().transform(x))
+        np.testing.assert_allclose(
+            y, sdct(x, type=2, axis=1, norm="ortho"), atol=1e-5
+        )
+        back = np.asarray(ht.DCT(inverse=True).transform(y))
+        np.testing.assert_allclose(back, x, atol=1e-5)
+
+    def test_pipeline_to_lda(self):
+        """The full text path feeds the device-side LDA."""
+        texts = ["ward ward full", "icu icu beds", "ward full", "icu beds"] * 10
+        toks = ht.Tokenizer().transform(texts)
+        mat = ht.CountVectorizer().fit_transform(toks)
+        m = ht.LDA(k=2, max_iter=10, seed=0).fit(mat)
+        mix = m.transform(mat)
+        assert mix.shape == (40, 2)
+        # the two doc families land on different dominant topics
+        assert (mix.argmax(axis=1)[0::2] != mix.argmax(axis=1)[1::2]).mean() > 0.9
+
+    def test_round_trips(self, tmp_path):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+            load_model, save_model,
+        )
+
+        cv = ht.CountVectorizer().fit(self.DOCS)
+        for name, stage in [
+            ("tok", ht.Tokenizer()),
+            ("rex", ht.RegexTokenizer(pattern=r"[a-z]+", gaps=False)),
+            ("sw", ht.StopWordsRemover(stop_words=("x",))),
+            ("ng", ht.NGram(n=3)),
+            ("cv", cv),
+            ("htf", ht.HashingTF(num_features=64)),
+            ("idf", ht.IDF().fit(cv.transform(self.DOCS))),
+            ("dct", ht.DCT(inverse=True)),
+        ]:
+            save_model(str(tmp_path / name), *stage._artifacts())
+            back = load_model(str(tmp_path / name))
+            assert type(back) is type(stage)
+        assert load_model(str(tmp_path / "cv")).vocabulary == cv.vocabulary
+
+
+class TestFPGrowth:
+    def test_spark_doc_example(self):
+        data = [["1", "2", "5"], ["1", "2", "3", "5"], ["1", "2"]]
+        m = ht.FPGrowth(min_support=0.5, min_confidence=0.6).fit(data)
+        freq = dict(m.freq_itemsets)
+        assert freq[("1",)] == 3 and freq[("2",)] == 3
+        assert freq[("1", "2")] == 3 and freq[("1", "2", "5")] == 2
+        rules = {
+            (a, c): (conf, lift)
+            for a, c, conf, lift, s in m.association_rules
+        }
+        assert rules[(("5",), "1")] == (1.0, 1.0)
+        np.testing.assert_allclose(rules[(("1", "2"), "5")][0], 2 / 3)
+        pred = m.transform([["1", "5"], ["1", "2", "3", "5"]])
+        assert "2" in pred[0]
+        assert pred[1] == []     # everything already present
+
+    def test_matches_brute_force(self, rng):
+        items = list("abcdef")
+        rows = [
+            [items[i] for i in np.flatnonzero(rng.uniform(size=6) < 0.45)]
+            for _ in range(80)
+        ]
+        rows = [r for r in rows if r]
+        m = ht.FPGrowth(min_support=0.1).fit(rows)
+        min_count = int(np.ceil(0.1 * len(rows)))
+        brute = {}
+        for k in range(1, 7):
+            for combo in combinations(items, k):
+                c = sum(1 for r in rows if set(combo) <= set(r))
+                if c >= min_count:
+                    brute[tuple(sorted(combo))] = c
+        mined = {tuple(sorted(i)): c for i, c in m.freq_itemsets}
+        assert mined == brute
+        assert len(brute) > 15      # the check actually covered pairs+
+
+    def test_round_trip_and_validation(self, tmp_path):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+            load_model, save_model,
+        )
+
+        m = ht.FPGrowth(min_support=0.5).fit([["a", "b"], ["a"], ["a", "b"]])
+        save_model(str(tmp_path / "fp"), *m._artifacts())
+        back = load_model(str(tmp_path / "fp"))
+        assert dict(back.freq_itemsets) == dict(m.freq_itemsets)
+        assert back.transform([["a"]]) == m.transform([["a"]])
+        with pytest.raises(ValueError, match="empty"):
+            ht.FPGrowth().fit([])
+        with pytest.raises(ValueError, match="min_support"):
+            ht.FPGrowth(min_support=0.0).fit([["a"]])
+
+
+def test_review_fixes(rng, tmp_path):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+        load_model, save_model,
+    )
+
+    # fractional min_tf = fraction of the doc's token count (Spark)
+    m = ht.CountVectorizer(min_tf=0.4).fit([["a", "a", "a", "b"]])
+    mat = m.transform([["a", "a", "a", "b"]])
+    v = {t: i for i, t in enumerate(m.vocabulary)}
+    assert mat[0, v["a"]] == 3.0 and mat[0, v["b"]] == 0.0  # 1 < 0.4*4
+    # integer TF matrices don't floor the idf weights to zero
+    tf_int = np.array([[2, 0], [1, 1]], np.int32)
+    out = ht.IDF().fit(tf_int).transform(tf_int)
+    # col 0 appears in every doc → idf 0; col 1 (df=1) must NOT floor to 0
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out[1, 1], np.log(3 / 2), rtol=1e-6)
+    # integer items survive an FPGrowth round trip
+    fp = ht.FPGrowth(min_support=0.5, min_confidence=0.5).fit(
+        [[1, 2], [1, 2, 5], [1]]
+    )
+    save_model(str(tmp_path / "fpi"), *fp._artifacts())
+    back = load_model(str(tmp_path / "fpi"))
+    assert back.transform([[1]]) == fp.transform([[1]]) != [[]]
+    # dense HashingTF budget raises instead of OOMing
+    with pytest.raises(ValueError, match="element budget"):
+        ht.HashingTF().transform([["x"]] * 2000)
