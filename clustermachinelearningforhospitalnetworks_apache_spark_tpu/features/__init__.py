@@ -38,6 +38,7 @@ from .text import (
     Tokenizer,
 )
 from .vector_ops import ElementwiseProduct, Interaction, VectorSlicer
+from .word2vec import FeatureHasher, Word2Vec, Word2VecModel
 
 __all__ = [
     "AssembledTable",
@@ -85,4 +86,7 @@ __all__ = [
     "ElementwiseProduct",
     "Interaction",
     "VectorSlicer",
+    "FeatureHasher",
+    "Word2Vec",
+    "Word2VecModel",
 ]

@@ -219,3 +219,96 @@ def test_review_fixes(rng, tmp_path):
     # dense HashingTF budget raises instead of OOMing
     with pytest.raises(ValueError, match="element budget"):
         ht.HashingTF().transform([["x"]] * 2000)
+
+
+class TestWord2Vec:
+    def _topic_docs(self, rng, n=400):
+        heart = [f"h{i}" for i in range(6)]
+        lung = [f"l{i}" for i in range(6)]
+        docs = []
+        for _ in range(n):
+            pool = heart if rng.uniform() < 0.5 else lung
+            docs.append(list(rng.choice(pool, size=8)))
+        return docs
+
+    def test_cooccurring_words_embed_together(self, rng):
+        docs = self._topic_docs(rng)
+        m = ht.Word2Vec(
+            vector_size=16, min_count=1, max_iter=15, window_size=4, seed=0
+        ).fit(docs)
+        syn = [t for t, s in m.find_synonyms("h0", num=5)]
+        assert np.mean([t.startswith("h") for t in syn]) >= 0.8
+        # similarities are descending
+        sims = [s for _, s in m.find_synonyms("h0", num=5)]
+        assert sims == sorted(sims, reverse=True)
+
+    def test_transform_and_round_trip(self, rng, tmp_path):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+            load_model, save_model,
+        )
+
+        docs = self._topic_docs(rng, n=200)
+        m = ht.Word2Vec(
+            vector_size=8, min_count=1, max_iter=10, window_size=4, seed=0
+        ).fit(docs)
+        emb = m.transform(docs[:6])
+        assert emb.shape == (6, 8)
+        # unknown-token documents embed to zeros (Spark's rule)
+        assert np.all(m.transform([["zzz"]]) == 0.0)
+        save_model(str(tmp_path / "w2v"), *m._artifacts())
+        back = load_model(str(tmp_path / "w2v"))
+        np.testing.assert_allclose(back.transform(docs[:3]), m.transform(docs[:3]))
+        with pytest.raises(KeyError, match="vocabulary"):
+            m.find_synonyms("zzz")
+
+    def test_validation(self, rng):
+        with pytest.raises(ValueError, match="min_count"):
+            ht.Word2Vec(min_count=99).fit([["a", "b"]])
+        with pytest.raises(ValueError, match="pairs"):
+            ht.Word2Vec(min_count=1).fit([["solo"]])
+
+
+class TestFeatureHasher:
+    def test_numeric_and_categorical(self):
+        fh = ht.FeatureHasher(num_features=512)   # wide enough to avoid
+        # an age/ward slot collision in this tiny example
+        out = fh.transform([{"age": 30, "ward": "icu"}, {"age": 40, "ward": "er"}])
+        assert out.shape == (2, 512)
+        assert out[0].sum() == 31.0      # 30 at hash(age) + 1 at hash(ward=icu)
+        assert out[1].sum() == 41.0
+        # same column hashes to the same slot across rows
+        age_slot = np.flatnonzero(out[0] == 30.0)[0]
+        assert out[1, age_slot] == 40.0
+        # deterministic across instances (CRC32, not salted hash())
+        np.testing.assert_array_equal(
+            out, ht.FeatureHasher(num_features=512).transform(
+                [{"age": 30, "ward": "icu"}, {"age": 40, "ward": "er"}]
+            )
+        )
+
+    def test_table_input_and_validation(self):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+        t = Table.from_dict(
+            {"age": np.array([30.0, 40.0]), "ward": np.array(["icu", "er"], object)}
+        )
+        out = ht.FeatureHasher(num_features=32).transform(t)
+        assert out.shape == (2, 32) and out[0].sum() == 31.0
+        with pytest.raises(TypeError, match="dicts"):
+            ht.FeatureHasher(num_features=8).transform([["not", "a", "dict"]])
+        with pytest.raises(ValueError, match="num_features"):
+            ht.FeatureHasher(num_features=0)
+
+
+def test_feature_hasher_nulls_and_numpy_bools():
+    fh = ht.FeatureHasher(num_features=128)
+    a = fh.transform([{"flag": True, "x": 1.0}])
+    b = fh.transform([{"flag": np.bool_(True), "x": 1.0}])
+    np.testing.assert_array_equal(a, b)      # np.bool_ hashes categorically
+    # nulls contribute nothing instead of crashing / writing NaN
+    c = fh.transform([{"age": None, "x": 1.0}, {"age": float("nan"), "x": 1.0}])
+    assert np.isfinite(c).all() and c[0].sum() == 1.0 == c[1].sum()
+    with pytest.raises(ValueError, match="vector_size"):
+        ht.Word2Vec(vector_size=0, min_count=1).fit([["a", "b"]])
+    with pytest.raises(ValueError, match="max_iter"):
+        ht.Word2Vec(max_iter=0, min_count=1).fit([["a", "b"]])
