@@ -30,7 +30,7 @@ import numpy as np
 from jax import lax
 
 from ..io.model_io import register_model
-from .text import _tokens_column
+from .text import HashingTF, _tokens_column
 
 
 @partial(jax.jit, static_argnames=("batch", "neg", "steps"))
@@ -161,6 +161,12 @@ class Word2Vec:
             raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
         if self.window_size < 1:
             raise ValueError(f"window_size must be >= 1, got {self.window_size}")
+        if self.batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
+        if self.num_negatives < 1:
+            raise ValueError(
+                f"num_negatives must be >= 1, got {self.num_negatives}"
+            )
         rows = _tokens_column(tokens)
         counts: dict[str, int] = {}
         for row in rows:
@@ -208,8 +214,10 @@ class Word2Vec:
         batch = min(self.batch_size, n_pairs)
         for _ in range(self.max_iter):
             perm = rng.permutation(n_pairs)
-            steps = n_pairs // batch      # >= 1 since batch <= n_pairs
-            take = perm[: steps * batch]
+            # ceil-div + wrap-around fill: the shuffled tail trains too
+            # (truncating would silently drop up to batch−1 pairs/epoch)
+            steps = -(-n_pairs // batch)
+            take = np.resize(perm, steps * batch)
             negs = rng.choice(
                 v, size=(steps * batch, self.num_negatives), p=p_neg
             ).astype(np.int32)
@@ -233,8 +241,8 @@ class FeatureHasher:
     string/bool values at hash(col=value) with 1.0)."""
 
     num_features: int = 1 << 18
-    #: dense-output element budget, same rationale as HashingTF
-    _MAX_DENSE_ELEMS = 1 << 28
+    # ONE budget policy for every dense hasher (shared with HashingTF)
+    _MAX_DENSE_ELEMS = HashingTF._MAX_DENSE_ELEMS
 
     def __post_init__(self):
         if self.num_features < 1:

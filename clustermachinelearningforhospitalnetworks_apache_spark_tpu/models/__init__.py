@@ -21,6 +21,7 @@ from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
 from .bisecting_kmeans import BisectingKMeans, BisectingKMeansModel
 from .streaming_kmeans import StreamingKMeans, StreamingKMeansModel
+from .streaming_linear import StreamingLinearRegression, StreamingLogisticRegression
 from .tree import (
     GBTClassifier,
     GBTModel,
@@ -48,6 +49,8 @@ __all__ = [
     "PowerIterationClustering",
     "FPGrowth",
     "FPGrowthModel",
+    "StreamingLinearRegression",
+    "StreamingLogisticRegression",
     "Estimator",
     "Model",
     "PredictionResult",

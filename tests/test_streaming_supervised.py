@@ -1,0 +1,150 @@
+"""StreamingLinearRegression / StreamingLogisticRegression — incremental
+supervised learners over micro-batches (the working realization of the
+reference's dead incremental-training hook, C6/D2, whose comment names
+LogisticRegression as the intended per-batch model)."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def _reg_data(rng, n=8000, d=4):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([1.0, -2.0, 0.5, 0.3], np.float32)[:d]
+    y = (x @ beta + 0.7 + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return x, y, beta
+
+
+class TestStreamingLinear:
+    def test_decay_one_equals_batch_wls(self, rng, mesh8):
+        x, y, _ = _reg_data(rng)
+        sl = ht.StreamingLinearRegression()
+        for s in range(0, len(x), 1000):
+            sl.update((x[s : s + 1000], y[s : s + 1000]), mesh=mesh8)
+        assert sl.n_batches == 8
+        m = sl.latest_model
+        batch = ht.LinearRegression().fit((x, y), mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(m.coefficients), np.asarray(batch.coefficients),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(m.intercept), float(batch.intercept), rtol=1e-3
+        )
+
+    def test_forgetting_tracks_drift(self, rng, mesh8):
+        x, y, beta = _reg_data(rng)
+        y2 = (x @ (-beta) + 0.7).astype(np.float32)   # regime flip
+        tracker = ht.StreamingLinearRegression(decay_factor=0.3)
+        averager = ht.StreamingLinearRegression(decay_factor=1.0)
+        for yy in (y, y2):
+            for s in range(0, len(x), 1000):
+                tracker.update((x[s : s + 1000], yy[s : s + 1000]), mesh=mesh8)
+                averager.update((x[s : s + 1000], yy[s : s + 1000]), mesh=mesh8)
+        tc = np.asarray(tracker.latest_model.coefficients)
+        ac = np.asarray(averager.latest_model.coefficients)
+        assert np.abs(tc + beta).max() < 0.05      # locked onto the new regime
+        assert np.abs(ac + beta).max() > 0.5       # still dragged by history
+
+    def test_validation(self, rng, mesh8):
+        with pytest.raises(ValueError, match="decay_factor"):
+            ht.StreamingLinearRegression(decay_factor=1.5)
+        with pytest.raises(RuntimeError, match="update"):
+            ht.StreamingLinearRegression().latest_model
+
+
+class TestStreamingLogistic:
+    def test_converges_to_batch_newton(self, rng, mesh8):
+        x, _, beta = _reg_data(rng)
+        p = 1 / (1 + np.exp(-(x @ beta + 0.3)))
+        yb = (rng.uniform(size=len(x)) < p).astype(np.float32)
+        sl = ht.StreamingLogisticRegression(newton_steps_per_batch=2)
+        for s in range(0, len(x), 1000):
+            sl.update((x[s : s + 1000], yb[s : s + 1000]), mesh=mesh8)
+        sm = sl.latest_model
+        bm = ht.LogisticRegression(max_iter=50).fit((x, yb), mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(sm.coefficients), np.asarray(bm.coefficients), atol=0.05
+        )
+        acc_s = np.mean(np.asarray(sm.predict_numpy(x)) == yb)
+        acc_b = np.mean(np.asarray(bm.predict_numpy(x)) == yb)
+        assert acc_s > acc_b - 0.01
+
+    def test_validation(self, mesh8):
+        with pytest.raises(ValueError, match="decay_factor"):
+            ht.StreamingLogisticRegression(decay_factor=-0.1)
+        with pytest.raises(ValueError, match="newton_steps"):
+            ht.StreamingLogisticRegression(newton_steps_per_batch=0)
+        with pytest.raises(RuntimeError, match="update"):
+            ht.StreamingLogisticRegression().latest_model
+
+
+def test_foreach_batch_incremental_supervised(tmp_path, mesh8):
+    """The reference's C6 intent end-to-end: stream micro-batches through
+    the file-source driver, train LogisticRegression incrementally in the
+    foreachBatch hook."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.csv import write_csv
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+        FileStreamSource,
+        StreamCheckpoint,
+        StreamExecution,
+        UnboundedTable,
+        WatermarkTracker,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def event_csv(path, start_minute, n):
+        base = np.datetime64("2025-03-31T22:00:00") + np.timedelta64(
+            int(start_minute), "m"
+        )
+        adm = rng.integers(0, 50, n)
+        t = ht.Table.from_dict(
+            {
+                "hospital_id": np.array(["H01"] * n, dtype=object),
+                "event_time": base + np.arange(n).astype("timedelta64[s]"),
+                "admission_count": adm,
+                "current_occupancy": rng.integers(20, 200, n),
+                "emergency_visits": rng.integers(0, 30, n),
+                "seasonality_index": rng.uniform(0.5, 1.5, n),
+                # LOS driven by admissions → the stream learner must find it
+                "length_of_stay": 2.0 + 0.2 * adm + rng.normal(0, 0.1, n),
+            },
+            ht.hospital_event_schema(),
+        )
+        write_csv(t, path)
+
+    incoming = tmp_path / "incoming"
+    incoming.mkdir()
+    learner = ht.StreamingLogisticRegression(newton_steps_per_batch=3)
+
+    def hook(batch, batch_id):
+        if batch.num_rows:
+            xb = batch.numeric_matrix(list(ht.FEATURE_COLS)).astype(np.float32)
+            yb = (
+                np.asarray(batch.column("length_of_stay")) > 5.0
+            ).astype(np.float32)
+            learner.update((xb, yb), mesh=mesh8)
+
+    exec_ = StreamExecution(
+        source=FileStreamSource(str(incoming), ht.hospital_event_schema()),
+        sink=UnboundedTable(str(tmp_path / "table"), ht.hospital_event_schema()),
+        checkpoint=StreamCheckpoint(str(tmp_path / "ckpt")),
+        watermark=WatermarkTracker("event_time", 10.0),
+        foreach_batch=hook,
+    )
+    for i in range(4):
+        event_csv(str(incoming / f"{i}.csv"), i, 400)
+        exec_.run_once()
+    assert learner.n_batches >= 1
+    m = learner.latest_model
+    # the learned boundary tracks the LOS>5 rule (admissions-driven)
+    xt = np.asarray(
+        exec_.sink.read().numeric_matrix(list(ht.FEATURE_COLS)), np.float32
+    )
+    yt = (np.asarray(exec_.sink.read().column("length_of_stay")) > 5.0).astype(
+        np.float32
+    )
+    acc = np.mean(np.asarray(m.predict_numpy(xt)) == yt)
+    assert acc > 0.95
