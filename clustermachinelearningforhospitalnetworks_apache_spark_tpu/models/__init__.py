@@ -15,7 +15,7 @@ from .fm import FMClassifier, FMModel, FMRegressor
 from .aft import AFTSurvivalRegression, AFTSurvivalRegressionModel
 from .lda import LDA, LDAModel
 from .pic import PowerIterationClustering
-from .fpm import FPGrowth, FPGrowthModel
+from .fpm import FPGrowth, FPGrowthModel, PrefixSpan
 from .linear_svc import LinearSVC, LinearSVCModel
 from .gmm import GaussianMixture, GaussianMixtureModel
 from .one_vs_rest import OneVsRest, OneVsRestModel
@@ -49,6 +49,7 @@ __all__ = [
     "PowerIterationClustering",
     "FPGrowth",
     "FPGrowthModel",
+    "PrefixSpan",
     "StreamingLinearRegression",
     "StreamingLogisticRegression",
     "Estimator",

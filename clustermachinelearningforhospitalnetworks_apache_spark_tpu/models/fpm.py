@@ -206,3 +206,111 @@ class FPGrowth:
             n_rows=len(rows),
             min_confidence=self.min_confidence,
         )
+
+
+# ------------------------------------------------------------- PrefixSpan
+def _seq_contains(seq: list, pattern: list) -> bool:
+    """Greedy earliest-embedding subsequence test: pattern elements map to
+    strictly increasing sequence elements with itemset containment (the
+    PrefixSpan pattern-occurrence rule; greedy matching is complete for
+    existence)."""
+    i = 0
+    for elem in seq:
+        if i < len(pattern) and pattern[i] <= elem:
+            i += 1
+    return i == len(pattern)
+
+
+@dataclass(frozen=True)
+class PrefixSpan:
+    """Sequential pattern mining (``pyspark.ml.fpm.PrefixSpan``).
+
+    Spark defaults: minSupport 0.1, maxPatternLength 10.  Sequences are
+    lists of itemsets; a pattern occurs in a sequence when its elements
+    map to strictly increasing sequence positions with itemset
+    containment.  Host-side DFS with support-based pruning (symbolic
+    search — the same placement argument as FP-growth); candidate
+    extensions are drawn only from sequences still supporting the
+    current prefix, and both s-extensions (new element) and i-extensions
+    (grow the last element) are explored, so the enumeration is exactly
+    the PrefixSpan pattern space."""
+
+    min_support: float = 0.1
+    max_pattern_length: int = 10
+
+    def find_frequent_sequential_patterns(self, sequences) -> list:
+        """→ [(pattern as tuple of sorted item tuples, count), ...] sorted
+        by descending count (Spark's freq column)."""
+        all_seqs = [
+            [frozenset(elem) for elem in seq if len(elem) > 0]
+            for seq in sequences
+        ]
+        n_total = len(all_seqs)          # Spark's support denominator
+        db = [s for s in all_seqs if s]  # empty sequences support nothing
+        if n_total == 0:
+            raise ValueError("PrefixSpan on an empty sequence database")
+        if not 0.0 < self.min_support <= 1.0:
+            raise ValueError(
+                f"min_support must be in (0, 1], got {self.min_support}"
+            )
+        if self.max_pattern_length < 1:
+            raise ValueError(
+                f"max_pattern_length must be >= 1, got {self.max_pattern_length}"
+            )
+        # minCount over ALL input sequences (Spark counts empties in the
+        # denominator even though they can never support a pattern)
+        min_count = max(int(np.ceil(self.min_support * n_total)), 1)
+        if not db:
+            return []
+        out: list = []
+
+        def extensions(support_ids, pattern):
+            """Candidate (kind, item) extensions from supporting seqs."""
+            s_items: set = set()
+            i_items: set = set()
+            last = pattern[-1] if pattern else None
+            for sid in support_ids:
+                for elem in db[sid]:
+                    s_items |= elem
+                    if last is not None:
+                        # i-extension candidates: items co-occurring with
+                        # the full last element, ordered after its max
+                        if last <= elem:
+                            i_items |= {
+                                it for it in elem
+                                if it not in last
+                                and str(it) > max(map(str, last))
+                            }
+            return s_items, i_items
+
+        def dfs(pattern, support_ids):
+            length = sum(len(e) for e in pattern)
+            if length >= self.max_pattern_length:
+                return
+            s_items, i_items = extensions(support_ids, pattern)
+            for kind, items in (("s", s_items), ("i", i_items)):
+                for it in sorted(items, key=str):
+                    if kind == "s":
+                        cand = pattern + [frozenset((it,))]
+                    else:
+                        cand = pattern[:-1] + [pattern[-1] | {it}]
+                    sup = [
+                        sid for sid in support_ids
+                        if _seq_contains(db[sid], cand)
+                    ]
+                    if len(sup) >= min_count:
+                        out.append(
+                            (
+                                tuple(
+                                    tuple(sorted(e, key=str)) for e in cand
+                                ),
+                                len(sup),
+                            )
+                        )
+                        dfs(cand, sup)
+
+        dfs([], list(range(len(db))))
+        # str-keyed ordering like every other sort here (mixed-type items
+        # would TypeError under raw tuple comparison)
+        out.sort(key=lambda kv: (-kv[1], str(kv[0])))
+        return out

@@ -312,3 +312,106 @@ def test_feature_hasher_nulls_and_numpy_bools():
         ht.Word2Vec(vector_size=0, min_count=1).fit([["a", "b"]])
     with pytest.raises(ValueError, match="max_iter"):
         ht.Word2Vec(max_iter=0, min_count=1).fit([["a", "b"]])
+
+
+class TestPrefixSpan:
+    def test_spark_doc_example(self):
+        db = [
+            [[1, 2], [3]],
+            [[1], [3, 2], [1, 2]],
+            [[1, 2], [5]],
+            [[6]],
+        ]
+        pats = ht.PrefixSpan(
+            min_support=0.5, max_pattern_length=5
+        ).find_frequent_sequential_patterns(db)
+        d = dict(pats)
+        # Spark's documented output, exactly
+        assert d == {
+            ((1,),): 3,
+            ((2,),): 3,
+            ((3,),): 2,
+            ((1, 2),): 3,
+            ((1,), (3,)): 2,
+        }
+
+    def test_matches_brute_force(self, rng):
+        """Exhaustive subsequence enumeration over a small random DB."""
+        from itertools import combinations
+
+        items = [0, 1, 2]
+        db = []
+        for _ in range(30):
+            seq = []
+            for _ in range(rng.integers(1, 4)):
+                elem = [i for i in items if rng.uniform() < 0.5]
+                if elem:
+                    seq.append(elem)
+            if seq:
+                db.append(seq)
+        min_sup = 0.2
+        got = dict(
+            ht.PrefixSpan(
+                min_support=min_sup, max_pattern_length=3
+            ).find_frequent_sequential_patterns(db)
+        )
+
+        # brute force: all patterns of <= 3 total items, <= 3 elements
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.fpm import (
+            _seq_contains,
+        )
+
+        elems = [
+            frozenset(c)
+            for k in (1, 2, 3)
+            for c in combinations(items, k)
+        ]
+        def all_patterns(max_items):
+            pats = [[e] for e in elems if len(e) <= max_items]
+            out = list(pats)
+            frontier = pats
+            while frontier:
+                nxt = []
+                for p in frontier:
+                    used = sum(len(e) for e in p)
+                    for e in elems:
+                        if used + len(e) <= max_items:
+                            nxt.append(p + [e])
+                out.extend(nxt)
+                frontier = nxt
+            return out
+
+        min_count = int(np.ceil(min_sup * len(db)))
+        fdb = [[frozenset(e) for e in s] for s in db]
+        brute = {}
+        for pat in all_patterns(3):
+            c = sum(1 for s in fdb if _seq_contains(s, pat))
+            if c >= min_count:
+                brute[tuple(tuple(sorted(e)) for e in pat)] = c
+        assert got == brute
+        assert len(brute) > 5
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="empty"):
+            ht.PrefixSpan().find_frequent_sequential_patterns([])
+        with pytest.raises(ValueError, match="min_support"):
+            ht.PrefixSpan(min_support=0).find_frequent_sequential_patterns(
+                [[[1]]]
+            )
+        with pytest.raises(ValueError, match="max_pattern_length"):
+            ht.PrefixSpan(
+                max_pattern_length=0
+            ).find_frequent_sequential_patterns([[[1]]])
+
+
+def test_prefixspan_review_fixes():
+    # empty sequences count in the support denominator (Spark's rule)
+    pats = ht.PrefixSpan(min_support=0.5).find_frequent_sequential_patterns(
+        [[[1]], [], [], []]
+    )
+    assert pats == []   # freq 1 < ceil(0.5·4)
+    # mixed-type items sort without TypeError
+    pats = ht.PrefixSpan(min_support=0.5).find_frequent_sequential_patterns(
+        [[[1, "a"]], [[1, "a"]]]
+    )
+    assert dict(pats)[(("a",),)] == 2 and dict(pats)[((1,),)] == 2
