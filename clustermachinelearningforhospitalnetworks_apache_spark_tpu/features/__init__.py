@@ -38,6 +38,7 @@ from .text import (
     Tokenizer,
 )
 from .vector_ops import ElementwiseProduct, Interaction, VectorSlicer
+from .rformula import RFormula, RFormulaModel, VectorSizeHint
 from .word2vec import FeatureHasher, Word2Vec, Word2VecModel
 
 __all__ = [
@@ -87,6 +88,9 @@ __all__ = [
     "Interaction",
     "VectorSlicer",
     "FeatureHasher",
+    "RFormula",
+    "RFormulaModel",
+    "VectorSizeHint",
     "Word2Vec",
     "Word2VecModel",
 ]

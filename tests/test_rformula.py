@@ -1,0 +1,133 @@
+"""RFormula + VectorSizeHint — the final pyspark.ml.feature stages.
+
+Oracle: a known additive model over a categorical ward column; the
+treatment-coded fit must recover the per-level effects exactly."""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture
+def ward_table(rng):
+    n = 600
+    ward = rng.choice(["icu", "er", "gen"], size=n, p=[0.2, 0.3, 0.5])
+    adm = rng.integers(0, 40, n).astype(np.float32)
+    occ = rng.integers(20, 90, n).astype(np.float32)
+    eff = {"icu": 3.0, "er": 1.0, "gen": 0.0}
+    y = (
+        0.1 * adm + np.vectorize(eff.get)(ward) + 2.0
+        + 0.05 * rng.normal(size=n)
+    ).astype(np.float32)
+    return Table.from_dict(
+        {"ward": ward.astype(object), "adm": adm, "occ": occ, "los": y}
+    )
+
+
+class TestRFormula:
+    def test_treatment_coding_recovers_effects(self, ward_table, mesh8):
+        m = ht.RFormula(formula="los ~ adm + ward").fit(ward_table)
+        at = m.transform(ward_table)
+        # Spark's composition drops the LAST level by descending
+        # frequency: base = "icu" (rarest); dummies for gen and er
+        assert at.feature_cols == ("adm", "ward_gen", "ward_er")
+        lr = ht.LinearRegression(label_col="los").fit(at, mesh=mesh8)
+        coef = np.asarray(lr.coefficients)
+        np.testing.assert_allclose(coef[0], 0.1, atol=0.01)      # adm slope
+        np.testing.assert_allclose(coef[1], -3.0, atol=0.05)     # gen vs icu
+        np.testing.assert_allclose(coef[2], -2.0, atol=0.05)     # er vs icu
+        np.testing.assert_allclose(float(lr.intercept), 5.0, atol=0.05)
+
+    def test_dot_minus_and_interactions(self, ward_table):
+        m = ht.RFormula(formula="los ~ . - occ").fit(ward_table)
+        roots = {c.split("_")[0].split(":")[0] for c in m.transform(ward_table).feature_cols}
+        assert "occ" not in roots and "adm" in roots and "ward" in roots
+        m2 = ht.RFormula(formula="los ~ adm:occ").fit(ward_table)
+        at = m2.transform(ward_table)
+        assert at.feature_cols == ("adm:occ",)
+        np.testing.assert_allclose(
+            at.features[:, 0],
+            np.asarray(ward_table.column("adm"))
+            * np.asarray(ward_table.column("occ")),
+            rtol=1e-6,
+        )
+        # categorical × numeric interaction expands per dummy
+        m3 = ht.RFormula(formula="los ~ ward:adm").fit(ward_table)
+        assert m3.transform(ward_table).feature_cols == (
+            "ward_gen:adm", "ward_er:adm",
+        )
+        # '- a:b' removes exactly that interaction, keeping main effects
+        m4 = ht.RFormula(formula="los ~ adm + occ + adm:occ - adm:occ").fit(
+            ward_table
+        )
+        assert m4.transform(ward_table).feature_cols == ("adm", "occ")
+
+    def test_categorical_label_and_unseen_levels(self, ward_table):
+        y = np.asarray(ward_table.column("los"))
+        t = ward_table.with_column(
+            "risk", np.where(y > 4, "high", "low").astype(object)
+        )
+        m = ht.RFormula(formula="risk ~ adm + ward").fit(t)
+        at = m.transform(t)
+        assert set(np.unique(np.asarray(at.table.column("risk")))) <= {0.0, 1.0}
+        # unseen category at transform time raises (like the binned trees)
+        t_bad = t.with_column(
+            "ward", np.array(["lunar"] * len(t), object)
+        )
+        with pytest.raises(ValueError, match="unseen level"):
+            m.transform(t_bad)
+
+    def test_round_trip_and_validation(self, ward_table, tmp_path):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+            load_model, save_model,
+        )
+
+        m = ht.RFormula(formula="los ~ adm + ward").fit(ward_table)
+        save_model(str(tmp_path / "rf"), *m._artifacts())
+        back = load_model(str(tmp_path / "rf"))
+        np.testing.assert_allclose(
+            back.transform(ward_table).features,
+            m.transform(ward_table).features,
+        )
+        assert back.feature_names == m.transform(ward_table).feature_cols
+        for bad, msg in [
+            ("los adm", "~"),
+            ("~ adm", "label"),
+            ("los ~ ", "feature terms"),
+            ("los ~ nope", "not in the table"),
+        ]:
+            with pytest.raises((ValueError, KeyError), match=msg):
+                ht.RFormula(formula=bad).fit(ward_table)
+        with pytest.raises(KeyError, match="label"):
+            ht.RFormula(formula="nope ~ adm").fit(ward_table)
+        with pytest.raises(TypeError, match="Table"):
+            ht.RFormula(formula="y ~ x").fit(np.ones((3, 2)))
+
+
+class TestVectorSizeHint:
+    def test_pass_and_mismatch(self, ward_table):
+        at = ht.RFormula(formula="los ~ adm + ward").fit_transform(ward_table)
+        assert ht.VectorSizeHint(size=3).transform(at) is at
+        with pytest.raises(ValueError, match="saw 3"):
+            ht.VectorSizeHint(size=4).transform(at)
+        with pytest.raises(ValueError, match="size"):
+            ht.VectorSizeHint(size=0)
+        with pytest.raises(ValueError, match="handle_invalid"):
+            ht.VectorSizeHint(size=2, handle_invalid="skip")
+
+    def test_in_pipeline(self, ward_table, mesh8):
+        pipe = ht.Pipeline(
+            [
+                ht.VectorAssembler(["adm", "occ"]),
+                ht.VectorSizeHint(size=2),
+                ht.LinearRegression(label_col="los"),
+            ]
+        )
+        pm = pipe.fit(ward_table, mesh=mesh8)
+        assert np.isfinite(
+            np.asarray(pm.transform(ward_table, mesh=mesh8).prediction)
+        ).all()
