@@ -205,3 +205,40 @@ class TestAFT:
             ht.AFTSurvivalRegression().fit(
                 ht.device_dataset(x, y, mesh=mesh8), mesh=mesh8
             )
+
+
+def test_new_families_compose_in_pipeline(rng, mesh8, tmp_path):
+    """MLP and FM are full Pipeline citizens (chained stages +
+    composite persistence), like every earlier estimator family."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    n = 1500
+    a = rng.normal(size=n).astype(np.float32)
+    b = rng.normal(size=n).astype(np.float32)
+    y = ((a * b) > 0).astype(np.float32)        # pure interaction rule
+    t = Table.from_dict({"a": a, "b": b, "LOS_binary": y})
+
+    pipe = ht.Pipeline(
+        [
+            ht.VectorAssembler(["a", "b"]),
+            ht.StandardScaler(),
+            ht.FMClassifier(factor_size=3, max_iter=500, step_size=0.1, seed=0),
+        ]
+    )
+    pm = pipe.fit(t, label_col="LOS_binary", mesh=mesh8)
+    pred, lab = pm.transform(t, label_col="LOS_binary", mesh=mesh8).to_numpy()
+    assert np.mean(pred == lab) > 0.9           # linear stages can't do this
+    pm.write().overwrite().save(str(tmp_path / "fm_pipe"))
+    back = ht.load_model(str(tmp_path / "fm_pipe"))
+    pred2, _ = back.transform(t, label_col="LOS_binary", mesh=mesh8).to_numpy()
+    np.testing.assert_allclose(pred2, pred)
+
+    mlp_pipe = ht.Pipeline(
+        [
+            ht.VectorAssembler(["a", "b"]),
+            ht.MultilayerPerceptronClassifier(layers=(2, 12, 2), max_iter=150, seed=0),
+        ]
+    )
+    mm = mlp_pipe.fit(t, label_col="LOS_binary", mesh=mesh8)
+    mp, ml = mm.transform(t, label_col="LOS_binary", mesh=mesh8).to_numpy()
+    assert np.mean(mp == ml) > 0.9
