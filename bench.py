@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -235,7 +236,7 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
 
     def measure(chunk_rows: int, precision: str, windows: int = 3):
-        """(rate, final centers) for one (chunk, precision) variant."""
+        """(rate, final centers, per-window rates) for one variant."""
         step = _make_train_step(mesh, n_loc, k_pad, d, chunk_rows, False, precision)
         c, _, _, _ = step(ds.x, ds.w, centers0, c_valid_dev)  # warm-up/compile
         jax.block_until_ready(c)
@@ -246,7 +247,7 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
                 c, counts, cost, move = step(ds.x, ds.w, c, c_valid_dev)
             jax.block_until_ready(c)
             rates.append(n * timed_iters / (time.perf_counter() - t0))
-        return float(np.median(rates)), c
+        return float(np.median(rates)), c, rates
 
     # chunk_rows autotune (TPU only — compile cost per candidate is wasted
     # on the CPU smoke path, and the persistent compile cache amortizes it
@@ -256,11 +257,11 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     tuned = {}
     if on_tpu and os.environ.get("BENCH_AUTOTUNE", "1") != "0":
         for cand in (16384, 32768, 65536, 131072):
-            r, _ = measure(cand, "highest", windows=1)
+            r, _, _ = measure(cand, "highest", windows=1)
             tuned[cand] = round(r / n_chips, 1)
         chunk = max(tuned, key=tuned.get)
 
-    f32_rate, f32_centers = measure(chunk, "highest")
+    f32_rate, f32_centers, f32_windows = measure(chunk, "highest")
 
     # Both silhouettes are computed mesh-resident (nothing of size n
     # crosses to host, no (n, k) matrix in HBM — chunked shard_map assign).
@@ -282,14 +283,16 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     sil_f32 = mesh_silhouette(f32_centers)
     use_bf16 = False
     bf16_rate = sil_bf16 = None
+    bf16_windows: list[float] = []
     if on_tpu:
-        bf16_rate, bf16_centers = measure(chunk, "bf16")
+        bf16_rate, bf16_centers, bf16_windows = measure(chunk, "bf16")
         sil_bf16 = mesh_silhouette(bf16_centers)
         use_bf16 = bf16_rate > f32_rate and abs(sil_bf16 - sil_f32) <= 0.01
 
     per_chip = (bf16_rate if use_bf16 else f32_rate) / n_chips
     precision = "bf16" if use_bf16 else "highest"
     sil = sil_bf16 if use_bf16 else sil_f32
+    windows = bf16_windows if use_bf16 else f32_windows
 
     # CPU (Spark-CPU proxy) denominator on a bounded sample, same shape.
     # Best-of-2 (fastest CPU run) keeps the reported ratio conservative.
@@ -307,6 +310,7 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
         "precision": precision,
         "chunk_rows": chunk,
         "f32_rps_per_chip": round(f32_rate / n_chips, 1),
+        **_variance_fields([r / n_chips for r in windows]),
     }
     if bf16_rate is not None:
         out["bf16_rps_per_chip"] = round(bf16_rate / n_chips, 1)
@@ -356,6 +360,55 @@ def _cpu_gmm_throughput(x: np.ndarray, k: int, iters: int = 2) -> float:
     return n * iters / (time.perf_counter() - t0)
 
 
+def _variance_fields(rates: list[float]) -> dict:
+    """Per-run rates + spread-of-best — one definition for every row."""
+    best = max(rates)
+    return {
+        "runs_rps_per_chip": [round(r, 1) for r in rates],
+        "spread_pct": round(100.0 * (best - min(rates)) / best, 1) if best else 0.0,
+    }
+
+
+#: wall-clock start of BENCH_CHILD mode (set by _child_main) — lets
+#: _best_of respect the parent's watchdog budget instead of blowing it
+_CHILD_T0: list[float] = []
+
+
+def _extra_run_fits_budget(last_run_s: float) -> bool:
+    """Would another timed run of ~``last_run_s`` fit the watchdog budget
+    the parent passed down (BENCH_CHILD_BUDGET)?  The variance feature
+    must never cost the metric it annotates: better one run and no
+    spread than a watchdog kill."""
+    budget = float(os.environ.get("BENCH_CHILD_BUDGET", 0) or 0)
+    if budget <= 0 or not _CHILD_T0:
+        return True
+    elapsed = time.perf_counter() - _CHILD_T0[0]
+    return elapsed + 1.2 * last_run_s < budget - 15.0
+
+
+def _best_of(run, n_runs: int | None = None):
+    """(best_rate, variance_fields) over up to N timed runs of ``run()``.
+
+    VERDICT r4 #8: rows without a variance estimate made the GBT
+    3,237→2,778 delta unjudgeable (signal or fallback-host noise?).
+    Every single-shot config now times its fit N times (default 2;
+    BENCH_VARIANCE_RUNS overrides) and reports best-of-N as ``value``
+    plus the raw per-run rates and their spread as a fraction of best.
+    Compile cost is already paid by the warm-up, but the run cost is
+    real — extra runs are skipped when they would blow the watchdog
+    budget the parent passed down."""
+    n_runs = n_runs or int(os.environ.get("BENCH_VARIANCE_RUNS", 2))
+    rates = []
+    for i in range(max(1, n_runs)):
+        t0 = time.perf_counter()
+        rates.append(float(run()))
+        if i + 1 < n_runs and not _extra_run_fits_budget(
+            time.perf_counter() - t0
+        ):
+            break
+    return max(rates), _variance_fields(rates)
+
+
 def _bench_gmm(k: int = 32) -> dict:
     """Config 3: GaussianMixture EM-iteration throughput."""
     import jax
@@ -381,10 +434,13 @@ def _bench_gmm(k: int = 32) -> dict:
     # device EM loop — a different value compiles a different executable,
     # which would land in the timed region); also warms the init path
     est.fit(ds, mesh=mesh)
-    t0 = time.perf_counter()
-    model = est.fit(ds, mesh=mesh)
-    dt = time.perf_counter() - t0
-    per_chip = n * model.n_iter / dt / n_chips
+
+    def timed():
+        t0 = time.perf_counter()
+        model = est.fit(ds, mesh=mesh)
+        return n * model.n_iter / (time.perf_counter() - t0) / n_chips
+
+    per_chip, var = _best_of(timed)
 
     cpu_n = min(n, 100_000)
     cpu_thr = _cpu_gmm_throughput(x[:cpu_n], k)
@@ -394,6 +450,7 @@ def _bench_gmm(k: int = 32) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
+        **var,
     }
 
 
@@ -425,10 +482,13 @@ def _bench_bisecting(k: int = 8) -> dict:
     # level width L = next_pow2(k//2), so a different k compiles a
     # different program and the timed fit would pay the compile.
     est.fit(ds, mesh=mesh)
-    t0 = time.perf_counter()
-    est.fit(ds, mesh=mesh)
-    dt = time.perf_counter() - t0
-    per_chip = n / dt / n_chips
+
+    def timed():
+        t0 = time.perf_counter()
+        est.fit(ds, mesh=mesh)
+        return n / (time.perf_counter() - t0) / n_chips
+
+    per_chip, var = _best_of(timed)
 
     # Charge the CPU proxy the level-order pass count the TPU fit actually
     # runs: ⌈log₂k⌉ levels × max_iter 2-means Lloyd passes over the full
@@ -443,6 +503,7 @@ def _bench_bisecting(k: int = 8) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
+        **var,
     }
 
 
@@ -552,9 +613,13 @@ def _bench_random_forest(T: int = 20, depth: int = 5) -> dict:
     else:
         fit = lambda: est.fit(ds, mesh=mesh)
     fit()  # warm-up: per-level executables
-    t0 = time.perf_counter()
-    fit()
-    per_chip = n / (time.perf_counter() - t0) / n_chips
+
+    def timed():
+        t0 = time.perf_counter()
+        fit()
+        return n / (time.perf_counter() - t0) / n_chips
+
+    per_chip, var = _best_of(timed)
 
     cpu_n = min(n, 100_000)
     cpu_thr = _cpu_rf_throughput(
@@ -569,6 +634,7 @@ def _bench_random_forest(T: int = 20, depth: int = 5) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
+        **var,
     }
 
 
@@ -600,10 +666,14 @@ def _bench_streaming(k: int = 16) -> dict:
     # call (the scan is specialized on B; a different B recompiles)
     sk.update_many(batches[2:], mesh=mesh)
     jax.block_until_ready(sk._centers)
-    t0 = time.perf_counter()
-    sk.update_many(batches[2:], mesh=mesh)
-    jax.block_until_ready(sk._centers)
-    drain_per_chip = batch * 10 / (time.perf_counter() - t0) / n_chips
+
+    def timed():
+        t0 = time.perf_counter()
+        sk.update_many(batches[2:], mesh=mesh)
+        jax.block_until_ready(sk._centers)
+        return batch * 10 / (time.perf_counter() - t0) / n_chips
+
+    drain_per_chip, var = _best_of(timed)
 
     t0 = time.perf_counter()
     for b in batches[2:]:
@@ -619,6 +689,7 @@ def _bench_streaming(k: int = 16) -> dict:
         "vs_baseline": round(drain_per_chip / cpu_thr, 2),
         "per_update_rps": round(upd_per_chip, 1),
         "platform": platform,
+        **var,
     }
 
 
@@ -658,9 +729,13 @@ def _bench_naive_bayes(k: int = 8, d: int = 32) -> dict:
 
     est = NaiveBayes(model_type="multinomial")
     est.fit(ds, mesh=mesh)  # warm-up: compile the stats contraction
-    t0 = time.perf_counter()
-    est.fit(ds, mesh=mesh)
-    per_chip = n / (time.perf_counter() - t0) / n_chips
+
+    def timed():
+        t0 = time.perf_counter()
+        est.fit(ds, mesh=mesh)
+        return n / (time.perf_counter() - t0) / n_chips
+
+    per_chip, var = _best_of(timed)
 
     cpu_n = min(n, 2_000_000)
     cpu_thr = _cpu_nb_throughput(x[:cpu_n], y[:cpu_n], k)
@@ -670,6 +745,7 @@ def _bench_naive_bayes(k: int = 8, d: int = 32) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
+        **var,
     }
 
 
@@ -695,9 +771,13 @@ def _bench_gbt(M: int = 20, depth: int = 3) -> dict:
 
     est = GBTRegressor(max_iter=M, max_depth=depth, seed=0)
     est.fit(ds, mesh=mesh)  # warm-up: per-level executables
-    t0 = time.perf_counter()
-    est.fit(ds, mesh=mesh)
-    per_chip = n / (time.perf_counter() - t0) / n_chips
+
+    def timed():
+        t0 = time.perf_counter()
+        est.fit(ds, mesh=mesh)
+        return n / (time.perf_counter() - t0) / n_chips
+
+    per_chip, var = _best_of(timed)
 
     # CPU proxy: M histogram trees over the same rows (the boosting rounds'
     # tree-build cost; residual updates are excluded — conservative).
@@ -713,6 +793,7 @@ def _bench_gbt(M: int = 20, depth: int = 3) -> dict:
         "value": round(per_chip, 1),
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
+        **var,
     }
 
 
@@ -771,10 +852,10 @@ def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
                 c, _, _, _ = step(ds.x, ds.w, c, c_valid)
             jax.block_until_ready(c)
             rates.append(n * iters / (time.perf_counter() - t0))
-        return float(np.median(rates))
+        return float(np.median(rates)), rates
 
-    xla = rate(_make_train_step(mesh, n_loc, k, d, 32768))
-    fused = rate(_make_train_step_fused(mesh, k, False))
+    xla, xla_w = rate(_make_train_step(mesh, n_loc, k, d, 32768))
+    fused, fused_w = rate(_make_train_step_fused(mesh, k, False))
     return {
         "metric": (
             f"Pallas fused-Lloyd records/sec/chip (A/B vs XLA scan, "
@@ -785,6 +866,7 @@ def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
         "vs_baseline": round(fused / xla, 3),
         "xla_scan_rps_per_chip": round(xla / n_chips, 1),
         "platform": platform,
+        **_variance_fields([r / n_chips for r in fused_w]),
     }
 
 
@@ -808,6 +890,16 @@ _CONFIG_TIMEOUT = {"kmeans256": 600}
 _DEFAULT_CONFIG_TIMEOUT = 420
 
 
+#: transcript of every probe attempt this run — emitted in bench_meta so
+#: the artifact itself proves how many spaced attempts were made and what
+#: each saw (VERDICT r4 #1: a failed round must leave probe evidence)
+_PROBE_LOG: list[dict] = []
+
+#: stepwise escalation for re-probe timeouts: a flaky tunnel sometimes
+#: answers slowly rather than never, so later attempts wait longer
+_PROBE_STEPS = (120.0, 300.0, 600.0)
+
+
 def _probe_backend(timeout_s: float) -> tuple[str | None, str]:
     """Ask a THROWAWAY subprocess to initialize the default (TPU) backend.
 
@@ -815,8 +907,11 @@ def _probe_backend(timeout_s: float) -> tuple[str | None, str]:
     when the TPU tunnel is down, and it ignores ``JAX_PLATFORMS`` env (the
     image's sitecustomize imports jax before user code runs).  A bounded
     subprocess probe converts that hang into a timeout the parent survives.
-    Returns (platform | None, reason)."""
+    Every attempt (timeout, outcome, output tail) is appended to
+    ``_PROBE_LOG``.  Returns (platform | None, reason)."""
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    t0 = time.perf_counter()
+    rec = {"t_offset_s": round(time.monotonic() - _T_MONO0, 1), "timeout_s": timeout_s}
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
@@ -825,14 +920,109 @@ def _probe_backend(timeout_s: float) -> tuple[str | None, str]:
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
+        rec["outcome"] = f"timed out after {timeout_s:.0f}s (tunnel hang)"
+        _PROBE_LOG.append(rec)
         return None, f"backend probe timed out after {timeout_s:.0f}s"
     except OSError as e:
+        rec["outcome"] = f"spawn failed: {e}"
+        _PROBE_LOG.append(rec)
         return None, f"backend probe failed to spawn: {e}"
+    rec["elapsed_s"] = round(time.perf_counter() - t0, 1)
     for line in r.stdout.splitlines():
         if line.startswith("PLATFORM="):
+            rec["outcome"] = f"ok: {line.split('=', 1)[1]}"
+            _PROBE_LOG.append(rec)
             return line.split("=", 1)[1], "ok"
     tail = (r.stderr or r.stdout).strip().splitlines()
+    rec["outcome"] = f"rc={r.returncode}: {tail[-1][-200:] if tail else 'no output'}"
+    _PROBE_LOG.append(rec)
     return None, f"backend probe rc={r.returncode}: {tail[-1] if tail else 'no output'}"
+
+
+#: monotonic zero for probe-attempt offsets
+_T_MONO0 = time.monotonic()
+
+
+def _spark_denominator_attempt(budget_s: float = 600.0) -> dict:
+    """Try to obtain the REAL Spark-CPU denominator BASELINE.md demands
+    ("must be measured, not inherited") and record the attempt either way.
+
+    The honest outcome in this image is expected to be "unavailable":
+    the environment bakes in no JVM and no pyspark wheel (and has zero
+    egress to fetch one), so the NumPy/BLAS proxy — documented at the top
+    of this file as *overstating* Spark (no JVM/Py4J/shuffle overhead),
+    hence understating ``vs_baseline`` — remains the denominator.  This
+    function turns that caveat from a docstring into permanent artifact
+    evidence: the bench JSON shows exactly what was tried and what the
+    image answered."""
+    rec: dict = {}
+    java = shutil.which("java")
+    rec["java"] = java or "not on PATH (no JVM in image)"
+    try:
+        import pyspark  # noqa: F401
+
+        rec["pyspark"] = pyspark.__version__
+    except ImportError as e:
+        rec["pyspark"] = f"import failed: {e}"
+    if java and "import failed" not in str(rec["pyspark"]) and budget_s < 60:
+        rec["run"] = (
+            f"skipped: only {budget_s:.0f}s of deadline left for a JVM "
+            "start + 200k-row fit"
+        )
+    elif java and "import failed" not in str(rec["pyspark"]):
+        code = (
+            "from pyspark.sql import SparkSession\n"
+            "import numpy, time\n"
+            "s = SparkSession.builder.master('local[*]').getOrCreate()\n"
+            "from pyspark.ml.clustering import KMeans\n"
+            "from pyspark.ml.linalg import Vectors\n"
+            "rows = [(Vectors.dense(numpy.random.rand(8).tolist()),) for _ in range(200000)]\n"
+            "df = s.createDataFrame(rows, ['features'])\n"
+            "t0 = time.time(); KMeans(k=8, maxIter=10).fit(df)\n"
+            "print('SPARK_RPS=' + str(200000*10/(time.time()-t0)))\n"
+        )
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True,
+                timeout=min(600.0, budget_s),
+            )
+            for line in r.stdout.splitlines():
+                if line.startswith("SPARK_RPS="):
+                    rec["spark_local_kmeans8_rps"] = float(line.split("=", 1)[1])
+            if "spark_local_kmeans8_rps" not in rec:
+                rec["run"] = f"rc={r.returncode}: {(r.stderr or '')[-200:]}"
+        except (subprocess.TimeoutExpired, OSError) as e:
+            rec["run"] = f"{type(e).__name__}: {e}"
+    else:
+        rec["outcome"] = (
+            "real pyspark local[*] run IMPOSSIBLE in this image; "
+            "vs_baseline stays on the NumPy/BLAS proxy (conservative: "
+            "the proxy has no JVM/Py4J/shuffle overhead)"
+        )
+    return rec
+
+
+def _session_probe_history() -> list[dict]:
+    """Round-long probe attempts persisted by the build session (the agent
+    probes the tunnel at spaced intervals between bench runs and appends
+    to ``tools/probe_r05.jsonl``); folded into bench_meta so the artifact
+    carries the WHOLE round's evidence, not just this invocation's."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "probe_r05.jsonl")
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out[-50:]
 
 
 #: row count for the salvage retry after a signal-killed child — small
@@ -899,6 +1089,7 @@ def _run_config_watchdogged(name: str, env: dict, timeout_s: float) -> list[dict
 
 def _child_main(name: str) -> None:
     """BENCH_CHILD mode: run exactly one config in-process."""
+    _CHILD_T0.append(time.perf_counter())
     _apply_forced_platform()  # before any framework import inits a backend
     try:
         print(json.dumps(CONFIGS[name]()), flush=True)
@@ -985,9 +1176,11 @@ def main() -> None:
     def run_one(key: str, cenv: dict) -> list[dict]:
         cenv = dict(cenv)
         cenv["BENCH_CHILD"] = key
-        return _run_config_watchdogged(
-            key, cenv, min(budget_for(key), max(remaining(), 30))
-        )
+        budget = min(budget_for(key), max(remaining(), 30))
+        # tell the child its watchdog budget so _best_of can skip extra
+        # variance runs rather than blow it
+        cenv["BENCH_CHILD_BUDGET"] = str(budget)
+        return _run_config_watchdogged(key, cenv, budget)
 
     def emit(rows: list[dict]) -> None:
         for obj in rows:
@@ -1008,9 +1201,14 @@ def main() -> None:
         platform, reason = _probe_backend(probe_timeout)
         if platform is not None:
             # TPU (or whatever the default backend is) answered: run the
-            # sweep on it, re-probing after any failed config so a
+            # sweep on it IN PRIORITY ORDER — the north-star row and the
+            # A/B verdicts land before anything else can eat the budget
+            # (VERDICT r4 #1) — re-probing after any failed config so a
             # mid-sweep tunnel drop falls back instead of hanging through
             # every remaining watchdog budget.
+            names = [k for k in _TPU_PRIORITY if k in names] + [
+                k for k in names if k not in _TPU_PRIORITY
+            ]
             tpu_ok = True
             for key in names:
                 if remaining() < 30:
@@ -1053,8 +1251,15 @@ def main() -> None:
                 note(f"cpu-fallback {key} done")
             platform = "cpu (fallback)"
             retry = [k for k in _TPU_PRIORITY if k in names]
+            attempt = 0
             while retry and remaining() > reprobe_timeout + 60:
-                p, _ = _probe_backend(min(reprobe_timeout, remaining()))
+                # stepwise escalation (120 → 300 → 600s): a flaky tunnel
+                # sometimes answers slowly rather than never, so spend
+                # longer per attempt as the CPU sweep's results are
+                # already banked and the deadline allows
+                step = _PROBE_STEPS[min(attempt, len(_PROBE_STEPS) - 1)]
+                attempt += 1
+                p, _ = _probe_backend(min(step, remaining() - 60))
                 if p is None:
                     time.sleep(min(20.0, max(remaining() - 60, 0)))
                     continue
@@ -1075,6 +1280,11 @@ def main() -> None:
                 "metric": "bench_meta",
                 "platform": platform,
                 "probe": reason,
+                "probe_attempts": _PROBE_LOG,
+                "session_probe_history": _session_probe_history(),
+                "spark_denominator": _spark_denominator_attempt(
+                    max(remaining(), 0.0)
+                ),
                 "elapsed_s": round(time.perf_counter() - t_start, 1),
             }
         ),

@@ -859,7 +859,9 @@ def execute(query: str, resolve_table) -> Table:
             if not m:
                 raise ValueError(f"SQL: {name!r} is not an aggregate")
             agg, c = m.groups()
-            return float(len(t)) if c == "*" else _aggregate(getcol(c), agg)
+            # count(*) stays integer so its dtype matches the bare
+            # projection path; arithmetic contexts promote as needed
+            return len(t) if c == "*" else _aggregate(getcol(c), agg)
 
         out_cols: dict[str, Any] = {}
         for it in items:

@@ -158,8 +158,10 @@ class MultilabelClassificationEvaluator:
         n = len(predictions)
         if n == 0:
             raise ValueError("MultilabelClassificationEvaluator on an empty dataset")
-        pred = _pad_sets(predictions)
-        truth = _pad_sets(labels)
+        # Spark's MultilabelMetrics operates on *sets*; dedup each row so
+        # duplicate ids can't inflate tp / |pred| / |truth|.
+        pred = _pad_sets([set(r) for r in predictions])
+        truth = _pad_sets([set(r) for r in labels])
         np_pred = (pred >= 0).sum(axis=1)
         np_true = (truth >= 0).sum(axis=1)
         tp = (_membership(pred, truth)).sum(axis=1)          # |pred ∩ truth|
@@ -169,8 +171,11 @@ class MultilabelClassificationEvaluator:
         if name == "subsetAccuracy":
             return float((tp == np.maximum(np_pred, np_true)).mean())
         if name == "accuracy":
+            # Spark computes intersect/union per row; an empty prediction AND
+            # empty truth row is 0/0 = NaN there, and the NaN propagates
+            # through the mean — match that rather than scoring such rows 1.0.
             return float(
-                np.where(union > 0, tp / np.maximum(union, 1), 1.0).mean()
+                np.where(union > 0, tp / np.maximum(union, 1), np.nan).mean()
             )
         if name == "hammingLoss":
             # Spark: Σ(|pred|+|truth|−2·tp) / (n · numLabels) with

@@ -63,7 +63,13 @@ def _link_fns(link: str, link_power: float = 0.0):
             return _link_fns("inverse")
         return (
             lambda mu: mu ** lp,
-            lambda eta: jnp.maximum(eta, 1e-12) ** (1.0 / lp),
+            # η < 0 is outside the power link's domain for fractional
+            # exponents; surface it as NaN so IRLS divergence is visible
+            # (the named links do the same via log/inverse blowing up)
+            # instead of clamping to an extreme μ.  η = 0 stays in-domain:
+            # μ = 0^(1/lp) (0 for lp > 0, inf for lp < 0 — Spark's
+            # math.pow semantics).
+            lambda eta: jnp.where(eta >= 0, eta, jnp.nan) ** (1.0 / lp),
             lambda mu: lp * mu ** (lp - 1.0),
         )
     if link == "identity":

@@ -40,6 +40,20 @@ def _power_iterate(w_norm, v0, max_iter: int):
     return lax.fori_loop(0, max_iter, body, v0)
 
 
+def _build_affinity(src, dst, w, n: int) -> np.ndarray:
+    """Symmetrized dense (n, n) affinity from edge triplets.
+
+    Spark requires symmetric affinities; either orientation is accepted
+    and duplicates fold additively.  Self-loops (src == dst) are folded
+    exactly once — symmetrization must not double the diagonal.
+    """
+    a = np.zeros((n, n), np.float32)
+    np.add.at(a, (src, dst), w)
+    off_diag = src != dst
+    np.add.at(a, (dst[off_diag], src[off_diag]), w[off_diag])
+    return a
+
+
 @dataclass(frozen=True)
 class PowerIterationClustering(Estimator):
     """Spark defaults: k 2, maxIter 20, initMode "random" (or "degree").
@@ -81,11 +95,7 @@ class PowerIterationClustering(Estimator):
                 f"{n} nodes exceeds the dense-affinity budget "
                 f"({_MAX_NODES}); PIC here materializes (n, n) in HBM"
             )
-        a = np.zeros((n, n), np.float32)
-        # symmetrize (Spark requires symmetric affinities; accept either
-        # orientation and fold duplicates additively)
-        np.add.at(a, (src, dst), w)
-        np.add.at(a, (dst, src), w)
+        a = _build_affinity(src, dst, w, n)
         deg = a.sum(axis=1)
         if (deg == 0).any():
             isolated = int(np.flatnonzero(deg == 0)[0])

@@ -109,6 +109,18 @@ class TestPIC:
         ).assign_clusters(src, dst, w, mesh=mesh8)
         assert set(np.unique(a)) == {0, 1}
 
+    def test_self_loops_fold_once(self):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.pic import (
+            _build_affinity,
+        )
+
+        src = np.array([0, 0, 1])
+        dst = np.array([1, 0, 1])          # two self-loops, one cross edge
+        w = np.array([2.0, 3.0, 5.0], np.float32)
+        a = _build_affinity(src, dst, w, 2)
+        # symmetrization must not double the diagonal
+        np.testing.assert_allclose(a, [[3.0, 2.0], [2.0, 5.0]])
+
     def test_validation(self, rng, mesh8):
         with pytest.raises(ValueError, match="empty"):
             ht.PowerIterationClustering().assign_clusters(

@@ -120,6 +120,25 @@ class TestMultilabelEvaluator:
         with pytest.raises(ValueError, match="empty"):
             ht.MultilabelClassificationEvaluator().evaluate([], [])
 
+    def test_duplicate_ids_are_set_semantics(self):
+        # Spark's MultilabelMetrics operates on sets — duplicated ids in a
+        # row must not inflate tp/|pred|/|truth|
+        dup = ht.MultilabelClassificationEvaluator("microPrecision").evaluate(
+            [[1.0, 1.0, 2.0]], [[1.0, 1.0]]
+        )
+        clean = ht.MultilabelClassificationEvaluator("microPrecision").evaluate(
+            [[1.0, 2.0]], [[1.0]]
+        )
+        np.testing.assert_allclose(dup, clean, rtol=1e-12)
+
+    def test_accuracy_empty_vs_empty_is_nan(self):
+        # Spark: intersect/union on an empty/empty row is 0/0 → NaN, which
+        # propagates through the mean
+        out = ht.MultilabelClassificationEvaluator("accuracy").evaluate(
+            [[], [1.0]], [[], [1.0]]
+        )
+        assert np.isnan(out)
+
 
 def test_atk_short_prediction_lists_use_k_denominators():
     """Review regression: a row predicting fewer than k items must not

@@ -517,6 +517,15 @@ def test_arithmetic_over_aggregates(session):
     np.testing.assert_allclose(whole.column("m"), full.column("a"), rtol=1e-12)
 
 
+def test_count_star_dtype_consistent_across_spellings(session):
+    # count(*) must be integer however it is spelled — bare projection and
+    # expression-atom paths used to disagree (int64 vs float64)
+    out = session.sql("SELECT COUNT(*) AS a, COUNT(*) + 0 AS b FROM events")
+    assert np.issubdtype(out.column("a").dtype, np.integer)
+    assert np.issubdtype(out.column("b").dtype, np.integer)
+    assert out.column("a")[0] == out.column("b")[0]
+
+
 def test_division_by_zero_is_null(session):
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import execute
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
