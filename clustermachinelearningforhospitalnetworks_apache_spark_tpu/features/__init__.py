@@ -24,6 +24,12 @@ from .selector import (
     VectorIndexer,
     VectorIndexerModel,
 )
+from .lsh import (
+    BucketedRandomProjectionLSH,
+    BucketedRandomProjectionLSHModel,
+    MinHashLSH,
+    MinHashLSHModel,
+)
 from .sql_transformer import SQLTransformer
 from .text import (
     CountVectorizer,
@@ -73,6 +79,10 @@ __all__ = [
     "RobustScalerModel",
     "VarianceThresholdSelector",
     "VarianceThresholdSelectorModel",
+    "BucketedRandomProjectionLSH",
+    "BucketedRandomProjectionLSHModel",
+    "MinHashLSH",
+    "MinHashLSHModel",
     "SQLTransformer",
     "CountVectorizer",
     "CountVectorizerModel",

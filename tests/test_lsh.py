@@ -1,0 +1,230 @@
+"""LSH families (BucketedRandomProjectionLSH / MinHashLSH) vs brute force.
+
+Verification model: candidate generation is approximate by design, so the
+contract tested is (a) every RETURNED pair/neighbor is exactly right
+(exact re-ranking: true distance, correct ordering, threshold respected),
+(b) with enough hash tables the families find what they should (recall on
+planted structure), (c) hash identity: same-bucket probability behaves
+like the family's collision probability (clustered data collides, far
+data doesn't), (d) persistence round-trips.
+"""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _clustered(rng, n_per=40, centers=((0.0, 0.0, 0.0), (8.0, 8.0, 8.0))):
+    xs = [rng.normal(c, 0.4, size=(n_per, len(c))) for c in centers]
+    return np.concatenate(xs).astype(np.float64)
+
+
+class TestBucketedRandomProjectionLSH:
+    def test_transform_shape_and_determinism(self, rng):
+        x = _clustered(rng)
+        m = ht.BucketedRandomProjectionLSH(
+            bucket_length=2.0, num_hash_tables=3, seed=5
+        ).fit(x)
+        h = m.transform(x)
+        assert h.shape == (len(x), 3) and h.dtype == np.int64
+        np.testing.assert_array_equal(h, m.transform(x))
+        # same seed → same family
+        h2 = ht.BucketedRandomProjectionLSH(
+            bucket_length=2.0, num_hash_tables=3, seed=5
+        ).fit(x).transform(x)
+        np.testing.assert_array_equal(h, h2)
+
+    def test_near_points_collide_far_points_dont(self, rng):
+        x = _clustered(rng)
+        m = ht.BucketedRandomProjectionLSH(
+            bucket_length=4.0, num_hash_tables=6, seed=0
+        ).fit(x)
+        h = m.transform(x)
+        # collision probability is monotone in distance (the family's
+        # defining property): averaged over pairs, same-cluster rows
+        # share far more buckets than cross-cluster rows (≈ 13.8 apart
+        # vs bucket 4).  Averaged, because any single pair can straddle
+        # a bucket boundary in any table.
+        same = np.mean([(h[i] == h[j]).mean() for i in range(10) for j in range(10, 20)])
+        cross = np.mean([(h[i] == h[-1 - j]).mean() for i in range(10) for j in range(10)])
+        assert same > cross + 0.2
+
+    def test_approx_nearest_neighbors_match_brute_force(self, rng):
+        x = _clustered(rng, n_per=60)
+        key = np.array([0.2, -0.1, 0.1])
+        m = ht.BucketedRandomProjectionLSH(
+            bucket_length=3.0, num_hash_tables=8, seed=2
+        ).fit(x)
+        idx, dist = m.approx_nearest_neighbors(x, key, 5)
+        true = np.sqrt(((x - key) ** 2).sum(axis=1))
+        # returned distances are EXACT and ascending
+        np.testing.assert_allclose(dist, true[idx], rtol=1e-12)
+        assert (np.diff(dist) >= 0).all()
+        # with 8 tables on this scale, the top-5 is the true top-5
+        np.testing.assert_array_equal(np.sort(idx), np.sort(np.argsort(true)[:5]))
+
+    def test_approx_similarity_join_vs_brute_force(self, rng):
+        a = _clustered(rng, n_per=30)
+        b = a + rng.normal(0, 0.05, size=a.shape)   # jittered copy
+        m = ht.BucketedRandomProjectionLSH(
+            bucket_length=3.0, num_hash_tables=8, seed=3
+        ).fit(a)
+        ia, ib, d = m.approx_similarity_join(a, b, threshold=0.5)
+        # every returned pair is exactly verified
+        true = np.sqrt(((a[ia] - b[ib]) ** 2).sum(axis=1))
+        np.testing.assert_allclose(d, true, rtol=1e-12)
+        assert (d <= 0.5).all()
+        # the diagonal (each row vs its jittered copy) must be found
+        diag = set(zip(ia.tolist(), ib.tolist()))
+        found = sum((i, i) in diag for i in range(len(a)))
+        assert found >= 0.95 * len(a)
+        # no pair across the two distant clusters sneaks in
+        assert not ((ia < 30) & (ib >= 30)).any()
+
+    def test_validation(self, rng):
+        x = _clustered(rng)
+        with pytest.raises(ValueError, match="bucket_length"):
+            ht.BucketedRandomProjectionLSH().fit(x)
+        with pytest.raises(ValueError, match="num_hash_tables"):
+            ht.BucketedRandomProjectionLSH(
+                bucket_length=1.0, num_hash_tables=0
+            ).fit(x)
+        m = ht.BucketedRandomProjectionLSH(bucket_length=1.0).fit(x)
+        with pytest.raises(ValueError, match="features"):
+            m.approx_nearest_neighbors(x, np.zeros(7), 3)
+        with pytest.raises(ValueError, match="k"):
+            m.approx_nearest_neighbors(x, np.zeros(3), 0)
+        with pytest.raises(ValueError, match="threshold"):
+            m.approx_similarity_join(x, x, -1.0)
+
+    def test_persistence_round_trip(self, rng, tmp_path):
+        x = _clustered(rng)
+        m = ht.BucketedRandomProjectionLSH(
+            bucket_length=2.0, num_hash_tables=4, seed=9
+        ).fit(x)
+        p = str(tmp_path / "brp")
+        m.save(p)
+        m2 = ht.load_model(p)
+        np.testing.assert_array_equal(m.transform(x), m2.transform(x))
+
+
+def _binary(rng, n=60, d=40, density=0.25):
+    return (rng.uniform(size=(n, d)) < density).astype(np.float64)
+
+
+class TestMinHashLSH:
+    def test_hash_values_match_spark_family(self, rng):
+        # h = min over non-zero j of ((1+j)·a + b) mod 2038074743 —
+        # recompute by hand against the model's coefficients
+        x = _binary(rng, n=10)
+        m = ht.MinHashLSH(num_hash_tables=3, seed=1).fit(x)
+        h = m.transform(x)
+        prime = 2038074743
+        for i in range(len(x)):
+            nz = np.flatnonzero(x[i])
+            for t in range(3):
+                vals = ((1 + nz) * int(m.coef_a[t]) + int(m.coef_b[t])) % prime
+                assert h[i, t] == vals.min()
+
+    def test_identical_sets_always_collide(self, rng):
+        x = _binary(rng)
+        x[1] = x[0]
+        m = ht.MinHashLSH(num_hash_tables=5, seed=0).fit(x)
+        h = m.transform(x)
+        np.testing.assert_array_equal(h[0], h[1])
+
+    def test_approx_nearest_neighbors_jaccard(self, rng):
+        x = _binary(rng, n=80, d=50)
+        key = x[7].copy()
+        m = ht.MinHashLSH(num_hash_tables=10, seed=4).fit(x)
+        idx, dist = m.approx_nearest_neighbors(x, key, 3)
+        assert idx[0] == 7 and dist[0] == 0.0
+        # distances are the exact Jaccard distances
+        a = x[idx] > 0
+        b = key[None, :] > 0
+        true = 1.0 - (a & b).sum(axis=1) / (a | b).sum(axis=1)
+        np.testing.assert_allclose(dist, true, rtol=1e-12)
+
+    def test_approx_similarity_join_threshold(self, rng):
+        a = _binary(rng, n=50, d=60)
+        # b: copies of a with a few bits flipped → low Jaccard distance
+        b = a.copy()
+        flips = rng.integers(0, 60, size=50)
+        b[np.arange(50), flips] = 1 - b[np.arange(50), flips]
+        m = ht.MinHashLSH(num_hash_tables=12, seed=6).fit(a)
+        ia, ib, d = m.approx_similarity_join(a, b, threshold=0.3)
+        assert (d <= 0.3).all()
+        diag = set(zip(ia.tolist(), ib.tolist()))
+        found = sum((i, i) in diag for i in range(50))
+        assert found >= 45     # near-duplicates must be found
+        ja = a[ia] > 0
+        jb = b[ib] > 0
+        true = 1.0 - (ja & jb).sum(axis=1) / (ja | jb).sum(axis=1)
+        np.testing.assert_allclose(d, true, rtol=1e-12)
+
+    def test_validation(self, rng):
+        x = _binary(rng)
+        with pytest.raises(ValueError, match="num_hash_tables"):
+            ht.MinHashLSH(num_hash_tables=0).fit(x)
+        m = ht.MinHashLSH(num_hash_tables=2, seed=0).fit(x)
+        with pytest.raises(ValueError, match="non-negative"):
+            m.transform(-x)
+        empty = x.copy()
+        empty[3] = 0.0
+        with pytest.raises(ValueError, match="non-zero"):
+            m.transform(empty)
+
+    def test_persistence_round_trip(self, rng, tmp_path):
+        x = _binary(rng)
+        m = ht.MinHashLSH(num_hash_tables=4, seed=2).fit(x)
+        p = str(tmp_path / "minhash")
+        m.save(p)
+        m2 = ht.load_model(p)
+        np.testing.assert_array_equal(m.transform(x), m2.transform(x))
+
+
+def test_assembled_table_inputs():
+    """LSH transform on an AssembledTable APPENDS hash columns and keeps
+    the feature matrix intact (Spark adds outputCol, leaves inputCol) —
+    an LSH stage mid-Pipeline must not replace features with bucket
+    ids."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import Table
+
+    gen = np.random.default_rng(0)
+    t = Table.from_dict(
+        {"a": gen.normal(size=30), "b": gen.normal(size=30), "c": gen.normal(size=30)}
+    )
+    at = ht.VectorAssembler(["a", "b", "c"]).transform(t)
+    m = ht.BucketedRandomProjectionLSH(bucket_length=1.0, num_hash_tables=2).fit(at)
+    out = m.transform(at)
+    np.testing.assert_array_equal(
+        np.asarray(out.features), np.asarray(at.features)
+    )
+    np.testing.assert_array_equal(
+        np.column_stack([out.table.column("hashes_0"), out.table.column("hashes_1")]),
+        m.hash_matrix(at),
+    )
+    assert m.hash_matrix(at).shape == (30, 2)
+    idx, dist = m.approx_nearest_neighbors(at, np.zeros(3), 4)
+    assert len(idx) <= 4
+
+
+def test_brp_large_magnitude_buckets_stay_exact():
+    """Review regression: f32 hashing quantized bucket ids for features
+    of magnitude ~1e8 (ULP ≈ 8 > bucket_length) — hashing must stay in
+    double like Spark's."""
+    gen = np.random.default_rng(1)
+    base = 1.0e8
+    x = base + gen.uniform(0, 100, size=(50, 4))
+    m = ht.BucketedRandomProjectionLSH(bucket_length=1.0, num_hash_tables=4).fit(x)
+    h = m.hash_matrix(x)
+    expect = np.floor(x @ m.projections.T / 1.0).astype(np.int64)
+    np.testing.assert_array_equal(h, expect)
+    # distinct buckets survive: rows spread ~100/|v| apart in projection
+    assert len(np.unique(h[:, 0])) > 10
