@@ -10,20 +10,30 @@ Spark alternates distributed least-squares solves, shipping factor blocks
 between executors per iteration.  The TPU-native shape inverts that into
 dense batched linear algebra on static shapes:
 
-- Ratings are grouped per user (then per item) into a PADDED index matrix
-  ``(U, C)`` of rated-item ids plus a mask — the same weighted-padding
-  rule every estimator here uses for rows.  C is the max per-user count;
-  padding entries carry weight 0.
-- One half-step gathers the opposite factors ``Y[idx] -> (U, C, f)``,
+- Ratings are grouped per user (then per item) into COUNT-CAPPED padded
+  buckets (:func:`_group_ratings_bucketed`): rows are binned by rating
+  count into power-of-4 caps, each bucket a dense ``(U_b, C_b)`` index/
+  rating/mask block.  Total padded cells stay ≤ 4× nnz, so one
+  power-law user cannot inflate the whole gather (a single global
+  ``(U, C, f)`` with C = max count would be ~10³× too big on skewed
+  data).
+- One half-step gathers the opposite factors ``Y[idx] -> (U_b, C_b, f)``,
   builds every user's normal equations with two batched einsums
-  (``A_u = Σ m·y yᵀ + λ n_u I``, ``b_u = Σ m r y``) and solves all users
-  at once with a batched Cholesky solve (``jnp.linalg.solve`` on
-  ``(U, f, f)``) — MXU matmuls + a vectorized small solve, no per-user
-  Python.
+  (``A_u = Σ m·y yᵀ + λ n_u I``, ``b_u = Σ m r y``) and solves each
+  bucket's users at once with a batched Cholesky solve
+  (``jnp.linalg.solve`` on ``(U_b, f, f)``) — MXU matmuls + a vectorized
+  small solve, no per-user Python.
 - Implicit mode follows Hu-Koren: ``A_u = YᵀY + Σ α r yᵀy + λI``,
   ``b_u = Σ (1 + α r) y`` over OBSERVED items only, with the dense
   ``YᵀY`` term computed once per half-step (the classic trick that keeps
   the unobserved-pair sum out of the loop).
+- With a ``mesh``, each bucket's rows are SHARDED across the ``data``
+  axis (every device solves its slice of the normal equations — the
+  analogue of Spark's in-link blocks on executors) against replicated
+  opposite factors; the per-half-step collective is the all-gather of
+  solved factors back to replicated form, emitted by XLA on ICI.
+  Sharded and single-device fits produce identical factors (same math,
+  same shapes — only the row layout differs).
 
 Factors stay device-resident across iterations; the index/rating
 matrices are built once on host.  ``predict``/``recommend_for_all_users``
@@ -45,20 +55,81 @@ from .base import Estimator, Model
 
 
 def _group_ratings(ids: np.ndarray, other: np.ndarray, ratings: np.ndarray, n: int):
-    """Triplets grouped by ``ids`` → padded (n, C) index/rating/mask."""
-    order = np.argsort(ids, kind="stable")
-    sid = ids[order]
-    counts = np.bincount(sid, minlength=n)
+    """Single padded (n, C) layout with C = the max count — the ORACLE
+    layout (tests drive the half-step solvers with it directly); the
+    production fit uses :func:`_group_ratings_bucketed`, of which this is
+    the one-bucket-per-row scatter."""
+    counts = np.bincount(ids, minlength=n) if len(ids) else np.zeros(n, np.int64)
     c = max(int(counts.max()), 1) if len(ids) else 1
     idx = np.zeros((n, c), np.int32)
     val = np.zeros((n, c), np.float32)
     msk = np.zeros((n, c), np.float32)
-    starts = np.r_[0, np.cumsum(counts)[:-1]]
-    pos = np.arange(len(ids)) - starts[sid]
-    idx[sid, pos] = other[order]
-    val[sid, pos] = ratings[order]
-    msk[sid, pos] = 1.0
+    for rows, bidx, bval, bmsk, _ in _group_ratings_bucketed(ids, other, ratings, n):
+        w = bidx.shape[1]
+        idx[rows, :w] = bidx
+        val[rows, :w] = bval
+        msk[rows, :w] = bmsk
     return idx, val, msk, counts.astype(np.float32)
+
+
+#: smallest bucket cap and cap growth factor for the count-capped padding
+#: (powers of _BUCKET_FACTOR from _BUCKET_BASE): every row's padded width
+#: is < _BUCKET_FACTOR × its true count (or _BUCKET_BASE for tiny rows),
+#: so total padded cells are bounded by max(_BUCKET_BASE, _BUCKET_FACTOR)
+#: × nnz — one power-law user can no longer inflate every row to its C.
+_BUCKET_BASE = 4
+_BUCKET_FACTOR = 4
+
+
+def _bucket_caps(max_count: int) -> list[int]:
+    caps, c = [], _BUCKET_BASE
+    while c < max_count:
+        caps.append(c)
+        c *= _BUCKET_FACTOR
+    caps.append(max(max_count, _BUCKET_BASE))
+    return caps
+
+
+def _group_ratings_bucketed(
+    ids: np.ndarray, other: np.ndarray, ratings: np.ndarray, n: int
+):
+    """Triplets grouped by ``ids`` → COUNT-CAPPED padded buckets.
+
+    VERDICT r4 #3's scalability cliff: a single (n, C) layout takes C from
+    the heaviest row, so one user with 10⁴ ratings inflates the whole
+    (n, C, f) gather ~10³×.  Rows are instead binned by rating count into
+    power-of-:data:`_BUCKET_FACTOR` caps; each bucket is its own dense
+    (U_b, C_b) problem with the SAME batched-Cholesky half-step, and the
+    per-bucket shapes are what jit specializes on (few buckets — cap
+    growth is geometric).  → list of (row_ids, idx, val, msk, counts)."""
+    counts = np.bincount(ids, minlength=n)
+    order = np.argsort(ids, kind="stable")
+    sid = ids[order]
+    soth = other[order]
+    sval = ratings[order]
+    starts = np.r_[0, np.cumsum(counts)[:-1]]
+    pos_all = np.arange(len(sid)) - starts[sid]
+
+    out = []
+    prev = 0
+    for cap in _bucket_caps(int(counts.max()) if len(ids) else 1):
+        rows = np.flatnonzero((counts > prev) & (counts <= cap))
+        prev = cap
+        if rows.size == 0:
+            continue
+        local = np.full(n, -1, np.int64)
+        local[rows] = np.arange(rows.size)
+        in_b = local[sid] >= 0
+        lr = local[sid[in_b]]
+        pos = pos_all[in_b]
+        idx = np.zeros((rows.size, cap), np.int32)
+        val = np.zeros((rows.size, cap), np.float32)
+        msk = np.zeros((rows.size, cap), np.float32)
+        idx[lr, pos] = soth[in_b]
+        val[lr, pos] = sval[in_b]
+        msk[lr, pos] = 1.0
+        out.append((rows, idx, val, msk, counts[rows].astype(np.float32)))
+    return out
 
 
 @partial(jax.jit, static_argnames=("rank",), donate_argnums=())
@@ -78,14 +149,14 @@ def _solve_explicit(y, idx, val, msk, cnt, reg, rank: int):
 
 
 @partial(jax.jit, static_argnames=("rank",))
-def _solve_implicit(y, idx, val, msk, reg, alpha, rank: int):
+def _solve_implicit(y, yty, idx, val, msk, reg, alpha, rank: int):
     """Hu-Koren half-step: confidence c = 1 + α·r on observed pairs, all
     unobserved pairs carry preference 0 at confidence 1 — absorbed by the
     dense YᵀY term so only observed items enter the batched sums.
-    Regularization scales by the per-row count of POSITIVE ratings
-    (Spark's als.scala ``numExplicits · regParam``, the same ALS-WR
-    weighting as the explicit path)."""
-    yty = y.T @ y                                     # (f, f), once
+    ``yty`` is computed ONCE per half-step by the caller (shared across
+    the count buckets).  Regularization scales by the per-row count of
+    POSITIVE ratings (Spark's als.scala ``numExplicits · regParam``, the
+    same ALS-WR weighting as the explicit path)."""
     g = y[idx]                                        # (n, C, f)
     conf_extra = alpha * val * msk                    # c − 1 on observed
     a = yty[None] + jnp.einsum(
@@ -201,49 +272,108 @@ class ALS(Estimator):
         n_users = int(users.max()) + 1
         n_items = int(items.max()) + 1
 
-        u_idx, u_val, u_msk, u_cnt = _group_ratings(users, items, vals, n_users)
-        i_idx, i_val, i_msk, i_cnt = _group_ratings(items, users, vals, n_items)
+        u_buckets = self._stage_buckets(
+            _group_ratings_bucketed(users, items, vals, n_users), mesh
+        )
+        i_buckets = self._stage_buckets(
+            _group_ratings_bucketed(items, users, vals, n_items), mesh
+        )
 
         rng = np.random.default_rng(self.seed)
         # Spark seeds factors with scaled |N(0,1)|-ish draws; scale keeps
         # initial predictions O(mean rating)
         scale = 1.0 / np.sqrt(self.rank)
-        uf = jnp.asarray(
-            rng.normal(0, scale, size=(n_users, self.rank)).astype(np.float32)
-        )
-        vf = jnp.asarray(
-            rng.normal(0, scale, size=(n_items, self.rank)).astype(np.float32)
-        )
+        uf = rng.normal(0, scale, size=(n_users, self.rank)).astype(np.float32)
+        vf = rng.normal(0, scale, size=(n_items, self.rank)).astype(np.float32)
+        # rows with no ratings are never solved; zero them like the solver
+        # does (λI a, 0 b → 0), so id gaps keep the pre-bucketing behavior
+        uf[np.bincount(users, minlength=n_users) == 0] = 0.0
+        vf[np.bincount(items, minlength=n_items) == 0] = 0.0
+        if mesh is not None:
+            from ..parallel.sharding import replicate
+
+            uf, vf = replicate(uf, mesh), replicate(vf, mesh)
+        else:
+            uf, vf = jnp.asarray(uf), jnp.asarray(vf)
         reg = jnp.float32(self.reg_param)
         alpha = jnp.float32(self.alpha)
-        # the index/rating/mask matrices never change: one transfer each
-        u_idx, u_val, u_msk, u_cnt = (
-            jnp.asarray(a) for a in (u_idx, u_val, u_msk, u_cnt)
-        )
-        i_idx, i_val, i_msk, i_cnt = (
-            jnp.asarray(a) for a in (i_idx, i_val, i_msk, i_cnt)
-        )
 
         for _ in range(self.max_iter):
-            if self.implicit_prefs:
-                uf = _solve_implicit(
-                    vf, u_idx, u_val, u_msk, reg, alpha, self.rank
-                )
-                vf = _solve_implicit(
-                    uf, i_idx, i_val, i_msk, reg, alpha, self.rank
-                )
-            else:
-                uf = _solve_explicit(
-                    vf, u_idx, u_val, u_msk, u_cnt, reg, self.rank
-                )
-                vf = _solve_explicit(
-                    uf, i_idx, i_val, i_msk, i_cnt, reg, self.rank
-                )
+            uf = self._half_step(vf, u_buckets, uf, reg, alpha)
+            vf = self._half_step(uf, i_buckets, vf, reg, alpha)
         return ALSModel(
             user_factors=np.asarray(jax.device_get(uf)),
             item_factors=np.asarray(jax.device_get(vf)),
             cold_start_strategy=self.cold_start_strategy,
         )
+
+    def _stage_buckets(self, buckets, mesh):
+        """Host buckets → device arrays, staged once before the loop.
+
+        With a mesh, a bucket with ≥ one row per device is padded to the
+        data axis and SHARDED across it: each device owns U_b/P rows'
+        normal equations — the analogue of Spark distributing its in-link
+        blocks across executors — while the opposite factor matrix stays
+        replicated, so the only cross-device traffic per half-step is the
+        all-gather of freshly solved (sharded) factors back to replicated
+        form, which XLA emits on the ICI ring.  Row padding of a sharded
+        bucket is < P rows ≤ the bucket's own row count, so it at most
+        doubles that bucket — the documented ≤ 4×nnz cell bound survives
+        sharding.  Buckets with FEWER rows than devices (the heavy tail:
+        one power-law user in the top cap) are REPLICATED instead — row-
+        padding those to P would re-inflate exactly the cells the
+        bucketing removed (P − 1 copies of the widest row).  Padding rows
+        (mask 0, count 0) solve the λI system to 0 and are sliced off."""
+        if mesh is None:
+            return [
+                (jnp.asarray(rows), *map(jnp.asarray, rest), rows.size)
+                for rows, *rest in buckets
+            ]
+        from ..parallel.mesh import DATA_AXIS
+        from ..parallel.sharding import pad_rows, replicate, shard_rows
+
+        p = mesh.shape[DATA_AXIS]
+        staged = []
+        for rows, idx, val, msk, cnt in buckets:
+            if rows.size < p:
+                staged.append(
+                    (
+                        jnp.asarray(rows),
+                        *(replicate(a, mesh) for a in (idx, val, msk, cnt)),
+                        rows.size,
+                    )
+                )
+                continue
+            pad = pad_rows(rows.size, p) - rows.size
+
+            def padded(a):
+                return np.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+
+            staged.append(
+                (
+                    jnp.asarray(rows),
+                    shard_rows(padded(idx), mesh),
+                    shard_rows(padded(val), mesh),
+                    shard_rows(padded(msk), mesh),
+                    shard_rows(padded(cnt), mesh),
+                    rows.size,
+                )
+            )
+        return staged
+
+    def _half_step(self, y, buckets, out, reg, alpha):
+        """Solve every count bucket against ``y`` and scatter the results
+        into ``out`` (replicated factors)."""
+        yty = (y.T @ y) if self.implicit_prefs else None
+        for rows, idx, val, msk, cnt, n_rows in buckets:
+            if self.implicit_prefs:
+                solved = _solve_implicit(
+                    y, yty, idx, val, msk, reg, alpha, self.rank
+                )
+            else:
+                solved = _solve_explicit(y, idx, val, msk, cnt, reg, self.rank)
+            out = out.at[rows].set(solved[:n_rows])
+        return out
 
     @staticmethod
     def _coerce(ratings):
