@@ -129,6 +129,10 @@ class _FMParams:
     weight_col: str | None = None
 
     def _fit(self, data, label_col, mesh, loss: str) -> FMModel:
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(data, HostDataset):
+            return self._fit_outofcore(data, mesh, loss)
         ds = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )
@@ -158,6 +162,85 @@ class _FMParams:
             ds.w.astype(jnp.float32), jnp.float32(self.reg_param),
             jnp.float32(self.step_size), self.max_iter, loss,
         )
+        return FMModel(
+            intercept=float(w0),
+            linear=np.asarray(jax.device_get(w)),
+            factors=np.asarray(jax.device_get(v)),
+            task="regression" if loss == "squared" else "classification",
+        )
+
+
+    def _fit_outofcore(self, hd, mesh, loss: str) -> FMModel:
+        """Rows ≫ HBM (VERDICT r4 #5): streaming MINIBATCH Adam — each
+        epoch scans the ``max_device_rows`` host blocks through the mesh,
+        one Adam step per block on the block's weighted-mean loss.  This
+        is Spark's own ``miniBatchFraction`` SGD shape (the resident path
+        upgrades to full-batch Adam because the whole matrix is on
+        device); the two paths converge to the same optimum statistically
+        but are not step-for-step identical.  ``max_iter`` counts epochs
+        here (full sweeps), matching the resident pass count."""
+        import optax
+
+        from ..parallel.mesh import default_mesh
+
+        mesh = mesh or default_mesh()
+        if hd.y is None:
+            raise ValueError("FM fit needs labels: HostDataset(y=...)")
+        if hd.n == 0 or hd.count() == 0.0:
+            raise ValueError("FM fit on an empty dataset")
+        if self.factor_size < 1:
+            raise ValueError(f"factor_size must be >= 1, got {self.factor_size}")
+        if loss == "logistic":
+            w_host = (
+                np.asarray(hd.w) if hd.w is not None else np.ones(hd.n, np.float32)
+            )
+            uniq = np.unique(np.asarray(hd.y)[w_host > 0])
+            if not np.all(np.isin(uniq, (0.0, 1.0))):
+                raise ValueError(
+                    f"FMClassifier is binary (labels 0/1); got {uniq[:5]}"
+                )
+        rng = np.random.default_rng(self.seed)
+        d = hd.n_features
+        params = (
+            jnp.float32(0.0),
+            jnp.zeros((d,), jnp.float32),
+            jnp.asarray(
+                rng.normal(0, self.init_std, size=(d, self.factor_size)).astype(
+                    np.float32
+                )
+            ),
+        )
+        opt = optax.adam(self.step_size)
+        state = opt.init(params)
+        reg = jnp.float32(self.reg_param)
+
+        @jax.jit
+        def block_step(params, state, x, y, wt):
+            wsum = jnp.maximum(jnp.sum(wt), 1.0)
+
+            def loss_fn(p):
+                w0_, w_, v_ = p
+                raw = _fm_raw(w0_, w_, v_, x)
+                if loss == "squared":
+                    per_row = (raw - y) ** 2
+                else:
+                    ypm = 2.0 * y - 1.0
+                    per_row = jax.nn.softplus(-ypm * raw)
+                data = jnp.sum(per_row * wt) / wsum
+                return data + reg * (jnp.sum(w_ * w_) + jnp.sum(v_ * v_))
+
+            l, grads = jax.value_and_grad(loss_fn)(params)
+            updates, state_new = opt.update(grads, state)
+            return optax.apply_updates(params, updates), state_new, l
+
+        for _ in range(self.max_iter):
+            for blk in hd.blocks(mesh):
+                params, state, _ = block_step(
+                    params, state,
+                    blk.x.astype(jnp.float32), blk.y.astype(jnp.float32),
+                    blk.w.astype(jnp.float32),
+                )
+        w0, w, v = params
         return FMModel(
             intercept=float(w0),
             linear=np.asarray(jax.device_get(w)),

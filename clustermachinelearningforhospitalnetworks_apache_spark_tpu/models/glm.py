@@ -144,18 +144,12 @@ def _irls_glm(
     g, ginv, gprime = _link_fns(link, link_power)
     vfn = _variance_fn(family, var_power)
 
-    # μ init (Spark/statsmodels convention): nudge y into the domain.
+    # μ init (Spark/statsmodels convention): nudge y into the domain —
+    # the SAME helper the out-of-core first pass uses, so the two paths
+    # start from an identical η₀ by construction
     n = jnp.maximum(jnp.sum(w), 1.0)
     ybar = jnp.sum(y * w) / n
-    if family == "binomial":
-        mu0 = jnp.clip((y + 0.5) / 2.0, 1e-3, 1.0 - 1e-3)
-    elif family in ("poisson", "gamma") or (
-        family == "tweedie" and var_power != 0.0
-    ):
-        mu0 = jnp.maximum(y, 0.0) + 0.1 * jnp.maximum(ybar, 0.1)
-    else:
-        mu0 = y
-    eta0 = g(_mu_clip(family, mu0, var_power))
+    eta0 = _glm_mu0_eta(y, ybar, family, link, var_power, link_power)
 
     def irls_step(theta, eta):
         mu = _mu_clip(family, ginv(eta), var_power)
@@ -193,6 +187,107 @@ def _irls_glm(
     mu = _mu_clip(family, ginv(xa @ theta + offset), var_power)
     deviance = jnp.sum(_unit_deviance(family, y, mu, var_power) * w)
     return coef, intercept, it, deviance
+
+
+@jax.jit
+def _glm_block_moments(x, y, w):
+    """(Σw, Σw·x, Σw·x², Σw·y) — the out-of-core pre-pass feeding the
+    standardized ridge and the μ-init's ȳ."""
+    x = x.astype(jnp.float32)
+    xm = jnp.where(w[:, None] > 0, x, 0.0)
+    return (
+        jnp.sum(w),
+        jnp.sum(xm * w[:, None], axis=0),
+        jnp.sum(xm * xm * w[:, None], axis=0),
+        jnp.sum(y * w),
+    )
+
+
+def _glm_mu0_eta(y, ybar, family: str, link: str, var_power: float, link_power: float):
+    """Spark/statsmodels μ-init → η₀, per row (shared by the resident
+    ``_irls_glm`` init and the out-of-core first pass)."""
+    g, _, _ = _link_fns(link, link_power)
+    if family == "binomial":
+        mu0 = jnp.clip((y + 0.5) / 2.0, 1e-3, 1.0 - 1e-3)
+    elif family in ("poisson", "gamma") or (
+        family == "tweedie" and var_power != 0.0
+    ):
+        mu0 = jnp.maximum(y, 0.0) + 0.1 * jnp.maximum(ybar, 0.1)
+    else:
+        mu0 = y
+    return g(_mu_clip(family, mu0, var_power))
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "family", "link", "fit_intercept", "first", "var_power", "link_power",
+    ),
+)
+def _glm_block_irls_stats(
+    x, y, w, theta, ybar,
+    family: str, link: str, fit_intercept: bool, first: bool,
+    var_power: float, link_power: float,
+):
+    """One block's (gram, moment) IRLS contribution at the current θ.
+
+    ``first=True`` derives η from the family's μ-init (a pure function of
+    y and ȳ — exactly what the resident loop starts from); afterwards
+    η = X_aθ, which is also what the resident loop carries between
+    iterations."""
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    xa = (
+        jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+        if fit_intercept
+        else x
+    )
+    _, ginv, gprime = _link_fns(link, link_power)
+    vfn = _variance_fn(family, var_power)
+    if first:
+        eta = _glm_mu0_eta(y, ybar, family, link, var_power, link_power)
+    else:
+        eta = xa @ theta
+    mu = _mu_clip(family, ginv(eta), var_power)
+    gp = gprime(mu)
+    z = eta + (y - mu) * gp
+    om = w / jnp.maximum(gp * gp * vfn(mu), 1e-12)
+    return (xa * om[:, None]).T @ xa, (xa * om[:, None]).T @ z
+
+
+@partial(
+    jax.jit,
+    static_argnames=("family", "link", "fit_intercept", "var_power", "link_power"),
+)
+def _glm_block_deviance(
+    x, y, w, theta,
+    family: str, link: str, fit_intercept: bool,
+    var_power: float, link_power: float,
+):
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    xa = (
+        jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+        if fit_intercept
+        else x
+    )
+    _, ginv, _ = _link_fns(link, link_power)
+    mu = _mu_clip(family, ginv(xa @ theta), var_power)
+    return jnp.sum(_unit_deviance(family, y, mu, var_power) * w)
+
+
+@jax.jit
+def _glm_update_from_stats(theta, gram, mom, ridge):
+    """The resident loop's damped solve on ACCUMULATED statistics."""
+    d = gram.shape[0]
+    g = gram + jnp.diag(ridge)
+    jitter = 1e-7 * jnp.trace(g) / d + 1e-9
+    theta_new = jnp.linalg.solve(g + jitter * jnp.eye(d, dtype=gram.dtype), mom)
+    delta = jnp.max(jnp.abs(theta_new - theta)) / jnp.maximum(
+        jnp.max(jnp.abs(theta_new)), 1.0
+    )
+    return theta_new, delta
 
 
 def _unit_deviance(family: str, y, mu, var_power: float = 0.0):
@@ -667,6 +762,10 @@ class GeneralizedLinearRegression(Estimator):
                     f"domain); got {vp}"
                 )
             lp = float(self.link_power) if self.link_power is not None else 1.0 - vp
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(data, HostDataset):
+            return self._fit_outofcore(data, link, vp, lp, mesh)
         ds = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )
@@ -693,7 +792,30 @@ class GeneralizedLinearRegression(Estimator):
             offset = shard_rows(off, mesh)
         y_host = np.asarray(jax.device_get(ds.y))
         w_host = np.asarray(jax.device_get(ds.w))
-        yv = y_host[w_host > 0]
+        self._validate_labels(y_host[w_host > 0], link, vp)
+        coef, intercept, it, deviance = _irls_glm(
+            ds.x, ds.y, ds.w,
+            offset if offset is not None else jnp.zeros_like(ds.y),
+            jnp.float32(self.reg_param), jnp.float32(self.tol),
+            self.family, link, self.fit_intercept, self.standardize,
+            self.max_iter, vp, lp,
+        )
+        model = GeneralizedLinearRegressionModel(
+            coefficients=np.asarray(jax.device_get(coef)),
+            intercept=float(intercept),
+            family=self.family,
+            link=link,
+            n_iter=int(it),
+            deviance=float(deviance),
+            variance_power=vp,
+            link_power=lp,
+        )
+        model._summary = GeneralizedLinearRegressionTrainingSummary(
+            model, ds, self.reg_param, self.fit_intercept, offset
+        )
+        return model
+
+    def _validate_labels(self, yv: np.ndarray, link: str, vp: float) -> None:
         if yv.size == 0:
             raise ValueError("GeneralizedLinearRegression fit on an empty dataset")
         if self.family == "binomial" and not np.all(np.isin(yv, (0.0, 1.0))):
@@ -723,24 +845,87 @@ class GeneralizedLinearRegression(Estimator):
             # η₀ = log(y) — a non-positive label would NaN the first IRLS
             # step and silently return an all-NaN model
             raise ValueError("gaussian family with log link needs positive labels")
-        coef, intercept, it, deviance = _irls_glm(
-            ds.x, ds.y, ds.w,
-            offset if offset is not None else jnp.zeros_like(ds.y),
-            jnp.float32(self.reg_param), jnp.float32(self.tol),
-            self.family, link, self.fit_intercept, self.standardize,
-            self.max_iter, vp, lp,
+
+    def _fit_outofcore(self, hd, link: str, vp: float, lp: float, mesh=None):
+        """Rows ≫ HBM IRLS (VERDICT r4 #5): every IRLS iteration streams
+        ``max_device_rows`` host blocks through the mesh accumulating the
+        SAME weighted (XᵀΩX, XᵀΩz) statistics the resident ``_irls_glm``
+        computes in one shot, then runs the identical damped solve — the
+        round-4 logistic pattern applied to the whole GLM family surface.
+        The first iteration derives η from the family's μ-init exactly as
+        the resident loop does; afterwards η = X_aθ.  ``offset_col`` and
+        the training ``summary`` are unavailable on this path (the offset
+        needs a table column; the summary would pin the dataset)."""
+        from ..parallel.mesh import default_mesh
+        from ..parallel.outofcore import add_stats
+
+        mesh = mesh or default_mesh()
+        if self.offset_col is not None:
+            raise ValueError(
+                "offset_col needs a table input to resolve the column; "
+                "HostDataset has no columns"
+            )
+        if hd.y is None:
+            raise ValueError(
+                "GeneralizedLinearRegression needs labels: HostDataset(y=...)"
+            )
+        y_host = np.asarray(hd.y)
+        w_host = (
+            np.asarray(hd.w) if hd.w is not None else np.ones(hd.n, np.float32)
         )
-        model = GeneralizedLinearRegressionModel(
-            coefficients=np.asarray(jax.device_get(coef)),
-            intercept=float(intercept),
+        self._validate_labels(y_host[w_host > 0], link, vp)
+
+        # pass 0: moments → standardized ridge + ȳ for the μ-init
+        mom = None
+        for blk in hd.blocks(mesh):
+            s = _glm_block_moments(blk.x, blk.y, blk.w)
+            mom = s if mom is None else add_stats(mom, s)
+        sw, sx, sxx, sy = (np.asarray(jax.device_get(v)) for v in mom)
+        n = max(float(sw), 1.0)
+        mean = sx / n
+        var = np.maximum(sxx / n - mean * mean, 0.0)
+        std = np.sqrt(np.maximum(var, 1e-12))
+        scale = std if self.standardize else np.ones_like(std)
+        ybar = jnp.float32(sy / n)
+
+        nfeat = hd.n_features
+        dd = nfeat + (1 if self.fit_intercept else 0)
+        ridge_h = np.zeros((dd,), np.float32)
+        ridge_h[:nfeat] = self.reg_param * n * scale * scale
+        ridge = jnp.asarray(ridge_h)
+
+        theta = jnp.zeros((dd,), jnp.float32)
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            tot = None
+            for blk in hd.blocks(mesh):
+                s = _glm_block_irls_stats(
+                    blk.x, blk.y, blk.w, theta, ybar,
+                    self.family, link, self.fit_intercept, it == 1, vp, lp,
+                )
+                tot = s if tot is None else add_stats(tot, s)
+            theta, delta = _glm_update_from_stats(theta, *tot, ridge)
+            if float(delta) <= self.tol:
+                break
+
+        dev = 0.0
+        for blk in hd.blocks(mesh):
+            dev += float(
+                jax.device_get(
+                    _glm_block_deviance(
+                        blk.x, blk.y, blk.w, theta,
+                        self.family, link, self.fit_intercept, vp, lp,
+                    )
+                )
+            )
+        theta_h = np.asarray(jax.device_get(theta))
+        return GeneralizedLinearRegressionModel(
+            coefficients=theta_h[:nfeat],
+            intercept=float(theta_h[nfeat]) if self.fit_intercept else 0.0,
             family=self.family,
             link=link,
-            n_iter=int(it),
-            deviance=float(deviance),
+            n_iter=it,
+            deviance=dev,
             variance_power=vp,
             link_power=lp,
         )
-        model._summary = GeneralizedLinearRegressionTrainingSummary(
-            model, ds, self.reg_param, self.fit_intercept, offset
-        )
-        return model

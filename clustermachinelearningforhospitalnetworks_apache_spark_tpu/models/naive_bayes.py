@@ -52,6 +52,29 @@ def _count_sums(x: jax.Array, y: jax.Array, w: jax.Array, k: int, binary: bool =
     return counts, s1, bad
 
 
+@jax.jit
+def _mean_stats(x: jax.Array, w: jax.Array):
+    """(Σw, Σw·x) for the out-of-core gaussian path's global-mean pass."""
+    xm = jnp.where(w[:, None] > 0, x, 0.0)
+    return jnp.sum(w), jnp.sum(xm * w[:, None], axis=0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _gaussian_stats_centered(
+    x: jax.Array, y: jax.Array, w: jax.Array, k: int, gmean: jax.Array
+):
+    """Per-class weighted (count, Σxc, Σxc²) at a FIXED center — the
+    per-block half of :func:`_gaussian_stats` for out-of-core fits (the
+    resident version computes ``gmean`` in the same jit)."""
+    xm = jnp.where(w[:, None] > 0, x, 0.0)
+    xc = xm - gmean[None, :]
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=x.dtype) * w[:, None]
+    counts = jnp.sum(onehot, axis=0)
+    s1c = onehot.T @ xc
+    s2c = onehot.T @ (xc * xc)
+    return counts, s1c, s2c
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _gaussian_stats(x: jax.Array, y: jax.Array, w: jax.Array, k: int):
     """Per-class weighted (count, Σxc, Σxc²) of GLOBALLY CENTERED features.
@@ -166,6 +189,10 @@ class NaiveBayes(Estimator):
                 "model_type must be multinomial|bernoulli|complement|"
                 f"gaussian, got {self.model_type!r}"
             )
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(data, HostDataset):
+            return self._fit_outofcore(data, mesh)
         ds: DeviceDataset = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )
@@ -173,50 +200,56 @@ class NaiveBayes(Estimator):
         y_host = np.asarray(jax.device_get(ds.y))
         w_host = np.asarray(jax.device_get(ds.w))
         k = int(y_host[w_host > 0].max()) + 1 if np.any(w_host > 0) else 1
-        sm = self.smoothing
-
-        def spark_pi(counts: np.ndarray) -> np.ndarray:
-            """MLlib's smoothed priors: log(n_c + λ) − log(n + kλ)."""
-            return np.log(counts + sm) - np.log(counts.sum() + k * sm)
 
         if self.model_type in ("multinomial", "bernoulli", "complement"):
             counts, s1, bad = _count_sums(
                 x, ds.y, ds.w, k, binary=self.model_type == "bernoulli"
             )
             if bool(jax.device_get(bad)):
-                if self.model_type == "bernoulli":
-                    raise ValueError(
-                        "bernoulli NaiveBayes requires 0/1 features; "
-                        "binarize first (features/binarizer.py)"
-                    )
-                raise ValueError(
-                    f"{self.model_type} NaiveBayes requires non-negative, "
-                    "non-NaN features (counts); use model_type='gaussian' "
-                    "for real-valued data"
-                )
+                self._raise_bad_features()
             counts = np.asarray(counts, dtype=np.float64)
             s1 = np.asarray(s1, dtype=np.float64)
-            pi = spark_pi(counts)
-            if self.model_type == "multinomial":
-                theta = np.log(
-                    (s1 + sm) / (s1.sum(axis=1, keepdims=True) + sm * s1.shape[1])
-                )
-                return NaiveBayesModel("multinomial", pi, theta)
-            if self.model_type == "bernoulli":
-                # P(f=1 | c) = (doc count with f, in c + λ) / (n_c + 2λ)
-                p = (s1 + sm) / (counts[:, None] + 2.0 * sm)
-                return NaiveBayesModel(
-                    "bernoulli", pi, np.log(p), theta2=np.log1p(-p)
-                )
-            # complement (Rennie's CNB, sklearn ComplementNB norm=False):
-            # per class, feature mass from every OTHER class's rows
-            comp = s1.sum(axis=0, keepdims=True) - s1 + sm          # (k, d)
-            theta = -(np.log(comp) - np.log(comp.sum(axis=1, keepdims=True)))
-            return NaiveBayesModel("complement", pi, theta)
+            return self._finalize_discrete(counts, s1, k)
         counts, s1c, s2c, gmean = (
             np.asarray(a, dtype=np.float64)
             for a in _gaussian_stats(x, ds.y, ds.w, k)
         )
+        return self._finalize_gaussian(counts, s1c, s2c, gmean)
+
+    def _raise_bad_features(self):
+        if self.model_type == "bernoulli":
+            raise ValueError(
+                "bernoulli NaiveBayes requires 0/1 features; "
+                "binarize first (features/binarizer.py)"
+            )
+        raise ValueError(
+            f"{self.model_type} NaiveBayes requires non-negative, "
+            "non-NaN features (counts); use model_type='gaussian' "
+            "for real-valued data"
+        )
+
+    def _finalize_discrete(self, counts: np.ndarray, s1: np.ndarray, k: int):
+        """(counts, Σx) → model, shared by the resident and out-of-core
+        paths (the statistics are identical; only how they were
+        accumulated differs)."""
+        sm = self.smoothing
+        pi = np.log(counts + sm) - np.log(counts.sum() + k * sm)
+        if self.model_type == "multinomial":
+            theta = np.log(
+                (s1 + sm) / (s1.sum(axis=1, keepdims=True) + sm * s1.shape[1])
+            )
+            return NaiveBayesModel("multinomial", pi, theta)
+        if self.model_type == "bernoulli":
+            # P(f=1 | c) = (doc count with f, in c + λ) / (n_c + 2λ)
+            p = (s1 + sm) / (counts[:, None] + 2.0 * sm)
+            return NaiveBayesModel("bernoulli", pi, np.log(p), theta2=np.log1p(-p))
+        # complement (Rennie's CNB, sklearn ComplementNB norm=False):
+        # per class, feature mass from every OTHER class's rows
+        comp = s1.sum(axis=0, keepdims=True) - s1 + sm          # (k, d)
+        theta = -(np.log(comp) - np.log(comp.sum(axis=1, keepdims=True)))
+        return NaiveBayesModel("complement", pi, theta)
+
+    def _finalize_gaussian(self, counts, s1c, s2c, gmean):
         # gaussian priors are UNSMOOTHED — Spark's trainGaussianImpl uses
         # log(weightSum) − log(n) (λ applies only to the discrete models),
         # which is also sklearn GaussianNB's convention
@@ -233,3 +266,61 @@ class NaiveBayes(Estimator):
         floor = self.var_smoothing * max(float(var.max()), 1e-12)
         var = np.maximum(var, floor)
         return NaiveBayesModel("gaussian", pi, mean_c + gmean[None, :], var)
+
+    def _fit_outofcore(self, hd, mesh=None) -> NaiveBayesModel:
+        """Rows ≫ HBM (VERDICT r4 #5, the easiest case): NaiveBayes IS one
+        pass of psum'd sufficient statistics, so the out-of-core fit just
+        accumulates the SAME per-class (count, Σx[, Σx²]) block by block —
+        Spark's treeAggregate over disk-backed partitions, one
+        ``max_device_rows`` block at a time through the mesh.  Gaussian
+        needs the globally-centered two-pass variant: pass 1 computes the
+        global weighted mean, pass 2 the centered per-class stats (the
+        resident path fuses both in one jit; the math is identical)."""
+        from ..parallel.mesh import default_mesh
+        from ..parallel.outofcore import add_stats
+
+        mesh = mesh or default_mesh()
+        if hd.y is None:
+            raise ValueError("NaiveBayes needs labels: HostDataset(y=...)")
+        if hd.n == 0:
+            raise ValueError("NaiveBayes fit on an empty dataset")
+        y_host = np.asarray(hd.y)
+        w_host = (
+            np.asarray(hd.w) if hd.w is not None else np.ones(hd.n, np.float32)
+        )
+        if not np.any(w_host > 0):
+            raise ValueError("NaiveBayes fit with no positively-weighted rows")
+        k = int(y_host[w_host > 0].max()) + 1
+
+        if self.model_type in ("multinomial", "bernoulli", "complement"):
+            tot, bad_any = None, False
+            for blk in hd.blocks(mesh):
+                counts, s1, bad = _count_sums(
+                    blk.x.astype(jnp.float32), blk.y, blk.w, k,
+                    binary=self.model_type == "bernoulli",
+                )
+                bad_any = bad_any or bool(jax.device_get(bad))
+                tot = (counts, s1) if tot is None else add_stats(tot, (counts, s1))
+            if bad_any:
+                self._raise_bad_features()
+            counts, s1 = (np.asarray(a, dtype=np.float64) for a in tot)
+            return self._finalize_discrete(counts, s1, k)
+
+        # gaussian: pass 1 — global weighted mean
+        mtot = None
+        for blk in hd.blocks(mesh):
+            s = _mean_stats(blk.x.astype(jnp.float32), blk.w)
+            mtot = s if mtot is None else add_stats(mtot, s)
+        sw, sx = mtot
+        gmean = jnp.asarray(sx) / jnp.maximum(jnp.asarray(sw), 1.0)
+        # pass 2 — per-class centered stats at the FIXED global mean
+        tot = None
+        for blk in hd.blocks(mesh):
+            s = _gaussian_stats_centered(
+                blk.x.astype(jnp.float32), blk.y, blk.w, k, gmean
+            )
+            tot = s if tot is None else add_stats(tot, s)
+        counts, s1c, s2c = (np.asarray(a, dtype=np.float64) for a in tot)
+        return self._finalize_gaussian(
+            counts, s1c, s2c, np.asarray(gmean, dtype=np.float64)
+        )

@@ -48,6 +48,10 @@ def _fit_grown(
         return grow_forest_outofcore(
             data, mesh=mesh, **subset_kw(data.n_features), **kw
         )
+    # checkpointing targets the long streaming fits; a resident fit
+    # completes in one device pass per level and restarts cheaply
+    kw.pop("checkpoint_dir", None)
+    kw.pop("checkpoint_every", None)
     ds = as_device_dataset(data, label_col, mesh=mesh, weight_col=weight_col)
     return grow_forest(ds, mesh=mesh, **subset_kw(ds.n_features), **kw)
 
@@ -179,6 +183,13 @@ class _TreeParams:
     # columns hold StringIndexer-style category ids and are split as
     # unordered sets (engine.py); arity ≤ min(32, max_bins).
     categorical_features: dict[int, int] | None = None
+    # Spark's checkpointInterval analogue for OUT-OF-CORE (HostDataset)
+    # fits: commit the fit state every `checkpoint_every` tree levels so
+    # a preempted streaming fit resumes mid-growth (engine.py
+    # grow_forest_outofcore).  Resident fits ignore it (they re-run in
+    # seconds).
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
 
 
 @dataclass(frozen=True)
@@ -194,6 +205,8 @@ class DecisionTreeRegressor(Estimator, _TreeParams):
             min_info_gain=self.min_info_gain,
             seed=self.seed,
             categorical_features=self.categorical_features,
+            checkpoint_dir=self.checkpoint_dir,
+            checkpoint_every=self.checkpoint_every,
         )
         return _from_grown(DecisionTreeModel, grown, "regression", 2)
 
@@ -215,5 +228,7 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
             min_info_gain=self.min_info_gain,
             seed=self.seed,
             categorical_features=self.categorical_features,
+            checkpoint_dir=self.checkpoint_dir,
+            checkpoint_every=self.checkpoint_every,
         )
         return _from_grown(DecisionTreeModel, grown, "classification", self.num_classes)

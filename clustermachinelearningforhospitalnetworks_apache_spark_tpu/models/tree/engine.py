@@ -774,6 +774,9 @@ def grow_forest_outofcore(
     init_sample_size: int = 65536,
     categorical_features: dict[int, int] | None = None,
     bin_thresholds: np.ndarray | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 1,
+    on_level=None,
 ) -> GrownForest:
     """Rows ≫ HBM level-order growth: every tree level is ONE more
     sufficient-statistics pass over streamed host blocks (VERDICT r3 next
@@ -789,6 +792,14 @@ def grow_forest_outofcore(
     :func:`_make_select_fn` picks the winners.  With exact (f32-closed)
     sums the resulting splits are bit-identical to the resident engine's;
     device residency stays bounded by ``hd.max_device_rows``.
+
+    ``checkpoint_dir`` composes with this path (VERDICT r4 #5): a tree
+    LEVEL is the natural commit boundary (block streaming happens inside
+    it), and the recorder arrays + binning thresholds are the complete
+    fit state — the per-level descend ``winners`` are reconstructed from
+    the recorded splits (``_advance_level`` only consumes them where
+    ``do_split``), so a preempted multi-hour streaming fit resumes at the
+    next unfinished level instead of from scratch.
     """
     from ...parallel.mesh import default_mesh as _default_mesh
 
@@ -846,6 +857,52 @@ def grow_forest_outofcore(
     # per-level winners kept ON DEVICE for the descend replay (tiny)
     winners: list[tuple] = []   # (feat, bin, do_split, catmask) per level
 
+    def _winners_from_recorder(dep: int) -> tuple:
+        """Rebuild one level's descend inputs from the recorded splits —
+        ``split_feat`` already holds -1 where no split, which is exactly
+        ``_advance_level``'s ``feat_eff`` convention."""
+        sl = slice((1 << dep) - 1, (1 << dep) - 1 + (1 << dep))
+        feat = rec.split_feat[:, sl]
+        return (
+            jnp.asarray(feat),
+            jnp.asarray(rec.split_bin[:, sl]),
+            jnp.asarray(feat >= 0),
+            jnp.asarray(rec.split_catmask[:, sl]),
+        )
+
+    ckpt = None
+    start_depth = 0
+    if checkpoint_dir:
+        from ...io.fit_checkpoint import FitCheckpointer, data_fingerprint
+
+        signature = {
+            "estimator": "forest", "storage": "outofcore",
+            "task": task, "num_classes": num_classes, "num_trees": T,
+            "max_depth": max_depth, "max_bins": B,
+            "min_instances_per_node": min_instances_per_node,
+            "min_info_gain": min_info_gain,
+            "feature_subset_size": feature_subset_size,
+            "bootstrap": bootstrap, "subsampling_rate": subsampling_rate,
+            # JSON-normalized (lists, not tuples): the committed signature
+            # is JSON round-tripped before comparison
+            "seed": seed, "cat": [list(t) for t in sorted(cat.items())],
+            "data": data_fingerprint(hd.x, hd.w),
+            "labels": data_fingerprint(np.asarray(hd.y)[:, None]),
+            "n": hd.n,
+        }
+        ckpt = FitCheckpointer(checkpoint_dir, signature)
+        resumed = ckpt.resume()
+        if resumed is not None:
+            step0, arrays, _ = resumed
+            thr = arrays["thr"]
+            rec.split_feat = arrays["split_feat"]
+            rec.split_bin = arrays["split_bin"]
+            rec.split_catmask = arrays["split_catmask"]
+            rec.node_stats = arrays["node_stats"]
+            rec.importances = arrays["importances"]
+            winners.extend(_winners_from_recorder(dep) for dep in range(step0 + 1))
+            start_depth = step0 + 1
+
     def block_arrays(blk, block_idx):
         """(binned_t, base_t, w_tree) for one streamed block."""
         binned_t = bin_feature_matrix(blk.x, thr, cat, w=blk.w)
@@ -881,7 +938,7 @@ def grow_forest_outofcore(
             )
         return node_id
 
-    for depth in range(max_depth + 1):
+    for depth in range(start_depth, max_depth + 1):
         level_nodes = 1 << depth
         level_base = level_nodes - 1
         if feature_subset_size is not None and feature_subset_size < d:
@@ -910,6 +967,22 @@ def grow_forest_outofcore(
             depth,
             jax.device_get((agg_d, gain_d, feat_d, bin_d, split_d, catmask_d)),
         )
+        if ckpt is not None and (depth + 1) % max(checkpoint_every, 1) == 0:
+            ckpt.save(
+                depth,
+                {
+                    "thr": thr,
+                    "split_feat": rec.split_feat,
+                    "split_bin": rec.split_bin,
+                    "split_catmask": rec.split_catmask,
+                    "node_stats": rec.node_stats,
+                    "importances": rec.importances,
+                },
+            )
+        if on_level is not None:
+            # after the commit, like KMeans's on_iteration — the fault-
+            # injection / progress hook the checkpoint tests preempt at
+            on_level(depth)
 
     return rec.materialize(thr, task, num_classes, cat_arities, B)
 

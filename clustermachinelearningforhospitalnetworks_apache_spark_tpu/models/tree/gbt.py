@@ -35,7 +35,7 @@ import numpy as np
 from ...io.model_io import register_model
 from ...parallel.mesh import default_mesh
 from ..base import Estimator, Model, as_device_dataset, check_features
-from .engine import bin_feature_matrix, grow_forest, predict_forest
+from .engine import GrownForest, bin_feature_matrix, grow_forest, predict_forest
 
 
 @register_model("GBTModel")
@@ -151,6 +151,12 @@ class _GBTParams:
     # their loss stops improving (runWithValidation semantics)
     validation_indicator_col: str | None = None
     validation_tol: float = 0.01      # Spark default
+    # Spark's checkpointInterval analogue for OUT-OF-CORE (HostDataset)
+    # fits: commit (margin column + trees so far) every
+    # `checkpoint_every` boosted rounds so a preempted streaming boost
+    # resumes mid-sequence.  Resident fits ignore it.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
 
     def _resolve_validation(self, data, ds, mesh):
         """validation_indicator_col → (n_pad,) float device mask (or None),
@@ -361,9 +367,67 @@ class _GBTParams:
             jnp.asarray([f in cat for f in range(hd.n_features)]) if cat else None
         )
 
+        cat_arities_np = (
+            np.asarray(
+                [cat.get(f, 0) for f in range(hd.n_features)], np.int32
+            )
+            if cat
+            else None
+        )
         f_cur = np.full((hd.n,), np.float32(f0), np.float32)
         trees, importances = [], []
-        for t in range(self.max_iter):
+
+        # checkpoint at the BOOSTED-ROUND boundary (VERDICT r4 #5): the
+        # host margin column + the trees grown so far are the complete
+        # fit state, so a preempted streaming boost resumes at the next
+        # round instead of from round 0
+        ckpt = None
+        start_t = 0
+        if self.checkpoint_dir:
+            from ...io.fit_checkpoint import FitCheckpointer, data_fingerprint
+
+            signature = {
+                "estimator": "GBT", "storage": "outofcore", "loss": loss,
+                "max_iter": self.max_iter, "max_depth": self.max_depth,
+                "max_bins": self.max_bins, "step_size": self.step_size,
+                "min_instances_per_node": self.min_instances_per_node,
+                "min_info_gain": self.min_info_gain,
+                "subsampling_rate": self.subsampling_rate,
+                # JSON-normalized (lists, not tuples) — the committed
+                # signature is JSON round-tripped before comparison
+                "seed": self.seed,
+                "cat": [list(t) for t in sorted((cat or {}).items())],
+                "data": data_fingerprint(hd.x, hd.w),
+                "labels": data_fingerprint(y[:, None]),
+                "n": hd.n,
+            }
+            ckpt = FitCheckpointer(self.checkpoint_dir, signature)
+            resumed = ckpt.resume()
+            if resumed is not None:
+                step0, arrays, _ = resumed
+                thr = arrays["thr"]
+                f_cur = arrays["f_cur"].astype(np.float32)
+                for i in range(step0 + 1):
+                    grown = GrownForest(
+                        split_feat=arrays["split_feat"][i : i + 1],
+                        split_bin=np.zeros_like(
+                            arrays["split_feat"][i : i + 1]
+                        ),
+                        threshold=arrays["threshold"][i : i + 1],
+                        value=arrays["value"][i : i + 1],
+                        importances=arrays["importances"][i : i + 1],
+                        max_depth=self.max_depth,
+                        bin_thresholds=thr,
+                        split_catmask=(
+                            arrays["split_catmask"][i : i + 1] if cat else None
+                        ),
+                        cat_arities=cat_arities_np,
+                    )
+                    trees.append(grown)
+                    importances.append(grown.importances[0])
+                start_t = step0 + 1
+
+        for t in range(start_t, self.max_iter):
             res_hd = HostDataset(
                 hd.x, residual(f_cur).astype(np.float32), hd.w,
                 max_device_rows=hd.max_device_rows,
@@ -402,6 +466,22 @@ class _GBTParams:
                 f_cur[s:e] += self.step_size * np.asarray(
                     jax.device_get(pred)
                 )[: e - s]
+            if ckpt is not None and (t + 1) % max(self.checkpoint_every, 1) == 0:
+                arrays = {
+                    "thr": thr,
+                    "f_cur": f_cur,
+                    "split_feat": np.concatenate([g.split_feat for g in trees]),
+                    "threshold": np.concatenate([g.threshold for g in trees]),
+                    "value": np.concatenate([g.value for g in trees]),
+                    "importances": np.concatenate(
+                        [g.importances for g in trees]
+                    ),
+                }
+                if cat:
+                    arrays["split_catmask"] = np.concatenate(
+                        [g.split_catmask for g in trees]
+                    )
+                ckpt.save(t, arrays)
 
         imp = np.sum(importances, axis=0)
         s = imp.sum()

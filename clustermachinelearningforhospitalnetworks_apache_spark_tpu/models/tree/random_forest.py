@@ -64,6 +64,8 @@ class RandomForestRegressor(Estimator, _TreeParams):
             subsampling_rate=self.subsampling_rate,
             seed=self.seed,
             categorical_features=self.categorical_features,
+            checkpoint_dir=self.checkpoint_dir,
+            checkpoint_every=self.checkpoint_every,
         )
         return _from_grown(RandomForestModel, grown, "regression", 2)
 
@@ -91,5 +93,7 @@ class RandomForestClassifier(Estimator, _TreeParams):
             subsampling_rate=self.subsampling_rate,
             seed=self.seed,
             categorical_features=self.categorical_features,
+            checkpoint_dir=self.checkpoint_dir,
+            checkpoint_every=self.checkpoint_every,
         )
         return _from_grown(RandomForestModel, grown, "classification", self.num_classes)

