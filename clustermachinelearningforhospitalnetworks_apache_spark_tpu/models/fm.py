@@ -233,8 +233,13 @@ class _FMParams:
             updates, state_new = opt.update(grads, state)
             return optax.apply_updates(params, updates), state_new, l
 
+        n_blocks, _ = hd.block_shape(mesh)
+        shuffle = np.random.default_rng(self.seed + 1)
         for _ in range(self.max_iter):
-            for blk in hd.blocks(mesh):
+            # fresh block order per epoch: rows grouped on disk (e.g.
+            # label-sorted ETL output) must not make every epoch end on
+            # the same class (standard minibatch-SGD shuffling)
+            for blk in hd.blocks(mesh, order=shuffle.permutation(n_blocks)):
                 params, state, _ = block_step(
                     params, state,
                     blk.x.astype(jnp.float32), blk.y.astype(jnp.float32),

@@ -189,20 +189,6 @@ def _irls_glm(
     return coef, intercept, it, deviance
 
 
-@jax.jit
-def _glm_block_moments(x, y, w):
-    """(Σw, Σw·x, Σw·x², Σw·y) — the out-of-core pre-pass feeding the
-    standardized ridge and the μ-init's ȳ."""
-    x = x.astype(jnp.float32)
-    xm = jnp.where(w[:, None] > 0, x, 0.0)
-    return (
-        jnp.sum(w),
-        jnp.sum(xm * w[:, None], axis=0),
-        jnp.sum(xm * xm * w[:, None], axis=0),
-        jnp.sum(y * w),
-    )
-
-
 def _glm_mu0_eta(y, ybar, family: str, link: str, var_power: float, link_power: float):
     """Spark/statsmodels μ-init → η₀, per row (shared by the resident
     ``_irls_glm`` init and the out-of-core first pass)."""
@@ -875,10 +861,13 @@ class GeneralizedLinearRegression(Estimator):
         )
         self._validate_labels(y_host[w_host > 0], link, vp)
 
-        # pass 0: moments → standardized ridge + ȳ for the μ-init
+        # pass 0: moments → standardized ridge + ȳ for the μ-init (the
+        # shared out-of-core pre-pass kernel, parallel/outofcore.py)
+        from ..parallel.outofcore import block_moments
+
         mom = None
         for blk in hd.blocks(mesh):
-            s = _glm_block_moments(blk.x, blk.y, blk.w)
+            s = block_moments(blk.x, blk.y, blk.w, extra="ysum")
             mom = s if mom is None else add_stats(mom, s)
         sw, sx, sxx, sy = (np.asarray(jax.device_get(v)) for v in mom)
         n = max(float(sw), 1.0)

@@ -179,22 +179,6 @@ def _multinomial_fit(
     return coef, intercept, n_iter
 
 
-@jax.jit
-def _logit_block_moments(x, y, w):
-    """One streamed block's contribution to the standardization moments +
-    class-count stat the out-of-core IRLS needs before its first Newton
-    pass: (Σw, Σw·x, Σw·x², max valid y)."""
-    x = x.astype(jnp.float32)
-    w = w.astype(jnp.float32)
-    wcol = w[:, None]
-    return (
-        jnp.sum(w),
-        jnp.sum(x * wcol, axis=0),
-        jnp.sum(x * x * wcol, axis=0),
-        jnp.max(jnp.where(w > 0, y.astype(jnp.float32), 0.0)),
-    )
-
-
 @partial(jax.jit, static_argnames=("fit_intercept",))
 def _logit_block_newton_stats(x, y, w, theta, fit_intercept: bool):
     """One block's (gradient, Hessian) contribution at ``theta`` — the
@@ -497,7 +481,7 @@ class LogisticRegression(Estimator):
         time through the mesh.  The training ``summary`` is unavailable on
         this path (it would pin the full dataset on device)."""
         from ..parallel.mesh import default_mesh
-        from ..parallel.outofcore import add_stats
+        from ..parallel.outofcore import add_stats, block_moments
 
         mesh = mesh or default_mesh()
         if hd.y is None:
@@ -506,11 +490,12 @@ class LogisticRegression(Estimator):
             raise ValueError("LogisticRegression fit on an empty dataset")
 
         # pass 0: standardization moments (→ Spark's standardized-L2 ridge)
-        # + class count (max accumulates by max, not add)
+        # + class count, via the shared out-of-core pre-pass kernel
+        # (parallel/outofcore.py; "ymax" accumulates by max, not add)
         mom = None
         ymax = 0.0
         for blk in hd.blocks(mesh):
-            s = _logit_block_moments(blk.x, blk.y, blk.w)
+            s = block_moments(blk.x, blk.y, blk.w, extra="ymax")
             ymax = max(ymax, float(jax.device_get(s[3])))
             mom = s[:3] if mom is None else add_stats(mom, s[:3])
         sw, sx, sxx = (np.asarray(jax.device_get(v)) for v in mom)

@@ -243,9 +243,12 @@ class MultilayerPerceptronClassifier(Estimator):
             return optax.apply_updates(params, updates), state_new, l
 
         prev = np.inf
+        n_blocks, _ = hd.block_shape(mesh)
+        shuffle = np.random.default_rng(self.seed + 1)
         for _ in range(self.max_iter):
             losses = []
-            for blk in hd.blocks(mesh):
+            # fresh block order per epoch — see HostDataset.blocks(order=)
+            for blk in hd.blocks(mesh, order=shuffle.permutation(n_blocks)):
                 params, state, l = block_step(
                     params, state,
                     blk.x.astype(jnp.float32), blk.y, blk.w.astype(jnp.float32),

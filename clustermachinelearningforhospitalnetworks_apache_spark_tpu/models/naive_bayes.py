@@ -52,13 +52,6 @@ def _count_sums(x: jax.Array, y: jax.Array, w: jax.Array, k: int, binary: bool =
     return counts, s1, bad
 
 
-@jax.jit
-def _mean_stats(x: jax.Array, w: jax.Array):
-    """(Σw, Σw·x) for the out-of-core gaussian path's global-mean pass."""
-    xm = jnp.where(w[:, None] > 0, x, 0.0)
-    return jnp.sum(w), jnp.sum(xm * w[:, None], axis=0)
-
-
 @partial(jax.jit, static_argnames=("k",))
 def _gaussian_stats_centered(
     x: jax.Array, y: jax.Array, w: jax.Array, k: int, gmean: jax.Array
@@ -293,25 +286,29 @@ class NaiveBayes(Estimator):
         k = int(y_host[w_host > 0].max()) + 1
 
         if self.model_type in ("multinomial", "bernoulli", "complement"):
-            tot, bad_any = None, False
+            # bad flag accumulates ON DEVICE (bool→f32 sum > 0) so the
+            # streamed loop never blocks on a per-block host round-trip
+            tot = None
             for blk in hd.blocks(mesh):
                 counts, s1, bad = _count_sums(
                     blk.x.astype(jnp.float32), blk.y, blk.w, k,
                     binary=self.model_type == "bernoulli",
                 )
-                bad_any = bad_any or bool(jax.device_get(bad))
-                tot = (counts, s1) if tot is None else add_stats(tot, (counts, s1))
-            if bad_any:
+                s = (counts, s1, bad.astype(jnp.float32))
+                tot = s if tot is None else add_stats(tot, s)
+            if float(jax.device_get(tot[2])) > 0:
                 self._raise_bad_features()
-            counts, s1 = (np.asarray(a, dtype=np.float64) for a in tot)
+            counts, s1 = (np.asarray(a, dtype=np.float64) for a in tot[:2])
             return self._finalize_discrete(counts, s1, k)
 
         # gaussian: pass 1 — global weighted mean
+        from ..parallel.outofcore import block_moments
+
         mtot = None
         for blk in hd.blocks(mesh):
-            s = _mean_stats(blk.x.astype(jnp.float32), blk.w)
+            s = block_moments(blk.x, blk.y, blk.w)
             mtot = s if mtot is None else add_stats(mtot, s)
-        sw, sx = mtot
+        sw, sx = mtot[0], mtot[1]
         gmean = jnp.asarray(sx) / jnp.maximum(jnp.asarray(sw), 1.0)
         # pass 2 — per-class centered stats at the FIXED global mean
         tot = None

@@ -37,6 +37,35 @@ from .sharding import DeviceDataset, device_dataset, pad_rows
 # out-of-core estimator driver (KMeans / LinearRegression / GMM).
 add_stats = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("extra",))
+def block_moments(x, y, w, extra: str = "none"):
+    """One streamed block's standardization moments — the shared pre-pass
+    kernel of every out-of-core GLM-family fit: (Σw, Σw·x, Σw·x²[, extra]).
+
+    NaN features in w=0 rows are masked BEFORE any product (padding rows
+    are contractually inert).  ``extra`` appends a fourth statistic:
+    ``"ysum"`` → Σw·y (sum-accumulated; GLM's ȳ init), ``"ymax"`` → max
+    valid y (max-accumulated by the CALLER, not ``add_stats`` — summing
+    maxima is wrong; logistic's class count)."""
+    x = x.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    xm = jnp.where(w[:, None] > 0, x, 0.0)
+    base = (
+        jnp.sum(w),
+        jnp.sum(xm * w[:, None], axis=0),
+        jnp.sum(xm * xm * w[:, None], axis=0),
+    )
+    if extra == "ysum":
+        return base + (jnp.sum(y.astype(jnp.float32) * w),)
+    if extra == "ymax":
+        return base + (
+            jnp.max(jnp.where(w > 0, y.astype(jnp.float32), 0.0)),
+        )
+    return base
+
 
 @dataclass
 class HostDataset:
@@ -102,12 +131,21 @@ class HostDataset:
             idx = np.sort(rng.choice(idx, size=size, replace=False))
         return np.asarray(self.x[idx], dtype=np.float64)
 
-    def blocks(self, mesh=None, dtype=np.float32) -> Iterator[DeviceDataset]:
-        """Stream the table as double-buffered fixed-shape device blocks."""
+    def blocks(
+        self, mesh=None, dtype=np.float32, order=None
+    ) -> Iterator[DeviceDataset]:
+        """Stream the table as double-buffered fixed-shape device blocks.
+
+        ``order`` (optional permutation of block indices) reorders the
+        stream — the minibatch-SGD consumers (MLP/FM) shuffle blocks per
+        epoch so rows grouped on disk (e.g. sorted by label after ETL)
+        don't make every epoch end on the same class.  Sufficient-stats
+        consumers sum, so they leave it None."""
         mesh = mesh or default_mesh()
         n_blocks, b = self.block_shape(mesh)
         if n_blocks == 0:  # empty dataset: no phantom all-pad block
             return
+        seq = list(range(n_blocks)) if order is None else [int(i) for i in order]
 
         def make(i: int) -> DeviceDataset:
             s = i * b
@@ -126,8 +164,8 @@ class HostDataset:
                 yb[:m] = self.y[s:e]
             return device_dataset(xb, yb, mesh=mesh, weights=wb)
 
-        nxt = make(0)
-        for i in range(1, n_blocks):
+        nxt = make(seq[0])
+        for i in seq[1:]:
             cur, nxt = nxt, make(i)  # issue i's transfer, then yield i-1
             yield cur
         yield nxt

