@@ -167,10 +167,6 @@ class TestALSModel:
         )
 
     def test_validation(self, rng):
-        with pytest.raises(NotImplementedError, match="nonnegative"):
-            ht.ALS(nonnegative=True).fit(
-                (np.array([0]), np.array([0]), np.array([1.0], np.float32))
-            )
         with pytest.raises(ValueError, match="cold_start"):
             ht.ALS(cold_start_strategy="keep").fit(
                 (np.array([0]), np.array([0]), np.array([1.0], np.float32))
@@ -280,3 +276,67 @@ class TestALSBucketedDistributed:
         )
         rmse = np.sqrt(np.mean((m.predict(uu, ii) - rr) ** 2))
         assert rmse < 0.5
+
+
+class TestALSNonnegative:
+    """nonnegative=True — Spark's NNLS solver, as batched projected CD."""
+
+    def test_half_step_matches_scipy_nnls(self, rng):
+        from scipy import optimize
+
+        import jax.numpy as jnp
+
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.als import (
+            _group_ratings, _solve_explicit,
+        )
+
+        _, _, _, uu, ii, rr = _synth(rng, n_u=20, n_i=12, f=3)
+        rr = np.abs(rr).astype(np.float32)
+        n_u, rank = 20, 3
+        y = np.abs(rng.normal(size=(12, rank))).astype(np.float32)
+        u_idx, u_val, u_msk, u_cnt = _group_ratings(uu, ii, rr, n_u)
+        out = np.asarray(_solve_explicit(
+            jnp.asarray(y), jnp.asarray(u_idx), jnp.asarray(u_val),
+            jnp.asarray(u_msk), jnp.asarray(u_cnt), jnp.float32(0.1), rank,
+            True,
+        ))
+        assert (out >= 0).all()
+        # per-row oracle: min ||Ax-b|| s.t. x>=0 via scipy on the SAME
+        # normal equations (Cholesky square root of A)
+        for u in range(n_u):
+            sel = uu == u
+            if not sel.any():
+                continue
+            yy = y[ii[sel]].astype(np.float64)
+            a = yy.T @ yy + 0.1 * sel.sum() * np.eye(rank)
+            b = yy.T @ rr[sel].astype(np.float64)
+            L = np.linalg.cholesky(a)
+            ref, _ = optimize.nnls(L.T, np.linalg.solve(L, b))
+            np.testing.assert_allclose(out[u], ref, atol=5e-3)
+
+    def test_end_to_end_nonnegative_fit(self, rng, mesh8):
+        U = np.abs(rng.normal(size=(40, 3)))
+        V = np.abs(rng.normal(size=(25, 3)))
+        mask = rng.uniform(size=(40, 25)) < 0.5
+        uu, ii = np.nonzero(mask)
+        rr = ((U @ V.T)[uu, ii] + 0.02 * rng.normal(size=len(uu))).astype(
+            np.float32
+        )
+        m = ht.ALS(rank=3, max_iter=12, reg_param=0.02, nonnegative=True,
+                   seed=0).fit((uu, ii, rr))
+        assert (m.user_factors >= 0).all() and (m.item_factors >= 0).all()
+        rmse = np.sqrt(np.mean((m.predict(uu, ii) - rr) ** 2))
+        assert rmse < 0.25 * rr.std()
+        # mesh == solo for the NNLS path too
+        md = ht.ALS(rank=3, max_iter=12, reg_param=0.02, nonnegative=True,
+                    seed=0).fit((uu, ii, rr), mesh=mesh8)
+        np.testing.assert_allclose(
+            md.user_factors, m.user_factors, rtol=2e-3, atol=2e-4
+        )
+
+    def test_implicit_nonnegative(self, rng):
+        _, _, _, uu, ii, rr = _synth(rng, n_u=25, n_i=15)
+        rr = np.abs(rr).astype(np.float32)
+        m = ht.ALS(rank=2, max_iter=6, implicit_prefs=True, nonnegative=True,
+                   seed=0).fit((uu, ii, rr))
+        assert (m.user_factors >= 0).all() and (m.item_factors >= 0).all()
