@@ -862,26 +862,19 @@ class GeneralizedLinearRegression(Estimator):
         self._validate_labels(y_host[w_host > 0], link, vp)
 
         # pass 0: moments → standardized ridge + ȳ for the μ-init (the
-        # shared out-of-core pre-pass kernel, parallel/outofcore.py)
-        from ..parallel.outofcore import block_moments
+        # shared out-of-core pre-pass, parallel/outofcore.py)
+        from ..parallel.outofcore import standardized_ridge, streamed_standardization
 
-        mom = None
-        for blk in hd.blocks(mesh):
-            s = block_moments(blk.x, blk.y, blk.w, extra="ysum")
-            mom = s if mom is None else add_stats(mom, s)
-        sw, sx, sxx, sy = (np.asarray(jax.device_get(v)) for v in mom)
-        n = max(float(sw), 1.0)
-        mean = sx / n
-        var = np.maximum(sxx / n - mean * mean, 0.0)
-        std = np.sqrt(np.maximum(var, 1e-12))
-        scale = std if self.standardize else np.ones_like(std)
+        n, _, std, sy = streamed_standardization(hd, mesh, extra="ysum")
         ybar = jnp.float32(sy / n)
-
         nfeat = hd.n_features
         dd = nfeat + (1 if self.fit_intercept else 0)
-        ridge_h = np.zeros((dd,), np.float32)
-        ridge_h[:nfeat] = self.reg_param * n * scale * scale
-        ridge = jnp.asarray(ridge_h)
+        ridge = jnp.asarray(
+            standardized_ridge(
+                n, std, self.reg_param, nfeat, self.fit_intercept,
+                self.standardize,
+            )
+        )
 
         theta = jnp.zeros((dd,), jnp.float32)
         it = 0

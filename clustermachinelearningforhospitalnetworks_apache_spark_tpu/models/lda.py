@@ -181,6 +181,10 @@ class LDA(Estimator):
             )
         if self.k < 2:
             raise ValueError(f"k must be >= 2, got {self.k}")
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(counts, HostDataset):
+            return self._fit_outofcore(counts, mesh)
         ds = as_device_dataset(counts, mesh=mesh)
         x_host_min = float(jax.device_get(jnp.min(ds.x)))
         if x_host_min < 0:
@@ -205,6 +209,68 @@ class LDA(Estimator):
             lam_hat = eta + sstats * expelog_beta
             rho = (self.learning_offset + t) ** (-self.learning_decay)
             lam = (1.0 - rho) * lam + rho * lam_hat
+        return LDAModel(
+            lam=np.asarray(jax.device_get(lam)),
+            alpha=float(alpha),
+            eta=float(eta),
+            n_docs_trained=float(n),
+            e_step_sweeps=self.e_step_sweeps,
+        )
+
+    def _fit_outofcore(self, hd, mesh=None) -> LDAModel:
+        """Docs ≫ HBM online VB — this is Hoffman's algorithm in its
+        NATIVE form: each update consumes one minibatch (here: one
+        streamed host block) with sufficient statistics scaled by
+        n/|batch|, blended at rate ρ_t.  The resident path trains
+        full-batch (every doc in every update); both converge to the
+        same variational objective, and Spark's online optimizer is
+        itself the minibatch form (miniBatchFraction).  Each block step
+        counts as one iteration (Spark's convention too)."""
+        from ..parallel.mesh import default_mesh
+
+        mesh = mesh or default_mesh()
+        if np.min(hd.x) < 0:
+            raise ValueError("LDA needs a non-negative term-count matrix")
+        w_host = (
+            np.asarray(hd.w) if hd.w is not None else np.ones(hd.n, np.float32)
+        )
+        n = int(np.sum(w_host > 0))
+        if n == 0:
+            raise ValueError("LDA fit on an empty dataset")
+        v = hd.n_features
+        alpha = (
+            self.doc_concentration
+            if self.doc_concentration is not None
+            else 1.0 / self.k
+        )
+        eta = (
+            self.topic_concentration
+            if self.topic_concentration is not None
+            else 1.0 / self.k
+        )
+        rng = np.random.default_rng(self.seed)
+        lam = jnp.asarray(
+            rng.gamma(100.0, 1.0 / 100.0, size=(self.k, v)).astype(np.float32)
+        )
+        n_blocks, b = hd.block_shape(mesh)
+        shuffle = np.random.default_rng(self.seed + 1)
+        t = 0
+        while t < self.max_iter:
+            perm = shuffle.permutation(n_blocks)
+            for i, blk in zip(perm, hd.blocks(mesh, order=perm)):
+                if t >= self.max_iter:
+                    break
+                s, e = int(i) * b, min(int(i) * b + b, hd.n)
+                bsz = max(float(np.sum(w_host[s:e] > 0)), 1.0)
+                expelog_beta = jnp.exp(_dirichlet_expectation(lam))
+                _, sstats = _e_step(
+                    blk.x.astype(jnp.float32), blk.w.astype(jnp.float32),
+                    expelog_beta, jnp.float32(alpha), self.e_step_sweeps,
+                )
+                lam_hat = eta + (n / bsz) * sstats * expelog_beta
+                rho = (self.learning_offset + t) ** (-self.learning_decay)
+                lam = (1.0 - rho) * lam + rho * lam_hat
+                t += 1
         return LDAModel(
             lam=np.asarray(jax.device_get(lam)),
             alpha=float(alpha),

@@ -481,7 +481,7 @@ class LogisticRegression(Estimator):
         time through the mesh.  The training ``summary`` is unavailable on
         this path (it would pin the full dataset on device)."""
         from ..parallel.mesh import default_mesh
-        from ..parallel.outofcore import add_stats, block_moments
+        from ..parallel.outofcore import add_stats
 
         mesh = mesh or default_mesh()
         if hd.y is None:
@@ -489,20 +489,12 @@ class LogisticRegression(Estimator):
         if hd.n == 0:
             raise ValueError("LogisticRegression fit on an empty dataset")
 
-        # pass 0: standardization moments (→ Spark's standardized-L2 ridge)
-        # + class count, via the shared out-of-core pre-pass kernel
+        # pass 0: standardization moments (→ Spark's standardized-L2
+        # ridge) + class count, via the shared out-of-core pre-pass
         # (parallel/outofcore.py; "ymax" accumulates by max, not add)
-        mom = None
-        ymax = 0.0
-        for blk in hd.blocks(mesh):
-            s = block_moments(blk.x, blk.y, blk.w, extra="ymax")
-            ymax = max(ymax, float(jax.device_get(s[3])))
-            mom = s[:3] if mom is None else add_stats(mom, s[:3])
-        sw, sx, sxx = (np.asarray(jax.device_get(v)) for v in mom)
-        n = max(float(sw), 1.0)
-        mean = sx / n
-        var = np.maximum(sxx / n - mean * mean, 0.0)
-        std = np.where(var > 1e-12, np.sqrt(np.maximum(var, 1e-12)), 1.0)
+        from ..parallel.outofcore import streamed_standardization
+
+        n, _, std, ymax = streamed_standardization(hd, mesh, extra="ymax")
         scale = std if self.standardize else np.ones_like(std)
         num_classes = int(ymax) + 1
 

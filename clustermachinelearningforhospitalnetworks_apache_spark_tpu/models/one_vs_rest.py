@@ -114,6 +114,42 @@ class OneVsRest(Estimator):
     def fit(self, data, label_col: str | None = None, mesh=None) -> OneVsRestModel:
         if self.classifier is None:
             raise ValueError("OneVsRest needs a classifier estimator")
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(data, HostDataset):
+            # pass-through composition: each one-vs-all fit streams blocks
+            # through the INNER estimator's own out-of-core path — the
+            # relabeled y is a host array, so the k sub-datasets cost
+            # nothing beyond the label vector
+            if data.y is None:
+                raise ValueError("OneVsRest needs labels: HostDataset(y=...)")
+            if getattr(self.classifier, "weight_col", None) is not None:
+                raise ValueError(
+                    "set weight_col on OneVsRest itself, not the inner "
+                    "classifier (the one-vs-all HostDataset already carries "
+                    "the weights)"
+                )
+            y_host = np.asarray(data.y)
+            w_host = (
+                np.asarray(data.w)
+                if data.w is not None
+                else np.ones(data.n, np.float32)
+            )
+            if not np.any(w_host > 0):
+                raise ValueError("OneVsRest fit on an empty dataset")
+            k = int(y_host[w_host > 0].max()) + 1
+            if k < 2:
+                raise ValueError("OneVsRest needs at least 2 classes")
+            models = []
+            for c in range(k):
+                sub = HostDataset(
+                    x=data.x,
+                    y=(y_host == float(c)).astype(np.float32),
+                    w=data.w,
+                    max_device_rows=data.max_device_rows,
+                )
+                models.append(self.classifier.fit(sub, mesh=mesh))
+            return OneVsRestModel(tuple(models))
         ds = as_device_dataset(
             data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
         )

@@ -40,6 +40,52 @@ add_stats = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
 from functools import partial as _partial
 
 
+def streamed_standardization(hd, mesh, extra: str = "none"):
+    """Stream the moments pre-pass over ``hd`` → (n, mean, std, extra).
+
+    The ONE implementation of the out-of-core standardization reduction
+    (GLM / logistic / SVC all consume it), including
+    ``weighted_moments``' degenerate-variance rule: a (near-)constant
+    feature gets std 1.0 so the L2 penalty applies at full strength —
+    three hand-rolled copies of this 15-line reduction had already let
+    that rule drift once.  ``extra``: "ysum" → 4th element Σw·y (GLM's
+    ȳ), "ymax" → max valid y accumulated by max on host (logistic's
+    class count), "none" → None."""
+    tot = None
+    ymax = 0.0
+    for blk in hd.blocks(mesh):
+        s = block_moments(blk.x, blk.y, blk.w, extra=extra)
+        if extra == "ymax":
+            ymax = max(ymax, float(jax.device_get(s[3])))
+            s = s[:3]
+        tot = s if tot is None else add_stats(tot, s)
+    parts = [np.asarray(jax.device_get(v)) for v in tot]
+    sw, sx, sxx = parts[0], parts[1], parts[2]
+    n = max(float(sw), 1.0)
+    mean = sx / n
+    var = np.maximum(sxx / n - mean * mean, 0.0)
+    std = np.where(var > 1e-12, np.sqrt(np.maximum(var, 1e-12)), 1.0)
+    if extra == "ymax":
+        return n, mean, std, ymax
+    if extra == "ysum":
+        return n, mean, std, float(parts[3])
+    return n, mean, std, None
+
+
+def standardized_ridge(
+    n: float, std: np.ndarray, reg_param: float, nfeat: int,
+    fit_intercept: bool, standardize: bool,
+) -> np.ndarray:
+    """Spark's standardized-L2 ridge vector (intercept unpenalized) from
+    the streamed moments — the out-of-core analogue of
+    ``standardized_design``'s ridge."""
+    scale = std if standardize else np.ones_like(std)
+    dd = nfeat + (1 if fit_intercept else 0)
+    ridge = np.zeros((dd,), np.float32)
+    ridge[:nfeat] = reg_param * n * scale * scale
+    return ridge
+
+
 @_partial(jax.jit, static_argnames=("extra",))
 def block_moments(x, y, w, extra: str = "none"):
     """One streamed block's standardization moments — the shared pre-pass

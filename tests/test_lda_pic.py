@@ -139,3 +139,29 @@ class TestPIC:
             ht.PowerIterationClustering(init_mode="ones").assign_clusters(
                 np.array([0]), np.array([1]), mesh=mesh8
             )
+
+
+def test_lda_outofcore_minibatch_recovers_topics(rng, mesh8):
+    """Docs >> HBM: the streamed minibatch form (Hoffman's native
+    algorithm) must recover the same disjoint topic structure."""
+    docs, zs, span = _topic_docs(rng)
+    m = ht.LDA(k=3, max_iter=60, seed=0).fit(
+        ht.HostDataset(x=docs.astype(np.float32), max_device_rows=64),
+        mesh=mesh8,
+    )
+    learned = m.topics_matrix().T
+    mass = np.zeros((3, 3))
+    for a in range(3):
+        for b in range(3):
+            mass[a, b] = learned[a, b * span : (b + 1) * span].sum()
+    assert (mass.max(axis=1) > 0.8).all()
+    assert len(set(mass.argmax(axis=1))) == 3
+    # perplexity evaluates on held-in docs
+    assert np.isfinite(m.log_perplexity(docs))
+
+
+def test_lda_outofcore_validation(mesh8):
+    with pytest.raises(ValueError, match="non-negative"):
+        ht.LDA(k=2).fit(
+            ht.HostDataset(x=-np.ones((8, 4), np.float32)), mesh=mesh8
+        )
