@@ -300,6 +300,46 @@ def _make_fit_loop(
     )
 
 
+@jax.jit
+def _bkm_lloyd_block(x, w, pos, cen, shift):
+    """One streamed block's 2-means sufficient stats for ALL splitting
+    leaves at once: each row belongs to leaf slot ``pos`` (−1 = not
+    splitting) and chooses the nearer of that leaf's two children in
+    ``cen`` (2L, d).  Euclidean argmin on (optionally unit-sphere) data
+    serves both distance measures — on the sphere it is monotone with
+    cosine distance, the same fact the resident scan uses."""
+    L = cen.shape[0] // 2
+    xb = x.astype(jnp.float32) - shift[None, :]
+    safe = jnp.clip(pos, 0, L - 1)
+    c0 = cen[2 * safe]
+    c1 = cen[2 * safe + 1]
+    d0 = jnp.sum((xb - c0) ** 2, axis=1)
+    d1 = jnp.sum((xb - c1) ** 2, axis=1)
+    bit = (d1 < d0).astype(jnp.int32)
+    child = 2 * safe + bit
+    live = ((pos >= 0) & (w > 0)).astype(jnp.float32) * w
+    oh = jax.nn.one_hot(child, cen.shape[0], dtype=jnp.float32) * live[:, None]
+    return oh.T @ xb, jnp.sum(oh, axis=0)
+
+
+@jax.jit
+def _bkm_stats_block(x, w, pos, cen, shift):
+    """Final per-level pass: child (counts, SSE) + each row's side bit."""
+    L = cen.shape[0] // 2
+    xb = x.astype(jnp.float32) - shift[None, :]
+    safe = jnp.clip(pos, 0, L - 1)
+    c0 = cen[2 * safe]
+    c1 = cen[2 * safe + 1]
+    d0 = jnp.sum((xb - c0) ** 2, axis=1)
+    d1 = jnp.sum((xb - c1) ** 2, axis=1)
+    bit = (d1 < d0).astype(jnp.int32)
+    child = 2 * safe + bit
+    live = ((pos >= 0) & (w > 0)).astype(jnp.float32) * w
+    oh = jax.nn.one_hot(child, cen.shape[0], dtype=jnp.float32) * live[:, None]
+    mind = jnp.where(bit == 1, d1, d0)
+    return jnp.sum(oh, axis=0), jnp.sum(oh * mind[:, None], axis=0), bit
+
+
 @register_model("BisectingKMeansModel")
 @dataclass
 class BisectingKMeansModel(KMeansModel):
@@ -332,6 +372,10 @@ class BisectingKMeans(Estimator):
 
     def fit(self, data, label_col: str | None = None, mesh=None) -> BisectingKMeansModel:
         mesh = mesh or default_mesh()
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(data, HostDataset):
+            return self._fit_outofcore(data, mesh)
         ds: DeviceDataset = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
         x = ds.x.astype(jnp.float32)
         cosine = self.distance_measure == "cosine"
@@ -378,4 +422,208 @@ class BisectingKMeans(Estimator):
             training_cost=float(sse[keep].sum()),
             n_iter=int(n_splits),
             cluster_sizes=np.asarray(sizes)[keep],
+        )
+
+    def _fit_outofcore(self, hd, mesh=None) -> BisectingKMeansModel:
+        """Rows ≫ HBM hierarchical bisection: the SAME level algorithm
+        with the per-row leaf assignment carried on HOST (n int32 — tiny
+        next to the host-resident matrix itself) and every Lloyd
+        iteration / stats pass a streamed block sweep.  All cluster math
+        runs recentered around the global mean exactly like the resident
+        shard_map loop (same f32-cancellation argument), children are
+        seeded from the same ``fold_in(key, level)`` draws, and the
+        level bookkeeping (priority, min-size gate, failed-split
+        pinning) is the resident logic in host numpy — so both paths
+        walk the same split tree up to block-sum rounding."""
+        from ..parallel.mesh import default_mesh as _dm
+        from ..parallel.outofcore import add_stats, block_moments
+        from ..parallel.sharding import replicate, shard_rows
+
+        mesh = mesh or _dm()
+        if self.strategy not in ("level", "sequential"):
+            raise ValueError(f"unknown strategy {self.strategy!r}")
+        sequential = self.strategy == "sequential"
+        cosine = self.distance_measure == "cosine"
+        k = self.k
+        L = 1 if sequential else 1 << (max(1, k // 2) - 1).bit_length()
+        d = hd.n_features
+        if hd.n == 0:
+            raise ValueError("BisectingKMeans fit on an empty dataset")
+
+        from .kmeans import _cosine_prep
+
+        def prep(blk):
+            return _cosine_prep(blk.x, blk.w) if cosine else blk.x
+
+        # pass 0: global mean → recentering shift; root center + SSE
+        mom = None
+        for blk in hd.blocks(mesh):
+            # w doubles as the (ignored) y slot — clustering blocks carry
+            # no labels and block_moments touches y only for extra stats
+            s = block_moments(prep(blk), blk.w, blk.w)
+            mom = s if mom is None else add_stats(mom, s)
+        sw = max(float(jax.device_get(mom[0])), 0.0)
+        if sw == 0.0:
+            raise ValueError("BisectingKMeans fit on an empty dataset")
+        mean = np.asarray(jax.device_get(mom[1])) / max(sw, 1.0)
+        shift = np.zeros((d,), np.float32) if cosine else mean.astype(np.float32)
+        root = (mean.astype(np.float32) - shift)
+        if cosine:
+            root = root / max(np.linalg.norm(root), 1e-12)
+        shift_dev = replicate(shift, mesh)
+
+        root_cen = replicate(
+            np.broadcast_to(root, (2, d)).astype(np.float32).copy(), mesh
+        )
+        tot = None
+        for i, blk in enumerate(hd.blocks(mesh)):
+            pos_b = np.zeros((blk.x.shape[0],), np.int32)
+            _, csse, _ = _bkm_stats_block(
+                prep(blk), blk.w, shard_rows(pos_b, mesh), root_cen, shift_dev
+            )
+            tot = csse if tot is None else add_stats(tot, csse)
+        root_sse = float(np.asarray(jax.device_get(tot)).sum())
+
+        is_frac = self.min_divisible_cluster_size < 1.0
+        min_size = max(
+            self.min_divisible_cluster_size * sw
+            if is_frac
+            else self.min_divisible_cluster_size,
+            2.0,
+        )
+
+        centers = np.zeros((k + 1, d), np.float32)
+        centers[0] = root
+        sizes = np.zeros((k + 1,), np.float32)
+        sizes[0] = sw
+        sse = np.zeros((k + 1,), np.float32)
+        sse[0] = root_sse
+        divisible = np.zeros((k + 1,), bool)
+        divisible[0] = True
+        assign = np.zeros((hd.n,), np.int32)
+        key = jax.random.PRNGKey(self.seed)
+        _, b = hd.block_shape(mesh)
+        n_leaves, n_splits, level = 1, 0, 0
+
+        while n_leaves < k:
+            cand = divisible[:k] & (sizes[:k] >= min_size)
+            if not cand.any():
+                break
+            priority = sse[:k] if sequential else sizes[:k]
+            order = np.argsort(-np.where(cand, priority, -1.0), kind="stable")
+            sel = order[:L]
+            slot_valid = (np.arange(L) < (k - n_leaves)) & cand[sel]
+            slot_of = np.full((k + 1,), -1, np.int32)
+            slot_of[sel] = np.where(slot_valid, np.arange(L, dtype=np.int32), -1)
+
+            radius = np.sqrt(
+                np.maximum(sse[sel], 1e-12) / np.maximum(sizes[sel], 1.0)
+            )
+            dirs = np.asarray(
+                jax.random.normal(jax.random.fold_in(key, level), (L, d)),
+                np.float32,
+            )
+            dirs = dirs / np.maximum(
+                np.linalg.norm(dirs, axis=1, keepdims=True), 1e-12
+            ) * radius[:, None]
+            parents = centers[sel]
+            cen = np.stack(
+                [parents + 0.5 * dirs, parents - 0.5 * dirs], axis=1
+            ).reshape(2 * L, d)
+            if cosine:
+                cen = np.asarray(jax.device_get(normalize_rows(jnp.asarray(cen))))
+            cen_dev = replicate(cen.astype(np.float32), mesh)
+
+            def block_pos(i: int, rows: int) -> np.ndarray:
+                s, e = i * b, min(i * b + b, hd.n)
+                p = np.full((rows,), -1, np.int32)
+                p[: e - s] = slot_of[np.clip(assign[s:e], 0, k)]
+                return p
+
+            for _ in range(self.max_iter):
+                tot = None
+                for i, blk in enumerate(hd.blocks(mesh)):
+                    pos_b = block_pos(i, blk.x.shape[0])
+                    s2 = _bkm_lloyd_block(
+                        prep(blk), blk.w, shard_rows(pos_b, mesh),
+                        cen_dev, shift_dev,
+                    )
+                    tot = s2 if tot is None else add_stats(tot, s2)
+                sums, counts = (np.asarray(jax.device_get(v)) for v in tot)
+                new_cen = np.where(
+                    (counts > 0)[:, None],
+                    sums / np.maximum(counts, 1.0)[:, None],
+                    cen,
+                )
+                if cosine:
+                    new_cen = np.asarray(
+                        jax.device_get(normalize_rows(jnp.asarray(new_cen)))
+                    )
+                valid2 = np.repeat(slot_valid, 2)
+                move = float(
+                    np.max(np.sum((new_cen - cen) ** 2, axis=1) * valid2)
+                )
+                cen = new_cen.astype(np.float32)
+                cen_dev = replicate(cen, mesh)
+                if move <= 1e-8:
+                    break
+
+            counts_t = sse_t = None
+            bits_blocks = []
+            for i, blk in enumerate(hd.blocks(mesh)):
+                pos_b = block_pos(i, blk.x.shape[0])
+                c, cs, bit = _bkm_stats_block(
+                    prep(blk), blk.w, shard_rows(pos_b, mesh),
+                    cen_dev, shift_dev,
+                )
+                counts_t = c if counts_t is None else add_stats(counts_t, c)
+                sse_t = cs if sse_t is None else add_stats(sse_t, cs)
+                bits_blocks.append((i, pos_b, np.asarray(jax.device_get(bit))))
+            counts2 = np.asarray(jax.device_get(counts_t)).reshape(L, 2)
+            csse2 = np.asarray(jax.device_get(sse_t)).reshape(L, 2)
+            cen2 = cen.reshape(L, 2, d)
+
+            succ = slot_valid & (counts2[:, 1] > 0)
+            new_id = np.where(
+                succ, n_leaves + np.cumsum(succ.astype(np.int32)) - 1, k
+            ).astype(np.int32)
+            for i, pos_b, bit in bits_blocks:
+                s, e = i * b, min(i * b + b, hd.n)
+                p = pos_b[: e - s]
+                bt = bit[: e - s]
+                safe_p = np.clip(p, 0, L - 1)
+                relabel = (p >= 0) & (bt == 1) & succ[safe_p]
+                if relabel.any():
+                    seg = assign[s:e]
+                    seg[relabel] = new_id[safe_p[relabel]]
+                    assign[s:e] = seg
+
+            upd = sel[succ]
+            centers[upd] = cen2[succ, 0]
+            sizes[upd] = counts2[succ, 0]
+            sse[upd] = csse2[succ, 0]
+            divisible[sel[slot_valid]] = (
+                succ[slot_valid] & (counts2[slot_valid, 0] > 0)
+            )
+            nid = new_id[succ]
+            centers[nid] = cen2[succ, 1]
+            sizes[nid] = counts2[succ, 1]
+            sse[nid] = csse2[succ, 1]
+            divisible[nid] = True
+            grown = int(succ.sum())
+            n_leaves += grown
+            n_splits += grown
+            level += 1
+            if grown == 0 and not divisible[:k].any():
+                break
+
+        keep = np.flatnonzero(sizes[:k] > 0)
+        return BisectingKMeansModel(
+            cluster_centers=(centers[:k] + shift[None, :])[keep].astype(
+                np.float32
+            ),
+            distance_measure=self.distance_measure,
+            training_cost=float(sse[:k][keep].sum()),
+            n_iter=int(n_splits),
+            cluster_sizes=sizes[:k][keep],
         )

@@ -931,3 +931,64 @@ def test_constant_feature_ridge_matches_resident(mesh8, rng):
             np.asarray(ooc.coefficients), np.asarray(res.coefficients),
             rtol=5e-3, atol=5e-4,
         )
+
+
+class TestBisectingOutOfCore:
+    """Round-5: the last family without a streaming path.  Host-carried
+    leaf assignments + streamed Lloyd/stats sweeps walk the same split
+    tree as the resident shard_map loop."""
+
+    def _blobs(self, rng, n_per=400, k=6, d=4):
+        cs = rng.normal(0, 8, size=(k, d))
+        return np.concatenate(
+            [rng.normal(c, 0.5, size=(n_per, d)) for c in cs]
+        ).astype(np.float32)
+
+    @pytest.mark.parametrize("strategy", ["level", "sequential"])
+    @pytest.mark.parametrize("dm", ["euclidean", "cosine"])
+    def test_matches_resident(self, mesh8, rng, strategy, dm):
+        x = self._blobs(rng)
+        res = ht.BisectingKMeans(
+            k=6, seed=0, strategy=strategy, distance_measure=dm
+        ).fit(x, mesh=mesh8)
+        ooc = ht.BisectingKMeans(
+            k=6, seed=0, strategy=strategy, distance_measure=dm
+        ).fit(HostDataset(x=x, max_device_rows=300), mesh=mesh8)
+        a = np.asarray(sorted(res.cluster_centers.tolist()))
+        b = np.asarray(sorted(ooc.cluster_centers.tolist()))
+        assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, atol=2e-2)
+        np.testing.assert_allclose(
+            res.training_cost, ooc.training_cost, rtol=1e-3
+        )
+
+    def test_weights_and_min_divisible(self, mesh8, rng):
+        x = self._blobs(rng, n_per=200, k=4)
+        w = rng.uniform(0.5, 2.0, size=len(x)).astype(np.float32)
+        res = ht.BisectingKMeans(
+            k=4, seed=1, min_divisible_cluster_size=50.0
+        ).fit((x, None, w), mesh=mesh8)       # resident WEIGHTED baseline
+        ooc = ht.BisectingKMeans(
+            k=4, seed=1, min_divisible_cluster_size=50.0
+        ).fit(HostDataset(x=x, w=w, max_device_rows=256), mesh=mesh8)
+        a = np.asarray(sorted(res.cluster_centers.tolist()))
+        b = np.asarray(sorted(ooc.cluster_centers.tolist()))
+        assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, atol=5e-2)
+        np.testing.assert_allclose(ooc.cluster_sizes.sum(), w.sum(), rtol=1e-4)
+
+    def test_zero_row_dataset_raises(self, mesh8):
+        with pytest.raises(ValueError, match="empty"):
+            ht.BisectingKMeans(k=2).fit(
+                HostDataset(x=np.zeros((0, 3), np.float32)), mesh=mesh8
+            )
+
+    def test_empty_raises_bkm(self, mesh8):
+        with pytest.raises(ValueError, match="empty"):
+            ht.BisectingKMeans(k=2).fit(
+                HostDataset(
+                    x=np.ones((4, 2), np.float32),
+                    w=np.zeros((4,), np.float32),
+                ),
+                mesh=mesh8,
+            )
