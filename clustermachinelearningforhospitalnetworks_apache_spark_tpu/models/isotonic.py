@@ -96,18 +96,51 @@ class IsotonicRegression(Estimator):
     features_col: str = "features"
     weight_col: str | None = None
 
-    def fit(self, data, label_col: str | None = None, mesh=None) -> IsotonicRegressionModel:
-        ds = as_device_dataset(
-            data, label_col or self.label_col, mesh=mesh, weight_col=self.weight_col
-        )
-        if not 0 <= self.feature_index < ds.n_features:
+    def _check_feature_index(self, n_features: int) -> None:
+        if not 0 <= self.feature_index < n_features:
             raise ValueError(
                 f"feature_index {self.feature_index} out of range "
-                f"[0, {ds.n_features})"
+                f"[0, {n_features})"
             )
-        x = np.asarray(jax.device_get(ds.x))[:, self.feature_index].astype(np.float64)
-        y = np.asarray(jax.device_get(ds.y), dtype=np.float64)
-        w = np.asarray(jax.device_get(ds.w), dtype=np.float64)
+
+    def fit(self, data, label_col: str | None = None, mesh=None) -> IsotonicRegressionModel:
+        from ..parallel.outofcore import HostDataset
+
+        if isinstance(data, HostDataset):
+            # Isotonic consumes ONE feature column + labels + weights —
+            # 1-D host vectors regardless of how wide or HBM-oversized
+            # the matrix is, and PAVA is host work anyway (a sort +
+            # reduceat).  So the out-of-core path never stages anything:
+            # it slices the column straight out of the host (possibly
+            # memmap) matrix.  The f32 round-trip mirrors the device
+            # path's staging cast, so both paths pool the SAME distinct
+            # x values on float64 input.
+            if data.y is None:
+                raise ValueError(
+                    "IsotonicRegression needs labels: HostDataset(y=...)"
+                )
+            self._check_feature_index(data.n_features)
+            x = (
+                np.asarray(data.x[:, self.feature_index], np.float32)
+                .astype(np.float64)
+            )
+            y = np.asarray(data.y, np.float32).astype(np.float64)
+            w = (
+                np.asarray(data.w, np.float32).astype(np.float64)
+                if data.w is not None
+                else np.ones(data.n, np.float64)
+            )
+        else:
+            ds = as_device_dataset(
+                data, label_col or self.label_col, mesh=mesh,
+                weight_col=self.weight_col,
+            )
+            self._check_feature_index(ds.n_features)
+            x = np.asarray(jax.device_get(ds.x))[:, self.feature_index].astype(
+                np.float64
+            )
+            y = np.asarray(jax.device_get(ds.y), dtype=np.float64)
+            w = np.asarray(jax.device_get(ds.w), dtype=np.float64)
         valid = w > 0
         x, y, w = x[valid], y[valid], w[valid]
         if x.size == 0:

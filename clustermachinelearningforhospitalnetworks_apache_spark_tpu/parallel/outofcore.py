@@ -140,6 +140,11 @@ class HostDataset:
                     f"HostDataset.{name} has {v.shape[0]} rows but x has "
                     f"{self.x.shape[0]}"
                 )
+        # same contract the device staging path enforces (sharding.py):
+        # a negative weight silently flips reductions, so fail at
+        # construction on EVERY estimator's out-of-core path at once
+        if self.w is not None and np.any(np.asarray(self.w) < 0):
+            raise ValueError("sample weights must be non-negative")
         if self.max_device_rows < 1:
             raise ValueError("max_device_rows must be >= 1")
 

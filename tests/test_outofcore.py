@@ -992,3 +992,31 @@ class TestBisectingOutOfCore:
                 ),
                 mesh=mesh8,
             )
+
+
+def test_isotonic_hostdataset_identical(mesh8, rng):
+    """Isotonic consumes one 1-D column; the HostDataset path slices it
+    host-side with zero device staging and must match exactly."""
+    n = 3000
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (np.sort(rng.normal(size=n)) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    x[:, 1] = np.sort(x[:, 1])     # monotone-ish feature 1
+    res = ht.IsotonicRegression(feature_index=1).fit((x, y), mesh=mesh8)
+    ooc = ht.IsotonicRegression(feature_index=1).fit(
+        HostDataset(x=x, y=y, max_device_rows=256), mesh=mesh8
+    )
+    np.testing.assert_array_equal(res.boundaries, ooc.boundaries)
+    np.testing.assert_array_equal(res.predictions, ooc.predictions)
+    with pytest.raises(ValueError, match="labels"):
+        ht.IsotonicRegression().fit(HostDataset(x=x), mesh=mesh8)
+
+
+def test_hostdataset_negative_weights_rejected():
+    """Review regression: the device staging path rejects negative
+    weights; HostDataset must enforce the same contract at construction
+    for every estimator's streaming path at once."""
+    with pytest.raises(ValueError, match="non-negative"):
+        HostDataset(
+            x=np.ones((4, 2), np.float32),
+            w=np.array([1.0, -1.0, 1.0, 1.0], np.float32),
+        )
