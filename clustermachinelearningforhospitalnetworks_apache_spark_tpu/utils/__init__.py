@@ -1,6 +1,6 @@
 from .logging import Logger, configure_logging, get_logger
 from .metrics import MetricsRegistry, StageTiming, global_metrics
-from .profiling import block_until_ready, capture_trace, trace_annotation
+from .profiling import block_until_ready, capture_trace, device_fence, trace_annotation
 
 __all__ = [
     "Logger",
@@ -10,6 +10,7 @@ __all__ = [
     "StageTiming",
     "global_metrics",
     "block_until_ready",
+    "device_fence",
     "capture_trace",
     "trace_annotation",
 ]

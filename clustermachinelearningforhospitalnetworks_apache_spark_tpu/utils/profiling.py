@@ -31,6 +31,57 @@ def capture_trace(log_dir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
+def device_fence(*objs) -> None:
+    """Hard execution fence — the canonical one (bench.py uses this too).
+
+    On proxied/tunneled TPU backends (e.g. the experimental "axon"
+    platform) dispatch is fully asynchronous and ``jax.block_until_ready``
+    can return before the device has executed anything (measured: 0.4 ms
+    "fenced" vs 204 s of real execution for the same enqueued program;
+    docs/ARCHITECTURE.md, round-5 fencing discovery).  Fetching result
+    bytes is the only barrier that provably drains such a queue, so this
+    fence collects every device-array leaf — small arrays whole, large
+    ones as a one-element slice (which still forces the producing chain),
+    size-0 leaves skipped (already materialized) — and pulls them in ONE
+    batched ``device_get``, so the cost is a single round trip no matter
+    how many leaves.  Accepts jax arrays, pytrees, containers, and model
+    objects (``__dict__`` scanned recursively a few levels, so nested
+    composites like OneVsRest sub-models are drained too)."""
+    pulls: list = []
+
+    def collect(a) -> None:
+        if isinstance(a, jax.Array) and a.size:
+            pulls.append(a if a.size <= (1 << 16) else a[(0,) * a.ndim])
+
+    def visit(o, depth: int) -> None:
+        if isinstance(o, jax.Array):
+            collect(o)
+        elif depth <= 0:
+            return  # cyclic/deep object graphs stop here
+        elif isinstance(o, (list, tuple)):
+            for v in o:
+                visit(v, depth - 1)
+        elif isinstance(o, dict):
+            for v in o.values():
+                visit(v, depth - 1)
+        elif hasattr(o, "__dict__"):
+            for v in vars(o).values():
+                visit(v, depth - 1)
+        else:
+            for leaf in jax.tree_util.tree_leaves(o):
+                collect(leaf)
+
+    for o in objs:
+        visit(o, 6)
+    if pulls:
+        jax.device_get(pulls)  # returns materialized ndarrays — the fence
+
+
 def block_until_ready(tree):
-    """Barrier helper so stage timings measure device work, not dispatch."""
-    return jax.block_until_ready(tree)
+    """Barrier helper so stage timings measure device work, not dispatch.
+
+    Delegates to :func:`device_fence`, which unlike
+    ``jax.block_until_ready`` is a guaranteed fence on async-dispatch
+    proxy backends (see its docstring)."""
+    device_fence(tree)
+    return tree
