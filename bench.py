@@ -174,6 +174,13 @@ def _fence(*objs) -> None:
 _MIN_WINDOW_S = 2.0
 
 
+def _calibrated_count(count: int, dt: float, cap: int) -> int:
+    """Scale a repetition count so the next window is ≥ ``_MIN_WINDOW_S``
+    — the ONE copy of the calibration formula (window protocol docstring
+    in :func:`_timed_windows`; ``_make_timed`` shares it)."""
+    return min(int(count * _MIN_WINDOW_S / max(dt, 0.05)) + 1, cap)
+
+
 def _timed_windows(run_iters, n: int, iters: int, windows: int,
                    calibrate: bool = True):
     """(median_rate, per-window rates) over calibrated timed windows.
@@ -187,7 +194,7 @@ def _timed_windows(run_iters, n: int, iters: int, windows: int,
     dt = run_iters(iters)
     rates = [n * iters / dt]
     if calibrate and dt < _MIN_WINDOW_S:
-        iters = min(int(iters * _MIN_WINDOW_S / max(dt, 0.05)) + 1, 512)
+        iters = _calibrated_count(iters, dt, cap=512)
         rates = []  # calibration window too short to count
     while len(rates) < windows:
         dt = run_iters(iters)
@@ -222,9 +229,9 @@ def _make_timed(fit_once, units_per_fit: float, n_chips: int,
             if not state["calibrated"]:
                 state["calibrated"] = True
                 if dt < _MIN_WINDOW_S:
-                    state["reps"] = min(
-                        int(reps * _MIN_WINDOW_S / max(dt, 0.05)) + 1, 256
-                    )
+                    # lower cap than _timed_windows' 512: each rep is a
+                    # whole fit, not one Lloyd step
+                    state["reps"] = _calibrated_count(reps, dt, cap=256)
                     continue  # discard the short calibration window
             return units / dt / n_chips
 

@@ -34,6 +34,7 @@ from jax.scipy.special import logsumexp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..io.model_io import register_model
+from ..ops.distance import MATMUL_PRECISIONS, matmul_p
 from ..parallel.mesh import DATA_AXIS, default_mesh
 from ..parallel.outofcore import add_stats as _gmm_add_stats
 from ..parallel.sharding import DeviceDataset
@@ -51,6 +52,41 @@ def _chol_log_pdf(x, mean, chol):
     return -0.5 * (d * jnp.log(2.0 * jnp.pi) + logdet + maha)
 
 
+def _pdf_factors(means, chols):
+    """→ (W (d, k·d), offset (k, d), const (k,)) for the matmul E-step.
+
+    With L⁻¹ the inverse Cholesky factor, maha_k(x) = ‖x·L_k⁻ᵀ −
+    mean_k·L_k⁻ᵀ‖²; stacking L⁻ᵀ over components turns the per-component
+    triangular solves of :func:`_chol_log_pdf` (VPU work, k·d² per row)
+    into ONE (chunk, d) @ (d, k·d) MXU matmul per row chunk.  The k
+    (d, d) inversions run once per EM iteration, outside the row scan."""
+    k, d = means.shape
+    eye = jnp.eye(d, dtype=jnp.float32)
+    linv = jax.vmap(
+        lambda L: jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    )(chols)                                      # (k, d, d) = L⁻¹
+    linvT = jnp.transpose(linv, (0, 2, 1))        # [k, i, j] = L⁻ᵀ entries
+    w_fac = jnp.transpose(linvT, (1, 0, 2)).reshape(d, k * d)
+    offset = jnp.einsum("kd,kde->ke", means, linvT)
+    logdet = 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(chols, axis1=1, axis2=2)), axis=1
+    )
+    const = -0.5 * (d * jnp.log(2.0 * jnp.pi) + logdet)
+    return w_fac, offset, const
+
+
+def _batched_log_pdf(xb, w_fac, offset, const, precision="highest"):
+    """(chunk, k) log-densities via the precomputed :func:`_pdf_factors`
+    — identical values to the ``vmap(_chol_log_pdf)`` form (modulo matmul
+    rounding), but the hot op is an MXU matmul instead of per-component
+    triangular solves."""
+    k, d = offset.shape
+    xw = matmul_p(xb, w_fac, precision).reshape(-1, k, d)
+    y = xw - offset[None]
+    maha = jnp.sum(y * y, axis=-1)
+    return const[None, :] - 0.5 * maha
+
+
 @partial(jax.jit, static_argnames=())
 def _e_step(x, w, log_weights, means, chols):
     """Full-table responsibilities — model-side scoring only (``score``,
@@ -63,30 +99,50 @@ def _e_step(x, w, log_weights, means, chols):
     return resp, log_likelihood
 
 
-def _em_pass_builder(k: int, d: int):
+def _em_pass_builder(k: int, d: int, precision: str = "highest"):
     """Chunk-scan E-step sufficient statistics (nk, Σr·x, Σr·xxᵀ, ll),
     psum'd over the data axis — shared by the fused resident EM loop and
-    the out-of-core block-stats step."""
+    the out-of-core block-stats step.
+
+    ``precision`` drives the log-pdf computation AND the moment
+    contractions.  The default "highest" keeps the triangular-solve
+    log-pdf (diff-first: forming x − mean_k before the L⁻¹ transform is
+    stable when component separations dwarf within-component scale) with
+    exact-f32 matmul emulation.  The throughput modes ("high"/"default"/
+    "bf16") switch the log-pdf to the :func:`_pdf_factors` matmul form —
+    one (chunk, d) @ (d, k·d) MXU contraction per chunk instead of
+    per-component VPU solves — which subtracts in the transformed basis
+    and therefore trades that extreme-offset stability guard for MXU
+    rate, on top of the reduced matmul precision the caller already
+    opted into (the global-mean recentering shift still absorbs a
+    common offset)."""
+    use_factors = precision != "highest"
 
     def em_pass(x_c, w_c, shift, logw, means, chols):
+        if use_factors:
+            # Per-iteration factor precompute (k triangular inversions) —
+            # outside the row scan, so the per-chunk hot op is one matmul.
+            w_fac, offset, const = _pdf_factors(means, chols)
+
         def body(carry, inputs):
             nk, sums, outer, ll = carry
             xb, wb = inputs
             xb = xb - shift[None, :]
-            log_pdf = jax.vmap(lambda m, L: _chol_log_pdf(xb, m, L))(means, chols).T
+            if use_factors:
+                log_pdf = _batched_log_pdf(xb, w_fac, offset, const, precision)
+            else:
+                log_pdf = jax.vmap(
+                    lambda m, L: _chol_log_pdf(xb, m, L)
+                )(means, chols).T
             log_resp_un = log_pdf + logw[None, :]
             log_norm = logsumexp(log_resp_un, axis=1)
             resp = jnp.exp(log_resp_un - log_norm[:, None]) * wb[:, None]  # (c, k)
             nk = nk + jnp.sum(resp, axis=0)
-            sums = sums + jnp.dot(
-                resp.T, xb, precision=lax.Precision.HIGHEST
-            )
+            sums = sums + matmul_p(resp.T, xb, precision)
             # (chunk, d·d) row outer products against (chunk, k) resp —
             # an MXU matmul instead of an (n, k, d, d)-shaped einsum.
             xx = (xb[:, :, None] * xb[:, None, :]).reshape(-1, d * d)
-            outer = outer + jnp.dot(
-                resp.T, xx, precision=lax.Precision.HIGHEST
-            ).reshape(k, d, d)
+            outer = outer + matmul_p(resp.T, xx, precision).reshape(k, d, d)
             ll = ll + jnp.sum(log_norm * wb)
             return (nk, sums, outer, ll), None
 
@@ -126,7 +182,8 @@ def _m_step_rule(nk, sums, outer, reg_covar):
 
 @lru_cache(maxsize=32)
 def _make_em_loop(
-    mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int, max_iter: int
+    mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int, max_iter: int,
+    precision: str = "highest",
 ):
     """The whole EM fit as one jitted shard_map computation.
 
@@ -137,7 +194,7 @@ def _make_em_loop(
     """
     n_chunks, chunk = _chunked(n_loc, chunk_rows)
     pad_to = n_chunks * chunk
-    em_pass = _em_pass_builder(k, d)
+    em_pass = _em_pass_builder(k, d, precision)
 
     def shard_fn(x, w, shift, means, covs, weights, reg_covar, tol):
         xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
@@ -212,13 +269,16 @@ def _init_params(valid: np.ndarray, k: int, d: int, seed: int, reg_covar: float)
 
 
 @lru_cache(maxsize=32)
-def _make_em_stats_step(mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int):
+def _make_em_stats_step(
+    mesh: Mesh, n_loc: int, k: int, d: int, chunk_rows: int,
+    precision: str = "highest",
+):
     """Per-BLOCK E-step sufficient statistics (nk, Σr·x, Σr·xxᵀ, ll) —
     the out-of-core driver accumulates these across host row blocks, then
     applies one :func:`_gmm_m_step` per EM iteration."""
     n_chunks, chunk = _chunked(n_loc, chunk_rows)
     pad_to = n_chunks * chunk
-    em_pass = _em_pass_builder(k, d)
+    em_pass = _em_pass_builder(k, d, precision)
 
     def shard_fn(x, w, shift, logw, means, chols):
         xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
@@ -413,6 +473,14 @@ class GaussianMixture(Estimator):
     checkpoint_dir: str | None = None
     checkpoint_every: int = 5
     weight_col: str | None = None  # Spark's weightCol (3.0+)
+    # Matmul mode for the E-step log-pdf + moment contractions — same
+    # naming as KMeans.matmul_precision.  Default "highest" keeps the
+    # exact-f32, solve-form E-step (round-4 behavior, bit-comparable).
+    # The throughput modes use the matmul-factor E-step; note that under
+    # them the convergence log-likelihood is itself computed at reduced
+    # matmul precision, so a tol much below the mode's rounding noise
+    # (~1e-2 relative for "bf16") stops on noise, not EM progress.
+    matmul_precision: str = "highest"
 
     def fit(
         self, data, label_col: str | None = None, mesh=None, on_iteration=None
@@ -425,6 +493,11 @@ class GaussianMixture(Estimator):
         ``max_device_rows`` blocks per EM iteration."""
         from ..parallel.outofcore import HostDataset
 
+        if self.matmul_precision not in MATMUL_PRECISIONS:
+            raise ValueError(
+                f"matmul_precision must be one of {MATMUL_PRECISIONS}, got "
+                f"{self.matmul_precision!r}"
+            )
         mesh = mesh or default_mesh()
         if isinstance(data, HostDataset):
             return self._fit_outofcore(data, mesh, on_iteration)
@@ -498,7 +571,7 @@ class GaussianMixture(Estimator):
             # (single host sync instead of one per iteration).
             loop = _make_em_loop(
                 mesh, n_loc, self.k, d, self.chunk_rows,
-                self.max_iter - (start_it - 1),
+                self.max_iter - (start_it - 1), self.matmul_precision,
             )
             means_d, covs_d, weights_d, ll_dev, it_dev = loop(
                 x, w, shift_d, means_d, covs_d, weights_d,
@@ -509,7 +582,10 @@ class GaussianMixture(Estimator):
         else:
             # Host-hook path: one EM iteration per device call (the
             # max_iter=1 loop never re-enters its while body).
-            step = _make_em_loop(mesh, n_loc, self.k, d, self.chunk_rows, 1)
+            step = _make_em_loop(
+                mesh, n_loc, self.k, d, self.chunk_rows, 1,
+                self.matmul_precision,
+            )
             for it in range(start_it, self.max_iter + 1):
                 means_d, covs_d, weights_d, ll_dev, _ = step(
                     x, w, shift_d, means_d, covs_d, weights_d,
@@ -602,7 +678,9 @@ class GaussianMixture(Estimator):
 
         _, b = hd.block_shape(mesh)
         n_loc = b // mesh.shape[DATA_AXIS]
-        step = _make_em_stats_step(mesh, n_loc, self.k, d, self.chunk_rows)
+        step = _make_em_stats_step(
+            mesh, n_loc, self.k, d, self.chunk_rows, self.matmul_precision
+        )
 
         ll = prev_ll_resume if np.isfinite(prev_ll_resume) else 0.0
         prev_ll = prev_ll_resume

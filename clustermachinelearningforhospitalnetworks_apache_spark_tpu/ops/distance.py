@@ -33,6 +33,21 @@ def sq_norms(x: jax.Array) -> jax.Array:
 MATMUL_PRECISIONS = ("highest", "high", "default", "bf16")
 
 
+def matmul_p(a: jax.Array, b: jax.Array, precision) -> jax.Array:
+    """``a @ b`` under a :data:`MATMUL_PRECISIONS` mode — the one copy of
+    the bf16-truncate/f32-accumulate vs ``lax.Precision`` dispatch shared
+    by the assignment matmul here and the GMM E-step contractions."""
+    if precision == "bf16":
+        return jnp.dot(
+            a.astype(jnp.bfloat16),
+            b.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+    if isinstance(precision, str):
+        precision = lax.Precision(precision.lower())
+    return jnp.dot(a, b, precision=precision)
+
+
 def pairwise_sqdist(
     x: jax.Array,
     centers: jax.Array,
@@ -49,16 +64,7 @@ def pairwise_sqdist(
         x_sq = sq_norms(x)
     if c_sq is None:
         c_sq = sq_norms(centers)
-    if precision == "bf16":
-        cross = jnp.dot(
-            x.astype(jnp.bfloat16),
-            centers.astype(jnp.bfloat16).T,
-            preferred_element_type=jnp.float32,
-        )
-    else:
-        if isinstance(precision, str):
-            precision = lax.Precision(precision.lower())
-        cross = jnp.dot(x, centers.T, precision=precision)
+    cross = matmul_p(x, centers.T, precision)
     d2 = x_sq[:, None] - 2.0 * cross + c_sq[None, :]
     return jnp.maximum(d2, 0.0)
 

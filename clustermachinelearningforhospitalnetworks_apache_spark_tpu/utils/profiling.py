@@ -47,14 +47,20 @@ def device_fence(*objs) -> None:
     how many leaves.  Accepts jax arrays, pytrees, containers, and model
     objects (``__dict__`` scanned recursively a few levels, so nested
     composites like OneVsRest sub-models are drained too)."""
+    import numpy as _np
+
     pulls: list = []
+    seen_host = [False]  # host ndarrays are already materialized — not a
+    # missed fence, so their presence suppresses the no-leaves warning
 
     def collect(a) -> None:
         if isinstance(a, jax.Array) and a.size:
             pulls.append(a if a.size <= (1 << 16) else a[(0,) * a.ndim])
 
     def visit(o, depth: int) -> None:
-        if isinstance(o, jax.Array):
+        if isinstance(o, _np.ndarray):
+            seen_host[0] = True
+        elif isinstance(o, jax.Array):
             collect(o)
         elif depth <= 0:
             return  # cyclic/deep object graphs stop here
@@ -67,6 +73,9 @@ def device_fence(*objs) -> None:
         elif hasattr(o, "__dict__"):
             for v in vars(o).values():
                 visit(v, depth - 1)
+        elif hasattr(o, "__slots__"):
+            for name in o.__slots__:
+                visit(getattr(o, name, None), depth - 1)
         else:
             for leaf in jax.tree_util.tree_leaves(o):
                 collect(leaf)
@@ -75,6 +84,17 @@ def device_fence(*objs) -> None:
         visit(o, 6)
     if pulls:
         jax.device_get(pulls)  # returns materialized ndarrays — the fence
+    elif not seen_host[0] and any(o is not None for o in objs):
+        # A fence that collected nothing from non-empty inputs is a silent
+        # no-op — exactly the mistimed-bench failure this exists to stop.
+        import warnings
+
+        warnings.warn(
+            "device_fence: no device-array leaves found in "
+            f"{[type(o).__name__ for o in objs]}; nothing was fenced",
+            RuntimeWarning,
+            stacklevel=2,
+        )
 
 
 def block_until_ready(tree):

@@ -51,6 +51,49 @@ def test_gmm_sklearn_parity(rng, mesh8):
     assert abs(ours.avg_log_likelihood - sk.score(x)) < 0.25
 
 
+@pytest.mark.fast
+def test_gmm_factor_logpdf_matches_solve_form(rng):
+    """The matmul E-step (x @ stacked-L⁻ᵀ) must reproduce the triangular-
+    solve log-densities exactly (modulo f32 matmul rounding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.gmm import (
+        _batched_log_pdf,
+        _chol_log_pdf,
+        _pdf_factors,
+    )
+
+    k, d, n = 4, 6, 300
+    a = rng.standard_normal((k, d, d)).astype(np.float32)
+    covs = jnp.asarray(a @ np.transpose(a, (0, 2, 1)) + 2 * np.eye(d, dtype=np.float32))
+    chols = jnp.linalg.cholesky(covs)
+    means = jnp.asarray(rng.standard_normal((k, d)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32) * 2)
+    ref = jax.vmap(lambda m, L: _chol_log_pdf(x, m, L))(means, chols).T
+    got = _batched_log_pdf(x, *_pdf_factors(means, chols), "highest")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
+
+
+def test_gmm_bf16_precision_parity(rng, mesh8):
+    """matmul_precision="bf16" (one-pass MXU mode) must land in the same
+    optimum on separated blobs — same gate shape as the KMeans bench A/B."""
+    x, _, _ = _blobs(rng, n=500)
+    exact = GaussianMixture(k=3, seed=0, max_iter=40).fit(x, mesh=mesh8)
+    fast = GaussianMixture(
+        k=3, seed=0, max_iter=40, matmul_precision="bf16"
+    ).fit(x, mesh=mesh8)
+    assert abs(fast.avg_log_likelihood - exact.avg_log_likelihood) < 0.05
+    dist = np.linalg.norm(exact.means[:, None] - fast.means[None], axis=2)
+    assert dist.min(axis=1).max() < 0.1
+
+
+def test_gmm_bad_precision_raises(rng, mesh8):
+    x, _, _ = _blobs(rng, n=50)
+    with pytest.raises(ValueError, match="matmul_precision"):
+        GaussianMixture(k=2, matmul_precision="fp8").fit(x, mesh=mesh8)
+
+
 def test_gmm_save_load(rng, mesh8, tmp_path):
     x, _, _ = _blobs(rng, n=200)
     model = GaussianMixture(k=2, seed=0).fit(x, mesh=mesh8)

@@ -114,3 +114,31 @@ def test_wrong_feature_width_raises_friendly(rng, mesh8):
     for m in models:
         with pytest.raises(ValueError, match="features"):
             m.predict_numpy(bad)
+
+
+# ------------------------------------------------------- device_fence
+def test_device_fence_slots_and_warning(mesh8):
+    """The fence must reach device arrays held by __slots__ objects (a
+    silent no-op fence reproduces the round-5 mistimed-bench failure),
+    and warn when it finds nothing to fence."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import (
+        device_fence,
+    )
+
+    class Slotted:
+        __slots__ = ("arr",)
+
+        def __init__(self, arr):
+            self.arr = arr
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any RuntimeWarning -> failure
+        device_fence(Slotted(jnp.arange(8)))           # slots traversed
+        device_fence(np.zeros(4))                      # host array: quiet
+
+    with pytest.warns(RuntimeWarning, match="nothing was fenced"):
+        device_fence(object())

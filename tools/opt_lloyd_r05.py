@@ -1,0 +1,256 @@
+"""Round-5 on-chip Lloyd-step variant timing (k=256 north-star shape).
+
+Hypothesis: the headline KMeans number (671.9M rec/s/chip, 44.7% of the
+d-limited roofline) is limited by (a) the one-hot centroid-sums matmul
+``onehot.T @ xb`` running at f32 default precision while the distance
+matmul runs 1-pass bf16, and (b) VPU epilogue passes over the (chunk, k)
+distance matrix.  Variants timed here, each a candidate for
+models/kmeans.py if it wins:
+
+  base      — current _make_train_step (precision="bf16")
+  sumsbf16  — one-hot + xb cast to bf16 for the sums matmul (f32 accum)
+  fused1    — sumsbf16 + counts folded into the sums matmul (ones column)
+  leanvpu   — fused1 + drop x_sq from the argmin basis (argmin over
+              c_sq - 2·cross is identical; x_sq re-added only for cost)
+
+Run: JAX_PLATFORMS='' python tools/opt_lloyd_r05.py [rows]
+Appends one JSON line per variant to tools/opt_lloyd_r05.jsonl.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+    _centroid_rule,
+    _chunked,
+    _finalize_lloyd,
+    _make_train_step,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.ops.distance import (
+    sq_norms,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    build_mesh,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+    device_dataset,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.profiling import (
+    device_fence,
+)
+
+K = 256
+D = 8
+_BIG = jnp.float32(1e30)
+
+
+def make_variant_step(mesh, n_loc, k_pad, d, chunk_rows, variant: str):
+    """Single-model-shard (m=1) variant steps — the bench's one-chip shape."""
+    n_chunks, chunk = _chunked(n_loc, chunk_rows)
+    pad_to = n_chunks * chunk
+
+    def shard_fn(x, w, centers, c_valid):
+        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
+        wp = jnp.pad(w, (0, pad_to - n_loc))
+        xc = xp.reshape(n_chunks, chunk, d)
+        wc = wp.reshape(n_chunks, chunk)
+        c_sq = sq_norms(centers)
+        cen_bf = centers.astype(jnp.bfloat16)
+
+        def body(carry, inputs):
+            sums, counts, cost = carry
+            xb, wb = inputs
+            xb_bf = xb.astype(jnp.bfloat16)
+            cross = jnp.dot(xb_bf, cen_bf.T, preferred_element_type=jnp.float32)
+            if variant == "leanvpu":
+                # argmin basis: c_sq - 2*cross (x_sq is row-constant).
+                basis = c_sq[None, :] - 2.0 * cross
+                basis = jnp.where(c_valid[None, :] > 0, basis, _BIG)
+                loc_arg = jnp.argmin(basis, axis=1).astype(jnp.int32)
+                loc_min = jnp.min(basis, axis=1)
+                g_min = jnp.maximum(loc_min + sq_norms(xb), 0.0)
+            else:
+                d2 = sq_norms(xb)[:, None] - 2.0 * cross + c_sq[None, :]
+                d2 = jnp.maximum(d2, 0.0)
+                d2 = jnp.where(c_valid[None, :] > 0, d2, _BIG)
+                loc_arg = jnp.argmin(d2, axis=1).astype(jnp.int32)
+                g_min = jnp.min(d2, axis=1)
+            mask = wb > 0
+            if variant in ("sumsbf16", "fused1", "leanvpu"):
+                oh = jax.nn.one_hot(loc_arg, k_pad, dtype=jnp.bfloat16)
+                oh = oh * (mask.astype(jnp.bfloat16) * wb.astype(jnp.bfloat16))[:, None]
+                if variant == "sumsbf16":
+                    sums = sums + jnp.dot(
+                        oh.T, xb_bf, preferred_element_type=jnp.float32
+                    )
+                    counts = counts + jnp.sum(oh.astype(jnp.float32), axis=0)
+                else:
+                    x1 = jnp.concatenate(
+                        [xb_bf, jnp.ones((chunk, 1), jnp.bfloat16)], axis=1
+                    )
+                    sc = jnp.dot(oh.T, x1, preferred_element_type=jnp.float32)
+                    sums = sums + sc[:, :d]
+                    counts = counts + sc[:, d]
+            else:  # base-equivalent f32 sums matmul
+                oh = jax.nn.one_hot(loc_arg, k_pad, dtype=xb.dtype)
+                oh = oh * (mask.astype(xb.dtype) * wb)[:, None]
+                sums = sums + oh.T @ xb
+                counts = counts + jnp.sum(oh, axis=0)
+            cost = cost + jnp.sum(g_min * wb)
+            return (sums, counts, cost), None
+
+        init = jax.tree.map(
+            lambda z: lax.pcast(z, (DATA_AXIS, MODEL_AXIS), to="varying"),
+            (
+                jnp.zeros((k_pad, d), jnp.float32),
+                jnp.zeros((k_pad,), jnp.float32),
+                jnp.zeros((), jnp.float32),
+            ),
+        )
+        (sums, counts, cost), _ = lax.scan(body, init, (xc, wc))
+        sums = lax.psum(sums, DATA_AXIS)
+        counts = lax.psum(counts, DATA_AXIS)
+        cost = lax.psum(cost, DATA_AXIS)
+        return _finalize_lloyd(sums, counts, cost, centers, c_valid, False)
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
+            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P()),
+        )
+    )
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    only = sys.argv[2].split(",") if len(sys.argv) > 2 else None
+    chunk_rows = 131072
+    dev = jax.devices()
+    print("devices:", dev)
+    mesh = build_mesh()
+    rng = np.random.default_rng(0)
+    # Sample rows on host (for centroid init) but generate the big matrix
+    # on-device — a 305 MB host→device copy over the tunnel is pure setup
+    # cost with zero measurement value.
+    x_head = rng.standard_normal((4096, D), dtype=np.float32)
+    shard = NamedSharding(mesh, P(DATA_AXIS, None))
+    key = jax.random.key(0)
+    x_dev = jax.jit(
+        lambda k: jax.random.normal(k, (rows, D), jnp.float32),
+        out_shardings=shard,
+    )(key)
+
+    class _DS:
+        pass
+
+    ds = _DS()
+    dshard = mesh.shape[DATA_AXIS]
+    ds.n_padded = -(-rows // dshard) * dshard
+    if ds.n_padded != rows:
+        x_dev = jnp.pad(x_dev, ((0, ds.n_padded - rows), (0, 0)))
+    ds.x = jax.device_put(x_dev, shard)
+    ds.w = jax.device_put(
+        jnp.ones((ds.n_padded,), jnp.float32), NamedSharding(mesh, P(DATA_AXIS))
+    )
+    x = x_head
+    n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+    m = mesh.shape[MODEL_AXIS]
+    k_pad = -(-K // m) * m
+    cen = np.asarray(x[rng.choice(len(x), K, replace=False)])
+    if k_pad > K:
+        cen = np.concatenate([cen, np.zeros((k_pad - K, D), np.float32)])
+    c_valid = np.concatenate([np.ones(K, np.float32), np.zeros(k_pad - K, np.float32)])
+    centers0 = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    cv = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+
+    out_path = os.path.join(os.path.dirname(__file__), "opt_lloyd_r05.jsonl")
+    results = {}
+
+    def time_step(name, step):
+        c, counts, cost, move = step(ds.x, ds.w, centers0, cv)
+        device_fence(c)
+        c0 = np.asarray(jax.device_get(c))
+        # calibrate iters to ~2s windows
+        t0 = time.perf_counter()
+        c2, *_ = step(ds.x, ds.w, centers0, cv)
+        device_fence(c2)
+        dt1 = time.perf_counter() - t0
+        iters = max(1, int(2.0 / max(dt1, 1e-3)))
+        rates = []
+        for _ in range(3):
+            cc = centers0
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                cc, counts, cost, move = step(ds.x, ds.w, cc, cv)
+            device_fence(cc)
+            dt = time.perf_counter() - t0
+            rates.append(rows * iters / dt)
+        med = float(np.median(rates))
+        rec = {
+            "variant": name,
+            "devgen": True,
+            "rows": rows,
+            "k": K,
+            "d": D,
+            "chunk_rows": chunk_rows,
+            "iters_per_window": iters,
+            "rps_per_chip": round(med, 1),
+            "runs": [round(r, 1) for r in rates],
+            "centers_first_step": c0[:2, :3].tolist(),
+        }
+        results[name] = rec
+        print(json.dumps(rec))
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    done = set()
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                    if r.get("rows") == rows and r.get("devgen"):
+                        done.add(r["variant"])
+                        results[r["variant"]] = r
+                except Exception:
+                    pass
+
+    todo = only or ["base", "sumsbf16", "fused1", "leanvpu"]
+    for v in todo:
+        if v in done:
+            print(f"skip {v} (already recorded)")
+            continue
+        if v == "base":
+            step = _make_train_step(mesh, n_loc, k_pad, D, chunk_rows, False, "bf16")
+        else:
+            step = make_variant_step(mesh, n_loc, k_pad, D, chunk_rows, v)
+        time_step(v, step)
+
+    # one-step centroid agreement across variants (bf16 sums perturb low bits)
+    if "base" in results:
+        ref = np.asarray(results["base"]["centers_first_step"])
+        for v in ("sumsbf16", "fused1", "leanvpu"):
+            if v in results:
+                got = np.asarray(results[v]["centers_first_step"])
+                print(v, "max|Δ centers[:2,:3]| vs base:",
+                      float(np.abs(ref - got).max()))
+
+
+if __name__ == "__main__":
+    main()

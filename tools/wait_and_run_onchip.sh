@@ -1,0 +1,20 @@
+#!/bin/bash
+# Probe the TPU tunnel on a spaced cadence; when it answers, run the
+# round-5 Lloyd variant timing.  Bounded per-attempt so a downed tunnel
+# costs one subprocess, not the session.
+LOG=tools/opt_wait.log
+cd /root/repo
+for i in $(seq 1 40); do
+  echo "$(date -u +%FT%T) probe attempt $i" >> "$LOG"
+  if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%T) tunnel UP — running variant timing" >> "$LOG"
+    timeout 900 python -u tools/opt_lloyd_r05.py 10000000 >> "$LOG" 2>&1
+    rc=$?
+    echo "$(date -u +%FT%T) variant timing rc=$rc" >> "$LOG"
+    if [ $rc -eq 0 ]; then exit 0; fi
+    # partial progress persists in the jsonl; keep waiting and retry
+  fi
+  sleep 300
+done
+echo "$(date -u +%FT%T) gave up after 40 attempts" >> "$LOG"
+exit 1
