@@ -529,19 +529,52 @@ def _bench_gmm(k: int = 32) -> dict:
     x = _make_data(n, d, k)
     ds = device_dataset(x, mesh=mesh)  # staged once, like Spark's cached RDD
 
-    est = GaussianMixture(k=k, max_iter=iters, tol=0.0, seed=0)
-    # warm-up with the SAME estimator (max_iter is a static jit arg of the
-    # device EM loop — a different value compiles a different executable,
-    # which would land in the timed region); also warms the init path
-    _fence(est.fit(ds, mesh=mesh))
+    def measure(precision: str):
+        est = GaussianMixture(
+            k=k, max_iter=iters, tol=0.0, seed=0, matmul_precision=precision
+        )
+        # warm-up with the SAME estimator (max_iter is a static jit arg of
+        # the device EM loop — a different value compiles a different
+        # executable, which would land in the timed region); also warms the
+        # init path
+        warm = est.fit(ds, mesh=mesh)
+        _fence(warm)
 
-    def fit_once():
-        model = est.fit(ds, mesh=mesh)
-        _fence(model)
-        return n * model.n_iter  # actual EM iterations (NaN can exit early)
+        def fit_once():
+            model = est.fit(ds, mesh=mesh)
+            _fence(model)
+            return n * model.n_iter  # actual EM iterations (NaN exits early)
 
-    timed = _make_timed(fit_once, n * est.max_iter, n_chips, calibrate=on_tpu)
-    per_chip, var = _best_of(timed)
+        timed = _make_timed(fit_once, n * est.max_iter, n_chips, calibrate=on_tpu)
+        per_chip, var = _best_of(timed)
+        return per_chip, var, warm
+
+    per_chip, var, model_exact = measure("highest")
+    precision = "highest"
+    extra = {}
+    if on_tpu and os.environ.get("BENCH_GMM_BF16_AB", "1") != "0":
+        # bf16 A/B, same adopt rule as the KMeans headline: faster AND
+        # model-quality parity.  Both models are RE-SCORED at exact
+        # precision on the same bounded subsample — the fit-reported
+        # avg_log_likelihood under bf16 is itself a bf16-matmul quantity
+        # (~1e-2 relative noise), so comparing fit-reported values would
+        # gate on metric rounding, not model quality (the KMeans config
+        # recomputes its final cost at exact precision for the same
+        # reason).
+        bf16_chip, bf16_var, model_bf16 = measure("bf16")
+        x_score = x[: min(n, 100_000)]
+        ll_exact = model_exact.score(x_score)
+        ll_bf16 = model_bf16.score(x_score)
+        extra = {
+            "f32_rps_per_chip": round(per_chip, 1),
+            "bf16_rps_per_chip": round(bf16_chip, 1),
+            "avg_ll_f32": round(float(ll_exact), 4),
+            "avg_ll_bf16": round(float(ll_bf16), 4),
+            "ll_gate_note": "both models re-scored at exact precision "
+                            f"on {len(x_score)} rows",
+        }
+        if bf16_chip > per_chip and abs(ll_bf16 - ll_exact) < 0.05:
+            per_chip, var, precision = bf16_chip, bf16_var, "bf16"
 
     cpu_n = min(n, 100_000)
     cpu_thr = _cpu_gmm_throughput(x[:cpu_n], k)
@@ -551,6 +584,8 @@ def _bench_gmm(k: int = 32) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
+        "precision": precision,
+        **extra,
         **var,
     }
 

@@ -247,6 +247,37 @@ class ALSModel(Model):
         top, ids = lax.top_k(scores, k)
         return np.asarray(ids), np.asarray(top)
 
+    def recommend_for_user_subset(self, user_ids, num_items: int):
+        """Spark's ``recommendForUserSubset``: top items for the GIVEN
+        users only → (item ids (len(user_ids), k), scores).  Unknown ids
+        raise (the Spark call joins on known ids; a silent clip would
+        return another user's recommendations)."""
+        u = self._check_subset_ids(user_ids, self.user_factors.shape[0], "user")
+        scores = jnp.asarray(self.user_factors[u]) @ jnp.asarray(self.item_factors).T
+        k = min(num_items, self.item_factors.shape[0])
+        top, ids = lax.top_k(scores, k)
+        return np.asarray(ids), np.asarray(top)
+
+    def recommend_for_item_subset(self, item_ids, num_users: int):
+        """Spark's ``recommendForItemSubset``: top users for the GIVEN
+        items only."""
+        i = self._check_subset_ids(item_ids, self.item_factors.shape[0], "item")
+        scores = jnp.asarray(self.item_factors[i]) @ jnp.asarray(self.user_factors).T
+        k = min(num_users, self.user_factors.shape[0])
+        top, ids = lax.top_k(scores, k)
+        return np.asarray(ids), np.asarray(top)
+
+    @staticmethod
+    def _check_subset_ids(ids, bound: int, kind: str) -> np.ndarray:
+        out = np.asarray(ids, np.int64).reshape(-1)
+        bad = (out < 0) | (out >= bound)
+        if bad.any():
+            raise ValueError(
+                f"unknown {kind} id(s) {out[bad][:5].tolist()} — fit saw "
+                f"{kind} ids 0..{bound - 1}"
+            )
+        return out
+
     def _artifacts(self):
         return (
             "ALSModel",

@@ -34,7 +34,7 @@ from jax.scipy.special import logsumexp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..io.model_io import register_model
-from ..ops.distance import MATMUL_PRECISIONS, matmul_p
+from ..ops.distance import matmul_p, validate_matmul_precision
 from ..parallel.mesh import DATA_AXIS, default_mesh
 from ..parallel.outofcore import add_stats as _gmm_add_stats
 from ..parallel.sharding import DeviceDataset
@@ -493,11 +493,7 @@ class GaussianMixture(Estimator):
         ``max_device_rows`` blocks per EM iteration."""
         from ..parallel.outofcore import HostDataset
 
-        if self.matmul_precision not in MATMUL_PRECISIONS:
-            raise ValueError(
-                f"matmul_precision must be one of {MATMUL_PRECISIONS}, got "
-                f"{self.matmul_precision!r}"
-            )
+        validate_matmul_precision(self.matmul_precision)
         mesh = mesh or default_mesh()
         if isinstance(data, HostDataset):
             return self._fit_outofcore(data, mesh, on_iteration)

@@ -632,14 +632,10 @@ class KMeans(Estimator):
         out-of-core path: rows stream through the device in
         ``max_device_rows`` blocks (Spark's disk-backed-RDD analogue,
         SURVEY.md §7 hard part 3)."""
-        from ..ops.distance import MATMUL_PRECISIONS
+        from ..ops.distance import validate_matmul_precision
         from ..parallel.outofcore import HostDataset
 
-        if self.matmul_precision not in MATMUL_PRECISIONS:
-            raise ValueError(
-                f"matmul_precision must be one of {MATMUL_PRECISIONS}, got "
-                f"{self.matmul_precision!r}"
-            )
+        validate_matmul_precision(self.matmul_precision)
         mesh = mesh or default_mesh()
         if isinstance(data, HostDataset):
             return self._fit_outofcore(data, mesh, on_iteration)

@@ -33,6 +33,17 @@ def sq_norms(x: jax.Array) -> jax.Array:
 MATMUL_PRECISIONS = ("highest", "high", "default", "bf16")
 
 
+def validate_matmul_precision(value: str) -> None:
+    """Raise the shared friendly error for an unknown precision mode —
+    one copy of the membership check KMeans and GaussianMixture both
+    apply at fit time."""
+    if value not in MATMUL_PRECISIONS:
+        raise ValueError(
+            f"matmul_precision must be one of {MATMUL_PRECISIONS}, got "
+            f"{value!r}"
+        )
+
+
 def matmul_p(a: jax.Array, b: jax.Array, precision) -> jax.Array:
     """``a @ b`` under a :data:`MATMUL_PRECISIONS` mode — the one copy of
     the bf16-truncate/f32-accumulate vs ``lax.Precision`` dispatch shared

@@ -73,9 +73,13 @@ def device_fence(*objs) -> None:
         elif hasattr(o, "__dict__"):
             for v in vars(o).values():
                 visit(v, depth - 1)
-        elif hasattr(o, "__slots__"):
-            for name in o.__slots__:
-                visit(getattr(o, name, None), depth - 1)
+        elif hasattr(type(o), "__slots__"):
+            # walk the MRO: __slots__ may be a bare string, and each class
+            # in the hierarchy declares only its own slots
+            for klass in type(o).__mro__:
+                s = klass.__dict__.get("__slots__", ())
+                for name in (s,) if isinstance(s, str) else s:
+                    visit(getattr(o, name, None), depth - 1)
         else:
             for leaf in jax.tree_util.tree_leaves(o):
                 collect(leaf)

@@ -156,6 +156,23 @@ class TestALSModel:
         )
         assert len(md.predict([0, 99], [0, 0])) == 1
 
+    def test_recommend_subsets(self, rng):
+        _, _, _, uu, ii, rr = _synth(rng, n_u=20, n_i=15)
+        m = ht.ALS(rank=3, max_iter=5, seed=0).fit((uu, ii, rr))
+        subset = [3, 7, 11]
+        ids, scores = m.recommend_for_user_subset(subset, 4)
+        assert ids.shape == (3, 4)
+        all_ids, all_scores = m.recommend_for_all_users(4)
+        np.testing.assert_array_equal(ids, all_ids[subset])
+        np.testing.assert_allclose(scores, all_scores[subset], rtol=1e-6)
+        iids, _ = m.recommend_for_item_subset([0, 14], 5)
+        all_iids, _ = m.recommend_for_all_items(5)
+        np.testing.assert_array_equal(iids, all_iids[[0, 14]])
+        with pytest.raises(ValueError, match="unknown user id"):
+            m.recommend_for_user_subset([0, 20], 3)
+        with pytest.raises(ValueError, match="unknown item id"):
+            m.recommend_for_item_subset([-1], 3)
+
     def test_round_trip(self, rng, tmp_path):
         _, _, _, uu, ii, rr = _synth(rng, n_u=10, n_i=8)
         m = ht.ALS(rank=2, max_iter=3, seed=0).fit((uu, ii, rr))
