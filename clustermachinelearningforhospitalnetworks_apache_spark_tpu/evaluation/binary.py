@@ -76,6 +76,53 @@ def _pr_auc(scores, labels, weights):
     return jnp.sum((r[1:] - r[:-1]) * 0.5 * (p[1:] + p[:-1]))
 
 
+@jax.jit
+def _threshold_stats(scores, labels, weights):
+    """Descending-sorted scores with tie-collapsed cumulative (tp, fp) at
+    each block edge — the shared device pass behind every threshold curve
+    (roc / pr / *ByThreshold).  Host code dedupes the tie blocks."""
+    s = scores.astype(jnp.float32)
+    y = labels.astype(jnp.float32)
+    w = weights.astype(jnp.float32)
+    order = jnp.argsort(-s)
+    ss, ys, ws = s[order], y[order], w[order]
+    tp = jnp.cumsum(ws * ys)
+    fp = jnp.cumsum(ws * (1.0 - ys))
+    edge = jnp.searchsorted(-ss, -ss, side="right") - 1
+    return ss, tp[edge], fp[edge], tp[-1], fp[-1]
+
+
+def binary_curves(scores, labels, weights=None):
+    """→ dict of ``thresholds`` (distinct, descending), cumulative ``tp``/
+    ``fp`` at each threshold (score ≥ threshold predicted positive), and
+    ``total_pos``/``total_neg`` — one device pass, curve assembly on host
+    (curves are user-facing diagnostics of at most n points)."""
+    import numpy as np
+
+    labels_ = jnp.asarray(labels)
+    if weights is None:
+        weights = jnp.ones_like(labels_, dtype=jnp.float32)
+    ss, tp_e, fp_e, tot_p, tot_n = (
+        np.asarray(jax.device_get(a))
+        for a in _threshold_stats(jnp.asarray(scores), labels_, jnp.asarray(weights))
+    )
+    # one point per distinct threshold: last index of each tie block
+    last = np.r_[ss[1:] != ss[:-1], True]
+    thr, tp_b, fp_b = ss[last], tp_e[last], fp_e[last]
+    # drop zero-mass blocks — score values contributed only by w=0 rows
+    # (sharding pad rows most of all); Spark's *ByThreshold output
+    # contains only observed-instance thresholds
+    mass = np.diff(np.r_[0.0, tp_b]) + np.diff(np.r_[0.0, fp_b])
+    keep = mass > 0
+    return {
+        "thresholds": thr[keep],
+        "tp": tp_b[keep],
+        "fp": fp_b[keep],
+        "total_pos": float(tot_p),
+        "total_neg": float(tot_n),
+    }
+
+
 @dataclass(frozen=True)
 class BinaryClassificationEvaluator:
     """``metric_name``: areaUnderROC (default, Spark parity) or areaUnderPR.

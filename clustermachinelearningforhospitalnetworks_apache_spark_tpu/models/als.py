@@ -234,18 +234,22 @@ class ALSModel(Model):
         pred[~known] = np.nan
         return pred
 
-    def recommend_for_all_users(self, num_items: int):
-        """→ (item ids (U, k), scores (U, k)) — one matmul + top_k."""
-        scores = jnp.asarray(self.user_factors) @ jnp.asarray(self.item_factors).T
-        k = min(num_items, self.item_factors.shape[0])
+    @staticmethod
+    def _top_k_recs(query_factors, target_factors, k: int):
+        """One copy of the recommend body — (query, f) @ (f, T) scores,
+        top-k over targets — shared by the all-/subset- user/item calls
+        so their rankings are identical by construction."""
+        scores = jnp.asarray(query_factors) @ jnp.asarray(target_factors).T
+        k = min(k, target_factors.shape[0])
         top, ids = lax.top_k(scores, k)
         return np.asarray(ids), np.asarray(top)
 
+    def recommend_for_all_users(self, num_items: int):
+        """→ (item ids (U, k), scores (U, k)) — one matmul + top_k."""
+        return self._top_k_recs(self.user_factors, self.item_factors, num_items)
+
     def recommend_for_all_items(self, num_users: int):
-        scores = jnp.asarray(self.item_factors) @ jnp.asarray(self.user_factors).T
-        k = min(num_users, self.user_factors.shape[0])
-        top, ids = lax.top_k(scores, k)
-        return np.asarray(ids), np.asarray(top)
+        return self._top_k_recs(self.item_factors, self.user_factors, num_users)
 
     def recommend_for_user_subset(self, user_ids, num_items: int):
         """Spark's ``recommendForUserSubset``: top items for the GIVEN
@@ -253,19 +257,13 @@ class ALSModel(Model):
         raise (the Spark call joins on known ids; a silent clip would
         return another user's recommendations)."""
         u = self._check_subset_ids(user_ids, self.user_factors.shape[0], "user")
-        scores = jnp.asarray(self.user_factors[u]) @ jnp.asarray(self.item_factors).T
-        k = min(num_items, self.item_factors.shape[0])
-        top, ids = lax.top_k(scores, k)
-        return np.asarray(ids), np.asarray(top)
+        return self._top_k_recs(self.user_factors[u], self.item_factors, num_items)
 
     def recommend_for_item_subset(self, item_ids, num_users: int):
         """Spark's ``recommendForItemSubset``: top users for the GIVEN
         items only."""
         i = self._check_subset_ids(item_ids, self.item_factors.shape[0], "item")
-        scores = jnp.asarray(self.item_factors[i]) @ jnp.asarray(self.user_factors).T
-        k = min(num_users, self.user_factors.shape[0])
-        top, ids = lax.top_k(scores, k)
-        return np.asarray(ids), np.asarray(top)
+        return self._top_k_recs(self.item_factors[i], self.user_factors, num_users)
 
     @staticmethod
     def _check_subset_ids(ids, bound: int, kind: str) -> np.ndarray:

@@ -285,10 +285,29 @@ class MultinomialLogisticRegressionModel(Model):
     coefficient_matrix: jax.Array      # (K, d)
     intercept_vector: jax.Array        # (K,)
     n_iter: int = 0
+    _summary: object | None = field(default=None, repr=False, compare=False)
 
     @property
     def num_classes(self) -> int:
         return int(self.coefficient_matrix.shape[0])
+
+    @property
+    def has_summary(self) -> bool:
+        return self._summary is not None
+
+    def release_summary(self) -> None:
+        """Unpin the training dataset (see models/summary.py)."""
+        self._summary = None
+
+    @property
+    def summary(self):
+        """Multiclass training summary (accuracy / per-label + weighted
+        P/R/F/TPR/FPR) — fresh fits only, like Spark's ``hasSummary``."""
+        if self._summary is None:
+            from .summary import summary_unavailable
+
+            raise summary_unavailable("MultinomialLogisticRegressionModel")
+        return self._summary
 
     def predict_raw(self, x: jax.Array) -> jax.Array:
         """(n, K) class margins."""
@@ -454,10 +473,16 @@ class LogisticRegression(Estimator):
                 self.fit_intercept, self.standardize, self.max_iter,
                 chunk,
             )
-            return MultinomialLogisticRegressionModel(
+            model = MultinomialLogisticRegressionModel(
                 coefficient_matrix=coef, intercept_vector=intercept,
                 n_iter=int(n_iter),
             )
+            from .summary import MulticlassLogisticRegressionTrainingSummary
+
+            model._summary = MulticlassLogisticRegressionTrainingSummary(
+                model, ds
+            )
+            return model
         coef, intercept, n_iter = _irls_fit(
             ds.x, ds.y, ds.w, jnp.float32(self.reg_param), jnp.float32(self.tol),
             self.fit_intercept, self.standardize, self.max_iter,

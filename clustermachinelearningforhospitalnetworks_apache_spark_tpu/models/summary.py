@@ -110,6 +110,17 @@ class LinearRegressionTrainingSummary:
     def explained_variance(self) -> float:
         return self._reg_metrics["var"]
 
+    @property
+    def r2adj(self) -> float:
+        """Spark's ``r2adj``: 1 − (1−r²)(n−1)/(n−p−1) with p the feature
+        count (intercept excluded, Spark's convention)."""
+        n = self.num_instances
+        p = self._model.coefficients.shape[0]
+        denom = n - p - (1 if self._fit_intercept else 0)
+        if denom <= 0:
+            return float("nan")
+        return 1.0 - (1.0 - self.r2) * (n - (1 if self._fit_intercept else 0)) / denom
+
     @cached_property
     def num_instances(self) -> int:
         """Count of (w>0) rows — Spark's numInstances is a ROW count, not
@@ -189,16 +200,15 @@ class LinearRegressionTrainingSummary:
             )
 
 
-@dataclass
-class BinaryLogisticRegressionTrainingSummary:
-    """``pyspark.ml.classification.BinaryLogisticRegressionSummary``."""
+class _ConfusionMetricsMixin:
+    """Confusion-matrix-derived metrics shared by the binary and
+    multiclass logistic training summaries (Spark's
+    ``LogisticRegressionSummary`` base surface).  Subclasses set
+    ``_model``/``_ds`` dataclass fields and ``_num_classes``."""
 
-    _model: Any = field(repr=False)
-    _ds: Any = field(repr=False)
-
-    @cached_property
-    def _scores(self):
-        return self._model.predict_proba(self._ds.x)
+    @property
+    def _num_classes(self) -> int:
+        return 2
 
     @cached_property
     def predictions(self):
@@ -222,30 +232,10 @@ class BinaryLogisticRegressionTrainingSummary:
         )
 
     @cached_property
-    def area_under_roc(self) -> float:
-        from ..evaluation.binary import BinaryClassificationEvaluator
-
-        return float(
-            BinaryClassificationEvaluator("areaUnderROC").evaluate(
-                self._scores, self._ds.y, self._ds.w
-            )
-        )
-
-    @cached_property
-    def area_under_pr(self) -> float:
-        from ..evaluation.binary import BinaryClassificationEvaluator
-
-        return float(
-            BinaryClassificationEvaluator("areaUnderPR").evaluate(
-                self._scores, self._ds.y, self._ds.w
-            )
-        )
-
-    @cached_property
     def _confusion(self) -> np.ndarray:
         from ..evaluation.classification import MulticlassClassificationEvaluator
 
-        ev = MulticlassClassificationEvaluator(num_classes=2)
+        ev = MulticlassClassificationEvaluator(num_classes=self._num_classes)
         p = self.predictions
         return ev.confusion_matrix(p.prediction, p.label, p.weight)
 
@@ -271,6 +261,186 @@ class BinaryLogisticRegressionTrainingSummary:
     @property
     def f_measure_by_label(self) -> np.ndarray:
         return self._by_label("f1")
+
+    # -- support-weighted aggregates (Spark's weighted* columns) — one
+    #    copy of the math: delegate to MulticlassClassificationEvaluator
+    #    on the cached predictions -------------------------------------
+    def _weighted(self, metric: str) -> float:
+        from ..evaluation.classification import MulticlassClassificationEvaluator
+
+        return float(
+            MulticlassClassificationEvaluator(
+                metric, num_classes=self._num_classes
+            ).evaluate(self.predictions)
+        )
+
+    @property
+    def _support_frac(self) -> np.ndarray:
+        support = self._confusion.sum(axis=1)
+        return support / max(support.sum(), 1e-30)
+
+    @property
+    def weighted_precision(self) -> float:
+        return self._weighted("weightedPrecision")
+
+    @property
+    def weighted_recall(self) -> float:
+        return self._weighted("weightedRecall")
+
+    @property
+    def weighted_f_measure(self) -> float:
+        return self._weighted("f1")
+
+    @property
+    def weighted_true_positive_rate(self) -> float:
+        return self.weighted_recall  # Spark aliases TPR = recall
+
+    @property
+    def weighted_false_positive_rate(self) -> float:
+        cm = self._confusion
+        support = cm.sum(axis=1)
+        total = max(support.sum(), 1e-30)
+        pred_ct = cm.sum(axis=0)
+        tp = np.diag(cm)
+        # per-label FPR = FP_l / (rows not labeled l)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            fpr = np.where(
+                total - support > 0, (pred_ct - tp) / (total - support), 0.0
+            )
+        return float(self._support_frac @ fpr)
+
+    @property
+    def true_positive_rate_by_label(self) -> np.ndarray:
+        return self._by_label("recall")  # Spark: TPR_l = recall_l
+
+    @property
+    def false_positive_rate_by_label(self) -> np.ndarray:
+        cm = self._confusion
+        support = cm.sum(axis=1)
+        total = max(support.sum(), 1e-30)
+        fp = cm.sum(axis=0) - np.diag(cm)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.where(total - support > 0, fp / (total - support), 0.0)
+
+
+@dataclass
+class MulticlassLogisticRegressionTrainingSummary(_ConfusionMetricsMixin):
+    """``pyspark.ml.classification.LogisticRegressionTrainingSummary``
+    for the multinomial family: accuracy, per-label P/R/F/TPR/FPR, and
+    the support-weighted aggregates (no ROC — Spark likewise reserves the
+    curve surface for the binary summary)."""
+
+    _model: Any = field(repr=False)
+    _ds: Any = field(repr=False)
+
+    @property
+    def _num_classes(self) -> int:
+        return self._model.num_classes
+
+    @property
+    def num_classes(self) -> int:
+        return self._model.num_classes
+
+
+@dataclass
+class BinaryLogisticRegressionTrainingSummary(_ConfusionMetricsMixin):
+    """``pyspark.ml.classification.BinaryLogisticRegressionSummary``:
+    the confusion-derived base surface plus AUC and the threshold
+    curves."""
+
+    _model: Any = field(repr=False)
+    _ds: Any = field(repr=False)
+
+    @cached_property
+    def _scores(self):
+        return self._model.predict_proba(self._ds.x)
+
+    @cached_property
+    def area_under_roc(self) -> float:
+        from ..evaluation.binary import BinaryClassificationEvaluator
+
+        return float(
+            BinaryClassificationEvaluator("areaUnderROC").evaluate(
+                self._scores, self._ds.y, self._ds.w
+            )
+        )
+
+    @cached_property
+    def area_under_pr(self) -> float:
+        from ..evaluation.binary import BinaryClassificationEvaluator
+
+        return float(
+            BinaryClassificationEvaluator("areaUnderPR").evaluate(
+                self._scores, self._ds.y, self._ds.w
+            )
+        )
+
+    # -- threshold curves (Spark's roc / pr / *ByThreshold DataFrames,
+    #    returned as (m, 2) arrays of curve points) --------------------
+    @cached_property
+    def _curves(self) -> dict:
+        from ..evaluation.binary import binary_curves
+
+        return binary_curves(self._scores, self._ds.y, self._ds.w)
+
+    @cached_property
+    def roc(self) -> np.ndarray:
+        """(m, 2) [FPR, TPR] points anchored at (0,0) and (1,1) —
+        Spark's ``summary.roc`` DataFrame as an array."""
+        c = self._curves
+        fpr = c["fp"] / max(c["total_neg"], 1e-30)
+        tpr = c["tp"] / max(c["total_pos"], 1e-30)
+        return np.column_stack(
+            [np.r_[0.0, fpr, 1.0], np.r_[0.0, tpr, 1.0]]
+        )
+
+    @cached_property
+    def pr(self) -> np.ndarray:
+        """(m, 2) [recall, precision] points, anchored at recall=0 with
+        the highest-threshold block's precision (Spark's first point)."""
+        c = self._curves
+        recall = c["tp"] / max(c["total_pos"], 1e-30)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            precision = c["tp"] / np.maximum(c["tp"] + c["fp"], 1e-30)
+        return np.column_stack(
+            [np.r_[0.0, recall], np.r_[precision[:1], precision]]
+        )
+
+    def _by_threshold(self, kind: str, beta: float = 1.0) -> np.ndarray:
+        c = self._curves
+        with np.errstate(invalid="ignore", divide="ignore"):
+            precision = c["tp"] / np.maximum(c["tp"] + c["fp"], 1e-30)
+            recall = c["tp"] / max(c["total_pos"], 1e-30)
+            if kind == "precision":
+                val = precision
+            elif kind == "recall":
+                val = recall
+            else:
+                b2 = beta * beta
+                val = np.where(
+                    precision + recall > 0,
+                    (1 + b2) * precision * recall
+                    / np.maximum(b2 * precision + recall, 1e-30),
+                    0.0,
+                )
+        return np.column_stack([c["thresholds"], val])
+
+    def precision_by_threshold(self) -> np.ndarray:
+        """(m, 2) [threshold, precision] over distinct score thresholds."""
+        return self._by_threshold("precision")
+
+    def recall_by_threshold(self) -> np.ndarray:
+        return self._by_threshold("recall")
+
+    def f_measure_by_threshold(self, beta: float = 1.0) -> np.ndarray:
+        return self._by_threshold("f", beta)
+
+    @property
+    def max_f_measure_threshold(self) -> float:
+        """Threshold maximizing F1 — Spark exposes the curve and leaves
+        the argmax to the user; this is the one-liner everyone writes."""
+        curve = self.f_measure_by_threshold()
+        return float(curve[np.argmax(curve[:, 1]), 0])
 
 
 @dataclass(frozen=True)

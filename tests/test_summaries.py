@@ -282,3 +282,139 @@ def test_fvalue_matches_sklearn(rng, mesh8):
         ht.ANOVATest.test(
             x.astype(np.float32), np.zeros(n - 50, np.float32), mesh=mesh8
         )
+
+
+def test_linear_regression_r2adj(rng, mesh8):
+    x, y = _lr_problem(rng, n=200)
+    m = ht.LinearRegression().fit((x, y), mesh=mesh8)
+    s = m.summary
+    n, p = 200, 4
+    expect = 1.0 - (1.0 - s.r2) * (n - 1) / (n - p - 1)
+    np.testing.assert_allclose(s.r2adj, expect, rtol=1e-6)
+    assert s.r2adj < s.r2  # adjustment always penalizes
+
+
+def test_logistic_summary_curves_sklearn_parity(rng, mesh8):
+    """roc / pr / *ByThreshold against sklearn's curve functions."""
+    from sklearn.metrics import precision_recall_curve, roc_curve
+
+    n, d = 400, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    yb = (x @ np.array([1.0, -2.0, 0.5]) + 0.2 * rng.normal(size=n) > 0).astype(
+        np.float32
+    )
+    m = ht.LogisticRegression(max_iter=20).fit((x, yb), mesh=mesh8)
+    s = m.summary
+    ds = ht.device_dataset(x, yb, mesh=mesh8)
+    scores = np.asarray(m.predict_proba(ds.x))[:n]
+
+    fpr, tpr, _ = roc_curve(yb, scores)
+    ours = s.roc
+    # same monotone curve: compare TPR sampled at shared FPR grid
+    grid = np.linspace(0, 1, 51)
+    np.testing.assert_allclose(
+        np.interp(grid, ours[:, 0], ours[:, 1]),
+        np.interp(grid, fpr, tpr),
+        atol=0.02,
+    )
+
+    prec, rec, _ = precision_recall_curve(yb, scores)
+    ours_pr = s.pr
+    np.testing.assert_allclose(
+        np.interp(grid, ours_pr[:, 0], ours_pr[:, 1]),
+        np.interp(grid, rec[::-1], prec[::-1]),
+        atol=0.03,
+    )
+
+    # threshold curves: precision/recall at each distinct score cut
+    pbt = s.precision_by_threshold()
+    rbt = s.recall_by_threshold()
+    fbt = s.f_measure_by_threshold()
+    assert pbt.shape == rbt.shape == fbt.shape
+    for thr, pv in pbt[:: max(1, len(pbt) // 20)]:
+        mask = scores >= thr
+        np.testing.assert_allclose(
+            pv, yb[mask].sum() / max(mask.sum(), 1), atol=1e-5
+        )
+    t_star = s.max_f_measure_threshold
+    assert fbt[:, 1].max() == pytest.approx(
+        fbt[np.argmin(np.abs(fbt[:, 0] - t_star)), 1]
+    )
+
+
+def test_logistic_summary_weighted_metrics(rng, mesh8):
+    from sklearn.metrics import precision_score, recall_score, f1_score
+
+    n, d = 300, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    yb = (x @ np.array([1.0, -1.0, 2.0]) > 0.4).astype(np.float32)
+    m = ht.LogisticRegression(max_iter=20).fit((x, yb), mesh=mesh8)
+    s = m.summary
+    ds = ht.device_dataset(x, yb, mesh=mesh8)
+    pred = np.asarray(m.predict(ds.x))[:n]
+    np.testing.assert_allclose(
+        s.weighted_precision,
+        precision_score(yb, pred, average="weighted"),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        s.weighted_recall, recall_score(yb, pred, average="weighted"), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        s.weighted_f_measure, f1_score(yb, pred, average="weighted"), atol=1e-6
+    )
+    assert s.weighted_true_positive_rate == pytest.approx(s.weighted_recall)
+    assert 0.0 <= s.weighted_false_positive_rate <= 1.0
+
+
+def test_multinomial_logistic_summary(rng, mesh8):
+    from sklearn.metrics import f1_score, precision_score, recall_score
+
+    n, d, K = 450, 4, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logits = x @ rng.normal(size=(d, K))
+    y = logits.argmax(axis=1).astype(np.float32)
+    m = ht.LogisticRegression(family="multinomial", max_iter=25).fit(
+        (x, y), mesh=mesh8
+    )
+    assert m.has_summary
+    s = m.summary
+    assert s.num_classes == K
+    ds = ht.device_dataset(x, y, mesh=mesh8)
+    pred = np.asarray(m.predict(ds.x))[:n]
+    acc = (pred == y).mean()
+    np.testing.assert_allclose(s.accuracy, acc, atol=1e-6)
+    np.testing.assert_allclose(
+        s.weighted_precision,
+        precision_score(y, pred, average="weighted"),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        s.weighted_recall, recall_score(y, pred, average="weighted"), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        s.weighted_f_measure, f1_score(y, pred, average="weighted"), atol=1e-6
+    )
+    assert s.precision_by_label.shape == (K,)
+    assert s.true_positive_rate_by_label.shape == (K,)
+    assert np.all(s.false_positive_rate_by_label <= 1.0)
+    # no ROC surface on the multiclass summary (Spark parity)
+    assert not hasattr(s, "area_under_roc")
+    m.release_summary()
+    assert not m.has_summary
+    with pytest.raises(RuntimeError, match="no training summary"):
+        _ = m.summary
+
+
+def test_threshold_curve_excludes_pad_rows(rng, mesh8):
+    """Sharding pad rows (w=0) must not mint thresholds: every curve
+    threshold corresponds to at least one real weighted instance."""
+    n = 450  # not divisible by 8 -> 6 pad rows on the mesh
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    yb = (x[:, 0] > 0).astype(np.float32)
+    m = ht.LogisticRegression(max_iter=15).fit((x, yb), mesh=mesh8)
+    s = m.summary
+    ds = ht.device_dataset(x, yb, mesh=mesh8)
+    real_scores = np.unique(np.asarray(m.predict_proba(ds.x))[:n].astype(np.float32))
+    thr = s.precision_by_threshold()[:, 0]
+    assert np.all(np.isin(thr, real_scores))
