@@ -102,9 +102,13 @@ def binary_curves(scores, labels, weights=None):
     labels_ = jnp.asarray(labels)
     if weights is None:
         weights = jnp.ones_like(labels_, dtype=jnp.float32)
+    # ONE batched device_get — five per-array pulls would pay five tunnel
+    # round trips on the async proxy backend
     ss, tp_e, fp_e, tot_p, tot_n = (
-        np.asarray(jax.device_get(a))
-        for a in _threshold_stats(jnp.asarray(scores), labels_, jnp.asarray(weights))
+        np.asarray(a)
+        for a in jax.device_get(
+            _threshold_stats(jnp.asarray(scores), labels_, jnp.asarray(weights))
+        )
     )
     # one point per distinct threshold: last index of each tie block
     last = np.r_[ss[1:] != ss[:-1], True]

@@ -226,9 +226,9 @@ class _ConfusionMetricsMixin:
 
         p = self.predictions
         return float(
-            MulticlassClassificationEvaluator("accuracy").evaluate(
-                p.prediction, p.label, p.weight
-            )
+            MulticlassClassificationEvaluator(
+                "accuracy", num_classes=self._num_classes
+            ).evaluate(p.prediction, p.label, p.weight)
         )
 
     @cached_property
@@ -297,17 +297,7 @@ class _ConfusionMetricsMixin:
 
     @property
     def weighted_false_positive_rate(self) -> float:
-        cm = self._confusion
-        support = cm.sum(axis=1)
-        total = max(support.sum(), 1e-30)
-        pred_ct = cm.sum(axis=0)
-        tp = np.diag(cm)
-        # per-label FPR = FP_l / (rows not labeled l)
-        with np.errstate(invalid="ignore", divide="ignore"):
-            fpr = np.where(
-                total - support > 0, (pred_ct - tp) / (total - support), 0.0
-            )
-        return float(self._support_frac @ fpr)
+        return float(self._support_frac @ self.false_positive_rate_by_label)
 
     @property
     def true_positive_rate_by_label(self) -> np.ndarray:

@@ -580,6 +580,100 @@ class GrownForest:
     cat_arities: np.ndarray | None = None    # (d,) int32, 0 = continuous
 
 
+@dataclass
+class DeferredForest:
+    """:func:`grow_forest` output with the host fetch DEFERRED: the
+    per-level winner tensors are still device arrays (possibly still in
+    flight on an async-dispatch backend).  The GBT boosting loop consumes
+    the tree on device via :func:`device_tree_arrays` — so round t+1's
+    residuals chain off round t with zero host round trips — and fetches
+    every round's winners in ONE ``device_get`` at the end of the fit
+    (the per-round blocking fetch + host materialize + re-upload cost
+    more than the round's histograms on a tunneled chip; BENCH_r05 gbt20
+    measured ≈1× the CPU proxy because of it)."""
+
+    level_out: list             # per level: 6-tuple of device arrays
+    thr: np.ndarray             # (d, B-1) float64 bin thresholds
+    task: str
+    num_classes: int
+    cat_arities: tuple[int, ...] | None
+    B: int
+    max_depth: int
+    is_cat_host: np.ndarray
+    T: int
+    d: int
+    S: int
+
+    def fetch(self) -> GrownForest:
+        return self.fetch_from(jax.device_get(self.level_out))
+
+    def fetch_from(self, fetched_levels) -> GrownForest:
+        """Materialize from already-fetched winner tensors (batch several
+        rounds' fetches into one ``device_get``, then call this per
+        round)."""
+        rec = _ForestRecorder(
+            self.T, self.d, self.S, self.max_depth, self.is_cat_host
+        )
+        for depth, fetched in enumerate(fetched_levels):
+            rec.record_level(depth, fetched)
+        return rec.materialize(
+            self.thr, self.task, self.num_classes, self.cat_arities, self.B
+        )
+
+
+def device_tree_arrays(level_out, thr_dev, is_cat_dev, B: int):
+    """→ (split_feat, threshold, value (T, total, 1), catmask) heap
+    tensors as DEVICE arrays from a :class:`DeferredForest`'s level
+    winners — the traceable mirror of ``_ForestRecorder.record_level`` +
+    ``materialize`` for REGRESSION trees (the GBT boosting path; S=3
+    stats (w, Σy, Σy²)), so ``predict_forest`` can consume a just-grown
+    tree without the arrays ever visiting the host.  Division runs in
+    f32 (the recorder uses f64 on host); on integer-exact sums both
+    round identically."""
+    max_depth = len(level_out) - 1
+    feats, bins, valids, masks, stats = [], [], [], [], []
+    for depth, (agg, _gain, feat, bin_, split, catmask) in enumerate(level_out):
+        stats.append(agg)
+        if depth == max_depth:                      # deepest level: leaves
+            feats.append(jnp.full_like(feat, -1))
+            bins.append(jnp.zeros_like(bin_))
+            valids.append(jnp.zeros_like(split))
+            masks.append(jnp.zeros_like(catmask))
+        else:
+            feats.append(jnp.where(split, feat, -1))
+            bins.append(jnp.where(split, bin_, 0))
+            valids.append(split)
+            masks.append(
+                jnp.where(split & is_cat_dev[feat], catmask, jnp.uint32(0))
+            )
+    split_feat = jnp.concatenate(feats, axis=1)     # (T, total)
+    split_bin = jnp.concatenate(bins, axis=1)
+    do_split = jnp.concatenate(valids, axis=1)
+    catmask = jnp.concatenate(masks, axis=1)
+    node_stats = jnp.concatenate(stats, axis=1)     # (T, total, 3)
+
+    w = node_stats[..., 0]
+    value = jnp.where(w > 0, node_stats[..., 1] / jnp.maximum(w, 1e-12), 0.0)
+    # un-populated heap slots predict their parent (same static loop as
+    # the host materializer; total ≤ 2^(depth+1)−1 slots)
+    total = split_feat.shape[1]
+    for parent in range(total // 2):
+        for child in (2 * parent + 1, 2 * parent + 2):
+            empty = w[:, child] <= 0
+            value = value.at[:, child].set(
+                jnp.where(empty, value[:, parent], value[:, child])
+            )
+
+    f_idx = jnp.maximum(split_feat, 0)
+    valid_split = do_split & ~is_cat_dev[f_idx]
+    threshold = jnp.where(
+        valid_split,
+        thr_dev[f_idx, jnp.minimum(split_bin, B - 2)].astype(jnp.float32),
+        0.0,
+    )
+    return split_feat, threshold, value[..., None].astype(jnp.float32), catmask
+
+
 def grow_forest(
     ds,
     *,
@@ -600,7 +694,8 @@ def grow_forest(
     bin_thresholds: np.ndarray | None = None,
     binned_t: jax.Array | None = None,
     categorical_features: dict[int, int] | None = None,
-) -> GrownForest:
+    defer_fetch: bool = False,
+) -> "GrownForest | DeferredForest":
     """Train ``num_trees`` trees level-by-level on the sharded dataset.
 
     ``use_pallas`` routes the level histograms through the fused
@@ -616,7 +711,12 @@ def grow_forest(
     ``categoricalFeaturesInfo``, the StringIndexer-output contract the
     reference imports at ``mllearnforhospitalnetwork.py:29``): those
     columns hold category ids 0..arity-1 and are split as **unordered
-    sets** (see ``_make_level_step``); arity ≤ min(32, max_bins)."""
+    sets** (see ``_make_level_step``); arity ≤ min(32, max_bins).
+
+    ``defer_fetch=True`` returns a :class:`DeferredForest` (device winner
+    tensors, no host sync at all — including the fast-path empty-dataset
+    guard, so the caller must have validated non-emptiness already); the
+    GBT round loop uses it to chain boosting rounds entirely on device."""
     from ...parallel.sharding import sample_valid_rows
 
     mesh = mesh or default_mesh()
@@ -644,8 +744,10 @@ def grow_forest(
             raise ValueError(
                 f"bin_thresholds shape {thr.shape} != ({d}, {B - 1})"
             )
-        # the sampling path's empty-dataset guard must survive the fast path
-        if float(jax.device_get(ds.count())) == 0.0:
+        # the sampling path's empty-dataset guard must survive the fast
+        # path — except under defer_fetch, whose contract is ZERO host
+        # syncs (the GBT caller validated emptiness computing F₀)
+        if not defer_fetch and float(jax.device_get(ds.count())) == 0.0:
             raise ValueError("tree fit on an empty dataset")
     else:
         sample = sample_valid_rows(ds, init_sample_size, seed)
@@ -726,6 +828,12 @@ def grow_forest(
                 catmask_d if cat else None, cat_flags_dev,
             )
 
+    if defer_fetch:
+        return DeferredForest(
+            level_out=level_out, thr=thr, task=task, num_classes=num_classes,
+            cat_arities=cat_arities, B=B, max_depth=max_depth,
+            is_cat_host=is_cat_host, T=T, d=d, S=S,
+        )
     # one host fetch for every level's winners; the shared recorder +
     # materialization tail emits the GrownForest (same code as out-of-core)
     for depth, fetched in enumerate(jax.device_get(level_out)):

@@ -35,7 +35,13 @@ import numpy as np
 from ...io.model_io import register_model
 from ...parallel.mesh import default_mesh
 from ..base import Estimator, Model, as_device_dataset, check_features
-from .engine import GrownForest, bin_feature_matrix, grow_forest, predict_forest
+from .engine import (
+    GrownForest,
+    bin_feature_matrix,
+    device_tree_arrays,
+    grow_forest,
+    predict_forest,
+)
 
 
 @register_model("GBTModel")
@@ -254,9 +260,10 @@ class _GBTParams:
         best_m = 0
         f_cur = jnp.full(y.shape, jnp.float32(f0))
         trees, importances = [], []
-        for t in range(self.max_iter):
+
+        def grow_round(t, defer: bool):
             res_ds = DeviceDataset(x=x, y=residual(f_cur), w=w)
-            grown = grow_forest(
+            return grow_forest(
                 res_ds,
                 task="regression",           # every boosting stage fits residuals
                 num_trees=1,
@@ -271,21 +278,58 @@ class _GBTParams:
                 bin_thresholds=thr,
                 binned_t=binned_t,
                 categorical_features=self.categorical_features,
+                defer_fetch=defer,
             )
-            trees.append(grown)
-            importances.append(grown.importances[0])
-            f_cur = advance(
-                f_cur,
-                jnp.asarray(grown.split_feat),
-                jnp.asarray(grown.threshold),
-                jnp.asarray(grown.value),
-                (
-                    jnp.asarray(grown.split_catmask, jnp.uint32)
-                    if cat
-                    else jnp.zeros(grown.split_feat.shape, jnp.uint32)
-                ),
+
+        if val_ind is None:
+            # No early stop → the WHOLE boosting chain dispatches without
+            # one host sync: each round's tree stays a device tensor
+            # (device_tree_arrays), round t+1's residuals chain off it,
+            # and every round's winner tensors are fetched in one
+            # device_get at the end.  The per-round fetch+re-upload it
+            # replaces cost more than the round's histograms on a
+            # tunneled chip (BENCH_r05 gbt20 ≈ 1× the CPU proxy).
+            thr_dev = jnp.asarray(thr, jnp.float32)
+            is_cat_dev = jnp.asarray(
+                [f in cat for f in range(x.shape[1])] if cat
+                else np.zeros((x.shape[1],), bool)
             )
-            if val_ind is not None:
+            @jax.jit
+            def advance_deferred(f, level_out):
+                sf, th, val, cm = device_tree_arrays(
+                    level_out, thr_dev, is_cat_dev, self.max_bins
+                )
+                if not cat:
+                    cm = jnp.zeros_like(sf, jnp.uint32)
+                pred = predict_forest(x, sf, th, val, cm, cat_flags)[0, :, 0]
+                return f + jnp.float32(self.step_size) * pred
+
+            deferred = []
+            for t in range(self.max_iter):
+                dfr = grow_round(t, defer=True)
+                deferred.append(dfr)
+                f_cur = advance_deferred(f_cur, dfr.level_out)
+            all_fetched = jax.device_get([d.level_out for d in deferred])
+            trees = [
+                d.fetch_from(lv) for d, lv in zip(deferred, all_fetched)
+            ]
+            importances = [g.importances[0] for g in trees]
+        else:
+            for t in range(self.max_iter):
+                grown = grow_round(t, defer=False)
+                trees.append(grown)
+                importances.append(grown.importances[0])
+                f_cur = advance(
+                    f_cur,
+                    jnp.asarray(grown.split_feat),
+                    jnp.asarray(grown.threshold),
+                    jnp.asarray(grown.value),
+                    (
+                        jnp.asarray(grown.split_catmask, jnp.uint32)
+                        if cat
+                        else jnp.zeros(grown.split_feat.shape, jnp.uint32)
+                    ),
+                )
                 # Spark runWithValidation: stop when the best-so-far
                 # held-out error stops improving by validationTol
                 # (relative to max(err, 0.01)); keep the best-M prefix.
@@ -295,9 +339,9 @@ class _GBTParams:
                 if err < best_err:
                     best_err = err
                     best_m = t + 1
-        if val_ind is not None and best_m > 0:
-            trees = trees[:best_m]
-            importances = importances[:best_m]
+            if best_m > 0:
+                trees = trees[:best_m]
+                importances = importances[:best_m]
 
         imp = np.sum(importances, axis=0)
         s = imp.sum()

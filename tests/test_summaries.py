@@ -374,6 +374,11 @@ def test_multinomial_logistic_summary(rng, mesh8):
     x = rng.normal(size=(n, d)).astype(np.float32)
     logits = x @ rng.normal(size=(d, K))
     y = logits.argmax(axis=1).astype(np.float32)
+    # 15% label noise → real cross-class confusion, so a confusion matrix
+    # clipped to 2 classes (the bug class this guards against) would
+    # miscount label-1↔2 errors as correct and report inflated accuracy
+    flip = rng.random(n) < 0.15
+    y[flip] = rng.integers(0, K, flip.sum()).astype(np.float32)
     m = ht.LogisticRegression(family="multinomial", max_iter=25).fit(
         (x, y), mesh=mesh8
     )
@@ -383,6 +388,7 @@ def test_multinomial_logistic_summary(rng, mesh8):
     ds = ht.device_dataset(x, y, mesh=mesh8)
     pred = np.asarray(m.predict(ds.x))[:n]
     acc = (pred == y).mean()
+    assert acc < 0.99  # noise guaranteed real misclassifications
     np.testing.assert_allclose(s.accuracy, acc, atol=1e-6)
     np.testing.assert_allclose(
         s.weighted_precision,
