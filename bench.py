@@ -356,7 +356,11 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     chunk = est.chunk_rows
     tuned = {}
     if on_tpu and os.environ.get("BENCH_AUTOTUNE", "1") != "0":
-        for cand in (16384, 32768, 65536, 131072):
+        # r05 session 2: the sweep rose monotonically to its then-largest
+        # candidate 131072 (3.01G rec/s at 131k vs 2.86G at 65k, k=8) —
+        # the range was clipping the optimum, so it now extends to 512k
+        # rows (d=8 f32 transients stay well under HBM at k≤256)
+        for cand in (32768, 65536, 131072, 262144, 524288):
             r, _, _ = measure(cand, "highest", windows=1)
             tuned[cand] = round(r / n_chips, 1)
         chunk = max(tuned, key=tuned.get)

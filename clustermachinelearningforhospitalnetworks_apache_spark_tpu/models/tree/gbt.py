@@ -296,13 +296,13 @@ class _GBTParams:
             )
             @jax.jit
             def advance_deferred(f, level_out):
-                sf, th, val, cm = device_tree_arrays(
-                    level_out, thr_dev, is_cat_dev, self.max_bins
+                # device_tree_arrays already zeroes the catmask for
+                # all-continuous fits; advance() owns the update math
+                return advance(
+                    f, *device_tree_arrays(
+                        level_out, thr_dev, is_cat_dev, self.max_bins
+                    )
                 )
-                if not cat:
-                    cm = jnp.zeros_like(sf, jnp.uint32)
-                pred = predict_forest(x, sf, th, val, cm, cat_flags)[0, :, 0]
-                return f + jnp.float32(self.step_size) * pred
 
             deferred = []
             for t in range(self.max_iter):

@@ -1,0 +1,112 @@
+"""Training-summary diagnostics — the TPU-native answer to the
+reference's matplotlib section (``mllearnforhospitalnetwork.py:204-223``
+plots predicted-vs-actual and residuals; SURVEY.md C14), extended with
+the Spark classification-summary surface the reference never reached:
+
+1. LinearRegression summary: r²/r²adj, coefficient t/p-values, residual
+   plot to PNG.
+2. LogisticRegression (binary, the intended LOS_binary task at
+   reference ``:176-190``): ROC + PR curves from ``model.summary``
+   (one tie-exact device pass — no sklearn involved), the max-F1
+   operating threshold, weighted precision/recall.
+3. Multinomial LogisticRegression summary: per-label and weighted
+   metrics for a 3-tier LOS triage label.
+
+    PYTHONPATH=. python examples/model_diagnostics.py [out_dir]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+try:  # installed copy (pip install -e .) takes precedence
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu  # noqa: F401
+except ImportError:  # running from a raw checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def main() -> None:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "diagnostics_out"
+    os.makedirs(out_dir, exist_ok=True)
+    mesh = ht.build_mesh()
+    rng = np.random.default_rng(0)
+
+    n = 4000
+    x = np.column_stack(
+        [
+            rng.poisson(30, n),          # admission_count
+            rng.uniform(0.4, 1.0, n),    # current_occupancy
+            rng.poisson(12, n),          # emergency_visits
+            rng.normal(1.0, 0.15, n),    # seasonality_index
+        ]
+    ).astype(np.float32)
+    los = (
+        0.08 * x[:, 0] + 4.0 * x[:, 1] + 0.12 * x[:, 2] + 1.5 * x[:, 3]
+        + 0.5 * rng.normal(size=n)
+    ).astype(np.float32)
+
+    # 1. regression diagnostics ---------------------------------------
+    lin = ht.LinearRegression().fit((x, los), mesh=mesh)
+    s = lin.summary
+    print(f"rmse={s.root_mean_squared_error:.4f}  r2={s.r2:.4f}  "
+          f"r2adj={s.r2adj:.4f}")
+    for name, t, p in zip(ht.FEATURE_COLS, s.t_values, s.p_values):
+        print(f"  {name:20s} t={t:8.2f}  p={p:.3g}")
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    resid = s.residuals
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.scatter(los[: len(resid)], resid, s=4, alpha=0.4)
+    ax.axhline(0.0, color="k", lw=1)
+    ax.set_xlabel("actual length_of_stay")
+    ax.set_ylabel("residual")
+    fig.savefig(os.path.join(out_dir, "residuals.png"), dpi=120)
+    plt.close(fig)
+
+    # 2. binary LOS-risk diagnostics ----------------------------------
+    yb = (los > np.median(los)).astype(np.float32)
+    log = ht.LogisticRegression(max_iter=30).fit((x, yb), mesh=mesh)
+    b = log.summary
+    roc, pr = b.roc, b.pr
+    print(f"AUC={b.area_under_roc:.4f}  AUPR={b.area_under_pr:.4f}  "
+          f"maxF1 @ threshold {b.max_f_measure_threshold:.3f}")
+    print(f"weighted precision={b.weighted_precision:.4f} "
+          f"recall={b.weighted_recall:.4f}")
+
+    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+    axes[0].plot(roc[:, 0], roc[:, 1])
+    axes[0].plot([0, 1], [0, 1], "k--", lw=1)
+    axes[0].set(xlabel="FPR", ylabel="TPR",
+                title=f"ROC (AUC={b.area_under_roc:.3f})")
+    axes[1].plot(pr[:, 0], pr[:, 1])
+    axes[1].set(xlabel="recall", ylabel="precision",
+                title=f"PR (AUPR={b.area_under_pr:.3f})")
+    fig.tight_layout()
+    fig.savefig(os.path.join(out_dir, "roc_pr.png"), dpi=120)
+    plt.close(fig)
+
+    # 3. 3-tier triage (multinomial) ----------------------------------
+    tiers = np.digitize(los, np.quantile(los, [0.5, 0.85])).astype(np.float32)
+    mlr = ht.LogisticRegression(family="multinomial", max_iter=30).fit(
+        (x, tiers), mesh=mesh
+    )
+    ms = mlr.summary
+    print(f"triage accuracy={ms.accuracy:.4f}  "
+          f"weighted F1={ms.weighted_f_measure:.4f}")
+    for c in range(ms.num_classes):
+        print(f"  tier {c}: precision={ms.precision_by_label[c]:.3f} "
+              f"recall={ms.recall_by_label[c]:.3f}")
+    print(f"plots written to {out_dir}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,20 +1,42 @@
 #!/bin/bash
 # Probe the TPU tunnel on a spaced cadence; when it answers, run the
-# round-5 Lloyd variant timing.  Bounded per-attempt so a downed tunnel
-# costs one subprocess, not the session.
+# round-5 on-chip measurement queue:
+#   1. Lloyd sums-matmul variant timing (tools/opt_lloyd_r05.py)
+#   2. bench gbt20  — quantifies the deferred-fetch boosting win
+#   3. bench gmm32  — quantifies the bf16 factor-form E-step A/B
+# Bench rows append to tools/bench_onchip_r05_session2.jsonl.  Each step
+# is bounded so a dropped tunnel costs one subprocess; completed steps
+# are skipped on retry via marker files.
 LOG=tools/opt_wait.log
+OUT=tools/bench_onchip_r05_session2.jsonl
 cd /root/repo
-for i in $(seq 1 40); do
+for i in $(seq 1 60); do
   echo "$(date -u +%FT%T) probe attempt $i" >> "$LOG"
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "$(date -u +%FT%T) tunnel UP — running variant timing" >> "$LOG"
-    timeout 900 python -u tools/opt_lloyd_r05.py 10000000 >> "$LOG" 2>&1
-    rc=$?
-    echo "$(date -u +%FT%T) variant timing rc=$rc" >> "$LOG"
-    if [ $rc -eq 0 ]; then exit 0; fi
-    # partial progress persists in the jsonl; keep waiting and retry
+    echo "$(date -u +%FT%T) tunnel UP" >> "$LOG"
+    if [ ! -f tools/.done_variants ]; then
+      timeout 900 python -u tools/opt_lloyd_r05.py 10000000 >> "$LOG" 2>&1 \
+        && touch tools/.done_variants
+      echo "$(date -u +%FT%T) variants rc=$?" >> "$LOG"
+    fi
+    # bench.py exits 0 BY DESIGN even on failure/CPU fallback — gate the
+    # done markers on an actual on-chip row landing in the jsonl instead
+    if [ ! -f tools/.done_gbt20 ]; then
+      timeout 900 env BENCH_CONFIG=gbt20 python bench.py >> "$OUT" 2>>"$LOG"
+      echo "$(date -u +%FT%T) gbt20 rc=$?" >> "$LOG"
+      grep -q 'GBT.*"platform": "tpu"' "$OUT" && touch tools/.done_gbt20
+    fi
+    if [ ! -f tools/.done_gmm32 ]; then
+      timeout 1200 env BENCH_CONFIG=gmm32 python bench.py >> "$OUT" 2>>"$LOG"
+      echo "$(date -u +%FT%T) gmm32 rc=$?" >> "$LOG"
+      grep -q 'GaussianMixture.*"platform": "tpu"' "$OUT" && touch tools/.done_gmm32
+    fi
+    if [ -f tools/.done_variants ] && [ -f tools/.done_gbt20 ] && [ -f tools/.done_gmm32 ]; then
+      echo "$(date -u +%FT%T) all on-chip steps done" >> "$LOG"
+      exit 0
+    fi
   fi
   sleep 300
 done
-echo "$(date -u +%FT%T) gave up after 40 attempts" >> "$LOG"
+echo "$(date -u +%FT%T) gave up after 60 attempts" >> "$LOG"
 exit 1
