@@ -324,7 +324,8 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     est = KMeans(k=k)
     n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
 
-    def measure(chunk_rows: int, precision: str, windows: int = 3):
+    def measure(chunk_rows: int, precision: str, windows: int = 3,
+                fused: bool = False):
         """(rate, final centers, per-window rates) for one variant.
 
         Windows are calibrated to ≥2 s on TPU so the single fence round
@@ -332,7 +333,9 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
         body only *enqueues* steps (dispatch is async), the fence drains
         them, and the window measures enqueue + execution + one round
         trip."""
-        step = _make_train_step(mesh, n_loc, k_pad, d, chunk_rows, False, precision)
+        step = _make_train_step(
+            mesh, n_loc, k_pad, d, chunk_rows, False, precision, fused
+        )
         c, _, _, _ = step(ds.x, ds.w, centers0, c_valid_dev)  # warm-up/compile
         _fence(c)
 
@@ -386,17 +389,35 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
 
     sil_f32 = mesh_silhouette(f32_centers)
     use_bf16 = False
-    bf16_rate = sil_bf16 = None
+    bf16_rate = sil_bf16 = fused_rate = sil_fused = None
     bf16_windows: list[float] = []
+    fused_windows: list[float] = []
+    use_fused = False
     if on_tpu:
         bf16_rate, bf16_centers, bf16_windows = measure(chunk, "bf16")
         sil_bf16 = mesh_silhouette(bf16_centers)
         use_bf16 = bf16_rate > f32_rate and abs(sil_bf16 - sil_f32) <= 0.01
+        if use_bf16:
+            # second A/B rung: the bf16-rate accumulation restructure
+            # (KMeans.fused_stats — x²-free argmin + one bf16 one-hot
+            # matmul for sums AND counts), same parity gate vs exact f32
+            fused_rate, fused_centers, fused_windows = measure(
+                chunk, "bf16", fused=True
+            )
+            sil_fused = mesh_silhouette(fused_centers)
+            use_fused = (
+                fused_rate > bf16_rate and abs(sil_fused - sil_f32) <= 0.01
+            )
 
-    per_chip = (bf16_rate if use_bf16 else f32_rate) / n_chips
-    precision = "bf16" if use_bf16 else "highest"
-    sil = sil_bf16 if use_bf16 else sil_f32
-    windows = bf16_windows if use_bf16 else f32_windows
+    if use_fused:
+        per_chip = fused_rate / n_chips
+        precision, sil, windows = "bf16+fused", sil_fused, fused_windows
+    elif use_bf16:
+        per_chip = bf16_rate / n_chips
+        precision, sil, windows = "bf16", sil_bf16, bf16_windows
+    else:
+        per_chip = f32_rate / n_chips
+        precision, sil, windows = "highest", sil_f32, f32_windows
 
     # CPU (Spark-CPU proxy) denominator on a bounded sample, same shape.
     # Best-of-2 (fastest CPU run) keeps the reported ratio conservative.
@@ -420,12 +441,17 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
         out["bf16_rps_per_chip"] = round(bf16_rate / n_chips, 1)
         out["silhouette_f32"] = round(sil_f32, 4)
         out["silhouette_bf16"] = round(sil_bf16, 4)
+    if fused_rate is not None:
+        out["fused_stats_rps_per_chip"] = round(fused_rate / n_chips, 1)
+        out["silhouette_fused"] = round(sil_fused, 4)
     if tuned:
         out["chunk_autotune_rps"] = tuned
     if on_tpu:
         out.update(
             _kmeans_roofline(
-                per_chip, k, d, precision, jax.devices()[0].device_kind
+                per_chip, k, d,
+                "bf16" if precision.startswith("bf16") else precision,
+                jax.devices()[0].device_kind,
             )
         )
     return out
@@ -1017,7 +1043,8 @@ CONFIGS = {
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets
 # the compile + 10M-row CPU-proxy headroom.
-_CONFIG_TIMEOUT = {"kmeans256": 600}
+_CONFIG_TIMEOUT = {"kmeans256": 780}  # 5-candidate autotune + bf16 A/B
+# (each candidate pays a ~20-40s cold compile before its ≥2s window)
 _DEFAULT_CONFIG_TIMEOUT = 420
 
 

@@ -88,12 +88,27 @@ def _chunked(n_loc: int, target: int) -> tuple[int, int]:
 
 def _lloyd_shard_stats(
     n_loc: int, k_pad: int, d: int, chunk_rows: int, m: int,
-    precision: str = "highest",
+    precision: str = "highest", fuse_stats: bool = False,
 ):
     """Shard-local Lloyd sufficient statistics — the chunk-scanned
     assignment + accumulation shared by the resident train step and the
     out-of-core block-stats step.  Returns a function
-    ``(x, w, centers, c_valid) -> (sums, counts, cost)`` (pre-psum)."""
+    ``(x, w, centers, c_valid) -> (sums, counts, cost)`` (pre-psum).
+
+    ``fuse_stats`` (bf16-mode only; bench-A/B'd before the headline
+    adopts it) restructures the accumulation half of the step for MXU
+    rate: the assignment argmin runs on the x²-free basis ``c_sq −
+    2·x·cᵀ`` (row-constant x² cannot change the argmin — one fewer VPU
+    pass over the (chunk, k) tile, with x² re-added only for the scalar
+    cost), and sums+counts come from ONE bf16 one-hot matmul against
+    ``[x | 1]`` (f32 accumulation) instead of an f32 matmul plus a
+    separate reduction — the sums matmul otherwise costs the same
+    2·k·d FLOPs as the distance matmul but at the slower precision.
+    Loop-internal cost carries bf16 cross-term rounding exactly like the
+    plain bf16 mode; the fit's final cost/sizes stay exact (see
+    ``_make_train_loop``)."""
+    if fuse_stats and precision != "bf16":
+        raise ValueError("fuse_stats requires matmul_precision='bf16'")
     n_chunks, chunk = _chunked(n_loc, chunk_rows)
     pad_to = n_chunks * chunk
     k_loc = k_pad // m
@@ -107,23 +122,51 @@ def _lloyd_shard_stats(
         xc = xp.reshape(n_chunks, chunk, d)
         wc = wp.reshape(n_chunks, chunk)
         c_sq = sq_norms(centers)
+        cen_bf = centers.astype(jnp.bfloat16) if fuse_stats else None
 
         def body(carry, inputs):
             sums, counts, cost = carry
             xb, wb = inputs
-            d2 = pairwise_sqdist(xb, centers, c_sq=c_sq, precision=precision)
-            d2 = jnp.where(c_valid[None, :] > 0, d2, _BIG)
-            loc_min = jnp.min(d2, axis=1)
-            loc_arg = jnp.argmin(d2, axis=1).astype(jnp.int32)
+            if fuse_stats:
+                cross = jnp.dot(
+                    xb.astype(jnp.bfloat16), cen_bf.T,
+                    preferred_element_type=jnp.float32,
+                )
+                # x²-free argmin basis: x_sq is row-constant, so both the
+                # local argmin AND the cross-shard owner comparison are
+                # unchanged (every shard sees the same row's x_sq)
+                basis = c_sq[None, :] - 2.0 * cross
+                basis = jnp.where(c_valid[None, :] > 0, basis, _BIG)
+                loc_min = jnp.min(basis, axis=1)
+                loc_arg = jnp.argmin(basis, axis=1).astype(jnp.int32)
+            else:
+                d2 = pairwise_sqdist(xb, centers, c_sq=c_sq, precision=precision)
+                d2 = jnp.where(c_valid[None, :] > 0, d2, _BIG)
+                loc_min = jnp.min(d2, axis=1)
+                loc_arg = jnp.argmin(d2, axis=1).astype(jnp.int32)
             # Resolve global argmin across the model axis: m scalars/row.
             all_min = lax.all_gather(loc_min, MODEL_AXIS)        # (m, chunk)
             owner = jnp.argmin(all_min, axis=0).astype(jnp.int32)  # (chunk,)
             g_min = jnp.min(all_min, axis=0)
             mine = (owner == my_m) & (wb > 0)
-            onehot = jax.nn.one_hot(loc_arg, k_loc, dtype=xb.dtype)
-            onehot = onehot * (mine.astype(xb.dtype) * wb)[:, None]
-            sums = sums + onehot.T @ xb
-            counts = counts + jnp.sum(onehot, axis=0)
+            if fuse_stats:
+                g_min = jnp.maximum(g_min + sq_norms(xb), 0.0)
+                oh = jax.nn.one_hot(loc_arg, k_loc, dtype=jnp.bfloat16)
+                oh = oh * (
+                    mine.astype(jnp.bfloat16) * wb.astype(jnp.bfloat16)
+                )[:, None]
+                x1 = jnp.concatenate(
+                    [xb.astype(jnp.bfloat16), jnp.ones((chunk, 1), jnp.bfloat16)],
+                    axis=1,
+                )
+                sc = jnp.dot(oh.T, x1, preferred_element_type=jnp.float32)
+                sums = sums + sc[:, :d]
+                counts = counts + sc[:, d]
+            else:
+                onehot = jax.nn.one_hot(loc_arg, k_loc, dtype=xb.dtype)
+                onehot = onehot * (mine.astype(xb.dtype) * wb)[:, None]
+                sums = sums + onehot.T @ xb
+                counts = counts + jnp.sum(onehot, axis=0)
             cost = cost + jnp.sum(g_min * wb)
             return (sums, counts, cost), None
 
@@ -145,12 +188,17 @@ def _lloyd_shard_stats(
 def _make_train_step(
     mesh: Mesh, n_loc: int, k_pad: int, d: int, chunk_rows: int,
     cosine: bool = False, precision: str = "highest",
+    fuse_stats: bool = False,
 ):
     """One full Lloyd iteration as a shard_map over (data, model).
     ``precision`` picks the assignment matmul mode (``"bf16"`` = native
-    one-pass MXU rate with f32 accumulation; see ops/distance.py)."""
+    one-pass MXU rate with f32 accumulation; see ops/distance.py);
+    ``fuse_stats`` additionally runs the accumulation half at that rate
+    (see :func:`_lloyd_shard_stats`)."""
     m = mesh.shape[MODEL_AXIS]
-    stats = _lloyd_shard_stats(n_loc, k_pad, d, chunk_rows, m, precision)
+    stats = _lloyd_shard_stats(
+        n_loc, k_pad, d, chunk_rows, m, precision, fuse_stats
+    )
 
     def shard_fn(x, w, centers, c_valid):
         sums, counts, cost = stats(x, w, centers, c_valid)
@@ -169,14 +217,16 @@ def _make_train_step(
 @lru_cache(maxsize=64)
 def _make_stats_step(
     mesh: Mesh, n_loc: int, k_pad: int, d: int, chunk_rows: int,
-    precision: str = "highest",
+    precision: str = "highest", fuse_stats: bool = False,
 ):
     """Per-BLOCK Lloyd sufficient statistics (sums, counts, cost), psum'd
     over the mesh but WITHOUT the centroid update — the out-of-core driver
     accumulates these across host row blocks, then applies one
     :func:`_centroid_update` per Lloyd iteration."""
     m = mesh.shape[MODEL_AXIS]
-    stats = _lloyd_shard_stats(n_loc, k_pad, d, chunk_rows, m, precision)
+    stats = _lloyd_shard_stats(
+        n_loc, k_pad, d, chunk_rows, m, precision, fuse_stats
+    )
 
     def shard_fn(x, w, centers, c_valid):
         sums, counts, cost = stats(x, w, centers, c_valid)
@@ -263,6 +313,7 @@ def _make_train_loop(
     max_iter: int,
     tol_sq: float,
     precision: str = "highest",
+    fuse_stats: bool = False,
 ):
     """The whole Lloyd loop as ONE device computation: ``lax.while_loop``
     around the shard-mapped step, plus a final stats pass on the converged
@@ -271,7 +322,9 @@ def _make_train_loop(
     wall-clock on remote-attached chips; this version syncs once per fit.
     Used whenever no per-iteration host hook (checkpoint/on_iteration) is
     installed."""
-    step = _make_train_step(mesh, n_loc, k_pad, d, chunk_rows, cosine, precision)
+    step = _make_train_step(
+        mesh, n_loc, k_pad, d, chunk_rows, cosine, precision, fuse_stats
+    )
     # the returned cost/sizes are always computed exactly: reduced-precision
     # assignment matmuls are a throughput trade for the ITERATIONS, but the
     # reported objective must not inherit bf16 cancellation error (the
@@ -479,6 +532,13 @@ class KMeans(Estimator):
     # operands and accumulates f32 — ONE pass, the native systolic rate.
     # Default stays exact; the bench A/Bs "bf16" against silhouette parity.
     matmul_precision: str = "highest"
+    # bf16-mode-only accumulation restructure (x²-free argmin basis +
+    # one bf16 one-hot matmul for sums AND counts — see
+    # _lloyd_shard_stats).  The sums matmul costs the same 2·k·d
+    # FLOPs/row as the distance matmul, so leaving it at f32 caps the
+    # bf16 mode's win near 2×; the bench A/Bs this flag on-chip under
+    # the same silhouette-parity gate before the headline adopts it.
+    fused_stats: bool = False
     # Pallas fused Lloyd kernel (ops/pallas_kernels.py), opt-in; requires
     # model axis 1.  None/False = the XLA scan path, which measures faster
     # at this workload's shapes (kernel docstring has the numbers).
@@ -574,7 +634,8 @@ class KMeans(Estimator):
         _, b = hd.block_shape(mesh)
         n_loc = b // mesh.shape[DATA_AXIS]
         step = _make_stats_step(
-            mesh, n_loc, k_pad, d, self.chunk_rows, self.matmul_precision
+            mesh, n_loc, k_pad, d, self.chunk_rows, self.matmul_precision,
+            self.fused_stats,
         )
         final_stats = (
             step
@@ -636,6 +697,17 @@ class KMeans(Estimator):
         from ..parallel.outofcore import HostDataset
 
         validate_matmul_precision(self.matmul_precision)
+        if self.fused_stats and self.matmul_precision != "bf16":
+            raise ValueError(
+                "fused_stats=True requires matmul_precision='bf16' (it is "
+                "the bf16-rate accumulation mode; the exact path keeps f32 "
+                "sums)"
+            )
+        if self.fused_stats and self.use_pallas:
+            raise ValueError(
+                "fused_stats and use_pallas are mutually exclusive — the "
+                "Pallas kernel owns the whole Lloyd step"
+            )
         mesh = mesh or default_mesh()
         if isinstance(data, HostDataset):
             return self._fit_outofcore(data, mesh, on_iteration)
@@ -704,7 +776,7 @@ class KMeans(Estimator):
         else:
             step = _make_train_step(
                 mesh, n_loc, k_pad, d, self.chunk_rows, cosine,
-                self.matmul_precision,
+                self.matmul_precision, self.fused_stats,
             )
 
         if ckpt is None and on_iteration is None and not fused:
@@ -713,7 +785,7 @@ class KMeans(Estimator):
             loop = _make_train_loop(
                 mesh, n_loc, k_pad, d, self.chunk_rows, cosine,
                 self.max_iter - (start_it - 1), float(self.tol * self.tol),
-                self.matmul_precision,
+                self.matmul_precision, self.fused_stats,
             )
             centers, counts, cost_dev, it_dev = loop(x, ds.w, centers, c_valid_dev)
             it = (start_it - 1) + int(it_dev)

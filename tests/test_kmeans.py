@@ -177,3 +177,61 @@ def test_kmeans_bf16_precision_parity(rng, mesh8):
     )
     with pytest.raises(ValueError, match="matmul_precision"):
         KMeans(k=4, matmul_precision="fp8").fit(x, mesh=mesh8)
+
+
+@pytest.mark.fast
+def test_kmeans_fused_stats_parity(rng, mesh8):
+    """fused_stats=True (bf16-rate accumulation: x²-free argmin basis +
+    one bf16 one-hot matmul for sums AND counts) recovers the same
+    clustering as the plain bf16 mode — the gate behind the bench's
+    second A/B rung."""
+    x, labels, _ = _blobs(rng, n=800, k=4, d=6)
+    exact = KMeans(k=4, seed=0).fit(x, mesh=mesh8)
+    fused = KMeans(
+        k=4, seed=0, matmul_precision="bf16", fused_stats=True
+    ).fit(x, mesh=mesh8)
+    a, b = exact.predict_numpy(x), fused.predict_numpy(x)
+    remap = {}
+    for ca, cb in zip(a, b):
+        remap.setdefault(ca, cb)
+    assert np.mean([remap[ca] == cb for ca, cb in zip(a, b)]) > 0.995
+    np.testing.assert_allclose(
+        fused.training_cost, exact.training_cost, rtol=1e-2
+    )
+    # sizes survive the bf16 ones-column counts (integer-exact ≤ 2^24)
+    assert int(sum(fused.cluster_sizes)) == len(x)
+    with pytest.raises(ValueError, match="fused_stats"):
+        KMeans(k=4, fused_stats=True).fit(x, mesh=mesh8)
+
+
+def test_kmeans_fused_stats_2d_mesh(rng, mesh42):
+    """fused_stats on the (data=4, model=2) mesh: the x²-free argmin
+    basis must resolve the cross-shard owner identically to the full-d²
+    comparison (x² is row-constant, hence shard-invariant)."""
+    x, labels, _ = _blobs(rng, n=640, k=4, d=6)
+    base = KMeans(k=4, seed=0, matmul_precision="bf16").fit(x, mesh=mesh42)
+    fused = KMeans(
+        k=4, seed=0, matmul_precision="bf16", fused_stats=True
+    ).fit(x, mesh=mesh42)
+    dist = np.linalg.norm(
+        base.cluster_centers[:, None] - fused.cluster_centers[None], axis=2
+    )
+    assert dist.min(axis=1).max() < 0.05
+
+
+def test_kmeans_fused_stats_weighted(rng, mesh8):
+    """Fractional sample weights ride the bf16 ones-column: counts carry
+    ~1e-3 relative rounding but the partition still matches exact f32."""
+    x, labels, _ = _blobs(rng, n=600, k=3, d=5)
+    w = rng.uniform(0.5, 2.0, len(x)).astype(np.float32)
+    exact = KMeans(k=3, seed=0).fit((x, None, w), mesh=mesh8)
+    fused = KMeans(
+        k=3, seed=0, matmul_precision="bf16", fused_stats=True
+    ).fit((x, None, w), mesh=mesh8)
+    dist = np.linalg.norm(
+        exact.cluster_centers[:, None] - fused.cluster_centers[None], axis=2
+    )
+    assert dist.min(axis=1).max() < 0.05
+    np.testing.assert_allclose(
+        sorted(fused.cluster_sizes), sorted(exact.cluster_sizes), rtol=5e-3
+    )

@@ -1020,3 +1020,24 @@ def test_hostdataset_negative_weights_rejected():
             x=np.ones((4, 2), np.float32),
             w=np.array([1.0, -1.0, 1.0, 1.0], np.float32),
         )
+
+
+def test_outofcore_kmeans_fused_stats(rng, mesh8):
+    """fused_stats must actually reach the out-of-core block kernel (it
+    was silently dropped there once): streamed fused fit matches the
+    resident fused fit."""
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+    k, d, n = 4, 5, 2000
+    centers = rng.normal(scale=5.0, size=(k, d))
+    x = (centers[rng.integers(0, k, n)] + rng.normal(scale=0.3, size=(n, d))).astype(
+        np.float32
+    )
+    est = ht.KMeans(k=k, seed=0, matmul_precision="bf16", fused_stats=True)
+    resident = est.fit(x, mesh=mesh8)
+    streamed = est.fit(ht.HostDataset(x=x, max_device_rows=256), mesh=mesh8)
+    dist = np.linalg.norm(
+        resident.cluster_centers[:, None] - streamed.cluster_centers[None],
+        axis=2,
+    )
+    assert dist.min(axis=1).max() < 0.05
