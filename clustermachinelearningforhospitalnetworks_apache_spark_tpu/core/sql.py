@@ -7,9 +7,12 @@ same one-liner.  This module covers that working set with a hand-rolled
 tokenizer + recursive-descent parser + numpy columnar executor — no
 Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
 
-    SELECT [DISTINCT] [* [, extras] | cols | agg(col) | arithmetic
-                       expressions over cols/aggs/literals (+ - * /,
-                       parentheses, unary minus) [AS alias]]
+    SELECT [DISTINCT] [* [, extras] | cols | agg(col) | agg(expr)
+                       (e.g. SUM(CASE WHEN … END) — conditional
+                       aggregation) | arithmetic expressions over
+                       cols/aggs/literals (+ - * /, parentheses, unary
+                       minus) | CASE WHEN <pred> THEN <expr> […]
+                       [ELSE <expr>] END [AS alias]]
       FROM t [[AS] a]
       [[INNER|LEFT] JOIN t2 [[AS] b] ON a.key = b.key]   (single-key
                                          equi-join, vectorized hash join)
@@ -52,6 +55,7 @@ _KEYWORDS = {
     "select", "from", "where", "group", "by", "order", "limit",
     "and", "or", "between", "as", "asc", "desc",
     "distinct", "join", "inner", "left", "on", "having",
+    "case", "when", "then", "else", "end",
 } | _AGGS
 
 
@@ -95,7 +99,52 @@ def _expr_has_agg(e) -> bool:
         return _expr_has_agg(e[1])
     if k == "bin":
         return _expr_has_agg(e[2]) or _expr_has_agg(e[3])
+    if k == "case":
+        return any(_expr_has_agg(v) for _, v in e[1]) or _expr_has_agg(e[2])
+    if k == "aggex":
+        return True
     return False
+
+
+def _lower_aggex(e, compute):
+    """Replace ``("aggex", agg, inner)`` nodes (aggregates over arbitrary
+    expressions — ``sum(CASE WHEN … END)``) with sentinel ``("agg", key)``
+    atoms whose values ``compute(agg, inner_expr)`` produced against the
+    SOURCE rows; → (lowered expr, {sentinel: value}).  Lets every
+    aggregate-context evaluator keep its one name-based atom resolver."""
+    replaced: dict[str, Any] = {}
+
+    def walk(node):
+        if node is None:
+            return None
+        k = node[0]
+        if k == "aggex":
+            key = f"__aggex{len(replaced)}__"
+            replaced[key] = compute(node[1], node[2])
+            return ("agg", key)
+        if k == "neg":
+            return ("neg", walk(node[1]))
+        if k == "bin":
+            return ("bin", node[1], walk(node[2]), walk(node[3]))
+        if k == "case":
+            return (
+                "case",
+                [(c, walk(v)) for c, v in node[1]],
+                walk(node[2]),
+            )
+        return node
+
+    return walk(e), replaced
+
+
+def _cond_cols(c) -> list[str]:
+    """Column names referenced by a predicate tree."""
+    if c is None:
+        return []
+    k = c[0]
+    if k in ("and", "or"):
+        return _cond_cols(c[1]) + _cond_cols(c[2])
+    return [c[1]]  # between / cmp carry the name at index 1
 
 
 def _expr_cols(e) -> list[str]:
@@ -109,6 +158,11 @@ def _expr_cols(e) -> list[str]:
         return _expr_cols(e[1])
     if k == "bin":
         return _expr_cols(e[2]) + _expr_cols(e[3])
+    if k == "case":
+        out: list[str] = []
+        for cond, v in e[1]:
+            out += _cond_cols(cond) + _expr_cols(v)
+        return out + _expr_cols(e[2])
     return []
 
 
@@ -123,6 +177,10 @@ def _render_expr(e) -> str:
         return e[1]
     if k == "neg":
         return f"-{_render_expr(e[1])}"
+    if k == "case":
+        return "CASE"
+    if k == "aggex":
+        return f"{e[1]}({_render_expr(e[2])})"
     return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
 
 
@@ -137,6 +195,36 @@ def _eval_expr(getcol, e):
         return e[1]
     if k == "neg":
         return -_eval_expr(getcol, e[1])
+    if k == "case":
+        branches, default = e[1], e[2]
+        conds = [
+            np.asarray(_eval_cond(getcol, c), bool) for c, _ in branches
+        ]
+        vals = [_eval_expr(getcol, v) for _, v in branches]
+        if default is None:
+            # implicit ELSE is NULL in the result's own type family
+            kinds = {
+                np.asarray(v).dtype.kind if np.ndim(v) else
+                ("U" if isinstance(v, str) else "f")
+                for v in vals
+            }
+            if kinds & set("USO"):
+                dflt = None                       # object NULL
+            elif "M" in kinds:
+                dflt = np.datetime64("NaT")
+            elif "m" in kinds:
+                dflt = np.timedelta64("NaT")
+            else:
+                dflt = np.nan
+        else:
+            dflt = _eval_expr(getcol, default)
+        try:
+            return np.select(conds, vals, default=dflt)
+        except TypeError as exc:
+            raise ValueError(
+                "SQL: CASE branches (and ELSE) have incompatible types: "
+                f"{exc}"
+            ) from None
     _, op, le, re_ = e
     lv = _eval_expr(getcol, le)
     rv = _eval_expr(getcol, re_)
@@ -352,11 +440,48 @@ class _Parser:
             return e
         if t[0] in ("num", "str"):
             return ("lit", self._literal())
+        if t == ("kw", "case"):
+            return self._case_expr()
         if t[0] == "kw" and t[1] in _AGGS:
-            return ("agg", self._name(allow_agg=True))
+            return self._agg_factor()
         if t[0] == "name":
             return ("col", self._name())
         raise ValueError(f"SQL: expected column, literal or aggregate, got {t[1]!r}")
+
+    def _agg_factor(self):
+        """``agg(col)`` / ``count(*)`` keep the legacy name spelling
+        (HAVING/ORDER BY canonical references match on it); an aggregate
+        over any OTHER expression — ``sum(CASE WHEN … END)``,
+        ``avg(a*b)`` — becomes an ``aggex`` node, lowered per query."""
+        agg = self._next()[1]
+        self._expect("op", "(")
+        if self._accept("op", "*"):
+            if agg != "count":
+                raise ValueError(f"SQL: {agg.upper()}(*) is not defined")
+            self._expect("op", ")")
+            return ("agg", "count(*)")
+        inner = self._expr()
+        self._expect("op", ")")
+        if inner[0] == "col":
+            return ("agg", f"{agg}({inner[1]})")
+        return ("aggex", agg, inner)
+
+    def _case_expr(self):
+        """``CASE WHEN <cond> THEN <expr> [...] [ELSE <expr>] END`` —
+        Spark's searched-CASE form (the SQL spelling of the reference's
+        ``when(...).otherwise(...)`` LOS binarization,
+        ``mllearnforhospitalnetwork.py:176-177``)."""
+        self._expect("kw", "case")
+        branches = []
+        while self._accept("kw", "when"):
+            cond = self._or_cond()
+            self._expect("kw", "then")
+            branches.append((cond, self._expr()))
+        if not branches:
+            raise ValueError("SQL: CASE needs at least one WHEN branch")
+        default = self._expr() if self._accept("kw", "else") else None
+        self._expect("kw", "end")
+        return ("case", branches, default)
 
     def _or_cond(self, allow_agg: bool = False):
         left = self._and_cond(allow_agg)
@@ -760,10 +885,22 @@ def execute(query: str, resolve_table) -> Table:
                 return _grouped_aggregate(getcol(c), agg, starts, order_idx)
             return getcol(name)[first_row]
 
+        def grouped_aggex(agg: str, inner) -> np.ndarray:
+            # aggregate over an arbitrary row expression: evaluate the
+            # inner expr against SOURCE rows, then the usual reduceat
+            vals = _eval_expr(getcol, inner)
+            if np.ndim(vals) == 0:
+                vals = np.full(len(t), vals)
+            return _grouped_aggregate(np.asarray(vals), agg, starts, order_idx)
+
         cols: dict[str, Any] = {}
         for it in items:
             if it.expr is not None:
-                v = _eval_expr(per_group_atom, it.expr)
+                low, extra = _lower_aggex(it.expr, grouped_aggex)
+                v = _eval_expr(
+                    lambda n: extra[n] if n in extra else per_group_atom(n),
+                    low,
+                )
                 cols[it.alias] = (
                     np.full(len(first_row), v) if np.ndim(v) == 0 else v
                 )
@@ -863,10 +1000,24 @@ def execute(query: str, resolve_table) -> Table:
             # projection path; arithmetic contexts promote as needed
             return len(t) if c == "*" else _aggregate(getcol(c), agg)
 
+        def scalar_aggex(agg: str, inner):
+            vals = _eval_expr(getcol, inner)
+            if np.ndim(vals) == 0:
+                vals = np.full(len(t), vals)
+            return _aggregate(np.asarray(vals), agg)
+
         out_cols: dict[str, Any] = {}
         for it in items:
             if it.expr is not None:
-                out_cols[it.alias] = np.asarray([_eval_expr(scalar_atom, it.expr)])
+                low, extra = _lower_aggex(it.expr, scalar_aggex)
+                out_cols[it.alias] = np.asarray(
+                    [
+                        _eval_expr(
+                            lambda n: extra[n] if n in extra else scalar_atom(n),
+                            low,
+                        )
+                    ]
+                )
             else:
                 out_cols[it.alias] = np.asarray(
                     [len(t) if it.col is None else _aggregate(getcol(it.col), it.agg)]

@@ -587,3 +587,87 @@ def test_order_by_constant_expression_alias_keeps_all_rows(session):
     out = execute("SELECT a, 1 + 1 AS two FROM t ORDER BY two", lambda n: t)
     assert len(out) == 3
     np.testing.assert_allclose(out.column("two"), [2.0, 2.0, 2.0])
+
+
+# ------------------------------------------------------------ CASE WHEN
+class TestCaseWhen:
+    def _t(self):
+        return ht.Table.from_dict(
+            {
+                "los": np.array([2.0, 6.5, 4.0, 9.0, np.nan]),
+                "hosp": np.array(["a", "b", "a", "c", "b"], dtype=object),
+            }
+        )
+
+    def test_case_projection(self, session):
+        session.register_table("adm", self._t())
+        r = session.sql(
+            "SELECT CASE WHEN los > 5.0 THEN 1 ELSE 0 END AS LOS_binary FROM adm"
+        )
+        # NULL > 5 is NULL -> falsy -> ELSE (Spark semantics)
+        np.testing.assert_array_equal(r.column("LOS_binary"), [0, 1, 0, 1, 0])
+
+    def test_case_string_implicit_else_null(self, session):
+        session.register_table("adm", self._t())
+        r = session.sql(
+            "SELECT CASE WHEN los > 8 THEN 'high' WHEN los > 5 THEN 'mid' END "
+            "AS tier FROM adm"
+        )
+        assert list(r.column("tier")) == [None, "mid", None, "high", None]
+
+    def test_case_in_where_order(self, session):
+        session.register_table("adm", self._t())
+        r = session.sql(
+            "SELECT los, CASE WHEN los > 5 THEN los ELSE 0 END AS capped "
+            "FROM adm WHERE los > 1 ORDER BY capped DESC LIMIT 2"
+        )
+        np.testing.assert_array_equal(r.column("capped"), [9.0, 6.5])
+
+    def test_agg_over_case_scalar(self, session):
+        session.register_table("adm", self._t())
+        r = session.sql(
+            "SELECT avg(CASE WHEN los > 5 THEN 1 ELSE 0 END) AS frac, "
+            "count(CASE WHEN los > 3 THEN 1 END) AS c FROM adm"
+        )
+        assert r.column("frac")[0] == pytest.approx(0.4)
+        assert r.column("c")[0] == 3  # count skips the implicit-ELSE nulls
+
+    def test_agg_over_case_grouped(self, session):
+        session.register_table("adm", self._t())
+        r = session.sql(
+            "SELECT hosp, sum(CASE WHEN los > 5 THEN 1 ELSE 0 END) AS n_high "
+            "FROM adm GROUP BY hosp ORDER BY hosp"
+        )
+        np.testing.assert_array_equal(r.column("n_high"), [0.0, 1.0, 1.0])
+
+    def test_agg_over_arithmetic(self, session):
+        session.register_table("adm", self._t())
+        r = session.sql("SELECT avg(los * 2) AS a2 FROM adm")
+        assert r.column("a2")[0] == pytest.approx(10.75)  # nulls skipped
+
+    def test_case_requires_when_and_end(self, session):
+        session.register_table("adm", self._t())
+        with pytest.raises(ValueError, match="WHEN"):
+            session.sql("SELECT CASE ELSE 1 END AS x FROM adm")
+        with pytest.raises(ValueError, match="end"):
+            session.sql("SELECT CASE WHEN los > 1 THEN 1 AS x FROM adm")
+
+    def test_case_datetime_implicit_else_is_nat(self, session):
+        t = ht.Table.from_dict(
+            {
+                "los": np.array([2.0, 9.0]),
+                "ts": np.array(
+                    ["2025-03-31T22:00:00", "2025-03-31T23:00:00"],
+                    dtype="datetime64[s]",
+                ),
+            }
+        )
+        session.register_table("adm2", t)
+        r = session.sql("SELECT CASE WHEN los > 5 THEN ts END AS t2 FROM adm2")
+        out = r.column("t2")
+        assert np.isnat(out[0]) and out[1] == np.datetime64("2025-03-31T23:00:00")
+
+    def test_case_incompatible_branch_types_friendly_error(self, session):
+        session.register_table("adm", self._t())
+        with pytest.raises(ValueError, match="incompatible types"):
+            session.sql("SELECT CASE WHEN los > 5 THEN 'hi' ELSE 0 END AS x FROM adm")
