@@ -17,7 +17,12 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
       [[INNER|LEFT] JOIN t2 [[AS] b] ON a.key = b.key]   (single-key
                                          equi-join, vectorized hash join)
       [WHERE <pred> {AND|OR} ...]        predicates: = != <> < <= > >=,
-                                         BETWEEN 'a' AND 'b', parentheses
+                                         BETWEEN 'a' AND 'b', IS [NOT]
+                                         NULL, [NOT] IN (v, …), NOT,
+                                         parentheses — evaluated under
+                                         SQL three-valued logic (UNKNOWN
+                                         propagates through AND/OR/NOT
+                                         like Spark)
       [GROUP BY cols]                    aggs: COUNT(*) SUM AVG MIN MAX
       [HAVING <pred over aggregates>]
       [ORDER BY col [ASC|DESC]]
@@ -56,6 +61,7 @@ _KEYWORDS = {
     "and", "or", "between", "as", "asc", "desc",
     "distinct", "join", "inner", "left", "on", "having",
     "case", "when", "then", "else", "end",
+    "not", "is", "null", "in",
 } | _AGGS
 
 
@@ -144,7 +150,9 @@ def _cond_cols(c) -> list[str]:
     k = c[0]
     if k in ("and", "or"):
         return _cond_cols(c[1]) + _cond_cols(c[2])
-    return [c[1]]  # between / cmp carry the name at index 1
+    if k == "not":
+        return _cond_cols(c[1])
+    return [c[1]]  # between / cmp / in / isnull carry the name at index 1
 
 
 def _expr_cols(e) -> list[str]:
@@ -347,19 +355,20 @@ class _Parser:
 
     def _name(self, allow_agg: bool = False) -> str:
         """Possibly-qualified column reference → "alias.col" | "col";
-        with ``allow_agg``, also "agg(col)" / "count(*)" (HAVING/ORDER)."""
+        with ``allow_agg``, also "agg(col)" / "count(*)" (HAVING/ORDER).
+        Delegates aggregate parsing to :meth:`_agg_factor` — ONE copy of
+        the COUNT(*) rule and canonical spelling, so SELECT and
+        HAVING/ORDER BY references can never drift."""
+        if allow_agg and self._peek()[0] == "kw" and self._peek()[1] in _AGGS:
+            node = self._agg_factor()
+            if node[0] != "agg":
+                raise ValueError(
+                    "SQL: aggregates over expressions (e.g. SUM(CASE … END)) "
+                    "are only supported in the select list — alias the "
+                    "select item and reference the alias here"
+                )
+            return node[1]
         t = self._next()
-        if allow_agg and t[0] == "kw" and t[1] in _AGGS:
-            agg = t[1]
-            self._expect("op", "(")
-            if self._accept("op", "*"):
-                if agg != "count":
-                    raise ValueError(f"SQL: {agg.upper()}(*) is not defined")
-                col = None
-            else:
-                col = self._qual_tail(self._expect("name")[1])
-            self._expect("op", ")")
-            return f"{agg}({col or '*'})"
         if t[0] != "name":
             raise ValueError(f"SQL: expected a column name, got {t[1]!r}")
         return self._qual_tail(t[1])
@@ -496,6 +505,8 @@ class _Parser:
         return left
 
     def _pred(self, allow_agg: bool = False):
+        if self._accept("kw", "not"):
+            return ("not", self._pred(allow_agg))
         if self._accept("op", "("):
             c = self._or_cond(allow_agg)
             self._expect("op", ")")
@@ -506,6 +517,24 @@ class _Parser:
             self._expect("kw", "and")
             hi = self._literal()
             return ("between", col, lo, hi)
+        if self._accept("kw", "is"):
+            negate = bool(self._accept("kw", "not"))
+            self._expect("kw", "null")
+            node = ("isnull", col)
+            return ("not", node) if negate else node
+        negate = bool(self._accept("kw", "not"))
+        if self._accept("kw", "in"):
+            self._expect("op", "(")
+            vals = [self._literal()]
+            while self._accept("op", ","):
+                vals.append(self._literal())
+            self._expect("op", ")")
+            node = ("in", col, vals)
+            # NOT IN keeps Spark null semantics: a null row fails both
+            # IN and NOT IN, so the negation applies only to valid rows
+            return ("notin", col, vals) if negate else node
+        if negate:
+            raise ValueError("SQL: expected IN after NOT")
         op = self._expect("op")[1]
         if op not in ("=", "!=", "<>", "<", "<=", ">", ">="):
             raise ValueError(f"SQL: unsupported operator {op!r}")
@@ -530,31 +559,66 @@ def _coerce(col: np.ndarray, lit: Any) -> Any:
 
 
 def _eval_cond(getcol, cond) -> np.ndarray:
-    """Evaluate a predicate tree; ``getcol(name) -> np.ndarray`` resolves
-    (possibly qualified / aggregate) column references."""
+    """Evaluate a predicate tree to the rows-that-pass mask; ``getcol(name)
+    -> np.ndarray`` resolves (possibly qualified / aggregate) column
+    references.  SQL three-valued logic: a row passes only when the
+    predicate is exactly TRUE (UNKNOWN filters like FALSE), but UNKNOWN
+    still short-circuits correctly through AND/OR/NOT — ``FALSE AND
+    NULL`` is FALSE, so ``NOT (a > 5 AND b > 5)`` keeps a row with a ≤ 5
+    and b null, exactly like Spark."""
+    t, _ = _eval_cond3(getcol, cond)
+    return t
+
+
+def _eval_cond3(getcol, cond) -> tuple[np.ndarray, np.ndarray]:
+    """→ (true_mask, unknown_mask) under SQL three-valued logic."""
     kind = cond[0]
     if kind == "and":
-        return _eval_cond(getcol, cond[1]) & _eval_cond(getcol, cond[2])
+        t1, n1 = _eval_cond3(getcol, cond[1])
+        t2, n2 = _eval_cond3(getcol, cond[2])
+        f1, f2 = ~t1 & ~n1, ~t2 & ~n2
+        return t1 & t2, ~(f1 | f2) & (n1 | n2)
     if kind == "or":
-        return _eval_cond(getcol, cond[1]) | _eval_cond(getcol, cond[2])
+        t1, n1 = _eval_cond3(getcol, cond[1])
+        t2, n2 = _eval_cond3(getcol, cond[2])
+        t = t1 | t2
+        return t, ~t & (n1 | n2)
+    if kind == "not":
+        t, n = _eval_cond3(getcol, cond[1])
+        return ~t & ~n, n
+    if kind == "isnull":
+        col = getcol(cond[1])
+        # IS NULL is never UNKNOWN — it inspects nullness itself
+        return _null_mask(col), np.zeros(len(col), bool)
+    if kind in ("in", "notin"):
+        _, name, vals = cond
+        col = getcol(name)
+        null = _null_mask(col)
+        out = np.zeros(len(col), bool)
+        cv = col[~null]
+        hit = np.zeros(len(cv), bool)
+        for v in vals:
+            hit |= cv == _coerce(col, v)
+        out[~null] = ~hit if kind == "notin" else hit
+        return out, null
     if kind == "between":
         _, name, lo, hi = cond
         col = getcol(name)
-        valid = ~_null_mask(col)
+        null = _null_mask(col)
         out = np.zeros(len(col), bool)
-        cv = col[valid]
-        out[valid] = (cv >= _coerce(col, lo)) & (cv <= _coerce(col, hi))
-        return out
+        cv = col[~null]
+        out[~null] = (cv >= _coerce(col, lo)) & (cv <= _coerce(col, hi))
+        return out, null
     _, name, op, lit = cond
     col = getcol(name)
     v = _coerce(col, lit)
-    # Spark null semantics: a null row fails EVERY comparison (incl. !=);
-    # masking nulls out BEFORE comparing also keeps object columns with
+    # a null operand makes the comparison UNKNOWN (incl. !=); masking
+    # nulls out BEFORE comparing also keeps object columns with
     # LEFT-JOIN None fills from raising raw TypeErrors
-    valid = ~_null_mask(col)
+    null = _null_mask(col)
     out = np.zeros(len(col), bool)
-    cv = col[valid]
-    out[valid] = {
+    cv = col[~null]
+    out[~null] = {
         "=": lambda: cv == v,
         "!=": lambda: cv != v,
         "<": lambda: cv < v,
@@ -562,7 +626,7 @@ def _eval_cond(getcol, cond) -> np.ndarray:
         ">": lambda: cv > v,
         ">=": lambda: cv >= v,
     }[op]()
-    return out
+    return out, null
 
 
 def _resolve_name(t: Table, name: str, aliases: set[str]) -> str:

@@ -671,3 +671,50 @@ class TestCaseWhen:
         session.register_table("adm", self._t())
         with pytest.raises(ValueError, match="incompatible types"):
             session.sql("SELECT CASE WHEN los > 5 THEN 'hi' ELSE 0 END AS x FROM adm")
+
+
+# ------------------------------------------- IS NULL / IN / NOT (3VL)
+class TestNullPredicates:
+    def _t(self):
+        return ht.Table.from_dict(
+            {
+                "a": np.array([1.0, 8.0, np.nan, 3.0]),
+                "b": np.array([np.nan, 1.0, 2.0, 9.0]),
+                "h": np.array(["x", "y", "z", "y"], dtype=object),
+            }
+        )
+
+    def test_is_null(self, session):
+        session.register_table("t3", self._t())
+        r = session.sql("SELECT h FROM t3 WHERE a IS NULL")
+        assert list(r.column("h")) == ["z"]
+        r2 = session.sql("SELECT h FROM t3 WHERE a IS NOT NULL AND b IS NOT NULL")
+        assert list(r2.column("h")) == ["y", "y"]
+
+    def test_in_and_not_in(self, session):
+        session.register_table("t3", self._t())
+        r = session.sql("SELECT a FROM t3 WHERE h IN ('x', 'z')")
+        np.testing.assert_array_equal(np.isnan(r.column("a")), [False, True])
+        # NOT IN on a null row: UNKNOWN -> filtered (Spark semantics)
+        r2 = session.sql("SELECT h FROM t3 WHERE a NOT IN (1, 3)")
+        assert list(r2.column("h")) == ["y"]
+
+    def test_not_three_valued(self, session):
+        session.register_table("t3", self._t())
+        # row 'x': a=1 (a>5 FALSE), b null -> (a>5 AND b>5) = FALSE AND
+        # UNKNOWN = FALSE -> NOT keeps it.  row 'z': a null, b=2 ->
+        # UNKNOWN AND FALSE = FALSE -> NOT keeps it too.  row 'y'(8,1):
+        # TRUE AND FALSE = FALSE -> kept; row 'y'(3,9): FALSE AND TRUE ->
+        # kept.  Everything passes here; the discriminating case:
+        r = session.sql("SELECT h FROM t3 WHERE NOT (a > 5 OR b > 5)")
+        # 'x': FALSE OR UNKNOWN = UNKNOWN -> NOT = UNKNOWN -> filtered
+        # 'y'(8,1): TRUE -> filtered; 'z': UNKNOWN OR FALSE -> filtered
+        # 'y'(3,9): FALSE OR TRUE = TRUE -> filtered... keep none? no:
+        assert list(r.column("h")) == []
+        r2 = session.sql("SELECT h FROM t3 WHERE NOT (a > 5 AND b > 5)")
+        assert list(r2.column("h")) == ["x", "y", "z", "y"]
+
+    def test_not_requires_in(self, session):
+        session.register_table("t3", self._t())
+        with pytest.raises(ValueError, match="IN after NOT"):
+            session.sql("SELECT h FROM t3 WHERE a NOT = 1")
