@@ -12,7 +12,9 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                        aggregation) | arithmetic expressions over
                        cols/aggs/literals (+ - * /, parentheses, unary
                        minus) | CASE WHEN <pred> THEN <expr> […]
-                       [ELSE <expr>] END [AS alias]]
+                       [ELSE <expr>] END | scalar functions ABS ROUND
+                       (HALF_UP, Spark) UPPER LOWER LENGTH COALESCE
+                       [AS alias]]
       FROM t [[AS] a]
       [[INNER|LEFT] JOIN t2 [[AS] b] ON a.key = b.key]   (single-key
                                          equi-join, vectorized hash join)
@@ -56,6 +58,9 @@ _TOKEN = re.compile(
 )
 
 _AGGS = {"count", "sum", "avg", "min", "max"}
+#: scalar functions usable in expressions (names stay valid column
+#: identifiers when not followed by "(")
+_SCALAR_FUNCS = {"abs", "round", "upper", "lower", "length", "coalesce"}
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "order", "limit",
     "and", "or", "between", "as", "asc", "desc",
@@ -107,6 +112,8 @@ def _expr_has_agg(e) -> bool:
         return _expr_has_agg(e[2]) or _expr_has_agg(e[3])
     if k == "case":
         return any(_expr_has_agg(v) for _, v in e[1]) or _expr_has_agg(e[2])
+    if k == "fn":
+        return any(_expr_has_agg(a) for a in e[2])
     if k == "aggex":
         return True
     return False
@@ -138,6 +145,8 @@ def _lower_aggex(e, compute):
                 [(c, walk(v)) for c, v in node[1]],
                 walk(node[2]),
             )
+        if k == "fn":
+            return ("fn", node[1], [walk(a) for a in node[2]])
         return node
 
     return walk(e), replaced
@@ -171,6 +180,11 @@ def _expr_cols(e) -> list[str]:
         for cond, v in e[1]:
             out += _cond_cols(cond) + _expr_cols(v)
         return out + _expr_cols(e[2])
+    if k == "fn":
+        out = []
+        for a in e[2]:
+            out += _expr_cols(a)
+        return out
     return []
 
 
@@ -187,9 +201,131 @@ def _render_expr(e) -> str:
         return f"-{_render_expr(e[1])}"
     if k == "case":
         return "CASE"
+    if k == "fn":
+        return f"{e[1]}({', '.join(_render_expr(a) for a in e[2])})"
     if k == "aggex":
         return f"{e[1]}({_render_expr(e[2])})"
     return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
+
+
+def _require_arity(name: str, vals: list, lo: int, hi: int | None = None):
+    hi = lo if hi is None else hi
+    if not lo <= len(vals) <= hi:
+        want = str(lo) if lo == hi else f"{lo}..{hi}"
+        raise ValueError(
+            f"SQL: {name.upper()} takes {want} argument(s), got {len(vals)}"
+        )
+
+
+def _eval_fn(name: str, vals: list):
+    """Scalar-function application with Spark null semantics (nulls
+    propagate except through COALESCE, which exists to absorb them)."""
+    if name == "coalesce":
+        _require_arity(name, vals, 1, 64)
+
+        def kindclass(v):
+            if np.ndim(v) == 0:
+                return "str" if isinstance(v, str) else "num"
+            k = np.asarray(v).dtype.kind
+            return "str" if k in "USO" else "num"
+
+        kinds = {kindclass(v) for v in vals}
+        if len(kinds) > 1:
+            # np.where would silently stringify the numeric side — Spark
+            # raises an analysis error for incompatible COALESCE types
+            raise ValueError(
+                "SQL: COALESCE arguments mix string and numeric types"
+            )
+        n = max((np.ndim(v) and len(v)) for v in vals)
+        if n == 0:  # all-scalar arguments: first non-null wins
+            for v in vals:
+                if not (v is None or (isinstance(v, float) and np.isnan(v))):
+                    return v
+            return np.nan
+        cols = [
+            np.full(n, v) if np.ndim(v) == 0 else np.asarray(v) for v in vals
+        ]
+        out = cols[0].copy()
+        for c in cols[1:]:
+            miss = _null_mask(out)
+            if not miss.any():
+                break
+            # object columns (string CASE/LEFT JOIN fills) assign per-mask
+            out = np.where(miss, c, out) if out.dtype != object else _obj_fill(
+                out, c, miss
+            )
+        return out
+    if name == "abs":
+        _require_arity(name, vals, 1)
+        return np.abs(vals[0])
+    if name == "round":
+        _require_arity(name, vals, 1, 2)
+        if len(vals) == 2 and np.ndim(vals[1]) != 0:
+            raise ValueError("SQL: ROUND scale must be a literal, not a column")
+        d = int(vals[1]) if len(vals) == 2 else 0
+        from decimal import ROUND_HALF_UP, Decimal, localcontext
+
+        q = Decimal(1).scaleb(-d)
+
+        def r1(x: float) -> float:
+            if not np.isfinite(x):
+                return x
+            # Decimal(repr(x)) mirrors Spark's BigDecimal.valueOf(double)
+            # (shortest-repr), so 0.285 rounds UP to 0.29 — float scaling
+            # would see 0.28499999… and round down.  A wide local context
+            # keeps quantize legal for huge magnitudes (default prec=28
+            # raises InvalidOperation at ~1e28).
+            with localcontext() as ctx:
+                ctx.prec = 330
+                return float(
+                    Decimal(repr(float(x))).quantize(q, ROUND_HALF_UP)
+                )
+
+        x = vals[0]
+        if np.ndim(x) == 0:
+            return r1(float(x))
+        return np.array([r1(float(v)) for v in np.asarray(x, np.float64)])
+    if name == "length":
+        _require_arity(name, vals, 1)
+        return _str_fn(name, vals[0], len, out_dtype=np.float64)
+    if name in ("upper", "lower"):
+        _require_arity(name, vals, 1)
+        f = str.upper if name == "upper" else str.lower
+        return _str_fn(name, vals[0], f)
+    raise ValueError(f"SQL: unknown function {name!r}")
+
+
+def _obj_fill(out: np.ndarray, c: np.ndarray, miss: np.ndarray) -> np.ndarray:
+    out = out.copy()
+    out[miss] = c[miss]
+    return out
+
+
+def _str_fn(name, v, f, out_dtype=object):
+    """Apply a str→x function elementwise; None/NaN input → null output
+    (None for object results, NaN for numeric ones); non-string values
+    raise the engine's labeled error, not a raw TypeError."""
+    if np.ndim(v) == 0:
+        if isinstance(v, str):
+            return f(v)
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            return np.nan
+        raise ValueError(f"SQL: {name.upper()} expects a string argument")
+    arr = np.asarray(v, object)
+    null = _null_mask(arr)
+    bad = [s for s in arr[~null] if not isinstance(s, str)]
+    if bad:
+        raise ValueError(
+            f"SQL: {name.upper()} expects a string column, got value "
+            f"{bad[0]!r}"
+        )
+    if out_dtype is object:
+        out = np.empty(len(arr), object)
+        out[null] = None
+    else:
+        out = np.full(len(arr), np.nan)
+    out[~null] = [f(s) for s in arr[~null]]
+    return out
 
 
 def _eval_expr(getcol, e):
@@ -233,6 +369,8 @@ def _eval_expr(getcol, e):
                 "SQL: CASE branches (and ELSE) have incompatible types: "
                 f"{exc}"
             ) from None
+    if k == "fn":
+        return _eval_fn(e[1], [_eval_expr(getcol, a) for a in e[2]])
     _, op, le, re_ = e
     lv = _eval_expr(getcol, le)
     rv = _eval_expr(getcol, re_)
@@ -454,7 +592,14 @@ class _Parser:
         if t[0] == "kw" and t[1] in _AGGS:
             return self._agg_factor()
         if t[0] == "name":
-            return ("col", self._name())
+            name = self._next()[1]
+            if name.lower() in _SCALAR_FUNCS and self._accept("op", "("):
+                args = [self._expr()]
+                while self._accept("op", ","):
+                    args.append(self._expr())
+                self._expect("op", ")")
+                return ("fn", name.lower(), args)
+            return ("col", self._qual_tail(name))
         raise ValueError(f"SQL: expected column, literal or aggregate, got {t[1]!r}")
 
     def _agg_factor(self):

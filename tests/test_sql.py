@@ -718,3 +718,73 @@ class TestNullPredicates:
         session.register_table("t3", self._t())
         with pytest.raises(ValueError, match="IN after NOT"):
             session.sql("SELECT h FROM t3 WHERE a NOT = 1")
+
+
+# ------------------------------------------------------ scalar functions
+class TestScalarFunctions:
+    def _t(self):
+        return ht.Table.from_dict(
+            {
+                "v": np.array([-2.5, 1.45, np.nan, 3.0]),
+                "s": np.array(["Ab", None, "cD", "ee"], dtype=object),
+                "fb": np.array([9.0, 9.0, 9.0, 9.0]),
+            }
+        )
+
+    def test_abs_round_halfup(self, session):
+        session.register_table("tf", self._t())
+        r = session.sql("SELECT abs(v) AS a, round(v, 1) AS r FROM tf")
+        np.testing.assert_allclose(r.column("a"), [2.5, 1.45, np.nan, 3.0])
+        # Spark ROUND is HALF_UP: 1.45 -> 1.5 (numpy's half-even gives 1.4)
+        np.testing.assert_allclose(r.column("r"), [-2.5, 1.5, np.nan, 3.0])
+
+    def test_string_functions_null_propagation(self, session):
+        session.register_table("tf", self._t())
+        r = session.sql("SELECT upper(s) AS u, lower(s) AS lo, length(s) AS L FROM tf")
+        assert list(r.column("u")) == ["AB", None, "CD", "EE"]
+        assert list(r.column("lo")) == ["ab", None, "cd", "ee"]
+        np.testing.assert_allclose(r.column("L"), [2, np.nan, 2, 2])
+
+    def test_coalesce(self, session):
+        session.register_table("tf", self._t())
+        r = session.sql("SELECT coalesce(v, fb) AS c FROM tf")
+        np.testing.assert_allclose(r.column("c"), [-2.5, 1.45, 9.0, 3.0])
+        r2 = session.sql("SELECT coalesce(s, 'missing') AS cs FROM tf")
+        assert list(r2.column("cs")) == ["Ab", "missing", "cD", "ee"]
+
+    def test_fn_over_aggregate_and_in_case(self, session):
+        session.register_table("tf", self._t())
+        r = session.sql("SELECT round(avg(v), 2) AS m FROM tf")
+        assert r.column("m")[0] == pytest.approx(0.65)
+        r2 = session.sql(
+            "SELECT CASE WHEN v > 0 THEN round(v) ELSE abs(v) END AS x FROM tf"
+        )
+        np.testing.assert_allclose(r2.column("x"), [2.5, 1.0, np.nan, 3.0])
+
+    def test_fn_arity_and_unknown(self, session):
+        session.register_table("tf", self._t())
+        with pytest.raises(ValueError, match="ABS takes 1"):
+            session.sql("SELECT abs(v, v) AS x FROM tf")
+        # a column named like a function, WITHOUT parens, stays a column
+        t2 = ht.Table.from_dict({"round": np.array([1.0, 2.0])})
+        session.register_table("tr", t2)
+        np.testing.assert_allclose(
+            session.sql("SELECT round FROM tr").column("round"), [1.0, 2.0]
+        )
+
+    def test_fn_type_guards(self, session):
+        session.register_table("tf", self._t())
+        with pytest.raises(ValueError, match="COALESCE arguments mix"):
+            session.sql("SELECT coalesce(v, 'x') AS c FROM tf")
+        with pytest.raises(ValueError, match="LENGTH expects a string"):
+            session.sql("SELECT length(v) AS L FROM tf")
+        with pytest.raises(ValueError, match="ROUND scale must be a literal"):
+            session.sql("SELECT round(v, v) AS r FROM tf")
+
+    def test_round_decimal_parity(self, session):
+        """Spark rounds via BigDecimal on the double's shortest repr:
+        0.285 -> 0.29 even though the binary value is 0.28499999..."""
+        t = ht.Table.from_dict({"x": np.array([0.285, 1e308, -0.285])})
+        session.register_table("trd", t)
+        r = session.sql("SELECT round(x, 2) AS r FROM trd")
+        np.testing.assert_allclose(r.column("r"), [0.29, 1e308, -0.29])

@@ -11,6 +11,13 @@ LOG=tools/opt_wait.log
 OUT=tools/bench_onchip_r05_session2.jsonl
 cd /root/repo
 for i in $(seq 1 60); do
+  # never compete with a driver-initiated bench run for the chip (this
+  # bash script's own cmdline never matches the pattern, and its bench
+  # children only exist inside a step, not at loop top)
+  if pgrep -f "python bench.py" >/dev/null; then
+    echo "$(date -u +%FT%T) driver bench running — standing down" >> "$LOG"
+    exit 0
+  fi
   echo "$(date -u +%FT%T) probe attempt $i" >> "$LOG"
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%FT%T) tunnel UP" >> "$LOG"
