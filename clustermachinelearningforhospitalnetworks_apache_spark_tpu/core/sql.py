@@ -208,6 +208,15 @@ def _render_expr(e) -> str:
     return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
 
 
+def _require_numeric(name: str, v) -> None:
+    kind = (
+        "f" if np.ndim(v) == 0 and not isinstance(v, str)
+        else np.asarray(v).dtype.kind
+    )
+    if kind in "USO":
+        raise ValueError(f"SQL: {name.upper()} expects a numeric argument")
+
+
 def _require_arity(name: str, vals: list, lo: int, hi: int | None = None):
     hi = lo if hi is None else hi
     if not lo <= len(vals) <= hi:
@@ -257,9 +266,11 @@ def _eval_fn(name: str, vals: list):
         return out
     if name == "abs":
         _require_arity(name, vals, 1)
+        _require_numeric(name, vals[0])
         return np.abs(vals[0])
     if name == "round":
         _require_arity(name, vals, 1, 2)
+        _require_numeric(name, vals[0])
         if len(vals) == 2 and np.ndim(vals[1]) != 0:
             raise ValueError("SQL: ROUND scale must be a literal, not a column")
         d = int(vals[1]) if len(vals) == 2 else 0
@@ -509,6 +520,12 @@ class _Parser:
         t = self._next()
         if t[0] != "name":
             raise ValueError(f"SQL: expected a column name, got {t[1]!r}")
+        if t[1].lower() in _SCALAR_FUNCS and self._peek() == ("op", "("):
+            raise ValueError(
+                f"SQL: scalar function {t[1].upper()} is only supported in "
+                "the select list — compute it there (… AS alias) and "
+                "reference the alias here"
+            )
         return self._qual_tail(t[1])
 
     def _qual_tail(self, first: str) -> str:

@@ -61,3 +61,39 @@ def plot_residuals(
     fig.tight_layout()
     fig.savefig(path, dpi=120)
     return path
+
+
+def plot_roc(summary, out_dir: str, filename: str = "roc.png") -> str:
+    """ROC curve from a ``BinaryLogisticRegressionTrainingSummary`` (its
+    ``roc`` points come from one tie-exact device pass) — the
+    classification counterpart of the reference's regression plots."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, filename)
+    curve = summary.roc
+    fig = Figure(figsize=(6, 5))
+    ax = fig.add_subplot(111)
+    ax.plot(curve[:, 0], curve[:, 1], linewidth=1.5)
+    ax.plot([0, 1], [0, 1], "r--", linewidth=1.0)
+    ax.set_xlabel("false positive rate")
+    ax.set_ylabel("true positive rate")
+    ax.set_title(f"ROC (AUC = {summary.area_under_roc:.4f})")
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    return path
+
+
+def plot_pr(summary, out_dir: str, filename: str = "pr.png") -> str:
+    """Precision-recall curve from the binary training summary."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, filename)
+    curve = summary.pr
+    fig = Figure(figsize=(6, 5))
+    ax = fig.add_subplot(111)
+    ax.plot(curve[:, 0], curve[:, 1], linewidth=1.5)
+    ax.set_xlabel("recall")
+    ax.set_ylabel("precision")
+    ax.set_title(f"PR (AUC = {summary.area_under_pr:.4f})")
+    ax.set_ylim(0.0, 1.05)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    return path

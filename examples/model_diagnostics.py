@@ -76,23 +76,12 @@ def main() -> None:
     yb = (los > np.median(los)).astype(np.float32)
     log = ht.LogisticRegression(max_iter=30).fit((x, yb), mesh=mesh)
     b = log.summary
-    roc, pr = b.roc, b.pr
     print(f"AUC={b.area_under_roc:.4f}  AUPR={b.area_under_pr:.4f}  "
           f"maxF1 @ threshold {b.max_f_measure_threshold:.3f}")
     print(f"weighted precision={b.weighted_precision:.4f} "
           f"recall={b.weighted_recall:.4f}")
-
-    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
-    axes[0].plot(roc[:, 0], roc[:, 1])
-    axes[0].plot([0, 1], [0, 1], "k--", lw=1)
-    axes[0].set(xlabel="FPR", ylabel="TPR",
-                title=f"ROC (AUC={b.area_under_roc:.3f})")
-    axes[1].plot(pr[:, 0], pr[:, 1])
-    axes[1].set(xlabel="recall", ylabel="precision",
-                title=f"PR (AUPR={b.area_under_pr:.3f})")
-    fig.tight_layout()
-    fig.savefig(os.path.join(out_dir, "roc_pr.png"), dpi=120)
-    plt.close(fig)
+    ht.viz.plot_roc(b, out_dir)
+    ht.viz.plot_pr(b, out_dir)
 
     # 3. 3-tier triage (multinomial) ----------------------------------
     tiers = np.digitize(los, np.quantile(los, [0.5, 0.85])).astype(np.float32)

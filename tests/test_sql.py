@@ -788,3 +788,14 @@ class TestScalarFunctions:
         session.register_table("trd", t)
         r = session.sql("SELECT round(x, 2) AS r FROM trd")
         np.testing.assert_allclose(r.column("r"), [0.29, 1e308, -0.29])
+
+    def test_fn_numeric_guards_and_predicate_hint(self, session):
+        session.register_table("tf", self._t())
+        with pytest.raises(ValueError, match="ABS expects a numeric"):
+            session.sql("SELECT abs(s) AS a FROM tf")
+        with pytest.raises(ValueError, match="ROUND expects a numeric"):
+            session.sql("SELECT round(s) AS r FROM tf")
+        with pytest.raises(ValueError, match="only supported in the select"):
+            session.sql("SELECT v FROM tf WHERE length(s) > 1")
+        with pytest.raises(ValueError, match="only supported in the select"):
+            session.sql("SELECT v FROM tf ORDER BY abs(v)")
