@@ -25,7 +25,10 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                                          SQL three-valued logic (UNKNOWN
                                          propagates through AND/OR/NOT
                                          like Spark)
-      [GROUP BY cols]                    aggs: COUNT(*) SUM AVG MIN MAX
+      [GROUP BY cols | exprs]            aggs: COUNT(*) SUM AVG MIN MAX;
+                                         expression keys (GROUP BY CASE
+                                         … END) match select items
+                                         syntactically, Spark's rule
       [HAVING <pred over aggregates>]
       [ORDER BY col [ASC|DESC]]
       [LIMIT n]
@@ -467,9 +470,9 @@ class _Parser:
         group = []
         if self._accept("kw", "group"):
             self._expect("kw", "by")
-            group = [self._name()]
+            group = [self._group_item()]
             while self._accept("op", ","):
-                group.append(self._name())
+                group.append(self._group_item())
         having = None
         if self._accept("kw", "having"):
             having = self._or_cond(allow_agg=True)
@@ -548,6 +551,16 @@ class _Parser:
         while self._accept("op", ","):
             items.append(self._select_item())
         return items
+
+    def _group_item(self):
+        """GROUP BY item: a plain column name (string, the common case)
+        or an expression AST (``GROUP BY CASE … END`` bucketing)."""
+        e = self._expr()
+        if e[0] == "col":
+            return e[1]
+        if _expr_has_agg(e):
+            raise ValueError("SQL: aggregates are not allowed in GROUP BY")
+        return e
 
     def _select_item(self) -> _SelectItem:
         e = self._expr()
@@ -1060,14 +1073,57 @@ def execute(query: str, resolve_table) -> Table:
     if q.group:
         if items is None:
             raise ValueError("SQL: GROUP BY requires an explicit select list")
-        group_cols = {g: _resolve_name(t, g, aliases) for g in q.group}
+        # Spark's groupByOrdinal: GROUP BY 1 refers to the FIRST select
+        # item (any other literal key would silently collapse every row
+        # into one constant group)
+        resolved_group = []
+        for g in q.group:
+            if isinstance(g, str) or g[0] != "lit":
+                resolved_group.append(g)
+                continue
+            n_ord = g[1]
+            if not isinstance(n_ord, int) or not 1 <= n_ord <= len(items):
+                raise ValueError(
+                    f"SQL: GROUP BY ordinal {n_ord!r} must be an integer in "
+                    f"1..{len(items)}"
+                )
+            it = items[n_ord - 1]
+            if it.agg is not None or (it.expr is not None and _expr_has_agg(it.expr)):
+                raise ValueError(
+                    f"SQL: GROUP BY ordinal {n_ord} refers to an aggregate"
+                )
+            if it.col == "*":
+                raise ValueError("SQL: GROUP BY ordinal cannot refer to *")
+            resolved_group.append(it.col if it.expr is None else it.expr)
+        q = _Query(
+            items, q.distinct, q.table, q.joins, q.where, resolved_group,
+            q.having, q.order, q.limit,
+        )
+        # GROUP BY items: plain names (strings) and/or expression ASTs
+        # (GROUP BY CASE … END — Spark groups by arbitrary expressions;
+        # a select item structurally equal to a key expression reads the
+        # key's per-group value, Spark's syntactic-match rule)
+        name_keys = [g for g in q.group if isinstance(g, str)]
+        expr_key_list: list[tuple] = [
+            g for g in q.group if not isinstance(g, str)
+        ]
+        group_cols = {g: _resolve_name(t, g, aliases) for g in name_keys}
+
+        def _group_expr_index(e) -> int | None:
+            for i, ast in enumerate(expr_key_list):
+                if ast == e:
+                    return i
+            return None
+
         for it in items:
             if it.col == "*":
                 raise ValueError("SQL: SELECT * cannot mix with GROUP BY")
             if it.expr is not None:
+                if _group_expr_index(it.expr) is not None:
+                    continue  # this select item IS a group-key expression
                 for c in _expr_cols(it.expr):
                     if not (
-                        c in q.group
+                        c in name_keys
                         or _resolve_name(t, c, aliases) in group_cols.values()
                     ):
                         raise ValueError(
@@ -1076,14 +1132,20 @@ def execute(query: str, resolve_table) -> Table:
                         )
                 continue
             if it.agg is None and not (
-                it.col in q.group
+                it.col in name_keys
                 or _resolve_name(t, it.col, aliases) in group_cols.values()
             ):
                 raise ValueError(
                     f"SQL: column {it.col!r} must appear in GROUP BY or an "
                     "aggregate"
                 )
-        keys = [t.column(c) for c in group_cols.values()]
+        expr_key_arrays = []
+        for g in expr_key_list:
+            arr = _eval_expr(getcol, g)
+            expr_key_arrays.append(
+                np.full(len(t), arr) if np.ndim(arr) == 0 else np.asarray(arr)
+            )
+        keys = [t.column(c) for c in group_cols.values()] + expr_key_arrays
         # lexicographic group ids via np.unique over a structured view of
         # per-column integer codes — codes (not raw values) so every null
         # (NaN/NaT) lands in ONE group, Spark's GROUP BY rule
@@ -1122,6 +1184,10 @@ def execute(query: str, resolve_table) -> Table:
         cols: dict[str, Any] = {}
         for it in items:
             if it.expr is not None:
+                gi = _group_expr_index(it.expr)
+                if gi is not None:
+                    cols[it.alias] = expr_key_arrays[gi][first_row]
+                    continue
                 low, extra = _lower_aggex(it.expr, grouped_aggex)
                 v = _eval_expr(
                     lambda n: extra[n] if n in extra else per_group_atom(n),

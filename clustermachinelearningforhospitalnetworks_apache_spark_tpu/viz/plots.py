@@ -19,6 +19,15 @@ import numpy as np
 from matplotlib.figure import Figure
 
 
+def _save(fig: "Figure", out_dir: str, filename: str) -> str:
+    """One copy of the output convention (makedirs + 120-dpi PNG)."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, filename)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    return path
+
+
 def plot_predicted_vs_actual(
     actual: np.ndarray,
     predicted: np.ndarray,
@@ -26,8 +35,6 @@ def plot_predicted_vs_actual(
     label: str = "length_of_stay",
     filename: str = "predicted_vs_actual.png",
 ) -> str:
-    os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, filename)
     fig = Figure(figsize=(8, 6))
     ax = fig.add_subplot(111)
     ax.scatter(actual, predicted, alpha=0.5, s=12)
@@ -37,9 +44,7 @@ def plot_predicted_vs_actual(
     ax.set_xlabel(f"actual {label}")
     ax.set_ylabel(f"predicted {label}")
     ax.set_title("Predicted vs Actual")
-    fig.tight_layout()
-    fig.savefig(path, dpi=120)
-    return path
+    return _save(fig, out_dir, filename)
 
 
 def plot_residuals(
@@ -48,8 +53,6 @@ def plot_residuals(
     out_dir: str,
     filename: str = "residuals.png",
 ) -> str:
-    os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, filename)
     residuals = np.asarray(actual) - np.asarray(predicted)
     fig = Figure(figsize=(8, 6))
     ax = fig.add_subplot(111)
@@ -58,17 +61,13 @@ def plot_residuals(
     ax.set_xlabel("predicted")
     ax.set_ylabel("residual (actual − predicted)")
     ax.set_title("Residuals")
-    fig.tight_layout()
-    fig.savefig(path, dpi=120)
-    return path
+    return _save(fig, out_dir, filename)
 
 
 def plot_roc(summary, out_dir: str, filename: str = "roc.png") -> str:
     """ROC curve from a ``BinaryLogisticRegressionTrainingSummary`` (its
     ``roc`` points come from one tie-exact device pass) — the
     classification counterpart of the reference's regression plots."""
-    os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, filename)
     curve = summary.roc
     fig = Figure(figsize=(6, 5))
     ax = fig.add_subplot(111)
@@ -77,15 +76,11 @@ def plot_roc(summary, out_dir: str, filename: str = "roc.png") -> str:
     ax.set_xlabel("false positive rate")
     ax.set_ylabel("true positive rate")
     ax.set_title(f"ROC (AUC = {summary.area_under_roc:.4f})")
-    fig.tight_layout()
-    fig.savefig(path, dpi=120)
-    return path
+    return _save(fig, out_dir, filename)
 
 
 def plot_pr(summary, out_dir: str, filename: str = "pr.png") -> str:
     """Precision-recall curve from the binary training summary."""
-    os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, filename)
     curve = summary.pr
     fig = Figure(figsize=(6, 5))
     ax = fig.add_subplot(111)
@@ -94,6 +89,4 @@ def plot_pr(summary, out_dir: str, filename: str = "pr.png") -> str:
     ax.set_ylabel("precision")
     ax.set_title(f"PR (AUC = {summary.area_under_pr:.4f})")
     ax.set_ylim(0.0, 1.05)
-    fig.tight_layout()
-    fig.savefig(path, dpi=120)
-    return path
+    return _save(fig, out_dir, filename)

@@ -799,3 +799,91 @@ class TestScalarFunctions:
             session.sql("SELECT v FROM tf WHERE length(s) > 1")
         with pytest.raises(ValueError, match="only supported in the select"):
             session.sql("SELECT v FROM tf ORDER BY abs(v)")
+
+
+# -------------------------------------------------- GROUP BY expressions
+class TestGroupByExpression:
+    def _t(self):
+        return ht.Table.from_dict(
+            {
+                "los": np.array([2.0, 6.5, 4.0, 9.0, 12.0, np.nan]),
+                "w": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+            }
+        )
+
+    def test_group_by_case_bucketing(self, session):
+        session.register_table("g1", self._t())
+        r = session.sql(
+            "SELECT CASE WHEN los > 8 THEN 'high' WHEN los > 5 THEN 'mid' "
+            "ELSE 'low' END AS tier, count(*) AS n, avg(w) AS mw FROM g1 "
+            "GROUP BY CASE WHEN los > 8 THEN 'high' WHEN los > 5 THEN 'mid' "
+            "ELSE 'low' END ORDER BY tier"
+        )
+        assert list(r.column("tier")) == ["high", "low", "mid"]
+        np.testing.assert_array_equal(r.column("n"), [2, 3, 1])
+        np.testing.assert_allclose(r.column("mw"), [4.5, 10.0 / 3, 2.0])
+
+    def test_group_by_function_key(self, session):
+        session.register_table("g1", self._t())
+        r = session.sql(
+            "SELECT round(los) AS rl, count(*) AS n FROM g1 "
+            "GROUP BY round(los) ORDER BY rl"
+        )
+        # 6.5 rounds HALF_UP to 7; the null lands in its own group first
+        got = r.column("rl")
+        assert np.isnan(got[0]) and list(got[1:]) == [2.0, 4.0, 7.0, 9.0, 12.0]
+
+    def test_group_expr_mixed_with_name_key(self, session):
+        t = ht.Table.from_dict(
+            {
+                "h": np.array(["a", "a", "b", "b"], dtype=object),
+                "v": np.array([1.0, 7.0, 2.0, 8.0]),
+            }
+        )
+        session.register_table("g2", t)
+        r = session.sql(
+            "SELECT h, CASE WHEN v > 5 THEN 1 ELSE 0 END AS big, count(*) AS n "
+            "FROM g2 GROUP BY h, CASE WHEN v > 5 THEN 1 ELSE 0 END "
+            "ORDER BY h"
+        )
+        assert len(r.column("n")) == 4 and set(r.column("n")) == {1}
+
+    def test_group_expr_having(self, session):
+        session.register_table("g1", self._t())
+        r = session.sql(
+            "SELECT CASE WHEN los > 8 THEN 1 ELSE 0 END AS big, count(*) AS n "
+            "FROM g1 GROUP BY CASE WHEN los > 8 THEN 1 ELSE 0 END "
+            "HAVING count(*) > 2"
+        )
+        # big=1 is [9, 12] (2 rows, filtered); big=0 keeps its 4 rows
+        np.testing.assert_array_equal(r.column("big"), [0])
+        np.testing.assert_array_equal(r.column("n"), [4])
+
+    def test_group_by_agg_rejected(self, session):
+        session.register_table("g1", self._t())
+        with pytest.raises(ValueError, match="aggregates are not allowed"):
+            session.sql("SELECT count(*) AS n FROM g1 GROUP BY avg(los) + 1")
+
+    def test_nonkey_expression_still_rejected(self, session):
+        session.register_table("g1", self._t())
+        with pytest.raises(ValueError, match="must appear in GROUP BY"):
+            session.sql(
+                "SELECT los + 1 AS x, count(*) AS n FROM g1 "
+                "GROUP BY CASE WHEN los > 5 THEN 1 ELSE 0 END"
+            )
+
+    def test_group_by_ordinal(self, session):
+        """Spark groupByOrdinal: GROUP BY 1 = the first select item."""
+        session.register_table("g1", self._t())
+        r = session.sql(
+            "SELECT CASE WHEN los > 8 THEN 1 ELSE 0 END AS big, count(*) AS n "
+            "FROM g1 GROUP BY 1 ORDER BY big"
+        )
+        np.testing.assert_array_equal(r.column("big"), [0, 1])
+        np.testing.assert_array_equal(r.column("n"), [4, 2])
+        with pytest.raises(ValueError, match="ordinal 3"):
+            session.sql("SELECT los, count(*) AS n FROM g1 GROUP BY 3")
+        with pytest.raises(ValueError, match="refers to an aggregate"):
+            session.sql("SELECT count(*) AS n FROM g1 GROUP BY 1")
+        with pytest.raises(ValueError, match="ordinal 1.5"):
+            session.sql("SELECT los, count(*) AS n FROM g1 GROUP BY 1.5")
