@@ -1082,9 +1082,15 @@ def execute(query: str, resolve_table) -> Table:
                 resolved_group.append(g)
                 continue
             n_ord = g[1]
-            if not isinstance(n_ord, int) or not 1 <= n_ord <= len(items):
+            if not isinstance(n_ord, int):
+                # a non-integer literal key was never an ordinal — Spark
+                # groups by the constant (one group); match it rather
+                # than mislabel the literal in an ordinal error
+                resolved_group.append(g)
+                continue
+            if not 1 <= n_ord <= len(items):
                 raise ValueError(
-                    f"SQL: GROUP BY ordinal {n_ord!r} must be an integer in "
+                    f"SQL: GROUP BY ordinal {n_ord} must be in "
                     f"1..{len(items)}"
                 )
             it = items[n_ord - 1]

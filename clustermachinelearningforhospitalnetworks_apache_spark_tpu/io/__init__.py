@@ -1,4 +1,5 @@
 from .csv import read_csv, read_csv_dir, write_csv
+from .libsvm import read_libsvm, write_libsvm
 from .fit_checkpoint import FitCheckpointer
 from .model_io import load_model, register_model, save_model
 from .native import native_available
@@ -8,6 +9,8 @@ __all__ = [
     "read_csv",
     "read_csv_dir",
     "write_csv",
+    "read_libsvm",
+    "write_libsvm",
     "load_model",
     "register_model",
     "save_model",

@@ -142,3 +142,40 @@ def test_device_fence_slots_and_warning(mesh8):
 
     with pytest.warns(RuntimeWarning, match="nothing was fenced"):
         device_fence(object())
+
+
+# ---------------------------------------------------------- libsvm io
+def test_libsvm_roundtrip_and_validation(tmp_path, mesh8):
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(40, 6)).astype(np.float32)
+    x[rng.random(x.shape) < 0.5] = 0.0  # sparsity for the omit-zeros path
+    y = rng.integers(0, 2, 40).astype(np.float32)
+    p = str(tmp_path / "data.libsvm")
+    ht.write_libsvm(p, x, y)
+    x2, y2 = ht.read_libsvm(p, n_features=6)
+    np.testing.assert_allclose(x2, x, atol=1e-6)
+    np.testing.assert_array_equal(y2, y)
+    # the tuple feeds straight into a fit
+    m = ht.LogisticRegression(max_iter=5).fit((x2, y2), mesh=mesh8)
+    assert np.isfinite(np.asarray(m.coefficients)).all()
+
+    # width comes from the max index when unspecified (trailing zero
+    # features are unrecoverable without n_features — document by test)
+    x3, _ = ht.read_libsvm(p)
+    assert x3.shape[1] <= 6
+
+    bad = tmp_path / "bad.libsvm"
+    bad.write_text("1.0 3:1.0 2:2.0\n")
+    with pytest.raises(ValueError, match="ascending"):
+        ht.read_libsvm(str(bad))
+    bad.write_text("1.0 0:1.0\n")
+    with pytest.raises(ValueError, match="below the 1-based"):
+        ht.read_libsvm(str(bad))
+    ok0 = tmp_path / "zero.libsvm"
+    ok0.write_text("2.0 0:5.0 3:1.0  # comment\n\n1.0 1:2.0\n")
+    xz, yz = ht.read_libsvm(str(ok0), zero_based=True)
+    assert xz.shape == (2, 4) and xz[0, 0] == 5.0 and yz.tolist() == [2.0, 1.0]
+    with pytest.raises(ValueError, match="exceeds n_features"):
+        ht.read_libsvm(str(ok0), n_features=2, zero_based=True)
