@@ -32,6 +32,10 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
       [HAVING <pred over aggregates>]
       [ORDER BY col [ASC|DESC]]
       [LIMIT n]
+      [UNION [ALL] <select> …]           positional column alignment,
+                                         left-associative dedup folds;
+                                         a trailing ORDER BY/LIMIT
+                                         applies to the whole union
 
 Columns may be qualified (``a.col``); unqualified names resolve when
 unambiguous across the joined sides (ambiguity raises, like Spark).
@@ -70,6 +74,7 @@ _KEYWORDS = {
     "distinct", "join", "inner", "left", "on", "having",
     "case", "when", "then", "else", "end",
     "not", "is", "null", "in",
+    "union", "all",
 } | _AGGS
 
 
@@ -413,6 +418,18 @@ class _Query:
     limit: int | None
 
 
+@dataclass
+class _Union:
+    """UNION [ALL] chain: left-associative set folds (Spark semantics —
+    each non-ALL step dedups the accumulated rows), then one trailing
+    ORDER BY/LIMIT over the combined result."""
+
+    queries: list          # [_Query, ...] (order/limit stripped)
+    alls: list             # [bool] per UNION step (len = len(queries)-1)
+    order: tuple | None
+    limit: int | None
+
+
 class _Parser:
     def __init__(self, query: str):
         self.toks = _tokenize(query)
@@ -441,6 +458,38 @@ class _Parser:
 
     # ---- grammar ----
     def parse(self):
+        """Top level: one select, or a UNION [ALL] chain.  Spark binds a
+        trailing ORDER BY/LIMIT to the WHOLE union, which falls out of
+        greedy per-select parsing: the last branch's order/limit become
+        the union's; earlier branches must not carry any."""
+        first = self._select_query()
+        branches: list[tuple[bool, _Query]] = []
+        while self._accept("kw", "union"):
+            all_ = bool(self._accept("kw", "all"))
+            branches.append((all_, self._select_query()))
+        if self._peek()[0] != "eof":
+            raise ValueError(
+                f"SQL: unexpected trailing input {self._peek()[1]!r}"
+            )
+        if not branches:
+            return first
+        queries = [first] + [q for _, q in branches]
+        for q in queries[:-1]:
+            if q.order is not None or q.limit is not None:
+                raise ValueError(
+                    "SQL: ORDER BY/LIMIT inside a UNION branch is not "
+                    "supported — a trailing ORDER BY/LIMIT applies to the "
+                    "whole union"
+                )
+        last = queries[-1]
+        order, limit = last.order, last.limit
+        queries[-1] = _Query(
+            last.items, last.distinct, last.table, last.joins, last.where,
+            last.group, last.having, None, None,
+        )
+        return _Union(queries, [a for a, _ in branches], order, limit)
+
+    def _select_query(self):
         self._expect("kw", "select")
         distinct = self._accept("kw", "distinct")
         items = self._select_list()
@@ -489,8 +538,6 @@ class _Parser:
         limit = None
         if self._accept("kw", "limit"):
             limit = int(self._expect("num")[1])
-        if self._peek()[0] != "eof":
-            raise ValueError(f"SQL: unexpected trailing input {self._peek()[1]!r}")
         return _Query(
             items, distinct, table, joins, where, group, having, order, limit
         )
@@ -1011,6 +1058,93 @@ def _grouped_aggregate(src: np.ndarray, agg: str, starts, order_idx):
 def execute(query: str, resolve_table) -> Table:
     """Run a query; ``resolve_table(name) -> Table`` supplies FROM/JOIN."""
     q = _Parser(query).parse()
+    if isinstance(q, _Union):
+        return _execute_union(q, resolve_table)
+    return _execute_query(q, resolve_table)
+
+
+def _union_kind(col: np.ndarray) -> str:
+    """Type-compat class for UNION columns: string-like, datetime,
+    timedelta, numeric — np.concatenate across classes would either
+    silently stringify or raise an obscure DTypePromotionError."""
+    k = col.dtype.kind
+    if k in "USO":
+        return "string"
+    if k == "M":
+        return "timestamp"
+    if k == "m":
+        return "interval"
+    return "numeric"
+
+
+def _null_aware_sort_idx(vals: np.ndarray, desc: bool) -> np.ndarray:
+    """Stable ASC argsort with Spark's null placement (nulls FIRST on
+    ASC; DESC falls out of reversing) — the one copy shared by the
+    single-select ORDER BY and the union tail."""
+    nm = _null_mask(vals)
+    if nm.any():
+        nonnull = np.flatnonzero(~nm)
+        idx = np.concatenate(
+            [
+                np.flatnonzero(nm),
+                nonnull[np.argsort(vals[nonnull], kind="stable")],
+            ]
+        )
+    else:
+        idx = np.argsort(vals, kind="stable")
+    return idx[::-1] if desc else idx
+
+
+def _execute_union(u: "_Union", resolve_table) -> Table:
+    parts = [_execute_query(sub, resolve_table) for sub in u.queries]
+    width = len(parts[0].columns)
+    for p in parts[1:]:
+        if len(p.columns) != width:
+            raise ValueError(
+                f"SQL: UNION branches have {width} and {len(p.columns)} "
+                "columns — they must match"
+            )
+    names = list(parts[0].columns)
+    out: dict[str, np.ndarray] = {}
+    for j, name in enumerate(names):
+        segs = [p.column(list(p.columns)[j]) for p in parts]  # positional
+        kinds = {_union_kind(s) for s in segs}
+        if len(kinds) > 1:
+            raise ValueError(
+                f"SQL: UNION column {name!r} mixes "
+                f"{' and '.join(sorted(kinds))} branches"
+            )
+        out[name] = np.concatenate(segs)
+    t = Table.from_dict(out)
+    if not all(u.alls):
+        # left-associative set folds: each non-ALL step dedups everything
+        # accumulated so far (an ALL-only chain is just the concat above)
+        sizes = [len(p) for p in parts]
+        acc = t.mask(np.arange(sizes[0]))
+        offset = sizes[0]
+        for all_, size in zip(u.alls, sizes[1:]):
+            nxt = t.mask(np.arange(offset, offset + size))
+            acc = Table.concat([acc, nxt])
+            if not all_:
+                acc = _distinct_rows(acc)
+            offset += size
+        t = acc
+    if u.order is not None and len(t) > 0:
+        col, desc = u.order
+        try:
+            col = _resolve_name(t, col, set())
+        except ValueError:
+            raise ValueError(
+                f"SQL: ORDER BY column {u.order[0]!r} is not in the union "
+                "result"
+            ) from None
+        t = t.mask(_null_aware_sort_idx(t.column(col), desc))
+    if u.limit is not None:
+        t = t.mask(np.arange(min(u.limit, len(t))))
+    return t
+
+
+def _execute_query(q: "_Query", resolve_table) -> Table:
     items = q.items
     if items is not None:
         # duplicate output names would silently shadow each other in the
@@ -1392,23 +1526,9 @@ def execute(query: str, resolve_table) -> Table:
                     f"{'grouped result' if q.group else 'table'}"
                 ) from None
             vals = t.column(col)
-        nm = _null_mask(vals)
-        if nm.any():
-            # null-aware sort (object None would crash np.argsort):
-            # ASC → NULLS FIRST, DESC → NULLS LAST (Spark defaults; the
-            # DESC case falls out of reversing the ASC order below)
-            nonnull = np.flatnonzero(~nm)
-            idx = np.concatenate(
-                [
-                    np.flatnonzero(nm),
-                    nonnull[np.argsort(vals[nonnull], kind="stable")],
-                ]
-            )
-        else:
-            idx = np.argsort(vals, kind="stable")
-        if desc:
-            idx = idx[::-1]
-        t = t.mask(idx)  # integer fancy-indexing permutes every column
+        # _null_aware_sort_idx: ASC → NULLS FIRST, DESC → NULLS LAST
+        # (Spark defaults; DESC falls out of reversing the ASC order)
+        t = t.mask(_null_aware_sort_idx(vals, desc))  # permutes every column
     if items is not None:
         # plain projection, applied after ORDER BY so sorting may use any
         # source column; star-plus expands here, expressions evaluate

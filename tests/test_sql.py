@@ -889,3 +889,72 @@ class TestGroupByExpression:
         # Spark groups every row under it (one group)
         r2 = session.sql("SELECT count(*) AS n FROM g1 GROUP BY 1.5")
         np.testing.assert_array_equal(r2.column("n"), [6])
+
+
+# ------------------------------------------------------------ UNION [ALL]
+class TestUnion:
+    @pytest.fixture
+    def two_tables(self, session):
+        a = ht.Table.from_dict(
+            {"h": np.array(["x", "y"], object), "v": np.array([1.0, 2.0])}
+        )
+        b = ht.Table.from_dict(
+            {"hosp": np.array(["y", "z"], object), "val": np.array([2.0, 3.0])}
+        )
+        session.register_table("ua", a)
+        session.register_table("ub", b)
+        return session
+
+    def test_union_all_positional_alignment(self, two_tables):
+        r = two_tables.sql("SELECT h, v FROM ua UNION ALL SELECT hosp, val FROM ub")
+        # names come from the FIRST branch; rows concatenate positionally
+        assert list(r.column("h")) == ["x", "y", "y", "z"]
+        np.testing.assert_allclose(r.column("v"), [1, 2, 2, 3])
+
+    def test_union_dedups_and_orders_whole_result(self, two_tables):
+        r = two_tables.sql(
+            "SELECT h, v FROM ua UNION SELECT hosp, val FROM ub ORDER BY v DESC"
+        )
+        assert list(r.column("h")) == ["z", "y", "x"]  # (y,2) dedup'd
+
+    def test_union_mixed_all_left_assoc(self, two_tables):
+        # (ua UNION ua) dedups to 2 rows, then UNION ALL appends ub's 2
+        r = two_tables.sql(
+            "SELECT h, v FROM ua UNION SELECT h, v FROM ua "
+            "UNION ALL SELECT hosp, val FROM ub"
+        )
+        assert len(r) == 4
+
+    def test_union_guards(self, two_tables):
+        with pytest.raises(ValueError, match="must match"):
+            two_tables.sql("SELECT h FROM ua UNION SELECT hosp, val FROM ub")
+        with pytest.raises(ValueError, match="mixes numeric and string"):
+            two_tables.sql("SELECT h FROM ua UNION ALL SELECT val FROM ub")
+        with pytest.raises(ValueError, match="inside a UNION branch"):
+            two_tables.sql("SELECT h FROM ua LIMIT 1 UNION SELECT hosp FROM ub")
+
+    def test_union_with_aggregates_and_limit(self, two_tables):
+        r = two_tables.sql(
+            "SELECT count(*) AS n FROM ua UNION ALL SELECT count(*) AS n "
+            "FROM ub LIMIT 2"
+        )
+        np.testing.assert_array_equal(r.column("n"), [2, 2])
+
+    def test_union_datetime_guard_and_join_order_resolution(self, two_tables):
+        t = ht.Table.from_dict(
+            {"ts": np.array(["2025-01-01T00:00:00"], dtype="datetime64[s]")}
+        )
+        two_tables.register_table("ut", t)
+        with pytest.raises(ValueError, match="mixes numeric and timestamp"):
+            two_tables.sql("SELECT v FROM ua UNION ALL SELECT ts FROM ut")
+        # ORDER BY resolves unqualified names over qualified union output
+        meta = ht.Table.from_dict(
+            {"h": np.array(["x", "y", "z"], object), "beds": np.array([5.0, 7.0, 9.0])}
+        )
+        two_tables.register_table("um", meta)
+        r = two_tables.sql(
+            "SELECT ua.v, um.beds FROM ua JOIN um ON ua.h = um.h "
+            "UNION ALL SELECT ua.v, um.beds FROM ua JOIN um ON ua.h = um.h "
+            "ORDER BY beds DESC"
+        )
+        assert len(r) == 4 and r.column(list(r.columns)[1])[0] == 7.0
