@@ -15,7 +15,9 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                        [ELSE <expr>] END | scalar functions ABS ROUND
                        (HALF_UP, Spark) UPPER LOWER LENGTH COALESCE
                        [AS alias]]
-      FROM t [[AS] a]
+      FROM t [[AS] a] | ( <select …> ) a   (derived tables, also on the
+                                            JOIN right side; inner
+                                            ORDER BY/LIMIT = top-N)
       [[INNER|LEFT] JOIN t2 [[AS] b] ON a.key = b.key]   (single-key
                                          equi-join, vectorized hash join)
       [WHERE <pred> {AND|OR} ...]        predicates: = != <> < <= > >=,
@@ -462,15 +464,22 @@ class _Parser:
         trailing ORDER BY/LIMIT to the WHOLE union, which falls out of
         greedy per-select parsing: the last branch's order/limit become
         the union's; earlier branches must not carry any."""
-        first = self._select_query()
-        branches: list[tuple[bool, _Query]] = []
-        while self._accept("kw", "union"):
-            all_ = bool(self._accept("kw", "all"))
-            branches.append((all_, self._select_query()))
+        node = self._union_chain()
         if self._peek()[0] != "eof":
             raise ValueError(
                 f"SQL: unexpected trailing input {self._peek()[1]!r}"
             )
+        return node
+
+    def _union_chain(self):
+        """One select, or select UNION [ALL] select … → _Query | _Union."""
+        first = self._select_query()
+        branches: list[tuple[bool, _Query]] = []
+        while self._accept("kw", "union"):
+            all_ = bool(self._accept("kw", "all"))
+            if not all_:
+                self._accept("kw", "distinct")  # UNION DISTINCT = UNION
+            branches.append((all_, self._select_query()))
         if not branches:
             return first
         queries = [first] + [q for _, q in branches]
@@ -543,7 +552,21 @@ class _Parser:
         )
 
     def _table_ref(self):
-        """name [[AS] alias] → (table_name, alias)."""
+        """name [[AS] alias] → (table_name, alias); or a derived table
+        ``( <select [UNION …]> ) alias`` → (query AST, alias) — the
+        executor runs the sub-select and treats its result as the
+        table (Spark's FROM-subquery)."""
+        if self._accept("op", "("):
+            node = self._union_chain()
+            self._expect("op", ")")
+            alias = None
+            if self._accept("kw", "as"):
+                alias = self._expect("name")[1]
+            elif self._peek()[0] == "name":
+                alias = self._next()[1]
+            if alias is None:
+                raise ValueError("SQL: a FROM subquery needs an alias")
+            return node, alias
         name = self._expect("name")[1]
         alias = name
         if self._accept("kw", "as"):
@@ -1095,6 +1118,33 @@ def _null_aware_sort_idx(vals: np.ndarray, desc: bool) -> np.ndarray:
     return idx[::-1] if desc else idx
 
 
+def _resolve_source(ref, resolve_table) -> Table:
+    """A FROM/JOIN source: a table name (string) resolved by the caller,
+    or a derived-table query node executed recursively.  A derived
+    table's inner join qualifiers are STRIPPED at the boundary (Spark's
+    scoping: inner aliases are invisible outside; the outer query sees
+    base names it may re-qualify with ITS alias)."""
+    if isinstance(ref, str):
+        return resolve_table(ref)
+    t = (
+        _execute_union(ref, resolve_table)
+        if isinstance(ref, _Union)
+        else _execute_query(ref, resolve_table)
+    )
+    renames = {c: c.split(".")[-1] for c in t.columns}
+    if len(set(renames.values())) != len(renames):
+        dup = [b for b in set(renames.values())
+               if sum(1 for v in renames.values() if v == b) > 1][0]
+        raise ValueError(
+            f"SQL: derived table exposes duplicate column {dup!r} after "
+            "dropping inner qualifiers — alias one side in the subquery's "
+            "select list"
+        )
+    if any(k != v for k, v in renames.items()):
+        t = Table.from_dict({renames[c]: t.column(c) for c in t.columns})
+    return t
+
+
 def _execute_union(u: "_Union", resolve_table) -> Table:
     parts = [_execute_query(sub, resolve_table) for sub in u.queries]
     width = len(parts[0].columns)
@@ -1129,11 +1179,15 @@ def _execute_union(u: "_Union", resolve_table) -> Table:
                 acc = _distinct_rows(acc)
             offset += size
         t = acc
-    if u.order is not None and len(t) > 0:
+    if u.order is not None:
+        # validate BEFORE any emptiness shortcut — an unknown ORDER BY
+        # column must raise even on a zero-row result (Spark analysis)
         col, desc = u.order
         try:
             col = _resolve_name(t, col, set())
-        except ValueError:
+        except ValueError as e:
+            if "ambiguous" in str(e):
+                raise  # keep the qualify-it diagnostic
             raise ValueError(
                 f"SQL: ORDER BY column {u.order[0]!r} is not in the union "
                 "result"
@@ -1158,7 +1212,7 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
                 )
             seen.add(it.alias)
     base_name, base_alias = q.table
-    t: Table = resolve_table(base_name)
+    t: Table = _resolve_source(base_name, resolve_table)
     aliases = {base_alias}
 
     if q.joins:
@@ -1167,7 +1221,7 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
         for kind, (r_name, r_alias), lk_name, rk_name in q.joins:
             if r_alias in aliases:
                 raise ValueError(f"SQL: duplicate table alias {r_alias!r}")
-            rt = resolve_table(r_name)
+            rt = _resolve_source(r_name, resolve_table)
 
             def right_col(name: str):
                 """Resolve a key reference against the NEW right table."""
@@ -1191,9 +1245,10 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
             if lk is None or rk is None:
                 lk, rk = left_col(rk_name), right_col(lk_name)
             if lk is None or rk is None:
+                shown = r_name if isinstance(r_name, str) else f"(subquery) {r_alias}"
                 raise ValueError(
                     f"SQL: JOIN ON must compare a joined column with a "
-                    f"column of {r_name!r}; got {lk_name!r} = {rk_name!r}"
+                    f"column of {shown!r}; got {lk_name!r} = {rk_name!r}"
                 )
             t = _equi_join(t, rt, lk, np.asarray(rk), kind, r_alias)
             aliases.add(r_alias)
