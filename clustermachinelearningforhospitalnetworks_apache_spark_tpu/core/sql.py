@@ -22,7 +22,10 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                                          equi-join, vectorized hash join)
       [WHERE <pred> {AND|OR} ...]        predicates: = != <> < <= > >=,
                                          BETWEEN 'a' AND 'b', IS [NOT]
-                                         NULL, [NOT] IN (v, …), NOT,
+                                         NULL, [NOT] IN (v, …), [NOT]
+                                         IN (SELECT …) (uncorrelated
+                                         semi/anti-join, Spark's
+                                         null-set 3VL), NOT,
                                          parentheses — evaluated under
                                          SQL three-valued logic (UNKNOWN
                                          propagates through AND/OR/NOT
@@ -770,6 +773,10 @@ class _Parser:
         negate = bool(self._accept("kw", "not"))
         if self._accept("kw", "in"):
             self._expect("op", "(")
+            if self._peek() == ("kw", "select"):
+                sub = self._union_chain()
+                self._expect("op", ")")
+                return ("notinsub" if negate else "insub", col, sub)
             vals = [self._literal()]
             while self._accept("op", ","):
                 vals.append(self._literal())
@@ -846,6 +853,49 @@ def _eval_cond3(getcol, cond) -> tuple[np.ndarray, np.ndarray]:
             hit |= cv == _coerce(col, v)
         out[~null] = ~hit if kind == "notin" else hit
         return out, null
+    if kind in ("in3", "notin3"):
+        # materialized IN (SELECT …) set, Spark 3VL with subquery nulls:
+        # x IN (…, NULL) is TRUE on a match, else UNKNOWN; x NOT IN
+        # (…, NULL) is FALSE on a match, else UNKNOWN (never TRUE)
+        _, name, values, has_null = cond
+        col = getcol(name)
+        null = _null_mask(col)
+        # coerce set values to the operand's comparison space (the same
+        # _coerce the literal-IN path applies — a numeric column vs a
+        # string-typed subquery must cast, not silently miss); a value
+        # Spark's cast would null out joins the null-set instead
+        coerced = []
+        for v in list(values):
+            v = v.item() if isinstance(v, np.generic) else v
+            try:
+                coerced.append(_coerce(col, v))
+            except (ValueError, TypeError):
+                has_null = True
+        values = np.asarray(coerced)
+        if len(values) == 0 and not has_null:
+            # empty build side: IN is FALSE and NOT IN is TRUE for EVERY
+            # row — null operands included (Spark's semi/anti-join rule)
+            n = len(col)
+            zero = np.zeros(n, bool)
+            return (zero, zero) if kind == "in3" else (np.ones(n, bool), zero)
+        hit = np.zeros(len(col), bool)
+        cv = col[~null]
+        h = np.isin(cv, values) if len(values) else np.zeros(len(cv), bool)
+        hit[~null] = h
+        if kind == "in3":
+            true = hit
+            unknown = null | (~hit & ~null & has_null)
+        else:
+            true = (
+                np.zeros(len(col), bool) if has_null else (~null & ~hit)
+            )
+            unknown = null | (has_null & ~hit & ~null)
+        return true, unknown
+    if kind in ("insub", "notinsub"):
+        raise ValueError(
+            "SQL: IN (SELECT …) must be lowered before evaluation — "
+            "it is only supported in WHERE/HAVING"
+        )
     if kind == "between":
         _, name, lo, hi = cond
         col = getcol(name)
@@ -1118,6 +1168,42 @@ def _null_aware_sort_idx(vals: np.ndarray, desc: bool) -> np.ndarray:
     return idx[::-1] if desc else idx
 
 
+def _lower_insub(cond, resolve_table):
+    """Materialize ``IN (SELECT …)`` predicates: run each subquery once
+    (it must project exactly one column), dedupe its values, and rewrite
+    the node to the 3VL set form so :func:`_eval_cond3` needs no table
+    resolver."""
+    if cond is None:
+        return None
+    kind = cond[0]
+    if kind in ("and", "or"):
+        return (
+            kind,
+            _lower_insub(cond[1], resolve_table),
+            _lower_insub(cond[2], resolve_table),
+        )
+    if kind == "not":
+        return ("not", _lower_insub(cond[1], resolve_table))
+    if kind in ("insub", "notinsub"):
+        sub = _resolve_source(cond[2], resolve_table)
+        cols = list(sub.columns)
+        if len(cols) != 1:
+            raise ValueError(
+                f"SQL: IN (SELECT …) subquery must project exactly one "
+                f"column, got {len(cols)}"
+            )
+        vals = sub.column(cols[0])
+        null = _null_mask(vals)
+        uniq = np.unique(vals[~null])
+        return (
+            "in3" if kind == "insub" else "notin3",
+            cond[1],
+            uniq,
+            bool(null.any()),
+        )
+    return cond
+
+
 def _resolve_source(ref, resolve_table) -> Table:
     """A FROM/JOIN source: a table name (string) resolved by the caller,
     or a derived-table query node executed recursively.  A derived
@@ -1199,6 +1285,13 @@ def _execute_union(u: "_Union", resolve_table) -> Table:
 
 
 def _execute_query(q: "_Query", resolve_table) -> Table:
+    if q.where is not None or q.having is not None:
+        # uncorrelated IN (SELECT …) predicates materialize up front
+        q = _Query(
+            q.items, q.distinct, q.table, q.joins,
+            _lower_insub(q.where, resolve_table), q.group,
+            _lower_insub(q.having, resolve_table), q.order, q.limit,
+        )
     items = q.items
     if items is not None:
         # duplicate output names would silently shadow each other in the

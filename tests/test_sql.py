@@ -1051,3 +1051,79 @@ class TestDerivedTables:
                 "SELECT h FROM adm WHERE los > 99 UNION ALL "
                 "SELECT h FROM adm WHERE los > 99 ORDER BY nope"
             )
+
+
+# ------------------------------------------------- IN (SELECT …) subqueries
+class TestInSubquery:
+    @pytest.fixture
+    def tbls(self, session):
+        session.register_table(
+            "adm3",
+            ht.Table.from_dict(
+                {
+                    "h": np.array(["a", "a", "b", "c", "d"], object),
+                    "los": np.array([2.0, 6.0, 4.0, 9.0, 1.0]),
+                }
+            ),
+        )
+        session.register_table(
+            "flagged", ht.Table.from_dict({"h": np.array(["a", "c"], object)})
+        )
+        session.register_table(
+            "wn", ht.Table.from_dict({"v": np.array([2.0, np.nan])})
+        )
+        return session
+
+    def test_semi_and_anti_join(self, tbls):
+        r = tbls.sql(
+            "SELECT h FROM adm3 WHERE h IN (SELECT h FROM flagged) ORDER BY h"
+        )
+        assert list(r.column("h")) == ["a", "a", "c"]
+        r2 = tbls.sql(
+            "SELECT h FROM adm3 WHERE h NOT IN (SELECT h FROM flagged) "
+            "ORDER BY h"
+        )
+        assert list(r2.column("h")) == ["b", "d"]
+
+    def test_null_in_subquery_3vl(self, tbls):
+        """Spark's NOT IN null trap: a null in the subquery set makes
+        NOT IN never-true (UNKNOWN for non-matches)."""
+        r = tbls.sql("SELECT los FROM adm3 WHERE los IN (SELECT v FROM wn)")
+        np.testing.assert_allclose(r.column("los"), [2.0])
+        r2 = tbls.sql(
+            "SELECT los FROM adm3 WHERE los NOT IN (SELECT v FROM wn)"
+        )
+        assert len(r2) == 0
+
+    def test_self_subquery_and_composition(self, tbls):
+        r = tbls.sql(
+            "SELECT h FROM adm3 WHERE los IN "
+            "(SELECT los FROM adm3 WHERE los > 5) OR h = 'd' ORDER BY h"
+        )
+        assert list(r.column("h")) == ["a", "c", "d"]
+
+    def test_multi_column_subquery_rejected(self, tbls):
+        with pytest.raises(ValueError, match="exactly one column"):
+            tbls.sql("SELECT h FROM adm3 WHERE h IN (SELECT h, los FROM adm3)")
+
+    def test_empty_and_cross_type_subqueries(self, tbls):
+        tbls.register_table(
+            "empty", ht.Table.from_dict({"v": np.array([], dtype=np.float64)})
+        )
+        # Spark's semi/anti-join over an empty build side: IN = FALSE,
+        # NOT IN = TRUE — null operands included
+        tbls.register_table(
+            "wnull", ht.Table.from_dict({"x": np.array([1.0, np.nan, 3.0])})
+        )
+        r = tbls.sql("SELECT x FROM wnull WHERE x NOT IN (SELECT v FROM empty)")
+        assert len(r) == 3
+        r2 = tbls.sql("SELECT x FROM wnull WHERE x IN (SELECT v FROM empty)")
+        assert len(r2) == 0
+        # numeric column vs string-typed subquery coerces like literal IN
+        tbls.register_table(
+            "codes", ht.Table.from_dict({"c": np.array(["1", "3"], object)})
+        )
+        r3 = tbls.sql(
+            "SELECT x FROM wnull WHERE x IN (SELECT c FROM codes) ORDER BY x"
+        )
+        np.testing.assert_allclose(r3.column("x"), [1.0, 3.0])
