@@ -37,10 +37,13 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
       [HAVING <pred over aggregates>]
       [ORDER BY col [ASC|DESC]]
       [LIMIT n]
-      [UNION [ALL] <select> …]           positional column alignment,
-                                         left-associative dedup folds;
-                                         a trailing ORDER BY/LIMIT
-                                         applies to the whole union
+      [UNION [ALL|DISTINCT] | INTERSECT [DISTINCT] | EXCEPT [DISTINCT]
+       <select> …]                       positional column alignment,
+                                         left-associative folds with
+                                         INTERSECT binding tighter
+                                         (standard precedence); a
+                                         trailing ORDER BY/LIMIT
+                                         applies to the whole chain
 
 Columns may be qualified (``a.col``); unqualified names resolve when
 unambiguous across the joined sides (ambiguity raises, like Spark).
@@ -79,7 +82,7 @@ _KEYWORDS = {
     "distinct", "join", "inner", "left", "on", "having",
     "case", "when", "then", "else", "end",
     "not", "is", "null", "in",
-    "union", "all",
+    "union", "all", "intersect", "except",
 } | _AGGS
 
 
@@ -425,14 +428,33 @@ class _Query:
 
 @dataclass
 class _Union:
-    """UNION [ALL] chain: left-associative set folds (Spark semantics —
-    each non-ALL step dedups the accumulated rows), then one trailing
-    ORDER BY/LIMIT over the combined result."""
+    """Set-operation chain: left-associative folds over UNION [ALL] /
+    INTERSECT / EXCEPT steps (INTERSECT parsed at higher precedence,
+    standard SQL), then one trailing ORDER BY/LIMIT over the combined
+    result."""
 
-    queries: list          # [_Query, ...] (order/limit stripped)
-    alls: list             # [bool] per UNION step (len = len(queries)-1)
+    queries: list          # [_Query | _Union, ...] (order/limit stripped)
+    ops: list              # per step: "union" | "union_all" | "intersect"
+    #                        | "except"  (len = len(queries)-1)
     order: tuple | None
     limit: int | None
+
+
+def _take_order_limit(node) -> tuple:
+    """Detach (order, limit) from a chain branch (query or nested
+    chain) so they can bind the enclosing chain instead."""
+    order, limit = node.order, node.limit
+    node.order = node.limit = None
+    return order, limit
+
+
+def _require_no_order_limit(node) -> None:
+    if node.order is not None or node.limit is not None:
+        raise ValueError(
+            "SQL: ORDER BY/LIMIT inside a set-operation branch is not "
+            "supported — a trailing ORDER BY/LIMIT applies to the whole "
+            "chain"
+        )
 
 
 class _Parser:
@@ -475,31 +497,54 @@ class _Parser:
         return node
 
     def _union_chain(self):
-        """One select, or select UNION [ALL] select … → _Query | _Union."""
-        first = self._select_query()
-        branches: list[tuple[bool, _Query]] = []
-        while self._accept("kw", "union"):
-            all_ = bool(self._accept("kw", "all"))
-            if not all_:
-                self._accept("kw", "distinct")  # UNION DISTINCT = UNION
-            branches.append((all_, self._select_query()))
-        if not branches:
-            return first
-        queries = [first] + [q for _, q in branches]
-        for q in queries[:-1]:
-            if q.order is not None or q.limit is not None:
-                raise ValueError(
-                    "SQL: ORDER BY/LIMIT inside a UNION branch is not "
-                    "supported — a trailing ORDER BY/LIMIT applies to the "
-                    "whole union"
+        """Set-op grammar with standard precedence — INTERSECT binds
+        tighter than UNION/EXCEPT:
+
+            chain     := intersects ((UNION [ALL|DISTINCT] | EXCEPT
+                         [DISTINCT]) intersects)*
+            intersects := select (INTERSECT [DISTINCT] select)*
+
+        → _Query | _Union.  The trailing ORDER BY/LIMIT of the chain's
+        LAST select binds the whole chain (Spark); any earlier select
+        carrying one raises."""
+        first = self._intersect_chain()
+        steps: list[tuple[str, Any]] = []
+        while True:
+            if self._accept("kw", "union"):
+                all_ = bool(self._accept("kw", "all"))
+                if not all_:
+                    self._accept("kw", "distinct")  # UNION DISTINCT = UNION
+                steps.append(
+                    ("union_all" if all_ else "union", self._intersect_chain())
                 )
-        last = queries[-1]
-        order, limit = last.order, last.limit
-        queries[-1] = _Query(
-            last.items, last.distinct, last.table, last.joins, last.where,
-            last.group, last.having, None, None,
-        )
-        return _Union(queries, [a for a, _ in branches], order, limit)
+            elif self._accept("kw", "except"):
+                self._accept("kw", "distinct")
+                steps.append(("except", self._intersect_chain()))
+            else:
+                break
+        if not steps:
+            return first
+        queries = [first] + [q for _, q in steps]
+        order, limit = _take_order_limit(queries[-1])
+        for q in queries[:-1]:
+            _require_no_order_limit(q)
+        return _Union(queries, [op for op, _ in steps], order, limit)
+
+    def _intersect_chain(self):
+        first = self._select_query()
+        steps = []
+        while self._accept("kw", "intersect"):
+            self._accept("kw", "distinct")
+            steps.append(("intersect", self._select_query()))
+        if not steps:
+            return first
+        queries = [first] + [q for _, q in steps]
+        # the last select's order/limit becomes THIS chain's; the outer
+        # chain takes it over (or rejects it) if this chain isn't final
+        order, limit = _take_order_limit(queries[-1])
+        for q in queries[:-1]:
+            _require_no_order_limit(q)
+        return _Union(queries, [op for op, _ in steps], order, limit)
 
     def _select_query(self):
         self._expect("kw", "select")
@@ -866,6 +911,12 @@ def _eval_cond3(getcol, cond) -> tuple[np.ndarray, np.ndarray]:
         # Spark's cast would null out joins the null-set instead
         coerced = []
         for v in list(values):
+            if isinstance(v, (np.datetime64, np.timedelta64)):
+                # already in comparison space; .item() would flatten to
+                # raw integer ns and _coerce would re-parse it as a
+                # garbage year-precision datetime
+                coerced.append(v)
+                continue
             v = v.item() if isinstance(v, np.generic) else v
             try:
                 coerced.append(_coerce(col, v))
@@ -1021,11 +1072,18 @@ def _equi_join(
     return Table.from_dict(cols)
 
 
+def _row_codes(cols) -> np.recarray:
+    """Columns → packed per-row codes with null-safe equality (every
+    NaN/NaT/None folds to one code) — the ONE copy of the row-identity
+    rule shared by DISTINCT, the set operations, and GROUP BY."""
+    return np.rec.fromarrays([_group_codes(c) for c in cols])
+
+
 def _distinct_rows(t: Table) -> Table:
     """Row-level DISTINCT via per-column group codes (nulls equal)."""
     if len(t) == 0 or not t.columns:
         return t
-    packed = np.rec.fromarrays([_group_codes(t.column(c)) for c in t.columns])
+    packed = _row_codes([t.column(c) for c in t.columns])
     _, first = np.unique(packed, return_index=True)
     return t.mask(np.sort(first))
 
@@ -1231,40 +1289,55 @@ def _resolve_source(ref, resolve_table) -> Table:
     return t
 
 
+def _set_combine(lt: Table, rt: Table, op: str) -> Table:
+    """One left-fold step of a set-operation chain: positional column
+    alignment (names from the left side), the string/timestamp/interval/
+    numeric type guard, then the op.  INTERSECT/EXCEPT return DISTINCT
+    left rows by set membership on shared row codes (standard SQL)."""
+    l_cols, r_cols = list(lt.columns), list(rt.columns)
+    if len(l_cols) != len(r_cols):
+        raise ValueError(
+            f"SQL: set-operation branches have {len(l_cols)} and "
+            f"{len(r_cols)} columns — they must match"
+        )
+    combined: dict[str, np.ndarray] = {}
+    for j, name in enumerate(l_cols):
+        a, b = lt.column(name), rt.column(r_cols[j])
+        ka, kb = _union_kind(a), _union_kind(b)
+        if ka != kb:
+            raise ValueError(
+                f"SQL: set-operation column {name!r} mixes {ka} and {kb} "
+                "branches"
+            )
+        combined[name] = np.concatenate([a, b])
+    t = Table.from_dict(combined)
+    if op == "union_all":
+        return t
+    if op == "union":
+        return _distinct_rows(t)
+    # INTERSECT / EXCEPT: shared row codes over the combined table make
+    # left and right rows comparable (per-table codes would not be)
+    if not combined:
+        return lt
+    packed = _row_codes([t.column(c) for c in t.columns])
+    _, inv = np.unique(packed, return_inverse=True)
+    n_l = len(lt)
+    member = np.isin(inv[:n_l], inv[n_l:])
+    keep = member if op == "intersect" else ~member
+    return _distinct_rows(lt.mask(keep))
+
+
 def _execute_union(u: "_Union", resolve_table) -> Table:
-    parts = [_execute_query(sub, resolve_table) for sub in u.queries]
-    width = len(parts[0].columns)
-    for p in parts[1:]:
-        if len(p.columns) != width:
-            raise ValueError(
-                f"SQL: UNION branches have {width} and {len(p.columns)} "
-                "columns — they must match"
-            )
-    names = list(parts[0].columns)
-    out: dict[str, np.ndarray] = {}
-    for j, name in enumerate(names):
-        segs = [p.column(list(p.columns)[j]) for p in parts]  # positional
-        kinds = {_union_kind(s) for s in segs}
-        if len(kinds) > 1:
-            raise ValueError(
-                f"SQL: UNION column {name!r} mixes "
-                f"{' and '.join(sorted(kinds))} branches"
-            )
-        out[name] = np.concatenate(segs)
-    t = Table.from_dict(out)
-    if not all(u.alls):
-        # left-associative set folds: each non-ALL step dedups everything
-        # accumulated so far (an ALL-only chain is just the concat above)
-        sizes = [len(p) for p in parts]
-        acc = t.mask(np.arange(sizes[0]))
-        offset = sizes[0]
-        for all_, size in zip(u.alls, sizes[1:]):
-            nxt = t.mask(np.arange(offset, offset + size))
-            acc = Table.concat([acc, nxt])
-            if not all_:
-                acc = _distinct_rows(acc)
-            offset += size
-        t = acc
+    def run(node):
+        return (
+            _execute_union(node, resolve_table)
+            if isinstance(node, _Union)
+            else _execute_query(node, resolve_table)
+        )
+
+    t = run(u.queries[0])
+    for op, node in zip(u.ops, u.queries[1:]):
+        t = _set_combine(t, run(node), op)
     if u.order is not None:
         # validate BEFORE any emptiness shortcut — an unknown ORDER BY
         # column must raise even on a zero-row result (Spark analysis)
@@ -1437,7 +1510,7 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
         # lexicographic group ids via np.unique over a structured view of
         # per-column integer codes — codes (not raw values) so every null
         # (NaN/NaT) lands in ONE group, Spark's GROUP BY rule
-        packed = np.rec.fromarrays([_group_codes(k) for k in keys])
+        packed = _row_codes(keys)
         uniq, inv = np.unique(packed, return_inverse=True)
         order_idx = np.argsort(inv, kind="stable")
         sorted_inv = inv[order_idx]

@@ -928,9 +928,9 @@ class TestUnion:
     def test_union_guards(self, two_tables):
         with pytest.raises(ValueError, match="must match"):
             two_tables.sql("SELECT h FROM ua UNION SELECT hosp, val FROM ub")
-        with pytest.raises(ValueError, match="mixes numeric and string"):
+        with pytest.raises(ValueError, match="mixes string and numeric"):
             two_tables.sql("SELECT h FROM ua UNION ALL SELECT val FROM ub")
-        with pytest.raises(ValueError, match="inside a UNION branch"):
+        with pytest.raises(ValueError, match="set-operation branch"):
             two_tables.sql("SELECT h FROM ua LIMIT 1 UNION SELECT hosp FROM ub")
 
     def test_union_with_aggregates_and_limit(self, two_tables):
@@ -1127,3 +1127,64 @@ class TestInSubquery:
             "SELECT x FROM wnull WHERE x IN (SELECT c FROM codes) ORDER BY x"
         )
         np.testing.assert_allclose(r3.column("x"), [1.0, 3.0])
+
+
+# ---------------------------------------------------- INTERSECT / EXCEPT
+class TestSetOps:
+    @pytest.fixture
+    def ab(self, session):
+        session.register_table(
+            "sa", ht.Table.from_dict({"h": np.array(["x", "y", "z", "y"], object)})
+        )
+        session.register_table(
+            "sb", ht.Table.from_dict({"h2": np.array(["y", "z", "w"], object)})
+        )
+        return session
+
+    def test_intersect_and_except_distinct(self, ab):
+        r = ab.sql("SELECT h FROM sa INTERSECT SELECT h2 FROM sb ORDER BY h")
+        assert list(r.column("h")) == ["y", "z"]  # distinct, both sides
+        r2 = ab.sql("SELECT h FROM sa EXCEPT SELECT h2 FROM sb")
+        assert list(r2.column("h")) == ["x"]
+        r3 = ab.sql("SELECT h2 FROM sb EXCEPT DISTINCT SELECT h FROM sa")
+        assert list(r3.column("h2")) == ["w"]
+
+    def test_intersect_binds_tighter_than_union(self, ab):
+        # a UNION (b INTERSECT b) — standard precedence
+        r = ab.sql(
+            "SELECT h FROM sa UNION SELECT h2 FROM sb "
+            "INTERSECT SELECT h2 FROM sb ORDER BY h"
+        )
+        assert list(r.column("h")) == ["w", "x", "y", "z"]
+
+    def test_trailing_order_limit_binds_chain(self, ab):
+        r = ab.sql(
+            "SELECT h FROM sa INTERSECT SELECT h2 FROM sb ORDER BY h DESC "
+            "LIMIT 1"
+        )
+        assert list(r.column("h")) == ["z"]
+        with pytest.raises(ValueError, match="set-operation branch"):
+            ab.sql("SELECT h FROM sa LIMIT 2 EXCEPT SELECT h2 FROM sb")
+
+    def test_nulls_compare_equal_in_set_ops(self, ab):
+        ab.register_table(
+            "n1", ht.Table.from_dict({"v": np.array([1.0, np.nan])})
+        )
+        ab.register_table(
+            "n2", ht.Table.from_dict({"v": np.array([np.nan, 2.0])})
+        )
+        # set ops use grouping (null-safe) equality: NaN ∩ NaN = NaN row
+        r = ab.sql("SELECT v FROM n1 INTERSECT SELECT v FROM n2")
+        assert len(r) == 1 and np.isnan(r.column("v")[0])
+
+    def test_timestamp_in_subquery(self, ab):
+        ts = np.array(
+            ["2025-03-31T22:00:00", "2025-03-31T23:00:00", "2025-04-01T00:00:00"],
+            dtype="datetime64[ns]",
+        )
+        ab.register_table("tt", ht.Table.from_dict({"ts": ts}))
+        ab.register_table("tf2", ht.Table.from_dict({"ts": ts[:2]}))
+        r = ab.sql("SELECT ts FROM tt WHERE ts IN (SELECT ts FROM tf2)")
+        assert len(r) == 2
+        r2 = ab.sql("SELECT ts FROM tt WHERE ts NOT IN (SELECT ts FROM tf2)")
+        assert len(r2) == 1 and r2.column("ts")[0] == ts[2]
