@@ -13,7 +13,12 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                        cols/aggs/literals (+ - * /, parentheses, unary
                        minus) | CASE WHEN <pred> THEN <expr> […]
                        [ELSE <expr>] END | scalar functions ABS ROUND
-                       (HALF_UP, Spark) UPPER LOWER LENGTH COALESCE
+                       (HALF_UP, Spark) UPPER LOWER LENGTH COALESCE |
+                       window functions: agg(col) OVER ([PARTITION BY
+                       cols] [ORDER BY col [DESC]]) and ROW_NUMBER /
+                       RANK / DENSE_RANK — Spark default frames (whole
+                       partition without ORDER BY; RANGE … CURRENT ROW
+                       with it, ties share their block's value)
                        [AS alias]]
       FROM t [[AS] a] | ( <select …> ) a   (derived tables, also on the
                                             JOIN right side; inner
@@ -83,7 +88,11 @@ _KEYWORDS = {
     "case", "when", "then", "else", "end",
     "not", "is", "null", "in",
     "union", "all", "intersect", "except",
+    "over", "partition",
 } | _AGGS
+
+#: ranking window functions (parse as name() calls, require OVER)
+_RANK_FUNCS = {"row_number", "rank", "dense_rank"}
 
 
 def _tokenize(query: str) -> list[tuple[str, str]]:
@@ -114,6 +123,9 @@ class _SelectItem:
     # arithmetic expression AST (("col",name) | ("lit",v) | ("agg",name) |
     # ("neg",e) | ("bin",op,l,r)); None for the simple col/agg fast paths
     expr: tuple | None = None
+    # window spec (partition_cols tuple, (order_col, desc) | None) for
+    # `agg(col) OVER (...)` / ranking functions; None = not windowed
+    window: tuple | None = None
 
 
 def _expr_has_agg(e) -> bool:
@@ -219,6 +231,8 @@ def _render_expr(e) -> str:
         return "CASE"
     if k == "fn":
         return f"{e[1]}({', '.join(_render_expr(a) for a in e[2])})"
+    if k == "rankfn":
+        return f"{e[1]}()"
     if k == "aggex":
         return f"{e[1]}({_render_expr(e[2])})"
     return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
@@ -518,6 +532,11 @@ class _Parser:
                     ("union_all" if all_ else "union", self._intersect_chain())
                 )
             elif self._accept("kw", "except"):
+                if self._peek() == ("kw", "all"):
+                    raise ValueError(
+                        "SQL: EXCEPT ALL (bag semantics) is not supported — "
+                        "EXCEPT returns distinct rows"
+                    )
                 self._accept("kw", "distinct")
                 steps.append(("except", self._intersect_chain()))
             else:
@@ -534,6 +553,11 @@ class _Parser:
         first = self._select_query()
         steps = []
         while self._accept("kw", "intersect"):
+            if self._peek() == ("kw", "all"):
+                raise ValueError(
+                    "SQL: INTERSECT ALL (bag semantics) is not supported — "
+                    "INTERSECT returns distinct rows"
+                )
             self._accept("kw", "distinct")
             steps.append(("intersect", self._select_query()))
         if not steps:
@@ -682,20 +706,57 @@ class _Parser:
 
     def _select_item(self) -> _SelectItem:
         e = self._expr()
+        window = None
+        if self._accept("kw", "over"):
+            if e[0] not in ("agg", "rankfn"):
+                raise ValueError(
+                    "SQL: OVER applies to an aggregate or ranking function"
+                )
+            window = self._window_spec()
+        elif e[0] == "rankfn":
+            raise ValueError(
+                f"SQL: {e[1].upper()}() needs an OVER (...) window"
+            )
         # bare column / bare aggregate keep the legacy fast-path fields
         if e[0] == "col":
             col = e[1]
             item = _SelectItem(None, col, col.split(".")[-1])
-        elif e[0] == "agg":
+        elif e[0] == "agg" and window is None:
             name = e[1]
             agg = name.split("(", 1)[0]
             inner = name[len(agg) + 1 : -1]
             item = _SelectItem(agg, None if inner == "*" else inner, name)
+        elif window is not None:
+            item = _SelectItem(
+                None, None, _render_expr(e), expr=e, window=window
+            )
         else:
             item = _SelectItem(None, None, _render_expr(e), expr=e)
         if self._accept("kw", "as"):
             item.alias = self._expect("name")[1]
         return item
+
+    def _window_spec(self):
+        """``( [PARTITION BY cols] [ORDER BY col [ASC|DESC]] )``."""
+        self._expect("op", "(")
+        partition: list[str] = []
+        if self._accept("kw", "partition"):
+            self._expect("kw", "by")
+            partition = [self._name()]
+            while self._accept("op", ","):
+                partition.append(self._name())
+        order = None
+        if self._accept("kw", "order"):
+            self._expect("kw", "by")
+            col = self._name()
+            desc = False
+            if self._accept("kw", "desc"):
+                desc = True
+            else:
+                self._accept("kw", "asc")
+            order = (col, desc)
+        self._expect("op", ")")
+        return (tuple(partition), order)
 
     # ---- arithmetic expressions (SELECT items) ----
     def _expr(self):
@@ -741,6 +802,9 @@ class _Parser:
             return self._agg_factor()
         if t[0] == "name":
             name = self._next()[1]
+            if name.lower() in _RANK_FUNCS and self._accept("op", "("):
+                self._expect("op", ")")
+                return ("rankfn", name.lower())
             if name.lower() in _SCALAR_FUNCS and self._accept("op", "("):
                 args = [self._expr()]
                 while self._accept("op", ","):
@@ -1262,6 +1326,123 @@ def _lower_insub(cond, resolve_table):
     return cond
 
 
+def _window_column(getcol, n: int, item: "_SelectItem") -> np.ndarray:
+    """One windowed select item → a full-length column.
+
+    Frames follow Spark defaults: no ORDER BY = the whole partition;
+    with ORDER BY = RANGE UNBOUNDED PRECEDING .. CURRENT ROW (ties share
+    the value at their block's last row).  Ranking functions require
+    ORDER BY.  Null ordering matches the engine's sorts (ASC nulls
+    first, DESC nulls last)."""
+    part, order = item.window
+    e = item.expr
+    inv = (
+        np.unique(_row_codes([getcol(p) for p in part]), return_inverse=True)[1]
+        if part
+        else np.zeros(n, np.int64)
+    )
+    if e[0] == "agg":
+        m = _AGG_REF.match(e[1])
+        agg, c = m.groups()
+        x_raw = np.ones(n, np.float64) if c == "*" else getcol(c)
+        xnull = np.zeros(n, bool) if c == "*" else _null_mask(x_raw)
+    else:
+        agg = e[1]                       # row_number | rank | dense_rank
+        if order is None:
+            raise ValueError(
+                f"SQL: {agg.upper()}() requires ORDER BY in its window"
+            )
+
+    if order is None:
+        # whole-partition frame: grouped aggregate broadcast to rows —
+        # the RAW column feeds _grouped_aggregate so datetime min/max and
+        # string min/max keep their dtype (a float64 pre-cast would turn
+        # timestamps into raw nanosecond floats)
+        order_idx = np.argsort(inv, kind="stable")
+        sorted_inv = inv[order_idx]
+        starts = (
+            np.r_[0, np.flatnonzero(np.diff(sorted_inv)) + 1]
+            if n
+            else np.empty((0,), np.int64)
+        )
+        per_group = _grouped_aggregate(np.asarray(x_raw), agg, starts, order_idx)
+        return np.asarray(per_group)[inv] if n else np.empty((0,))
+
+    ocol, odesc = order
+    ovals = getcol(ocol)
+    onull = _null_mask(ovals)
+    # VALUE-ordered rank codes (NOT _group_codes, whose object-column
+    # factorization is first-appearance order): np.unique over the
+    # non-null values sorts, searchsorted ranks; nulls key first on ASC,
+    # last on DESC (the engine's sort convention)
+    codes = np.zeros(n, np.int64)
+    if n and (~onull).any():
+        vv = ovals[~onull]
+        uniq = np.unique(vv)
+        codes[~onull] = np.searchsorted(uniq, vv)
+    big = np.int64(n + 2)
+    okey = (
+        np.where(onull, big, -codes) if odesc else np.where(onull, -1, codes)
+    )
+    sort_idx = np.lexsort((okey, inv))          # partition-major
+    p_s, k_s = inv[sort_idx], okey[sort_idx]
+    new_part = np.r_[True, p_s[1:] != p_s[:-1]] if n else np.empty(0, bool)
+    part_start = np.maximum.accumulate(np.where(new_part, np.arange(n), 0))
+    if agg == "row_number":
+        out_s = np.arange(n) - part_start + 1.0
+    elif agg in ("rank", "dense_rank"):
+        new_block = new_part | np.r_[True, k_s[1:] != k_s[:-1]] if n else (
+            np.empty(0, bool)
+        )
+        block_start = np.maximum.accumulate(np.where(new_block, np.arange(n), 0))
+        if agg == "rank":
+            out_s = block_start - part_start + 1.0
+        else:
+            blk_ord = np.cumsum(new_block & ~new_part)
+            part_blk0 = np.maximum.accumulate(np.where(new_part, blk_ord, 0))
+            out_s = blk_ord - part_blk0 + 1.0
+    elif agg in ("sum", "avg", "count"):
+        if agg == "count":
+            x_s = np.zeros(n, np.float64)
+        else:
+            if not np.issubdtype(np.asarray(x_raw).dtype, np.number):
+                raise ValueError(
+                    f"SQL: running {agg.upper()} needs a numeric column"
+                )
+            x_s = np.where(xnull, 0.0, np.asarray(x_raw, np.float64))[sort_idx]
+        c_s = (~xnull).astype(np.float64)[sort_idx]
+        csum, ccnt = np.cumsum(x_s), np.cumsum(c_s)
+        base_sum = np.where(part_start > 0, csum[part_start - 1], 0.0)
+        base_cnt = np.where(part_start > 0, ccnt[part_start - 1], 0.0)
+        run_sum, run_cnt = csum - base_sum, ccnt - base_cnt
+        # RANGE frame: ties share the value at their block's LAST row —
+        # block_end[i] = the next index ≥ i where a tie block closes
+        last_of_block = (
+            np.r_[(p_s[1:] != p_s[:-1]) | (k_s[1:] != k_s[:-1]), True]
+            if n
+            else np.empty(0, bool)
+        )
+        block_end = np.minimum.accumulate(
+            np.where(last_of_block, np.arange(n), n)[::-1]
+        )[::-1]
+        run_sum, run_cnt = run_sum[block_end], run_cnt[block_end]
+        if agg == "count":
+            out_s = run_cnt
+        elif agg == "sum":
+            out_s = np.where(run_cnt > 0, run_sum, np.nan)
+        else:
+            out_s = np.where(run_cnt > 0, run_sum / np.maximum(run_cnt, 1), np.nan)
+    else:
+        raise ValueError(
+            f"SQL: running {agg.upper()} over an ordered window is not "
+            "supported (whole-partition frames support every aggregate — "
+            "drop the window ORDER BY)"
+        )
+    out = np.empty(n, np.float64)
+    out[sort_idx] = out_s
+    return out
+
+
 def _resolve_source(ref, resolve_table) -> Table:
     """A FROM/JOIN source: a table name (string) resolved by the caller,
     or a derived-table query node executed recursively.  A derived
@@ -1424,6 +1605,36 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
 
     if q.where is not None:
         t = t.mask(_eval_cond(getcol, q.where))
+
+    windowed = [it for it in (items or []) if it.window is not None]
+    if windowed:
+        if q.group:
+            raise ValueError(
+                "SQL: window functions cannot mix with GROUP BY — compute "
+                "the windows in a FROM subquery"
+            )
+        for it in items:
+            if it.window is None and it.agg is not None:
+                raise ValueError(
+                    f"SQL: plain aggregate {it.alias!r} cannot mix with "
+                    "window functions — give it an OVER () window"
+                )
+        # windows compute AFTER the WHERE mask (SQL logical order), then
+        # become HIDDEN columns (sentinel-named, so star-plus expansion
+        # cannot collide with them) that the rewritten select items and
+        # ORDER BY reference by alias
+        n_rows = len(t)
+        merged = {c: t.column(c) for c in t.columns}
+        rewritten = []
+        for it in items:
+            if it.window is None:
+                rewritten.append(it)
+                continue
+            hidden = f"__win{len(merged)}__"
+            merged[hidden] = _window_column(getcol, n_rows, it)
+            rewritten.append(_SelectItem(None, hidden, it.alias))
+        t = Table.from_dict(merged)
+        items = rewritten
 
     if q.group:
         if items is None:
@@ -1760,6 +1971,8 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
                 if pos != 0:
                     raise ValueError("SQL: * must come first in a select list")
                 for c in t.columns:
+                    if c.startswith("__win") and c.endswith("__"):
+                        continue  # hidden window columns are not user data
                     proj[c] = t.column(c)
                 continue
             if it.alias in proj:

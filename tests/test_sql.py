@@ -1188,3 +1188,109 @@ class TestSetOps:
         assert len(r) == 2
         r2 = ab.sql("SELECT ts FROM tt WHERE ts NOT IN (SELECT ts FROM tf2)")
         assert len(r2) == 1 and r2.column("ts")[0] == ts[2]
+
+
+# ------------------------------------------------------- window functions
+class TestWindowFunctions:
+    @pytest.fixture
+    def wt(self, session):
+        session.register_table(
+            "wadm",
+            ht.Table.from_dict(
+                {
+                    "h": np.array(["a", "a", "a", "b", "b"], object),
+                    "los": np.array([2.0, 6.0, 6.0, 9.0, 1.0]),
+                }
+            ),
+        )
+        return session
+
+    def test_partition_aggregate_broadcast(self, wt):
+        r = wt.sql("SELECT h, avg(los) OVER (PARTITION BY h) AS m FROM wadm")
+        np.testing.assert_allclose(
+            r.column("m"), [14 / 3, 14 / 3, 14 / 3, 5.0, 5.0]
+        )
+        r2 = wt.sql("SELECT max(los) OVER (PARTITION BY h) AS mx FROM wadm")
+        np.testing.assert_allclose(r2.column("mx"), [6, 6, 6, 9, 9])
+
+    def test_ranking_functions(self, wt):
+        r = wt.sql(
+            "SELECT row_number() OVER (PARTITION BY h ORDER BY los) AS rn, "
+            "rank() OVER (PARTITION BY h ORDER BY los) AS rk, "
+            "dense_rank() OVER (PARTITION BY h ORDER BY los) AS dr FROM wadm"
+        )
+        np.testing.assert_array_equal(r.column("rn"), [1, 2, 3, 2, 1])
+        np.testing.assert_array_equal(r.column("rk"), [1, 2, 2, 2, 1])
+        np.testing.assert_array_equal(r.column("dr"), [1, 2, 2, 2, 1])
+
+    def test_running_sum_range_frame_ties(self, wt):
+        """Spark's default RANGE frame: tied order values share the
+        cumulative at their block's last row."""
+        r = wt.sql(
+            "SELECT sum(los) OVER (PARTITION BY h ORDER BY los) AS run "
+            "FROM wadm"
+        )
+        np.testing.assert_allclose(r.column("run"), [2, 14, 14, 10, 1])
+
+    def test_global_window_desc(self, wt):
+        r = wt.sql("SELECT count(*) OVER (ORDER BY los DESC) AS c FROM wadm")
+        np.testing.assert_array_equal(r.column("c"), [4, 3, 3, 1, 5])
+
+    def test_window_composes_with_where_order_and_subquery(self, wt):
+        r = wt.sql(
+            "SELECT h, rn FROM (SELECT h, los, row_number() OVER "
+            "(PARTITION BY h ORDER BY los DESC) AS rn FROM wadm) x "
+            "WHERE rn = 1 ORDER BY h"
+        )
+        # top-1 per hospital by LOS — the canonical windowed query
+        assert list(r.column("h")) == ["a", "b"]
+
+    def test_window_guards(self, wt):
+        with pytest.raises(ValueError, match="needs an OVER"):
+            wt.sql("SELECT row_number() AS r FROM wadm")
+        with pytest.raises(ValueError, match="requires ORDER BY"):
+            wt.sql("SELECT rank() OVER (PARTITION BY h) AS r FROM wadm")
+        with pytest.raises(ValueError, match="cannot mix with GROUP BY"):
+            wt.sql(
+                "SELECT h, count(*) OVER (PARTITION BY h) AS c FROM wadm "
+                "GROUP BY h"
+            )
+        with pytest.raises(ValueError, match="cannot mix with window"):
+            wt.sql(
+                "SELECT avg(los) AS a, count(*) OVER (PARTITION BY h) AS c "
+                "FROM wadm"
+            )
+        with pytest.raises(ValueError, match="running MIN"):
+            wt.sql("SELECT min(los) OVER (ORDER BY los) AS m FROM wadm")
+
+    def test_star_plus_window_and_string_order(self, wt):
+        r = wt.sql(
+            "SELECT *, row_number() OVER (ORDER BY h) AS rn FROM wadm"
+        )
+        # string window ORDER BY ranks by VALUE order (a before b)
+        assert set(r.columns) == {"h", "los", "rn"}
+        got = dict(zip(r.column("rn"), r.column("h")))
+        assert got[1.0] == "a" and got[5.0] == "b"
+
+    def test_window_datetime_minmax_keeps_dtype(self, wt):
+        ts = np.array(
+            ["2025-01-02T00:00:00", "2025-01-01T00:00:00", "2025-01-03T00:00:00"],
+            dtype="datetime64[ns]",
+        )
+        wt.register_table(
+            "wts",
+            ht.Table.from_dict(
+                {"g": np.array(["u", "u", "v"], object), "ts": ts}
+            ),
+        )
+        r = wt.sql("SELECT max(ts) OVER (PARTITION BY g) AS m FROM wts")
+        assert r.column("m").dtype.kind == "M"
+        assert r.column("m")[0] == ts[0]
+        with pytest.raises(ValueError, match="running SUM needs a numeric"):
+            wt.sql("SELECT sum(ts) OVER (ORDER BY ts) AS s FROM wts")
+
+    def test_intersect_all_rejected(self, wt):
+        with pytest.raises(ValueError, match="INTERSECT ALL"):
+            wt.sql("SELECT h FROM wadm INTERSECT ALL SELECT h FROM wadm")
+        with pytest.raises(ValueError, match="EXCEPT ALL"):
+            wt.sql("SELECT h FROM wadm EXCEPT ALL SELECT h FROM wadm")
