@@ -15,11 +15,12 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                        [ELSE <expr>] END | scalar functions ABS ROUND
                        (HALF_UP, Spark) UPPER LOWER LENGTH COALESCE |
                        window functions: agg(col) OVER ([PARTITION BY
-                       cols] [ORDER BY col [DESC]]) and ROW_NUMBER /
-                       RANK / DENSE_RANK — Spark default frames (whole
-                       partition without ORDER BY; RANGE … CURRENT ROW
-                       with it, ties share their block's value)
-                       [AS alias]]
+                       cols] [ORDER BY col [DESC]]), ROW_NUMBER / RANK
+                       / DENSE_RANK, LAG/LEAD(col[, offset]) — Spark
+                       default frames (whole partition without ORDER
+                       BY; RANGE … CURRENT ROW with it, ties share
+                       their block's value; out-of-partition offsets
+                       are NULL) [AS alias]]
       FROM t [[AS] a] | ( <select …> ) a   (derived tables, also on the
                                             JOIN right side; inner
                                             ORDER BY/LIMIT = top-N)
@@ -93,6 +94,8 @@ _KEYWORDS = {
 
 #: ranking window functions (parse as name() calls, require OVER)
 _RANK_FUNCS = {"row_number", "rank", "dense_rank"}
+#: offset window functions: lag(col[, offset]) / lead(col[, offset])
+_SHIFT_FUNCS = {"lag", "lead"}
 
 
 def _tokenize(query: str) -> list[tuple[str, str]]:
@@ -126,6 +129,30 @@ class _SelectItem:
     # window spec (partition_cols tuple, (order_col, desc) | None) for
     # `agg(col) OVER (...)` / ranking functions; None = not windowed
     window: tuple | None = None
+
+
+def _expr_has_window_fn(e) -> bool:
+    """True when a rankfn/shiftfn node appears ANYWHERE in the tree —
+    nested window functions inside arithmetic have no evaluation rule
+    and must be rejected at parse time, not crash the evaluator."""
+    if e is None:
+        return False
+    k = e[0]
+    if k in ("rankfn", "shiftfn"):
+        return True
+    if k == "neg":
+        return _expr_has_window_fn(e[1])
+    if k == "bin":
+        return _expr_has_window_fn(e[2]) or _expr_has_window_fn(e[3])
+    if k == "case":
+        return any(_expr_has_window_fn(v) for _, v in e[1]) or (
+            _expr_has_window_fn(e[2])
+        )
+    if k == "fn":
+        return any(_expr_has_window_fn(a) for a in e[2])
+    if k == "aggex":
+        return _expr_has_window_fn(e[2])
+    return False
 
 
 def _expr_has_agg(e) -> bool:
@@ -233,6 +260,8 @@ def _render_expr(e) -> str:
         return f"{e[1]}({', '.join(_render_expr(a) for a in e[2])})"
     if k == "rankfn":
         return f"{e[1]}()"
+    if k == "shiftfn":
+        return f"{e[1]}({e[2]})" if e[3] == 1 else f"{e[1]}({e[2]}, {e[3]})"
     if k == "aggex":
         return f"{e[1]}({_render_expr(e[2])})"
     return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
@@ -708,14 +737,21 @@ class _Parser:
         e = self._expr()
         window = None
         if self._accept("kw", "over"):
-            if e[0] not in ("agg", "rankfn"):
+            if e[0] not in ("agg", "rankfn", "shiftfn"):
                 raise ValueError(
-                    "SQL: OVER applies to an aggregate or ranking function"
+                    "SQL: OVER applies to an aggregate, ranking, or "
+                    "lag/lead function"
                 )
             window = self._window_spec()
-        elif e[0] == "rankfn":
+        elif e[0] in ("rankfn", "shiftfn"):
             raise ValueError(
                 f"SQL: {e[1].upper()}() needs an OVER (...) window"
+            )
+        elif _expr_has_window_fn(e):
+            raise ValueError(
+                "SQL: window functions cannot nest inside expressions — "
+                "alias the window in a FROM subquery and compute on the "
+                "alias"
             )
         # bare column / bare aggregate keep the legacy fast-path fields
         if e[0] == "col":
@@ -805,6 +841,13 @@ class _Parser:
             if name.lower() in _RANK_FUNCS and self._accept("op", "("):
                 self._expect("op", ")")
                 return ("rankfn", name.lower())
+            if name.lower() in _SHIFT_FUNCS and self._accept("op", "("):
+                col = self._name()
+                offset = 1
+                if self._accept("op", ","):
+                    offset = int(self._expect("num")[1])
+                self._expect("op", ")")
+                return ("shiftfn", name.lower(), col, offset)
             if name.lower() in _SCALAR_FUNCS and self._accept("op", "("):
                 args = [self._expr()]
                 while self._accept("op", ","):
@@ -1326,7 +1369,9 @@ def _lower_insub(cond, resolve_table):
     return cond
 
 
-def _window_column(getcol, n: int, item: "_SelectItem") -> np.ndarray:
+def _window_column(
+    getcol, n: int, item: "_SelectItem", cache: dict | None = None
+) -> np.ndarray:
     """One windowed select item → a full-length column.
 
     Frames follow Spark defaults: no ORDER BY = the whole partition;
@@ -1336,18 +1381,25 @@ def _window_column(getcol, n: int, item: "_SelectItem") -> np.ndarray:
     first, DESC nulls last)."""
     part, order = item.window
     e = item.expr
-    inv = (
-        np.unique(_row_codes([getcol(p) for p in part]), return_inverse=True)[1]
-        if part
-        else np.zeros(n, np.int64)
-    )
+    cache = {} if cache is None else cache
+    if ("inv", part) in cache:
+        inv = cache[("inv", part)]
+    else:
+        inv = (
+            np.unique(
+                _row_codes([getcol(p) for p in part]), return_inverse=True
+            )[1]
+            if part
+            else np.zeros(n, np.int64)
+        )
+        cache[("inv", part)] = inv
     if e[0] == "agg":
         m = _AGG_REF.match(e[1])
         agg, c = m.groups()
         x_raw = np.ones(n, np.float64) if c == "*" else getcol(c)
         xnull = np.zeros(n, bool) if c == "*" else _null_mask(x_raw)
     else:
-        agg = e[1]                       # row_number | rank | dense_rank
+        agg = e[1]           # row_number | rank | dense_rank | lag | lead
         if order is None:
             raise ValueError(
                 f"SQL: {agg.upper()}() requires ORDER BY in its window"
@@ -1368,27 +1420,62 @@ def _window_column(getcol, n: int, item: "_SelectItem") -> np.ndarray:
         per_group = _grouped_aggregate(np.asarray(x_raw), agg, starts, order_idx)
         return np.asarray(per_group)[inv] if n else np.empty((0,))
 
-    ocol, odesc = order
-    ovals = getcol(ocol)
-    onull = _null_mask(ovals)
-    # VALUE-ordered rank codes (NOT _group_codes, whose object-column
-    # factorization is first-appearance order): np.unique over the
-    # non-null values sorts, searchsorted ranks; nulls key first on ASC,
-    # last on DESC (the engine's sort convention)
-    codes = np.zeros(n, np.int64)
-    if n and (~onull).any():
-        vv = ovals[~onull]
-        uniq = np.unique(vv)
-        codes[~onull] = np.searchsorted(uniq, vv)
-    big = np.int64(n + 2)
-    okey = (
-        np.where(onull, big, -codes) if odesc else np.where(onull, -1, codes)
-    )
-    sort_idx = np.lexsort((okey, inv))          # partition-major
-    p_s, k_s = inv[sort_idx], okey[sort_idx]
-    new_part = np.r_[True, p_s[1:] != p_s[:-1]] if n else np.empty(0, bool)
-    part_start = np.maximum.accumulate(np.where(new_part, np.arange(n), 0))
-    if agg == "row_number":
+    spec_key = ("sort", part, order)
+    if spec_key in cache:
+        sort_idx, p_s, k_s, new_part, part_start = cache[spec_key]
+    else:
+        ocol, odesc = order
+        ovals = getcol(ocol)
+        onull = _null_mask(ovals)
+        # VALUE-ordered rank codes (NOT _group_codes, whose object-column
+        # factorization is first-appearance order): np.unique over the
+        # non-null values sorts, searchsorted ranks; nulls key first on
+        # ASC, last on DESC (the engine's sort convention)
+        codes = np.zeros(n, np.int64)
+        if n and (~onull).any():
+            vv = ovals[~onull]
+            uniq = np.unique(vv)
+            codes[~onull] = np.searchsorted(uniq, vv)
+        big = np.int64(n + 2)
+        okey = (
+            np.where(onull, big, -codes)
+            if odesc
+            else np.where(onull, -1, codes)
+        )
+        sort_idx = np.lexsort((okey, inv))          # partition-major
+        p_s, k_s = inv[sort_idx], okey[sort_idx]
+        new_part = (
+            np.r_[True, p_s[1:] != p_s[:-1]] if n else np.empty(0, bool)
+        )
+        part_start = np.maximum.accumulate(np.where(new_part, np.arange(n), 0))
+        cache[spec_key] = (sort_idx, p_s, k_s, new_part, part_start)
+    if agg in ("lag", "lead"):
+        # shift within partition along the window order; out-of-partition
+        # offsets are NULL (Spark's default, no explicit default value)
+        src = getcol(e[2])
+        k = int(e[3]) * (1 if agg == "lag" else -1)
+        src_s = src[sort_idx]
+        idx = np.arange(n) - k
+        valid = (idx >= 0) & (idx < n)
+        idx_c = np.clip(idx, 0, max(n - 1, 0))
+        same_part = valid & (p_s[idx_c] == p_s)
+        if src.dtype.kind == "M":
+            out_s = np.where(
+                same_part, src_s[idx_c], np.datetime64("NaT")
+            )
+        elif src.dtype.kind == "m":
+            out_s = np.where(
+                same_part, src_s[idx_c], np.timedelta64("NaT")
+            )
+        elif src.dtype.kind in "USO":
+            out_s = np.empty(n, object)
+            out_s[:] = None
+            out_s[same_part] = src_s[idx_c][same_part]
+        else:
+            out_s = np.where(
+                same_part, np.asarray(src_s, np.float64)[idx_c], np.nan
+            )
+    elif agg == "row_number":
         out_s = np.arange(n) - part_start + 1.0
     elif agg in ("rank", "dense_rank"):
         new_block = new_part | np.r_[True, k_s[1:] != k_s[:-1]] if n else (
@@ -1438,7 +1525,7 @@ def _window_column(getcol, n: int, item: "_SelectItem") -> np.ndarray:
             "supported (whole-partition frames support every aggregate — "
             "drop the window ORDER BY)"
         )
-    out = np.empty(n, np.float64)
+    out = np.empty(n, np.asarray(out_s).dtype)
     out[sort_idx] = out_s
     return out
 
@@ -1614,7 +1701,9 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
                 "the windows in a FROM subquery"
             )
         for it in items:
-            if it.window is None and it.agg is not None:
+            if it.window is None and (
+                it.agg is not None or _expr_has_agg(it.expr)
+            ):
                 raise ValueError(
                     f"SQL: plain aggregate {it.alias!r} cannot mix with "
                     "window functions — give it an OVER () window"
@@ -1626,12 +1715,13 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
         n_rows = len(t)
         merged = {c: t.column(c) for c in t.columns}
         rewritten = []
+        win_cache: dict = {}  # shared partition codes + sorts per spec
         for it in items:
             if it.window is None:
                 rewritten.append(it)
                 continue
             hidden = f"__win{len(merged)}__"
-            merged[hidden] = _window_column(getcol, n_rows, it)
+            merged[hidden] = _window_column(getcol, n_rows, it, win_cache)
             rewritten.append(_SelectItem(None, hidden, it.alias))
         t = Table.from_dict(merged)
         items = rewritten

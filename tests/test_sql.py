@@ -1294,3 +1294,47 @@ class TestWindowFunctions:
             wt.sql("SELECT h FROM wadm INTERSECT ALL SELECT h FROM wadm")
         with pytest.raises(ValueError, match="EXCEPT ALL"):
             wt.sql("SELECT h FROM wadm EXCEPT ALL SELECT h FROM wadm")
+
+    def test_lag_lead(self, wt):
+        r = wt.sql(
+            "SELECT h, los, lag(los) OVER (PARTITION BY h ORDER BY los) AS p, "
+            "lead(los) OVER (PARTITION BY h ORDER BY los) AS nx, "
+            "lag(los, 2) OVER (PARTITION BY h ORDER BY los) AS p2 FROM wadm"
+        )
+        # rows: a:(2,6,6)  b:(9,1).  sorted a: 2,6,6;  b: 1,9
+        by_row = {
+            (h, l): (p, nx, p2)
+            for h, l, p, nx, p2 in zip(
+                r.column("h"), r.column("los"), r.column("p"),
+                r.column("nx"), r.column("p2"),
+            )
+        }
+        assert np.isnan(by_row[("a", 2.0)][0])      # no previous
+        assert by_row[("b", 9.0)][0] == 1.0          # lag within b
+        assert by_row[("b", 1.0)][1] == 9.0          # lead within b
+        assert np.isnan(by_row[("b", 9.0)][1])       # no next
+        assert by_row[("a", 2.0)][1] == 6.0
+        # offset 2 crosses partition start -> NULL
+        assert np.isnan(by_row[("a", 2.0)][2]) and np.isnan(by_row[("b", 9.0)][2])
+
+    def test_lag_string_column(self, wt):
+        r = wt.sql("SELECT h, lag(h) OVER (ORDER BY los) AS ph FROM wadm")
+        # global order by los: 1(b), 2(a), 6(a), 6(a), 9(b)
+        got = list(r.column("ph"))
+        assert got.count(None) == 1  # only the first row lacks a lag
+        with pytest.raises(ValueError, match="needs an OVER"):
+            wt.sql("SELECT lag(h) AS x FROM wadm")
+
+    def test_window_edge_guards(self, wt):
+        with pytest.raises(ValueError, match="cannot nest inside"):
+            wt.sql("SELECT row_number() + 1 AS x FROM wadm")
+        with pytest.raises(ValueError, match="cannot mix with window"):
+            wt.sql(
+                "SELECT sum(los) + 1 AS s, count(*) OVER () AS c FROM wadm"
+            )
+        # distinct auto-aliases for different lag offsets
+        r = wt.sql(
+            "SELECT lag(los) OVER (ORDER BY los), "
+            "lag(los, 2) OVER (ORDER BY los) FROM wadm"
+        )
+        assert len(r.columns) == 2
