@@ -845,7 +845,13 @@ class _Parser:
                 col = self._name()
                 offset = 1
                 if self._accept("op", ","):
-                    offset = int(self._expect("num")[1])
+                    tok = self._expect("num")[1]
+                    if "." in tok or "e" in tok.lower():
+                        raise ValueError(
+                            f"SQL: {name.upper()} offset must be an "
+                            f"integer, got {tok!r}"
+                        )
+                    offset = int(tok)
                 self._expect("op", ")")
                 return ("shiftfn", name.lower(), col, offset)
             if name.lower() in _SCALAR_FUNCS and self._accept("op", "("):

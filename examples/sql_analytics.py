@@ -1,0 +1,104 @@
+"""Spark-SQL-shaped analytics on the bundled hospital data — the
+engine's round-5 surface in one tour (the reference itself runs one
+windowed SELECT, ``mllearnforhospitalnetwork.py:123-128``; a Spark user
+expects the rest of the verbs to follow):
+
+1. CASE-bucketed conditional aggregation per hospital.
+2. A FROM-subquery enrichment join against per-hospital averages.
+3. Top-2 stays per hospital via ROW_NUMBER() OVER (PARTITION BY …).
+4. Event-sequence deltas with LAG over admission order.
+5. Semi-join via IN (SELECT …) + set ops.
+
+    PYTHONPATH=. python examples/sql_analytics.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+try:  # installed copy (pip install -e .) takes precedence
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu  # noqa: F401
+except ImportError:  # running from a raw checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+
+def main() -> None:
+    csv = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "data", "hospital_patients.csv",
+    )
+    table = ht.read_csv(csv, ht.hospital_event_schema())
+    spark = ht.Session.builder.app_name("sql-analytics").get_or_create()
+    spark.register_table("events", table)
+    print(f"{len(table)} events loaded\n")
+
+    print("== 1. LOS tiers per hospital (CASE + conditional aggregation)")
+    r = spark.sql(
+        "SELECT hospital_id, count(*) AS n, "
+        "round(avg(length_of_stay), 2) AS mean_los, "
+        "sum(CASE WHEN length_of_stay > 5.0 THEN 1 ELSE 0 END) AS n_high "
+        "FROM events GROUP BY hospital_id ORDER BY mean_los DESC LIMIT 5"
+    )
+    for row in zip(r.column("hospital_id"), r.column("n"),
+                   r.column("mean_los"), r.column("n_high")):
+        print("   %-6s n=%-5d mean_los=%-6.2f high=%d" % row)
+
+    print("\n== 2. High stays with their hospital's average attached "
+          "(derived-table join)")
+    r = spark.sql(
+        "SELECT e.hospital_id, count(*) AS n_above, "
+        "round(avg(m), 2) AS hosp_avg FROM events e "
+        "JOIN (SELECT hospital_id, avg(length_of_stay) AS m FROM events "
+        "GROUP BY hospital_id) h ON e.hospital_id = h.hospital_id "
+        "WHERE length_of_stay > 5.0 GROUP BY e.hospital_id "
+        "ORDER BY n_above DESC LIMIT 5"
+    )
+    for h, n, m in zip(r.column("hospital_id"), r.column("n_above"),
+                       r.column("hosp_avg")):
+        print(f"   {h}  {n} stays above 5.0 (hospital mean {m})")
+
+    print("\n== 3. Two longest stays per hospital (window top-N)")
+    r = spark.sql(
+        "SELECT hospital_id, length_of_stay FROM "
+        "(SELECT hospital_id, length_of_stay, row_number() OVER "
+        "(PARTITION BY hospital_id ORDER BY length_of_stay DESC) AS rn "
+        "FROM events) t WHERE rn <= 2 ORDER BY hospital_id LIMIT 8"
+    )
+    for h, l in zip(r.column("hospital_id"), r.column("length_of_stay")):
+        print(f"   {h}  {l:.2f}")
+
+    print("\n== 4. Occupancy swing between consecutive events (LAG)")
+    r = spark.sql(
+        "SELECT hospital_id, occ, prev FROM "
+        "(SELECT hospital_id, current_occupancy AS occ, "
+        "lag(current_occupancy) OVER (PARTITION BY hospital_id "
+        "ORDER BY event_time) AS prev FROM events) t "
+        "WHERE prev IS NOT NULL LIMIT 5"
+    )
+    for h, occ, prev in zip(r.column("hospital_id"), r.column("occ"),
+                            r.column("prev")):
+        print(f"   {h}  occupancy {prev:.0f} -> {occ:.0f}")
+
+    print("\n== 5. Hospitals with any >9.0-day stay (semi-join + set ops)")
+    r = spark.sql(
+        "SELECT DISTINCT hospital_id FROM events WHERE hospital_id IN "
+        "(SELECT hospital_id FROM events WHERE length_of_stay > 9.0) "
+        "ORDER BY hospital_id"
+    )
+    flagged = list(r.column("hospital_id"))
+    r2 = spark.sql(
+        "SELECT DISTINCT hospital_id FROM events EXCEPT "
+        "SELECT hospital_id FROM events WHERE length_of_stay > 9.0"
+    )
+    print(f"   flagged: {flagged}")
+    print(f"   never exceeded 9.0: {sorted(r2.column('hospital_id'))}")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()
