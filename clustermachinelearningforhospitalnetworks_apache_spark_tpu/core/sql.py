@@ -16,7 +16,8 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                        (HALF_UP, Spark) UPPER LOWER LENGTH COALESCE |
                        window functions: agg(col) OVER ([PARTITION BY
                        cols] [ORDER BY col [DESC]]), ROW_NUMBER / RANK
-                       / DENSE_RANK, LAG/LEAD(col[, offset]) — Spark
+                       / DENSE_RANK / NTILE(k), LAG/LEAD(col[, offset]),
+                       FIRST_VALUE/LAST_VALUE(col) — Spark
                        default frames (whole partition without ORDER
                        BY; RANGE … CURRENT ROW with it, ties share
                        their block's value; out-of-partition offsets
@@ -96,6 +97,10 @@ _KEYWORDS = {
 _RANK_FUNCS = {"row_number", "rank", "dense_rank"}
 #: offset window functions: lag(col[, offset]) / lead(col[, offset])
 _SHIFT_FUNCS = {"lag", "lead"}
+#: frame-edge window functions (one column arg)
+_EDGE_FUNCS = {"first_value", "last_value"}
+#: every AST node kind that is a window function (must carry OVER)
+_WINDOW_NODES = frozenset({"rankfn", "shiftfn", "ntilefn", "edgefn"})
 
 
 def _tokenize(query: str) -> list[tuple[str, str]]:
@@ -138,7 +143,7 @@ def _expr_has_window_fn(e) -> bool:
     if e is None:
         return False
     k = e[0]
-    if k in ("rankfn", "shiftfn"):
+    if k in _WINDOW_NODES:
         return True
     if k == "neg":
         return _expr_has_window_fn(e[1])
@@ -262,6 +267,10 @@ def _render_expr(e) -> str:
         return f"{e[1]}()"
     if k == "shiftfn":
         return f"{e[1]}({e[2]})" if e[3] == 1 else f"{e[1]}({e[2]}, {e[3]})"
+    if k == "ntilefn":
+        return f"ntile({e[1]})"
+    if k == "edgefn":
+        return f"{e[1]}({e[2]})"
     if k == "aggex":
         return f"{e[1]}({_render_expr(e[2])})"
     return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
@@ -737,16 +746,14 @@ class _Parser:
         e = self._expr()
         window = None
         if self._accept("kw", "over"):
-            if e[0] not in ("agg", "rankfn", "shiftfn"):
+            if e[0] != "agg" and e[0] not in _WINDOW_NODES:
                 raise ValueError(
-                    "SQL: OVER applies to an aggregate, ranking, or "
-                    "lag/lead function"
+                    "SQL: OVER applies to an aggregate or window function"
                 )
             window = self._window_spec()
-        elif e[0] in ("rankfn", "shiftfn"):
-            raise ValueError(
-                f"SQL: {e[1].upper()}() needs an OVER (...) window"
-            )
+        elif e[0] in _WINDOW_NODES:
+            fn = "NTILE" if e[0] == "ntilefn" else str(e[1]).upper()
+            raise ValueError(f"SQL: {fn}() needs an OVER (...) window")
         elif _expr_has_window_fn(e):
             raise ValueError(
                 "SQL: window functions cannot nest inside expressions — "
@@ -841,6 +848,18 @@ class _Parser:
             if name.lower() in _RANK_FUNCS and self._accept("op", "("):
                 self._expect("op", ")")
                 return ("rankfn", name.lower())
+            if name.lower() == "ntile" and self._accept("op", "("):
+                tok = self._expect("num")[1]
+                if "." in tok or "e" in tok.lower() or int(tok) < 1:
+                    raise ValueError(
+                        f"SQL: NTILE needs a positive integer, got {tok!r}"
+                    )
+                self._expect("op", ")")
+                return ("ntilefn", int(tok))
+            if name.lower() in _EDGE_FUNCS and self._accept("op", "("):
+                col = self._name()
+                self._expect("op", ")")
+                return ("edgefn", name.lower(), col)
             if name.lower() in _SHIFT_FUNCS and self._accept("op", "("):
                 col = self._name()
                 offset = 1
@@ -1375,6 +1394,17 @@ def _lower_insub(cond, resolve_table):
     return cond
 
 
+def _suffix_end(last_flags: np.ndarray, n: int) -> np.ndarray:
+    """Per-row index of the enclosing segment's END, from last-of-segment
+    booleans — the one copy of the reversed minimum-accumulate idiom the
+    window paths (tie blocks, partitions) share."""
+    if n == 0:
+        return np.empty(0, np.int64)
+    return np.minimum.accumulate(
+        np.where(last_flags, np.arange(n), n)[::-1]
+    )[::-1]
+
+
 def _window_column(
     getcol, n: int, item: "_SelectItem", cache: dict | None = None
 ) -> np.ndarray:
@@ -1405,8 +1435,12 @@ def _window_column(
         x_raw = np.ones(n, np.float64) if c == "*" else getcol(c)
         xnull = np.zeros(n, bool) if c == "*" else _null_mask(x_raw)
     else:
-        agg = e[1]           # row_number | rank | dense_rank | lag | lead
-        if order is None:
+        # row_number | rank | dense_rank | lag | lead | ntile |
+        # first_value | last_value
+        agg = "ntile" if e[0] == "ntilefn" else e[1]
+        if order is None and e[0] != "edgefn":
+            # FIRST/LAST_VALUE work on the whole-partition frame; the
+            # rank/shift/ntile functions are meaningless unordered
             raise ValueError(
                 f"SQL: {agg.upper()}() requires ORDER BY in its window"
             )
@@ -1418,6 +1452,25 @@ def _window_column(
         # timestamps into raw nanosecond floats)
         order_idx = np.argsort(inv, kind="stable")
         sorted_inv = inv[order_idx]
+        if e[0] == "edgefn":
+            # unordered FIRST/LAST_VALUE = the partition's first/last row
+            # in stable source order (Spark: nondeterministic-but-legal)
+            src_s = getcol(e[2])[order_idx]
+            new_p = (
+                np.r_[True, sorted_inv[1:] != sorted_inv[:-1]]
+                if n else np.empty(0, bool)
+            )
+            if agg == "first_value":
+                pick = np.maximum.accumulate(np.where(new_p, np.arange(n), 0))
+            else:
+                last_p = (
+                    np.r_[sorted_inv[1:] != sorted_inv[:-1], True]
+                    if n else np.empty(0, bool)
+                )
+                pick = _suffix_end(last_p, n)
+            out = np.empty(n, src_s.dtype)
+            out[order_idx] = src_s[pick]
+            return out
         starts = (
             np.r_[0, np.flatnonzero(np.diff(sorted_inv)) + 1]
             if n
@@ -1455,7 +1508,44 @@ def _window_column(
         )
         part_start = np.maximum.accumulate(np.where(new_part, np.arange(n), 0))
         cache[spec_key] = (sort_idx, p_s, k_s, new_part, part_start)
-    if agg in ("lag", "lead"):
+    if agg in ("first_value", "last_value"):
+        src = getcol(e[2])
+        src_s = src[sort_idx]
+        if agg == "first_value":
+            # default RANGE frame starts at the partition start
+            out_s = src_s[part_start]
+        else:
+            # Spark's famous default-frame gotcha: LAST_VALUE over
+            # RANGE … CURRENT ROW is the value at the current TIE
+            # block's end, not the partition end
+            last_of_block = (
+                np.r_[(p_s[1:] != p_s[:-1]) | (k_s[1:] != k_s[:-1]), True]
+                if n
+                else np.empty(0, bool)
+            )
+            out_s = src_s[_suffix_end(last_of_block, n)]
+    elif agg == "ntile":
+        k_tiles = int(e[1])
+        last_of_part = (
+            np.r_[p_s[1:] != p_s[:-1], True] if n else np.empty(0, bool)
+        )
+        part_end = _suffix_end(last_of_part, n)
+        size = part_end - part_start + 1
+        pos = np.arange(n) - part_start
+        q, r = size // k_tiles, size % k_tiles
+        # the first r tiles carry q+1 rows (SQL NTILE distribution)
+        cut = r * (q + 1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out_s = np.where(
+                q == 0,
+                pos + 1.0,
+                np.where(
+                    pos < cut,
+                    pos // np.maximum(q + 1, 1) + 1.0,
+                    r + (pos - cut) // np.maximum(q, 1) + 1.0,
+                ),
+            )
+    elif agg in ("lag", "lead"):
         # shift within partition along the window order; out-of-partition
         # offsets are NULL (Spark's default, no explicit default value)
         src = getcol(e[2])
@@ -1515,9 +1605,7 @@ def _window_column(
             if n
             else np.empty(0, bool)
         )
-        block_end = np.minimum.accumulate(
-            np.where(last_of_block, np.arange(n), n)[::-1]
-        )[::-1]
+        block_end = _suffix_end(last_of_block, n)
         run_sum, run_cnt = run_sum[block_end], run_cnt[block_end]
         if agg == "count":
             out_s = run_cnt

@@ -1338,3 +1338,46 @@ class TestWindowFunctions:
             "lag(los, 2) OVER (ORDER BY los) FROM wadm"
         )
         assert len(r.columns) == 2
+
+    def test_ntile_first_last_value(self, wt):
+        wt.register_table(
+            "wv",
+            ht.Table.from_dict(
+                {
+                    "h": np.array(["a"] * 5 + ["b"] * 3, object),
+                    "v": np.array([1.0, 2, 3, 4, 5, 10, 20, 30]),
+                }
+            ),
+        )
+        r = wt.sql(
+            "SELECT ntile(2) OVER (PARTITION BY h ORDER BY v) AS nt, "
+            "first_value(v) OVER (PARTITION BY h ORDER BY v) AS fv, "
+            "last_value(v) OVER (PARTITION BY h ORDER BY v) AS lv FROM wv"
+        )
+        # SQL NTILE: first (n mod k) tiles get the extra row
+        np.testing.assert_array_equal(r.column("nt"), [1, 1, 1, 2, 2, 1, 1, 2])
+        np.testing.assert_allclose(
+            r.column("fv"), [1, 1, 1, 1, 1, 10, 10, 10]
+        )
+        # default-frame LAST_VALUE = current row (no ties here) — the
+        # Spark RANGE..CURRENT ROW gotcha, faithfully reproduced
+        np.testing.assert_allclose(r.column("lv"), [1, 2, 3, 4, 5, 10, 20, 30])
+        # ties: both 6.0 rows in wadm share their block-end value
+        r2 = wt.sql(
+            "SELECT los, last_value(los) OVER (ORDER BY los) AS lv FROM wadm"
+        )
+        by = dict(zip(r2.column("los"), r2.column("lv")))
+        assert by[6.0] == 6.0 and by[1.0] == 1.0
+        with pytest.raises(ValueError, match="NTILE needs a positive"):
+            wt.sql("SELECT ntile(0) OVER (ORDER BY los) AS x FROM wadm")
+
+    def test_edge_values_without_order_by(self, wt):
+        r = wt.sql(
+            "SELECT h, first_value(los) OVER (PARTITION BY h) AS f, "
+            "last_value(los) OVER (PARTITION BY h) AS l FROM wadm"
+        )
+        # whole-partition frame in stable source order: a=(2,6,6), b=(9,1)
+        by = {}
+        for h, f, l in zip(r.column("h"), r.column("f"), r.column("l")):
+            by[h] = (f, l)
+        assert by["a"] == (2.0, 6.0) and by["b"] == (9.0, 1.0)
