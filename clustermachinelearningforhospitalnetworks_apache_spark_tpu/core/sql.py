@@ -37,7 +37,10 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                                          SQL three-valued logic (UNKNOWN
                                          propagates through AND/OR/NOT
                                          like Spark)
-      [GROUP BY cols | exprs]            aggs: COUNT(*) SUM AVG MIN MAX;
+      [GROUP BY cols | exprs]            aggs: COUNT(*) SUM AVG MIN MAX
+                                         MEDIAN PERCENTILE_APPROX(col,
+                                         p[, acc]) — exact percentile
+                                         (acc accepted, ignored);
                                          expression keys (GROUP BY CASE
                                          … END) match select items
                                          syntactically, Spark's rule
@@ -157,6 +160,8 @@ def _expr_has_window_fn(e) -> bool:
         return any(_expr_has_window_fn(a) for a in e[2])
     if k == "aggex":
         return _expr_has_window_fn(e[2])
+    if k == "pct":
+        return _expr_has_window_fn(e[1])
     return False
 
 
@@ -174,26 +179,27 @@ def _expr_has_agg(e) -> bool:
         return any(_expr_has_agg(v) for _, v in e[1]) or _expr_has_agg(e[2])
     if k == "fn":
         return any(_expr_has_agg(a) for a in e[2])
-    if k == "aggex":
+    if k in ("aggex", "pct"):
         return True
     return False
 
 
 def _lower_aggex(e, compute):
     """Replace ``("aggex", agg, inner)`` nodes (aggregates over arbitrary
-    expressions — ``sum(CASE WHEN … END)``) with sentinel ``("agg", key)``
-    atoms whose values ``compute(agg, inner_expr)`` produced against the
-    SOURCE rows; → (lowered expr, {sentinel: value}).  Lets every
-    aggregate-context evaluator keep its one name-based atom resolver."""
+    expressions — ``sum(CASE WHEN … END)``) and ``("pct", inner, p)``
+    percentile nodes with sentinel ``("agg", key)`` atoms whose values
+    ``compute(node)`` produced against the SOURCE rows; → (lowered expr,
+    {sentinel: value}).  Lets every aggregate-context evaluator keep its
+    one name-based atom resolver."""
     replaced: dict[str, Any] = {}
 
     def walk(node):
         if node is None:
             return None
         k = node[0]
-        if k == "aggex":
+        if k in ("aggex", "pct"):
             key = f"__aggex{len(replaced)}__"
-            replaced[key] = compute(node[1], node[2])
+            replaced[key] = compute(node)
             return ("agg", key)
         if k == "neg":
             return ("neg", walk(node[1]))
@@ -273,6 +279,8 @@ def _render_expr(e) -> str:
         return f"{e[1]}({e[2]})"
     if k == "aggex":
         return f"{e[1]}({_render_expr(e[2])})"
+    if k == "pct":
+        return f"percentile({_render_expr(e[1])}, {e[2]:g})"
     return f"({_render_expr(e[2])} {e[1]} {_render_expr(e[3])})"
 
 
@@ -709,6 +717,13 @@ class _Parser:
                 "the select list — compute it there (… AS alias) and "
                 "reference the alias here"
             )
+        if t[1].lower() in ("median", "percentile_approx") and (
+            self._peek() == ("op", "(")
+        ):
+            raise ValueError(
+                f"SQL: {t[1].upper()} is only supported in the select "
+                "list — alias the select item and reference the alias here"
+            )
         return self._qual_tail(t[1])
 
     def _qual_tail(self, first: str) -> str:
@@ -873,6 +888,24 @@ class _Parser:
                     offset = int(tok)
                 self._expect("op", ")")
                 return ("shiftfn", name.lower(), col, offset)
+            if name.lower() in ("percentile_approx", "median") and (
+                self._accept("op", "(")
+            ):
+                inner = self._expr()
+                if name.lower() == "median":
+                    p = 0.5
+                else:
+                    self._expect("op", ",")
+                    p = float(self._expect("num")[1])
+                    if not 0.0 <= p <= 1.0:
+                        raise ValueError(
+                            f"SQL: percentile must be in [0, 1], got {p}"
+                        )
+                    if self._accept("op", ","):
+                        self._expect("num")  # Spark's accuracy arg: ignored
+                        # (this engine computes the EXACT percentile)
+                self._expect("op", ")")
+                return ("pct", inner, p)
             if name.lower() in _SCALAR_FUNCS and self._accept("op", "("):
                 args = [self._expr()]
                 while self._accept("op", ","):
@@ -1288,6 +1321,28 @@ def _aggregate(vals: np.ndarray, agg: str) -> Any:
     return f(ok.astype(np.float64) if np.issubdtype(ok.dtype, np.number) else ok)
 
 
+def _require_pct_numeric(vals: np.ndarray) -> None:
+    if vals.dtype.kind in "USOMm":
+        raise ValueError(
+            "SQL: MEDIAN/PERCENTILE_APPROX expects a numeric column"
+        )
+
+
+def _grouped_percentile(src: np.ndarray, p: float, starts, order_idx):
+    """Per-group EXACT percentile (Spark's percentile_approx is an
+    approximation; exact is a conservative superset at these scales) —
+    a per-group loop over sorted slices (group count ≪ rows)."""
+    _require_pct_numeric(src)
+    s = src[order_idx]
+    bounds = np.r_[starts, len(s)]
+    out = np.empty(len(starts), np.float64)
+    for i in range(len(starts)):
+        seg = s[bounds[i]:bounds[i + 1]]
+        seg = seg[~_null_mask(seg)]
+        out[i] = float(np.percentile(seg, p * 100.0)) if seg.size else np.nan
+    return out
+
+
 def _grouped_aggregate(src: np.ndarray, agg: str, starts, order_idx):
     """Per-group aggregate via one sort + ``ufunc.reduceat`` — O(n), not
     O(groups × n) boolean scans.  Null (NaN/NaT) entries are skipped,
@@ -1637,7 +1692,11 @@ def _resolve_source(ref, resolve_table) -> Table:
         if isinstance(ref, _Union)
         else _execute_query(ref, resolve_table)
     )
-    renames = {c: c.split(".")[-1] for c in t.columns}
+    # expression-derived names ("percentile(v, 0.5)") may contain dots
+    # that are NOT qualifiers — only plain identifier columns strip
+    renames = {
+        c: (c.split(".")[-1] if "(" not in c else c) for c in t.columns
+    }
     if len(set(renames.values())) != len(renames):
         dup = [b for b in set(renames.values())
                if sum(1 for v in renames.values() if v == b) > 1][0]
@@ -1929,13 +1988,18 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
                 return _grouped_aggregate(getcol(c), agg, starts, order_idx)
             return getcol(name)[first_row]
 
-        def grouped_aggex(agg: str, inner) -> np.ndarray:
+        def grouped_aggex(node) -> np.ndarray:
             # aggregate over an arbitrary row expression: evaluate the
             # inner expr against SOURCE rows, then the usual reduceat
+            # (or the per-group percentile loop for "pct" nodes)
+            inner = node[1] if node[0] == "pct" else node[2]
             vals = _eval_expr(getcol, inner)
             if np.ndim(vals) == 0:
                 vals = np.full(len(t), vals)
-            return _grouped_aggregate(np.asarray(vals), agg, starts, order_idx)
+            vals = np.asarray(vals)
+            if node[0] == "pct":
+                return _grouped_percentile(vals, node[2], starts, order_idx)
+            return _grouped_aggregate(vals, node[1], starts, order_idx)
 
         cols: dict[str, Any] = {}
         for it in items:
@@ -2048,11 +2112,20 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
             # projection path; arithmetic contexts promote as needed
             return len(t) if c == "*" else _aggregate(getcol(c), agg)
 
-        def scalar_aggex(agg: str, inner):
+        def scalar_aggex(node):
+            inner = node[1] if node[0] == "pct" else node[2]
             vals = _eval_expr(getcol, inner)
             if np.ndim(vals) == 0:
                 vals = np.full(len(t), vals)
-            return _aggregate(np.asarray(vals), agg)
+            vals = np.asarray(vals)
+            if node[0] == "pct":
+                _require_pct_numeric(vals)
+                ok = vals[~_null_mask(vals)]
+                return (
+                    float(np.percentile(ok, node[2] * 100.0))
+                    if ok.size else np.nan
+                )
+            return _aggregate(vals, node[1])
 
         out_cols: dict[str, Any] = {}
         for it in items:

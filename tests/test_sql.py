@@ -1381,3 +1381,56 @@ class TestWindowFunctions:
         for h, f, l in zip(r.column("h"), r.column("f"), r.column("l")):
             by[h] = (f, l)
         assert by["a"] == (2.0, 6.0) and by["b"] == (9.0, 1.0)
+
+
+# ------------------------------------------------ percentile aggregates
+class TestPercentiles:
+    @pytest.fixture
+    def pt(self, session):
+        session.register_table(
+            "pv",
+            ht.Table.from_dict(
+                {
+                    "h": np.array(["a"] * 5 + ["b"] * 4, object),
+                    "v": np.array([1.0, 2, 3, 4, 100, 10, 20, np.nan, 30]),
+                }
+            ),
+        )
+        return session
+
+    def test_whole_table_median_and_percentile(self, pt):
+        r = pt.sql(
+            "SELECT median(v) AS m, percentile_approx(v, 0.9) AS p90 FROM pv"
+        )
+        assert r.column("m")[0] == pytest.approx(7.0)   # (4+10)/2, nan skipped
+        assert r.column("p90")[0] == pytest.approx(51.0)
+
+    def test_grouped_percentiles_skip_nulls(self, pt):
+        r = pt.sql(
+            "SELECT h, median(v) AS m, percentile_approx(v, 0.25, 100) AS q1 "
+            "FROM pv GROUP BY h ORDER BY h"
+        )
+        np.testing.assert_allclose(r.column("m"), [3.0, 20.0])
+        np.testing.assert_allclose(r.column("q1"), [2.0, 15.0])
+
+    def test_percentile_over_expression_and_bounds(self, pt):
+        r = pt.sql("SELECT median(v * 2) AS m2 FROM pv")
+        assert r.column("m2")[0] == pytest.approx(14.0)
+        with pytest.raises(ValueError, match="must be in \\[0, 1\\]"):
+            pt.sql("SELECT percentile_approx(v, 1.5) AS x FROM pv")
+
+    def test_percentile_guards_and_subquery_naming(self, pt):
+        with pytest.raises(ValueError, match="expects a numeric"):
+            pt.sql("SELECT median(h) AS m FROM pv")
+        with pytest.raises(ValueError, match="only supported in the select"):
+            pt.sql("SELECT h, median(v) AS m FROM pv GROUP BY h "
+                   "HAVING median(v) > 5")
+        # dotted default names survive the subquery boundary intact
+        r = pt.sql("SELECT * FROM (SELECT median(v) FROM pv) s")
+        assert list(r.columns) == ["percentile(v, 0.5)"]
+        # and HAVING via the alias works
+        r2 = pt.sql(
+            "SELECT h, median(v) AS m FROM pv GROUP BY h HAVING m > 5 "
+            "ORDER BY h"
+        )
+        assert list(r2.column("h")) == ["b"]
