@@ -177,6 +177,81 @@ class Table:
         vals, counts = np.unique(self.columns[column], return_counts=True)
         return dict(zip(vals.tolist(), counts.tolist()))
 
+    # ----------------------------------------------------- interactive
+    def show(self, n: int = 20, truncate: int = 20) -> None:
+        """Spark's ``df.show()``: print the first ``n`` rows as an
+        ASCII-boxed table, string cells truncated to ``truncate`` chars
+        (pass 0 to disable truncation)."""
+        names = list(self.columns)
+
+        def fmt(v) -> str:
+            if (
+                v is None
+                or (isinstance(v, float) and np.isnan(v))
+                or (isinstance(v, (np.datetime64, np.timedelta64)) and np.isnat(v))
+            ):
+                return "NULL"
+            s = f"{v:.6g}" if isinstance(v, (float, np.floating)) else str(v)
+            if truncate and len(s) > truncate:
+                # Spark: ellipsis only when there is room for it
+                s = s[:truncate] if truncate < 4 else s[: truncate - 3] + "..."
+            return s
+
+        rows = [
+            [fmt(self.columns[c][i]) for c in names]
+            for i in range(min(n, len(self)))
+        ]
+        widths = [
+            max(len(c), *(len(r[j]) for r in rows)) if rows else len(c)
+            for j, c in enumerate(names)
+        ]
+        bar = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(bar)
+        print(
+            "|" + "|".join(f" {c:<{w}} " for c, w in zip(names, widths)) + "|"
+        )
+        print(bar)
+        for r in rows:
+            print(
+                "|" + "|".join(f" {v:<{w}} " for v, w in zip(r, widths)) + "|"
+            )
+        print(bar)
+        if len(self) > n:
+            print(f"only showing top {n} rows")
+
+    def describe(self, *cols: str) -> "Table":
+        """Spark's ``df.describe()``: count / mean / stddev / min / max
+        per numeric column (all numeric columns when none named),
+        returned as a Table whose first column is ``summary``."""
+        names = list(cols) if cols else self.schema.numeric_names()
+        # one copy of the non-numeric check (0-row slice skips the
+        # matrix materialization)
+        self.limit(0).numeric_matrix(names)
+        if "summary" in names:
+            raise ValueError(
+                "describe() reserves the output column name 'summary' — "
+                "rename that column first"
+            )
+        out: dict[str, Any] = {
+            "summary": np.asarray(
+                ["count", "mean", "stddev", "min", "max"], dtype=object
+            )
+        }
+        for c in names:
+            v = self.columns[c].astype(np.float64)
+            ok = v[~np.isnan(v)]
+            if ok.size:
+                # Spark reports the SAMPLE stddev (ddof=1; NaN for n=1)
+                sd = float(np.std(ok, ddof=1)) if ok.size > 1 else np.nan
+                stats = [
+                    float(ok.size), float(ok.mean()), sd,
+                    float(ok.min()), float(ok.max()),
+                ]
+            else:
+                stats = [0.0, np.nan, np.nan, np.nan, np.nan]
+            out[c] = np.asarray(stats)
+        return Table.from_dict(out)
+
     # ------------------------------------------------------- conversion
     def to_pandas(self):
         """``toPandas`` analogue (reference :204)."""

@@ -179,3 +179,53 @@ def test_libsvm_roundtrip_and_validation(tmp_path, mesh8):
     assert xz.shape == (2, 4) and xz[0, 0] == 5.0 and yz.tolist() == [2.0, 1.0]
     with pytest.raises(ValueError, match="exceeds n_features"):
         ht.read_libsvm(str(ok0), n_features=2, zero_based=True)
+
+
+# -------------------------------------------------- show() / describe()
+def test_table_describe_spark_semantics():
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+    t = ht.Table.from_dict(
+        {
+            "h": np.array(["a", "b", "c"], object),
+            "v": np.array([1.5, np.nan, 3.0]),
+            "w": np.array([2.0, 4.0, 6.0]),
+        }
+    )
+    d = t.describe()
+    assert list(d.column("summary")) == ["count", "mean", "stddev", "min", "max"]
+    np.testing.assert_allclose(
+        d.column("v"), [2, 2.25, np.std([1.5, 3.0], ddof=1), 1.5, 3.0]
+    )
+    np.testing.assert_allclose(d.column("w")[0:2], [3, 4.0])
+    # named subset + non-numeric rejection
+    d2 = t.describe("w")
+    assert set(d2.columns) == {"summary", "w"}
+    with pytest.raises(TypeError, match="not numeric"):
+        t.describe("h")
+
+
+def test_table_show_smoke(capsys):
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+    t = ht.Table.from_dict({"x": np.arange(30).astype(np.float64)})
+    t.show(3)
+    out = capsys.readouterr().out
+    assert "only showing top 3 rows" in out and "| x" in out
+
+
+def test_describe_show_edge_cases(capsys):
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+    with pytest.raises(ValueError, match="reserves the output column"):
+        ht.Table.from_dict({"summary": np.array([1.0, 2.0])}).describe()
+    t = ht.Table.from_dict(
+        {
+            "s": np.array(["abcdefghij"], object),
+            "ts": np.array(["NaT"], dtype="datetime64[ns]"),
+        }
+    )
+    t.show(truncate=2)
+    out = capsys.readouterr().out
+    assert "ab " in out and "abcdefghi" not in out  # hard cut, no ellipsis
+    assert "NULL" in out and "NaT" not in out       # NaT renders as NULL
