@@ -166,6 +166,41 @@ class Table:
             end = np.datetime64(end)
         return self.mask((v >= start) & (v <= end))
 
+    def sample(self, fraction: float, seed: int = 0) -> "Table":
+        """Spark's ``df.sample(fraction, seed)``: per-row Bernoulli
+        draw (row count varies around n·fraction, like Spark's)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        keep = np.random.default_rng(seed).random(len(self)) < fraction
+        return self.mask(keep)
+
+    def drop(self, *names: str) -> "Table":
+        """Spark's ``df.drop``: remove columns (unknown names ignored,
+        Spark semantics)."""
+        gone = set(names)
+        return self.select([c for c in self.columns if c not in gone])
+
+    def with_column_renamed(self, existing: str, new: str) -> "Table":
+        """Spark's ``withColumnRenamed`` (no-op when ``existing`` is
+        absent, like Spark) — except a rename ONTO an existing column
+        raises here (Spark silently produces duplicate columns, which
+        this Table cannot represent)."""
+        if existing not in self.columns:
+            return self
+        if new in self.columns and new != existing:
+            raise ValueError(
+                f"cannot rename {existing!r} to {new!r}: a column named "
+                f"{new!r} already exists"
+            )
+        fields = [
+            Field(new, f.dtype, f.nullable) if f.name == existing else f
+            for f in self.schema.fields
+        ]
+        return Table(
+            Schema(fields),
+            {(new if k == existing else k): v for k, v in self.columns.items()},
+        )
+
     def sort_by(self, column: str) -> "Table":
         order = np.argsort(self.columns[column], kind="stable")
         return self.mask(order)

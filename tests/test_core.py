@@ -229,3 +229,32 @@ def test_describe_show_edge_cases(capsys):
     out = capsys.readouterr().out
     assert "ab " in out and "abcdefghi" not in out  # hard cut, no ellipsis
     assert "NULL" in out and "NaT" not in out       # NaT renders as NULL
+
+
+def test_table_sample_drop_rename():
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+    t = ht.Table.from_dict(
+        {"a": np.arange(1000).astype(np.float64), "b": np.ones(1000)}
+    )
+    s = t.sample(0.3, seed=1)
+    assert 200 < len(s) < 400                    # Bernoulli around 300
+    np.testing.assert_array_equal(
+        s.column("a"), t.sample(0.3, seed=1).column("a")  # seeded = stable
+    )
+    with pytest.raises(ValueError, match="fraction"):
+        t.sample(1.5)
+    d = t.drop("b", "nonexistent")
+    assert list(d.columns) == ["a"]
+    r = t.with_column_renamed("a", "alpha")
+    assert list(r.columns) == ["alpha", "b"]
+    assert r.schema.field("alpha").dtype == t.schema.field("a").dtype
+    assert t.with_column_renamed("zzz", "x") is t  # absent = no-op
+
+
+def test_rename_collision_raises():
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+
+    t = ht.Table.from_dict({"a": np.ones(3), "b": np.zeros(3)})
+    with pytest.raises(ValueError, match="already exists"):
+        t.with_column_renamed("a", "b")
