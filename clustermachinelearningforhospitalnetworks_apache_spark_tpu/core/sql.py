@@ -25,8 +25,10 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
       FROM t [[AS] a] | ( <select …> ) a   (derived tables, also on the
                                             JOIN right side; inner
                                             ORDER BY/LIMIT = top-N)
-      [[INNER|LEFT] JOIN t2 [[AS] b] ON a.key = b.key]   (single-key
-                                         equi-join, vectorized hash join)
+      [[INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]] JOIN t2 [[AS] b]
+       ON a.key = b.key]                 (single-key equi-join,
+                                         vectorized hash join; outer
+                                         sides null-fill)
       [WHERE <pred> {AND|OR} ...]        predicates: = != <> < <= > >=,
                                          BETWEEN 'a' AND 'b', IS [NOT]
                                          NULL, [NOT] IN (v, …), [NOT]
@@ -90,6 +92,8 @@ _KEYWORDS = {
     "select", "from", "where", "group", "by", "order", "limit",
     "and", "or", "between", "as", "asc", "desc",
     "distinct", "join", "inner", "left", "on", "having",
+    # right/full/outer stay NON-reserved (Spark parity: legal as column
+    # names) — the join grammar consumes them contextually
     "case", "when", "then", "else", "end",
     "not", "is", "null", "in",
     "union", "all", "intersect", "except",
@@ -525,6 +529,32 @@ class _Parser:
     def _peek(self):
         return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
 
+    def _peek_at(self, k: int):
+        j = self.i + k
+        return self.toks[j] if j < len(self.toks) else ("eof", "")
+
+    def _starts_join_clause(self) -> bool:
+        """True when the CURRENT name token begins ``RIGHT|FULL [OUTER]
+        JOIN`` — so ``FROM t RIGHT JOIN u`` doesn't eat RIGHT as t's
+        alias (LEFT/INNER are reserved keywords and need no lookahead)."""
+        t = self._peek()
+        if t[0] != "name" or t[1].lower() not in ("right", "full"):
+            return False
+        nxt = self._peek_at(1)
+        return nxt == ("kw", "join") or (
+            nxt[0] == "name" and nxt[1].lower() == "outer"
+        )
+
+    def _accept_word(self, word: str) -> bool:
+        """Consume a NON-reserved word used contextually (RIGHT/FULL/
+        OUTER in join clauses) — it tokenizes as a name, staying legal
+        as a column identifier everywhere else."""
+        t = self._peek()
+        if t[0] == "name" and t[1].lower() == word:
+            self.i += 1
+            return True
+        return False
+
     def _next(self):
         t = self._peek()
         self.i += 1
@@ -630,8 +660,17 @@ class _Parser:
                 self._expect("kw", "join")
                 kind = "inner"
             elif self._accept("kw", "left"):
+                self._accept_word("outer")  # LEFT OUTER JOIN synonym
                 self._expect("kw", "join")
                 kind = "left"
+            elif self._accept_word("right"):
+                self._accept_word("outer")
+                self._expect("kw", "join")
+                kind = "right"
+            elif self._accept_word("full"):
+                self._accept_word("outer")
+                self._expect("kw", "join")
+                kind = "full"
             else:
                 break
             right = self._table_ref()
@@ -689,7 +728,7 @@ class _Parser:
         alias = name
         if self._accept("kw", "as"):
             alias = self._expect("name")[1]
-        elif self._peek()[0] == "name":
+        elif self._peek()[0] == "name" and not self._starts_join_clause():
             alias = self._next()[1]
         return name, alias
 
@@ -1225,13 +1264,28 @@ def _equi_join(
 
     cnt_full = np.zeros(len(lk), np.int64)
     cnt_full[lv] = cnt
-    out_cnt = np.maximum(cnt_full, 1) if kind == "left" else cnt_full
+    # which LEFT rows survive unmatched: left + full keep them
+    out_cnt = (
+        np.maximum(cnt_full, 1) if kind in ("left", "full") else cnt_full
+    )
     li = np.repeat(np.arange(len(lk)), out_cnt)
     total = int(out_cnt.sum())
     ri = np.full(total, -1, np.int64)
     ri[np.repeat(cnt_full > 0, out_cnt)] = ri_matched
 
-    cols: dict[str, Any] = {c: lt.column(c)[li] for c in lt.columns}
+    if kind in ("right", "full"):
+        # append unmatched RIGHT rows with null left columns (null right
+        # keys are unmatched by definition — SQL outer-join semantics)
+        matched_right = np.zeros(len(rk), bool)
+        matched_right[ri_matched] = True
+        extra = np.flatnonzero(~matched_right)
+        li = np.concatenate([li, np.full(len(extra), -1, np.int64)])
+        ri = np.concatenate([ri, extra])
+        cols: dict[str, Any] = {
+            c: _null_fill_take(lt.column(c), li) for c in lt.columns
+        }
+    else:
+        cols = {c: lt.column(c)[li] for c in lt.columns}
     for c in rt.columns:
         cols[f"{r_alias}.{c}"] = _null_fill_take(rt.column(c), ri)
     return Table.from_dict(cols)

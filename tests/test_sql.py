@@ -1434,3 +1434,71 @@ class TestPercentiles:
             "ORDER BY h"
         )
         assert list(r2.column("h")) == ["b"]
+
+
+# ------------------------------------------------ RIGHT / FULL OUTER JOIN
+class TestOuterJoins:
+    @pytest.fixture
+    def jt(self, session):
+        session.register_table(
+            "ja",
+            ht.Table.from_dict(
+                {"k": np.array(["x", "y", "z"], object),
+                 "va": np.array([1.0, 2, 3])}
+            ),
+        )
+        session.register_table(
+            "jb",
+            ht.Table.from_dict(
+                {"k": np.array(["y", "z", "w"], object),
+                 "vb": np.array([20.0, 30, 40])}
+            ),
+        )
+        return session
+
+    def test_right_join(self, jt):
+        r = jt.sql(
+            "SELECT a.k, va, vb FROM ja a RIGHT JOIN jb b ON a.k = b.k "
+            "ORDER BY vb"
+        )
+        assert list(r.column("k")) == ["y", "z", None]
+        np.testing.assert_allclose(r.column("va"), [2, 3, np.nan])
+        np.testing.assert_allclose(r.column("vb"), [20, 30, 40])
+
+    def test_full_outer_join(self, jt):
+        r = jt.sql("SELECT va, vb FROM ja FULL OUTER JOIN jb ON ja.k = jb.k")
+        assert len(r) == 4
+        np.testing.assert_allclose(sorted(r.column("va")[~np.isnan(r.column("va"))]), [1, 2, 3])
+        np.testing.assert_allclose(sorted(r.column("vb")[~np.isnan(r.column("vb"))]), [20, 30, 40])
+        assert np.isnan(r.column("va")).sum() == 1
+        assert np.isnan(r.column("vb")).sum() == 1
+
+    def test_left_outer_synonym_and_null_keys(self, jt):
+        r = jt.sql("SELECT va, vb FROM ja LEFT OUTER JOIN jb ON ja.k = jb.k")
+        np.testing.assert_allclose(r.column("va"), [1, 2, 3])
+        # null keys never match in outer joins either
+        jt.register_table(
+            "jn",
+            ht.Table.from_dict(
+                {"k": np.array([None, "y"], object), "vn": np.array([7.0, 8])}
+            ),
+        )
+        r2 = jt.sql("SELECT vn, vb FROM jn FULL OUTER JOIN jb ON jn.k = jb.k")
+        # null-key left row survives unmatched; y matches; z+w unmatched
+        assert len(r2) == 4
+        m = ~np.isnan(r2.column("vn")) & ~np.isnan(r2.column("vb"))
+        assert m.sum() == 1  # only the y row pairs
+
+    def test_right_full_stay_legal_identifiers(self, jt):
+        # right/full/outer are NON-reserved (Spark parity)
+        jt.register_table(
+            "idt",
+            ht.Table.from_dict(
+                {"full": np.array([1.0, 2.0]), "outer": np.array([3.0, 4.0])}
+            ),
+        )
+        r = jt.sql("SELECT full, outer FROM idt WHERE full > 1")
+        np.testing.assert_allclose(r.column("full"), [2.0])
+        # and FROM t RIGHT JOIN still parses as a join, not alias 'right'
+        r2 = jt.sql("SELECT vb FROM ja RIGHT OUTER JOIN jb ON ja.k = jb.k")
+        assert len(r2) == 3
