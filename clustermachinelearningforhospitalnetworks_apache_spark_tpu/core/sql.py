@@ -26,7 +26,7 @@ Catalyst, no codegen; d ≪ n tabular queries are host-side column sweeps:
                                             JOIN right side; inner
                                             ORDER BY/LIMIT = top-N)
       [[INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]] JOIN t2 [[AS] b]
-       ON a.key = b.key]                 (single-key equi-join,
+       ON a.key = b.key | CROSS JOIN t2] (single-key equi-join,
                                          vectorized hash join; outer
                                          sides null-fill)
       [WHERE <pred> {AND|OR} ...]        predicates: = != <> < <= > >=,
@@ -535,10 +535,11 @@ class _Parser:
 
     def _starts_join_clause(self) -> bool:
         """True when the CURRENT name token begins ``RIGHT|FULL [OUTER]
-        JOIN`` — so ``FROM t RIGHT JOIN u`` doesn't eat RIGHT as t's
-        alias (LEFT/INNER are reserved keywords and need no lookahead)."""
+        JOIN`` / ``CROSS JOIN`` — so ``FROM t RIGHT JOIN u`` doesn't eat
+        RIGHT as t's alias (LEFT/INNER are reserved keywords and need no
+        lookahead)."""
         t = self._peek()
-        if t[0] != "name" or t[1].lower() not in ("right", "full"):
+        if t[0] != "name" or t[1].lower() not in ("right", "full", "cross"):
             return False
         nxt = self._peek_at(1)
         return nxt == ("kw", "join") or (
@@ -671,6 +672,10 @@ class _Parser:
                 self._accept_word("outer")
                 self._expect("kw", "join")
                 kind = "full"
+            elif self._accept_word("cross"):
+                self._expect("kw", "join")
+                joins.append(("cross", self._table_ref(), None, None))
+                continue
             else:
                 break
             right = self._table_ref()
@@ -719,7 +724,7 @@ class _Parser:
             alias = None
             if self._accept("kw", "as"):
                 alias = self._expect("name")[1]
-            elif self._peek()[0] == "name":
+            elif self._peek()[0] == "name" and not self._starts_join_clause():
                 alias = self._next()[1]
             if alias is None:
                 raise ValueError("SQL: a FROM subquery needs an alias")
@@ -1863,6 +1868,21 @@ def _execute_query(q: "_Query", resolve_table) -> Table:
             if r_alias in aliases:
                 raise ValueError(f"SQL: duplicate table alias {r_alias!r}")
             rt = _resolve_source(r_name, resolve_table)
+            if kind == "cross":
+                n_l, n_r = len(t), len(rt)
+                li = np.repeat(np.arange(n_l), n_r)
+                ri = np.tile(np.arange(n_r), n_l)
+                t = Table.from_dict(
+                    {
+                        **{c: t.column(c)[li] for c in t.columns},
+                        **{
+                            f"{r_alias}.{c}": rt.column(c)[ri]
+                            for c in rt.columns
+                        },
+                    }
+                )
+                aliases.add(r_alias)
+                continue
 
             def right_col(name: str):
                 """Resolve a key reference against the NEW right table."""

@@ -1502,3 +1502,29 @@ class TestOuterJoins:
         # and FROM t RIGHT JOIN still parses as a join, not alias 'right'
         r2 = jt.sql("SELECT vb FROM ja RIGHT OUTER JOIN jb ON ja.k = jb.k")
         assert len(r2) == 3
+
+    def test_cross_join(self, jt):
+        r = jt.sql(
+            "SELECT ja.k, jb.k AS k2 FROM ja CROSS JOIN jb ORDER BY ja.k"
+        )
+        assert len(r) == 9
+        assert list(r.column("k"))[:3] == ["x", "x", "x"]
+        # 'cross' stays a legal identifier
+        jt.register_table(
+            "ct", ht.Table.from_dict({"cross": np.array([1.0, 2.0])})
+        )
+        np.testing.assert_allclose(
+            jt.sql("SELECT cross FROM ct").column("cross"), [1, 2]
+        )
+
+    def test_outer_join_after_derived_table(self, jt):
+        # RIGHT after a FROM-subquery must be a join, not the alias
+        # (an unaliased subquery then raises, pointing at the real fix)
+        with pytest.raises(ValueError, match="needs an alias"):
+            jt.sql("SELECT vb FROM (SELECT k FROM ja) RIGHT JOIN jb "
+                   "ON k = jb.k")
+        r = jt.sql(
+            "SELECT vb FROM (SELECT k FROM ja) s RIGHT JOIN jb "
+            "ON s.k = jb.k ORDER BY vb"
+        )
+        np.testing.assert_allclose(r.column("vb"), [20, 30, 40])
