@@ -99,7 +99,15 @@ def _apply_forced_platform() -> None:
 
         jax.config.update("jax_platforms", forced)
         if forced == "cpu":
-            jax.config.update("jax_num_cpu_devices", 8)
+            try:
+                jax.config.update("jax_num_cpu_devices", 8)
+            except AttributeError:  # jax 0.4.x: flag route (backend is
+                # not yet initialized this early in a child process)
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "host_platform_device_count" not in flags:
+                    os.environ["XLA_FLAGS"] = (
+                        flags + " --xla_force_host_platform_device_count=8"
+                    ).strip()
 
 
 def _bench_setup(default_rows: int, default_iters: int = 10):
@@ -1027,6 +1035,114 @@ def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
     }
 
 
+def _bench_serve() -> dict:
+    """Serving config: the ``serve/`` subsystem end to end — adaptive
+    micro-batching + shape-bucketed jit executables under concurrent
+    client load, plus the mesh-sharded bulk-scoring path.
+
+    Reports sustained ONLINE predictions/sec (single serving device — the
+    latency path doesn't shard a 16-row batch over 8 chips) and the
+    SHARDED bulk rate per chip, with p50/p99 latency, batch-fill ratio,
+    and the recompile counter after warmup across ≥3 distinct request
+    batch sizes (the zero-recompile acceptance gate).  ``vs_baseline`` is
+    the batching win: server rate vs an unbatched per-request predict
+    loop on the same model."""
+    import threading
+
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        LinearRegression,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+        ShardedScorer,
+    )
+
+    platform, on_tpu, n, _, mesh, n_chips = _bench_setup(2_000_000)
+    d = 8
+    n_train = min(n, 200_000)
+    rng = np.random.default_rng(0)
+    x = _make_data(n_train, d, 8)
+    y = (x @ rng.normal(size=(d,)).astype(np.float32)).astype(np.float32)
+    model = LinearRegression().fit((x, y))
+    prior = float(np.mean(y))
+
+    duration = float(os.environ.get("BENCH_SERVE_SECONDS", 5.0 if on_tpu else 3.0))
+    request_sizes = (1, 7, 32)  # ≥3 distinct sizes, none bucket-aligned
+    buckets = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+    srv = InferenceServer(max_queue_rows=8192)
+    srv.add_model(
+        "los", model, buckets=buckets,
+        fallback=lambda rows: np.full(rows.shape[0], prior, np.float32),
+    )
+    with srv:  # start() warms every bucket before workers accept traffic
+        recompiles0 = srv.metrics.recompile_count
+        served = [0] * 6  # one slot per client thread
+        stop = threading.Event()
+
+        def client(i: int, size: int) -> None:
+            j = 0
+            while not stop.is_set():
+                r = srv.predict("los", x[(j * size) % (n_train - size) :][:size])
+                if r.ok:
+                    served[i] += size
+                j += 1
+
+        threads = [
+            threading.Thread(target=client, args=(i, request_sizes[i % 3]), daemon=True)
+            for i in range(6)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration)
+        stop.set()
+        for t in threads:
+            t.join(5.0)
+        dt = time.perf_counter() - t0
+        online_rps = sum(served) / dt
+        snap = srv.metrics.snapshot()
+        recompiles = srv.metrics.recompile_count - recompiles0
+
+    # unbatched denominator: one synchronous single-row predict at a time
+    # (what serving without the batcher would do)
+    base = srv.registry.get("los")
+    naive_n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < min(1.0, duration):
+        base.predict_bucketed(x[naive_n % n_train][None, :])
+        naive_n += 1
+    naive_rps = naive_n / (time.perf_counter() - t0)
+
+    # sharded bulk path: all chips, one canonical chunk executable
+    bulk_rows = min(n, 1_000_000)
+    scorer = ShardedScorer(model, mesh=mesh, chunk_rows=131_072).warmup()
+    t0 = time.perf_counter()
+    _ = scorer.score(x[np.arange(bulk_rows) % n_train])
+    bulk_rps = bulk_rows / (time.perf_counter() - t0)
+
+    return {
+        "metric": (
+            f"serve online sustained predictions/sec (LinearRegression d={d}, "
+            f"buckets≤{buckets[-1]}, sizes {list(request_sizes)}, {platform})"
+        ),
+        "value": round(online_rps, 1),
+        "unit": "predictions/sec",
+        "vs_baseline": round(online_rps / naive_rps, 2),
+        "latency_p50_ms": snap.get("latency_p50_ms"),
+        "latency_p99_ms": snap.get("latency_p99_ms"),
+        "batch_fill_ratio": snap.get("batch_fill_ratio"),
+        "recompiles_after_warmup": recompiles,
+        "warmup_compiles": snap.get("warmup_compiles"),
+        "request_sizes": list(request_sizes),
+        "unbatched_rps": round(naive_rps, 1),
+        "bulk_sharded_rps_per_chip": round(bulk_rps / n_chips, 1),
+        "platform": platform,
+    }
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -1039,6 +1155,7 @@ CONFIGS = {
     "gbt20": lambda: _bench_gbt(20, 3),                         # boosted rounds
     "nb": lambda: _bench_naive_bayes(8),                        # stats pass
     "pallas_ab": lambda: _bench_pallas_ab(64, 64),              # win-or-retire A/B
+    "serve": lambda: _bench_serve(),                            # online inference
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets
@@ -1263,7 +1380,7 @@ def _child_main(name: str) -> None:
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
     "kmeans256", "pallas_ab", "rf20", "gbt20", "nb",
-    "gmm32", "bisecting", "streaming", "kmeans8",
+    "gmm32", "bisecting", "streaming", "kmeans8", "serve",
 ]
 
 
@@ -1340,8 +1457,11 @@ def main() -> None:
         cenv["BENCH_CHILD_BUDGET"] = str(budget)
         return _run_config_watchdogged(key, cenv, budget)
 
+    all_rows: list[dict] = []
+
     def emit(rows: list[dict]) -> None:
         for obj in rows:
+            all_rows.append(obj)
             print(json.dumps(obj), flush=True)
 
     def good(rows: list[dict]) -> bool:
@@ -1432,23 +1552,200 @@ def main() -> None:
             for key in names:
                 emit(tpu_rows.get(key, []) + cpu_rows.get(key, []))
 
-    print(
-        json.dumps(
+    # ---- final line: COMPACT single-line JSON (driver tail-capture is
+    # 2 KB; r05's verbose bench_meta overflowed it and parsed as null).
+    # The verbose evidence (probe transcript, session history, Spark-
+    # denominator attempt) moves to a jsonl sidecar under tools/.
+    verbose = {
+        "platform": platform,
+        "probe": reason,
+        "probe_attempts": _PROBE_LOG,
+        "session_probe_history": _session_probe_history(),
+        "spark_denominator": _spark_denominator_attempt(max(remaining(), 0.0)),
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+        "rows": all_rows,
+    }
+    sidecar = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "bench_meta_history.jsonl",
+    )
+    try:
+        with open(sidecar, "a") as f:
+            f.write(json.dumps(verbose) + "\n")
+        sidecar_note = sidecar
+    except OSError as e:
+        sidecar_note = f"unwritable: {e}"
+    good_rows = [r for r in all_rows if "error" not in r]
+    headline = good_rows[0] if good_rows else None
+    cache_dir = env.get("BENCH_CACHE_DIR", "")
+    meta = {
+        "metric": "bench_meta",
+        "platform": platform,
+        "probe": reason[:200],
+        "headline": None if headline is None else {
+            k: headline.get(k)
+            for k in ("metric", "value", "unit", "vs_baseline")
+        },
+        "configs_ok": len(good_rows),
+        "configs_err": len(all_rows) - len(good_rows),
+        "cache": {
+            "data_cache_dir": cache_dir,
+            "data_cache_entries": (
+                len(os.listdir(cache_dir))
+                if cache_dir and os.path.isdir(cache_dir) else 0
+            ),
+        },
+        "probe_attempts": len(_PROBE_LOG),
+        "sidecar": sidecar_note,
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+    line = json.dumps(meta)
+    if len(line) > 2000:  # hard driver budget — drop detail, keep headline
+        meta.pop("cache", None)
+        meta["probe"] = meta["probe"][:60]
+        meta["sidecar"] = str(meta["sidecar"])[:80]
+        if meta.get("headline") and isinstance(meta["headline"], dict):
+            meta["headline"]["metric"] = str(meta["headline"]["metric"])[:120]
+        line = json.dumps(meta)
+    if len(line) > 2000:
+        # last resort stays VALID JSON — a mid-token slice would parse as
+        # null, the exact r05 failure this line exists to fix
+        line = json.dumps(
             {
                 "metric": "bench_meta",
-                "platform": platform,
-                "probe": reason,
-                "probe_attempts": _PROBE_LOG,
-                "session_probe_history": _session_probe_history(),
-                "spark_denominator": _spark_denominator_attempt(
-                    max(remaining(), 0.0)
-                ),
+                "platform": str(platform)[:40],
+                "configs_ok": len(good_rows),
+                "configs_err": len(all_rows) - len(good_rows),
                 "elapsed_s": round(time.perf_counter() - t_start, 1),
             }
-        ),
-        flush=True,
+        )
+    print(line, flush=True)
+
+
+def _foreign_bench_running() -> bool:
+    """A DRIVER-initiated ``python bench.py`` (not this watcher, not its
+    own children — only called at loop top, before any child exists) —
+    the watcher must never compete with it for the chip."""
+    me = os.getpid()
+    try:
+        import glob
+
+        for path in glob.glob("/proc/[0-9]*/cmdline"):
+            pid = int(path.split("/")[2])
+            if pid == me:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    argv = f.read().decode(errors="replace").split("\0")
+            except OSError:
+                continue
+            # a python interpreter RUNNING bench.py — not an editor, tail,
+            # or grep whose argv merely mentions the file name
+            if (
+                argv
+                and "python" in os.path.basename(argv[0])
+                and any(a.endswith("bench.py") for a in argv[1:3])
+                and "--watch" not in argv
+            ):
+                return True
+    except Exception:  # /proc unavailable: assume clear rather than stall
+        return False
+    return False
+
+
+def watch_main() -> int:
+    """``python bench.py --watch`` — the tunnel-watcher that used to live
+    in ``tools/wait_and_run_onchip.sh`` (now a thin wrapper over this).
+
+    Probes the TPU tunnel on a spaced cadence; each time it answers, runs
+    the not-yet-done on-chip configs in ``_TPU_PRIORITY`` order with the
+    normal per-config watchdogs and fences, appending every JSON row to a
+    session jsonl under ``tools/``.  A config is DONE only when an actual
+    on-chip row (``"platform": "tpu"``) has landed — bench children exit 0
+    by design even on CPU fallback, so rc can't gate.  The sweep runs with
+    the shared synthetic-table cache (BENCH_CACHE_DIR) and jax's
+    persistent compile cache, so a recovered tunnel minute goes to
+    measurement, not regeneration.
+
+    Env knobs: BENCH_WATCH_OUT (jsonl, default tools/bench_onchip_watch
+    .jsonl), BENCH_WATCH_CONFIGS (comma list, default _TPU_PRIORITY),
+    BENCH_WATCH_ATTEMPTS (60), BENCH_WATCH_SLEEP (300 s),
+    BENCH_WATCH_PROBE_TIMEOUT (45 s)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get(
+        "BENCH_WATCH_OUT", os.path.join(here, "tools", "bench_onchip_watch.jsonl")
     )
+    attempts = int(os.environ.get("BENCH_WATCH_ATTEMPTS", 60))
+    sleep_s = float(os.environ.get("BENCH_WATCH_SLEEP", 300))
+    probe_t = float(os.environ.get("BENCH_WATCH_PROBE_TIMEOUT", 45))
+    cfg_env = os.environ.get("BENCH_WATCH_CONFIGS", "")
+    configs = [c for c in cfg_env.split(",") if c] or list(_TPU_PRIORITY)
+    unknown = [c for c in configs if c not in CONFIGS]
+    if unknown:
+        raise SystemExit(f"unknown BENCH_WATCH_CONFIGS {unknown}")
+
+    def note(msg: str) -> None:
+        print(f"[bench --watch] {msg}", file=sys.stderr, flush=True)
+
+    def done_configs() -> set[str]:
+        """Configs with an on-chip row already in the session jsonl."""
+        done = set()
+        try:
+            with open(out_path) as f:
+                for line in f:
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        continue
+                    if obj.get("platform") == "tpu" and "error" not in obj:
+                        done.add(obj.get("config", ""))
+        except OSError:
+            pass
+        return done
+
+    env = dict(os.environ)
+    env.setdefault(
+        "BENCH_CACHE_DIR", os.path.join(tempfile.gettempdir(), "cmlhn_bench_cache")
+    )
+    for i in range(attempts):
+        if _foreign_bench_running():
+            note("driver bench running — standing down")
+            return 0
+        todo = [c for c in configs if c not in done_configs()]
+        if not todo:
+            note("all on-chip configs done")
+            return 0
+        p, reason = _probe_backend(probe_t)
+        if p == "cpu":
+            reason = "default backend is cpu (no TPU plugin answered)"
+        if p is not None and p != "cpu":
+            note(f"tunnel UP ({p}); running {len(todo)} config(s)")
+            for key in todo:
+                cenv = dict(env)
+                cenv["BENCH_CHILD"] = key
+                budget = float(
+                    os.environ.get("BENCH_CONFIG_TIMEOUT")
+                    or _CONFIG_TIMEOUT.get(key, _DEFAULT_CONFIG_TIMEOUT)
+                )
+                cenv["BENCH_CHILD_BUDGET"] = str(budget)
+                rows = _run_config_watchdogged(key, cenv, budget)
+                with open(out_path, "a") as f:
+                    for obj in rows:
+                        obj["config"] = key
+                        f.write(json.dumps(obj) + "\n")
+                if not any("error" not in r for r in rows):
+                    note(f"{key} failed on-chip; re-probing before the next")
+                    p2, _ = _probe_backend(probe_t)
+                    if p2 is None:
+                        break  # tunnel dropped mid-sweep — back to cadence
+        else:
+            note(f"attempt {i + 1}/{attempts}: tunnel down ({reason})")
+        time.sleep(sleep_s)
+    note(f"gave up after {attempts} attempts")
+    return 1
 
 
 if __name__ == "__main__":
+    if "--watch" in sys.argv[1:]:
+        raise SystemExit(watch_main())
     main()

@@ -87,7 +87,12 @@ _TOKEN = re.compile(
 _AGGS = {"count", "sum", "avg", "min", "max"}
 #: scalar functions usable in expressions (names stay valid column
 #: identifiers when not followed by "(")
-_SCALAR_FUNCS = {"abs", "round", "upper", "lower", "length", "coalesce"}
+_SCALAR_FUNCS = {
+    "abs", "round", "upper", "lower", "length", "coalesce",
+    # date/time scalars for the timestamped-events schema (reference
+    # window extraction, mllearnforhospitalnetwork.py:123-128)
+    "date_trunc", "unix_timestamp", "datediff",
+}
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "order", "limit",
     "and", "or", "between", "as", "asc", "desc",
@@ -383,7 +388,83 @@ def _eval_fn(name: str, vals: list):
         _require_arity(name, vals, 1)
         f = str.upper if name == "upper" else str.lower
         return _str_fn(name, vals[0], f)
+    if name == "date_trunc":
+        _require_arity(name, vals, 2)
+        if not isinstance(vals[0], str):
+            raise ValueError(
+                "SQL: DATE_TRUNC unit must be a string literal "
+                "('year'|'quarter'|'month'|'week'|'day'|'hour'|'minute'|"
+                "'second')"
+            )
+        return _date_trunc(vals[0].lower(), _as_datetime(name, vals[1]))
+    if name == "unix_timestamp":
+        _require_arity(name, vals, 1)
+        ts = _as_datetime(name, vals[0])
+        secs = ts.astype("datetime64[s]").astype(np.float64)
+        return np.where(np.isnat(ts), np.nan, secs) if np.ndim(ts) else (
+            np.nan if np.isnat(ts) else float(secs)
+        )
+    if name == "datediff":
+        _require_arity(name, vals, 2)
+        end = _as_datetime(name, vals[0]).astype("datetime64[D]")
+        start = _as_datetime(name, vals[1]).astype("datetime64[D]")
+        days = (end - start).astype(np.float64)
+        nat = np.isnat(end) | np.isnat(start)
+        if np.ndim(days):
+            return np.where(nat, np.nan, days)
+        return np.nan if nat else float(days)
     raise ValueError(f"SQL: unknown function {name!r}")
+
+
+def _as_datetime(name: str, v):
+    """Coerce a function argument to datetime64[ns]: timestamp columns pass
+    through, string literals parse (Spark's implicit cast), anything else
+    is a labeled analysis error."""
+    if isinstance(v, str):
+        try:
+            return np.datetime64(v.replace(" ", "T"))
+        except ValueError:
+            raise ValueError(
+                f"SQL: {name.upper()} got an unparseable timestamp literal "
+                f"{v!r}"
+            ) from None
+    arr = np.asarray(v)
+    if arr.dtype.kind != "M":
+        raise ValueError(
+            f"SQL: {name.upper()} expects a timestamp argument, got "
+            f"{arr.dtype}"
+        )
+    return arr if np.ndim(v) else arr[()]
+
+
+def _date_trunc(unit: str, ts):
+    """Spark ``date_trunc``: floor to the unit, result stays a timestamp.
+    NaT propagates through every path (numpy casts keep it NaT)."""
+    simple = {"year": "Y", "month": "M", "day": "D",
+              "hour": "h", "minute": "m", "second": "s"}
+    if unit in simple:
+        return ts.astype(f"datetime64[{simple[unit]}]").astype("datetime64[ns]")
+    if unit == "quarter":
+        months = ts.astype("datetime64[M]")
+        m_idx = months.astype(np.int64)  # months since 1970-01
+        floored = (months - (m_idx % 3).astype("timedelta64[M]"))
+        out = floored.astype("datetime64[ns]")
+        # integer arithmetic on NaT yields garbage offsets — restore NaT
+        return np.where(np.isnat(ts), np.datetime64("NaT", "ns"), out) \
+            if np.ndim(ts) else (np.datetime64("NaT", "ns") if np.isnat(ts) else out)
+    if unit == "week":
+        # Spark truncates to Monday; datetime64[W] weeks start Thursday
+        # (the epoch's weekday), so floor on day index instead
+        days = ts.astype("datetime64[D]")
+        d_idx = days.astype(np.int64)          # 1970-01-01 = Thursday
+        monday = days - ((d_idx + 3) % 7).astype("timedelta64[D]")
+        out = monday.astype("datetime64[ns]")
+        return np.where(np.isnat(ts), np.datetime64("NaT", "ns"), out) \
+            if np.ndim(ts) else (np.datetime64("NaT", "ns") if np.isnat(ts) else out)
+    raise ValueError(
+        f"SQL: DATE_TRUNC does not support unit {unit!r} "
+        "(year|quarter|month|week|day|hour|minute|second)"
+    )
 
 
 def _obj_fill(out: np.ndarray, c: np.ndarray, miss: np.ndarray) -> np.ndarray:

@@ -98,6 +98,38 @@ class Model:
     def predict(self, x: jax.Array) -> jax.Array:
         raise NotImplementedError
 
+    # serving contract ---------------------------------------------------
+    def serving_predict_fn(self):
+        """Stable raw-array predict entry point for the ``serve/`` layer.
+
+        Returns a PURE function ``(batch, d) array -> (batch,)
+        predictions``: traceable under ``jax.jit``, deterministic in its
+        parameters (closed over, never mutated), and row-local — row i of
+        the output depends only on row i of the input, so the server may
+        pad batches with junk rows and slice the real rows back out.
+        Defaults to ``self.predict``; families whose predict takes extra
+        arguments or runs host-side logic override this with a serving-
+        safe closure."""
+        return self.predict
+
+    @property
+    def num_features(self) -> int | None:
+        """Feature width the model was trained on, when recoverable from
+        its parameters — the serve registry uses it to size shape-bucket
+        executables without a probe row.  ``None`` when undeterminable."""
+        for attr, axis in (
+            ("coefficients", -1),   # linear family; (d,) or (k, d)
+            ("cluster_centers", 1),  # kmeans / bisecting
+            ("means", 1),            # gmm
+            ("theta", 1),            # naive bayes
+            ("feature_importances", -1),  # tree ensembles (what
+            # decision_tree.check_features sizes against)
+        ):
+            v = getattr(self, attr, None)
+            if v is not None and getattr(v, "ndim", 0) >= 1:
+                return int(np.asarray(v).shape[axis])
+        return None
+
     def transform(self, data: Any, label_col: str | None = None, mesh=None) -> PredictionResult:
         ds = as_device_dataset(data, label_col=label_col, mesh=mesh)
         pred = self.predict(ds.x)

@@ -27,7 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ..utils.compat import shard_map
 
 from .mesh import DATA_AXIS, default_mesh
 

@@ -1,0 +1,58 @@
+"""TPU-native online inference: the trained half's path back to traffic.
+
+``serve/`` turns a saved artifact into low-latency predictions without
+ever recompiling in steady state:
+
+* :mod:`registry`  — saved model → jitted, shape-bucketed executables
+* :mod:`bucketing` — the power-of-two batch-shape ladder (zero-recompile
+  contract)
+* :mod:`batcher`   — adaptive micro-batching of single-row requests
+* :mod:`queue`     — bounded admission, deadlines, graceful degradation
+* :mod:`scoring`   — sharded bulk scoring over the training data mesh
+* :mod:`metrics`   — p50/p99 latency, queue depth, fill ratio, recompiles
+* :mod:`server`    — the composed front door (:class:`InferenceServer`)
+
+See docs/ARCHITECTURE.md §Serving layer for the design rationale.
+"""
+
+from .batcher import DEFAULT_MAX_WAIT_S, MicroBatcher
+from .bucketing import DEFAULT_BUCKETS, bucket_for, fill_ratio, pad_to_bucket
+from .metrics import ServingMetrics
+from .queue import (
+    DEGRADED_STATUSES,
+    Request,
+    RequestQueue,
+    ServeResult,
+    STATUS_DEADLINE_EXCEEDED,
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_REJECTED,
+    STATUS_SHUTDOWN,
+)
+from .registry import ModelRegistry, ServingModel
+from .scoring import ShardedScorer, bulk_score
+from .server import InferenceServer
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "DEFAULT_MAX_WAIT_S",
+    "DEGRADED_STATUSES",
+    "InferenceServer",
+    "MicroBatcher",
+    "ModelRegistry",
+    "Request",
+    "RequestQueue",
+    "ServeResult",
+    "ServingMetrics",
+    "ServingModel",
+    "ShardedScorer",
+    "STATUS_DEADLINE_EXCEEDED",
+    "STATUS_ERROR",
+    "STATUS_OK",
+    "STATUS_REJECTED",
+    "STATUS_SHUTDOWN",
+    "bucket_for",
+    "bulk_score",
+    "fill_ratio",
+    "pad_to_bucket",
+]

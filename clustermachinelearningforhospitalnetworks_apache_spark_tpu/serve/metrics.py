@@ -1,0 +1,123 @@
+"""Serving metrics: tail latency, queue depth, batch fill, recompiles.
+
+Rides :mod:`..utils.metrics` (the same registry the training pipeline
+stages feed) rather than inventing a second metrics surface: counters and
+gauges land in a ``MetricsRegistry`` under a ``serve.`` prefix, and the
+latency distribution is kept here as a bounded reservoir so p50/p99 are
+computable without unbounded memory on a long-lived server.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..utils.metrics import MetricsRegistry
+
+#: reservoir capacity: enough for stable p99 estimates, small enough that
+#: a week-long server never grows (uniform reservoir sampling past the cap)
+_RESERVOIR = 8192
+
+
+@dataclass
+class ServingMetrics:
+    """Thread-safe serving-side metrics sink.
+
+    Each sink owns its registry by default, so two servers (or two test
+    cases) never bleed counters into each other; pass
+    ``utils.metrics.global_metrics()`` explicitly to fold serve counters
+    into the process-wide training registry.
+    """
+
+    registry: MetricsRegistry = field(default_factory=MetricsRegistry)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _latencies: list = field(default_factory=list, repr=False)
+    _fills: list = field(default_factory=list, repr=False)
+    _seen: int = 0
+
+    # ------------------------------------------------------------ record
+    def record_request(self, latency_s: float, status: str = "ok") -> None:
+        with self._lock:
+            self.registry.inc("serve.requests")
+            self.registry.inc(f"serve.status.{status}")
+            self._seen += 1
+            if len(self._latencies) < _RESERVOIR:
+                self._latencies.append(latency_s)
+            else:  # uniform reservoir: every request keeps equal weight
+                j = np.random.randint(0, self._seen)
+                if j < _RESERVOIR:
+                    self._latencies[j] = latency_s
+
+    def record_batch(self, n_valid: int, bucket: int) -> None:
+        with self._lock:
+            self.registry.inc("serve.batches")
+            self.registry.inc("serve.rows", float(n_valid))
+            self.registry.inc("serve.padded_rows", float(bucket - n_valid))
+            self._fills.append(n_valid / bucket if bucket else 0.0)
+            if len(self._fills) > _RESERVOIR:
+                del self._fills[: -_RESERVOIR // 2]
+
+    def record_compile(self, bucket: int, warm: bool) -> None:
+        """``warm`` marks planned warmup compiles; anything else is a
+        steady-state recompile — the number that must read 0."""
+        with self._lock:
+            self.registry.inc(
+                "serve.warmup_compiles" if warm else "serve.recompiles"
+            )
+
+    def set_queue_depth(self, rows: int) -> None:
+        with self._lock:
+            self.registry.set("serve.queue_depth_rows", float(rows))
+            peak = self.registry.gauges.get("serve.queue_depth_peak", 0.0)
+            if rows > peak:
+                self.registry.set("serve.queue_depth_peak", float(rows))
+
+    # ------------------------------------------------------------ read
+    @property
+    def recompile_count(self) -> int:
+        return int(self.registry.counters.get("serve.recompiles", 0))
+
+    def percentile(self, q: float) -> float | None:
+        with self._lock:
+            if not self._latencies:
+                return None
+            return float(np.percentile(np.asarray(self._latencies), q))
+
+    def batch_fill_ratio(self) -> float | None:
+        """Mean real-rows fraction over recent batches."""
+        with self._lock:
+            if not self._fills:
+                return None
+            return float(np.mean(self._fills))
+
+    def snapshot(self) -> dict[str, Any]:
+        c = self.registry.counters
+        out = {
+            "requests": int(c.get("serve.requests", 0)),
+            "batches": int(c.get("serve.batches", 0)),
+            "rows": int(c.get("serve.rows", 0)),
+            "warmup_compiles": int(c.get("serve.warmup_compiles", 0)),
+            "recompiles": self.recompile_count,
+            "queue_depth_rows": self.registry.gauges.get(
+                "serve.queue_depth_rows", 0.0
+            ),
+            "queue_depth_peak": self.registry.gauges.get(
+                "serve.queue_depth_peak", 0.0
+            ),
+            "statuses": {
+                k.split(".", 2)[2]: int(v)
+                for k, v in c.items()
+                if k.startswith("serve.status.")
+            },
+        }
+        p50, p99 = self.percentile(50), self.percentile(99)
+        if p50 is not None:
+            out["latency_p50_ms"] = round(p50 * 1e3, 3)
+            out["latency_p99_ms"] = round(p99 * 1e3, 3)
+        fill = self.batch_fill_ratio()
+        if fill is not None:
+            out["batch_fill_ratio"] = round(fill, 4)
+        return out

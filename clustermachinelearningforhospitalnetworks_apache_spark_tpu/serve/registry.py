@@ -1,0 +1,206 @@
+"""Model registry: saved artifact → jitted, shape-bucketed predict.
+
+The online half of ``io/model_io.py``: ``load_model(path)`` rebuilds any
+registered family, and :class:`ServingModel` wraps its stable raw-array
+predict (``models/base.py::Model.serving_predict_fn``) in ONE ``jax.jit``
+executable per shape bucket.  Warmup compiles the whole ladder up front;
+after that a request of any size ≤ the top bucket hits a cached
+executable — the serving analogue of Flare's "compile the hot path
+natively, don't interpret the dataflow" (arXiv:1703.08219), with XLA
+doing the compiling and the bucket ladder keeping the executable count
+finite.
+
+Recompiles are tracked two ways: a semantic counter (a request shape
+outside the warmed set) and, where the jax version exposes it, the jit
+cache size itself — ``tests/test_serving.py`` cross-checks both.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.model_io import load_model
+from ..models.base import Model
+from ..utils.logging import get_logger
+from .bucketing import (
+    DEFAULT_BUCKETS,
+    bucket_for,
+    fill_ratio,
+    iter_chunks,
+    pad_to_bucket,
+    validate_buckets,
+)
+from .metrics import ServingMetrics
+
+log = get_logger("serve")
+
+
+def _donate_ok() -> bool:
+    """Donation elides the output allocation on TPU (the padded batch
+    buffer is dead after the call); the CPU backend just warns, so only
+    donate where it pays."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # backend not initializable — caller will find out
+        return False
+
+
+class ServingModel:
+    """A loaded model behind a fixed ladder of compiled batch shapes."""
+
+    def __init__(
+        self,
+        model: Model,
+        n_features: int | None = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        metrics: ServingMetrics | None = None,
+        dtype=jnp.float32,
+        donate: bool | None = None,
+    ):
+        self.model = model
+        self.buckets = validate_buckets(buckets)
+        self.metrics = metrics or ServingMetrics()
+        self.dtype = dtype
+        n = n_features if n_features is not None else model.num_features
+        if n is None:
+            raise ValueError(
+                f"{type(model).__name__} does not expose num_features; pass "
+                "n_features= explicitly so bucket executables can be sized"
+            )
+        self.n_features = int(n)
+        donate = _donate_ok() if donate is None else donate
+        fn = model.serving_predict_fn()
+        self._jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+        self._warmed: set[int] = set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ compile
+    def warmup(self, buckets: Sequence[int] | None = None) -> "ServingModel":
+        """Compile (and execute once) every bucket shape so steady-state
+        serving never pays a compile.  Idempotent; returns self."""
+        for b in validate_buckets(buckets) if buckets else self.buckets:
+            with self._lock:
+                if b in self._warmed:
+                    continue
+                self._warmed.add(b)
+            self.metrics.record_compile(b, warm=True)
+            z = np.zeros((b, self.n_features), dtype=np.dtype(self.dtype))
+            jax.block_until_ready(self._jitted(jnp.asarray(z)))
+        return self
+
+    def jit_cache_size(self) -> int | None:
+        """The wrapped jit's compiled-executable count, when the jax
+        version exposes it — None otherwise.  Stable across steady-state
+        serving iff the bucket contract holds."""
+        cache_size = getattr(self._jitted, "_cache_size", None)
+        return cache_size() if callable(cache_size) else None
+
+    # ------------------------------------------------------------ serve
+    def predict_bucketed(self, x: np.ndarray) -> np.ndarray:
+        """One padded device call: pick the bucket, pad, predict, slice.
+
+        ``x`` must fit the largest bucket; :meth:`predict` splits larger
+        inputs.  Thread-safe (jax dispatch is)."""
+        x = np.ascontiguousarray(x, dtype=np.dtype(self.dtype))
+        if x.ndim == 1:
+            x = x[None, :]
+        n = x.shape[0]
+        b = bucket_for(n, self.buckets)
+        with self._lock:
+            if b not in self._warmed:
+                # a shape outside the warmed ladder: this compile happens
+                # on the request path — the counter that must stay 0
+                self._warmed.add(b)
+                cold = True
+            else:
+                cold = False
+        if cold:
+            log.warning("steady-state compile", bucket=b, n=n)
+            self.metrics.record_compile(b, warm=False)
+        out = self._jitted(jnp.asarray(pad_to_bucket(x, b)))
+        self.metrics.record_batch(n, b)
+        return np.asarray(jax.device_get(out))[:n]
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Predict any batch size: oversized inputs stream through the top
+        bucket's executable chunk by chunk (still zero recompiles)."""
+        x = np.asarray(x)
+        if x.ndim == 1:
+            x = x[None, :]
+        top = self.buckets[-1]
+        if x.shape[0] <= top:
+            return self.predict_bucketed(x)
+        parts = [self.predict_bucketed(piece) for _, piece in iter_chunks(x, top)]
+        return np.concatenate(parts, axis=0)
+
+    def batch_fill(self, n: int) -> float:
+        return fill_ratio(n, bucket_for(n, self.buckets))
+
+
+class ModelRegistry:
+    """Name → :class:`ServingModel`, loadable straight from saved artifact
+    directories (``model.save(path)`` → ``registry.load(name, path)``)."""
+
+    def __init__(self, metrics: ServingMetrics | None = None):
+        self.metrics = metrics or ServingMetrics()
+        self._models: dict[str, ServingModel] = {}
+        self._lock = threading.Lock()
+
+    def register(
+        self,
+        name: str,
+        model: Model,
+        n_features: int | None = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        warmup: bool = False,
+        dtype=jnp.float32,
+    ) -> ServingModel:
+        sm = ServingModel(
+            model, n_features=n_features, buckets=buckets,
+            metrics=self.metrics, dtype=dtype,
+        )
+        if warmup:
+            sm.warmup()
+        with self._lock:
+            self._models[name] = sm
+        log.info(
+            "model registered", name=name, family=type(model).__name__,
+            n_features=sm.n_features, buckets=len(sm.buckets),
+        )
+        return sm
+
+    def load(
+        self,
+        name: str,
+        path: str,
+        n_features: int | None = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        warmup: bool = False,
+    ) -> ServingModel:
+        """``io/model_io.load_model`` + wrap: any family the persistence
+        registry knows round-trips straight into serving."""
+        return self.register(
+            name, load_model(path), n_features=n_features,
+            buckets=buckets, warmup=warmup,
+        )
+
+    def get(self, name: str) -> ServingModel:
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(
+                    f"no model {name!r} in registry; have {sorted(self._models)}"
+                )
+            return self._models[name]
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def warmup_all(self) -> None:
+        for name in self.names():
+            self.get(name).warmup()

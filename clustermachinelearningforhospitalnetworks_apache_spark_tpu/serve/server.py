@@ -1,0 +1,135 @@
+"""InferenceServer: registry + per-model micro-batchers, one front door.
+
+The deployment-shaped surface: load saved artifacts into a registry,
+``start()``, then ``predict(name, row)`` from any number of client
+threads.  Each model gets its own :class:`MicroBatcher` (its own queue
+and worker) so a slow family cannot head-of-line-block a fast one; the
+metrics sink is shared so one ``stats()`` call reports the whole server.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..models.base import Model
+from ..utils.logging import get_logger
+from .batcher import DEFAULT_MAX_WAIT_S, Fallback, MicroBatcher
+from .bucketing import DEFAULT_BUCKETS
+from .metrics import ServingMetrics
+from .queue import ServeResult
+from .registry import ModelRegistry, ServingModel
+
+log = get_logger("serve")
+
+
+class InferenceServer:
+    """Online inference over one or more registered models."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry | None = None,
+        max_queue_rows: int = 4096,
+        max_wait_s: float = DEFAULT_MAX_WAIT_S,
+    ):
+        self.registry = registry or ModelRegistry()
+        self.metrics: ServingMetrics = self.registry.metrics
+        self.max_queue_rows = max_queue_rows
+        self.max_wait_s = max_wait_s
+        self._batchers: dict[str, MicroBatcher] = {}
+        self._fallbacks: dict[str, Fallback] = {}
+        self._started = False
+
+    # ------------------------------------------------------------ setup
+    def add_model(
+        self,
+        name: str,
+        model: Model | str,
+        n_features: int | None = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        fallback: Fallback = None,
+    ) -> ServingModel:
+        """Register a fitted model (or a saved-artifact path) for serving.
+        ``fallback`` answers degraded requests for THIS model."""
+        if isinstance(model, str):
+            sm = self.registry.load(
+                name, model, n_features=n_features, buckets=buckets
+            )
+        else:
+            sm = self.registry.register(
+                name, model, n_features=n_features, buckets=buckets
+            )
+        self._fallbacks[name] = fallback
+        if self._started:  # hot-add: warm and attach a batcher now
+            sm.warmup()
+            self._batchers[name] = MicroBatcher(
+                sm, max_queue_rows=self.max_queue_rows,
+                max_wait_s=self.max_wait_s, fallback=fallback,
+                metrics=self.metrics,
+            ).start()
+        return sm
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "InferenceServer":
+        """Warm every bucket executable, then start the batcher workers —
+        in that order, so no request ever races a warmup compile."""
+        for name in self.registry.names():
+            sm = self.registry.get(name)
+            sm.warmup()
+            if name not in self._batchers:
+                self._batchers[name] = MicroBatcher(
+                    sm, max_queue_rows=self.max_queue_rows,
+                    max_wait_s=self.max_wait_s,
+                    fallback=self._fallbacks.get(name),
+                    metrics=self.metrics,
+                ).start()
+        self._started = True
+        log.info("inference server started", models=len(self._batchers))
+        return self
+
+    def stop(self) -> None:
+        for b in self._batchers.values():
+            b.stop()
+        self._batchers.clear()
+        self._started = False
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ serve
+    def _batcher(self, name: str) -> MicroBatcher:
+        if name not in self._batchers:
+            raise KeyError(
+                f"model {name!r} is not being served "
+                f"(started={self._started}); have {sorted(self._batchers)}"
+            )
+        return self._batchers[name]
+
+    def submit(self, name: str, x: np.ndarray, deadline_s: float | None = None):
+        return self._batcher(name).submit(x, deadline_s=deadline_s)
+
+    def predict(
+        self, name: str, x: np.ndarray, deadline_s: float | None = None,
+        wait_timeout_s: float | None = 30.0,
+    ) -> ServeResult:
+        return self._batcher(name).predict(
+            x, deadline_s=deadline_s, wait_timeout_s=wait_timeout_s
+        )
+
+    # ------------------------------------------------------------ observe
+    def stats(self) -> dict[str, Any]:
+        out = self.metrics.snapshot()
+        out["models"] = {
+            name: {
+                "buckets": list(b.model.buckets),
+                "n_features": b.model.n_features,
+                "queue_depth_rows": b.queue.depth_rows,
+                "jit_cache_size": b.model.jit_cache_size(),
+            }
+            for name, b in self._batchers.items()
+        }
+        return out

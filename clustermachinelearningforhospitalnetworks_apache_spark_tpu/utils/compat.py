@@ -1,0 +1,78 @@
+"""jax version compatibility shims.
+
+The tree is written against the modern ``jax.shard_map`` entry point
+(JAX ≥ 0.6, where ``check_vma`` replaced ``check_rep``); the image pins
+jax 0.4.37 where shard_map still lives in ``jax.experimental.shard_map``.
+One shim with the modern signature keeps every call site on the new
+spelling — when the image's jax catches up, the shim resolves to the
+real thing and this module becomes a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if hasattr(jax, "shard_map"):  # modern jax: nothing to shim
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @functools.wraps(_legacy_shard_map)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        # check_rep is always disabled on legacy jax: its replication
+        # checker predates vma types and has no rule for while/scan bodies
+        # this tree uses ("No replication rule for while"), and with
+        # :func:`_pcast` marking everything varying the modern programs
+        # assume plain psum semantics — exactly what check_rep=False runs.
+        del check_vma
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
+class _AvalView:
+    """``jax.typeof`` stand-in result: delegates to the abstract value but
+    answers ``.vma`` (varying-mesh-axes, JAX ≥0.7) with the empty set —
+    legacy jax tracks replication in check_rep instead, so "varies on no
+    axes" makes every ``pcast``-to-missing-axes call site a no-op."""
+
+    __slots__ = ("_aval",)
+
+    def __init__(self, aval):
+        self._aval = aval
+
+    @property
+    def vma(self):
+        return frozenset()
+
+    def __getattr__(self, name):
+        return getattr(self._aval, name)
+
+
+def _typeof(x):
+    return _AvalView(jax.core.get_aval(x))
+
+
+def _pcast(x, axis_name=None, *, to=None):
+    """``lax.pcast`` (JAX ≥0.8) re-labels which mesh axes a value varies
+    over WITHOUT touching its per-device contents — a pure type-system
+    operation.  Legacy jax has no vma types, so the value itself is the
+    whole story: identity."""
+    del axis_name, to
+    return x
+
+
+def install() -> None:
+    """Expose the modern spellings on legacy jax so call sites throughout
+    the tree use one API. Idempotent; no-op on modern jax."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    if not hasattr(jax, "typeof"):
+        jax.typeof = _typeof
+    from jax import lax
+
+    if not hasattr(lax, "pcast"):
+        lax.pcast = _pcast

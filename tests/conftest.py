@@ -23,7 +23,16 @@ import jax
 # runs, so env vars alone are too late; the config route still works
 # because backends are initialized lazily.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax ≥ 0.5 route; 0.4.x doesn't know the option and raises
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # the XLA_FLAGS fallback above covers 0.4.x
+
+assert jax.device_count() == 8, (
+    f"virtual 8-device CPU mesh not in effect (got {jax.device_count()} "
+    "devices) — every sharding/psum test below would silently degrade"
+)
 
 # Persistent compilation cache: the suite is XLA-compile-bound on a 1-core
 # host (every estimator family compiles per-shape executables), and the

@@ -53,7 +53,14 @@ _WORKER = textwrap.dedent(
     import os, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)   # 2 local devices / process
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)   # 2 local devices / process
+    except AttributeError:  # jax 0.4.x: flag route, backend not yet up
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
 
     # The runtime must be wired BEFORE anything touches the XLA backend —
     # importing the package materializes jnp constants, so load the
@@ -336,6 +343,15 @@ def test_two_process_cluster_fit(tmp_path):
                 q.kill()
             pytest.fail("distributed worker timed out")
         outs.append(out)
+    if any(
+        "Multiprocess computations aren't implemented on the CPU backend"
+        in out
+        for out in outs
+    ):
+        # jax 0.4.x jaxlib: the CPU runtime has no cross-process
+        # collectives at all (gloo-backed CPU collectives land in later
+        # jaxlibs) — the capability under test cannot exist here
+        pytest.skip("this jaxlib's CPU backend lacks multiprocess collectives")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"proc {pid}: OK" in out, out

@@ -1,0 +1,280 @@
+"""serve/ — registry, shape buckets, micro-batching, degradation.
+
+Covers the subsystem's three contracts:
+
+1. any family ``io/model_io`` can round-trip serves through the registry
+   with save→load→predict parity (the MLlib ``transform()`` gap the
+   serving layer closes);
+2. bucket padding never changes a real row's prediction, and steady-state
+   serving after warmup triggers ZERO recompiles (cross-checked against
+   the jit cache itself where available);
+3. overload degrades gracefully — saturated queues shed at admission,
+   expired deadlines answer degraded, nothing hangs, the queue stays
+   bounded.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu import serve
+
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture
+def xy(rng):
+    n, d = 96, 4
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y_reg = (x @ np.array([1.0, -2.0, 0.5, 0.0]) + 0.3).astype(np.float32)
+    y_cls = (y_reg > 0).astype(np.float32)
+    return x, y_reg, y_cls
+
+
+#: (family name, estimator factory, which label column it trains on)
+FAMILIES = [
+    ("linear_regression", lambda: ht.LinearRegression(max_iter=20), "reg"),
+    ("logistic_regression", lambda: ht.LogisticRegression(max_iter=20), "cls"),
+    ("linear_svc", lambda: ht.LinearSVC(max_iter=20), "cls"),
+    ("naive_bayes", lambda: ht.NaiveBayes(model_type="gaussian"), "cls"),
+    ("decision_tree", lambda: ht.DecisionTreeRegressor(max_depth=3), "reg"),
+    ("random_forest", lambda: ht.RandomForestRegressor(num_trees=3, max_depth=3), "reg"),
+    ("gbt", lambda: ht.GBTRegressor(max_iter=3, max_depth=2), "reg"),
+    ("kmeans", lambda: ht.KMeans(k=3, max_iter=5, seed=0), None),
+    ("gmm", lambda: ht.GaussianMixture(k=2, max_iter=5, seed=0), None),
+]
+
+
+def _fit(factory, label, x, y_reg, y_cls):
+    est = factory()
+    if label is None:
+        return est.fit(x)
+    return est.fit((x, y_reg if label == "reg" else y_cls))
+
+
+@pytest.mark.parametrize("name,factory,label", FAMILIES, ids=[f[0] for f in FAMILIES])
+def test_save_load_serve_roundtrip(tmp_path, xy, name, factory, label):
+    """save → load_model → registry → bucketed predict parity, for every
+    family the registry must serve."""
+    x, y_reg, y_cls = xy
+    model = _fit(factory, label, x, y_reg, y_cls)
+    path = str(tmp_path / name)
+    model.save(path)
+
+    reg = serve.ModelRegistry()
+    sm = reg.load(name, path, buckets=(1, 4, 16, 128))
+    assert sm.n_features == x.shape[1]  # num_features inferred post-load
+    expect = model.predict_numpy(x)
+    got = sm.predict(x)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_bucket_ladder_selection():
+    assert serve.bucket_for(1, (1, 2, 4)) == 1
+    assert serve.bucket_for(3, (1, 2, 4)) == 4
+    assert serve.bucket_for(4, (1, 2, 4)) == 4
+    with pytest.raises(ValueError, match="largest bucket"):
+        serve.bucket_for(5, (1, 2, 4))
+    with pytest.raises(ValueError):
+        serve.bucket_for(0, (1, 2, 4))
+
+
+def test_bucket_padding_parity(xy):
+    """Padded bucketed predict == unpadded predict for every request size
+    that lands mid-bucket (the pad rows must be inert)."""
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    sm = serve.ServingModel(model, buckets=(1, 2, 4, 8, 16, 32)).warmup()
+    for n in (1, 2, 3, 5, 8, 13, 31):
+        np.testing.assert_allclose(
+            sm.predict(x[:n]),
+            model.predict_numpy(x[:n]),
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"padding leaked at n={n}",
+        )
+    # oversized request streams through the top bucket, same answers
+    np.testing.assert_allclose(
+        sm.predict(x[:70]), model.predict_numpy(x[:70]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_zero_recompiles_after_warmup(xy):
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    sm = serve.ServingModel(model, buckets=(1, 2, 4, 8, 16)).warmup()
+    warm_cache = sm.jit_cache_size()
+    assert sm.metrics.recompile_count == 0
+    for n in (1, 3, 7, 13, 16, 2, 9, 1, 5):  # ≥3 distinct sizes, shuffled
+        sm.predict(x[:n])
+    assert sm.metrics.recompile_count == 0
+    if warm_cache is not None:  # cross-check against the jit cache itself
+        assert sm.jit_cache_size() == warm_cache
+    snap = sm.metrics.snapshot()
+    assert snap["warmup_compiles"] == 5
+    assert 0 < snap["batch_fill_ratio"] <= 1.0
+
+
+def test_recompile_counter_detects_cold_shape(xy):
+    """A bucket NOT in the warmed ladder must be visible in the counter —
+    the alarm the zero-recompile assertion relies on."""
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    sm = serve.ServingModel(model, buckets=(1, 2, 4, 8))
+    sm.warmup(buckets=(1, 2, 4))  # deliberately partial
+    sm.predict(x[:7])             # lands in the cold 8-bucket
+    assert sm.metrics.recompile_count == 1
+
+
+def test_microbatcher_coalesces_and_answers_all(xy):
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    sm = serve.ServingModel(model, buckets=(1, 2, 4, 8, 16, 32)).warmup()
+    expect = model.predict_numpy(x)
+    with serve.MicroBatcher(sm, max_queue_rows=256) as mb:
+        reqs = [mb.submit(x[i]) for i in range(48)]
+        res = [r.wait(10.0) for r in reqs]
+    assert all(r.ok for r in res)
+    got = np.concatenate([r.value for r in res])
+    np.testing.assert_allclose(got, expect[:48], rtol=1e-5, atol=1e-6)
+    # coalescing actually happened (strictly fewer batches than requests)
+    snap = sm.metrics.snapshot()
+    assert snap["batches"] < snap["requests"]
+
+
+def test_saturated_queue_sheds_not_hangs(xy):
+    """Acceptance gate: queue artificially saturated → overflow requests
+    get an immediate degraded answer, queue depth stays bounded, nothing
+    hangs."""
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    prior = float(np.mean(y_reg))
+    sm = serve.ServingModel(model, buckets=(1, 2, 4)).warmup()
+    mb = serve.MicroBatcher(
+        sm, max_queue_rows=8,
+        fallback=lambda rows: np.full(rows.shape[0], prior, np.float32),
+    )
+    # worker NOT started: the queue saturates by construction
+    t0 = time.monotonic()
+    reqs = [mb.submit(x[i]) for i in range(50)]
+    admission_s = time.monotonic() - t0
+    assert admission_s < 2.0  # no blocking admission
+    shed = [r for r in reqs if r._result is not None]
+    assert len(shed) == 42  # everything beyond the 8-row bound
+    for r in shed:
+        out = r.wait(0.1)
+        assert out.status == serve.STATUS_REJECTED
+        assert out.degraded and out.value is not None
+        np.testing.assert_allclose(out.value, [prior])
+    assert mb.queue.depth_rows == 8  # bounded, not growing
+    # the queued 8 are served once the worker starts — no lost requests
+    mb.start()
+    served = [r.wait(10.0) for r in reqs[:8]]
+    assert all(r.ok for r in served)
+    mb.stop()
+
+
+def test_deadline_exceeded_degrades(xy):
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    prior = float(np.mean(y_reg))
+    sm = serve.ServingModel(model, buckets=(1, 2, 4)).warmup()
+    mb = serve.MicroBatcher(
+        sm, max_queue_rows=64,
+        fallback=lambda rows: np.full(rows.shape[0], prior, np.float32),
+    )
+    # enqueue with a deadline that expires before the worker exists
+    req = mb.submit(x[0], deadline_s=0.01)
+    time.sleep(0.05)
+    mb.start()
+    out = req.wait(10.0)
+    assert out.status == serve.STATUS_DEADLINE_EXCEEDED
+    assert out.degraded
+    np.testing.assert_allclose(out.value, [prior])
+    # a patient request right behind it is served normally
+    ok = mb.predict(x[1])
+    assert ok.ok
+    mb.stop()
+    # stop() answers stragglers instead of stranding them
+    late = mb.submit(x[2])
+    assert late.wait(1.0).status in (serve.STATUS_REJECTED, serve.STATUS_SHUTDOWN)
+
+
+def test_stop_answers_queued_requests(xy):
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    sm = serve.ServingModel(model, buckets=(1, 2)).warmup()
+    mb = serve.MicroBatcher(sm, max_queue_rows=64)  # never started
+    reqs = [mb.submit(x[i]) for i in range(5)]
+    mb.stop()
+    for r in reqs:
+        assert r.wait(1.0).status == serve.STATUS_SHUTDOWN
+
+
+def test_inference_server_multi_model_and_stats(xy):
+    x, y_reg, y_cls = xy
+    reg_m = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    cls_m = ht.LogisticRegression(max_iter=20).fit((x, y_cls))
+    srv = serve.InferenceServer(max_queue_rows=256)
+    srv.add_model("los", reg_m, buckets=(1, 2, 4, 8))
+    srv.add_model("risk", cls_m, buckets=(1, 2, 4, 8))
+    with srv:
+        a = srv.predict("los", x[:3])
+        b = srv.predict("risk", x[:3])
+        assert a.ok and b.ok
+        np.testing.assert_allclose(a.value, reg_m.predict_numpy(x[:3]), rtol=1e-5)
+        np.testing.assert_allclose(b.value, cls_m.predict_numpy(x[:3]), rtol=1e-5)
+        with pytest.raises(KeyError):
+            srv.predict("nope", x[:1])
+        stats = srv.stats()
+    assert stats["recompiles"] == 0
+    assert set(stats["models"]) == {"los", "risk"}
+    assert stats["latency_p50_ms"] > 0
+
+
+def test_concurrent_clients_all_answered(xy):
+    """Many threads × mixed batch sizes: every request answered OK, zero
+    recompiles, predictions correct."""
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    expect = model.predict_numpy(x)
+    sm = serve.ServingModel(model, buckets=(1, 2, 4, 8, 16, 32)).warmup()
+    errs: list = []
+    with serve.MicroBatcher(sm, max_queue_rows=1024) as mb:
+        def client(size: int) -> None:
+            for i in range(20):
+                s = (i * size) % (len(x) - size)
+                r = mb.predict(x[s : s + size], wait_timeout_s=30.0)
+                if not r.ok:
+                    errs.append(r.status)
+                elif not np.allclose(r.value, expect[s : s + size], rtol=1e-4, atol=1e-5):
+                    errs.append(f"wrong value at {s}+{size}")
+        threads = [
+            threading.Thread(target=client, args=(sz,)) for sz in (1, 3, 7, 16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+    assert not errs
+    assert sm.metrics.recompile_count == 0
+
+
+def test_bulk_score_matches_predict(xy, mesh8):
+    x, y_reg, _ = xy
+    model = ht.LinearRegression(max_iter=20).fit((x, y_reg))
+    expect = model.predict_numpy(x)
+    np.testing.assert_allclose(
+        serve.bulk_score(model, x, mesh=mesh8), expect, rtol=1e-5, atol=1e-6
+    )
+    # chunked path (chunk smaller than the job) through one fixed shape
+    np.testing.assert_allclose(
+        serve.bulk_score(model, x, mesh=mesh8, chunk_rows=32),
+        expect, rtol=1e-5, atol=1e-6,
+    )
+    scorer = serve.ShardedScorer(model, mesh=mesh8, chunk_rows=32).warmup()
+    np.testing.assert_allclose(scorer.score(x), expect, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(scorer.score(x[:5]), expect[:5], rtol=1e-5, atol=1e-6)

@@ -1528,3 +1528,101 @@ class TestOuterJoins:
             "ON s.k = jb.k ORDER BY vb"
         )
         np.testing.assert_allclose(r.column("vb"), [20, 30, 40])
+
+
+class TestDateTimeFunctions:
+    """date_trunc / unix_timestamp / datediff — the timestamped-events
+    scalars (reference window extraction, mllearnforhospitalnetwork.py:
+    123-128)."""
+
+    @pytest.fixture
+    def tt(self):
+        s = ht.Session.builder.app_name("sql-dt-test").get_or_create()
+        times = np.array(
+            ["2025-03-31T22:15:42", "2025-04-01T01:02:03",
+             "2025-06-15T00:00:00", "NaT"],
+            dtype="datetime64[ns]",
+        )
+        s.register_table(
+            "ev",
+            ht.Table.from_dict(
+                {
+                    "event_time": times,
+                    "v": np.array([1.0, 2.0, 3.0, 4.0]),
+                }
+            ),
+        )
+        yield s
+        s.stop()
+
+    def test_date_trunc_units(self, tt):
+        r = tt.sql(
+            "SELECT date_trunc('year', event_time) AS y, "
+            "date_trunc('quarter', event_time) AS q, "
+            "date_trunc('month', event_time) AS m, "
+            "date_trunc('week', event_time) AS w, "
+            "date_trunc('day', event_time) AS d, "
+            "date_trunc('hour', event_time) AS h, "
+            "date_trunc('minute', event_time) AS mi FROM ev"
+        )
+        def col(name):
+            return r.column(name).astype("datetime64[s]")
+        np.testing.assert_array_equal(
+            col("y")[:2], np.array(["2025-01-01T00:00:00"] * 2, "datetime64[s]")
+        )
+        np.testing.assert_array_equal(
+            col("q")[:3],
+            np.array(["2025-01-01", "2025-04-01", "2025-04-01"], "datetime64[s]"),
+        )
+        np.testing.assert_array_equal(
+            col("m")[:2],
+            np.array(["2025-03-01", "2025-04-01"], "datetime64[s]"),
+        )
+        # Spark weeks start Monday: 2025-03-31 IS a Monday; 2025-04-01
+        # (Tue) truncates back to it; 2025-06-15 is a Sunday -> 06-09
+        np.testing.assert_array_equal(
+            col("w")[:3],
+            np.array(["2025-03-31", "2025-03-31", "2025-06-09"], "datetime64[s]"),
+        )
+        np.testing.assert_array_equal(
+            col("h")[0], np.datetime64("2025-03-31T22:00:00", "s")
+        )
+        np.testing.assert_array_equal(
+            col("mi")[0], np.datetime64("2025-03-31T22:15:00", "s")
+        )
+        for name in ("y", "q", "m", "w", "d", "h", "mi"):
+            assert np.isnat(r.column(name)[3]), name
+
+    def test_date_trunc_bad_unit_and_nonliteral(self, tt):
+        with pytest.raises(ValueError, match="DATE_TRUNC"):
+            tt.sql("SELECT date_trunc('fortnight', event_time) AS x FROM ev")
+        with pytest.raises(ValueError, match="DATE_TRUNC"):
+            tt.sql("SELECT date_trunc(v, event_time) AS x FROM ev")
+
+    def test_unix_timestamp(self, tt):
+        r = tt.sql("SELECT unix_timestamp(event_time) AS ut FROM ev")
+        ut = r.column("ut")
+        expect = np.array(
+            ["2025-03-31T22:15:42", "2025-04-01T01:02:03"], "datetime64[s]"
+        ).astype(np.int64)
+        np.testing.assert_allclose(ut[:2], expect)
+        assert np.isnan(ut[3])
+        # non-timestamp argument is a labeled analysis error
+        with pytest.raises(ValueError, match="UNIX_TIMESTAMP"):
+            tt.sql("SELECT unix_timestamp(v) AS x FROM ev")
+
+    def test_datediff_col_vs_literal_and_null(self, tt):
+        r = tt.sql(
+            "SELECT datediff(event_time, '2025-03-30') AS dd, "
+            "datediff('2025-04-10', event_time) AS rev FROM ev"
+        )
+        np.testing.assert_allclose(r.column("dd")[:3], [1.0, 2.0, 77.0])
+        np.testing.assert_allclose(r.column("rev")[:3], [10.0, 9.0, -66.0])
+        assert np.isnan(r.column("dd")[3])
+
+    def test_datediff_in_arithmetic(self, tt):
+        # scalar fns compose with arithmetic in the select list
+        r = tt.sql(
+            "SELECT v * datediff(event_time, '2025-03-30') AS scaled FROM ev"
+        )
+        np.testing.assert_allclose(r.column("scaled")[:2], [1.0, 4.0])

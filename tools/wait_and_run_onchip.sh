@@ -1,49 +1,7 @@
 #!/bin/bash
-# Probe the TPU tunnel on a spaced cadence; when it answers, run the
-# round-5 on-chip measurement queue:
-#   1. Lloyd sums-matmul variant timing (tools/opt_lloyd_r05.py)
-#   2. bench gbt20  — quantifies the deferred-fetch boosting win
-#   3. bench gmm32  — quantifies the bf16 factor-form E-step A/B
-# Bench rows append to tools/bench_onchip_r05_session2.jsonl.  Each step
-# is bounded so a dropped tunnel costs one subprocess; completed steps
-# are skipped on retry via marker files.
-LOG=tools/opt_wait.log
-OUT=tools/bench_onchip_r05_session2.jsonl
+# Thin wrapper — the tunnel-watcher now lives in `bench.py --watch`
+# (probe cadence, per-config watchdogs, on-chip-row done markers, cache
+# reuse; see watch_main() there).  Env knobs: BENCH_WATCH_OUT,
+# BENCH_WATCH_CONFIGS, BENCH_WATCH_ATTEMPTS, BENCH_WATCH_SLEEP.
 cd /root/repo
-for i in $(seq 1 60); do
-  # never compete with a driver-initiated bench run for the chip (this
-  # bash script's own cmdline never matches the pattern, and its bench
-  # children only exist inside a step, not at loop top)
-  if pgrep -f "python bench.py" >/dev/null; then
-    echo "$(date -u +%FT%T) driver bench running — standing down" >> "$LOG"
-    exit 0
-  fi
-  echo "$(date -u +%FT%T) probe attempt $i" >> "$LOG"
-  if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "$(date -u +%FT%T) tunnel UP" >> "$LOG"
-    if [ ! -f tools/.done_variants ]; then
-      timeout 900 python -u tools/opt_lloyd_r05.py 10000000 >> "$LOG" 2>&1 \
-        && touch tools/.done_variants
-      echo "$(date -u +%FT%T) variants rc=$?" >> "$LOG"
-    fi
-    # bench.py exits 0 BY DESIGN even on failure/CPU fallback — gate the
-    # done markers on an actual on-chip row landing in the jsonl instead
-    if [ ! -f tools/.done_gbt20 ]; then
-      timeout 900 env BENCH_CONFIG=gbt20 python bench.py >> "$OUT" 2>>"$LOG"
-      echo "$(date -u +%FT%T) gbt20 rc=$?" >> "$LOG"
-      grep -q 'GBT.*"platform": "tpu"' "$OUT" && touch tools/.done_gbt20
-    fi
-    if [ ! -f tools/.done_gmm32 ]; then
-      timeout 1200 env BENCH_CONFIG=gmm32 python bench.py >> "$OUT" 2>>"$LOG"
-      echo "$(date -u +%FT%T) gmm32 rc=$?" >> "$LOG"
-      grep -q 'GaussianMixture.*"platform": "tpu"' "$OUT" && touch tools/.done_gmm32
-    fi
-    if [ -f tools/.done_variants ] && [ -f tools/.done_gbt20 ] && [ -f tools/.done_gmm32 ]; then
-      echo "$(date -u +%FT%T) all on-chip steps done" >> "$LOG"
-      exit 0
-    fi
-  fi
-  sleep 300
-done
-echo "$(date -u +%FT%T) gave up after 60 attempts" >> "$LOG"
-exit 1
+exec python bench.py --watch "$@"
