@@ -1,11 +1,15 @@
 from .csv import read_csv, read_csv_dir, write_csv
 from .libsvm import read_libsvm, write_libsvm
 from .fit_checkpoint import FitCheckpointer
-from .model_io import load_model, register_model, save_model
+from .integrity import crc32c, crc32c_hex
+from .model_io import CorruptArtifactError, load_model, register_model, save_model
 from .native import native_available
 
 __all__ = [
+    "CorruptArtifactError",
     "FitCheckpointer",
+    "crc32c",
+    "crc32c_hex",
     "read_csv",
     "read_csv_dir",
     "write_csv",

@@ -24,11 +24,19 @@ signature raises instead of silently continuing the wrong run.
 from __future__ import annotations
 
 import hashlib
+import io as _io
 import json
 import os
 import shutil
 
 import numpy as np
+
+from ..utils.faults import fault_point, mangle_bytes
+from ..utils.logging import get_logger
+from .integrity import checksum_record, verify_bytes
+from .model_io import CorruptArtifactError
+
+log = get_logger("io")
 
 COMMIT_FILE = "COMMIT"
 
@@ -119,12 +127,22 @@ class FitCheckpointer:
         os.makedirs(tmp_dir)
         # fsync the npz payload itself — without it the COMMIT rename can
         # survive power loss while the array data blocks do not.
+        fault_point("fit_ckpt.save.arrays", path=self.path, step=step)
+        buf = _io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        data = buf.getvalue()
         with open(os.path.join(tmp_dir, "arrays.npz"), "wb") as f:
-            np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+            # checksum the INTENDED bytes, mangle only what hits the disk
+            f.write(mangle_bytes("fit_ckpt.save.arrays", data, path=self.path))
             f.flush()
             os.fsync(f.fileno())
         _atomic_write_json(
-            os.path.join(tmp_dir, "meta.json"), {"step": step, "extra": extra or {}}
+            os.path.join(tmp_dir, "meta.json"),
+            {
+                "step": step,
+                "extra": extra or {},
+                "integrity": {"arrays.npz": checksum_record(data)},
+            },
         )
         old_dir = None
         if os.path.exists(step_dir):
@@ -138,10 +156,12 @@ class FitCheckpointer:
         os.replace(tmp_dir, step_dir)
         _fsync_dir(self.path)
         # the commit point — everything above is invisible until this lands
+        fault_point("fit_ckpt.save.commit", path=self.path, step=step)
         _atomic_write_json(
             os.path.join(self.path, COMMIT_FILE),
             {"step": step, "signature": self.signature},
         )
+        fault_point("fit_ckpt.post_commit", path=self.path, step=step)
         if old_dir is not None:
             shutil.rmtree(old_dir, ignore_errors=True)
         self._prune(keep_latest=step)
@@ -169,9 +189,44 @@ class FitCheckpointer:
         return out
 
     # -- read -----------------------------------------------------------
+    def _load_step(self, step: int):
+        """Read + verify one committed step.  Raises CorruptArtifactError
+        on checksum/size mismatch, torn meta, or an undecodable payload."""
+        step_dir = os.path.join(self.path, f"step-{step}")
+        try:
+            with open(os.path.join(step_dir, "meta.json")) as f:
+                meta = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise CorruptArtifactError(
+                f"step-{step} meta.json at {self.path!r} is unreadable: {e}"
+            ) from e
+        with open(os.path.join(step_dir, "arrays.npz"), "rb") as f:
+            data = f.read()
+        rec = (meta.get("integrity") or {}).get("arrays.npz")
+        if rec is not None:
+            problem = verify_bytes(data, rec)
+            if problem is not None:
+                raise CorruptArtifactError(
+                    f"step-{step} arrays.npz at {self.path!r} failed "
+                    f"integrity verification ({problem})"
+                )
+        try:
+            with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+                arrays = {k: z[k] for k in z.files}
+        except Exception as e:  # noqa: BLE001
+            raise CorruptArtifactError(
+                f"step-{step} arrays.npz at {self.path!r} is undecodable: {e!r}"
+            ) from e
+        return arrays, meta.get("extra", {})
+
     def resume(self):
         """→ (step, arrays dict, extra dict) from the last commit, or None
-        if no commit exists.  Raises ValueError on signature mismatch."""
+        if no commit exists.  Raises ValueError on signature mismatch.
+
+        A corrupted committed step (bit rot after commit) falls back to
+        the newest OLDER retained step that verifies — losing a few
+        iterations, not the whole fit; only when no retained step is
+        intact does :class:`CorruptArtifactError` propagate."""
         commit_path = os.path.join(self.path, COMMIT_FILE)
         if not os.path.exists(commit_path):
             return None
@@ -184,13 +239,34 @@ class FitCheckpointer:
                 f"({commit.get('signature')!r} != {self.signature!r}); "
                 "point checkpoint_dir at a fresh directory or delete it"
             )
-        step = int(commit["step"])
-        step_dir = os.path.join(self.path, f"step-{step}")
-        with np.load(os.path.join(step_dir, "arrays.npz")) as z:
-            arrays = {k: z[k] for k in z.files}
-        with open(os.path.join(step_dir, "meta.json")) as f:
-            meta = json.load(f)
-        return step, arrays, meta.get("extra", {})
+        committed = int(commit["step"])
+        # newest-first candidates: the committed step, then older retained
+        # steps (never orphans NEWER than the commit point)
+        candidates = sorted(
+            (s for s in self._step_dirs() if s <= committed), reverse=True
+        )
+        last_err: CorruptArtifactError | None = None
+        for step in candidates:
+            try:
+                arrays, extra = self._load_step(step)
+            except (CorruptArtifactError, OSError) as e:
+                last_err = e if isinstance(e, CorruptArtifactError) else (
+                    CorruptArtifactError(str(e))
+                )
+                log.warning(
+                    "corrupt fit-checkpoint step, trying previous commit",
+                    path=self.path, step=step, error=str(e),
+                )
+                continue
+            if step != committed:
+                log.warning(
+                    "resumed from older intact step after corruption",
+                    path=self.path, committed=committed, resumed=step,
+                )
+            return step, arrays, extra
+        raise last_err or CorruptArtifactError(
+            f"no intact committed step found at {self.path!r}"
+        )
 
     def clear(self) -> None:
         shutil.rmtree(self.path, ignore_errors=True)

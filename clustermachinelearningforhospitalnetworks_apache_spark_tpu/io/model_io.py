@@ -5,16 +5,31 @@ Parity with MLlib's ``model.write().overwrite().save(path)`` at reference
 Parquet coefficient/tree-node files plus JSON metadata to HDFS.  Here a
 model artifact is a directory containing
 
-    metadata.json   — model class, framework version, params
+    metadata.json   — model class, framework version, params,
+                      integrity manifest (CRC32C + size per payload)
     arrays.npz      — every ndarray leaf of the model's pytree
 
 with the same overwrite-or-fail-if-exists semantics.  A registry maps the
 class name in metadata back to the Python class on load, so
 ``load_model(path)`` round-trips any registered model.
+
+Durability contract (chaos-tested in tests/test_chaos.py):
+
+* a save is **staged** into ``<path>.staging`` and installed with two
+  renames (displace the old artifact to ``<path>.old``, install the new
+  one) — a crash at any point leaves either the previous committed
+  artifact or the new one recoverable, never a half-written mix;
+* :func:`load_model` repairs a crashed swap (restores a displaced
+  artifact whose replacement never landed) before reading;
+* payload bytes are checksummed (CRC32C) into the metadata manifest at
+  save and verified at load, so bit rot or truncation raises a typed
+  :class:`CorruptArtifactError` at the boundary instead of a shape error
+  deep inside JAX.
 """
 
 from __future__ import annotations
 
+import io as _io
 import json
 import os
 import shutil
@@ -22,7 +37,17 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..utils.faults import fault_point, mangle_bytes
+from ..utils.logging import get_logger
 from ..version import __version__
+from .integrity import checksum_record, verify_bytes
+
+log = get_logger("io")
+
+
+class CorruptArtifactError(RuntimeError):
+    """A persisted artifact failed integrity verification (checksum/size
+    mismatch, unreadable payload, torn metadata)."""
 
 _REGISTRY: dict[str, Callable[[dict, dict], Any]] = {}
 
@@ -90,48 +115,174 @@ def register_model(name: str):
     return deco
 
 
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+#: sentinel dropped by prepare_artifact_dir and removed by
+#: finalize_artifact_dir — its presence marks a torn in-place save
+INCOMPLETE_SENTINEL = ".incomplete"
+
+
+def repair_artifact_dir(path: str) -> None:
+    """Undo/finish a crashed save so the committed artifact (if any) is
+    loadable again:
+
+    * ``<path>`` carrying the :data:`INCOMPLETE_SENTINEL` is a torn
+      in-place (composite) save — discard it;
+    * a committed artifact displaced to ``<path>.old`` whose replacement
+      never landed (or was just discarded) IS the artifact — restore it.
+    """
+    old = path + ".old"
+    if os.path.isdir(path) and os.path.exists(
+        os.path.join(path, INCOMPLETE_SENTINEL)
+    ):
+        shutil.rmtree(path)
+        log.warning("discarded torn artifact from crashed save", path=path)
+    if os.path.exists(old) and not os.path.exists(path):
+        os.replace(old, path)
+        log.warning("restored displaced artifact after crashed save", path=path)
+
+
 def prepare_artifact_dir(path: str, overwrite: bool) -> None:
-    """Overwrite-or-fail semantics shared by every artifact writer."""
+    """Overwrite-or-fail semantics shared by the composite artifact
+    writers (pipelines, CV/TVS selection models, OneVsRest), which write
+    their layouts in place: the previous committed artifact is DISPLACED
+    to ``<path>.old`` (not destroyed), and the fresh directory carries a
+    sentinel until :func:`finalize_artifact_dir` commits it — so a crash
+    anywhere in between leaves the previous artifact recoverable."""
+    repair_artifact_dir(path)
     if os.path.exists(path):
         if not overwrite:
             raise FileExistsError(f"{path} exists and overwrite=False")
-        shutil.rmtree(path)
+        old = path + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.replace(path, old)
     os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, INCOMPLETE_SENTINEL), "w") as f:
+        f.write("")
+
+
+def finalize_artifact_dir(path: str) -> None:
+    """Commit an in-place (composite) save: drop the sentinel, make the
+    removal durable, then discard the displaced previous artifact."""
+    sentinel = os.path.join(path, INCOMPLETE_SENTINEL)
+    if os.path.exists(sentinel):
+        os.remove(sentinel)
+    _fsync_dir(path)
+    shutil.rmtree(path + ".old", ignore_errors=True)
 
 
 def write_metadata(path: str, meta: dict) -> None:
-    """Atomic metadata.json write (tmp file + rename)."""
+    """Atomic metadata.json write (tmp file + rename + fsync)."""
     tmp = path + ".tmp_meta"
     with open(tmp, "w") as f:
         json.dump(meta, f, indent=2, default=_json_default)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(path, METADATA_FILE))
 
 
+def _npz_bytes(arrays: dict[str, np.ndarray]) -> bytes:
+    buf = _io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
 def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarray], overwrite: bool = True) -> None:
-    prepare_artifact_dir(path, overwrite)
+    """Crash-consistent save: stage, checksum, then swap in two renames.
+
+    Either the previous committed artifact or the new one survives a
+    crash at any byte boundary — never a torn mix of the two."""
+    repair_artifact_dir(path)
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists and overwrite=False")
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+
+    staging = path + ".staging"
+    if os.path.exists(staging):
+        shutil.rmtree(staging)
+    os.makedirs(staging)
+    fault_point("model_io.save.arrays", path=path)
+    data = _npz_bytes(arrays)
+    with open(os.path.join(staging, ARRAYS_FILE), "wb") as f:
+        # the manifest checksums the INTENDED bytes; corrupt rules mangle
+        # only what reaches the disk — exactly the failure CRC32C catches
+        f.write(mangle_bytes("model_io.save.arrays", data, path=path))
+        f.flush()
+        os.fsync(f.fileno())
+    fault_point("model_io.save.meta", path=path)
     write_metadata(
-        path,
+        staging,
         {
             "model_class": name,
             "framework_version": __version__,
             "params": metadata,
+            "integrity": {ARRAYS_FILE: checksum_record(data)},
         },
     )
-    np.savez(os.path.join(path, ARRAYS_FILE), **{k: np.asarray(v) for k, v in arrays.items()})
+    _fsync_dir(staging)
+
+    # the swap: displace-then-install, each step atomic, recoverable from
+    # any crash point by repair_artifact_dir
+    fault_point("model_io.save.swap", path=path)
+    old = None
+    if os.path.exists(path):
+        old = path + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.replace(path, old)
+    os.replace(staging, path)
+    _fsync_dir(parent)
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
 
 
 def load_model(path: str) -> Any:
-    with open(os.path.join(path, METADATA_FILE)) as f:
-        meta = json.load(f)
+    """Load any saved artifact, verifying content checksums when the
+    manifest carries them.  Raises :class:`CorruptArtifactError` on torn
+    metadata, checksum/size mismatch, or an unreadable payload — and
+    repairs a crashed save's displaced artifact first."""
+    repair_artifact_dir(path)
+    try:
+        with open(os.path.join(path, METADATA_FILE)) as f:
+            meta = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CorruptArtifactError(
+            f"artifact metadata at {path!r} is unreadable: {e}"
+        ) from e
     if meta.get("model_class") in _COMPOSITE_LOADERS:
         # composite artifact (own directory layout): delegate so load_model
         # works uniformly on anything save()d by the framework
         return _load_composite(meta["model_class"], path, meta)
+    integrity = meta.get("integrity") or {}
     arrays_path = os.path.join(path, ARRAYS_FILE)
     arrays: dict[str, np.ndarray] = {}
     if os.path.exists(arrays_path):
-        with np.load(arrays_path, allow_pickle=False) as z:
-            arrays = {k: z[k] for k in z.files}
+        with open(arrays_path, "rb") as f:
+            data = f.read()
+        rec = integrity.get(ARRAYS_FILE)
+        if rec is not None:
+            problem = verify_bytes(data, rec)
+            if problem is not None:
+                raise CorruptArtifactError(
+                    f"artifact payload {ARRAYS_FILE} at {path!r} failed "
+                    f"integrity verification ({problem})"
+                )
+        try:
+            with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+                arrays = {k: z[k] for k in z.files}
+        except Exception as e:  # noqa: BLE001 — any npz decode failure is
+            # corruption from the caller's point of view
+            raise CorruptArtifactError(
+                f"artifact payload {ARRAYS_FILE} at {path!r} is undecodable: {e!r}"
+            ) from e
     name = meta["model_class"]
     if name not in _REGISTRY:
         raise KeyError(f"no registered model class {name!r}; known: {sorted(_REGISTRY)}")

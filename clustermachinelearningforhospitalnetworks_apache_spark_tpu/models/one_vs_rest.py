@@ -27,6 +27,7 @@ import numpy as np
 from ..io.model_io import (
     METADATA_FILE,
     load_model,
+    finalize_artifact_dir,
     prepare_artifact_dir,
     register_composite,
     save_model,
@@ -90,6 +91,7 @@ class OneVsRestModel(Model):
                 "model_dirs": dirs,
             },
         )
+        finalize_artifact_dir(path)  # commit: drop sentinel, discard .old
 
     @classmethod
     def load(cls, path: str, _meta: dict | None = None) -> "OneVsRestModel":

@@ -32,6 +32,7 @@ from ..io.model_io import (
     PIPELINE_CLASS as _PIPELINE_CLASS,
     is_composite,
     load_model,
+    finalize_artifact_dir,
     prepare_artifact_dir,
     save_model,
     validate_persistable,
@@ -129,6 +130,7 @@ class PipelineModel:
                 "stage_dirs": dirs,
             },
         )
+        finalize_artifact_dir(path)  # commit: drop sentinel, discard .old
 
     def write(self):
         from ..models.base import _Writer

@@ -8,6 +8,7 @@ ever recompiling in steady state:
   contract)
 * :mod:`batcher`   — adaptive micro-batching of single-row requests
 * :mod:`queue`     — bounded admission, deadlines, graceful degradation
+* :mod:`breaker`   — per-model circuit breaker (closed→open→half-open)
 * :mod:`scoring`   — sharded bulk scoring over the training data mesh
 * :mod:`metrics`   — p50/p99 latency, queue depth, fill ratio, recompiles
 * :mod:`server`    — the composed front door (:class:`InferenceServer`)
@@ -16,6 +17,12 @@ See docs/ARCHITECTURE.md §Serving layer for the design rationale.
 """
 
 from .batcher import DEFAULT_MAX_WAIT_S, MicroBatcher
+from .breaker import (
+    CircuitBreaker,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+)
 from .bucketing import DEFAULT_BUCKETS, bucket_for, fill_ratio, pad_to_bucket
 from .metrics import ServingMetrics
 from .queue import (
@@ -28,16 +35,22 @@ from .queue import (
     STATUS_OK,
     STATUS_REJECTED,
     STATUS_SHUTDOWN,
+    STATUS_UNAVAILABLE,
 )
 from .registry import ModelRegistry, ServingModel
 from .scoring import ShardedScorer, bulk_score
 from .server import InferenceServer
 
 __all__ = [
+    "CircuitBreaker",
     "DEFAULT_BUCKETS",
     "DEFAULT_MAX_WAIT_S",
     "DEGRADED_STATUSES",
     "InferenceServer",
+    "STATE_CLOSED",
+    "STATE_HALF_OPEN",
+    "STATE_OPEN",
+    "STATUS_UNAVAILABLE",
     "MicroBatcher",
     "ModelRegistry",
     "Request",

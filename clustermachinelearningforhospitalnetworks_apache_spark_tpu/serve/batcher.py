@@ -28,6 +28,7 @@ from typing import Callable, Union
 import numpy as np
 
 from ..utils.logging import get_logger
+from .breaker import CircuitBreaker
 from .metrics import ServingMetrics
 from .queue import (
     DEGRADED_STATUSES,
@@ -35,10 +36,10 @@ from .queue import (
     RequestQueue,
     ServeResult,
     STATUS_DEADLINE_EXCEEDED,
-    STATUS_ERROR,
     STATUS_OK,
     STATUS_REJECTED,
     STATUS_SHUTDOWN,
+    STATUS_UNAVAILABLE,
 )
 from .registry import ServingModel
 
@@ -70,12 +71,16 @@ class MicroBatcher:
         max_wait_s: float = DEFAULT_MAX_WAIT_S,
         fallback: Fallback = None,
         metrics: ServingMetrics | None = None,
+        breaker: CircuitBreaker | None = None,
     ):
         self.model = model
         self.metrics = metrics or model.metrics
         self.queue = RequestQueue(max_rows=max_queue_rows)
         self.max_wait_s = max_wait_s
         self.fallback = fallback
+        #: wraps the primary executable: repeated failures OPEN it and
+        #: requests short-circuit to the fallback without device time
+        self.breaker = breaker
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -175,17 +180,26 @@ class MicroBatcher:
             self._execute(live)
 
     def _execute(self, live: list[Request]) -> None:
+        if self.breaker is not None and not self.breaker.allow():
+            # circuit open: the primary doesn't even see the batch —
+            # every waiter gets a fallback answer immediately
+            for r in live:
+                self._answer_degraded(r, STATUS_UNAVAILABLE, "circuit open")
+            return
         rows = np.concatenate([r.x for r in live], axis=0)
         try:
             preds = self.model.predict_bucketed(rows)
         except Exception as e:  # noqa: BLE001 — a poisoned batch must
             # answer every waiter, not kill the worker thread
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            self.metrics.record_primary_failure()
             log.error("batch predict failed", error=repr(e), rows=rows.shape[0])
             for r in live:
-                r.complete(
-                    ServeResult(None, STATUS_ERROR, detail=repr(e))
-                )
+                self._answer_degraded(r, STATUS_UNAVAILABLE, repr(e))
             return
+        if self.breaker is not None:
+            self.breaker.record_success()
         s = 0
         for r in live:
             r.complete(ServeResult(preds[s : s + r.rows], STATUS_OK))
@@ -208,6 +222,8 @@ class MicroBatcher:
                 degraded = True
             except Exception as e:  # noqa: BLE001 — degradation must not raise
                 log.warning("fallback failed", error=repr(e))
+        if degraded:
+            self.metrics.record_fallback_answer()
         req.complete(
             ServeResult(value, status, degraded=degraded, detail=detail)
         )

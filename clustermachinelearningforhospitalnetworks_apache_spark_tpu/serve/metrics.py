@@ -68,6 +68,21 @@ class ServingMetrics:
                 "serve.warmup_compiles" if warm else "serve.recompiles"
             )
 
+    def record_primary_failure(self) -> None:
+        """A primary-model executable raised — the breaker's raw signal."""
+        with self._lock:
+            self.registry.inc("serve.primary_failures")
+
+    def record_fallback_answer(self) -> None:
+        """A degraded request was answered by the fallback path."""
+        with self._lock:
+            self.registry.inc("serve.fallback_answers")
+
+    def record_breaker_transition(self, old: str, new: str) -> None:
+        with self._lock:
+            self.registry.inc("serve.breaker_transitions")
+            self.registry.inc(f"serve.breaker.to_{new}")
+
     def set_queue_depth(self, rows: int) -> None:
         with self._lock:
             self.registry.set("serve.queue_depth_rows", float(rows))
@@ -107,6 +122,9 @@ class ServingMetrics:
             "queue_depth_peak": self.registry.gauges.get(
                 "serve.queue_depth_peak", 0.0
             ),
+            "primary_failures": int(c.get("serve.primary_failures", 0)),
+            "fallback_answers": int(c.get("serve.fallback_answers", 0)),
+            "breaker_transitions": int(c.get("serve.breaker_transitions", 0)),
             "statuses": {
                 k.split(".", 2)[2]: int(v)
                 for k, v in c.items()

@@ -32,11 +32,17 @@ import numpy as np
 STATUS_OK = "ok"
 STATUS_REJECTED = "rejected"             # queue saturated at admission
 STATUS_DEADLINE_EXCEEDED = "deadline_exceeded"
+#: legacy (PR 1): no longer emitted — primary failures now answer
+#: ``unavailable`` through the fallback/breaker path; kept exported so
+#: clients that branched on it keep importing
 STATUS_ERROR = "error"
+STATUS_UNAVAILABLE = "unavailable"       # primary failed / circuit open
 STATUS_SHUTDOWN = "shutdown"
 
 #: statuses answered by the fallback path (degraded but not failed)
-DEGRADED_STATUSES = (STATUS_REJECTED, STATUS_DEADLINE_EXCEEDED)
+DEGRADED_STATUSES = (
+    STATUS_REJECTED, STATUS_DEADLINE_EXCEEDED, STATUS_UNAVAILABLE,
+)
 
 
 @dataclass

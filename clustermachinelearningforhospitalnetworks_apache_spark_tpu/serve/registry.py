@@ -26,6 +26,7 @@ import numpy as np
 
 from ..io.model_io import load_model
 from ..models.base import Model
+from ..utils.faults import fault_point
 from ..utils.logging import get_logger
 from .bucketing import (
     DEFAULT_BUCKETS,
@@ -110,6 +111,11 @@ class ServingModel:
         if x.ndim == 1:
             x = x[None, :]
         n = x.shape[0]
+        # the primary-model fault site: chaos tests fail the executable
+        # here to drive the batcher's circuit breaker
+        fault_point(
+            "serve.predict", model=type(self.model).__name__, rows=n
+        )
         b = bucket_for(n, self.buckets)
         with self._lock:
             if b not in self._warmed:

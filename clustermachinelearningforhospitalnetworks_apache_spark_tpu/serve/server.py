@@ -15,7 +15,9 @@ import numpy as np
 
 from ..models.base import Model
 from ..utils.logging import get_logger
+from ..utils.metrics import MetricsRegistry
 from .batcher import DEFAULT_MAX_WAIT_S, Fallback, MicroBatcher
+from .breaker import STATE_CLOSED, CircuitBreaker
 from .bucketing import DEFAULT_BUCKETS
 from .metrics import ServingMetrics
 from .queue import ServeResult
@@ -25,21 +27,45 @@ log = get_logger("serve")
 
 
 class InferenceServer:
-    """Online inference over one or more registered models."""
+    """Online inference over one or more registered models.
+
+    Every model is served behind its own :class:`CircuitBreaker` —
+    repeated primary failures open it and requests degrade straight to
+    the model's fallback instead of paying the failure each time.
+    ``ingest_metrics`` (optional) folds the streaming pipeline's registry
+    into :meth:`health`, so one snapshot covers quarantined batches and
+    source retries alongside breaker states.
+    """
 
     def __init__(
         self,
         registry: ModelRegistry | None = None,
         max_queue_rows: int = 4096,
         max_wait_s: float = DEFAULT_MAX_WAIT_S,
+        breaker_failure_threshold: int = 5,
+        breaker_recovery_s: float = 5.0,
+        ingest_metrics: MetricsRegistry | None = None,
     ):
         self.registry = registry or ModelRegistry()
         self.metrics: ServingMetrics = self.registry.metrics
         self.max_queue_rows = max_queue_rows
         self.max_wait_s = max_wait_s
+        self.breaker_failure_threshold = breaker_failure_threshold
+        self.breaker_recovery_s = breaker_recovery_s
+        self.ingest_metrics = ingest_metrics
         self._batchers: dict[str, MicroBatcher] = {}
         self._fallbacks: dict[str, Fallback] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
         self._started = False
+
+    def _breaker_for(self, name: str) -> CircuitBreaker:
+        if name not in self._breakers:
+            self._breakers[name] = CircuitBreaker(
+                failure_threshold=self.breaker_failure_threshold,
+                recovery_timeout_s=self.breaker_recovery_s,
+                on_transition=self.metrics.record_breaker_transition,
+            )
+        return self._breakers[name]
 
     # ------------------------------------------------------------ setup
     def add_model(
@@ -66,7 +92,7 @@ class InferenceServer:
             self._batchers[name] = MicroBatcher(
                 sm, max_queue_rows=self.max_queue_rows,
                 max_wait_s=self.max_wait_s, fallback=fallback,
-                metrics=self.metrics,
+                metrics=self.metrics, breaker=self._breaker_for(name),
             ).start()
         return sm
 
@@ -82,7 +108,7 @@ class InferenceServer:
                     sm, max_queue_rows=self.max_queue_rows,
                     max_wait_s=self.max_wait_s,
                     fallback=self._fallbacks.get(name),
-                    metrics=self.metrics,
+                    metrics=self.metrics, breaker=self._breaker_for(name),
                 ).start()
         self._started = True
         log.info("inference server started", models=len(self._batchers))
@@ -129,7 +155,37 @@ class InferenceServer:
                 "n_features": b.model.n_features,
                 "queue_depth_rows": b.queue.depth_rows,
                 "jit_cache_size": b.model.jit_cache_size(),
+                "breaker": self._breakers[name].state
+                if name in self._breakers else None,
             }
             for name, b in self._batchers.items()
         }
         return out
+
+    def health(self) -> dict[str, Any]:
+        """Liveness/degradation snapshot: breaker state per model plus the
+        self-healing counters (quarantined batches, retry totals) — what a
+        ``/healthz`` endpoint or an orchestrator's probe would poll."""
+        breakers = {name: b.snapshot() for name, b in self._breakers.items()}
+        degraded = any(b["state"] != STATE_CLOSED for b in breakers.values())
+        serve_c = self.metrics.registry.counters
+        ingest_c = (
+            self.ingest_metrics.counters if self.ingest_metrics is not None
+            else serve_c  # a shared registry folds ingest counters in
+        )
+        return {
+            "status": (
+                "stopped" if not self._started
+                else "degraded" if degraded else "ok"
+            ),
+            "started": self._started,
+            "models_serving": sorted(self._batchers),
+            "breakers": breakers,
+            "quarantined_batches": int(ingest_c.get("stream.quarantined", 0)),
+            "retry_totals": {
+                "source_reads": int(ingest_c.get("stream.retries", 0)),
+                "batch_replays": int(ingest_c.get("stream.batch_failures", 0)),
+                "primary_failures": int(serve_c.get("serve.primary_failures", 0)),
+            },
+            "fallback_answers": int(serve_c.get("serve.fallback_answers", 0)),
+        }

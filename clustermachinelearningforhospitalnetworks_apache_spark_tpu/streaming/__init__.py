@@ -2,9 +2,11 @@ from .source import FileStreamSource
 from .watermark import WatermarkTracker
 from .unbounded_table import UnboundedTable
 from .checkpoint import StreamCheckpoint
-from .microbatch import BatchInfo, StreamExecution
+from .microbatch import BATCH_OK, BATCH_QUARANTINED, BatchInfo, StreamExecution
 
 __all__ = [
+    "BATCH_OK",
+    "BATCH_QUARANTINED",
     "FileStreamSource",
     "WatermarkTracker",
     "UnboundedTable",

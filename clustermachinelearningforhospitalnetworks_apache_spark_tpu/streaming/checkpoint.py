@@ -1,4 +1,4 @@
-"""Streaming checkpoint: offsets WAL + commits, Spark-style.
+"""Streaming checkpoint: offsets WAL + commits + replay attempts, Spark-style.
 
 Parity with ``option("checkpointLocation", …)`` at reference
 ``mllearnforhospitalnetwork.py:43,:114`` (SURVEY.md §5 checkpoint/resume).
@@ -7,14 +7,24 @@ batch WILL process, plus watermark state) before running the batch, and a
 *commits* entry after the sink accepts it.  On restart, an offsets entry
 with no matching commit is replayed with exactly the same inputs —
 that is the exactly-once recipe, reproduced here with two JSON-line logs.
+
+A third log, ``attempts.log``, records every *try* at a batch (one line
+per attempt, surviving crashes like the other two) so a poison batch that
+kills the process on every replay is recognized across restarts and
+quarantined — written to ``<ckpt>/quarantine/batch-<id>.json`` and
+committed as skipped — instead of wedging the stream forever.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from dataclasses import dataclass
 
 from .wal import append_line as _append_line, read_lines as _read_lines
+
+QUARANTINE_DIR = "quarantine"
 
 
 @dataclass
@@ -25,6 +35,11 @@ class StreamCheckpoint:
         os.makedirs(self.path, exist_ok=True)
         self._offsets = os.path.join(self.path, "offsets.log")
         self._commits = os.path.join(self.path, "commits.log")
+        self._attempts = os.path.join(self.path, "attempts.log")
+        self._attempt_counts: dict[int, int] = {}
+        for e in _read_lines(self._attempts):
+            bid = int(e["batch_id"])
+            self._attempt_counts[bid] = self._attempt_counts.get(bid, 0) + 1
 
     # write-ahead intent -----------------------------------------------
     def write_offsets(self, batch_id: int, files: list[str], watermark_state: dict) -> None:
@@ -33,8 +48,73 @@ class StreamCheckpoint:
             {"batch_id": batch_id, "files": files, "watermark": watermark_state},
         )
 
-    def write_commit(self, batch_id: int) -> None:
-        _append_line(self._commits, {"batch_id": batch_id})
+    def write_commit(self, batch_id: int, quarantined: bool = False) -> None:
+        entry: dict = {"batch_id": batch_id}
+        if quarantined:
+            entry["quarantined"] = True
+        _append_line(self._commits, entry)
+
+    def record_attempt(self, batch_id: int) -> int:
+        """Durably log one try at ``batch_id``; → total attempts so far
+        (including crashes in previous incarnations of the process)."""
+        _append_line(self._attempts, {"batch_id": batch_id})
+        n = self._attempt_counts.get(batch_id, 0) + 1
+        self._attempt_counts[batch_id] = n
+        return n
+
+    def attempts(self, batch_id: int) -> int:
+        return self._attempt_counts.get(batch_id, 0)
+
+    # quarantine --------------------------------------------------------
+    def quarantine(
+        self,
+        batch_id: int,
+        files: list[str],
+        attempts: int,
+        error: str,
+        sink_rows_visible: bool = False,
+    ) -> str:
+        """Persist the poison batch's evidence (atomically — a quarantine
+        record must never itself be torn) and return its path."""
+        qdir = os.path.join(self.path, QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        p = os.path.join(qdir, f"batch-{batch_id:010d}.json")
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "batch_id": batch_id,
+                    "files": files,
+                    "attempts": attempts,
+                    "error": error,
+                    "sink_rows_visible": sink_rows_visible,
+                    "quarantined_at": time.time(),
+                },
+                f,
+                indent=2,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+        return p
+
+    def quarantined(self) -> list[dict]:
+        qdir = os.path.join(self.path, QUARANTINE_DIR)
+        if not os.path.isdir(qdir):
+            return []
+        out = []
+        for name in sorted(os.listdir(qdir)):
+            if not (name.startswith("batch-") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(qdir, name)) as f:
+                    out.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out
+
+    def quarantine_count(self) -> int:
+        return len(self.quarantined())
 
     # recovery ----------------------------------------------------------
     def recover(self) -> dict:

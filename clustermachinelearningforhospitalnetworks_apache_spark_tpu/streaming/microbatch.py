@@ -11,14 +11,33 @@ callback (e.g. StreamingKMeans.update, or the per-batch model training the
 dead ``ML()``/``train_model_on_batch`` hook aspired to, C6/D2).
 
 Batch lifecycle (exactly-once, SURVEY.md §5):
-    poll files → WRITE OFFSETS (intent + watermark state) → read → watermark
-    filter → foreach_batch → append part file → WRITE COMMIT → mark files.
+    poll files → WRITE OFFSETS (intent + watermark state) → record attempt
+    → read → watermark filter → foreach_batch → append part file →
+    WRITE COMMIT → mark files.
 A crash after offsets but before commit replays the identical batch on
 restart; a crash after commit skips it.
+
+Self-healing (the fault-tolerance layer over that lifecycle):
+
+* every attempt at a batch is durably counted (``attempts.log``), so a
+  **poison batch** — one that fails ``max_batch_replays`` times, whether
+  by exception in-process or by killing the process each replay — is
+  **quarantined**: its evidence lands in ``<ckpt>/quarantine/``, the batch
+  is committed as skipped, and the stream makes progress instead of
+  wedging forever (``stream.quarantined`` counts them);
+* transient in-process failures back off exponentially with jitter
+  between replays (``stream.batch_failures`` counts them);
+* per-file source reads retry independently (see ``source.py``).
+
+Named fault sites (``utils/faults.py``) bracket every WAL boundary —
+``stream.after_offsets`` / ``after_read`` / ``after_foreach`` /
+``after_sink`` / ``after_commit`` — so ``tests/test_chaos.py`` can kill
+the run at each one and assert crash-consistent resume.
 """
 
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable
@@ -26,13 +45,19 @@ from typing import Callable
 import numpy as np
 
 from ..core.table import Table
+from ..utils.faults import fault_point
 from ..utils.logging import get_logger
+from ..utils.metrics import MetricsRegistry
+from ..utils.retry import DEFAULT_REPLAY_BACKOFF, RetryPolicy
 from .checkpoint import StreamCheckpoint
 from .source import FileStreamSource
 from .unbounded_table import UnboundedTable
 from .watermark import WatermarkTracker
 
 log = get_logger("streaming")
+
+BATCH_OK = "ok"
+BATCH_QUARANTINED = "quarantined"
 
 
 @dataclass
@@ -42,6 +67,7 @@ class BatchInfo:
     num_late_rows: int
     num_appended_rows: int
     files: list[str]
+    status: str = BATCH_OK
 
 
 @dataclass
@@ -52,14 +78,27 @@ class StreamExecution:
     watermark: WatermarkTracker | None = None
     foreach_batch: Callable[[Table, int], None] | None = None
     add_ingest_time: bool = True
+    #: total tries a batch gets — across replays AND process restarts —
+    #: before it is quarantined instead of replayed forever
+    max_batch_replays: int = 3
+    replay_backoff: RetryPolicy = DEFAULT_REPLAY_BACKOFF
+    metrics: MetricsRegistry = field(default_factory=MetricsRegistry)
     history: list[BatchInfo] = field(default_factory=list)
     _next_batch_id: int = 0
     _pending: dict | None = None
+    # entropy-seeded: replaying drivers must not back off in lockstep
+    _rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def __post_init__(self) -> None:
+        if self.max_batch_replays < 1:
+            raise ValueError(
+                f"max_batch_replays must be >= 1, got {self.max_batch_replays}"
+            )
         state = self.checkpoint.recover()
         self._next_batch_id = state["next_batch_id"]
         self.source.restore(state["processed_files"])
+        if self.source.metrics is None:
+            self.source.metrics = self.metrics
         if self.watermark is not None and state["watermark_state"]:
             self.watermark.restore(state["watermark_state"])
         self._pending = state["pending"]
@@ -72,14 +111,18 @@ class StreamExecution:
 
     # ------------------------------------------------------------ core
     def run_once(self) -> BatchInfo | None:
-        """Process at most one micro-batch; None if no new data."""
+        """Process at most one micro-batch; None if no new data.
+
+        A failing batch is retried with backoff up to ``max_batch_replays``
+        total attempts (the durable attempt count includes crashed
+        incarnations), then quarantined.  An :class:`InjectedCrash` — like
+        a real crash — propagates; the attempt it interrupted still counts
+        on resume."""
         if self._pending is not None:
             entry = self._pending
             batch_id = entry["batch_id"]
             files = entry["files"]
-            # replay with the watermark state recorded at intent time
-            if self.watermark is not None and entry.get("watermark"):
-                self.watermark.restore(entry["watermark"])
+            wm_state = entry.get("watermark") or {}
         else:
             files = self.source.poll()
             if not files:
@@ -88,7 +131,52 @@ class StreamExecution:
             wm_state = self.watermark.state() if self.watermark else {}
             self.checkpoint.write_offsets(batch_id, files, wm_state)
 
+        if self.checkpoint.attempts(batch_id) >= self.max_batch_replays:
+            # a batch whose every replay KILLED the process arrives here
+            # with its attempt budget already spent — quarantine without
+            # giving it another shot at the process's life
+            info = self._quarantine(
+                batch_id, files, self.checkpoint.attempts(batch_id),
+                RuntimeError("batch crashed the process on every replay"),
+            )
+            self._pending = None
+            self._next_batch_id = batch_id + 1
+            self.history.append(info)
+            return info
+
+        while True:
+            attempts = self.checkpoint.record_attempt(batch_id)
+            try:
+                info = self._attempt(batch_id, files, wm_state)
+                break
+            except Exception as e:  # noqa: BLE001 — InjectedCrash is a
+                # BaseException and rightly flies past this handler
+                self.metrics.inc("stream.batch_failures")
+                log.warning(
+                    "batch attempt failed",
+                    batch_id=batch_id, attempt=attempts,
+                    max_attempts=self.max_batch_replays, error=repr(e),
+                )
+                if attempts >= self.max_batch_replays:
+                    info = self._quarantine(batch_id, files, attempts, e)
+                    break
+                time.sleep(self.replay_backoff.delay_for(attempts, self._rng))
+
+        self._pending = None
+        self._next_batch_id = batch_id + 1
+        self.history.append(info)
+        return info
+
+    def _attempt(self, batch_id: int, files: list[str], wm_state: dict) -> BatchInfo:
+        """One try at the batch lifecycle, fault sites at every boundary."""
+        fault_point("stream.after_offsets", batch_id=batch_id)
+        # replay with the watermark state recorded at intent time (a replay
+        # must see the state the original attempt saw, not one advanced by
+        # a failed half-run)
+        if self.watermark is not None and wm_state:
+            self.watermark.restore(wm_state)
         table = self.source.read_files(files)
+        fault_point("stream.after_read", batch_id=batch_id)
         n_in = len(table)
         if self.add_ingest_time:
             # parity with withColumn("ingest_time", current_timestamp()) :82
@@ -102,12 +190,14 @@ class StreamExecution:
 
         if self.foreach_batch is not None:
             self.foreach_batch(table, batch_id)
+        fault_point("stream.after_foreach", batch_id=batch_id)
 
         self.sink.append_batch(table, batch_id)
+        fault_point("stream.after_sink", batch_id=batch_id)
         self.checkpoint.write_commit(batch_id)
+        fault_point("stream.after_commit", batch_id=batch_id)
         self.source.commit_files(files)
-        self._pending = None
-        self._next_batch_id = batch_id + 1
+        self.metrics.inc("stream.batches")
 
         info = BatchInfo(
             batch_id=batch_id,
@@ -116,7 +206,6 @@ class StreamExecution:
             num_appended_rows=len(table),
             files=files,
         )
-        self.history.append(info)
         log.info(
             "batch committed",
             batch_id=batch_id,
@@ -124,6 +213,37 @@ class StreamExecution:
             late=dropped,
         )
         return info
+
+    def _quarantine(
+        self, batch_id: int, files: list[str], attempts: int, err: Exception
+    ) -> BatchInfo:
+        """Poison batch: record the evidence, commit the batch as skipped
+        (so recovery never replays it), and let the stream move on.
+
+        A failed attempt may have died AFTER the sink append landed (e.g.
+        the checkpoint commit write kept failing) — then the batch's rows
+        ARE visible in the table.  The quarantine record carries that
+        fact (``sink_rows_visible``) so an operator reprocessing the
+        quarantined files knows whether doing so would double-ingest."""
+        sink_visible = batch_id in self.sink.committed_batches()
+        qpath = self.checkpoint.quarantine(
+            batch_id, files, attempts, repr(err), sink_rows_visible=sink_visible
+        )
+        self.checkpoint.write_commit(batch_id, quarantined=True)
+        self.source.commit_files(files)
+        self.metrics.inc("stream.quarantined")
+        log.error(
+            "batch quarantined",
+            batch_id=batch_id, attempts=attempts, path=qpath, error=repr(err),
+        )
+        return BatchInfo(
+            batch_id=batch_id,
+            num_input_rows=0,
+            num_late_rows=0,
+            num_appended_rows=0,
+            files=files,
+            status=BATCH_QUARANTINED,
+        )
 
     def run(
         self,

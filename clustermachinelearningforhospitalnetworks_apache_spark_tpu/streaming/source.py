@@ -13,11 +13,18 @@ fallback is ``os.scandir``.
 from __future__ import annotations
 
 import os
+import random
 from dataclasses import dataclass, field
 
 from ..core.schema import Schema
 from ..core.table import Table
 from ..io.csv import read_csv
+from ..utils.faults import fault_point
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsRegistry
+from ..utils.retry import DEFAULT_IO_RETRY, RetryPolicy, call_with_retry
+
+log = get_logger("streaming")
 
 
 @dataclass
@@ -26,7 +33,16 @@ class FileStreamSource:
     schema: Schema
     glob_suffix: str = ".csv"
     header: bool = True
+    #: per-file read retry (exponential backoff + jitter): a flaky
+    #: hospital-source mount answers after a beat instead of failing the
+    #: whole micro-batch; a persistent failure still surfaces (and the
+    #: driver's replay/quarantine ladder takes over)
+    retry: RetryPolicy = DEFAULT_IO_RETRY
+    retries: int = 0
+    metrics: MetricsRegistry | None = None
     _seen: set[str] = field(default_factory=set)
+    # entropy-seeded: a fleet of sources must not jitter in lockstep
+    _rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def list_files(self) -> list[str]:
         if not os.path.isdir(self.path):
@@ -60,7 +76,23 @@ class FileStreamSource:
         """Re-mark files as seen when resuming from a checkpoint."""
         self._seen.update(files)
 
+    def _read_one(self, f: str) -> Table:
+        def attempt() -> Table:
+            fault_point("source.read_file", file=f)
+            return read_csv(f, self.schema, header=self.header)
+
+        def on_retry(n: int, exc: Exception, delay: float) -> None:
+            self.retries += 1
+            if self.metrics is not None:
+                self.metrics.inc("stream.retries")
+            log.warning(
+                "source read retry", file=os.path.basename(f), attempt=n,
+                delay_s=round(delay, 3), error=repr(exc),
+            )
+
+        return call_with_retry(attempt, self.retry, rng=self._rng, on_retry=on_retry)
+
     def read_files(self, files: list[str]) -> Table:
         if not files:
             return Table.empty(self.schema)
-        return Table.concat([read_csv(f, self.schema, header=self.header) for f in files])
+        return Table.concat([self._read_one(f) for f in files])

@@ -52,6 +52,9 @@ class UnboundedTable:
     def _write_parquet(self, table: Table, path: str) -> None:
         import pyarrow.parquet as pq
 
+        from ..utils.faults import fault_point
+
+        fault_point("sink.write_part", path=path)
         tmp = path + ".tmp"
         pq.write_table(table.to_arrow(), tmp)
         os.replace(tmp, path)

@@ -6,12 +6,20 @@ fsync'd, a torn tail left by a crash mid-write is repaired by starting the
 next append on a fresh line, and readers skip unparseable lines instead of
 failing — so a crash at any byte boundary costs at most the uncommitted
 entry that was being written, never previously-committed entries.
+
+The torn-tail probe and the append share ONE descriptor (``"ab+"``:
+writes always land at end-of-file, seeks only move the read head), so the
+probe can never race a second opener, and the ``wal.append`` fault site
+(:mod:`..utils.faults`) can tear the write at an exact byte offset — the
+chaos tests drive every recovery branch below through it.
 """
 
 from __future__ import annotations
 
 import json
 import os
+
+from ..utils.faults import InjectedCrash, fault_point, mangle_bytes, torn_point
 
 
 def append_line(path: str, obj: dict) -> None:
@@ -21,16 +29,25 @@ def append_line(path: str, obj: dict) -> None:
     mid-append), a newline is written first so the new entry never merges
     into the torn one.
     """
-    lead = ""
-    try:
-        with open(path, "rb") as f:
+    fault_point("wal.append", path=path)
+    payload = (json.dumps(obj) + "\n").encode()
+    with open(path, "ab+") as f:
+        # torn-tail probe on the same descriptor: append mode pins every
+        # write to EOF regardless of the read position this seek sets
+        f.seek(0, os.SEEK_END)
+        if f.tell() > 0:
             f.seek(-1, os.SEEK_END)
             if f.read(1) != b"\n":
-                lead = "\n"
-    except OSError:
-        pass  # missing file, or empty file (seek before start): no repair
-    with open(path, "a") as f:
-        f.write(lead + json.dumps(obj) + "\n")
+                payload = b"\n" + payload
+        payload = mangle_bytes("wal.append", payload, path=path)
+        cut = torn_point("wal.append", len(payload), path=path)
+        if cut is not None:
+            # injected torn write: persist exactly `cut` bytes, then "die"
+            f.write(payload[:cut])
+            f.flush()
+            os.fsync(f.fileno())
+            raise InjectedCrash(f"torn write at byte {cut} of {path}")
+        f.write(payload)
         f.flush()
         os.fsync(f.fileno())
 

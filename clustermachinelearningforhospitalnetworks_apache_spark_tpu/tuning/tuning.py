@@ -40,6 +40,7 @@ from ..features.assembler import AssembledTable
 from ..io.model_io import (
     METADATA_FILE,
     load_model,
+    finalize_artifact_dir,
     prepare_artifact_dir,
     register_composite,
     validate_persistable,
@@ -323,6 +324,7 @@ class _SelectedModel:
             "framework_version": __version__,
             **self._selection_meta(),
         })
+        finalize_artifact_dir(path)  # commit: drop sentinel, discard .old
 
     def write(self):
         from ..models.base import _Writer

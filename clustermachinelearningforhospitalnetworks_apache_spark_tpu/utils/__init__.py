@@ -1,8 +1,16 @@
+from .faults import FaultError, FaultPlan, InjectedCrash, fault_point
 from .logging import Logger, configure_logging, get_logger
 from .metrics import MetricsRegistry, StageTiming, global_metrics
 from .profiling import block_until_ready, capture_trace, device_fence, trace_annotation
+from .retry import RetryPolicy, call_with_retry
 
 __all__ = [
+    "FaultError",
+    "FaultPlan",
+    "InjectedCrash",
+    "RetryPolicy",
+    "call_with_retry",
+    "fault_point",
     "Logger",
     "configure_logging",
     "get_logger",

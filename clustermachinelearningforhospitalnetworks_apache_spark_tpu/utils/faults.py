@@ -1,0 +1,259 @@
+"""Deterministic fault injection: the chaos half of the durability story.
+
+The streaming WAL, fit checkpoints, and artifact writers all claim
+crash-consistency; this module is how those claims get *exercised*.  A
+:class:`FaultPlan` is a seedable list of rules ("the 3rd append to the
+offsets log tears at byte 7", "the first two reads of f.csv raise an IO
+error", "every serve-predict call fails for a while") that production code
+consults at named **fault sites** via the module-level hooks below.  With
+no plan installed the hooks are a single ``is None`` check — zero cost on
+the hot path.
+
+Sites are plain strings, matched with ``fnmatch`` globs so a rule can hit
+one site (``"wal.append"``) or a family (``"fit_ckpt.*"``).  Each hook
+passes keyword context (path, batch id, …) that a rule's optional ``when``
+predicate can filter on — e.g. tear only the commits log, not the offsets
+log.
+
+Actions:
+
+* ``fail``   — raise :class:`FaultError` (an ``OSError``: retryable, the
+  shape of a flaky disk/NFS/object-store call)
+* ``crash``  — raise :class:`InjectedCrash`.  It subclasses
+  ``BaseException`` deliberately: retry loops and self-healing handlers
+  catch ``Exception``, so an injected *process death* propagates through
+  them exactly like a real ``kill -9`` ends the process — the test harness
+  catches it at the top and "restarts".
+* ``delay``  — sleep (latency spike / straggler)
+* ``corrupt``— flip bits in a payload passed through :func:`mangle_bytes`
+* ``tear``   — report a byte offset to :func:`torn_point`; the writer
+  persists exactly that prefix and raises :class:`InjectedCrash`
+
+Everything is counted (calls per site, fires per rule) so tests can assert
+a fault actually happened — a chaos test whose fault never fired proves
+nothing.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Iterator
+
+
+class FaultError(OSError):
+    """Injected transient IO failure — retryable by design."""
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at a fault site.
+
+    ``BaseException`` so no ``except Exception`` self-healing path can
+    swallow it: code that survives an InjectedCrash by catching it would
+    also "survive" a power cut, which is a lie.
+    """
+
+
+@dataclass
+class FaultRule:
+    site: str                                  # fnmatch pattern
+    action: str                                # fail|crash|delay|corrupt|tear
+    after: int = 0                             # skip this many matching calls
+    times: int | None = 1                      # fire at most this many (None=∞)
+    error: Callable[[], BaseException] | None = None
+    delay_s: float = 0.0
+    at_byte: int | None = None                 # tear/corrupt offset
+    flip_mask: int = 0xFF                      # corrupt: XOR'd into the byte
+    when: Callable[[dict], bool] | None = None # extra context predicate
+    seen: int = 0                              # matching calls observed
+    fired: int = 0                             # times actually fired
+
+    def matches(self, site: str, ctx: dict) -> bool:
+        if not fnmatch.fnmatchcase(site, self.site):
+            return False
+        return self.when is None or bool(self.when(ctx))
+
+    def take(self) -> bool:
+        """Count a matching call; True when the rule fires on it."""
+        self.seen += 1
+        if self.seen <= self.after:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+
+class FaultPlan:
+    """A seedable, inspectable set of fault rules.
+
+    ``seed`` exists for future probabilistic rules and so two plans built
+    the same way are interchangeable; every rule here is
+    deterministic-by-count, which is what kill-and-resume tests need
+    (the *n*-th write tears, every run).
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rules: list[FaultRule] = []
+        self.calls: dict[str, int] = {}        # site -> hook invocations
+        self.log: list[tuple[str, str]] = []   # (site, action) fire history
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ authoring
+    def _add(self, rule: FaultRule) -> "FaultPlan":
+        self.rules.append(rule)
+        return self
+
+    def fail(
+        self,
+        site: str,
+        times: int | None = 1,
+        after: int = 0,
+        error: Callable[[], BaseException] | None = None,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        return self._add(FaultRule(site, "fail", after, times, error=error, when=when))
+
+    def crash(
+        self, site: str, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        return self._add(FaultRule(site, "crash", after, 1, when=when))
+
+    def delay(
+        self, site: str, seconds: float, times: int | None = 1, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        return self._add(FaultRule(site, "delay", after, times, delay_s=seconds, when=when))
+
+    def corrupt(
+        self, site: str, at_byte: int = 0, flip_mask: int = 0xFF,
+        times: int | None = 1, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        return self._add(
+            FaultRule(site, "corrupt", after, times, at_byte=at_byte,
+                      flip_mask=flip_mask, when=when)
+        )
+
+    def tear(
+        self, site: str, at_byte: int, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        return self._add(FaultRule(site, "tear", after, 1, at_byte=at_byte, when=when))
+
+    # ------------------------------------------------------------ inspection
+    def fired(self, site_pattern: str = "*") -> int:
+        with self._lock:
+            return sum(
+                1 for s, _ in self.log if fnmatch.fnmatchcase(s, site_pattern)
+            )
+
+    # ------------------------------------------------------------ runtime
+    def check(self, site: str, ctx: dict) -> None:
+        """Hook for fail/crash/delay rules — called by :func:`fault_point`."""
+        delay = 0.0
+        boom: BaseException | None = None
+        with self._lock:
+            self.calls[site] = self.calls.get(site, 0) + 1
+            for r in self.rules:
+                if r.action not in ("fail", "crash", "delay"):
+                    continue
+                if not (r.matches(site, ctx) and r.take()):
+                    continue
+                self.log.append((site, r.action))
+                if r.action == "delay":
+                    delay += r.delay_s
+                elif r.action == "crash":
+                    boom = InjectedCrash(f"injected crash at {site}")
+                    break
+                else:
+                    boom = (r.error or (lambda: FaultError(
+                        f"injected IO error at {site}"
+                    )))()
+                    break
+        if delay:
+            time.sleep(delay)
+        if boom is not None:
+            raise boom
+
+    def mangle(self, site: str, data: bytes, ctx: dict) -> bytes:
+        """Hook for corrupt rules — flip a byte of the payload in flight."""
+        with self._lock:
+            for r in self.rules:
+                if r.action != "corrupt":
+                    continue
+                if not (r.matches(site, ctx) and r.take()):
+                    continue
+                self.log.append((site, "corrupt"))
+                if not data:
+                    continue
+                i = min(r.at_byte or 0, len(data) - 1)
+                data = data[:i] + bytes([data[i] ^ (r.flip_mask & 0xFF)]) + data[i + 1:]
+        return data
+
+    def torn_point(self, site: str, length: int, ctx: dict) -> int | None:
+        """Hook for tear rules → byte count to persist before "dying"."""
+        with self._lock:
+            for r in self.rules:
+                if r.action != "tear":
+                    continue
+                if not (r.matches(site, ctx) and r.take()):
+                    continue
+                self.log.append((site, "tear"))
+                cut = r.at_byte or 0
+                if cut < 0:  # negative = from the end (-1: all but last byte)
+                    cut += length
+                return max(0, min(cut, length))
+        return None
+
+
+# ---------------------------------------------------------------- install
+_ACTIVE: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> None:
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+def clear() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+@contextmanager
+def active(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """``with faults.active(plan): ...`` — installed for the block only."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def fault_point(site: str, **ctx) -> None:
+    """Named injection site: raises/sleeps per the active plan (no-op
+    without one).  Production code calls this at every boundary whose
+    crash-consistency is part of the durability contract."""
+    p = _ACTIVE
+    if p is not None:
+        p.check(site, ctx)
+
+
+def mangle_bytes(site: str, data: bytes, **ctx) -> bytes:
+    """Pass a payload through the active plan's corrupt rules."""
+    p = _ACTIVE
+    return data if p is None else p.mangle(site, data, ctx)
+
+
+def torn_point(site: str, length: int, **ctx) -> int | None:
+    """How many of ``length`` bytes a torn write should persist (None =
+    no tear planned).  The caller writes that prefix, fsyncs, and raises
+    :class:`InjectedCrash`."""
+    p = _ACTIVE
+    return None if p is None else p.torn_point(site, length, ctx)

@@ -74,6 +74,16 @@ def pytest_configure(config):
         "fast: cross-subsystem smoke subset (python -m pytest tests/ -m fast, "
         "~2 min on the CPU mesh; full suite: -n 4 via pytest-xdist)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 budget "
+        "(tier-1 runs -m 'not slow')",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection kill-and-resume tests "
+        "(tools/run_chaos.sh runs just these with a per-site table)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

@@ -1,0 +1,594 @@
+"""Chaos suite: kill-and-resume at every durability boundary.
+
+Each test injects a deterministic fault (``utils/faults.py``) — a crash at
+a named WAL/commit boundary, a torn write at an exact byte offset, flipped
+payload bytes, a transient IO error, a failing model executable — then
+asserts the recovery contract: exactly-once rows after restart, bit-
+identical resumed fits, typed ``CorruptArtifactError`` instead of deep
+shape errors, poison-batch quarantine instead of a wedged stream, and
+circuit-breaker degradation instead of unhandled serving exceptions.
+
+Every fault is also asserted to have FIRED — a chaos test whose fault
+never triggered proves nothing.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+    CorruptArtifactError,
+    FitCheckpointer,
+    load_model,
+    write_csv,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+    KMeans,
+    KMeansModel,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+    FileStreamSource,
+    StreamCheckpoint,
+    StreamExecution,
+    UnboundedTable,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.microbatch import (
+    BATCH_QUARANTINED,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.retry import (
+    RetryPolicy,
+)
+
+pytestmark = pytest.mark.chaos
+
+#: near-instant backoffs so the suite exercises the ladder, not the clock
+FAST_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.001, max_delay_s=0.01)
+FAST_REPLAY = RetryPolicy(max_attempts=3, base_delay_s=0.001, max_delay_s=0.01)
+
+
+# ------------------------------------------------------------------ helpers
+def _event_csv(path, start_minute, n, hospital="H01"):
+    base = np.datetime64("2025-03-31T22:00:00") + np.timedelta64(start_minute, "m")
+    t = ht.Table.from_dict(
+        {
+            "hospital_id": np.array([hospital] * n, dtype=object),
+            "event_time": base + np.arange(n).astype("timedelta64[s]"),
+            "admission_count": np.arange(n),
+            "current_occupancy": np.full(n, 100),
+            "emergency_visits": np.full(n, 5),
+            "seasonality_index": np.full(n, 1.0),
+            "length_of_stay": np.full(n, 4.0),
+        },
+        ht.hospital_event_schema(),
+    )
+    write_csv(t, path)
+    return t
+
+
+def _mk_stream(tmp_path, foreach=None, max_batch_replays=3):
+    """A fresh StreamExecution over tmp_path's dirs — calling it again
+    after a crash IS the process restart."""
+    incoming = tmp_path / "incoming"
+    incoming.mkdir(exist_ok=True)
+    src = FileStreamSource(
+        str(incoming), ht.hospital_event_schema(), retry=FAST_RETRY
+    )
+    return incoming, StreamExecution(
+        source=src,
+        sink=UnboundedTable(str(tmp_path / "table"), ht.hospital_event_schema()),
+        checkpoint=StreamCheckpoint(str(tmp_path / "ckpt")),
+        foreach_batch=foreach,
+        max_batch_replays=max_batch_replays,
+        replay_backoff=FAST_REPLAY,
+    )
+
+
+def _drain(tmp_path, **kw):
+    """Restart + drain everything; → (exec, infos)."""
+    _, exec_ = _mk_stream(tmp_path, **kw)
+    infos = []
+    while True:
+        info = exec_.run_once()
+        if info is None:
+            return exec_, infos
+        infos.append(info)
+
+
+# ================================================================ stream kills
+STREAM_SITES = [
+    "stream.after_offsets",
+    "stream.after_read",
+    "stream.after_foreach",
+    "stream.after_sink",
+    "stream.after_commit",
+]
+
+
+@pytest.mark.parametrize("site", STREAM_SITES)
+def test_stream_killed_at_boundary_resumes_exactly_once(tmp_path, site):
+    """Kill the driver at each lifecycle boundary mid-batch; a restarted
+    stream must deliver every row exactly once — replaying the in-flight
+    batch when it died before commit, skipping it when it died after."""
+    incoming, exec_ = _mk_stream(tmp_path)
+    _event_csv(str(incoming / "a.csv"), 0, 30)
+    assert exec_.run_once().num_appended_rows == 30
+
+    _event_csv(str(incoming / "b.csv"), 1, 20)
+    plan = faults.FaultPlan().crash(site)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            exec_.run_once()
+    assert plan.fired(site) == 1
+
+    exec2, infos = _drain(tmp_path)
+    snap = exec2.sink.read()
+    assert snap.num_rows == 50  # no loss, no duplicates
+    assert exec2.checkpoint.quarantine_count() == 0
+    # batch ids are contiguous and the stream is fully caught up
+    assert exec2.sink.max_batch_id() == 1
+    assert exec2.run_once() is None
+
+
+# ================================================================ torn WAL
+@pytest.mark.parametrize("log_name", ["offsets.log", "commits.log"])
+@pytest.mark.parametrize("cut", [0, 1, 15, -1], ids=["b0", "b1", "mid", "last-1"])
+def test_stream_survives_torn_wal_write(tmp_path, log_name, cut):
+    """Tear the WAL append at exact byte offsets (0, 1, mid-entry, all but
+    the final newline) in each log; recovery must neither lose nor
+    duplicate rows, and the log must stay parseable."""
+    incoming, exec_ = _mk_stream(tmp_path)
+    _event_csv(str(incoming / "a.csv"), 0, 30)
+    exec_.run_once()
+
+    _event_csv(str(incoming / "b.csv"), 1, 20)
+    plan = faults.FaultPlan().tear(
+        "wal.append", at_byte=cut,
+        when=lambda ctx: ctx.get("path", "").endswith(log_name),
+    )
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            exec_.run_once()
+    assert plan.fired("wal.append") == 1
+
+    exec2, _ = _drain(tmp_path)
+    assert exec2.sink.read().num_rows == 50
+    assert exec2.run_once() is None
+    # a third drop keeps flowing over the repaired tail
+    _event_csv(str(incoming / "c.csv"), 2, 10)
+    exec3, infos = _drain(tmp_path)
+    assert exec3.sink.read().num_rows == 60
+    assert infos[-1].num_appended_rows == 10
+
+
+# ================================================================ fit kills
+FIT_SITES = [
+    "fit_ckpt.save.arrays",   # before any bytes of the new step land
+    "fit_ckpt.save.commit",   # step staged + installed, COMMIT missing
+    "fit_ckpt.post_commit",   # committed, cleanup never ran
+]
+
+
+@pytest.fixture(scope="module")
+def fit_data():
+    # structureless: Lloyd cannot hit exact convergence (move == 0) before
+    # the injected kill, so every parametrized crash site actually fires
+    rng = np.random.default_rng(7)
+    return rng.normal(size=(512, 4)).astype(np.float32)
+
+
+@pytest.mark.parametrize("site", FIT_SITES)
+def test_fit_killed_mid_checkpoint_resumes_bit_identical(
+    tmp_path, mesh8, fit_data, site
+):
+    """Kill a checkpointed KMeans fit inside the save protocol (before,
+    at, and after the commit point); rerunning the same config must land
+    on EXACTLY the uninterrupted fit's centers."""
+    def est(ckpt_dir):
+        return KMeans(
+            k=4, seed=0, max_iter=6, tol=0.0,
+            checkpoint_dir=str(ckpt_dir), checkpoint_every=1,
+        )
+
+    ref = est(tmp_path / "ref").fit(fit_data, mesh=mesh8)
+
+    plan = faults.FaultPlan().crash(site, after=2)  # die on the 3rd save
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            est(tmp_path / "crashed").fit(fit_data, mesh=mesh8)
+    assert plan.fired(site) == 1
+
+    resumed = est(tmp_path / "crashed").fit(fit_data, mesh=mesh8)
+    np.testing.assert_array_equal(resumed.cluster_centers, ref.cluster_centers)
+    np.testing.assert_array_equal(resumed.cluster_sizes, ref.cluster_sizes)
+
+
+# ================================================================ save kills
+SAVE_SITES = ["model_io.save.arrays", "model_io.save.meta", "model_io.save.swap"]
+
+
+def _toy_model(scale: float) -> KMeansModel:
+    return KMeansModel(
+        cluster_centers=np.full((2, 3), scale, np.float32),
+        distance_measure="euclidean",
+        training_cost=1.0,
+        n_iter=1,
+        cluster_sizes=np.array([1.0, 1.0], np.float32),
+    )
+
+
+@pytest.mark.parametrize("site", SAVE_SITES)
+def test_model_save_killed_preserves_previous_artifact(tmp_path, site):
+    """A save that dies at any staging/swap point must leave the previous
+    committed artifact loadable and intact."""
+    path = str(tmp_path / "model")
+    _toy_model(1.0).save(path)
+
+    plan = faults.FaultPlan().crash(site)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            _toy_model(2.0).save(path, overwrite=True)
+    assert plan.fired(site) == 1
+
+    m = load_model(path)  # repairs a displaced artifact if needed
+    np.testing.assert_array_equal(
+        m.cluster_centers, np.full((2, 3), 1.0, np.float32)
+    )
+    # and the NEXT save over the crash debris works
+    _toy_model(3.0).save(path, overwrite=True)
+    np.testing.assert_array_equal(
+        load_model(path).cluster_centers, np.full((2, 3), 3.0, np.float32)
+    )
+
+
+def test_composite_prepare_finalize_protocol_survives_crash(tmp_path):
+    """Composite savers (pipeline/CV/OvR) write in place between
+    prepare_artifact_dir and finalize_artifact_dir; a crash in between
+    must leave the PREVIOUS committed artifact recoverable."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.model_io import (
+        INCOMPLETE_SENTINEL,
+        finalize_artifact_dir,
+        prepare_artifact_dir,
+        repair_artifact_dir,
+    )
+
+    path = str(tmp_path / "composite")
+    # v1 committed through the full protocol
+    prepare_artifact_dir(path, overwrite=True)
+    with open(os.path.join(path, "payload"), "w") as f:
+        f.write("v1")
+    finalize_artifact_dir(path)
+    assert not os.path.exists(os.path.join(path, INCOMPLETE_SENTINEL))
+
+    # v2 save crashes mid-write: sentinel still present, v1 displaced
+    prepare_artifact_dir(path, overwrite=True)
+    with open(os.path.join(path, "payload"), "w") as f:
+        f.write("v2-torn")
+    # "restart": repair discards the torn save and restores v1
+    repair_artifact_dir(path)
+    with open(os.path.join(path, "payload")) as f:
+        assert f.read() == "v1"
+    # overwrite=False still refuses over the restored artifact
+    with pytest.raises(FileExistsError):
+        prepare_artifact_dir(path, overwrite=False)
+
+
+def test_stream_rejects_nonpositive_replay_budget(tmp_path):
+    with pytest.raises(ValueError, match="max_batch_replays"):
+        _mk_stream(tmp_path, max_batch_replays=0)
+
+
+# ================================================================ corruption
+def test_model_load_detects_bitflip(tmp_path):
+    path = str(tmp_path / "model")
+    _toy_model(1.0).save(path)
+    f = os.path.join(path, "arrays.npz")
+    data = bytearray(open(f, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(f, "wb").write(bytes(data))
+    with pytest.raises(CorruptArtifactError, match="crc32c mismatch"):
+        load_model(path)
+
+
+def test_model_load_detects_truncation(tmp_path):
+    path = str(tmp_path / "model")
+    _toy_model(1.0).save(path)
+    f = os.path.join(path, "arrays.npz")
+    data = open(f, "rb").read()
+    open(f, "wb").write(data[: len(data) // 2])
+    with pytest.raises(CorruptArtifactError, match="size mismatch"):
+        load_model(path)
+
+
+def test_model_save_corrupted_in_flight_detected(tmp_path):
+    """Bytes corrupted between checksum and platter (the write path lies):
+    the manifest carries the intended CRC, so load catches it."""
+    path = str(tmp_path / "model")
+    plan = faults.FaultPlan().corrupt("model_io.save.arrays", at_byte=64)
+    with faults.active(plan):
+        _toy_model(1.0).save(path)
+    assert plan.fired("model_io.save.arrays") == 1
+    with pytest.raises(CorruptArtifactError):
+        load_model(path)
+
+
+def test_fit_checkpoint_corrupt_step_falls_back_to_previous(tmp_path):
+    """Bit rot in the committed step → resume() silently falls back to the
+    previous retained commit; rot in ALL steps → typed error."""
+    ck = FitCheckpointer(str(tmp_path / "ck"), {"sig": 1}, keep=2)
+    ck.save(1, {"a": np.arange(4.0)})
+    ck.save(2, {"a": np.arange(4.0) * 2})
+
+    f2 = str(tmp_path / "ck" / "step-2" / "arrays.npz")
+    data = bytearray(open(f2, "rb").read())
+    data[len(data) // 2] ^= 0x01
+    open(f2, "wb").write(bytes(data))
+
+    step, arrays, _ = FitCheckpointer(str(tmp_path / "ck"), {"sig": 1}).resume()
+    assert step == 1
+    np.testing.assert_array_equal(arrays["a"], np.arange(4.0))
+
+    f1 = str(tmp_path / "ck" / "step-1" / "arrays.npz")
+    open(f1, "wb").write(b"not an npz at all")
+    with pytest.raises(CorruptArtifactError):
+        FitCheckpointer(str(tmp_path / "ck"), {"sig": 1}).resume()
+
+
+def test_fit_checkpoint_signature_still_guards_before_fallback(tmp_path):
+    ck = FitCheckpointer(str(tmp_path / "ck"), {"sig": 1})
+    ck.save(1, {"a": np.zeros(2)})
+    with pytest.raises(ValueError, match="signature mismatch"):
+        FitCheckpointer(str(tmp_path / "ck"), {"sig": 2}).resume()
+
+
+# ================================================================ quarantine
+def test_poison_batch_quarantined_and_stream_progresses(tmp_path):
+    """A batch whose foreach_batch always raises must be quarantined after
+    max_batch_replays tries — with the stream continuing past it — not
+    replayed forever."""
+    def poison(table, batch_id):
+        if len(table) and int(np.asarray(table.column("admission_count"))[0]) == 999:
+            raise ValueError("poison row")
+
+    incoming, exec_ = _mk_stream(tmp_path, foreach=poison, max_batch_replays=2)
+    n = 3
+    base = np.datetime64("2025-03-31T22:00:00")
+    bad = ht.Table.from_dict(
+        {
+            "hospital_id": np.array(["H01"] * n, dtype=object),
+            "event_time": base + np.arange(n).astype("timedelta64[s]"),
+            "admission_count": np.full(n, 999),  # the poison marker
+            "current_occupancy": np.full(n, 100),
+            "emergency_visits": np.full(n, 5),
+            "seasonality_index": np.full(n, 1.0),
+            "length_of_stay": np.full(n, 4.0),
+        },
+        ht.hospital_event_schema(),
+    )
+    write_csv(bad, str(incoming / "bad.csv"))
+
+    info = exec_.run_once()
+    assert info.status == BATCH_QUARANTINED
+    assert exec_.metrics.counters.get("stream.quarantined") == 1
+    assert exec_.metrics.counters.get("stream.batch_failures") == 2
+    q = exec_.checkpoint.quarantined()
+    assert len(q) == 1 and q[0]["attempts"] == 2 and "poison" in q[0]["error"]
+
+    # the stream moves on: the next (clean) drop processes normally
+    _event_csv(str(incoming / "good.csv"), 1, 10)
+    info2 = exec_.run_once()
+    assert info2.status == "ok" and info2.num_appended_rows == 10
+    assert exec_.sink.read().num_rows == 10  # poison rows never landed
+
+    # and a RESTART does not resurrect the quarantined batch
+    exec2, infos = _drain(tmp_path, foreach=poison, max_batch_replays=2)
+    assert infos == [] and exec2.sink.read().num_rows == 10
+
+
+def test_crash_poison_batch_quarantined_across_restarts(tmp_path):
+    """A batch that KILLS the process on every replay: the durable attempt
+    count recognizes it on the Nth restart and quarantines it up front."""
+    def die(table, batch_id):
+        if len(table):
+            raise faults.InjectedCrash("batch kills the process")
+
+    incoming, _ = _mk_stream(tmp_path)
+    _event_csv(str(incoming / "a.csv"), 0, 5)
+
+    for _ in range(2):  # two incarnations crash mid-batch
+        _, exec_ = _mk_stream(tmp_path, foreach=die, max_batch_replays=2)
+        with pytest.raises(faults.InjectedCrash):
+            exec_.run_once()
+
+    # third incarnation: attempt budget spent → quarantined, no third try
+    _, exec3 = _mk_stream(tmp_path, foreach=die, max_batch_replays=2)
+    info = exec3.run_once()
+    assert info.status == BATCH_QUARANTINED
+    assert exec3.checkpoint.quarantine_count() == 1
+    assert exec3.run_once() is None  # fully caught up, nothing pending
+
+
+# ================================================================ source retry
+def test_source_read_retries_transient_fault(tmp_path):
+    incoming, exec_ = _mk_stream(tmp_path)
+    _event_csv(str(incoming / "a.csv"), 0, 12)
+    plan = faults.FaultPlan().fail("source.read_file", times=2)
+    with faults.active(plan):
+        info = exec_.run_once()
+    assert info.num_appended_rows == 12   # healed within the batch
+    assert plan.fired("source.read_file") == 2
+    assert exec_.source.retries == 2
+    assert exec_.metrics.counters.get("stream.retries") == 2
+
+
+def test_source_read_exhaustion_escalates_to_quarantine(tmp_path):
+    """Retries exhausted on every replay → the batch ladder gives up and
+    quarantines; the file is NOT reprocessed after the fault clears."""
+    incoming, exec_ = _mk_stream(tmp_path, max_batch_replays=2)
+    _event_csv(str(incoming / "a.csv"), 0, 12)
+    plan = faults.FaultPlan().fail("source.read_file", times=None)
+    with faults.active(plan):
+        info = exec_.run_once()
+    assert info.status == BATCH_QUARANTINED
+    # 2 replays × 4 read attempts each
+    assert plan.fired("source.read_file") == 8
+    assert exec_.run_once() is None
+
+
+# ================================================================ breaker
+def test_circuit_breaker_state_machine():
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        CircuitBreaker,
+        STATE_CLOSED,
+        STATE_HALF_OPEN,
+        STATE_OPEN,
+    )
+
+    now = [0.0]
+    cb = CircuitBreaker(
+        failure_threshold=2, recovery_timeout_s=10.0, clock=lambda: now[0]
+    )
+    assert cb.state == STATE_CLOSED and cb.allow()
+    cb.record_failure()
+    assert cb.state == STATE_CLOSED  # one failure is not an outage
+    cb.record_failure()
+    assert cb.snapshot()["state"] == STATE_OPEN
+    assert not cb.allow() and cb.short_circuited == 1
+
+    now[0] = 10.0  # recovery window elapsed → one probe admitted
+    assert cb.state == STATE_HALF_OPEN
+    assert cb.allow()
+    assert not cb.allow()  # only one probe in flight
+    cb.record_failure()    # probe fails → straight back to open
+    assert cb.snapshot()["state"] == STATE_OPEN and not cb.allow()
+
+    now[0] = 20.0
+    assert cb.allow()
+    cb.record_success()    # probe succeeds → closed, counters reset
+    assert cb.state == STATE_CLOSED
+    assert cb.snapshot()["consecutive_failures"] == 0
+    assert cb.opened_count == 2
+
+
+@pytest.mark.slow
+def test_serving_degrades_via_breaker_and_recovers(mesh8):
+    """Primary-model faults behind the breaker: every request is answered
+    (fallback, degraded), zero unhandled exceptions, breaker opens, and
+    service self-heals once the fault clears."""
+    import time as _time
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        LinearRegression,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+        STATUS_UNAVAILABLE,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    y = (x @ np.array([1.0, -1.0, 0.5, 2.0], np.float32)).astype(np.float32)
+    model = LinearRegression().fit((x, y))
+    prior = float(np.mean(y))
+
+    srv = InferenceServer(
+        breaker_failure_threshold=2, breaker_recovery_s=0.05,
+    )
+    srv.add_model(
+        "los", model, buckets=(1, 4, 8),
+        fallback=lambda rows: np.full(rows.shape[0], prior, np.float32),
+    )
+    plan = faults.FaultPlan().fail("serve.predict", times=6)
+    with srv:
+        results = []
+        with faults.active(plan):
+            for i in range(10):
+                r = srv.predict("los", x[i], wait_timeout_s=10.0)
+                results.append(r)
+        # every faulted request was ANSWERED by the fallback — degraded,
+        # not dropped, and nothing raised
+        degraded = [r for r in results if r.status == STATUS_UNAVAILABLE]
+        assert len(degraded) >= 2
+        assert all(r.degraded and r.value is not None for r in degraded)
+        assert all(float(v) == prior for r in degraded for v in r.value)
+
+        health = srv.health()
+        assert health["breakers"]["los"]["opened_count"] >= 1
+        assert health["fallback_answers"] >= len(degraded)
+        assert health["retry_totals"]["primary_failures"] >= 2
+
+        # fault cleared: the breaker's half-open probe heals the service
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            _time.sleep(0.06)
+            if srv.predict("los", x[0], wait_timeout_s=10.0).ok:
+                break
+        else:
+            pytest.fail("service never recovered after faults cleared")
+        assert srv.health()["status"] == "ok"
+
+
+# ================================================================ soak
+@pytest.mark.slow
+def test_chaos_soak_every_boundary_twice(tmp_path):
+    """Serial kill-and-resume across every stream boundary, twice over,
+    on one long-lived checkpoint directory — accumulated recovery must
+    stay exactly-once end to end."""
+    incoming = tmp_path / "incoming"
+    incoming.mkdir()
+    total = 0
+    for round_ in range(2):
+        for i, site in enumerate(STREAM_SITES):
+            n = 5 + i + round_ * len(STREAM_SITES)
+            _event_csv(
+                str(incoming / f"drop-{round_}-{i}.csv"), total, n
+            )
+            total += n
+            _, exec_ = _mk_stream(tmp_path)
+            plan = faults.FaultPlan().crash(site)
+            with faults.active(plan):
+                with pytest.raises(faults.InjectedCrash):
+                    exec_.run_once()
+            # heal before the next kill: the replay budget belongs to
+            # each batch, and every boundary crash must recover cleanly
+            exec_, _ = _drain(tmp_path)
+            assert exec_.sink.read().num_rows == total
+    assert exec_.checkpoint.quarantine_count() == 0
+    assert exec_.sink.max_batch_id() + 1 == 2 * len(STREAM_SITES)
+
+
+# ================================================================ primitives
+def test_fault_plan_counts_and_after():
+    plan = faults.FaultPlan().fail("x.y", times=2, after=1)
+    with faults.active(plan):
+        faults.fault_point("x.y")          # after=1 skips the first
+        for _ in range(2):
+            with pytest.raises(faults.FaultError):
+                faults.fault_point("x.y")
+        faults.fault_point("x.y")          # times=2 exhausted
+    assert plan.fired("x.y") == 2 and plan.calls["x.y"] == 4
+    faults.fault_point("x.y")              # no plan installed → no-op
+
+
+def test_crc32c_known_vector():
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import crc32c
+
+    # RFC 3720 §B.4 test vector: 32 zero bytes
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"") == 0
+
+
+def test_quarantine_record_is_json_and_atomic(tmp_path):
+    ck = StreamCheckpoint(str(tmp_path / "ck"))
+    p = ck.quarantine(
+        7, ["f1.csv"], attempts=3, error="ValueError('x')",
+        sink_rows_visible=True,
+    )
+    with open(p) as f:
+        rec = json.load(f)
+    assert rec["batch_id"] == 7 and rec["attempts"] == 3
+    assert rec["sink_rows_visible"] is True
+    assert ck.quarantine_count() == 1

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Run the fault-injection matrix (tests marked `chaos`) on the CPU mesh
+# and print a per-site pass table.
+#
+#   tools/run_chaos.sh            # the tier-1 chaos subset
+#   tools/run_chaos.sh --slow     # include the slow soak/breaker tests
+#
+# Sites covered: stream WAL boundaries (stream.after_*), torn WAL writes
+# at exact byte offsets (wal.append), fit-checkpoint commit protocol
+# (fit_ckpt.*), model artifact save/swap (model_io.save.*), source IO
+# retries (source.read_file), and serving faults (serve.predict).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+MARK="chaos"
+if [[ "${1:-}" != "--slow" ]]; then
+    MARK="chaos and not slow"
+fi
+
+LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -m "$MARK" \
+    -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+echo
+echo "== chaos matrix: per-site results =="
+python - "$LOG" <<'EOF'
+import re
+import sys
+from collections import defaultdict
+
+tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
+for line in open(sys.argv[1]):
+    m = re.match(r"(PASSED|FAILED|ERROR)\s+tests/test_chaos\.py::(\S+)", line)
+    if not m:
+        continue
+    ok, test = m.group(1) == "PASSED", m.group(2)
+    param = re.search(r"\[(.+)\]$", test)
+    # parametrized kill sites group by their injection site; everything
+    # else groups by test name
+    site = param.group(1) if param else test.split("[", 1)[0]
+    tally[site][0 if ok else 1] += 1
+
+width = max((len(s) for s in tally), default=10) + 2
+print(f"{'site/case':<{width}} {'pass':>5} {'fail':>5}")
+bad = 0
+for site in sorted(tally):
+    p, f = tally[site]
+    bad += f
+    flag = "" if f == 0 else "  <-- FAILING"
+    print(f"{site:<{width}} {p:>5} {f:>5}{flag}")
+print()
+print("ALL SITES RECOVERED" if bad == 0 else f"{bad} CASE(S) FAILED")
+EOF
+
+exit "$rc"
