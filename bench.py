@@ -1329,6 +1329,139 @@ def _bench_chaos() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _bench_quality() -> dict:
+    """Data-quality firewall config: ingest rows/s with the firewall ON
+    vs OFF (the ≤10% validation-overhead acceptance gate), plus the
+    dirty-fleet path — ~5% corrupt rows through salvage parse + row
+    quarantine — and the PSI drift signal a unit-shifted hospital
+    produces.
+
+    ``vs_baseline`` is firewall-on / firewall-off throughput on CLEAN
+    files (≥ 0.9 means the firewall costs ≤ 10%); the dirty rate shows
+    what the salvage path costs when files actually are dirty."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu import (
+        DataFirewall,
+        DataProfile,
+        hospital_constraints,
+        hospital_event_schema,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.schema import (
+        FEATURE_COLS,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import read_csv
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+
+    platform = jax.default_backend()
+    schema = hospital_event_schema()
+    n_files = 8
+    rows_per_file = max(
+        1000, int(os.environ.get("BENCH_QUALITY_ROWS", 120_000)) // n_files
+    )
+    total = n_files * rows_per_file
+    work = tempfile.mkdtemp(prefix="bench_quality_")
+    try:
+        rng = np.random.default_rng(0)
+        clean_dir = os.path.join(work, "clean")
+        dirty_dir = os.path.join(work, "dirty")
+        os.makedirs(clean_dir)
+        os.makedirs(dirty_dir)
+        header = ",".join(schema.names)
+        for f in range(n_files):
+            n = rows_per_file
+            adm = rng.integers(0, 50, n)
+            occ = rng.integers(20, 400, n)
+            emv = rng.integers(0, 30, n)
+            sea = rng.uniform(0.5, 1.5, n)
+            los = 0.05 * adm + 0.01 * occ + 0.08 * emv + 1.5 * sea
+            lines = [header] + [
+                f"H{f:02d},2025-03-31 22:{(i // 60) % 60:02d}:{i % 60:02d},"
+                f"{adm[i]},{occ[i]},{emv[i]},{sea[i]:.4f},{los[i]:.4f}"
+                for i in range(n)
+            ]
+            text = "\n".join(lines) + "\n"
+            with open(os.path.join(clean_dir, f"h{f:02d}.csv"), "w") as fh:
+                fh.write(text)
+            # dirty twin: ~5% mangled fields + a unit-shifted column on
+            # one hospital (deterministic FaultPlan rules, pre-applied)
+            plan = faults.FaultPlan(seed=f).mangle_fields(
+                "bench.dirty", rate=0.025,
+                columns=("admission_count", "current_occupancy"), times=None,
+            )
+            if f == 0:
+                plan.unit_scale("bench.dirty", column="length_of_stay",
+                                factor=1000.0)
+            with faults.active(plan):
+                dirty = faults.corrupt_data("bench.dirty", text)
+            with open(os.path.join(dirty_dir, f"h{f:02d}.csv"), "w") as fh:
+                fh.write(dirty)
+        files = sorted(
+            os.path.join(clean_dir, p) for p in os.listdir(clean_dir)
+        )
+        dirty_files = sorted(
+            os.path.join(dirty_dir, p) for p in os.listdir(dirty_dir)
+        )
+
+        def best_rate(run, reps: int = 3) -> float:
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                run()
+                best = min(best, time.perf_counter() - t0)
+            return total / best
+
+        [read_csv(f, schema) for f in files]  # warm page cache once
+        plain_rps = best_rate(
+            lambda: [read_csv(f, schema) for f in files]
+        )
+        fw_clean = DataFirewall(schema, hospital_constraints())
+        fw_rps = best_rate(
+            lambda: [fw_clean.ingest_file(f) for f in files]
+        )
+        fw_dirty = DataFirewall(schema, hospital_constraints())
+        t0 = time.perf_counter()
+        dirty_results = [fw_dirty.ingest_file(f) for f in dirty_files]
+        dirty_rps = total / (time.perf_counter() - t0)
+        rejected = sum(r.n_rejected for r in dirty_results)
+
+        # drift signal: reference profile from one clean hospital, live
+        # from the unit-shifted one — PSI must scream
+        clean_t = read_csv(files[1], schema)
+        ref = DataProfile.from_matrix(
+            clean_t.numeric_matrix(list(FEATURE_COLS)), list(FEATURE_COLS)
+        )
+        live = DataProfile.like(ref)
+        live.update_matrix(
+            clean_t.numeric_matrix(list(FEATURE_COLS)) * 1000.0
+        )
+        psi_shift = max(ref.psi_against(live).values())
+
+        overhead_pct = (plain_rps - fw_rps) / plain_rps * 100.0
+        return {
+            "metric": (
+                f"quality firewall ingest throughput "
+                f"({n_files}×{rows_per_file} rows, clean fleet, {platform})"
+            ),
+            "value": round(fw_rps, 1),
+            "unit": "rows/sec",
+            "vs_baseline": round(fw_rps / plain_rps, 3),
+            "plain_rows_per_s": round(plain_rps, 1),
+            "validation_overhead_pct": round(overhead_pct, 2),
+            "dirty_rows_per_s": round(dirty_rps, 1),
+            "dirty_rows_rejected": int(rejected),
+            "dirty_reject_rate_pct": round(100.0 * rejected / total, 2),
+            "reject_reasons": dict(sorted(fw_dirty.histogram.items())),
+            "psi_unit_shift": round(psi_shift, 2),
+            "platform": platform,
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -1343,6 +1476,7 @@ CONFIGS = {
     "pallas_ab": lambda: _bench_pallas_ab(64, 64),              # win-or-retire A/B
     "serve": lambda: _bench_serve(),                            # online inference
     "chaos": lambda: _bench_chaos(),                            # fault recovery
+    "quality": lambda: _bench_quality(),                        # data firewall
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets

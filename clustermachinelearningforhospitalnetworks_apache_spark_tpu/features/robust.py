@@ -13,6 +13,7 @@ them with approxQuantile rather than an exact distributed sort.
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, replace
 
 import jax
@@ -60,12 +61,28 @@ class MaxAbsScaler:
             if float(s["count"]) == 0.0:
                 raise ValueError("MaxAbsScaler fit on an empty dataset")
             lo, hi = np.asarray(s["min"], np.float64), np.asarray(s["max"], np.float64)
+            if not (np.isfinite(lo).all() and np.isfinite(hi).all()):
+                # NaNs in the data poison the device min/max reduction;
+                # redo the affected statistic NaN-aware on the host (the
+                # rare firewall-accepted-missing case, not the hot path)
+                xh = np.asarray(jax.device_get(data.x), np.float64)
+                valid = np.asarray(jax.device_get(data.w)) > 0
+                xh = xh[valid]
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    lo = np.nanmin(xh, axis=0)
+                    hi = np.nanmax(xh, axis=0)
         else:
             x = np.asarray(data, np.float64)
             if x.shape[0] == 0:
                 raise ValueError("MaxAbsScaler fit on an empty dataset")
-            lo, hi = x.min(axis=0), x.max(axis=0)
-        return MaxAbsScalerModel(np.maximum(np.abs(lo), np.abs(hi)))
+            # NaN-tolerant: the data firewall accepts missing features and
+            # routes them here — one NaN must not de-scale a whole column
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN col
+                lo, hi = np.nanmin(x, axis=0), np.nanmax(x, axis=0)
+        m = np.maximum(np.abs(lo), np.abs(hi))
+        return MaxAbsScalerModel(np.where(np.isfinite(m), m, 0.0))
 
     def fit_transform(self, data):
         return self.fit(data).transform(data)
@@ -149,9 +166,16 @@ class RobustScaler:
                 ]
         if sample.shape[0] == 0:
             raise ValueError("RobustScaler fit on an empty dataset")
-        q = np.quantile(sample, [self.lower, 0.5, self.upper], axis=0)
+        # nanquantile: missing values (firewall-accepted NaNs) don't poison
+        # the statistic; an all-NaN column degrades to median 0 / iqr 0
+        # (transform leaves it unscaled) instead of NaN-ing every row
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN col
+            q = np.nanquantile(sample, [self.lower, 0.5, self.upper], axis=0)
+        median = np.where(np.isfinite(q[1]), q[1], 0.0)
+        iqr = np.where(np.isfinite(q[2] - q[0]), q[2] - q[0], 0.0)
         return RobustScalerModel(
-            median=q[1], iqr=q[2] - q[0],
+            median=median, iqr=iqr,
             with_centering=self.with_centering, with_scaling=self.with_scaling,
         )
 

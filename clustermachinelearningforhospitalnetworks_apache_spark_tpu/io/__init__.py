@@ -1,17 +1,38 @@
-from .csv import read_csv, read_csv_dir, write_csv
+from .csv import (
+    RowReject,
+    SalvageResult,
+    read_csv,
+    read_csv_dir,
+    read_csv_dir_salvage,
+    read_csv_salvage,
+    write_csv,
+)
 from .libsvm import read_libsvm, write_libsvm
 from .fit_checkpoint import FitCheckpointer
 from .integrity import crc32c, crc32c_hex
-from .model_io import CorruptArtifactError, load_model, register_model, save_model
+from .model_io import (
+    CorruptArtifactError,
+    attach_data_profile,
+    load_data_profile,
+    load_model,
+    register_model,
+    save_model,
+)
 from .native import native_available
 
 __all__ = [
     "CorruptArtifactError",
     "FitCheckpointer",
+    "RowReject",
+    "SalvageResult",
+    "attach_data_profile",
     "crc32c",
     "crc32c_hex",
+    "load_data_profile",
     "read_csv",
     "read_csv_dir",
+    "read_csv_dir_salvage",
+    "read_csv_salvage",
     "write_csv",
     "read_libsvm",
     "write_libsvm",

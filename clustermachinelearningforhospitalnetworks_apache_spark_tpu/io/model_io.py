@@ -6,7 +6,9 @@ Parquet coefficient/tree-node files plus JSON metadata to HDFS.  Here a
 model artifact is a directory containing
 
     metadata.json   — model class, framework version, params,
-                      integrity manifest (CRC32C + size per payload)
+                      integrity manifest (CRC32C + size per payload),
+                      optional data_profile (training-time feature
+                      sketches — the drift-detection reference)
     arrays.npz      — every ndarray leaf of the model's pytree
 
 with the same overwrite-or-fail-if-exists semantics.  A registry maps the
@@ -194,11 +196,22 @@ def _npz_bytes(arrays: dict[str, np.ndarray]) -> bytes:
     return buf.getvalue()
 
 
-def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarray], overwrite: bool = True) -> None:
+def save_model(
+    path: str,
+    name: str,
+    metadata: dict,
+    arrays: dict[str, np.ndarray],
+    overwrite: bool = True,
+    data_profile: dict | None = None,
+) -> None:
     """Crash-consistent save: stage, checksum, then swap in two renames.
 
     Either the previous committed artifact or the new one survives a
-    crash at any byte boundary — never a torn mix of the two."""
+    crash at any byte boundary — never a torn mix of the two.
+
+    ``data_profile`` (a ``quality.DataProfile.to_dict()``) rides in the
+    manifest so serving can rebuild the training-time distribution
+    reference with :func:`load_data_profile`."""
     repair_artifact_dir(path)
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(f"{path} exists and overwrite=False")
@@ -218,15 +231,15 @@ def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarra
         f.flush()
         os.fsync(f.fileno())
     fault_point("model_io.save.meta", path=path)
-    write_metadata(
-        staging,
-        {
-            "model_class": name,
-            "framework_version": __version__,
-            "params": metadata,
-            "integrity": {ARRAYS_FILE: checksum_record(data)},
-        },
-    )
+    meta = {
+        "model_class": name,
+        "framework_version": __version__,
+        "params": metadata,
+        "integrity": {ARRAYS_FILE: checksum_record(data)},
+    }
+    if data_profile is not None:
+        meta["data_profile"] = data_profile
+    write_metadata(staging, meta)
     _fsync_dir(staging)
 
     # the swap: displace-then-install, each step atomic, recoverable from
@@ -242,6 +255,42 @@ def save_model(path: str, name: str, metadata: dict, arrays: dict[str, np.ndarra
     _fsync_dir(parent)
     if old is not None:
         shutil.rmtree(old, ignore_errors=True)
+
+
+def attach_data_profile(path: str, data_profile: dict) -> None:
+    """Add/replace the training-data profile in a saved artifact's
+    manifest (atomic metadata rewrite).  The normal route for fitted
+    models whose ``save()`` predates the profile parameter: save, then
+    attach."""
+    repair_artifact_dir(path)
+    meta_path = os.path.join(path, METADATA_FILE)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CorruptArtifactError(
+            f"artifact metadata at {path!r} is unreadable: {e}"
+        ) from e
+    meta["data_profile"] = data_profile
+    write_metadata(path, meta)
+    _fsync_dir(path)
+
+
+def load_data_profile(path: str) -> dict | None:
+    """The training-data profile saved in an artifact's manifest, or
+    None when the artifact predates profiles.  Serving reads this to arm
+    per-model drift monitors and input guards."""
+    repair_artifact_dir(path)
+    try:
+        with open(os.path.join(path, METADATA_FILE)) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CorruptArtifactError(
+            f"artifact metadata at {path!r} is unreadable: {e}"
+        ) from e
+    return meta.get("data_profile")
 
 
 def load_model(path: str) -> Any:

@@ -32,6 +32,7 @@ from .queue import (
     ServeResult,
     STATUS_DEADLINE_EXCEEDED,
     STATUS_ERROR,
+    STATUS_INVALID_INPUT,
     STATUS_OK,
     STATUS_REJECTED,
     STATUS_SHUTDOWN,
@@ -61,6 +62,7 @@ __all__ = [
     "ShardedScorer",
     "STATUS_DEADLINE_EXCEEDED",
     "STATUS_ERROR",
+    "STATUS_INVALID_INPUT",
     "STATUS_OK",
     "STATUS_REJECTED",
     "STATUS_SHUTDOWN",

@@ -48,6 +48,8 @@ class CircuitBreaker:
         self._half_open_successes = 0
         self.opened_count = 0          # lifetime open transitions
         self.short_circuited = 0       # calls refused while open
+        self.tripped_count = 0         # forced opens via trip()
+        self._last_trip_reason = ""
 
     # ------------------------------------------------------------ internals
     def _to(self, state: str) -> None:
@@ -89,6 +91,20 @@ class CircuitBreaker:
             else:
                 self._consecutive_failures = 0
 
+    def trip(self, reason: str = "") -> None:
+        """Force the breaker OPEN regardless of failure counts — the
+        entry point for *external* degradation signals (sustained input
+        drift, operator action).  Requests short-circuit to the fallback
+        until the recovery timeout, exactly like failure-opened state;
+        half-open probes then test the primary as usual."""
+        with self._lock:
+            self.tripped_count += 1
+            self._last_trip_reason = reason
+            if self._state != STATE_OPEN:
+                self._to(STATE_OPEN)
+            else:  # already open: restart the recovery clock
+                self._opened_at = self._clock()
+
     def record_failure(self) -> None:
         with self._lock:
             if self._state == STATE_HALF_OPEN:
@@ -129,4 +145,6 @@ class CircuitBreaker:
                 "consecutive_failures": self._consecutive_failures,
                 "opened_count": self.opened_count,
                 "short_circuited": self.short_circuited,
+                "tripped_count": self.tripped_count,
+                "last_trip_reason": self._last_trip_reason,
             }

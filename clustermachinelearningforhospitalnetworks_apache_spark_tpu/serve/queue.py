@@ -38,6 +38,10 @@ STATUS_DEADLINE_EXCEEDED = "deadline_exceeded"
 STATUS_ERROR = "error"
 STATUS_UNAVAILABLE = "unavailable"       # primary failed / circuit open
 STATUS_SHUTDOWN = "shutdown"
+#: input guard refusal (PR 3): non-finite / wildly out-of-range features
+#: under the ``reject`` policy — a 400, not a 503, so NOT a degraded
+#: status (a made-up answer to a garbage question helps nobody)
+STATUS_INVALID_INPUT = "invalid_input"
 
 #: statuses answered by the fallback path (degraded but not failed)
 DEGRADED_STATUSES = (

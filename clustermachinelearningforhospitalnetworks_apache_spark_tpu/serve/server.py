@@ -9,18 +9,22 @@ metrics sink is shared so one ``stats()`` call reports the whole server.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Sequence
 
 import numpy as np
 
+from ..io.model_io import load_data_profile
 from ..models.base import Model
+from ..quality.drift import DriftMonitor, InputGuard, POLICY_REJECT
+from ..quality.sketches import DataProfile, PSI_DRIFT
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
 from .batcher import DEFAULT_MAX_WAIT_S, Fallback, MicroBatcher
 from .breaker import STATE_CLOSED, CircuitBreaker
 from .bucketing import DEFAULT_BUCKETS
 from .metrics import ServingMetrics
-from .queue import ServeResult
+from .queue import Request, ServeResult, STATUS_INVALID_INPUT
 from .registry import ModelRegistry, ServingModel
 
 log = get_logger("serve")
@@ -56,6 +60,10 @@ class InferenceServer:
         self._batchers: dict[str, MicroBatcher] = {}
         self._fallbacks: dict[str, Fallback] = {}
         self._breakers: dict[str, CircuitBreaker] = {}
+        #: per-model input guards / drift monitors (PR 3 data firewall)
+        self._guards: dict[str, InputGuard] = {}
+        self._monitors: dict[str, DriftMonitor] = {}
+        self._monitor_width_warned: set[str] = set()
         self._started = False
 
     def _breaker_for(self, name: str) -> CircuitBreaker:
@@ -75,10 +83,29 @@ class InferenceServer:
         n_features: int | None = None,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         fallback: Fallback = None,
+        data_profile: dict | None = None,
+        input_policy: str | None = None,
+        drift_threshold: float = PSI_DRIFT,
+        drift_window_rows: int = 512,
+        drift_trip_after: int = 3,
     ) -> ServingModel:
         """Register a fitted model (or a saved-artifact path) for serving.
-        ``fallback`` answers degraded requests for THIS model."""
+        ``fallback`` answers degraded requests for THIS model.
+
+        Data-quality guards (PR 3): ``data_profile`` is the training-time
+        feature profile (``quality.DataProfile.to_dict()``; auto-loaded
+        from the artifact manifest when ``model`` is a path).  With a
+        profile, live traffic is PSI-scored against it every
+        ``drift_window_rows`` rows and ``drift_trip_after`` consecutive
+        windows above ``drift_threshold`` TRIP the model's circuit
+        breaker — a drifting feed degrades to fallback answers instead of
+        silently mis-predicting.  ``input_policy`` guards individual
+        requests: ``"impute"`` repairs non-finite / wildly
+        out-of-reference-range values with the reference mean and counts
+        them; ``"reject"`` refuses the request (``invalid_input``)."""
         if isinstance(model, str):
+            if data_profile is None:
+                data_profile = load_data_profile(model)
             sm = self.registry.load(
                 name, model, n_features=n_features, buckets=buckets
             )
@@ -86,6 +113,19 @@ class InferenceServer:
             sm = self.registry.register(
                 name, model, n_features=n_features, buckets=buckets
             )
+        if data_profile is not None:
+            profile = DataProfile.from_dict(data_profile)
+            self._monitors[name] = DriftMonitor(
+                profile,
+                threshold=drift_threshold,
+                window_rows=drift_window_rows,
+                trip_after=drift_trip_after,
+            )
+            if input_policy is not None:
+                self._guards[name] = InputGuard(profile, policy=input_policy)
+        elif input_policy is not None:
+            # no reference: guard catches non-finite values only
+            self._guards[name] = InputGuard(None, policy=input_policy)
         self._fallbacks[name] = fallback
         if self._started:  # hot-add: warm and attach a batcher now
             sm.warmup()
@@ -135,15 +175,70 @@ class InferenceServer:
             )
         return self._batchers[name]
 
+    def _guard_input(
+        self, name: str, x: np.ndarray
+    ) -> tuple[np.ndarray, Request | None]:
+        """Input guard + drift observation for one request.  Returns the
+        (possibly repaired) batch, or a pre-answered ``invalid_input``
+        request when the reject policy refused it."""
+        guard = self._guards.get(name)
+        if guard is not None:
+            fixed, n_bad, reasons = guard.inspect(x)
+            if n_bad:
+                if guard.policy == POLICY_REJECT:
+                    self.metrics.registry.inc("serve.inputs_rejected")
+                    req = Request(
+                        x=np.atleast_2d(np.asarray(x, dtype=np.float64)),
+                        enqueued_at=time.monotonic(), deadline=None,
+                    )
+                    req.complete(ServeResult(
+                        None, STATUS_INVALID_INPUT,
+                        detail="; ".join(reasons),
+                    ))
+                    self.metrics.record_request(0.0, STATUS_INVALID_INPUT)
+                    return x, req
+                self.metrics.registry.inc("serve.inputs_imputed", n_bad)
+                x = fixed
+        monitor = self._monitors.get(name)
+        if monitor is not None:
+            rows = np.atleast_2d(np.asarray(x, dtype=np.float64))
+            if rows.shape[1] != len(monitor.reference.names):
+                # an armed monitor that can never observe is worse than
+                # none — say so once instead of silently never tripping
+                if name not in self._monitor_width_warned:
+                    self._monitor_width_warned.add(name)
+                    log.warning(
+                        "drift monitor inert: request width != profile",
+                        model=name, request_width=int(rows.shape[1]),
+                        profile_width=len(monitor.reference.names),
+                    )
+            else:
+                monitor.observe(rows)
+                if monitor.should_trip():
+                    self.metrics.registry.inc("serve.drift_trips")
+                    self._breaker_for(name).trip(
+                        f"sustained input drift (max PSI "
+                        f"{monitor.max_psi:.3f} > {monitor.threshold})"
+                    )
+                    log.error(
+                        "drift trip: serving degraded",
+                        model=name, max_psi=round(monitor.max_psi, 4),
+                    )
+        return x, None
+
     def submit(self, name: str, x: np.ndarray, deadline_s: float | None = None):
-        return self._batcher(name).submit(x, deadline_s=deadline_s)
+        batcher = self._batcher(name)  # unknown-model KeyError first
+        x, refused = self._guard_input(name, x)
+        if refused is not None:
+            return refused
+        return batcher.submit(x, deadline_s=deadline_s)
 
     def predict(
         self, name: str, x: np.ndarray, deadline_s: float | None = None,
         wait_timeout_s: float | None = 30.0,
     ) -> ServeResult:
-        return self._batcher(name).predict(
-            x, deadline_s=deadline_s, wait_timeout_s=wait_timeout_s
+        return self.submit(name, x, deadline_s=deadline_s).wait(
+            wait_timeout_s
         )
 
     # ------------------------------------------------------------ observe
@@ -167,7 +262,15 @@ class InferenceServer:
         self-healing counters (quarantined batches, retry totals) — what a
         ``/healthz`` endpoint or an orchestrator's probe would poll."""
         breakers = {name: b.snapshot() for name, b in self._breakers.items()}
-        degraded = any(b["state"] != STATE_CLOSED for b in breakers.values())
+        drift = {name: m.snapshot() for name, m in self._monitors.items()}
+        # status derives from breaker state only: SUSTAINED drift reaches
+        # it through trip() (trip_after consecutive hot windows), while a
+        # single hot window merely shows in the per-model "drifting"
+        # field — one traffic burst must not read as a degraded server
+        # to an orchestrator probe
+        degraded = any(
+            b["state"] != STATE_CLOSED for b in breakers.values()
+        )
         serve_c = self.metrics.registry.counters
         ingest_c = (
             self.ingest_metrics.counters if self.ingest_metrics is not None
@@ -181,11 +284,17 @@ class InferenceServer:
             "started": self._started,
             "models_serving": sorted(self._batchers),
             "breakers": breakers,
+            "drift": drift,
             "quarantined_batches": int(ingest_c.get("stream.quarantined", 0)),
+            "quarantined_rows": int(ingest_c.get("stream.rows_rejected", 0)),
+            "drift_events": int(ingest_c.get("stream.drift_events", 0)),
             "retry_totals": {
                 "source_reads": int(ingest_c.get("stream.retries", 0)),
                 "batch_replays": int(ingest_c.get("stream.batch_failures", 0)),
                 "primary_failures": int(serve_c.get("serve.primary_failures", 0)),
             },
             "fallback_answers": int(serve_c.get("serve.fallback_answers", 0)),
+            "inputs_imputed": int(serve_c.get("serve.inputs_imputed", 0)),
+            "inputs_rejected": int(serve_c.get("serve.inputs_rejected", 0)),
+            "drift_trips": int(serve_c.get("serve.drift_trips", 0)),
         }

@@ -13,6 +13,12 @@ per attempt, surviving crashes like the other two) so a poison batch that
 kills the process on every replay is recognized across restarts and
 quarantined — written to ``<ckpt>/quarantine/batch-<id>.json`` and
 committed as skipped — instead of wedging the stream forever.
+
+PR 3 adds the rung below batch quarantine: **row quarantine**.  Rows the
+data firewall rejects (malformed / out-of-range / constraint-violating)
+land in ``<ckpt>/quarantine/rows/batch-<id>.json`` with their raw
+evidence and a per-reason histogram, while the rest of the batch commits
+normally — one bad row no longer costs a file or a batch.
 """
 
 from __future__ import annotations
@@ -25,6 +31,24 @@ from dataclasses import dataclass
 from .wal import append_line as _append_line, read_lines as _read_lines
 
 QUARANTINE_DIR = "quarantine"
+ROW_QUARANTINE_DIR = os.path.join("quarantine", "rows")
+
+
+def _read_quarantine_dir(qdir: str) -> list[dict]:
+    """Load every ``batch-*.json`` evidence record (batch order); torn or
+    unreadable files are skipped, never fatal."""
+    if not os.path.isdir(qdir):
+        return []
+    out = []
+    for name in sorted(os.listdir(qdir)):
+        if not (name.startswith("batch-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(qdir, name)) as f:
+                out.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
 
 
 @dataclass
@@ -98,20 +122,59 @@ class StreamCheckpoint:
         os.replace(tmp, p)
         return p
 
+    def quarantine_rows(
+        self, batch_id: int, rejects: list[dict], drift_events: list | None = None
+    ) -> str:
+        """Persist one batch's rejected ROWS (atomically, idempotent on
+        replay — same batch id overwrites the same file) and return the
+        path.  ``rejects`` are firewall records: context + raw/row +
+        machine-readable reasons."""
+        qdir = os.path.join(self.path, ROW_QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        histogram: dict[str, int] = {}
+        for r in rejects:
+            for reason in r.get("reasons", ()):
+                histogram[reason] = histogram.get(reason, 0) + 1
+        p = os.path.join(qdir, f"batch-{batch_id:010d}.json")
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "batch_id": batch_id,
+                    "n_rejected": len(rejects),
+                    "reason_histogram": histogram,
+                    "drift_events": [
+                        e.to_dict() if hasattr(e, "to_dict") else e
+                        for e in (drift_events or [])
+                    ],
+                    "rejects": rejects,
+                    "quarantined_at": time.time(),
+                },
+                f,
+                indent=2,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+        return p
+
+    def quarantined_rows(self) -> list[dict]:
+        """All row-quarantine records, batch order."""
+        return _read_quarantine_dir(os.path.join(self.path, ROW_QUARANTINE_DIR))
+
+    def quarantined_row_count(self) -> int:
+        return sum(int(e.get("n_rejected", 0)) for e in self.quarantined_rows())
+
+    def row_reason_histogram(self) -> dict[str, int]:
+        """Aggregate reason histogram across every row-quarantine file."""
+        agg: dict[str, int] = {}
+        for e in self.quarantined_rows():
+            for k, v in (e.get("reason_histogram") or {}).items():
+                agg[k] = agg.get(k, 0) + int(v)
+        return agg
+
     def quarantined(self) -> list[dict]:
-        qdir = os.path.join(self.path, QUARANTINE_DIR)
-        if not os.path.isdir(qdir):
-            return []
-        out = []
-        for name in sorted(os.listdir(qdir)):
-            if not (name.startswith("batch-") and name.endswith(".json")):
-                continue
-            try:
-                with open(os.path.join(qdir, name)) as f:
-                    out.append(json.load(f))
-            except (OSError, json.JSONDecodeError):
-                continue
-        return out
+        return _read_quarantine_dir(os.path.join(self.path, QUARANTINE_DIR))
 
     def quarantine_count(self) -> int:
         return len(self.quarantined())

@@ -27,7 +27,14 @@ Self-healing (the fault-tolerance layer over that lifecycle):
   wedging forever (``stream.quarantined`` counts them);
 * transient in-process failures back off exponentially with jitter
   between replays (``stream.batch_failures`` counts them);
-* per-file source reads retry independently (see ``source.py``).
+* per-file source reads retry independently (see ``source.py``);
+* with a :class:`~..quality.firewall.DataFirewall` configured, the rung
+  BELOW batch quarantine activates: malformed / constraint-violating
+  rows are split out per-row (salvage parse + vectorized validation),
+  written to ``<ckpt>/quarantine/rows/`` with reasons, and the rest of
+  the batch proceeds — a bad row costs a row, not a batch
+  (``stream.rows_rejected`` / ``stream.drift_events`` count them, and
+  the firewall's drift monitor feeds the ``stream.drift_psi`` gauge).
 
 Named fault sites (``utils/faults.py``) bracket every WAL boundary —
 ``stream.after_offsets`` / ``after_read`` / ``after_foreach`` /
@@ -40,11 +47,14 @@ from __future__ import annotations
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import TYPE_CHECKING, Callable
 
 import numpy as np
 
 from ..core.table import Table
+
+if TYPE_CHECKING:  # pragma: no cover — typing only, avoids import cycle
+    from ..quality.firewall import DataFirewall
 from ..utils.faults import fault_point
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
@@ -68,6 +78,8 @@ class BatchInfo:
     num_appended_rows: int
     files: list[str]
     status: str = BATCH_OK
+    num_rejected_rows: int = 0     # rows the data firewall quarantined
+    num_drift_events: int = 0      # schema-drift reconciliations observed
 
 
 @dataclass
@@ -77,6 +89,9 @@ class StreamExecution:
     checkpoint: StreamCheckpoint
     watermark: WatermarkTracker | None = None
     foreach_batch: Callable[[Table, int], None] | None = None
+    #: data-quality firewall: when set, source reads salvage + validate
+    #: per row and rejects land in ``<ckpt>/quarantine/rows/``
+    firewall: "DataFirewall | None" = None
     add_ingest_time: bool = True
     #: total tries a batch gets — across replays AND process restarts —
     #: before it is quarantined instead of replayed forever
@@ -86,6 +101,10 @@ class StreamExecution:
     history: list[BatchInfo] = field(default_factory=list)
     _next_batch_id: int = 0
     _pending: dict | None = None
+    #: batches whose row-quarantine metrics were already counted — a
+    #: replayed attempt re-produces the same rejects, and the counters
+    #: must match the (idempotent) quarantine files, not the attempt count
+    _quarantine_counted: set = field(default_factory=set, repr=False)
     # entropy-seeded: replaying drivers must not back off in lockstep
     _rng: random.Random = field(default_factory=random.Random, repr=False)
 
@@ -94,6 +113,8 @@ class StreamExecution:
             raise ValueError(
                 f"max_batch_replays must be >= 1, got {self.max_batch_replays}"
             )
+        if self.firewall is not None and self.source.firewall is None:
+            self.source.firewall = self.firewall
         state = self.checkpoint.recover()
         self._next_batch_id = state["next_batch_id"]
         self.source.restore(state["processed_files"])
@@ -175,9 +196,15 @@ class StreamExecution:
         # a failed half-run)
         if self.watermark is not None and wm_state:
             self.watermark.restore(wm_state)
-        table = self.source.read_files(files)
+        if self.firewall is not None:
+            table, row_rejects, drift_events = self.source.read_files_audited(
+                files
+            )
+        else:
+            table = self.source.read_files(files)
+            row_rejects, drift_events = [], []
         fault_point("stream.after_read", batch_id=batch_id)
-        n_in = len(table)
+        n_in = len(table) + len(row_rejects)
         if self.add_ingest_time:
             # parity with withColumn("ingest_time", current_timestamp()) :82
             now = np.datetime64(int(time.time_ns()), "ns")
@@ -187,6 +214,28 @@ class StreamExecution:
         dropped = 0
         if self.watermark is not None:
             table, dropped = self.watermark.filter_late(table)
+
+        if row_rejects or drift_events:
+            # row quarantine: idempotent on replay (same batch id, same
+            # file), written before the sink so evidence survives a
+            # failing foreach/sink attempt too; counters gate on batch id
+            # so a replayed attempt doesn't double-count the same rows
+            self.checkpoint.quarantine_rows(batch_id, row_rejects, drift_events)
+            if batch_id not in self._quarantine_counted:
+                self._quarantine_counted.add(batch_id)
+                if row_rejects:
+                    self.metrics.inc("stream.rows_rejected", len(row_rejects))
+                if drift_events:
+                    self.metrics.inc("stream.drift_events", len(drift_events))
+            log.warning(
+                "rows quarantined",
+                batch_id=batch_id, rejected=len(row_rejects),
+                drift_events=len(drift_events),
+            )
+        if self.firewall is not None and self.firewall.monitor is not None:
+            self.metrics.set(
+                "stream.drift_psi", self.firewall.monitor.max_psi
+            )
 
         if self.foreach_batch is not None:
             self.foreach_batch(table, batch_id)
@@ -205,12 +254,15 @@ class StreamExecution:
             num_late_rows=dropped,
             num_appended_rows=len(table),
             files=files,
+            num_rejected_rows=len(row_rejects),
+            num_drift_events=len(drift_events),
         )
         log.info(
             "batch committed",
             batch_id=batch_id,
             rows=info.num_appended_rows,
             late=dropped,
+            rejected=info.num_rejected_rows,
         )
         return info
 

@@ -16,10 +16,15 @@ import os
 import random
 from dataclasses import dataclass, field
 
+from typing import TYPE_CHECKING
+
 from ..core.schema import Schema
 from ..core.table import Table
 from ..io.csv import read_csv
 from ..utils.faults import fault_point
+
+if TYPE_CHECKING:  # pragma: no cover — typing only, avoids import cycle
+    from ..quality.firewall import DataFirewall, FirewallResult
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
 from ..utils.retry import DEFAULT_IO_RETRY, RetryPolicy, call_with_retry
@@ -40,6 +45,10 @@ class FileStreamSource:
     retry: RetryPolicy = DEFAULT_IO_RETRY
     retries: int = 0
     metrics: MetricsRegistry | None = None
+    #: optional data-quality firewall: reads become salvage-mode (one bad
+    #: row rejects one row, drifted headers reconcile) via
+    #: :meth:`read_files_audited`; without one, reads stay strict
+    firewall: "DataFirewall | None" = None
     _seen: set[str] = field(default_factory=set)
     # entropy-seeded: a fleet of sources must not jitter in lockstep
     _rng: random.Random = field(default_factory=random.Random, repr=False)
@@ -96,3 +105,41 @@ class FileStreamSource:
         if not files:
             return Table.empty(self.schema)
         return Table.concat([self._read_one(f) for f in files])
+
+    # ------------------------------------------------------ firewalled
+    def _ingest_one(self, f: str) -> "FirewallResult":
+        """Firewalled read of one file, behind the same retry policy and
+        ``source.read_file`` fault site as the strict path."""
+
+        def attempt() -> "FirewallResult":
+            fault_point("source.read_file", file=f)
+            return self.firewall.ingest_file(f, header=self.header)
+
+        def on_retry(n: int, exc: Exception, delay: float) -> None:
+            self.retries += 1
+            if self.metrics is not None:
+                self.metrics.inc("stream.retries")
+            log.warning(
+                "source read retry", file=os.path.basename(f), attempt=n,
+                delay_s=round(delay, 3), error=repr(exc),
+            )
+
+        return call_with_retry(attempt, self.retry, rng=self._rng, on_retry=on_retry)
+
+    def read_files_audited(
+        self, files: list[str]
+    ) -> tuple[Table, list[dict], list]:
+        """Salvage-mode batch read through the firewall: → (accepted
+        table, per-row reject records, schema-drift events).  Falls back
+        to the strict read (no rejects possible) when no firewall is
+        configured."""
+        if not files:
+            return Table.empty(self.schema), [], []
+        if self.firewall is None:
+            return self.read_files(files), [], []
+        results = [self._ingest_one(f) for f in files]
+        return (
+            Table.concat([r.table for r in results]),
+            [rej for r in results for rej in r.rejects],
+            [ev for r in results for ev in r.drift_events],
+        )

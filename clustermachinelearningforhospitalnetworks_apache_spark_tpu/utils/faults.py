@@ -29,6 +29,19 @@ Actions:
 * ``tear``   — report a byte offset to :func:`torn_point`; the writer
   persists exactly that prefix and raises :class:`InjectedCrash`
 
+Data-plane corruption (PR 3) — the faults a *producer* commits rather
+than a disk: rules that rewrite CSV text passed through
+:func:`corrupt_data` at the ingest boundary (site ``ingest.csv_text``).
+All are seeded from the plan's ``seed`` (plus the rule's fire count), so
+a chaos test replays the identical dirty bytes every run:
+
+* ``mangle_field``   — replace a sample of fields with unparseable junk
+* ``shuffle_columns``— permute the column order (header included — the
+  drift the schema reconciler must undo)
+* ``unit_scale``     — multiply one numeric column by a factor (the
+  classic silent hours→minutes unit change)
+* ``nan_burst``      — blank a contiguous run of one column's values
+
 Everything is counted (calls per site, fires per rule) so tests can assert
 a fault actually happened — a chaos test whose fault never fired proves
 nothing.
@@ -37,11 +50,12 @@ nothing.
 from __future__ import annotations
 
 import fnmatch
+import random
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Callable, Iterator
+from typing import Callable, Iterator, Sequence
 
 
 class FaultError(OSError):
@@ -57,10 +71,14 @@ class InjectedCrash(BaseException):
     """
 
 
+#: rule actions that rewrite ingest data rather than raising/sleeping
+DATA_ACTIONS = ("mangle_field", "shuffle_columns", "unit_scale", "nan_burst")
+
+
 @dataclass
 class FaultRule:
     site: str                                  # fnmatch pattern
-    action: str                                # fail|crash|delay|corrupt|tear
+    action: str                                # fail|crash|delay|corrupt|tear|data
     after: int = 0                             # skip this many matching calls
     times: int | None = 1                      # fire at most this many (None=∞)
     error: Callable[[], BaseException] | None = None
@@ -68,6 +86,11 @@ class FaultRule:
     at_byte: int | None = None                 # tear/corrupt offset
     flip_mask: int = 0xFF                      # corrupt: XOR'd into the byte
     when: Callable[[dict], bool] | None = None # extra context predicate
+    # data-corruption parameters (DATA_ACTIONS only)
+    rate: float = 0.02                         # mangle_field: per-field prob
+    columns: tuple[str, ...] | None = None     # restrict to these columns
+    factor: float = 1000.0                     # unit_scale multiplier
+    burst_len: int = 8                         # nan_burst row run length
     seen: int = 0                              # matching calls observed
     fired: int = 0                             # times actually fired
 
@@ -146,6 +169,49 @@ class FaultPlan:
     ) -> "FaultPlan":
         return self._add(FaultRule(site, "tear", after, 1, at_byte=at_byte, when=when))
 
+    # ------------------------------------------------- data corruption
+    def mangle_fields(
+        self, site: str, rate: float = 0.02,
+        columns: Sequence[str] | None = None,
+        times: int | None = None, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        """Replace ~``rate`` of the (optionally ``columns``-restricted)
+        fields with unparseable junk."""
+        return self._add(FaultRule(
+            site, "mangle_field", after, times, rate=rate,
+            columns=None if columns is None else tuple(columns), when=when,
+        ))
+
+    def shuffle_columns(
+        self, site: str, times: int | None = 1, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        """Permute the column order (header and rows together)."""
+        return self._add(FaultRule(site, "shuffle_columns", after, times, when=when))
+
+    def unit_scale(
+        self, site: str, column: str, factor: float = 1000.0,
+        times: int | None = None, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        """Multiply every parseable value of ``column`` by ``factor``."""
+        return self._add(FaultRule(
+            site, "unit_scale", after, times, columns=(column,),
+            factor=factor, when=when,
+        ))
+
+    def nan_burst(
+        self, site: str, column: str, length: int = 8,
+        times: int | None = None, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        """Blank a contiguous run of ``length`` rows in ``column``."""
+        return self._add(FaultRule(
+            site, "nan_burst", after, times, columns=(column,),
+            burst_len=length, when=when,
+        ))
+
     # ------------------------------------------------------------ inspection
     def fired(self, site_pattern: str = "*") -> int:
         with self._lock:
@@ -196,6 +262,35 @@ class FaultPlan:
                 data = data[:i] + bytes([data[i] ^ (r.flip_mask & 0xFF)]) + data[i + 1:]
         return data
 
+    def has_data_rules(self, site: str) -> bool:
+        """Any (not-yet-exhausted) data-corruption rule aimed at ``site``?
+        The ingest fast path uses this as its one-branch gate."""
+        with self._lock:
+            return any(
+                r.action in DATA_ACTIONS
+                and fnmatch.fnmatchcase(site, r.site)
+                and (r.times is None or r.fired < r.times)
+                for r in self.rules
+            )
+
+    def corrupt_data(self, site: str, text: str, ctx: dict) -> str:
+        """Hook for data-corruption rules: rewrite CSV ``text`` (header
+        line + data lines) per the matching rules, deterministically
+        seeded from (plan seed, rule order, fire count)."""
+        fired_rules = []
+        with self._lock:
+            for i, r in enumerate(self.rules):
+                if r.action in DATA_ACTIONS and r.matches(site, ctx) and r.take():
+                    self.log.append((site, r.action))
+                    # snapshot the fire count INSIDE the lock: concurrent
+                    # callers must each get their own deterministic seed
+                    fired_rules.append((i, r, r.fired))
+        for i, r, fired in fired_rules:
+            # int-tuple hash is PYTHONHASHSEED-independent → deterministic
+            rng = random.Random(hash((self.seed, i, fired)))
+            text = _apply_data_rule(r, text, rng)
+        return text
+
     def torn_point(self, site: str, length: int, ctx: dict) -> int | None:
         """Hook for tear rules → byte count to persist before "dying"."""
         with self._lock:
@@ -210,6 +305,62 @@ class FaultPlan:
                     cut += length
                 return max(0, min(cut, length))
         return None
+
+
+# ------------------------------------------------------- data corruption
+#: the junk token mangle_field writes — unparseable as float/int/timestamp
+MANGLE_TOKEN = "x#!corrupt"
+
+
+def _apply_data_rule(r: FaultRule, text: str, rng: random.Random) -> str:
+    """Rewrite one CSV payload (header + rows) per one data rule."""
+    trailing_nl = text.endswith("\n")
+    lines = text.split("\n")
+    if trailing_nl:
+        lines = lines[:-1]
+    if len(lines) < 2:  # header only (or empty): nothing to corrupt
+        return text
+    header = lines[0].split(",")
+    rows = [ln.split(",") for ln in lines[1:]]
+    col_idx = {name.strip(): j for j, name in enumerate(header)}
+
+    def targets() -> list[int]:
+        if r.columns is None:
+            return list(range(len(header)))
+        return [col_idx[c] for c in r.columns if c in col_idx]
+
+    if r.action == "mangle_field":
+        cols = targets()
+        for row in rows:
+            for j in cols:
+                if j < len(row) and rng.random() < r.rate:
+                    row[j] = MANGLE_TOKEN
+    elif r.action == "shuffle_columns":
+        perm = list(range(len(header)))
+        while True:  # insist on a non-identity permutation
+            rng.shuffle(perm)
+            if perm != list(range(len(header))) or len(header) < 2:
+                break
+        header = [header[j] for j in perm]
+        rows = [
+            [row[j] if j < len(row) else "" for j in perm] for row in rows
+        ]
+    elif r.action == "unit_scale":
+        for j in targets():
+            for row in rows:
+                if j < len(row):
+                    try:
+                        row[j] = repr(float(row[j]) * r.factor)
+                    except (TypeError, ValueError):
+                        pass  # unparseable cell: leave as-is
+    elif r.action == "nan_burst":
+        start = rng.randrange(max(1, len(rows) - r.burst_len + 1))
+        for row in rows[start : start + r.burst_len]:
+            for j in targets():
+                if j < len(row):
+                    row[j] = ""
+    out = [",".join(header)] + [",".join(row) for row in rows]
+    return "\n".join(out) + ("\n" if trailing_nl else "")
 
 
 # ---------------------------------------------------------------- install
@@ -257,3 +408,18 @@ def torn_point(site: str, length: int, **ctx) -> int | None:
     :class:`InjectedCrash`."""
     p = _ACTIVE
     return None if p is None else p.torn_point(site, length, ctx)
+
+
+def corrupt_data(site: str, text: str, **ctx) -> str:
+    """Pass CSV text through the active plan's data-corruption rules
+    (mangle_field / shuffle_columns / unit_scale / nan_burst)."""
+    p = _ACTIVE
+    return text if p is None else p.corrupt_data(site, text, ctx)
+
+
+def data_rules_active(site: str) -> bool:
+    """True when the active plan holds live data-corruption rules for
+    ``site`` — the ingest fast path drops to the text-reading salvage
+    parser only then, so clean production reads stay on the native scan."""
+    p = _ACTIVE
+    return p is not None and p.has_data_rules(site)

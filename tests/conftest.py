@@ -84,6 +84,11 @@ def pytest_configure(config):
         "chaos: fault-injection kill-and-resume tests "
         "(tools/run_chaos.sh runs just these with a per-site table)",
     )
+    config.addinivalue_line(
+        "markers",
+        "quality: data-quality firewall tests — row validation, schema "
+        "drift, quarantine, PSI drift (python -m pytest tests/ -m quality)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

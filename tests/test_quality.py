@@ -1,0 +1,927 @@
+"""Data-quality firewall (PR 3): row validation, salvage parse, schema
+drift, quantile sketches / PSI, row quarantine, data-fault chaos, and
+drift-aware serving degradation.
+
+The chaos-marked classes run under ``tools/run_chaos.sh`` alongside the
+process-fault matrix; the soak test at the bottom is the PR's acceptance
+scenario (5% corrupt rows + one schema-drifted hospital, end to end).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu import quality
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+    attach_data_profile,
+    load_data_profile,
+    read_csv,
+    read_csv_salvage,
+    save_model,
+    write_csv,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.quality.reconcile import (
+    DRIFT_COLUMN_ADDED,
+    DRIFT_COLUMN_MISSING,
+    DRIFT_COLUMN_RENAMED,
+    DRIFT_COLUMN_REORDERED,
+    reconcile_columns,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+    FileStreamSource,
+    StreamCheckpoint,
+    StreamExecution,
+    UnboundedTable,
+    WatermarkTracker,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+
+pytestmark = pytest.mark.quality
+
+SCHEMA = ht.hospital_event_schema()
+
+
+def _event_table(n, hospital="H01", start="2025-03-31T22:00:00", los=None):
+    """Synthetic events with VARIED features and a linear LOS signal
+    (constant columns make estimators degenerate — see conftest's
+    hospital_table); deterministic so dirty-line injection is exact."""
+    base = np.datetime64(start)
+    i = np.arange(n)
+    admission = i % 50
+    occupancy = 80 + (i * 7) % 250
+    emergency = i % 25
+    season = 0.5 + (i % 10) * 0.1
+    los_v = (
+        np.full(n, float(los))
+        if los is not None
+        else 0.05 * admission + 0.01 * occupancy + 0.08 * emergency + 1.5 * season
+    )
+    return ht.Table.from_dict(
+        {
+            "hospital_id": np.array([hospital] * n, dtype=object),
+            "event_time": base + i.astype("timedelta64[s]"),
+            "admission_count": admission,
+            "current_occupancy": occupancy,
+            "emergency_visits": emergency,
+            "seasonality_index": season,
+            "length_of_stay": los_v,
+        },
+        SCHEMA,
+    )
+
+
+def _firewalled_stream(tmp_path, monitor=None, **kw):
+    incoming = tmp_path / "incoming"
+    incoming.mkdir(exist_ok=True)
+    fw = quality.DataFirewall(
+        SCHEMA, quality.hospital_constraints(),
+        aliases={"los": "length_of_stay"}, monitor=monitor,
+    )
+    ckpt = StreamCheckpoint(str(tmp_path / "ckpt"))
+    ex = StreamExecution(
+        source=FileStreamSource(str(incoming), SCHEMA),
+        sink=UnboundedTable(str(tmp_path / "table"), SCHEMA),
+        checkpoint=ckpt,
+        watermark=WatermarkTracker("event_time", 10.0),
+        firewall=fw,
+        **kw,
+    )
+    return incoming, ex, ckpt, fw
+
+
+# ===================================================================== sketches
+class TestSketches:
+    def test_update_moments_match_numpy(self, rng):
+        v = rng.normal(3.0, 2.0, 10_000)
+        sk = quality.FeatureSketch(edges=np.linspace(-5, 11, 17))
+        sk.update(v[:4000]).update(v[4000:])
+        assert sk.count == 10_000
+        assert np.isclose(sk.mean, v.mean())
+        assert np.isclose(sk.std, v.std())
+        assert sk.min == v.min() and sk.max == v.max()
+
+    def test_merge_is_exact(self, rng):
+        v = rng.normal(0, 1, 6000)
+        edges = np.linspace(-4, 4, 17)
+        a = quality.FeatureSketch(edges=edges).update(v[:1000])
+        b = quality.FeatureSketch(edges=edges).update(v[1000:])
+        whole = quality.FeatureSketch(edges=edges).update(v)
+        a.merge(b)
+        assert np.isclose(a.mean, whole.mean)
+        assert np.isclose(a.m2, whole.m2)
+        assert np.array_equal(a.counts, whole.counts)
+
+    def test_merge_rejects_mismatched_edges(self):
+        a = quality.FeatureSketch(edges=[0.0, 1.0])
+        b = quality.FeatureSketch(edges=[0.0, 2.0])
+        with pytest.raises(ValueError, match="different bin edges"):
+            a.merge(b)
+
+    def test_psi_separates_clean_from_shifted(self, rng):
+        ref = quality.DataProfile.from_matrix(
+            rng.normal(0, 1, (4000, 2)), ["a", "b"]
+        )
+        same = quality.DataProfile.like(ref)
+        same.update_matrix(rng.normal(0, 1, (2000, 2)))
+        shifted = quality.DataProfile.like(ref)
+        shifted.update_matrix(rng.normal(0, 1, (2000, 2)) * 100 + 50)
+        psi_same = max(ref.psi_against(same).values())
+        psi_shift = max(ref.psi_against(shifted).values())
+        assert psi_same < quality.PSI_STABLE
+        assert psi_shift > quality.PSI_DRIFT
+
+    def test_empty_live_is_not_drift(self, rng):
+        ref = quality.DataProfile.from_matrix(rng.normal(0, 1, (100, 1)), ["a"])
+        assert max(ref.psi_against(quality.DataProfile.like(ref)).values()) == 0.0
+
+    def test_json_roundtrip(self, rng):
+        ref = quality.DataProfile.from_matrix(
+            rng.normal(0, 1, (500, 3)), ["a", "b", "c"]
+        )
+        rt = quality.DataProfile.from_dict(
+            json.loads(json.dumps(ref.to_dict()))
+        )
+        live = quality.DataProfile.like(ref)
+        live.update_matrix(rng.normal(2, 1, (300, 3)))
+        assert ref.psi_against(live) == rt.psi_against(live)
+
+    def test_constant_column_and_nan_handling(self):
+        prof = quality.DataProfile.from_matrix(
+            np.column_stack([np.full(50, 7.0), np.full(50, np.nan)]),
+            ["const", "allnan"],
+        )
+        sk = prof.sketches["const"]
+        assert sk.count == 50 and sk.std == 0.0
+        assert prof.sketches["allnan"].n_invalid == 50
+
+    def test_approx_quantile(self, rng):
+        v = rng.uniform(0, 10, 50_000)
+        sk = quality.FeatureSketch(edges=np.linspace(0, 10, 41)).update(v)
+        assert abs(sk.approx_quantile(0.5) - 5.0) < 0.3
+
+
+# =================================================================== reconcile
+class TestReconcile:
+    NAMES = SCHEMA.names
+
+    def test_exact_header_no_events(self):
+        m = reconcile_columns(self.NAMES, SCHEMA)
+        assert m.exact
+        assert [m.indices[n] for n in self.NAMES] == list(range(len(self.NAMES)))
+
+    def test_reordered(self):
+        m = reconcile_columns(list(reversed(self.NAMES)), SCHEMA)
+        kinds = {e.kind for e in m.events}
+        assert kinds == {DRIFT_COLUMN_REORDERED}
+        assert m.indices["hospital_id"] == len(self.NAMES) - 1
+
+    def test_rename_via_alias_and_normalization(self):
+        src = [
+            "Hospital_ID", "event_time", "admission_count",
+            "current_occupancy", "emergency_visits", "seasonality_index",
+            "los",
+        ]
+        m = reconcile_columns(src, SCHEMA, aliases={"los": "length_of_stay"})
+        renamed = {
+            (e.source, e.target)
+            for e in m.events if e.kind == DRIFT_COLUMN_RENAMED
+        }
+        assert ("los", "length_of_stay") in renamed
+        assert ("Hospital_ID", "hospital_id") in renamed
+        assert m.missing == ()
+
+    def test_missing_and_added(self):
+        src = self.NAMES[:-1] + ["brand_new_col"]
+        m = reconcile_columns(src, SCHEMA)
+        kinds = [e.kind for e in m.events]
+        assert DRIFT_COLUMN_MISSING in kinds and DRIFT_COLUMN_ADDED in kinds
+        assert m.indices["length_of_stay"] is None
+
+
+# ================================================================== validators
+class TestValidators:
+    def test_range_rejects_with_reason(self):
+        t = _event_table(5).with_column(
+            "length_of_stay", np.array([4.0, 400.0, 4.0, -1.0, 4.0])
+        )
+        vr = quality.RowValidator(
+            SCHEMA, quality.hospital_constraints()
+        ).validate(t)
+        assert len(vr.accepted) == 3 and vr.n_rejected == 2
+        assert vr.histogram == {"range:length_of_stay": 2}
+        assert all("range:length_of_stay" in r for r in vr.reasons)
+
+    def test_nan_passes_range_but_inf_rejects(self):
+        t = _event_table(3).with_column(
+            "seasonality_index", np.array([np.nan, 1.0, np.inf])
+        )
+        vr = quality.RowValidator(
+            SCHEMA, quality.hospital_constraints()
+        ).validate(t)
+        # NaN is missing (imputer's job); +Inf is wrong (reject) — the one
+        # bad row carries both the range and the non-finite reason
+        assert len(vr.accepted) == 2 and vr.n_rejected == 1
+        assert vr.histogram["non_finite:seasonality_index"] == 1
+        assert "non_finite:seasonality_index" in vr.reasons[0]
+
+    def test_not_null(self):
+        t = _event_table(3)
+        et = t.column("event_time").copy()
+        et[1] = np.datetime64("NaT")
+        t = t.with_column("event_time", et)
+        vr = quality.RowValidator(
+            SCHEMA, quality.hospital_constraints()
+        ).validate(t)
+        assert vr.histogram == {"null:event_time": 1}
+
+    def test_domain(self):
+        cs = quality.ConstraintSet().domain("hospital_id", ["H01", "H02"])
+        t = _event_table(3)
+        hid = t.column("hospital_id").copy()
+        hid[2] = "MARS"
+        t = t.with_column("hospital_id", hid, dtype="string")
+        vr = quality.RowValidator(SCHEMA, cs).validate(t)
+        assert vr.histogram == {"domain:hospital_id": 1}
+
+    def test_monotone_grouped(self):
+        t = _event_table(4)
+        et = t.column("event_time").copy()
+        et[2] = et[0] - np.timedelta64(60, "s")  # H01 goes backwards
+        t = t.with_column("event_time", et)
+        cs = quality.ConstraintSet().monotone("event_time", group_by="hospital_id")
+        vr = quality.RowValidator(SCHEMA, cs).validate(t)
+        assert vr.histogram == {"monotone:event_time": 1}
+        assert len(vr.accepted) == 3
+
+    def test_empty_table(self):
+        vr = quality.RowValidator(
+            SCHEMA, quality.hospital_constraints()
+        ).validate(ht.Table.empty(SCHEMA))
+        assert vr.n_input == 0 and vr.n_rejected == 0
+
+
+# ================================================================ salvage csv
+class TestSalvageCsv:
+    def _write(self, tmp_path, text):
+        p = tmp_path / "h.csv"
+        p.write_text(text)
+        return str(p)
+
+    def test_clean_file_matches_strict_parse(self, tmp_path):
+        t = _event_table(30)
+        p = str(tmp_path / "clean.csv")
+        write_csv(t, p)
+        strict = read_csv(p, SCHEMA)
+        sr = read_csv_salvage(p, SCHEMA)
+        assert not sr.rejects and not sr.drift_events
+        for c in SCHEMA.names:
+            np.testing.assert_array_equal(
+                strict.columns[c].astype("U32"),
+                sr.table.columns[c].astype("U32"),
+            )
+
+    def test_single_bad_field_rejects_one_row_not_the_file(self, tmp_path):
+        t = _event_table(10)
+        p = str(tmp_path / "h.csv")
+        write_csv(t, p)
+        lines = open(p).read().rstrip("\n").split("\n")
+        parts = lines[3].split(",")
+        parts[3] = "one-hundred"  # occupancy garbage
+        lines[3] = ",".join(parts)
+        open(p, "w").write("\n".join(lines) + "\n")
+        sr = read_csv_salvage(p, SCHEMA)
+        assert len(sr.table) == 9
+        assert [r.line_no for r in sr.rejects] == [4]
+        assert sr.rejects[0].reasons == ("parse:current_occupancy",)
+
+    def test_ragged_row_rejects_field_count(self, tmp_path):
+        p = self._write(
+            tmp_path,
+            ",".join(SCHEMA.names) + "\n"
+            "H01,2025-03-31 22:00:00,1,100,5,1.0,4.0\n"
+            "H01,2025-03-31 22:00:01,1,100\n",
+        )
+        sr = read_csv_salvage(p, SCHEMA)
+        assert len(sr.table) == 1
+        assert sr.rejects[0].reasons == ("field_count",)
+
+    def test_empty_fields_become_nulls_not_rejects(self, tmp_path):
+        p = self._write(
+            tmp_path,
+            ",".join(SCHEMA.names) + "\n"
+            "H01,2025-03-31 22:00:00,,100,5,1.0,4.0\n",
+        )
+        sr = read_csv_salvage(p, SCHEMA)
+        assert len(sr.table) == 1 and not sr.rejects
+        assert np.isnan(sr.table.column("admission_count")[0])
+
+    def test_line_numbers_are_physical_despite_blank_lines(self, tmp_path):
+        """Quarantine evidence must point at the ACTUAL file line."""
+        p = self._write(
+            tmp_path,
+            ",".join(SCHEMA.names) + "\n"
+            "H01,2025-03-31 22:00:00,1,100,5,1.0,4.0\n"
+            "\n"
+            "H01,2025-03-31 22:00:01,BAD,100,5,1.0,4.0\n",
+        )
+        sr = read_csv_salvage(p, SCHEMA)
+        assert [r.line_no for r in sr.rejects] == [4]
+        # same contract through the firewall fast path's rescan
+        fw = quality.DataFirewall(SCHEMA, quality.hospital_constraints())
+        res = fw.ingest_file(p)
+        assert [r["line_no"] for r in res.rejects] == [4]
+
+    def test_drifted_header_reconciles(self, tmp_path):
+        p = self._write(
+            tmp_path,
+            "event_time,hospital_id,admission_count,current_occupancy,"
+            "emergency_visits,seasonality_index,los\n"
+            "2025-03-31 22:00:00,H09,1,100,5,1.0,4.0\n",
+        )
+        sr = read_csv_salvage(p, SCHEMA, aliases={"los": "length_of_stay"})
+        assert len(sr.table) == 1 and not sr.rejects
+        assert sr.table.column("hospital_id")[0] == "H09"
+        assert sr.table.column("length_of_stay")[0] == 4.0
+        kinds = {e.kind for e in sr.drift_events}
+        assert DRIFT_COLUMN_RENAMED in kinds and DRIFT_COLUMN_REORDERED in kinds
+
+    def test_strict_read_still_fails_the_file(self, tmp_path):
+        """The pre-PR3 contract is preserved for callers that want it."""
+        p = self._write(
+            tmp_path,
+            ",".join(SCHEMA.names) + "\n"
+            "H01,not-a-timestamp,1,100,5,1.0,4.0\n",
+        )
+        with pytest.raises(Exception):
+            read_csv(p, SCHEMA, engine="numpy")
+
+
+# ============================================================= stream firewall
+class TestStreamFirewall:
+    def test_dirty_rows_quarantined_batch_commits(self, tmp_path):
+        incoming, ex, ckpt, fw = _firewalled_stream(tmp_path)
+        t = _event_table(20)
+        p = str(incoming / "a.csv")
+        write_csv(t, p)
+        lines = open(p).read().rstrip("\n").split("\n")
+        lines[2] = "H01,2025-03-31 22:00:01,JUNK,100,5,1.0,4.0"
+        lines[5] = "H01,2025-03-31 22:00:04,4,100,5,1.0,900.0"
+        lines[8] = "H01,2025-03-31 22:00:07,4"  # ragged (fast-path rescan)
+        open(p, "w").write("\n".join(lines) + "\n")
+
+        info = ex.run_once()
+        assert info.status == "ok"
+        assert info.num_rejected_rows == 3
+        assert info.num_appended_rows == 17
+        assert ex.sink.read().num_rows == 17
+        assert ckpt.quarantined_row_count() == 3
+        hist = ckpt.row_reason_histogram()
+        assert hist == {
+            "parse:admission_count": 1,
+            "range:length_of_stay": 1,
+            "field_count": 1,
+        }
+        assert ex.metrics.counters["stream.rows_rejected"] == 3
+
+    def test_replay_does_not_double_count_rejects(self, tmp_path):
+        """A batch that fails AFTER quarantining and is replayed must not
+        double-count stream.rows_rejected (health() reads it)."""
+        calls = {"n": 0}
+
+        def flaky(batch, batch_id):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient foreach failure")
+
+        incoming, ex, ckpt, fw = _firewalled_stream(
+            tmp_path, foreach_batch=flaky
+        )
+        p = str(incoming / "a.csv")
+        write_csv(_event_table(10), p)
+        lines = open(p).read().rstrip("\n").split("\n")
+        lines[2] = "H01,2025-03-31 22:00:01,JUNK,100,5,1.0,4.0"
+        open(p, "w").write("\n".join(lines) + "\n")
+        info = ex.run_once()
+        assert info.status == "ok" and calls["n"] == 2  # replay happened
+        assert info.num_rejected_rows == 1
+        assert ex.metrics.counters["stream.rows_rejected"] == 1
+        assert ckpt.quarantined_row_count() == 1
+
+    def test_row_quarantine_file_layout(self, tmp_path):
+        incoming, ex, ckpt, fw = _firewalled_stream(tmp_path)
+        p = str(incoming / "a.csv")
+        write_csv(_event_table(5), p)
+        lines = open(p).read().rstrip("\n").split("\n")
+        lines[1] = "H01,2025-03-31 22:00:00,bad,100,5,1.0,4.0"
+        open(p, "w").write("\n".join(lines) + "\n")
+        ex.run_once()
+        qfile = tmp_path / "ckpt" / "quarantine" / "rows" / "batch-0000000000.json"
+        assert qfile.exists()
+        rec = json.loads(qfile.read_text())
+        assert rec["n_rejected"] == 1
+        assert rec["rejects"][0]["reasons"] == ["parse:admission_count"]
+        assert rec["rejects"][0]["line_no"] == 2
+        assert "raw" in rec["rejects"][0]
+
+    def test_drifted_hospital_ingests_with_events(self, tmp_path):
+        incoming, ex, ckpt, fw = _firewalled_stream(tmp_path)
+        (incoming / "h7.csv").write_text(
+            "event_time,hospital_id,admission_count,current_occupancy,"
+            "emergency_visits,seasonality_index,los\n"
+            "2025-03-31 22:00:00,H07,1,100,5,1.0,4.0\n"
+            "2025-03-31 22:00:01,H07,2,100,5,1.0,4.5\n"
+        )
+        info = ex.run_once()
+        assert info.num_appended_rows == 2 and info.num_rejected_rows == 0
+        assert info.num_drift_events > 0
+        assert ex.metrics.counters["stream.drift_events"] > 0
+        snap = ex.sink.read()
+        assert list(snap.column("length_of_stay")[:2]) == [4.0, 4.5]
+
+    def test_clean_stream_unchanged(self, tmp_path):
+        """Firewall on clean data: same rows, zero rejects, no events."""
+        incoming, ex, ckpt, fw = _firewalled_stream(tmp_path)
+        write_csv(_event_table(40), str(incoming / "a.csv"))
+        info = ex.run_once()
+        assert info.num_input_rows == 40
+        assert info.num_appended_rows == 40
+        assert info.num_rejected_rows == 0
+        assert ckpt.quarantined_row_count() == 0
+
+    def test_ingest_drift_monitor_gauge(self, tmp_path, rng):
+        ref = quality.DataProfile.from_matrix(
+            np.column_stack([
+                rng.integers(0, 50, 500),
+                rng.integers(20, 400, 500),
+                rng.integers(0, 30, 500),
+                rng.uniform(0.5, 1.5, 500),
+            ]).astype(np.float64),
+            list(ht.FEATURE_COLS),
+        )
+        monitor = quality.DriftMonitor(ref, window_rows=10, trip_after=1)
+        incoming, ex, ckpt, fw = _firewalled_stream(tmp_path, monitor=monitor)
+        write_csv(_event_table(30), str(incoming / "a.csv"))
+        ex.run_once()
+        assert "stream.drift_psi" in ex.metrics.gauges
+        assert monitor.snapshot()["windows"] >= 1
+
+
+# ============================================================== data faults
+@pytest.mark.chaos
+class TestDataFaultKinds:
+    """The four data-corruption kinds drive the firewall deterministically;
+    parametrized ids land in tools/run_chaos.sh's per-site table."""
+
+    def _run(self, tmp_path, plan, n=40):
+        incoming, ex, ckpt, fw = _firewalled_stream(tmp_path)
+        write_csv(_event_table(n), str(incoming / "a.csv"))
+        with faults.active(plan):
+            info = ex.run_once()
+        return info, ex, ckpt, plan
+
+    @pytest.mark.parametrize("kind", ["data-mangle_field"])
+    def test_mangle_field_rows_quarantined(self, tmp_path, kind):
+        plan = faults.FaultPlan(seed=3).mangle_fields(
+            "ingest.csv_text", rate=0.2,
+            columns=("admission_count", "current_occupancy"), times=None,
+        )
+        info, ex, ckpt, plan = self._run(tmp_path, plan)
+        assert plan.fired("ingest.csv_text") == 1
+        assert info.status == "ok"
+        assert info.num_rejected_rows > 0
+        hist = ckpt.row_reason_histogram()
+        assert set(hist) <= {"parse:admission_count", "parse:current_occupancy"}
+        assert info.num_appended_rows + info.num_rejected_rows == 40
+
+    @pytest.mark.parametrize("kind", ["data-shuffle_columns"])
+    def test_shuffle_columns_reconciled_lossless(self, tmp_path, kind):
+        plan = faults.FaultPlan(seed=5).shuffle_columns("ingest.csv_text")
+        info, ex, ckpt, plan = self._run(tmp_path, plan)
+        assert plan.fired("ingest.csv_text") == 1
+        assert info.num_rejected_rows == 0
+        assert info.num_appended_rows == 40          # nothing lost
+        assert info.num_drift_events > 0             # but it was seen
+        snap = ex.sink.read()
+        np.testing.assert_array_equal(
+            np.sort(snap.column("admission_count")), np.arange(40)
+        )
+
+    @pytest.mark.parametrize("kind", ["data-unit_scale"])
+    def test_unit_scale_caught_by_range(self, tmp_path, kind):
+        # LOS 4.0 days → ×1000 = 4000, far past the 365-day ceiling
+        plan = faults.FaultPlan(seed=7).unit_scale(
+            "ingest.csv_text", column="length_of_stay", factor=1000.0
+        )
+        info, ex, ckpt, plan = self._run(tmp_path, plan)
+        assert plan.fired("ingest.csv_text") == 1
+        assert info.num_rejected_rows == 40          # every row out of range
+        assert ckpt.row_reason_histogram() == {"range:length_of_stay": 40}
+        assert info.num_appended_rows == 0
+
+    @pytest.mark.parametrize("kind", ["data-nan_burst"])
+    def test_nan_burst_accepted_for_imputation(self, tmp_path, kind):
+        plan = faults.FaultPlan(seed=9).nan_burst(
+            "ingest.csv_text", column="current_occupancy", length=8
+        )
+        info, ex, ckpt, plan = self._run(tmp_path, plan)
+        assert plan.fired("ingest.csv_text") == 1
+        # missing ≠ wrong: the burst is accepted as nulls, imputer's job
+        assert info.num_rejected_rows == 0
+        occ = ex.sink.read().column("current_occupancy").astype(np.float64)
+        assert int(np.isnan(occ).sum()) == 8
+
+    @pytest.mark.parametrize("kind", ["data-deterministic_replay"])
+    def test_corruption_is_deterministic(self, tmp_path, kind):
+        """Same plan seed ⇒ byte-identical dirty text ⇒ identical rejects."""
+        write_csv(_event_table(30), str(tmp_path / "a.csv"))
+        raw = open(str(tmp_path / "a.csv")).read()
+        outs = []
+        for _ in range(2):
+            plan = faults.FaultPlan(seed=11).mangle_fields(
+                "ingest.csv_text", rate=0.3, times=None
+            )
+            with faults.active(plan):
+                outs.append(faults.corrupt_data("ingest.csv_text", raw))
+        assert outs[0] == outs[1] and outs[0] != raw
+
+    @pytest.mark.parametrize("kind", ["data-retry_then_salvage"])
+    def test_source_retry_composes_with_firewall(self, tmp_path, kind):
+        """Transient IO faults retry; the salvage read still fires after."""
+        plan = (
+            faults.FaultPlan(seed=13)
+            .fail("source.read_file", times=2)
+            .mangle_fields(
+                "ingest.csv_text", rate=0.2, columns=("admission_count",),
+                times=None,
+            )
+        )
+        info, ex, ckpt, plan = self._run(tmp_path, plan)
+        assert plan.fired("source.read_file") == 2
+        assert ex.source.retries == 2
+        assert info.status == "ok"
+        assert info.num_appended_rows + info.num_rejected_rows == 40
+
+
+# ============================================================ model_io profile
+class TestModelIoProfile:
+    def test_save_model_with_profile_roundtrip(self, tmp_path, rng):
+        prof = quality.DataProfile.from_matrix(
+            rng.normal(0, 1, (200, 2)), ["a", "b"]
+        )
+        p = str(tmp_path / "m")
+        save_model(
+            p, "KMeansModel", {"k": 1},
+            {"cluster_centers": np.zeros((1, 2))},
+            data_profile=prof.to_dict(),
+        )
+        loaded = load_data_profile(p)
+        assert loaded == json.loads(json.dumps(prof.to_dict()))
+
+    def test_attach_profile_after_save(self, tmp_path, rng):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+            LinearRegression,
+        )
+
+        x = rng.normal(0, 1, (64, 3)).astype(np.float32)
+        y = x.sum(axis=1)
+        m = LinearRegression().fit((x, y))
+        p = str(tmp_path / "m")
+        m.save(p)
+        assert load_data_profile(p) is None
+        prof = quality.DataProfile.from_matrix(x, ["a", "b", "c"])
+        attach_data_profile(p, prof.to_dict())
+        assert load_data_profile(p) is not None
+        # the artifact still loads as a model (metadata rewrite was clean)
+        assert ht.load_model(p).predict(x[:2]).shape == (2,)
+
+
+# =============================================================== serve guards
+class TestServeGuards:
+    BUCKETS = (1, 2, 4)
+
+    def _server(self, tmp_path, rng, policy, window=16, trip_after=2):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+            LinearRegression,
+        )
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+            InferenceServer,
+        )
+
+        x = rng.normal(0, 1, (512, 3)).astype(np.float32)
+        y = x @ np.array([1.0, 2.0, 3.0], np.float32)
+        model = LinearRegression().fit((x, y))
+        prof = quality.DataProfile.from_matrix(x, ["a", "b", "c"])
+        srv = InferenceServer(breaker_recovery_s=60.0)
+        srv.add_model(
+            "m", model, buckets=self.BUCKETS,
+            fallback=lambda rows: np.zeros(rows.shape[0], np.float32),
+            data_profile=prof.to_dict(), input_policy=policy,
+            drift_window_rows=window, drift_trip_after=trip_after,
+        )
+        return srv, x
+
+    def test_impute_policy_repairs_and_counts(self, tmp_path, rng):
+        srv, x = self._server(tmp_path, rng, "impute")
+        with srv:
+            r = srv.predict("m", np.array([np.nan, 0.0, 0.0], np.float32))
+            assert r.ok and np.isfinite(r.value).all()
+            assert srv.metrics.registry.counters["serve.inputs_imputed"] == 1
+            h = srv.health()
+            assert h["inputs_imputed"] == 1
+
+    def test_reject_policy_answers_invalid_input(self, tmp_path, rng):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+            STATUS_INVALID_INPUT,
+        )
+
+        srv, x = self._server(tmp_path, rng, "reject")
+        with srv:
+            r = srv.predict("m", np.array([np.inf, 0.0, 0.0], np.float32))
+            assert r.status == STATUS_INVALID_INPUT
+            assert r.value is None and not r.degraded
+            assert "non_finite:a" in r.detail
+            far = srv.predict("m", np.array([1e9, 0.0, 0.0], np.float32))
+            assert far.status == STATUS_INVALID_INPUT
+            assert "out_of_range:a" in far.detail
+            ok = srv.predict("m", x[0])
+            assert ok.ok
+
+    def test_sustained_drift_trips_to_degraded_answers(self, tmp_path, rng):
+        srv, x = self._server(tmp_path, rng, None, window=16, trip_after=2)
+        with srv:
+            for i in range(40):  # clean warm traffic
+                assert srv.predict("m", x[i]).ok
+            assert srv.health()["status"] == "ok"
+            degraded = 0
+            for i in range(64):  # unit-shifted traffic
+                r = srv.predict("m", x[i] * 200.0)
+                degraded += bool(r.degraded)
+            h = srv.health()
+            assert h["drift_trips"] >= 1
+            assert h["status"] == "degraded"
+            assert h["drift"]["m"]["drifting"]
+            assert h["drift"]["m"]["max_psi"] > quality.PSI_DRIFT
+            assert h["breakers"]["m"]["state"] != "closed"
+            assert h["breakers"]["m"]["tripped_count"] >= 1
+            assert "drift" in h["breakers"]["m"]["last_trip_reason"]
+            assert degraded > 0  # fallback answered, nobody got silence
+
+    def test_constant_training_column_tolerates_epsilon(self, rng):
+        """A feature constant at fit time must not flag epsilon-different
+        live values (span floors at half the value's scale)."""
+        prof = quality.DataProfile.from_matrix(
+            np.column_stack([np.full(100, 5.0), rng.normal(0, 1, 100)]),
+            ["const", "varied"],
+        )
+        g = quality.InputGuard(prof, policy="reject")
+        _, n_bad, _ = g.inspect(np.array([5.0001, 0.0]))
+        assert n_bad == 0
+        _, n_bad, reasons = g.inspect(np.array([100.0, 0.0]))
+        assert n_bad == 1 and reasons == ["out_of_range:const"]
+
+    def test_one_hot_window_does_not_degrade_health(self, tmp_path, rng):
+        """A single traffic burst shows as per-model 'drifting' but must
+        not read as a degraded server — only sustained drift (via the
+        breaker trip) changes the status an orchestrator probes."""
+        srv, x = self._server(tmp_path, rng, None, window=16, trip_after=50)
+        with srv:
+            for i in range(20):  # exactly one hot window, never trips
+                srv.predict("m", x[i] * 200.0)
+            h = srv.health()
+            assert h["drift"]["m"]["drifting"]
+            assert h["drift_trips"] == 0
+            assert h["status"] == "ok"
+
+    def test_clean_traffic_never_trips(self, tmp_path, rng):
+        srv, x = self._server(tmp_path, rng, "impute")
+        with srv:
+            for i in range(80):
+                assert srv.predict("m", x[i]).ok
+            h = srv.health()
+            assert h["drift_trips"] == 0 and h["status"] == "ok"
+
+
+# ====================================================== feature edge cases
+class TestFeatureEdgeCases:
+    """The inputs the firewall routes downstream: all-NaN column, constant
+    column, single-row batch (satellite: features/imputer.py +
+    features/robust.py)."""
+
+    def test_imputer_all_nan_column_raises_clearly(self):
+        t = ht.Table.from_dict({"a": np.full(4, np.nan)})
+        with pytest.raises(ValueError, match="no non-missing values"):
+            ht.Imputer(input_cols=["a"]).fit(t)
+
+    def test_imputer_constant_column(self):
+        t = ht.Table.from_dict({"a": np.array([7.0, 7.0, np.nan, 7.0])})
+        m = ht.Imputer(input_cols=["a"], strategy="median").fit(t)
+        assert m.surrogates == (7.0,)
+        out = m.transform(t)
+        np.testing.assert_array_equal(out.column("a"), np.full(4, 7.0))
+
+    def test_imputer_single_row(self):
+        t = ht.Table.from_dict({"a": np.array([3.0])})
+        m = ht.Imputer(input_cols=["a"]).fit(t)
+        assert m.surrogates == (3.0,)
+
+    def test_robust_scaler_constant_column_unscaled(self):
+        x = np.column_stack([np.full(20, 5.0), np.arange(20.0)])
+        m = ht.RobustScaler(with_centering=True).fit(x)
+        out = np.asarray(m.transform(x))
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out[:, 0], 0.0)  # centered, iqr-guarded
+
+    def test_robust_scaler_all_nan_column(self):
+        x = np.column_stack([np.full(10, np.nan), np.arange(10.0)])
+        m = ht.RobustScaler().fit(x)
+        assert np.isfinite(m.median).all() and np.isfinite(m.iqr).all()
+        out = np.asarray(m.transform(x))
+        assert np.isfinite(out[:, 1]).all()
+
+    def test_robust_scaler_single_row(self):
+        x = np.array([[2.0, 4.0]])
+        m = ht.RobustScaler(with_centering=True).fit(x)
+        out = np.asarray(m.transform(x))
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, 0.0)  # x − median(x) = 0, iqr 0
+
+    def test_maxabs_scaler_partial_nan_column(self):
+        x = np.array([[1.0, -8.0], [np.nan, 2.0], [0.5, 4.0]])
+        m = ht.MaxAbsScaler().fit(x)
+        np.testing.assert_allclose(m.max_abs, [1.0, 8.0])
+
+    def test_maxabs_scaler_partial_nan_device_path(self):
+        """The DeviceDataset fit must match the host path — a NaN must
+        not collapse a column's scale through the device reduction."""
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (
+            device_dataset,
+        )
+
+        x = np.array([[1.0, 5.0], [2.0, np.nan], [3.0, 7.0]])
+        m = ht.MaxAbsScaler().fit(device_dataset(x, None))
+        np.testing.assert_allclose(m.max_abs, [3.0, 7.0])
+
+    def test_maxabs_scaler_all_nan_column(self):
+        x = np.column_stack([np.full(5, np.nan), np.arange(5.0)])
+        m = ht.MaxAbsScaler().fit(x)
+        assert np.isfinite(m.max_abs).all()
+        out = np.asarray(m.transform(x))
+        assert np.isfinite(out[:, 1]).all()
+
+
+# ==================================================================== soak
+class TestDirtyDataSoak:
+    """Acceptance scenario: 5% injected corrupt rows + one schema-drifted
+    hospital → the ingest→train→serve run completes with zero unhandled
+    exceptions, quarantines EXACTLY the bad rows with reasons, and the
+    trained model matches the clean-data run."""
+
+    N_PER_FILE = 40
+    N_FILES = 4            # clean hospitals
+    N_DRIFTED = 20         # rows from the schema-drifted hospital
+
+    def _write_fleet(self, incoming):
+        expected_parse = set()   # (file, line_no)
+        expected_range = set()   # admission_count marker values
+        total_clean = 0
+        for f in range(self.N_FILES):
+            t = _event_table(
+                self.N_PER_FILE, hospital=f"H{f:02d}",
+                start="2025-03-31T22:00:00",
+            )
+            p = str(incoming / f"h{f:02d}.csv")
+            write_csv(t, p)
+            lines = open(p).read().rstrip("\n").split("\n")
+            # 5% dirty: one garbage field + one out-of-range LOS per file
+            garbage_ln = 3 + f          # 1-based line in file
+            lines[garbage_ln - 1] = (
+                f"H{f:02d},2025-03-31 22:30:00,NOT_A_NUMBER,100,5,1.0,4.0"
+            )
+            expected_parse.add((f"h{f:02d}.csv", garbage_ln))
+            marker = 9000 + f
+            range_ln = 10 + f
+            lines[range_ln - 1] = (
+                f"H{f:02d},2025-03-31 22:31:00,{marker},100,5,1.0,500.0"
+            )
+            expected_range.add(float(marker))
+            open(p, "w").write("\n".join(lines) + "\n")
+            total_clean += self.N_PER_FILE - 2
+        # the drifted hospital: renamed label + reordered columns, clean data
+        rows = "\n".join(
+            f"2025-03-31 22:00:{i:02d},H99,{i},150,6,1.1,5.0"
+            for i in range(self.N_DRIFTED)
+        )
+        (incoming / "h99.csv").write_text(
+            "event_time,hospital_id,admission_count,current_occupancy,"
+            "emergency_visits,seasonality_index,los\n" + rows + "\n"
+        )
+        total_clean += self.N_DRIFTED
+        return expected_parse, expected_range, total_clean
+
+    def test_soak_ingest_train_serve(self, tmp_path, rng):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+            LinearRegression,
+        )
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+            InferenceServer,
+        )
+
+        incoming, ex, ckpt, fw = _firewalled_stream(tmp_path)
+        expected_parse, expected_range, total_clean = self._write_fleet(incoming)
+
+        # ---- ingest: must complete, no unhandled exceptions, no batch loss
+        # (all files exist before the first poll ⇒ one micro-batch)
+        info = ex.run_once()
+        assert info is not None and info.status == "ok"
+        assert ex.run_once() is None  # fully drained
+        n_expected_bad = len(expected_parse) + len(expected_range)
+        assert info.num_rejected_rows == n_expected_bad
+
+        # ---- quarantined EXACTLY the bad rows, with reasons
+        recs = [r for e in ckpt.quarantined_rows() for r in e["rejects"]]
+        got_parse = {
+            (os.path.basename(r["context"]), r["line_no"])
+            for r in recs if "line_no" in r and "raw" in r
+        }
+        got_range = {
+            float(r["row"]["admission_count"])
+            for r in recs if "row" in r
+        }
+        assert got_parse == expected_parse
+        assert got_range == expected_range
+        hist = ckpt.row_reason_histogram()
+        assert hist["parse:admission_count"] == len(expected_parse)
+        assert hist["range:length_of_stay"] == len(expected_range)
+
+        # ---- the sink holds every good row (drifted hospital included)
+        snap = ex.sink.read()
+        assert snap.num_rows == total_clean
+        assert (snap.column("hospital_id") == "H99").sum() == self.N_DRIFTED
+        assert ex.metrics.counters["stream.drift_events"] > 0
+
+        # ---- train on accepted rows == train on clean data
+        feats = list(ht.FEATURE_COLS)
+        dirty_run = snap.na_drop(feats + [ht.LABEL_COL])
+        x = dirty_run.numeric_matrix(feats).astype(np.float32)
+        y = dirty_run.column(ht.LABEL_COL).astype(np.float32)
+        model = LinearRegression().fit((x, y))
+
+        # clean-data run: the SAME fleet with no corruption injected —
+        # all 40 rows per hospital plus the (clean-content) drifted one
+        n99 = self.N_DRIFTED
+        h99 = ht.Table.from_dict(
+            {
+                "hospital_id": np.array(["H99"] * n99, dtype=object),
+                "event_time": np.datetime64("2025-03-31T22:00:00")
+                + np.arange(n99).astype("timedelta64[s]"),
+                "admission_count": np.arange(n99),
+                "current_occupancy": np.full(n99, 150),
+                "emergency_visits": np.full(n99, 6),
+                "seasonality_index": np.full(n99, 1.1),
+                "length_of_stay": np.full(n99, 5.0),
+            },
+            SCHEMA,
+        )
+        clean = ht.Table.concat(
+            [
+                _event_table(self.N_PER_FILE, hospital=f"H{f:02d}")
+                for f in range(self.N_FILES)
+            ]
+            + [h99]
+        )
+        preds_dirty = np.asarray(model.predict(x[:64]))
+        xc = clean.numeric_matrix(feats).astype(np.float32)
+        yc = clean.column(ht.LABEL_COL).astype(np.float32)
+        clean_model = LinearRegression().fit((xc, yc))
+        preds_clean = np.asarray(clean_model.predict(x[:64]))
+        # the runs differ by only the 8 quarantined rows (of 180) ⇒ the
+        # trained models must agree within a small fraction of the label
+        # spread
+        rmse = float(np.sqrt(np.mean((preds_dirty - preds_clean) ** 2)))
+        spread = float(np.std(yc)) or 1.0
+        assert rmse / spread < 0.35
+
+        # ---- serve: profile armed, drifted feed trips health
+        prof = quality.DataProfile.from_matrix(
+            x.astype(np.float64), feats
+        )
+        srv = InferenceServer(
+            ingest_metrics=ex.metrics, breaker_recovery_s=60.0
+        )
+        srv.add_model(
+            "los", model, buckets=(1, 2, 4),
+            fallback=lambda rows: np.full(rows.shape[0], float(y.mean()), np.float32),
+            data_profile=prof.to_dict(), input_policy="impute",
+            drift_window_rows=16, drift_trip_after=2,
+        )
+        with srv:
+            assert srv.predict("los", x[0]).ok
+            h0 = srv.health()
+            assert h0["quarantined_rows"] == n_expected_bad  # ingest visible
+            for i in range(64):
+                srv.predict("los", x[i % 32] * 500.0)
+            h = srv.health()
+            assert h["drift_trips"] >= 1 and h["status"] == "degraded"

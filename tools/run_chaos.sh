@@ -8,7 +8,10 @@
 # Sites covered: stream WAL boundaries (stream.after_*), torn WAL writes
 # at exact byte offsets (wal.append), fit-checkpoint commit protocol
 # (fit_ckpt.*), model artifact save/swap (model_io.save.*), source IO
-# retries (source.read_file), and serving faults (serve.predict).
+# retries (source.read_file), serving faults (serve.predict), and the
+# data-corruption kinds at the ingest text boundary (ingest.csv_text:
+# mangle_field / shuffle_columns / unit_scale / nan_burst — the chaos
+# half of tests/test_quality.py).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -18,7 +21,8 @@ if [[ "${1:-}" != "--slow" ]]; then
 fi
 
 LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
-JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -m "$MARK" \
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
+    -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 
@@ -31,7 +35,9 @@ from collections import defaultdict
 
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
-    m = re.match(r"(PASSED|FAILED|ERROR)\s+tests/test_chaos\.py::(\S+)", line)
+    m = re.match(
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality)\.py::(\S+)", line
+    )
     if not m:
         continue
     ok, test = m.group(1) == "PASSED", m.group(2)
