@@ -146,6 +146,12 @@ def _from_string_columns(cols: Sequence[np.ndarray], schema: Schema) -> Table:
 #: fault site where data-corruption rules rewrite the CSV text in flight
 CSV_TEXT_SITE = "ingest.csv_text"
 
+#: cap on cached header→mapping entries: a fleet has a handful of real
+#: layouts, but corrupted/garbage headers are unique per file — an
+#: unbounded cache would grow for the life of a 24/7 stream.  Beyond the
+#: cap new layouts just reconcile uncached (correctness unchanged).
+MAPPING_CACHE_MAX = 64
+
 
 @dataclass(frozen=True)
 class RowReject:
@@ -178,18 +184,22 @@ def read_csv_salvage(
     schema: Schema,
     header: bool = True,
     aliases: dict[str, str] | None = None,
+    mapping_cache: dict | None = None,
 ) -> SalvageResult:
     """Salvage-mode read: malformed fields reject rows (with reasons),
     drifted headers are reconciled (with events) — the file never fails.
 
     The raw text passes through the ``ingest.csv_text`` fault site first,
-    so chaos plans can mangle/shuffle/rescale it deterministically."""
+    so chaos plans can mangle/shuffle/rescale it deterministically.
+    ``mapping_cache`` (header-tuple → ColumnMapping) lets a long-running
+    caller (the firewall) reconcile each hospital's header layout once
+    and reuse it for every later drop with the same header."""
     with open(path) as fh:
         text = fh.read()
     text = corrupt_data(CSV_TEXT_SITE, text, file=path)
     return salvage_from_text(
         text, schema, header=header, aliases=aliases,
-        context=os.path.basename(path),
+        context=os.path.basename(path), mapping_cache=mapping_cache,
     )
 
 
@@ -242,9 +252,12 @@ def salvage_from_text(
     header: bool = True,
     aliases: dict[str, str] | None = None,
     context: str = "",
+    mapping_cache: dict | None = None,
 ) -> SalvageResult:
     """Parse CSV text in salvage mode (see module docstring)."""
     # lazy: quality.reconcile sits above io in the import graph
+    from dataclasses import replace
+
     from ..quality.reconcile import reconcile_columns
 
     # keep PHYSICAL 1-based line numbers (blank lines skipped but counted)
@@ -257,8 +270,21 @@ def salvage_from_text(
             return SalvageResult(Table.empty(schema))
         source_names = [s.strip() for s in numbered[0][1].split(",")]
         data_lines = numbered[1:]
-        mapping = reconcile_columns(source_names, schema, aliases, context)
-        events = list(mapping.events)
+        cache_key = tuple(source_names)
+        mapping = (
+            mapping_cache.get(cache_key) if mapping_cache is not None else None
+        )
+        if mapping is None:
+            mapping = reconcile_columns(source_names, schema, aliases, context)
+            if mapping_cache is not None and len(mapping_cache) < MAPPING_CACHE_MAX:
+                mapping_cache[cache_key] = mapping
+        # events are per-FILE evidence: rebind the (possibly cached)
+        # mapping's events to this file's context so reuse across drops
+        # from the same hospital never mislabels the evidence
+        events = [
+            e if e.context == context else replace(e, context=context)
+            for e in mapping.events
+        ]
         indices = mapping.indices
     else:
         source_names = schema.names

@@ -28,19 +28,36 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.model_io import register_model
-from ..ops.distance import assign_clusters
 from ..parallel.mesh import default_mesh
-from ..parallel.sharding import DeviceDataset
+from ..parallel.sharding import (
+    DeviceDataset,
+    batch_rows,
+    mesh_of_dataset,
+    microbatch_mesh,
+    place_replicated,
+)
 from .base import Model, as_device_dataset
 from .kmeans import KMeansModel
 
 
 @jax.jit
 def _batch_stats(x, w, centers):
-    assign, mind2 = assign_clusters(x, centers)
+    # The assignment argmin runs over d² MINUS the row-constant ‖x‖² term
+    # (adding a per-row constant never changes a row's argmin).  With the
+    # old full-d² formulation the (n,) square-norm pass sat INSIDE the
+    # argmin operand where XLA cannot prove it row-constant; here it only
+    # appears in ``cost``, so callers that ignore cost (the streaming
+    # update body) get it dead-code-eliminated — one fewer O(n·d) pass on
+    # the per-batch hot path.
+    c2 = jnp.sum(centers * centers, axis=1)
+    score = x @ (-2.0 * centers.T) + c2[None, :]
+    assign = jnp.argmin(score, axis=1)
     onehot = jax.nn.one_hot(assign, centers.shape[0], dtype=x.dtype) * w[:, None]
     sums = onehot.T @ x
     counts = jnp.sum(onehot, axis=0)
+    # true squared distance restores the ‖x‖² term; clamp the fp
+    # cancellation residue so cost can't go (slightly) negative
+    mind2 = jnp.maximum(jnp.min(score, axis=1) + jnp.sum(x * x, axis=1), 0.0)
     cost = jnp.sum(mind2 * w)
     return sums, counts, cost
 
@@ -121,7 +138,11 @@ def _make_update_step(k: int, alpha_mode: str, alpha_param: float, seed: int):
         key = jax.random.fold_in(jax.random.key(seed), steps)
         return body(x, w, centers, w_hi, w_lo, key)
 
-    return jax.jit(step)
+    # donated state: centers/weights update IN PLACE (input-output
+    # aliasing), so steady-state batches allocate no new device buffers —
+    # the estimator reassigns its fields from the outputs immediately, so
+    # the consumed inputs are never read again
+    return jax.jit(step, donate_argnums=(2, 3, 4))
 
 
 @lru_cache(maxsize=32)
@@ -148,7 +169,11 @@ def _make_update_many(k: int, alpha_mode: str, alpha_param: float, seed: int):
         )
         return centers, w_hi, w_lo
 
-    return jax.jit(drain)
+    # donated state (the triple is reassigned from the outputs, so the
+    # consumed buffers are never read again); the xs/ws staging stack is
+    # NOT donated — nothing output-shaped can alias it, and jax warns on
+    # unusable donations
+    return jax.jit(drain, donate_argnums=(2, 3, 4))
 
 
 def _host_rows(batch) -> tuple[np.ndarray, np.ndarray]:
@@ -212,10 +237,18 @@ class StreamingKMeans:
     half_life: float | None = None
     time_unit: str = "batches"  # or "points"
     seed: int = 0
+    #: shard a micro-batch over the mesh only when every device gets at
+    #: least this many rows; smaller batches run on ONE device (see
+    #: ``parallel.sharding.microbatch_mesh`` — for typical micro-batch
+    #: sizes the collectives + multi-device dispatch cost more than the
+    #: parallelism buys, and per-chip throughput is what streaming pays
+    #: for).  None → the CMLHN_STREAM_SHARD_MIN_ROWS env default.
+    shard_min_rows_per_device: int | None = None
     _centers: np.ndarray | None = field(default=None, repr=False)
     _weights: np.ndarray | None = field(default=None, repr=False)
     _weights_lo: np.ndarray | None = field(default=None, repr=False)
     _steps: int = field(default=0, repr=False)
+    _state_mesh: object = field(default=None, repr=False)
 
     def set_initial_centers(self, centers: np.ndarray, weights: np.ndarray | None = None):
         # Stream state lives on device between batches (jnp arrays);
@@ -228,6 +261,7 @@ class StreamingKMeans:
             else jnp.zeros((self._centers.shape[0],), jnp.float32)
         )
         self._weights_lo = jnp.zeros_like(self._weights)
+        self._state_mesh = None  # fresh (uncommitted) state: re-place lazily
         return self
 
     def set_random_centers(self, dim: int, weight: float = 0.0):
@@ -261,8 +295,13 @@ class StreamingKMeans:
            for ``cluster_centers``/``cluster_weights`` (the estimator
            itself has no such attributes)."""
         mesh = mesh or default_mesh()
+        if not isinstance(batch, DeviceDataset):
+            mesh = microbatch_mesh(
+                batch_rows(batch), mesh, self.shard_min_rows_per_device
+            )
         ds = as_device_dataset(batch, mesh=mesh)
         self._ensure_centers(ds)
+        self._place_state(ds)
         mode, param = self._alpha()
         step = _make_update_step(self.k, mode, param, self.seed)
         self._centers, self._weights, self._weights_lo = step(
@@ -291,6 +330,10 @@ class StreamingKMeans:
         from ..parallel.sharding import pad_rows
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        mesh = microbatch_mesh(
+            max(b.shape[0] for b, _ in batches), mesh,
+            self.shard_min_rows_per_device,
+        )
         if self._centers is None:
             fx, fw = batches[0]
             # 3-tuple keeps the first batch's sample weights in play
@@ -301,13 +344,19 @@ class StreamingKMeans:
         n_pad = pad_rows(max(b.shape[0] for b, _ in batches), mesh.shape[DATA_AXIS])
         d = batches[0][0].shape[1]
         B = len(batches)
-        xs = np.zeros((B, n_pad, d), dtype=np.float32)
+        # np.empty + explicit pad-tail zeroing: the stack is rebuilt every
+        # drain and for mostly-equal-length batches the tail is tiny, so
+        # this skips zeroing the whole (B, n_pad, d) block
+        xs = np.empty((B, n_pad, d), dtype=np.float32)
         ws = np.zeros((B, n_pad), dtype=np.float32)
         for i, (b, bw) in enumerate(batches):
-            xs[i, : b.shape[0]] = b
-            ws[i, : b.shape[0]] = bw
+            m = b.shape[0]
+            xs[i, :m] = b
+            xs[i, m:] = 0.0
+            ws[i, :m] = bw
         xs = jax.device_put(xs, NamedSharding(mesh, P(None, DATA_AXIS, None)))
         ws = jax.device_put(ws, NamedSharding(mesh, P(None, DATA_AXIS)))
+        self._place_state_mesh(mesh)
         mode, param = self._alpha()
         drain = _make_update_many(self.k, mode, param, self.seed)
         self._centers, self._weights, self._weights_lo = drain(
@@ -316,6 +365,25 @@ class StreamingKMeans:
         )
         self._steps += B
         return self
+
+    def _place_state(self, ds: DeviceDataset) -> None:
+        """Commit the stream state to the mesh the batch actually lives
+        on (derived from the batch's own sharding, so caller-built
+        DeviceDatasets are honored).  Adaptive placement switches between
+        the full mesh and a single device as batch sizes change; the
+        state triple is tiny (k×d + 2k floats), so re-placing it is one
+        cheap transfer and jit never sees mixed-committed inputs."""
+        mesh = mesh_of_dataset(ds)
+        if mesh is not None:
+            self._place_state_mesh(mesh)
+
+    def _place_state_mesh(self, mesh) -> None:
+        if self._centers is None or self._state_mesh == mesh:
+            return
+        self._centers, self._weights, self._weights_lo = place_replicated(
+            mesh, (self._centers, self._weights, self._weights_lo)
+        )
+        self._state_mesh = mesh
 
     def _ensure_centers(self, ds: DeviceDataset) -> None:
         if self._centers is not None:

@@ -29,12 +29,20 @@ Both plug into the micro-batch driver (``streaming/microbatch.py``) as
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.mesh import default_mesh
+from ..parallel.sharding import (
+    DeviceDataset,
+    batch_rows,
+    mesh_of_dataset,
+    microbatch_mesh,
+    place_replicated,
+)
 from .base import as_device_dataset
 from .linear_regression import LinearRegressionModel
 from .logistic_regression import LogisticRegressionModel
@@ -49,6 +57,22 @@ def _lin_batch_stats(x, y, w):
     xa = jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
     xw = xa * w[:, None]
     return xw.T @ xa, xw.T @ y, jnp.sum(w)
+
+
+@lru_cache(maxsize=16)
+def _make_lin_update(decay: float):
+    """One jitted, state-donating dispatch per micro-batch: batch stats +
+    decayed accumulate fused, so the per-batch work is a single device
+    call with the (d+1)² running statistics updated in place (same math
+    as ``a*gram + g`` eagerly: elementwise, no reduction reorder — the
+    decay-1.0 ≡ batch-WLS bit-tightness is preserved)."""
+
+    def step(x, y, w, gram, mom, wsum):
+        g, m, ws = _lin_batch_stats(x, y, w)
+        a = jnp.float32(decay)
+        return a * gram + g, a * mom + m, a * wsum + ws
+
+    return jax.jit(step, donate_argnums=(3, 4, 5))
 
 
 @jax.jit
@@ -79,8 +103,9 @@ class StreamingLinearRegression:
 
     _gram: object = field(default=None, repr=False)
     _mom: object = field(default=None, repr=False)
-    _wsum: float = field(default=0.0, repr=False)
+    _wsum: object = field(default=0.0, repr=False)
     _n_batches: int = field(default=0, repr=False)
+    _state_mesh: object = field(default=None, repr=False)
 
     def __post_init__(self):
         if not 0.0 <= self.decay_factor <= 1.0:
@@ -94,26 +119,46 @@ class StreamingLinearRegression:
 
     def update(self, batch, mesh=None) -> "StreamingLinearRegression":
         mesh = mesh or default_mesh()
+        if not isinstance(batch, DeviceDataset):
+            mesh = microbatch_mesh(batch_rows(batch), mesh)
         ds = as_device_dataset(batch, self.label_col, mesh=mesh)
-        g, m, w = _lin_batch_stats(ds.x, ds.y, ds.w)
-        a = jnp.float32(self.decay_factor)
         if self._gram is None:
-            self._gram, self._mom = g, m
-        else:
-            self._gram = a * self._gram + g
-            self._mom = a * self._mom + m
-        self._wsum = float(self.decay_factor * self._wsum + float(w))
+            d = ds.n_features + 1
+            zero = jnp.zeros((d, d), jnp.float32)
+            # zero-initialized state makes the first batch exact
+            # (a·0 + g ≡ g bitwise), so one fused step covers every batch
+            self._gram, self._mom, self._wsum = (
+                zero, jnp.zeros((d,), jnp.float32), jnp.float32(0.0)
+            )
+        self._place_state(ds)
+        step = _make_lin_update(float(self.decay_factor))
+        self._gram, self._mom, self._wsum = step(
+            ds.x, ds.y, ds.w, self._gram, self._mom, self._wsum
+        )
         self._n_batches += 1
         return self
+
+    def _place_state(self, ds) -> None:
+        mesh = mesh_of_dataset(ds)
+        if mesh is None or self._state_mesh == mesh:
+            return
+        self._gram, self._mom, self._wsum = place_replicated(
+            mesh, (self._gram, self._mom, self._wsum)
+        )
+        self._state_mesh = mesh
 
     @property
     def latest_model(self) -> LinearRegressionModel:
         if self._gram is None:
             raise RuntimeError("no batches seen yet — call update() first")
         d = self._gram.shape[0]
-        ridge = self.reg_param * max(self._wsum, 1.0)
+        ridge = self.reg_param * max(float(jax.device_get(self._wsum)), 1.0)
         reg = jnp.zeros((d,), jnp.float32).at[:-1].set(ridge) + 1e-6
-        theta = jnp.linalg.solve(self._gram + jnp.diag(reg), self._mom)
+        # host arrays: the snapshot model must be usable on ANY mesh, not
+        # pinned to whichever device the stream state happens to live on
+        theta = np.asarray(
+            jax.device_get(jnp.linalg.solve(self._gram + jnp.diag(reg), self._mom))
+        )
         return LinearRegressionModel(coefficients=theta[:-1], intercept=theta[-1])
 
 
@@ -136,6 +181,7 @@ class StreamingLogisticRegression:
     _hess_hist: object = field(default=None, repr=False)
     _wsum: float = field(default=0.0, repr=False)
     _n_batches: int = field(default=0, repr=False)
+    _state_mesh: object = field(default=None, repr=False)
 
     def __post_init__(self):
         if not 0.0 <= self.decay_factor <= 1.0:
@@ -151,10 +197,13 @@ class StreamingLogisticRegression:
 
     def update(self, batch, mesh=None) -> "StreamingLogisticRegression":
         mesh = mesh or default_mesh()
+        if not isinstance(batch, DeviceDataset):
+            mesh = microbatch_mesh(batch_rows(batch), mesh)
         ds = as_device_dataset(batch, self.label_col, mesh=mesh)
         d = ds.n_features + 1
         if self._theta is None:
             self._theta = jnp.zeros((d,), jnp.float32)
+        self._place_state(ds)
         a = jnp.float32(self.decay_factor)
         w_batch = float(jax.device_get(jnp.sum(ds.w)))
         for _ in range(self.newton_steps_per_batch):
@@ -190,13 +239,26 @@ class StreamingLogisticRegression:
         self._n_batches += 1
         return self
 
+    def _place_state(self, ds) -> None:
+        """Keep θ and the decayed Newton statistics committed to the
+        batch's mesh, so adaptive single-device/mesh placement switches
+        never mix incompatibly-committed jit inputs."""
+        mesh = mesh_of_dataset(ds)
+        if mesh is None or self._state_mesh == mesh:
+            return
+        self._theta, self._grad_hist, self._hess_hist = place_replicated(
+            mesh, (self._theta, self._grad_hist, self._hess_hist)
+        )
+        self._state_mesh = mesh
+
     @property
     def latest_model(self) -> LogisticRegressionModel:
         if self._theta is None:
             raise RuntimeError("no batches seen yet — call update() first")
+        theta = np.asarray(jax.device_get(self._theta))  # any-mesh snapshot
         return LogisticRegressionModel(
-            coefficients=self._theta[:-1],
-            intercept=self._theta[-1],
+            coefficients=theta[:-1],
+            intercept=theta[-1],
             threshold=self.threshold,
             n_iter=self._n_batches,
         )

@@ -11,6 +11,7 @@ weights so padding never biases a reduction.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import lru_cache
 
@@ -19,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS, default_mesh
+from .mesh import DATA_AXIS, MODEL_AXIS, default_mesh, single_device_mesh
 
 
 @lru_cache(maxsize=32)
@@ -36,6 +37,87 @@ def _pad_fill_fns(mesh: Mesh, n_pad: int, dtype_name: str):
     return jax.jit(
         lambda n: (jnp.arange(n_pad) < n).astype(dtype), out_shardings=sharding
     )
+
+
+#: below this many rows PER DEVICE, a streaming micro-batch runs on ONE
+#: device instead of the full mesh.  Sharding a small batch is a
+#: pessimization twice over: the per-step all-reduce and multi-device
+#: dispatch cost more than the parallelism buys, AND the batch occupies
+#: every chip's dispatch queue to do work one chip finishes in the same
+#: wall time — an 8-chip mesh spends 8 chip-seconds per wall-second on a
+#: job sized for one (measured on the CPU proxy: the 8-way-sharded 40k-row
+#: drain ran no faster than single-device).  Override with the
+#: ``CMLHN_STREAM_SHARD_MIN_ROWS`` env var or per-estimator.
+DEFAULT_SHARD_MIN_ROWS_PER_DEVICE = 65536
+
+
+def microbatch_mesh(
+    n_rows: int, mesh: Mesh | None = None, min_rows_per_device: int | None = None
+) -> Mesh:
+    """The mesh a streaming micro-batch update should actually run on:
+    the given mesh when every device gets ≥ ``min_rows_per_device`` rows,
+    else a single-device mesh over the mesh's first device (freeing the
+    rest for concurrent per-hospital streams)."""
+    mesh = mesh or default_mesh()
+    if min_rows_per_device is None:
+        min_rows_per_device = int(
+            os.environ.get(
+                "CMLHN_STREAM_SHARD_MIN_ROWS", DEFAULT_SHARD_MIN_ROWS_PER_DEVICE
+            )
+        )
+    if mesh.size > 1 and n_rows < min_rows_per_device * mesh.shape[DATA_AXIS]:
+        return single_device_mesh(mesh.devices.flat[0])
+    return mesh
+
+
+def batch_rows(batch) -> int:
+    """Row count of any streaming batch form — bare/jax array, (x, y[, w])
+    tuple, Table, AssembledTable, DeviceDataset — WITHOUT materializing
+    device arrays on host (``np.asarray`` on a jax array would transfer
+    it)."""
+    if isinstance(batch, tuple):
+        batch = batch[0]
+    shape = getattr(batch, "shape", None)
+    if shape is not None:
+        return int(shape[0]) if len(shape) else 1
+    n = getattr(batch, "num_rows", None)  # Table
+    if n is not None:
+        return int(n)
+    x = getattr(batch, "x", None)  # DeviceDataset (padded count)
+    if x is not None:
+        return int(x.shape[0])
+    feats = getattr(batch, "features", None)  # AssembledTable
+    if feats is not None:
+        return int(feats.shape[0])
+    return int(np.asarray(batch).shape[0])
+
+
+def mesh_of_dataset(ds: "DeviceDataset") -> Mesh | None:
+    """The mesh a DeviceDataset is committed to — from its NamedSharding,
+    or a single-device mesh for single-device shardings; None when the
+    placement cannot be determined.  Streaming estimators use this to
+    keep their (tiny) state committed alongside the batch, so adaptive
+    single-device/mesh placement switches never hand jit
+    incompatibly-committed inputs."""
+    sh = ds.x.sharding
+    mesh = getattr(sh, "mesh", None)
+    if mesh is not None:
+        return mesh
+    if len(sh.device_set) == 1:
+        return single_device_mesh(next(iter(sh.device_set)))
+    return None
+
+
+def place_replicated(mesh: Mesh, state: tuple) -> tuple:
+    """Commit a (small) state tuple replicated onto ``mesh`` in one
+    transfer, preserving ``None`` slots — the shared placement step the
+    streaming estimators use when adaptive single-device/mesh switches
+    move their state between commitments."""
+    live = tuple(s for s in state if s is not None)
+    if not live:
+        return state
+    placed = iter(jax.device_put(live, NamedSharding(mesh, P())))
+    return tuple(next(placed) if s is not None else None for s in state)
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:

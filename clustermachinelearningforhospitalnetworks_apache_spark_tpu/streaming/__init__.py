@@ -3,6 +3,7 @@ from .watermark import WatermarkTracker
 from .unbounded_table import UnboundedTable
 from .checkpoint import StreamCheckpoint
 from .microbatch import BATCH_OK, BATCH_QUARANTINED, BatchInfo, StreamExecution
+from .pipeline import ModelUpdateConsumer, PipelinedStreamExecution, Prefetched
 
 __all__ = [
     "BATCH_OK",
@@ -13,4 +14,7 @@ __all__ = [
     "StreamCheckpoint",
     "BatchInfo",
     "StreamExecution",
+    "PipelinedStreamExecution",
+    "ModelUpdateConsumer",
+    "Prefetched",
 ]

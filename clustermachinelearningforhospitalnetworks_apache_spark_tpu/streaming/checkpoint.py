@@ -61,9 +61,15 @@ class StreamCheckpoint:
         self._commits = os.path.join(self.path, "commits.log")
         self._attempts = os.path.join(self.path, "attempts.log")
         self._attempt_counts: dict[int, int] = {}
+        # attempts live in attempts.log (replays) AND in offsets entries
+        # carrying the piggybacked first attempt (begin_batch)
         for e in _read_lines(self._attempts):
             bid = int(e["batch_id"])
             self._attempt_counts[bid] = self._attempt_counts.get(bid, 0) + 1
+        for e in _read_lines(self._offsets):
+            if e.get("attempt"):
+                bid = int(e["batch_id"])
+                self._attempt_counts[bid] = self._attempt_counts.get(bid, 0) + 1
 
     # write-ahead intent -----------------------------------------------
     def write_offsets(self, batch_id: int, files: list[str], watermark_state: dict) -> None:
@@ -71,6 +77,26 @@ class StreamCheckpoint:
             self._offsets,
             {"batch_id": batch_id, "files": files, "watermark": watermark_state},
         )
+
+    def begin_batch(
+        self, batch_id: int, files: list[str], watermark_state: dict
+    ) -> int:
+        """Offsets intent + the batch's FIRST attempt as ONE durable
+        append (one fsync instead of two on the per-batch hot path —
+        every fresh batch needs both records before any side effect, so
+        they always travel together).  → attempts so far (1)."""
+        _append_line(
+            self._offsets,
+            {
+                "batch_id": batch_id,
+                "files": files,
+                "watermark": watermark_state,
+                "attempt": True,
+            },
+        )
+        n = self._attempt_counts.get(batch_id, 0) + 1
+        self._attempt_counts[batch_id] = n
+        return n
 
     def write_commit(self, batch_id: int, quarantined: bool = False) -> None:
         entry: dict = {"batch_id": batch_id}

@@ -144,34 +144,80 @@ class StreamExecution:
             batch_id = entry["batch_id"]
             files = entry["files"]
             wm_state = entry.get("watermark") or {}
-        else:
-            files = self.source.poll()
-            if not files:
-                return None
-            batch_id = self._next_batch_id
-            wm_state = self.watermark.state() if self.watermark else {}
-            self.checkpoint.write_offsets(batch_id, files, wm_state)
+            if self.checkpoint.attempts(batch_id) >= self.max_batch_replays:
+                # a batch whose every replay KILLED the process arrives
+                # here with its attempt budget already spent — quarantine
+                # without giving it another shot at the process's life
+                info = self._quarantine(
+                    batch_id, files, self.checkpoint.attempts(batch_id),
+                    RuntimeError("batch crashed the process on every replay"),
+                )
+                return self._finish_batch(batch_id, info)
+            info = self._run_batch(batch_id, files, wm_state)
+            return self._finish_batch(batch_id, info)
 
+        files = self.source.poll()
+        if not files:
+            return None
+        batch_id = self._next_batch_id
         if self.checkpoint.attempts(batch_id) >= self.max_batch_replays:
-            # a batch whose every replay KILLED the process arrives here
-            # with its attempt budget already spent — quarantine without
-            # giving it another shot at the process's life
-            info = self._quarantine(
-                batch_id, files, self.checkpoint.attempts(batch_id),
-                RuntimeError("batch crashed the process on every replay"),
+            return self._finish_batch(
+                batch_id, self._quarantine_fresh(batch_id, files)
             )
-            self._pending = None
-            self._next_batch_id = batch_id + 1
-            self.history.append(info)
-            return info
+        wm_state = self.watermark.state() if self.watermark else {}
+        # intent + first attempt land as ONE fsync'd append
+        self.checkpoint.begin_batch(batch_id, files, wm_state)
+        info = self._run_batch(
+            batch_id, files, wm_state, first_attempt_recorded=True
+        )
+        return self._finish_batch(batch_id, info)
 
+    def _quarantine_fresh(self, batch_id: int, files: list[str]) -> BatchInfo:
+        """Budget already spent on the FRESH path (an in-session crash
+        loop re-polls the same uncommitted files under the same batch id,
+        each pass durably recording an attempt) — quarantine instead of
+        granting unlimited retries.  The offsets intent is written FIRST:
+        the final poll may have picked up files the spent attempts never
+        saw, and the WAL, the quarantine evidence, and restart recovery
+        must agree on exactly which files this batch consumed."""
+        wm_state = self.watermark.state() if self.watermark else {}
+        self.checkpoint.write_offsets(batch_id, files, wm_state)
+        return self._quarantine(
+            batch_id, files, self.checkpoint.attempts(batch_id),
+            RuntimeError("batch crashed the process on every replay"),
+        )
+
+    def _finish_batch(self, batch_id: int, info: BatchInfo) -> BatchInfo:
+        self._pending = None
+        self._next_batch_id = batch_id + 1
+        self.history.append(info)
+        return info
+
+    def _run_batch(
+        self,
+        batch_id: int,
+        files: list[str],
+        wm_state: dict,
+        prefetched=None,
+        first_attempt_recorded: bool = False,
+    ) -> BatchInfo:
+        """The replay/quarantine ladder around :meth:`_attempt`.
+
+        ``prefetched`` (a pipeline hand-off with the batch already parsed
+        and firewalled) is consumed by the FIRST attempt only — replays
+        always re-read from the source, so a corrupted prefetch can never
+        wedge the ladder."""
         while True:
-            attempts = self.checkpoint.record_attempt(batch_id)
+            if first_attempt_recorded:
+                attempts = self.checkpoint.attempts(batch_id)
+                first_attempt_recorded = False
+            else:
+                attempts = self.checkpoint.record_attempt(batch_id)
             try:
-                info = self._attempt(batch_id, files, wm_state)
-                break
+                return self._attempt(batch_id, files, wm_state, prefetched)
             except Exception as e:  # noqa: BLE001 — InjectedCrash is a
                 # BaseException and rightly flies past this handler
+                prefetched = None
                 self.metrics.inc("stream.batch_failures")
                 log.warning(
                     "batch attempt failed",
@@ -179,24 +225,31 @@ class StreamExecution:
                     max_attempts=self.max_batch_replays, error=repr(e),
                 )
                 if attempts >= self.max_batch_replays:
-                    info = self._quarantine(batch_id, files, attempts, e)
-                    break
+                    return self._quarantine(batch_id, files, attempts, e)
                 time.sleep(self.replay_backoff.delay_for(attempts, self._rng))
 
-        self._pending = None
-        self._next_batch_id = batch_id + 1
-        self.history.append(info)
-        return info
+    def _attempt(
+        self, batch_id: int, files: list[str], wm_state: dict, prefetched=None
+    ) -> BatchInfo:
+        """One try at the batch lifecycle, fault sites at every boundary.
 
-    def _attempt(self, batch_id: int, files: list[str], wm_state: dict) -> BatchInfo:
-        """One try at the batch lifecycle, fault sites at every boundary."""
+        With ``prefetched``, the parse + firewall work already happened on
+        the pipeline's worker thread; the fault sites still fire in the
+        serial order so every chaos kill-point keeps its meaning (a crash
+        "after read" is a crash after the read RESULT is adopted)."""
         fault_point("stream.after_offsets", batch_id=batch_id)
         # replay with the watermark state recorded at intent time (a replay
         # must see the state the original attempt saw, not one advanced by
         # a failed half-run)
         if self.watermark is not None and wm_state:
             self.watermark.restore(wm_state)
-        if self.firewall is not None:
+        if prefetched is not None:
+            if prefetched.error is not None:
+                raise prefetched.error
+            table = prefetched.table
+            row_rejects = prefetched.rejects
+            drift_events = prefetched.drift_events
+        elif self.firewall is not None:
             table, row_rejects, drift_events = self.source.read_files_audited(
                 files
             )
@@ -232,13 +285,17 @@ class StreamExecution:
                 batch_id=batch_id, rejected=len(row_rejects),
                 drift_events=len(drift_events),
             )
-        if self.firewall is not None and self.firewall.monitor is not None:
+        if prefetched is not None and prefetched.drift_psi is not None:
+            # the worker snapshotted PSI right after THIS batch's parse —
+            # reading the monitor now could see a later prefetch's windows
+            self.metrics.set("stream.drift_psi", prefetched.drift_psi)
+        elif self.firewall is not None and self.firewall.monitor is not None:
             self.metrics.set(
                 "stream.drift_psi", self.firewall.monitor.max_psi
             )
 
         if self.foreach_batch is not None:
-            self.foreach_batch(table, batch_id)
+            self._call_foreach(table, batch_id, prefetched)
         fault_point("stream.after_foreach", batch_id=batch_id)
 
         self.sink.append_batch(table, batch_id)
@@ -265,6 +322,12 @@ class StreamExecution:
             rejected=info.num_rejected_rows,
         )
         return info
+
+    def _call_foreach(self, table: Table, batch_id: int, prefetched) -> None:
+        """Hand the batch to the consumer; the pipelined subclass overrides
+        this to pass pre-staged (host-extracted / device-transferred) data
+        instead of the raw table."""
+        self.foreach_batch(table, batch_id)
 
     def _quarantine(
         self, batch_id: int, files: list[str], attempts: int, err: Exception

@@ -1,0 +1,508 @@
+"""Pipelined streaming execution: overlap host ingest with device update.
+
+The serial :class:`~.microbatch.StreamExecution` spends each batch's wall
+time in a strict chain — list files → parse CSV → firewall row-validation
+→ table build → WAL → transfer → jitted update — with the device idle
+through every host stage and the host idle while it waits on the device.
+This module runs the same lifecycle as a TWO-STAGE PIPELINE:
+
+* a single **prefetch worker** thread discovers new files and runs the
+  side-effect-free host stages for batch *N+1* — native/salvage CSV scan,
+  firewall validation (header reconciliation amortized through the
+  firewall's mapping cache), and optionally a caller-supplied ``stage``
+  hook (feature extraction + host→device transfer, giving double-buffered
+  transfers: batch N+1's buffer fills while batch N's is consumed);
+* the **commit thread** (whoever calls :meth:`run_once`) keeps the entire
+  durability protocol in the serial order — offsets+attempt intent (one
+  fsync'd append via ``StreamCheckpoint.begin_batch``), row quarantine,
+  foreach (the jitted model update dispatches asynchronously; with
+  donated state there is no steady-state allocation and nothing blocks
+  until the NEXT batch needs the result), sink append, commit.
+
+Backpressure is the bounded hand-off queue (``pipeline_depth``): the
+worker blocks once it is that many batches ahead, so memory stays
+bounded no matter how fast files arrive.
+
+Crash semantics are IDENTICAL to the serial driver, by construction:
+
+* nothing the worker does has durable side effects — a crash before the
+  commit thread writes the batch's offsets intent simply re-discovers
+  the files on restart;
+* every fault site (``stream.after_offsets`` … ``after_commit``) fires
+  on the commit thread in the serial order, so each chaos kill-point
+  keeps its exact serial meaning;
+* a worker-side failure (including an :class:`InjectedCrash` emulating
+  process death mid-parse) is delivered to the commit thread and
+  re-raised INSIDE the batch's attempt — after intent is recorded —
+  which is byte-for-byte the serial "crash between offsets and read"
+  story: the durable attempt count still advances and a restart replays
+  (or, past the budget, quarantines) the batch;
+* replays never trust a prefetch: the attempt ladder re-reads from the
+  source serially.
+
+Parity gate: with the same input files, the pipelined driver produces
+the same batches, the same sink rows, the same quarantine evidence, and
+the same WAL entries as the serial driver (``tests/test_stream_pipeline
+.py`` asserts all four, plus kill-and-resume idempotence).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from queue import Empty, Full, Queue
+from typing import Any, Callable
+
+from ..core.table import Table
+from ..parallel.sharding import batch_rows
+from ..utils.logging import get_logger
+from ..utils.profiling import StageClock
+from .microbatch import BatchInfo, StreamExecution
+
+log = get_logger("streaming")
+
+
+@dataclass
+class Prefetched:
+    """One batch's host work, done ahead of time by the worker."""
+
+    files: list[str]
+    table: Table | None = None
+    rejects: list = field(default_factory=list)
+    drift_events: list = field(default_factory=list)
+    #: drift monitor PSI snapshotted right after THIS batch's parse (the
+    #: live monitor may already reflect later prefetches)
+    drift_psi: float | None = None
+    #: output of the caller's ``stage`` hook (features extracted and/or
+    #: already transferred to device) — handed to ``foreach_batch``
+    staged: Any = None
+    #: a worker-side failure, re-raised inside the batch's first attempt
+    error: BaseException | None = None
+
+
+class _Prefetcher(threading.Thread):
+    """The single worker: polls, parses, firewalls, stages — in claim
+    order, one batch at a time, so the firewall's stateful pieces (drift
+    windows, reconciliation cache) see files in exactly the serial order."""
+
+    def __init__(
+        self, exec_: "PipelinedStreamExecution", depth: int, poll_interval_s: float
+    ) -> None:
+        super().__init__(daemon=True, name="stream-prefetch")
+        self._exec = exec_
+        self.queue: Queue = Queue(maxsize=max(1, depth))
+        #: files handed into the pipeline but not yet committed (the
+        #: source's ``_seen`` only advances at commit time)
+        self.claimed: set[str] = set()
+        self._seen_cache: tuple[frozenset, int] = (frozenset(), -1)
+        self.poll_interval_s = poll_interval_s
+        self._halt = threading.Event()  # NOT _stop: Thread.join() calls an internal _stop()
+        self._wake = threading.Event()
+        self._cond = threading.Condition()
+        #: listing-cycle sequence: bumped when a directory listing STARTS,
+        #: with the seq of the last listing that came up empty — poll_now
+        #: must wait for an empty listing that BEGAN after the call (one
+        #: already in flight may predate a just-dropped file)
+        self._poll_seq = 0
+        self._last_empty_seq = -1
+        self._inflight = False
+        #: serializes INGEST (discovery + parse + firewall): replays
+        #: re-read through the SAME source/firewall objects on the commit
+        #: thread, and their counters/drift windows/mapping cache are
+        #: plain mutable state — the worker holds this for each
+        #: discover+parse cycle (never across the queue hand-off), the
+        #: replay path holds it for the serial re-read
+        self.ingest_lock = threading.Lock()
+
+    # ------------------------------------------------------------ control
+    def stop(self) -> None:
+        self._halt.set()
+        self._wake.set()
+
+    def busy(self) -> bool:
+        with self._cond:
+            # a dead worker (loop-level failure or interpreter teardown)
+            # can never produce again — reporting it busy would make the
+            # consumer's wait loops spin forever
+            return (self._inflight and self.is_alive()) or not self.queue.empty()
+
+    def poll_now(self, timeout_s: float = 10.0) -> None:
+        """Force an immediate poll and wait until either data is queued
+        or a listing that STARTED after this call came up empty — so the
+        caller's "no new data" answer is as authoritative as a serial
+        ``source.poll()`` (an in-flight listing may predate a file the
+        caller just dropped, and must not count)."""
+        with self._cond:
+            seq0 = self._poll_seq
+            self._wake.set()
+            deadline = time.monotonic() + timeout_s
+            while (
+                self._last_empty_seq <= seq0
+                and self.queue.empty()
+                and not self._halt.is_set()
+                and time.monotonic() < deadline
+            ):
+                self._cond.wait(0.02)
+
+    # ------------------------------------------------------------ worker
+    def _new_files(self) -> list[str]:
+        src = self._exec.source
+        # copying the (ever-growing) committed-file set every 50 ms idle
+        # poll would be O(total files) per cycle forever — the generation
+        # counter makes the copy happen only when a commit changed it
+        gen = src.seen_generation()
+        if self._seen_cache[1] != gen:
+            self._seen_cache = (src.seen_snapshot(), gen)
+        seen = self._seen_cache[0]
+        # committed files live in the source's seen-set — drop them from
+        # the claim index so it tracks only the (bounded) in-pipeline
+        # window instead of growing for the life of a 24/7 stream
+        self.claimed.difference_update(seen)
+        new = [
+            f
+            for f in src.list_files()
+            if f not in seen and f not in self.claimed
+        ]
+        if src.max_files_per_batch > 0:
+            new = new[: src.max_files_per_batch]
+        return new
+
+    def run(self) -> None:  # pragma: no branch - loop structure
+        while not self._halt.is_set():
+            # bounded acquire so stop() is never ignored: a replay on the
+            # commit thread may hold the ingest lock for a while
+            if not self.ingest_lock.acquire(timeout=0.1):
+                continue
+            pre = None
+            try:
+                with self._cond:
+                    self._inflight = True
+                    self._poll_seq += 1
+                    seq = self._poll_seq
+                files = self._new_files()
+                if files:
+                    self.claimed.update(files)
+                    pre = self._produce(files)
+            except BaseException as e:  # noqa: BLE001 — discovery failed
+                # (e.g. a file deleted between listing and stat).  The
+                # serial driver would surface this from poll(); deliver
+                # it so run_once re-raises instead of hanging on a dead
+                # worker (files unknown → no batch intent is written).
+                pre = Prefetched(files=[], error=e)
+            finally:
+                self.ingest_lock.release()
+            if pre is None:  # empty poll
+                with self._cond:
+                    self._inflight = False
+                    self._last_empty_seq = seq
+                    self._cond.notify_all()
+                self._wake.wait(self.poll_interval_s)
+                self._wake.clear()
+                continue
+            while not self._halt.is_set():
+                try:
+                    self.queue.put(pre, timeout=0.1)
+                    break
+                except Full:  # bounded queue: backpressure on the worker
+                    continue
+            with self._cond:
+                self._inflight = False
+                self._cond.notify_all()
+
+    def _produce(self, files: list[str]) -> Prefetched:
+        ex = self._exec
+        try:
+            with ex.clock.stage("ingest"):
+                if ex.firewall is not None:
+                    table, rejects, events = ex.source.read_files_audited(files)
+                else:
+                    table = ex.source.read_files(files)
+                    rejects, events = [], []
+            psi = (
+                ex.firewall.monitor.max_psi
+                if ex.firewall is not None and ex.firewall.monitor is not None
+                else None
+            )
+            staged = None
+            if ex.stage is not None:
+                with ex.clock.stage("stage"):
+                    staged = ex.stage(table)
+            return Prefetched(
+                files=files,
+                table=table,
+                rejects=rejects,
+                drift_events=events,
+                drift_psi=psi,
+                staged=staged,
+            )
+        except BaseException as e:  # noqa: BLE001 — InjectedCrash included:
+            # the commit thread re-raises it inside the batch's attempt,
+            # where the serial driver would have hit it
+            log.warning(
+                "prefetch failed; delivering error to the commit thread",
+                files=len(files), error=repr(e),
+            )
+            return Prefetched(files=files, error=e)
+
+
+@dataclass
+class PipelinedStreamExecution(StreamExecution):
+    """Drop-in :class:`StreamExecution` with prefetch-pipelined ingest.
+
+    Extra knobs:
+
+    * ``pipeline_depth`` — bounded prefetch queue (backpressure bound);
+    * ``worker_poll_interval_s`` — idle re-list cadence of the worker;
+    * ``stage`` — optional host-side hook run on the WORKER thread per
+      batch (feature extraction, host→device transfer).  When set,
+      ``foreach_batch`` receives the staged value instead of the raw
+      Table (the raw table still goes to the sink).  The hook's input is
+      the batch's ACCEPTED SOURCE rows — no driver-added ``ingest_time``
+      column (re-stages drop it for parity with the worker's view).  When the consumer
+      coalesces backlogs through ``update_many`` (which stacks on HOST),
+      stage should return host arrays — device-put payloads would be
+      pulled straight back;
+    * ``clock`` — per-stage wall-time accumulator (``ingest`` / ``stage``
+      on the worker, ``update`` on the commit thread), the observable
+      evidence of the overlap: summed stage seconds exceeding wall time
+      is host work hidden behind the update.
+
+    Call :meth:`close` (or use as a context manager) when done.
+    """
+
+    pipeline_depth: int = 2
+    worker_poll_interval_s: float = 0.05
+    stage: Callable[[Table], Any] | None = None
+    clock: StageClock = field(default_factory=StageClock)
+    _prefetcher: _Prefetcher | None = field(default=None, repr=False)
+
+    # ------------------------------------------------------------ lifecycle
+    def _ensure_prefetcher(self) -> _Prefetcher:
+        # only reached with no pending batch (run_once routes pending
+        # recovery through the serial path first, and its commit marks
+        # the files seen before the worker could ever re-claim them)
+        if self._prefetcher is None:
+            self._prefetcher = _Prefetcher(
+                self, self.pipeline_depth, self.worker_poll_interval_s
+            )
+            self._prefetcher.start()
+        return self._prefetcher
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.stop()
+            self._prefetcher.join(timeout=5.0)
+            # forget the halted worker: a later run_once() spawns a fresh
+            # one, so a transient error (surfaced and raised once, like a
+            # serial poll() failure) doesn't leave the driver permanently
+            # answering "no new data" through a dead prefetcher
+            self._prefetcher = None
+
+    def __enter__(self) -> "PipelinedStreamExecution":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def ready_depth(self) -> int:
+        """Prefetched batches already waiting — consumers use this to
+        drain bursts through ``update_many`` instead of per-batch calls."""
+        return (
+            self._prefetcher.queue.qsize() if self._prefetcher is not None else 0
+        )
+
+    # ------------------------------------------------------------ core
+    def run_once(self) -> BatchInfo | None:
+        if self._pending is not None:
+            # crash recovery: replay the uncommitted batch through the
+            # serial path (a replay must re-read, never trust a prefetch)
+            return super().run_once()
+        pf = self._ensure_prefetcher()
+        try:
+            pre = pf.queue.get_nowait()
+        except Empty:
+            pf.poll_now()
+            while True:  # mid-parse on a large batch: wait it out
+                try:
+                    pre = pf.queue.get(timeout=0.05)
+                    break
+                except Empty:
+                    if not pf.busy():
+                        return None
+
+        if not pre.files:
+            # file DISCOVERY failed on the worker (no batch exists yet,
+            # so no intent to record) — surface it like a serial poll()
+            # failure and stop the pipeline
+            self.close()
+            raise pre.error
+
+        batch_id = self._next_batch_id
+        if self.checkpoint.attempts(batch_id) >= self.max_batch_replays:
+            # the serial driver's fresh-path budget guard, shared
+            return self._finish_batch(
+                batch_id, self._quarantine_fresh(batch_id, pre.files)
+            )
+        wm_state = self.watermark.state() if self.watermark else {}
+        try:
+            # intent + first attempt: ONE fsync'd append, exactly the
+            # serial protocol — from here on the lifecycle is the
+            # parent's.  Inside the try: if even the intent write fails,
+            # the worker must still be stopped (close() also frees the
+            # batch's files from the claimed set, so a restarted or
+            # retried driver re-discovers them instead of skipping them
+            # for the rest of this driver's life).
+            self.checkpoint.begin_batch(batch_id, pre.files, wm_state)
+            info = self._run_batch(
+                batch_id, pre.files, wm_state,
+                prefetched=pre, first_attempt_recorded=True,
+            )
+        except BaseException:
+            # a crash (injected or real) ends this driver's life: stop the
+            # worker so tests and operators never leak a polling thread
+            self.close()
+            raise
+        return self._finish_batch(batch_id, info)
+
+    def _attempt(
+        self, batch_id: int, files: list[str], wm_state: dict, prefetched=None
+    ):
+        if prefetched is not None:
+            return super()._attempt(batch_id, files, wm_state, prefetched)
+        # serial re-read (replay or pending recovery): it goes through the
+        # SAME source/firewall objects the worker uses, whose counters and
+        # drift windows are plain mutable state — take the ingest lock so
+        # the worker's discover+parse cycle can never interleave with it
+        pf = self._prefetcher
+        if pf is None or not pf.is_alive():
+            return super()._attempt(batch_id, files, wm_state, None)
+        with pf.ingest_lock:
+            return super()._attempt(batch_id, files, wm_state, None)
+
+    def _call_foreach(self, table: Table, batch_id: int, prefetched) -> None:
+        payload = table
+        if self.stage is not None:
+            # the worker staged the PRE-watermark table; its payload is
+            # only valid when filtering dropped nothing (row counts
+            # equal).  Late rows must never train the model when the
+            # serial driver would have dropped them — re-stage otherwise
+            # (replays always re-stage too).
+            if (
+                prefetched is not None
+                and prefetched.staged is not None
+                and prefetched.table is not None
+                and len(table) == len(prefetched.table)
+            ):
+                payload = prefetched.staged
+            else:
+                # the hook's contract is the ACCEPTED SOURCE rows — drop
+                # the driver-added ingest_time column so a re-stage sees
+                # the same column set the worker staged from
+                view = (
+                    table.drop("ingest_time")
+                    if self.add_ingest_time and "ingest_time" in table.schema
+                    else table
+                )
+                payload = self.stage(view)
+        with self.clock.stage("update"):
+            self.foreach_batch(payload, batch_id)
+
+
+@dataclass
+class ModelUpdateConsumer:
+    """``foreach_batch`` consumer feeding a streaming estimator, with
+    backlog coalescing.
+
+    Steady state (nothing else prefetched): one ``model.update(batch)``
+    per batch — an async jitted dispatch.  When the pipeline reports a
+    backlog (``ready_depth() > 0``), batches are buffered and the burst
+    is flushed through ``model.update_many`` — one stacked transfer and
+    one ``lax.scan`` dispatch for the whole backlog, numerically the
+    same decayed updates as the per-batch calls.
+
+    Note on semantics: a buffered update may execute after its batch's
+    commit.  The model state is in-memory either way (a crash loses it
+    regardless of ordering, and replay-after-crash re-delivers every
+    uncommitted batch), so durability invariants are unchanged; call
+    :meth:`flush` before reading ``latest_model`` mid-stream.
+    """
+
+    model: Any
+    pipeline: PipelinedStreamExecution | None = None
+    mesh: Any = None
+    max_backlog: int = 16
+    updates: int = 0
+    batches_drained: int = 0
+    _buf: list = field(default_factory=list)
+    _seen_rows: bool = False
+
+    def __call__(self, batch, batch_id: int) -> None:
+        if batch_rows(batch) == 0:
+            # an EMPTY batch still decays an initialized model (Spark's
+            # per-batch alpha in "batches" time units — a serial
+            # unconditional foreach would apply it too, and parity with
+            # that is the contract); before any rows have arrived there
+            # is no state to decay and nothing to initialize from
+            if not self._seen_rows:
+                return
+        else:
+            self._seen_rows = True
+        self._buf.append(batch)
+        backlog = (
+            self.pipeline.ready_depth() if self.pipeline is not None else 0
+        )
+        if (
+            backlog > 0
+            and len(self._buf) < self.max_backlog
+            and hasattr(self.model, "update_many")
+        ):
+            return  # more is coming: coalesce into one drain
+        try:
+            self.flush()
+        except BaseException:
+            # this exception fails the CURRENT batch's attempt, and its
+            # replay re-delivers the batch — drop it from the restored
+            # buffer so the retry doesn't apply it twice.  Earlier
+            # (already-committed) deferred batches stay buffered: their
+            # attempts succeeded, only the next flush can apply them.
+            for i, b in enumerate(self._buf):
+                if b is batch:
+                    del self._buf[i]
+                    break
+            raise
+
+    def flush(self) -> None:
+        buf, self._buf = self._buf, []
+        if not buf:
+            return
+        applied = 0
+        try:
+            if len(buf) == 1 or not hasattr(self.model, "update_many"):
+                for b in buf:
+                    self.model.update(b, mesh=self.mesh)
+                    self.updates += 1
+                    applied += 1
+                return
+            # drain in power-of-two chunks (8+2 → scan(8), scan(2)): the
+            # update_many executable is specialized on the backlog length
+            # B, so arbitrary burst sizes would each pay a fresh XLA
+            # compile — binary decomposition bounds the executable set at
+            # log2(burst) sizes with the same per-batch update sequence
+            i, n = 0, len(buf)
+            while n - i >= 2:
+                size = 1 << ((n - i).bit_length() - 1)
+                self.model.update_many(buf[i : i + size], mesh=self.mesh)
+                self.batches_drained += size
+                i += size
+                applied = i
+            for b in buf[i:]:
+                self.model.update(b, mesh=self.mesh)
+                self.updates += 1
+                applied += 1
+        except BaseException:
+            # keep every unapplied batch — deferred updates of batches
+            # that already committed must never be lost to a transient
+            # update failure (they'd silently diverge from serial)
+            self._buf = buf[applied:] + self._buf
+            raise

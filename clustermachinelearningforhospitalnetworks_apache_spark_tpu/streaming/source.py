@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import random
+import threading
 from dataclasses import dataclass, field
 
 from typing import TYPE_CHECKING
@@ -38,6 +39,12 @@ class FileStreamSource:
     schema: Schema
     glob_suffix: str = ".csv"
     header: bool = True
+    #: Spark's ``maxFilesPerTrigger``: cap how many new files one
+    #: micro-batch takes (0 = unbounded).  A backlog then drains as a
+    #: SEQUENCE of batches — which is what lets the pipelined driver
+    #: overlap batch N+1's parse with batch N's device update instead of
+    #: swallowing the whole backlog as one serial mega-batch.
+    max_files_per_batch: int = 0
     #: per-file read retry (exponential backoff + jitter): a flaky
     #: hospital-source mount answers after a beat instead of failing the
     #: whole micro-batch; a persistent failure still surfaces (and the
@@ -50,6 +57,10 @@ class FileStreamSource:
     #: :meth:`read_files_audited`; without one, reads stay strict
     firewall: "DataFirewall | None" = None
     _seen: set[str] = field(default_factory=set)
+    _seen_gen: int = field(default=0, repr=False)
+    # guards _seen: the pipelined driver's worker thread snapshots it
+    # while the commit thread marks files committed
+    _seen_lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     # entropy-seeded: a fleet of sources must not jitter in lockstep
     _rng: random.Random = field(default_factory=random.Random, repr=False)
 
@@ -75,15 +86,37 @@ class FileStreamSource:
     def poll(self) -> list[str]:
         """New files since the last poll (does not mark them processed —
         call :meth:`commit_files` after the batch commits, so a crash
-        between poll and commit replays the same files)."""
-        return [f for f in self.list_files() if f not in self._seen]
+        between poll and commit replays the same files), capped at
+        ``max_files_per_batch`` when set."""
+        new = [f for f in self.list_files() if f not in self._seen]
+        if self.max_files_per_batch > 0:
+            new = new[: self.max_files_per_batch]
+        return new
 
     def commit_files(self, files: list[str]) -> None:
-        self._seen.update(files)
+        with self._seen_lock:
+            self._seen.update(files)
+            self._seen_gen += 1
 
     def restore(self, files: list[str]) -> None:
         """Re-mark files as seen when resuming from a checkpoint."""
-        self._seen.update(files)
+        with self._seen_lock:
+            self._seen.update(files)
+            self._seen_gen += 1
+
+    def seen_generation(self) -> int:
+        """Bumped on every ``_seen`` mutation — lets a concurrent reader
+        cache :meth:`seen_snapshot` instead of copying the (ever-growing)
+        committed-file set on every poll."""
+        with self._seen_lock:
+            return self._seen_gen
+
+    def seen_snapshot(self) -> frozenset:
+        """Consistent copy of the committed-file set — iterating ``_seen``
+        directly from another thread races ``commit_files`` (a set resize
+        mid-iteration raises RuntimeError)."""
+        with self._seen_lock:
+            return frozenset(self._seen)
 
     def _read_one(self, f: str) -> Table:
         def attempt() -> Table:

@@ -7,10 +7,48 @@ captured to a Perfetto/TensorBoard trace directory for MXU/HBM analysis.
 
 from __future__ import annotations
 
+import threading
+import time
 from contextlib import contextmanager
 from typing import Iterator
 
 import jax
+
+
+class StageClock:
+    """Wall-clock accumulator per named pipeline stage.
+
+    The streaming pipeline runs its stages on different threads (parse +
+    firewall on the prefetch worker, transfer/update/durability on the
+    commit thread), so the per-stage seconds are what proves the overlap:
+    when stages overlap, ``sum(seconds.values())`` exceeds the elapsed
+    wall time.  Thread-safe; ~two ``perf_counter`` calls of overhead per
+    stage entry."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.seconds: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
+
+    def shares(self) -> dict[str, float]:
+        """Fraction of the summed stage time each stage took (NOT of the
+        wall clock — overlapped stages sum past it by design)."""
+        with self._lock:
+            total = sum(self.seconds.values())
+            if total <= 0:
+                return {}
+            return {k: v / total for k, v in sorted(self.seconds.items())}
 
 
 @contextmanager

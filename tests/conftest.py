@@ -89,6 +89,11 @@ def pytest_configure(config):
         "quality: data-quality firewall tests — row validation, schema "
         "drift, quarantine, PSI drift (python -m pytest tests/ -m quality)",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf: performance-contract tests — pipelined-vs-serial parity, "
+        "donation/zero-recompile, bench plumbing (pytest -m perf)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

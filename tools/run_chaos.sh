@@ -5,13 +5,15 @@
 #   tools/run_chaos.sh            # the tier-1 chaos subset
 #   tools/run_chaos.sh --slow     # include the slow soak/breaker tests
 #
-# Sites covered: stream WAL boundaries (stream.after_*), torn WAL writes
-# at exact byte offsets (wal.append), fit-checkpoint commit protocol
-# (fit_ckpt.*), model artifact save/swap (model_io.save.*), source IO
-# retries (source.read_file), serving faults (serve.predict), and the
-# data-corruption kinds at the ingest text boundary (ingest.csv_text:
-# mangle_field / shuffle_columns / unit_scale / nan_burst — the chaos
-# half of tests/test_quality.py).
+# Sites covered: stream WAL boundaries (stream.after_*) on BOTH the
+# serial and the pipelined driver (tests/test_stream_pipeline.py kills
+# the prefetch pipeline at every boundary plus mid-parse on the worker
+# thread), torn WAL writes at exact byte offsets (wal.append),
+# fit-checkpoint commit protocol (fit_ckpt.*), model artifact save/swap
+# (model_io.save.*), source IO retries (source.read_file), serving
+# faults (serve.predict), and the data-corruption kinds at the ingest
+# text boundary (ingest.csv_text: mangle_field / shuffle_columns /
+# unit_scale / nan_burst — the chaos half of tests/test_quality.py).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -22,6 +24,7 @@ fi
 
 LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
+    tests/test_stream_pipeline.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -36,7 +39,8 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality)\.py::(\S+)", line
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline)\.py::(\S+)",
+        line,
     )
     if not m:
         continue
