@@ -281,6 +281,102 @@ def _kmeans_roofline(
     }
 
 
+def _device_kind() -> str:
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def _hist_bytes_roofline(
+    rps_per_chip: float, *, T: int, depth: int, d: int, S: int,
+    rounds: int, device_kind: str,
+) -> dict:
+    """Bytes-moved bound for the level-order histogram contraction
+    (VERDICT r5 demand #6: every tree row states its structural bound).
+
+    Each level pass streams, per row: the binned matrix (d int32), the
+    stat vector (S f32), per-tree weights (T f32) and frontier positions
+    (T int32) → 4·(d + S + 2T) bytes; the (T, LN, d, B, S) histogram
+    output is row-count-independent and amortizes to ~0.  A fit runs
+    ``rounds·(depth+1)`` such passes (RF: rounds=1, all T trees share one
+    pass per level; GBT: rounds=M single-tree passes), so
+
+        bytes/row/fit   = rounds · (depth+1) · 4 · (d + S + 2T)
+        bound rows/s    = HBM_GB/s / bytes_per_row_fit
+
+    — no schedule trains faster without cutting passes.  The histogram
+    einsum itself is MXU work on top of this traffic, so at skinny d the
+    HBM bound is the binding one."""
+    _, hbm_gbps = _CHIP_SPECS.get(device_kind, (197.0, 819.0))
+    assumed = "" if device_kind in _CHIP_SPECS else " (assumed v5e)"
+    bytes_per_row = rounds * (depth + 1) * 4.0 * (d + S + 2 * T)
+    bound_rps = hbm_gbps * 1e9 / bytes_per_row
+    return {
+        "hist_bytes_per_row_fit": round(bytes_per_row, 1),
+        "hist_hbm_bound_rows_per_s_chip": round(bound_rps, 1),
+        "pct_of_roofline": round(100.0 * rps_per_chip / bound_rps, 2),
+        "roofline_note": (
+            f"bytes-moved histogram bound vs {device_kind}{assumed} HBM "
+            f"{hbm_gbps:.0f} GB/s; {rounds} round(s) × {depth + 1} level "
+            f"passes × 4·(d+S+2T) B/row"
+        ),
+    }
+
+
+def _gmm_roofline(
+    rps_per_chip: float, k: int, d: int, precision: str, device_kind: str
+) -> dict:
+    """MXU bound for the full-covariance EM iteration (VERDICT r5 #6).
+
+    FLOPs/row/iter ≈ 4·k·d²: the E-step's per-component triangular solve
+    is a d×d matmul against the row block (2·k·d² FLOPs), and the M-step's
+    responsibility-weighted scatter matrices are another 2·k·d²; the
+    k·d-order terms (means, log-dets) are ≤ d/2 of that and ignored —
+    keeping the stated bound generous.  Both matmul families contract
+    over d ≤ 128, so the MXU is structurally d/128-utilized (same
+    argument as ``_kmeans_roofline``); "highest" precision costs ~6 bf16
+    passes per f32 matmul, "bf16" costs 1."""
+    peak_tflops, _ = _CHIP_SPECS.get(device_kind, (197.0, 819.0))
+    assumed = "" if device_kind in _CHIP_SPECS else " (assumed v5e)"
+    passes = {"highest": 6.0, "high": 3.0, "default": 1.0, "bf16": 1.0}.get(
+        precision, 1.0
+    )
+    achieved_tflops = rps_per_chip * 4.0 * k * d * d / 1e12
+    bound_tflops = peak_tflops * min(d / 128.0, 1.0) / passes
+    return {
+        "achieved_tflops": round(achieved_tflops, 3),
+        "mxu_dlimited_bound_tflops": round(bound_tflops, 2),
+        "pct_of_roofline": round(100.0 * achieved_tflops / bound_tflops, 2),
+        "roofline_note": (
+            f"MXU bound vs {device_kind}{assumed}: 4·k·d² FLOPs/row/iter, "
+            f"K-dim {d}/128 utilized, precision={precision} "
+            f"({passes:.0f} bf16 pass(es))"
+        ),
+    }
+
+
+def _nb_bytes_roofline(rps_per_chip: float, d: int, device_kind: str) -> dict:
+    """Bytes-moved bound for the NaiveBayes sufficient-stats pass
+    (VERDICT r5 #6): ONE read of x (d f32) + y (1 f32) per row — the
+    (k, d) stat outputs are row-count-independent — so bytes/row =
+    4·(d+1) and the bound is HBM_GB/s / that.  The one-hot contraction's
+    FLOPs (2·k·d/row) are far below the MXU bound at small k, so HBM is
+    the binding wall."""
+    _, hbm_gbps = _CHIP_SPECS.get(device_kind, (197.0, 819.0))
+    assumed = "" if device_kind in _CHIP_SPECS else " (assumed v5e)"
+    bytes_per_row = 4.0 * (d + 1)
+    bound_rps = hbm_gbps * 1e9 / bytes_per_row
+    return {
+        "bytes_per_row": bytes_per_row,
+        "hbm_bound_rows_per_s_chip": round(bound_rps, 1),
+        "pct_of_roofline": round(100.0 * rps_per_chip / bound_rps, 2),
+        "roofline_note": (
+            f"bytes-moved bound vs {device_kind}{assumed} HBM "
+            f"{hbm_gbps:.0f} GB/s; one 4·(d+1) B/row stats pass"
+        ),
+    }
+
+
 def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dict:
     """Config 1/2: Lloyd-iteration throughput at the given k.
 
@@ -623,6 +719,7 @@ def _bench_gmm(k: int = 32) -> dict:
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
         "precision": precision,
+        **_gmm_roofline(per_chip, k, d, precision, _device_kind()),
         **extra,
         **var,
     }
@@ -747,6 +844,37 @@ def _cpu_rf_throughput(x: np.ndarray, y: np.ndarray, T: int, depth: int, B: int)
     return n / (time.perf_counter() - t0)
 
 
+def _tree_pallas_ab(force_pallas, on_tpu, pallas_fit, per_chip, n, n_chips):
+    """Tree-hist Pallas win-or-retire A/B fields, shared by the rf20 and
+    gbt20 rows (the adopt/retire record + the ≥1.05-on-two-sweeps rule
+    live in ops/pallas_kernels.fused_level_hist).  One timed run of the
+    kernel path with the SAME run count as the headline; >1 means the
+    kernel wins.  Off-TPU the kernel runs interpret-mode (noise presented
+    as signal), so the row records why the A/B is absent instead of a
+    bogus ratio.  A forced headline (BENCH_TREE_PALLAS=1) says so in the
+    row — a sweep consumer must never mistake a kernel-path (or
+    interpret-mode) headline for the XLA baseline."""
+    if force_pallas:
+        return {
+            "tree_pallas_headline": (
+                "BENCH_TREE_PALLAS=1: the headline IS the kernel path"
+                + ("" if on_tpu else
+                   " in INTERPRET mode — not device signal")
+            )
+        }
+    if not on_tpu:
+        return {"tree_pallas_ab": "skipped off-TPU (interpret-mode kernel)"}
+    _fence(pallas_fit())  # warm-up the kernel executables
+    p_timed = _make_timed(
+        lambda: _fence(pallas_fit()), n, n_chips, calibrate=on_tpu
+    )
+    p_rate, _ = _best_of(p_timed)
+    return {
+        "tree_pallas_rps_per_chip": round(p_rate, 1),
+        "tree_pallas_vs_xla": round(p_rate / per_chip, 3),
+    }
+
+
 def _bench_random_forest(T: int = 20, depth: int = 5) -> dict:
     """Config 6 (reference hot path): RandomForestRegressor fit throughput
     — the reference's own hottest fit (``rf.fit``,
@@ -771,27 +899,35 @@ def _bench_random_forest(T: int = 20, depth: int = 5) -> dict:
     y = (x @ rng.normal(size=(d,)) + rng.normal(0.0, 0.3, size=n)).astype(np.float32)
     ds = device_dataset(x, y, mesh=mesh)
 
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+        grow_forest,
+    )
+
     est = RandomForestRegressor(
         num_trees=T, max_depth=depth, feature_subset_strategy="all", seed=0
     )
-    # BENCH_TREE_PALLAS=1 measures the fused Pallas histogram kernel
-    # instead of the XLA one-hot-contraction scan (same split results,
-    # parity-tested) — the A/B the kernel's docstring numbers come from.
-    if os.environ.get("BENCH_TREE_PALLAS", "").lower() in ("1", "true", "yes"):
-        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
-            grow_forest,
-        )
 
-        fit = lambda: grow_forest(
+    def pallas_fit():
+        return grow_forest(
             ds, task="regression", num_trees=T, max_depth=depth,
             bootstrap=True, seed=0, mesh=mesh, use_pallas=True,
         )
-    else:
-        fit = lambda: est.fit(ds, mesh=mesh)
+
+    # BENCH_TREE_PALLAS=1 forces the HEADLINE through the fused Pallas
+    # histogram kernel (same split results, parity-tested); the A/B below
+    # records kernel-vs-XLA on every TPU sweep regardless.
+    force_pallas = os.environ.get("BENCH_TREE_PALLAS", "").lower() in (
+        "1", "true", "yes",
+    )
+    fit = pallas_fit if force_pallas else (lambda: est.fit(ds, mesh=mesh))
     _fence(fit())  # warm-up: per-level executables
 
     timed = _make_timed(lambda: _fence(fit()), n, n_chips, calibrate=on_tpu)
     per_chip, var = _best_of(timed)
+
+    pallas_fields = _tree_pallas_ab(
+        force_pallas, on_tpu, pallas_fit, per_chip, n, n_chips
+    )
 
     cpu_n = min(n, 100_000)
     cpu_thr = _cpu_rf_throughput(
@@ -806,6 +942,11 @@ def _bench_random_forest(T: int = 20, depth: int = 5) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
+        **pallas_fields,
+        **_hist_bytes_roofline(
+            per_chip, T=T, depth=depth, d=d, S=3, rounds=1,
+            device_kind=_device_kind(),
+        ),
         **var,
     }
 
@@ -1099,19 +1240,31 @@ def _bench_naive_bayes(k: int = 8, d: int = 32) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
         "platform": platform,
+        **_nb_bytes_roofline(per_chip, d, _device_kind()),
         **var,
     }
 
 
 def _bench_gbt(M: int = 20, depth: int = 3) -> dict:
-    """GBTRegressor fit throughput — M sequential boosted rounds, each a
-    level-order histogram tree with the bin matrix reused across rounds
-    (models/tree/gbt.py)."""
+    """GBTRegressor fit throughput — M boosted rounds fused into ONE
+    jitted lax.scan (models/tree/gbt.py round fusion): residual refresh,
+    level-order tree growth and leaf advance in the same dispatch, the
+    bin matrix reused across rounds, O(1) host syncs per fit.
+
+    The row carries the fusion evidence the VERDICT demands: measured
+    host-sync count per fit (transfer census — O(1), not O(M·depth)),
+    per-stage seconds/shares (StageClock inside the fit), the
+    fused-vs-legacy per-round-loop A/B, the tree-hist Pallas A/B (TPU),
+    and the bytes-moved histogram roofline."""
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
         GBTRegressor,
     )
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
         device_dataset,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.profiling import (
+        StageClock,
+        host_sync_census,
     )
 
     d = 8
@@ -1123,13 +1276,55 @@ def _bench_gbt(M: int = 20, depth: int = 3) -> dict:
     y = (x @ rng.normal(size=(d,)) + rng.normal(0.0, 0.3, size=n)).astype(np.float32)
     ds = device_dataset(x, y, mesh=mesh)
 
-    est = GBTRegressor(max_iter=M, max_depth=depth, seed=0)
-    _fence(est.fit(ds, mesh=mesh))  # warm-up: per-level executables
+    force_pallas = os.environ.get("BENCH_TREE_PALLAS", "").lower() in (
+        "1", "true", "yes",
+    )
+    base_kw = dict(max_iter=M, max_depth=depth, seed=0, use_pallas=force_pallas)
+    est = GBTRegressor(**base_kw)
+    _fence(est.fit(ds, mesh=mesh))  # warm-up: the fused boost executable
 
+    # host-sync census OUTSIDE the timed windows: the O(1)-per-fit
+    # contract (binning sample + F₀ + one bulk winner fetch), asserted
+    # independently of M·depth by tests/test_gbt_fused.py
+    with host_sync_census() as census:
+        est.fit(ds, mesh=mesh)
+    host_syncs = census["device_get"]
+
+    # headline: the UNINSTRUMENTED fit, like every other config (and the
+    # PR 4 row this one is gated against)
     timed = _make_timed(
         lambda: _fence(est.fit(ds, mesh=mesh)), n, n_chips, calibrate=on_tpu
     )
     per_chip, var = _best_of(timed)
+
+    # per-stage shares from ONE separate clocked fit (the clock brackets
+    # add a mid-boost fence for attribution, so the clocked fit never
+    # feeds the headline; one fit keeps stage_seconds per-fit numbers)
+    clock = StageClock()
+    GBTRegressor(**base_kw, stage_clock=clock).fit(ds, mesh=mesh)
+
+    # fused-vs-legacy A/B: the same fit through the per-round deferred
+    # loop AND the per-level dispatch loop (fused_rounds=False +
+    # fused_levels=False) — the full pre-fusion (PR 4) baseline; with
+    # only fused_rounds off, the legacy leg would still grow each tree
+    # in one fused dispatch and hide the per-level round trips PR 5
+    # eliminated.  Timed with the SAME instrumentation and run count as
+    # the headline.
+    est_legacy = GBTRegressor(
+        **base_kw, fused_rounds=False, fused_levels=False
+    )
+    _fence(est_legacy.fit(ds, mesh=mesh))  # warm-up legacy executables
+    l_timed = _make_timed(
+        lambda: _fence(est_legacy.fit(ds, mesh=mesh)), n, n_chips,
+        calibrate=on_tpu,
+    )
+    legacy_rate, _ = _best_of(l_timed)
+
+    est_pallas = GBTRegressor(**dict(base_kw, use_pallas=True))
+    pallas_fields = _tree_pallas_ab(
+        force_pallas, on_tpu, lambda: est_pallas.fit(ds, mesh=mesh),
+        per_chip, n, n_chips,
+    )
 
     # CPU proxy: M histogram trees over the same rows (the boosting rounds'
     # tree-build cost; residual updates are excluded — conservative).
@@ -1145,8 +1340,49 @@ def _bench_gbt(M: int = 20, depth: int = 3) -> dict:
         "value": round(per_chip, 1),
         "unit": "records/sec/chip",
         "vs_baseline": round(per_chip / cpu_thr, 2),
+        "host_syncs_per_fit": int(host_syncs),
+        "sync_model": (
+            f"O(1): {int(host_syncs)} blocking fetches/fit vs "
+            f"O(M·(depth+1))={M * (depth + 1)} per-level fetches on the "
+            "seed path (PR 4's deferred loop already fetched O(1) but "
+            "still enqueued O(M·depth) round-trip dispatches — the "
+            "legacy_loop leg)"
+        ),
+        "legacy_loop_rps_per_chip": round(legacy_rate, 1),
+        "fused_vs_legacy": round(per_chip / legacy_rate, 3),
+        "stage_seconds": {
+            k: round(v, 3) for k, v in sorted(clock.seconds.items())
+        },
+        "stage_shares": {k: round(v, 3) for k, v in clock.shares().items()},
+        **pallas_fields,
+        **_hist_bytes_roofline(
+            per_chip, T=1, depth=depth, d=d, S=3, rounds=M,
+            device_kind=_device_kind(),
+        ),
         **var,
     }
+
+
+def _lloyd_step_rate(step, ds, centers0, c_valid, n: int, iters: int):
+    """Measure one Lloyd-step variant for an A/B row: one warm-up call
+    (compile + first execute), then repeated steps threading the updated
+    centers under :func:`_timed_windows`.  Shared by the Pallas-kernel
+    and ``fused_stats`` A/B configs — both adjudicate alternatives of
+    the SAME ``(x, w, centers, c_valid) -> centers`` step contract, so
+    they must be timed identically for their ratios to be comparable.
+    windows=3: these configs are on-TPU-only paths."""
+    c, _, _, _ = step(ds.x, ds.w, centers0, c_valid)
+    _fence(c)
+
+    def run_iters(it):
+        nonlocal c
+        t0 = time.perf_counter()
+        for _ in range(it):
+            c, _, _, _ = step(ds.x, ds.w, c, c_valid)
+        _fence(c)
+        return time.perf_counter() - t0
+
+    return _timed_windows(run_iters, n, iters, 3)
 
 
 def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
@@ -1194,19 +1430,7 @@ def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
     n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
 
     def rate(step):
-        c = centers
-        c, _, _, _ = step(ds.x, ds.w, c, c_valid)   # warm-up/compile
-        _fence(c)
-
-        def run_iters(it):
-            nonlocal c
-            t0 = time.perf_counter()
-            for _ in range(it):
-                c, _, _, _ = step(ds.x, ds.w, c, c_valid)
-            _fence(c)
-            return time.perf_counter() - t0
-
-        return _timed_windows(run_iters, n, iters, 3)  # on-TPU only path
+        return _lloyd_step_rate(step, ds, centers, c_valid, n, iters)
 
     xla, xla_w = rate(_make_train_step(mesh, n_loc, k, d, 32768))
     fused, fused_w = rate(_make_train_step_fused(mesh, k, False))
@@ -1219,6 +1443,77 @@ def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
         "unit": "records/sec/chip",
         "vs_baseline": round(fused / xla, 3),
         "xla_scan_rps_per_chip": round(xla / n_chips, 1),
+        "platform": platform,
+        **_variance_fields([r / n_chips for r in fused_w]),
+    }
+
+
+def _bench_kmeans_fused_ab(k: int = 256, d: int = 8) -> dict:
+    """KMeans ``fused_stats`` 10M-row A/B (VERDICT r5 demand #4), as its
+    OWN row: bf16 baseline step vs the fused-accumulation restructure
+    (x²-free argmin + one bf16 one-hot matmul for sums AND counts) at the
+    north-star shape.  The kmeans256 headline only reaches the fused rung
+    when its bf16 gate adopts first, so a sweep where bf16 loses never
+    answers the fused question — this config always does, and it rides
+    the default ``--watch`` list so the next tunnel window answers it.
+    ``vs_baseline`` is fused/bf16 (>1 = restructure wins); quality gating
+    stays in the kmeans256 headline (silhouette-parity adopt rule)."""
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+        _make_train_step,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+        device_dataset,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    platform, on_tpu, n, iters, mesh, n_chips = _bench_setup(10_000_000)
+    if not on_tpu:
+        return {
+            "metric": f"KMeans fused_stats A/B k={k} d={d}",
+            "error": (
+                "requires the TPU backend (the A/B adjudicates MXU "
+                "accumulation scheduling; the CPU proxy has no MXU)"
+            ),
+        }
+    x = _make_data(n, d, k)
+    ds = device_dataset(x, mesh=mesh)
+    rng = np.random.default_rng(1)
+    m = mesh.shape[MODEL_AXIS]
+    k_pad = -(-k // m) * m
+    cen = np.zeros((k_pad, d), dtype=np.float32)
+    cen[:k] = x[rng.choice(n, size=k, replace=False)]
+    c_valid = np.zeros((k_pad,), dtype=np.float32)
+    c_valid[:k] = 1.0
+    centers0 = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+    n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+    chunk = int(os.environ.get("BENCH_KMEANS_CHUNK", 131072))
+
+    def rate(precision: str, fused: bool):
+        step = _make_train_step(
+            mesh, n_loc, k_pad, d, chunk, False, precision, fused
+        )
+        return _lloyd_step_rate(step, ds, centers0, c_valid_dev, n, iters)
+
+    bf16_rate, bf16_w = rate("bf16", False)
+    fused_rate, fused_w = rate("bf16", True)
+    f32_rate, _ = rate("highest", False)
+    return {
+        "metric": (
+            f"KMeans fused_stats A/B records/sec/chip (vs bf16 step, "
+            f"k={k}, d={d}, {n} rows, {platform})"
+        ),
+        "value": round(fused_rate / n_chips, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": round(fused_rate / bf16_rate, 3),
+        "bf16_rps_per_chip": round(bf16_rate / n_chips, 1),
+        "f32_rps_per_chip": round(f32_rate / n_chips, 1),
         "platform": platform,
         **_variance_fields([r / n_chips for r in fused_w]),
     }
@@ -1660,6 +1955,7 @@ CONFIGS = {
     "gbt20": lambda: _bench_gbt(20, 3),                         # boosted rounds
     "nb": lambda: _bench_naive_bayes(8),                        # stats pass
     "pallas_ab": lambda: _bench_pallas_ab(64, 64),              # win-or-retire A/B
+    "kmeans_fused_ab": lambda: _bench_kmeans_fused_ab(256, 8),  # VERDICT r5 #4
     "serve": lambda: _bench_serve(),                            # online inference
     "chaos": lambda: _bench_chaos(),                            # fault recovery
     "quality": lambda: _bench_quality(),                        # data firewall
@@ -1902,7 +2198,7 @@ def _child_main(name: str) -> None:
 #: recovers mid-window: headline first (north star, then the A/B the
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
-    "kmeans256", "pallas_ab", "rf20", "gbt20", "nb",
+    "kmeans256", "pallas_ab", "kmeans_fused_ab", "rf20", "gbt20", "nb",
     "gmm32", "bisecting", "streaming", "streaming_pipeline", "kmeans8",
     "serve",
 ]
@@ -2302,6 +2598,10 @@ def watch_main() -> int:
                     for obj in rows:
                         obj["config"] = key
                         f.write(json.dumps(obj) + "\n")
+                        # bank every watch row in the shared evidence
+                        # sidecar too: one command = fenced sweep +
+                        # sidecar update when the tunnel answers
+                        _sidecar_append({"banked": "watch", **obj})
                 if not any("error" not in r for r in rows):
                     note(f"{key} failed on-chip; re-probing before the next")
                     p2, _ = _probe_backend(probe_t)

@@ -45,6 +45,9 @@ def _fit_grown(
     if isinstance(data, HostDataset):
         if data.y is None:
             raise ValueError("tree fit needs labels: HostDataset(y=...)")
+        # out-of-core growth is inherently per-level (each level is one
+        # more sufficient-stats pass over streamed blocks) — no fused path
+        kw.pop("fused_levels", None)
         return grow_forest_outofcore(
             data, mesh=mesh, **subset_kw(data.n_features), **kw
         )
@@ -190,6 +193,11 @@ class _TreeParams:
     # seconds).
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
+    # Resident fits grow every level in ONE jitted dispatch
+    # (engine._make_forest_grower) instead of one dispatch per level —
+    # identical trees (parity-tested); False restores the per-level loop.
+    # Out-of-core fits ignore it (streaming levels are per-level passes).
+    fused_levels: bool = True
 
 
 @dataclass(frozen=True)
@@ -207,6 +215,7 @@ class DecisionTreeRegressor(Estimator, _TreeParams):
             categorical_features=self.categorical_features,
             checkpoint_dir=self.checkpoint_dir,
             checkpoint_every=self.checkpoint_every,
+            fused_levels=self.fused_levels,
         )
         return _from_grown(DecisionTreeModel, grown, "regression", 2)
 
@@ -230,5 +239,6 @@ class DecisionTreeClassifier(Estimator, _TreeParams):
             categorical_features=self.categorical_features,
             checkpoint_dir=self.checkpoint_dir,
             checkpoint_every=self.checkpoint_every,
+            fused_levels=self.fused_levels,
         )
         return _from_grown(DecisionTreeModel, grown, "classification", self.num_classes)

@@ -390,6 +390,17 @@ def _advance_level(
     return jnp.where(active & is_split, child, jnp.where(active, -1, node_id))
 
 
+def _subset_mask_draw(seed, depth, T: int, level_nodes: int, d: int, k: int):
+    """Feature-subset draw BODY — the one definition of the key stream,
+    traced by both :func:`_make_subset_mask` (per-level loop) and
+    :func:`_make_forest_grower` (fused path), so the two paths cannot
+    drift apart and stay bit-identical by construction."""
+    key = jax.random.fold_in(jax.random.key(seed), depth)
+    u = jax.random.uniform(key, (T, level_nodes, d))
+    ranks = jnp.argsort(jnp.argsort(u, axis=-1), axis=-1)
+    return (ranks < k).astype(jnp.float32)
+
+
 @lru_cache(maxsize=32)
 def _make_subset_mask(T: int, level_nodes: int, d: int, k: int):
     """jit'd per-(tree, node) feature-subset draw (Spark's
@@ -403,12 +414,96 @@ def _make_subset_mask(T: int, level_nodes: int, d: int, k: int):
     """
 
     def draw(seed, depth):
-        key = jax.random.fold_in(jax.random.key(seed), depth)
-        u = jax.random.uniform(key, (T, level_nodes, d))
-        ranks = jnp.argsort(jnp.argsort(u, axis=-1), axis=-1)
-        return (ranks < k).astype(jnp.float32)
+        return _subset_mask_draw(seed, depth, T, level_nodes, d, k)
 
     return jax.jit(draw)
+
+
+@lru_cache(maxsize=32)
+def _make_forest_grower(
+    mesh: Mesh, d: int, B: int, S: int, T: int, task: str, max_depth: int,
+    cat_arities: tuple[int, ...] | None = None, use_pallas: bool = False,
+    subset_k: int | None = None,
+):
+    """ONE jitted device computation growing the whole forest: every
+    level's histogram + on-device split selection + frontier advance,
+    statically unrolled over ``max_depth + 1`` levels inside a single
+    trace (the frontier is tiny at boosting depths, so the unroll is a
+    handful of ops per level).
+
+    The per-level loop in :func:`grow_forest` issues one dispatch per
+    level — already sync-free, but a GBT fit at M=20 × depth 3 pays
+    O(M·depth) dispatch round trips, each a measured ~ms of host work on
+    a tunneled chip while the device idles between enqueues.  This fused
+    path is the tree-engine analogue of KMeans's device-resident
+    ``while_loop`` (``models/kmeans.py``): the caller gets the full
+    per-level winner pytree from ONE dispatch, and — because the body is
+    pure — the whole grower can be traced INSIDE a ``lax.scan`` over
+    boosting rounds (``gbt.py``), collapsing a fit to one dispatch total.
+
+    Per-level building blocks are the SAME cached callables the legacy
+    loop uses (``_make_level_hist`` / ``_make_select_fn`` /
+    ``_advance_level``), and the feature-subset draw replicates
+    ``_make_subset_mask`` op-for-op, so fused and per-level growth emit
+    bit-identical winner tensors (pinned by tests/test_gbt_fused.py).
+
+    → ``grow(binned_t, base_t, w_tree, seed, min_inst, min_gain)``
+    returning the per-level list of 6-tuples ``(agg, gain, feat, bin,
+    do_split, catmask)`` — the exact ``DeferredForest.level_out``
+    structure."""
+    hist_fns = [
+        _make_level_hist(mesh, 1 << dep, d, B, S, T, use_pallas)
+        for dep in range(max_depth + 1)
+    ]
+    select_fns = [
+        _make_select_fn(1 << dep, d, B, S, T, task, cat_arities)
+        for dep in range(max_depth + 1)
+    ]
+    any_cat = cat_arities is not None and any(a > 0 for a in cat_arities)
+    cat_flags_np = (
+        np.asarray([a > 0 for a in cat_arities], bool) if any_cat else None
+    )
+
+    def grow(binned_t, base_t, w_tree, seed, min_inst, min_gain):
+        cat_flags_dev = (
+            jnp.asarray(cat_flags_np) if cat_flags_np is not None else None
+        )
+        node_id = jnp.zeros((T, binned_t.shape[1]), jnp.int32)
+        level_out = []
+        for depth in range(max_depth + 1):
+            level_nodes = 1 << depth
+            level_base = level_nodes - 1
+            pos = jnp.where(node_id >= 0, node_id - level_base, -1)
+            pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
+            if subset_k is not None and subset_k < d:
+                # the SAME draw body _make_subset_mask traces — one key
+                # stream, per-level parity by construction
+                mask = _subset_mask_draw(
+                    seed, depth, T, level_nodes, d, subset_k
+                )
+            else:
+                mask = jnp.ones((T, level_nodes, d), jnp.float32)
+            hist = hist_fns[depth](binned_t, base_t, w_tree, pos)
+            out = select_fns[depth](hist, mask, min_inst, min_gain)
+            level_out.append(out)
+            if depth < max_depth:
+                node_id = _advance_level(
+                    binned_t, node_id, pos, out[2], out[3], out[4],
+                    level_base,
+                    out[5] if any_cat else None, cat_flags_dev,
+                )
+        return level_out
+
+    return jax.jit(grow)
+
+
+def _bootstrap_draw(seed, rate: float, T: int, n_pad: int):
+    """Poisson bootstrap draw BODY — the one definition of the key
+    stream, traced by both :func:`_make_bootstrap` (per-round loop) and
+    GBT's fused boost scan (``seed = seed0 + t`` per round), so the two
+    paths draw identical weights by construction."""
+    key = jax.random.key(seed)
+    return jax.random.poisson(key, rate, shape=(T, n_pad)).astype(jnp.float32)
 
 
 @lru_cache(maxsize=16)
@@ -422,8 +517,7 @@ def _make_bootstrap(mesh: Mesh, T: int, n_pad: int, rate: float):
     from jax.sharding import NamedSharding
 
     def draw(seed):
-        key = jax.random.key(seed)
-        return jax.random.poisson(key, rate, shape=(T, n_pad)).astype(jnp.float32)
+        return _bootstrap_draw(seed, rate, T, n_pad)
 
     return jax.jit(
         draw, out_shardings=NamedSharding(mesh, P(None, DATA_AXIS))
@@ -695,6 +789,7 @@ def grow_forest(
     binned_t: jax.Array | None = None,
     categorical_features: dict[int, int] | None = None,
     defer_fetch: bool = False,
+    fused_levels: bool = True,
 ) -> "GrownForest | DeferredForest":
     """Train ``num_trees`` trees level-by-level on the sharded dataset.
 
@@ -716,7 +811,12 @@ def grow_forest(
     ``defer_fetch=True`` returns a :class:`DeferredForest` (device winner
     tensors, no host sync at all — including the fast-path empty-dataset
     guard, so the caller must have validated non-emptiness already); the
-    GBT round loop uses it to chain boosting rounds entirely on device."""
+    GBT round loop uses it to chain boosting rounds entirely on device.
+
+    ``fused_levels=True`` (the default) grows all levels in ONE jitted
+    dispatch (:func:`_make_forest_grower`) instead of one dispatch per
+    level; ``False`` keeps the legacy per-level loop (same winner
+    tensors bit-for-bit — the parity tests pin it)."""
     from ...parallel.sharding import sample_valid_rows
 
     mesh = mesh or default_mesh()
@@ -789,8 +889,6 @@ def grow_forest(
     is_cat_host = np.asarray([f in cat for f in range(d)], dtype=bool)
     rec = _ForestRecorder(T, d, S, max_depth, is_cat_host)
 
-    node_id = jnp.zeros((T, n_pad), jnp.int32)  # all rows start at the root
-
     # Dispatch the whole level chain to the device without a single host
     # sync: the level step selects splits on device, _advance_level consumes
     # its device outputs directly, and the (tiny) per-level winner tensors
@@ -799,34 +897,51 @@ def grow_forest(
     # histograms themselves.
     min_inst = jnp.float32(min_instances_per_node)
     min_gain = jnp.float32(min_info_gain)
-    level_out = []
-    for depth in range(max_depth + 1):
-        level_nodes = 1 << depth
-        level_base = level_nodes - 1
-        pos = jnp.where(node_id >= 0, node_id - level_base, -1)
-        pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
-
-        # per-(tree, node) feature subset (device-drawn, Spark's
-        # featureSubsetStrategy, applied at split-selection time)
-        if feature_subset_size is not None and feature_subset_size < d:
-            mask = _make_subset_mask(T, level_nodes, d, feature_subset_size)(
-                seed, depth
-            )
-        else:
-            mask = jnp.ones((T, level_nodes, d), jnp.float32)
-
-        step_fn = _make_level_step(
-            mesh, level_nodes, d, B, S, T, task, use_pallas, cat_arities
+    subset_k = (
+        feature_subset_size
+        if feature_subset_size is not None and feature_subset_size < d
+        else None
+    )
+    if fused_levels:
+        # whole-forest growth in ONE dispatch (the boosting-fusion path;
+        # same winner tensors as the per-level loop below)
+        grower = _make_forest_grower(
+            mesh, d, B, S, T, task, max_depth, cat_arities, use_pallas,
+            subset_k,
         )
-        agg_d, gain_d, feat_d, bin_d, split_d, catmask_d = step_fn(
-            binned_t, base_t, w_tree, pos, mask, min_inst, min_gain
-        )
-        level_out.append((agg_d, gain_d, feat_d, bin_d, split_d, catmask_d))
-        if depth < max_depth:
-            node_id = _advance_level(
-                binned_t, node_id, pos, feat_d, bin_d, split_d, level_base,
-                catmask_d if cat else None, cat_flags_dev,
+        level_out = grower(binned_t, base_t, w_tree, seed, min_inst, min_gain)
+    else:
+        node_id = jnp.zeros((T, n_pad), jnp.int32)  # all rows at the root
+        level_out = []
+        for depth in range(max_depth + 1):
+            level_nodes = 1 << depth
+            level_base = level_nodes - 1
+            pos = jnp.where(node_id >= 0, node_id - level_base, -1)
+            pos = jnp.where((pos >= 0) & (pos < level_nodes), pos, -1)
+
+            # per-(tree, node) feature subset (device-drawn, Spark's
+            # featureSubsetStrategy, applied at split-selection time)
+            if subset_k is not None:
+                mask = _make_subset_mask(T, level_nodes, d, subset_k)(
+                    seed, depth
+                )
+            else:
+                mask = jnp.ones((T, level_nodes, d), jnp.float32)
+
+            step_fn = _make_level_step(
+                mesh, level_nodes, d, B, S, T, task, use_pallas, cat_arities
             )
+            agg_d, gain_d, feat_d, bin_d, split_d, catmask_d = step_fn(
+                binned_t, base_t, w_tree, pos, mask, min_inst, min_gain
+            )
+            level_out.append(
+                (agg_d, gain_d, feat_d, bin_d, split_d, catmask_d)
+            )
+            if depth < max_depth:
+                node_id = _advance_level(
+                    binned_t, node_id, pos, feat_d, bin_d, split_d,
+                    level_base, catmask_d if cat else None, cat_flags_dev,
+                )
 
     if defer_fetch:
         return DeferredForest(

@@ -19,6 +19,22 @@ only on ``x``, so both are computed ONCE and reused for every round
 (``bin_thresholds=``/``binned_t=`` fast path into ``grow_forest``), and
 the prediction column ``F`` never leaves the device between rounds.
 
+**Round fusion (default):** the whole M-round chain above is ONE jitted
+``lax.scan`` — each scan step computes the pseudo-residual, grows the
+round's tree through the engine's fused multi-level path
+(``engine._make_forest_grower``), materializes its device heap arrays
+(``device_tree_arrays``) and advances ``F`` by ``lr·tree(x)``, all
+inside the same dispatch.  A full fit issues O(1) host syncs (the
+binning sample, F₀, and ONE ``device_get`` of every round's stacked
+winner tensors at the end) instead of O(M·depth) per-level round trips;
+``fused_rounds=False`` restores the per-round deferred loop (identical
+trees — tests/test_gbt_fused.py pins the parity), and stacking
+``fused_levels=False`` on top restores the per-level dispatch loop too
+— the full pre-fusion baseline the gbt20 bench A/B times.  The
+validation-early-stop path keeps one host sync per round by design
+(Spark's runWithValidation decides on the host), but still grows each
+tree in a single fused dispatch.
+
 Losses (Spark's set): regression "squared" — pseudo-residual y − F;
 classification "logistic" on labels y∈{0,1} — F is half the log-odds
 (Spark's ±1 formulation), pseudo-residual y − σ(2F).
@@ -26,22 +42,102 @@ classification "logistic" on labels y∈{0,1} — F is half the log-odds
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from contextlib import nullcontext
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import Mesh
 
 from ...io.model_io import register_model
 from ...parallel.mesh import default_mesh
 from ..base import Estimator, Model, as_device_dataset, check_features
 from .engine import (
+    DeferredForest,
     GrownForest,
+    _bootstrap_draw,
+    _make_forest_grower,
     bin_feature_matrix,
     device_tree_arrays,
     grow_forest,
     predict_forest,
 )
+
+
+def _stage(clock, name: str):
+    """StageClock stage when a clock is attached, else a no-op context."""
+    return clock.stage(name) if clock is not None else nullcontext()
+
+
+@lru_cache(maxsize=16)
+def _make_boost_scan(
+    mesh: Mesh, d: int, B: int, max_depth: int, max_iter: int, loss: str,
+    boot: bool, rate: float, use_pallas: bool,
+    cat_arities: tuple[int, ...] | None,
+):
+    """ONE jitted executable for the whole fused boost: a ``lax.scan``
+    over all ``max_iter`` rounds whose step refreshes the pseudo-
+    residual, grows the round's tree through the engine's fused
+    multi-level grower, materializes its device heap arrays and advances
+    the margin — the tentpole dispatch of the device-resident fit.
+
+    lru-cached on the static config (the same discipline as every
+    engine factory) so repeated fits — bench timed reps, CV folds,
+    refits on fresh data of the same shape — reuse the compiled scan
+    instead of retracing a per-fit closure.  The bootstrap draw and
+    residual math are the SHARED definitions the per-round loop uses
+    (``engine._bootstrap_draw``; Spark's LogLoss pseudo-residual), so
+    fused and legacy fits stay bit-identical by construction.
+
+    → ``run(x, y, w, binned_t, f0_arr, thr_dev, is_cat_dev, seed0, lr,
+    min_inst, min_gain)`` returning ``(final_margin, stacked_levels)``
+    where ``stacked_levels`` is the per-level tuple of winner tensors
+    with a leading round axis (``DeferredForest.level_out`` per round,
+    scan-stacked)."""
+    grower = _make_forest_grower(
+        mesh, d, B, 3, 1, "regression", max_depth, cat_arities,
+        use_pallas, None,
+    )
+    any_cat = cat_arities is not None and any(a > 0 for a in cat_arities)
+    cat_flags_np = (
+        np.asarray([a > 0 for a in cat_arities], bool) if any_cat else None
+    )
+
+    def run(
+        x, y, w, binned_t, f0_arr, thr_dev, is_cat_dev, seed0, lr,
+        min_inst, min_gain,
+    ):
+        cat_flags = (
+            jnp.asarray(cat_flags_np) if cat_flags_np is not None else None
+        )
+        n_pad = w.shape[0]
+
+        def round_body(f, t):
+            if loss == "squared":
+                r = y - f
+            else:  # Spark LogLoss pseudo-residual (see _boost.residual)
+                r = 4.0 * (y - jax.nn.sigmoid(2.0 * f))
+            base_t = jnp.stack([jnp.ones_like(r), r, r * r], axis=0)
+            if boot:
+                w_tree = _bootstrap_draw(seed0 + t, rate, 1, n_pad) * w[None, :]
+            else:
+                w_tree = jnp.broadcast_to(w[None, :], (1, n_pad))
+            level_out = grower(
+                binned_t, base_t, w_tree, 0, min_inst, min_gain
+            )
+            sf, th, val, cm = device_tree_arrays(
+                level_out, thr_dev, is_cat_dev, B
+            )
+            pred = predict_forest(x, sf, th, val, cm, cat_flags)[0, :, 0]
+            return f + lr * pred, tuple(tuple(lv) for lv in level_out)
+
+        return lax.scan(round_body, f0_arr, jnp.arange(max_iter))
+
+    return jax.jit(run)
 
 
 @register_model("GBTModel")
@@ -163,6 +259,30 @@ class _GBTParams:
     # resumes mid-sequence.  Resident fits ignore it.
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
+    # Device-resident boosting: ONE jitted lax.scan over all max_iter
+    # rounds (residual refresh + tree growth + leaf advance in the same
+    # dispatch, O(1) host syncs per fit).  False restores the per-round
+    # deferred loop — identical trees, kept for parity tests and as the
+    # fallback while the fused path soaks.
+    fused_rounds: bool = True
+    # Per-round tree growth in ONE dispatch (engine fused_levels) vs the
+    # per-level dispatch loop.  Only consulted by the per-round paths
+    # (fused_rounds=False or validation fits) — the fused scan grows
+    # levels fused by construction.  fused_rounds=False + fused_levels=
+    # False together reproduce the pre-fusion (PR 4) baseline, which is
+    # what the gbt20 bench A/B times as "legacy".
+    fused_levels: bool = True
+    # Route the level histograms through the fused Pallas kernel
+    # (ops/pallas_kernels.fused_level_hist) instead of the XLA one-hot
+    # contraction — the bench A/B knob (same splits, parity-tested).
+    use_pallas: bool = False
+    # Optional utils.profiling.StageClock: the resident fit brackets its
+    # phases ("bin", "init", "boost", "fetch_materialize") so bench.py's
+    # gbt20 row can report per-stage shares.  Validation fits fold the
+    # per-round fetches into "boost" (no separate fetch_materialize).
+    # compare=False keeps the estimator's dataclass equality/hash
+    # value-based.
+    stage_clock: Any = field(default=None, compare=False, repr=False)
 
     def _resolve_validation(self, data, ds, mesh):
         """validation_indicator_col → (n_pad,) float device mask (or None),
@@ -189,6 +309,7 @@ class _GBTParams:
         from ...parallel.sharding import DeviceDataset, sample_valid_rows
         from .binning import quantile_thresholds
 
+        clock = self.stage_clock
         x = ds.x.astype(jnp.float32)
         y = ds.y.astype(jnp.float32)
         w_all = ds.w.astype(jnp.float32)
@@ -208,17 +329,22 @@ class _GBTParams:
         # binning depends only on x — thresholds AND the digitized matrix
         # are computed once and reused by every boosting round.  The
         # sampling/binning dataset carries the TRAINING weights only.
-        ds = DeviceDataset(x=x, y=y, w=w)
-        sample = sample_valid_rows(ds, self.init_sample_size, self.seed)
-        if sample.shape[0] == 0:
-            raise ValueError("GBT fit on an empty dataset")
-        thr = quantile_thresholds(sample, self.max_bins)
-        # the categorical range check covers ALL valid rows — a held-out
-        # validation row with a bad category id must raise too, not slip
-        # into every round's advance() as an "unseen category"
-        binned_t = bin_feature_matrix(x, thr, self.categorical_features, w=w_all)
+        with _stage(clock, "bin"):
+            ds = DeviceDataset(x=x, y=y, w=w)
+            sample = sample_valid_rows(ds, self.init_sample_size, self.seed)
+            if sample.shape[0] == 0:
+                raise ValueError("GBT fit on an empty dataset")
+            thr = quantile_thresholds(sample, self.max_bins)
+            # the categorical range check covers ALL valid rows — a
+            # held-out validation row with a bad category id must raise
+            # too, not slip into every round's advance() as an "unseen
+            # category"
+            binned_t = bin_feature_matrix(
+                x, thr, self.categorical_features, w=w_all
+            )
 
-        ybar = float(jax.device_get(jnp.sum(y * w) / n))
+        with _stage(clock, "init"):
+            ybar = float(jax.device_get(jnp.sum(y * w) / n))
         if loss == "squared":
             f0 = ybar
         else:  # logistic: F₀ = ½ log(p/(1−p)) (Spark's prior margin)
@@ -238,6 +364,18 @@ class _GBTParams:
         cat_flags = (
             jnp.asarray([f in cat for f in range(x.shape[1])]) if cat else None
         )
+        # shared tree-materialization state for the deferred branches
+        # below — ONE definition so fused and legacy cannot diverge
+        d_feat = x.shape[1]
+        cat_arities = (
+            tuple(cat.get(f, 0) for f in range(d_feat)) if cat else None
+        )
+        is_cat_host = np.asarray(
+            [f in cat for f in range(d_feat)] if cat
+            else np.zeros((d_feat,), bool)
+        )
+        thr_dev = jnp.asarray(thr, jnp.float32)
+        is_cat_dev = jnp.asarray(is_cat_host)
 
         @jax.jit
         def advance(f, sf, th, val, cm):
@@ -279,21 +417,67 @@ class _GBTParams:
                 binned_t=binned_t,
                 categorical_features=self.categorical_features,
                 defer_fetch=defer,
+                use_pallas=self.use_pallas,
+                fused_levels=self.fused_levels,
             )
 
-        if val_ind is None:
-            # No early stop → the WHOLE boosting chain dispatches without
-            # one host sync: each round's tree stays a device tensor
-            # (device_tree_arrays), round t+1's residuals chain off it,
-            # and every round's winner tensors are fetched in one
-            # device_get at the end.  The per-round fetch+re-upload it
-            # replaces cost more than the round's histograms on a
-            # tunneled chip (BENCH_r05 gbt20 ≈ 1× the CPU proxy).
-            thr_dev = jnp.asarray(thr, jnp.float32)
-            is_cat_dev = jnp.asarray(
-                [f in cat for f in range(x.shape[1])] if cat
-                else np.zeros((x.shape[1],), bool)
+        if val_ind is None and self.fused_rounds:
+            # Device-resident boosting (the tentpole): ONE jitted
+            # lax.scan over all M rounds — each step refreshes the
+            # pseudo-residual, grows the round's tree through the fused
+            # multi-level grower, materializes its device heap arrays
+            # and advances F, all in the SAME dispatch.  The fit's only
+            # host syncs are the binning sample, F₀, and one device_get
+            # of the stacked winner tensors at the end (O(1), not
+            # O(M·depth) — the per-level fetches measured ~70 ms each on
+            # tunneled chips; BENCH_r05 gbt20 ≈ 1× the CPU proxy).
+            run_boost = _make_boost_scan(
+                mesh, d_feat, self.max_bins, self.max_depth, self.max_iter,
+                loss, self.subsampling_rate < 1.0,
+                float(self.subsampling_rate), self.use_pallas, cat_arities,
             )
+
+            with _stage(clock, "boost"):
+                f_cur, stacked = run_boost(
+                    x, y, w, binned_t, f_cur, thr_dev, is_cat_dev,
+                    self.seed, jnp.float32(self.step_size),
+                    jnp.float32(self.min_instances_per_node),
+                    jnp.float32(self.min_info_gain),
+                )
+                if clock is not None:
+                    # attribution only (clocked fits): drain the scan so
+                    # "boost" measures device execution, not just the
+                    # enqueue — otherwise async dispatch bills the whole
+                    # compute to the fetch stage.  Uninstrumented fits
+                    # skip it and keep the minimal sync count.
+                    from ...utils.profiling import device_fence
+
+                    device_fence(f_cur)
+            with _stage(clock, "fetch_materialize"):
+                # the fit's ONE bulk host sync: every round × level
+                # winner tensor in a single device_get
+                fetched = jax.device_get(stacked)
+                template = DeferredForest(
+                    level_out=[], thr=thr, task="regression",
+                    num_classes=2, cat_arities=cat_arities,
+                    B=self.max_bins, max_depth=self.max_depth,
+                    is_cat_host=is_cat_host, T=1, d=d_feat, S=3,
+                )
+                trees = [
+                    template.fetch_from(
+                        [
+                            tuple(np.asarray(a[t]) for a in level)
+                            for level in fetched
+                        ]
+                    )
+                    for t in range(self.max_iter)
+                ]
+                importances = [g.importances[0] for g in trees]
+        elif val_ind is None:
+            # Legacy per-round deferred loop (fused_rounds=False): each
+            # round's tree stays a device tensor (device_tree_arrays),
+            # round t+1's residuals chain off it, and every round's
+            # winner tensors are fetched in one device_get at the end.
             @jax.jit
             def advance_deferred(f, level_out):
                 # device_tree_arrays already zeroes the catmask for
@@ -304,41 +488,49 @@ class _GBTParams:
                     )
                 )
 
-            deferred = []
-            for t in range(self.max_iter):
-                dfr = grow_round(t, defer=True)
-                deferred.append(dfr)
-                f_cur = advance_deferred(f_cur, dfr.level_out)
-            all_fetched = jax.device_get([d.level_out for d in deferred])
-            trees = [
-                d.fetch_from(lv) for d, lv in zip(deferred, all_fetched)
-            ]
-            importances = [g.importances[0] for g in trees]
+            with _stage(clock, "boost"):
+                deferred = []
+                for t in range(self.max_iter):
+                    dfr = grow_round(t, defer=True)
+                    deferred.append(dfr)
+                    f_cur = advance_deferred(f_cur, dfr.level_out)
+            with _stage(clock, "fetch_materialize"):
+                all_fetched = jax.device_get([d.level_out for d in deferred])
+                trees = [
+                    d.fetch_from(lv) for d, lv in zip(deferred, all_fetched)
+                ]
+                importances = [g.importances[0] for g in trees]
         else:
-            for t in range(self.max_iter):
-                grown = grow_round(t, defer=False)
-                trees.append(grown)
-                importances.append(grown.importances[0])
-                f_cur = advance(
-                    f_cur,
-                    jnp.asarray(grown.split_feat),
-                    jnp.asarray(grown.threshold),
-                    jnp.asarray(grown.value),
-                    (
-                        jnp.asarray(grown.split_catmask, jnp.uint32)
-                        if cat
-                        else jnp.zeros(grown.split_feat.shape, jnp.uint32)
-                    ),
-                )
-                # Spark runWithValidation: stop when the best-so-far
-                # held-out error stops improving by validationTol
-                # (relative to max(err, 0.01)); keep the best-M prefix.
-                err = float(jax.device_get(val_err(f_cur)))
-                if best_err - err < self.validation_tol * max(err, 0.01):
-                    break
-                if err < best_err:
-                    best_err = err
-                    best_m = t + 1
+            # Validation early stop decides continuation on the host each
+            # round, and the eager grow_round(defer=False) fetches winners
+            # inside the loop — per-round fetch and growth are inseparable
+            # here, so the whole loop bills to "boost" (no separate
+            # fetch_materialize stage on validation fits).
+            with _stage(clock, "boost"):
+                for t in range(self.max_iter):
+                    grown = grow_round(t, defer=False)
+                    trees.append(grown)
+                    importances.append(grown.importances[0])
+                    f_cur = advance(
+                        f_cur,
+                        jnp.asarray(grown.split_feat),
+                        jnp.asarray(grown.threshold),
+                        jnp.asarray(grown.value),
+                        (
+                            jnp.asarray(grown.split_catmask, jnp.uint32)
+                            if cat
+                            else jnp.zeros(grown.split_feat.shape, jnp.uint32)
+                        ),
+                    )
+                    # Spark runWithValidation: stop when the best-so-far
+                    # held-out error stops improving by validationTol
+                    # (relative to max(err, 0.01)); keep the best-M prefix.
+                    err = float(jax.device_get(val_err(f_cur)))
+                    if best_err - err < self.validation_tol * max(err, 0.01):
+                        break
+                    if err < best_err:
+                        best_err = err
+                        best_m = t + 1
             if best_m > 0:
                 trees = trees[:best_m]
                 importances = importances[:best_m]

@@ -66,6 +66,7 @@ class RandomForestRegressor(Estimator, _TreeParams):
             categorical_features=self.categorical_features,
             checkpoint_dir=self.checkpoint_dir,
             checkpoint_every=self.checkpoint_every,
+            fused_levels=self.fused_levels,
         )
         return _from_grown(RandomForestModel, grown, "regression", 2)
 
@@ -95,5 +96,6 @@ class RandomForestClassifier(Estimator, _TreeParams):
             categorical_features=self.categorical_features,
             checkpoint_dir=self.checkpoint_dir,
             checkpoint_every=self.checkpoint_every,
+            fused_levels=self.fused_levels,
         )
         return _from_grown(RandomForestModel, grown, "classification", self.num_classes)

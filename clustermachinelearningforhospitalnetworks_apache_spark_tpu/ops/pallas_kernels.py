@@ -373,8 +373,28 @@ def fused_level_hist(
     row-transposed shards, padding rows carry ``pos=-1``/``w=0``.
     → (T, level_nodes, d, B, S) float32.
 
-    Opt-in via ``grow_forest(use_pallas=True)``; interpreter mode on CPU
-    so the test mesh runs the exact kernel code path.
+    Opt-in via ``grow_forest(use_pallas=True)`` /
+    ``GBTRegressor(use_pallas=True)``; interpreter mode on CPU so the
+    test mesh runs the exact kernel code path.
+
+    **Win-or-retire decision record (PR 5, same discipline as the
+    retired Lloyd kernel above):** RETIRED to a documented opt-in
+    experiment at the tree shapes this framework hits.  Structural
+    verdict, pending contrary on-chip evidence: at the BASELINE tree
+    shape (d=8, B=32) the kernel's per-feature (LN·S, C)×(C, B) matmuls
+    run with N=B=32 of 128 MXU lanes utilized and a ``d``-step unrolled
+    store chain per grid step, while the XLA formulation contracts ONE
+    (T·LN·S, C)×(d·C·B one-hot) einsum per chunk with a deeper effective
+    M — and XLA's scan fusion already keeps the masked-stats transient
+    out of HBM (the exact mechanism that retired ``fused_lloyd_stats``
+    at k=256/d=8: 112M vs 270M rec/s/chip).  The kernel adds grid
+    overhead without cutting traffic XLA hadn't.  ADJUDICATION IS NOW
+    AUTOMATIC: every ``rf20``/``gbt20`` bench row on a TPU sweep records
+    ``tree_pallas_vs_xla`` (this kernel vs the XLA scan, >1 = kernel
+    wins) — adopt by flipping the default only after it clears 1.05 on
+    two consecutive fenced on-chip sweeps; until then the A/B rides
+    every sweep for free.  ``BENCH_TREE_PALLAS=1`` still forces the
+    HEADLINE measurement through the kernel for manual runs.
     """
     if interpret is None:
         interpret = not _on_tpu()

@@ -52,6 +52,32 @@ class StageClock:
 
 
 @contextmanager
+def host_sync_census() -> Iterator[dict]:
+    """Count blocking host↔device syncs (``jax.device_get`` calls) in the
+    enclosed scope — the transfer-counter behind the boosting-fusion
+    O(1)-syncs-per-fit contract (bench.py ``gbt20`` row,
+    tests/test_gbt_fused.py).
+
+    Wraps ``jax.device_get`` module-wide for the scope's duration, so any
+    framework code that fetches via the canonical attribute is counted
+    (the fit paths all do).  NOT thread-safe — meant for single-threaded
+    measurement scopes, not production serving.  Yields a dict whose
+    ``device_get`` entry holds the running count."""
+    counter = {"device_get": 0}
+    real = jax.device_get
+
+    def counting(*args, **kwargs):
+        counter["device_get"] += 1
+        return real(*args, **kwargs)
+
+    jax.device_get = counting
+    try:
+        yield counter
+    finally:
+        jax.device_get = real
+
+
+@contextmanager
 def trace_annotation(name: str) -> Iterator[None]:
     """Named region visible in the device trace (no-op cost when idle)."""
     with jax.profiler.TraceAnnotation(name):

@@ -1,0 +1,328 @@
+"""Device-resident boosting (PR 5): fused-round GBT parity + sync contract.
+
+The tentpole collapses a GBT fit from O(M·depth) per-level host round
+trips to O(1): ``engine._make_forest_grower`` grows every tree level in
+ONE jitted dispatch, and ``gbt._boost`` chains all M rounds through a
+single ``lax.scan`` (residual refresh + growth + leaf advance in the same
+device computation).  These tests pin the contract:
+
+- fused-round GBT == the per-round deferred loop (``fused_rounds=False``)
+  tree-for-tree — structure, thresholds, leaf values — on fixed seeds,
+  for regression, classification, and Poisson-subsampled fits;
+- the engine's fused multi-level path (``fused_levels``) == the per-level
+  loop for RF-style fits too (feature subsets, bootstrap, categoricals);
+- the out-of-core and fit-checkpoint paths still agree with the fused
+  resident result, including kill-and-resume through an injected crash
+  in the checkpoint save protocol (chaos tier);
+- a transfer census proves the fused fit's host-sync count is a small
+  constant independent of ``max_iter`` (perf tier), and the StageClock
+  instrumentation the gbt20 bench row reports stays truthful.
+
+Integer-valued features keep every histogram sum f32-exact, so split
+decisions compare bit-for-bit across paths (same trick as
+tests/test_fit_checkpoint.py)."""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+    GBTClassifier,
+    GBTRegressor,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.tree.engine import (
+    grow_forest,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
+    device_dataset,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.profiling import (
+    StageClock,
+    host_sync_census,
+)
+
+
+def _tree_data(rng, n=1500, d=5):
+    x = np.round(rng.normal(size=(n, d)) * 4).astype(np.float32)
+    y = (x @ rng.normal(size=(d,)) + rng.normal(0, 0.3, size=n)).astype(
+        np.float32
+    )
+    return x, y
+
+
+def _assert_same_model(a, b, *, value_atol=0.0):
+    """Same trees: structure, thresholds, leaf values, importances, F0."""
+    np.testing.assert_array_equal(a.split_feat, b.split_feat)
+    np.testing.assert_array_equal(a.threshold, b.threshold)
+    if value_atol:
+        np.testing.assert_allclose(a.value, b.value, atol=value_atol)
+        np.testing.assert_allclose(a.init, b.init, rtol=1e-5)
+    else:
+        np.testing.assert_array_equal(a.value, b.value)
+        assert a.init == b.init
+    np.testing.assert_allclose(
+        a.feature_importances, b.feature_importances, atol=1e-6
+    )
+
+
+# ======================================================= fused-round parity
+def test_fused_rounds_regression_identical_trees(rng, mesh8):
+    x, y = _tree_data(rng)
+    ds = device_dataset(x, y, mesh=mesh8)
+    base = dict(max_iter=6, max_depth=3, seed=0)
+    fused = GBTRegressor(**base).fit(ds, mesh=mesh8)
+    legacy = GBTRegressor(fused_rounds=False, **base).fit(ds, mesh=mesh8)
+    # the full pre-fusion baseline (per-round loop + per-level dispatches)
+    # — the leg the gbt20 bench A/B times as "legacy"
+    prefusion = GBTRegressor(
+        fused_rounds=False, fused_levels=False, **base
+    ).fit(ds, mesh=mesh8)
+    _assert_same_model(fused, legacy)
+    _assert_same_model(fused, prefusion)
+    pred_f = np.asarray(fused.predict_numpy(x[:128]))
+    pred_l = np.asarray(legacy.predict_numpy(x[:128]))
+    np.testing.assert_array_equal(pred_f, pred_l)
+
+
+def test_fused_rounds_classification_identical_trees(rng, mesh8):
+    x, _ = _tree_data(rng)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    ds = device_dataset(x, y, mesh=mesh8)
+    base = dict(max_iter=5, max_depth=2, seed=1)
+    fused = GBTClassifier(**base).fit(ds, mesh=mesh8)
+    legacy = GBTClassifier(fused_rounds=False, **base).fit(ds, mesh=mesh8)
+    _assert_same_model(fused, legacy)
+
+
+def test_fused_rounds_categorical_identical_trees(rng, mesh8):
+    """The boost scan's categorical branch (cat_flags + per-round catmask
+    threading through device_tree_arrays/predict_forest) lands on the
+    same trees as the per-round deferred loop — the one fused path the
+    continuous-data parity tests above cannot pin."""
+    x, _ = _tree_data(rng, n=1200, d=4)
+    x[:, 2] = rng.integers(0, 6, x.shape[0]).astype(np.float32)
+    # non-monotone category effect → only an unordered SET split captures it
+    y = (
+        0.2 * x[:, 0]
+        + np.where(np.isin(x[:, 2], (1.0, 4.0)), 6.0, 0.0)
+        + rng.normal(0, 0.1, x.shape[0])
+    ).astype(np.float32)
+    ds = device_dataset(x, y, mesh=mesh8)
+    base = dict(
+        max_iter=5, max_depth=3, seed=4, categorical_features={2: 6}
+    )
+    fused = GBTRegressor(**base).fit(ds, mesh=mesh8)
+    legacy = GBTRegressor(fused_rounds=False, **base).fit(ds, mesh=mesh8)
+    _assert_same_model(fused, legacy)
+    np.testing.assert_array_equal(fused.split_catmask, legacy.split_catmask)
+    assert (fused.split_catmask > 0).any(), "fit never took a set split"
+    pred_f = np.asarray(fused.predict_numpy(x[:128]))
+    pred_l = np.asarray(legacy.predict_numpy(x[:128]))
+    np.testing.assert_array_equal(pred_f, pred_l)
+
+
+def test_fused_rounds_subsampled_identical_trees(rng, mesh8):
+    """Poisson bootstrap inside the scan draws the SAME per-round weights
+    as the legacy loop's _make_bootstrap(seed + t) — key-stream parity."""
+    x, y = _tree_data(rng)
+    ds = device_dataset(x, y, mesh=mesh8)
+    base = dict(max_iter=4, max_depth=2, seed=2, subsampling_rate=0.7)
+    fused = GBTRegressor(**base).fit(ds, mesh=mesh8)
+    legacy = GBTRegressor(fused_rounds=False, **base).fit(ds, mesh=mesh8)
+    _assert_same_model(fused, legacy)
+
+
+# =================================================== fused-level engine path
+def test_fused_levels_forest_parity_with_subsets(rng, mesh8):
+    """RF shape: feature subsets + bootstrap — the fused grower's
+    rank-of-uniform draw must replicate _make_subset_mask's stream."""
+    x, y = _tree_data(rng, n=1200, d=4)
+    ds = device_dataset(x, y, mesh=mesh8)
+    kw = dict(
+        task="regression", num_trees=4, max_depth=4, feature_subset_size=2,
+        bootstrap=True, subsampling_rate=0.8, seed=3, mesh=mesh8,
+    )
+    fused = grow_forest(ds, fused_levels=True, **kw)
+    legacy = grow_forest(ds, fused_levels=False, **kw)
+    np.testing.assert_array_equal(fused.split_feat, legacy.split_feat)
+    np.testing.assert_array_equal(fused.split_bin, legacy.split_bin)
+    np.testing.assert_array_equal(fused.threshold, legacy.threshold)
+    np.testing.assert_array_equal(fused.value, legacy.value)
+    np.testing.assert_allclose(
+        fused.importances, legacy.importances, atol=1e-7
+    )
+
+
+def test_fused_levels_forest_parity_categorical(rng, mesh8):
+    """Unordered-set categorical splits route identically through the
+    fused grower (catmask threading into _advance_level)."""
+    x, _ = _tree_data(rng, n=1000, d=4)
+    x[:, 1] = rng.integers(0, 5, x.shape[0]).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] >= 3)).astype(np.float32)
+    ds = device_dataset(x, y, mesh=mesh8)
+    kw = dict(
+        task="classification", num_classes=2, num_trees=2, max_depth=3,
+        categorical_features={1: 5}, seed=0, mesh=mesh8,
+    )
+    fused = grow_forest(ds, fused_levels=True, **kw)
+    legacy = grow_forest(ds, fused_levels=False, **kw)
+    np.testing.assert_array_equal(fused.split_feat, legacy.split_feat)
+    np.testing.assert_array_equal(fused.split_catmask, legacy.split_catmask)
+    np.testing.assert_array_equal(fused.value, legacy.value)
+
+
+def test_estimator_fused_levels_flag_round_trips(rng, mesh8):
+    """The _TreeParams knob reaches the engine: both settings produce the
+    same RF model (parity), and out-of-core fits accept the flag (it is
+    dropped — streaming levels are inherently per-level passes)."""
+    x, y = _tree_data(rng, n=900, d=4)
+    rf = dict(num_trees=3, max_depth=3, seed=0,
+              feature_subset_strategy="all")
+    m_f = ht.RandomForestRegressor(fused_levels=True, **rf).fit(
+        (x, y), mesh=mesh8
+    )
+    m_l = ht.RandomForestRegressor(fused_levels=False, **rf).fit(
+        (x, y), mesh=mesh8
+    )
+    np.testing.assert_array_equal(m_f.split_feat, m_l.split_feat)
+    np.testing.assert_array_equal(m_f.value, m_l.value)
+    hd = ht.HostDataset(x=x, y=y, max_device_rows=256)
+    m_ooc = ht.DecisionTreeRegressor(
+        max_depth=3, seed=0, fused_levels=True
+    ).fit(hd, mesh=mesh8)
+    m_res = ht.DecisionTreeRegressor(max_depth=3, seed=0).fit(
+        (x, y), mesh=mesh8
+    )
+    np.testing.assert_array_equal(m_ooc.split_feat, m_res.split_feat)
+
+
+# ===================================== out-of-core / checkpoint consistency
+#: base GBT config shared by the out-of-core consistency + chaos tests
+_OOC_BASE = dict(max_iter=4, max_depth=2, seed=0)
+
+
+@pytest.fixture(scope="module")
+def ooc_case(mesh8):
+    """One out-of-core reference fit + one fused resident fit, shared by
+    the consistency check and both chaos kill sites (the streamed-block
+    fits are the slow part of this file — compute each exactly once)."""
+    x, y = _tree_data(np.random.default_rng(0), n=1000, d=4)
+    hd = ht.HostDataset(x=x, y=y, max_device_rows=256)
+    uninterrupted = GBTRegressor(**_OOC_BASE).fit(hd, mesh=mesh8)
+    fused = GBTRegressor(**_OOC_BASE).fit((x, y), mesh=mesh8)
+    return x, y, uninterrupted, fused
+
+
+def test_outofcore_gbt_matches_fused_resident(ooc_case):
+    """The streaming (HostDataset) boost — per-round, per-level passes —
+    lands on the same trees as the fused device-resident fit."""
+    _, _, ooc, fused = ooc_case
+    _assert_same_model(ooc, fused, value_atol=1e-6)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "site", ["fit_ckpt.save.arrays", "fit_ckpt.save.commit"]
+)
+def test_gbt_checkpoint_kill_and_resume_matches_fused(
+    tmp_path, mesh8, ooc_case, site,
+):
+    """Kill a checkpointed out-of-core GBT boost inside the save protocol
+    (before / at the commit point); the resumed fit must land on EXACTLY
+    the uninterrupted out-of-core model, which itself matches the fused
+    device-resident fit — the chaos leg of the round-fusion parity gate
+    (tools/run_chaos.sh runs this)."""
+    x, y, uninterrupted, fused = ooc_case
+    hd = ht.HostDataset(x=x, y=y, max_device_rows=256)
+    base = _OOC_BASE
+
+    ckdir = str(tmp_path / "gbt_ck")
+    est = GBTRegressor(checkpoint_dir=ckdir, checkpoint_every=1, **base)
+    plan = faults.FaultPlan().crash(site, after=1)  # die on round-1's save
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            est.fit(hd, mesh=mesh8)
+    assert plan.fired(site) == 1
+
+    resumed = est.fit(hd, mesh=mesh8)
+    _assert_same_model(resumed, uninterrupted, value_atol=1e-7)
+    _assert_same_model(resumed, fused, value_atol=1e-6)
+
+
+# ============================================================ sync contract
+@pytest.mark.perf
+def test_fused_fit_host_syncs_constant_in_rounds(rng, mesh8):
+    """The O(1)-syncs-per-fit contract: the transfer census over a fused
+    fit counts the SAME small number of blocking device_get calls at
+    M=3 and M=9 — not O(M·depth) per-level fetches."""
+    x, y = _tree_data(rng, n=1000, d=4)
+    ds = device_dataset(x, y, mesh=mesh8)
+
+    def syncs(m):
+        est = GBTRegressor(max_iter=m, max_depth=3, seed=0)
+        est.fit(ds, mesh=mesh8)          # warm-up outside the census
+        with host_sync_census() as census:
+            est.fit(ds, mesh=mesh8)
+        return census["device_get"]
+
+    s3, s9 = syncs(3), syncs(9)
+    assert s3 == s9, f"sync count grew with rounds: M=3→{s3}, M=9→{s9}"
+    assert s3 <= 6, f"fused fit made {s3} host syncs; expected O(1) ≤ 6"
+    assert s9 < 9 * 4, "sync count is not below the per-level O(M·depth) bar"
+
+
+@pytest.mark.perf
+def test_stage_clock_brackets_fused_fit(rng, mesh8):
+    """The gbt20 bench row's per-stage shares come from this plumbing:
+    one entry per stage per fit, shares normalized over the fit."""
+    x, y = _tree_data(rng, n=800, d=4)
+    ds = device_dataset(x, y, mesh=mesh8)
+    clock = StageClock()
+    GBTRegressor(max_iter=4, max_depth=2, seed=0, stage_clock=clock).fit(
+        ds, mesh=mesh8
+    )
+    assert set(clock.seconds) == {"bin", "init", "boost", "fetch_materialize"}
+    assert all(c == 1 for c in clock.counts.values())
+    shares = clock.shares()
+    assert abs(sum(shares.values()) - 1.0) < 1e-9
+    assert shares["boost"] > 0.0
+
+
+# ============================================================ bench schema
+def _load_bench():
+    path = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.perf
+def test_bench_roofline_fields_schema():
+    """The roofline helpers behind the gbt20/rf20/gmm32/nb rows: every
+    bound reports pct_of_roofline + its formula note, and the GBT bytes
+    bound scales with rounds × levels (the quantity fusion cannot cut)."""
+    bench = _load_bench()
+    hist = bench._hist_bytes_roofline(
+        1e4, T=1, depth=3, d=8, S=3, rounds=20, device_kind="cpu-proxy"
+    )
+    assert {"pct_of_roofline", "hist_bytes_per_row_fit",
+            "hist_hbm_bound_rows_per_s_chip", "roofline_note"} <= set(hist)
+    assert hist["hist_bytes_per_row_fit"] == 20 * 4 * 4.0 * (8 + 3 + 2)
+    rf = bench._hist_bytes_roofline(
+        1e5, T=20, depth=5, d=8, S=3, rounds=1, device_kind="cpu-proxy"
+    )
+    assert rf["hist_bytes_per_row_fit"] == 6 * 4.0 * (8 + 3 + 40)
+    gmm = bench._gmm_roofline(1e4, 32, 8, "highest", "cpu-proxy")
+    assert {"pct_of_roofline", "achieved_tflops",
+            "mxu_dlimited_bound_tflops"} <= set(gmm)
+    nb = bench._nb_bytes_roofline(1e6, 32, "cpu-proxy")
+    assert nb["bytes_per_row"] == 4.0 * 33
+    assert nb["pct_of_roofline"] > 0
+    # the fused_stats A/B rides the default watch list (VERDICT r5 #4)
+    assert "kmeans_fused_ab" in bench.CONFIGS
+    assert "kmeans_fused_ab" in bench._TPU_PRIORITY

@@ -11,9 +11,12 @@
 # thread), torn WAL writes at exact byte offsets (wal.append),
 # fit-checkpoint commit protocol (fit_ckpt.*), model artifact save/swap
 # (model_io.save.*), source IO retries (source.read_file), serving
-# faults (serve.predict), and the data-corruption kinds at the ingest
+# faults (serve.predict), the data-corruption kinds at the ingest
 # text boundary (ingest.csv_text: mangle_field / shuffle_columns /
-# unit_scale / nan_burst — the chaos half of tests/test_quality.py).
+# unit_scale / nan_burst — the chaos half of tests/test_quality.py),
+# and the GBT fit-checkpoint path (tests/test_gbt_fused.py kills the
+# out-of-core boost inside the save protocol and asserts the resumed
+# model equals the fused device-resident fit).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -24,7 +27,7 @@ fi
 
 LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
-    tests/test_stream_pipeline.py \
+    tests/test_stream_pipeline.py tests/test_gbt_fused.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -39,7 +42,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused)\.py::(\S+)",
         line,
     )
     if not m:
