@@ -1,0 +1,301 @@
+"""Fuzz parity harness: compiled executor ≡ numpy interpreter.
+
+ISSUE 7 satellite: generate random queries over random tables inside the
+compiled subset's grammar, run each on BOTH executors
+(``execute(mode="interpret")`` vs ``execute(mode="compile")``), and
+assert identical results — column names/order, row counts, dtype kinds,
+null masks exactly; float values to 1e-9 relative (both paths compute in
+float64, so the slack only absorbs reduction-order differences).
+
+A mismatching query is **shrunk** before being reported: select items,
+predicate branches, and group keys are removed one at a time while the
+mismatch persists, so the failure message carries a minimal repro query
+instead of a 7-item monster.
+
+Queries are built from a small spec tree (dicts/tuples) and rendered to
+SQL, which is what makes shrinking structural rather than textual.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from .table import Table
+
+_NUM_COLS = ("f1", "f2", "i1", "i2")
+_FLOAT_COLS = ("f1", "f2")
+_TS_COL = "t1"
+_EPOCH = np.datetime64("2025-03-31T22:00:00")
+
+
+def random_table(rng: np.random.Generator, n_rows: int | None = None) -> Table:
+    """Numeric + timestamp + string columns with nulls where the dtype
+    can hold them (NaN floats, NaT timestamps)."""
+    n = int(rng.integers(0, 400)) if n_rows is None else n_rows
+    f1 = rng.normal(size=n) * 10
+    f1[rng.random(n) < 0.15] = np.nan
+    f2 = rng.gamma(2.0, 2.0, size=n)
+    f2[rng.random(n) < 0.05] = np.nan
+    t1 = (
+        _EPOCH + rng.integers(0, 7200, size=n).astype("timedelta64[s]")
+    ).astype("datetime64[ns]")
+    t1[rng.random(n) < 0.1] = np.datetime64("NaT")
+    return Table.from_dict(
+        {
+            "f1": f1,
+            "f2": f2,
+            "i1": rng.integers(-3, 4, size=n),
+            "i2": rng.integers(0, 100, size=n),
+            "t1": t1,
+            "s1": np.array(
+                [f"H{int(v)}" for v in rng.integers(0, 3, size=n)], object
+            ),
+        }
+    )
+
+
+# ------------------------------------------------------------ spec model
+@dataclass(frozen=True)
+class QuerySpec:
+    kind: str                 # "rowlevel" | "aggregate" | "window"
+    items: tuple              # rendered select-item SQL fragments
+    where: tuple | None       # cond spec tree
+    group: tuple = ()         # group-key column names (aggregate)
+    limit: int | None = None
+
+    def sql(self) -> str:
+        parts = ["SELECT ", ", ".join(self.items), " FROM fuzz"]
+        if self.where is not None:
+            parts += [" WHERE ", _render_cond(self.where)]
+        if self.group:
+            parts += [" GROUP BY ", ", ".join(self.group)]
+        if self.limit is not None:
+            parts += [f" LIMIT {self.limit}"]
+        return "".join(parts)
+
+
+def _lit(rng, col: str) -> str:
+    if col == _TS_COL:
+        off = int(rng.integers(0, 7200))
+        ts = (_EPOCH + np.timedelta64(off, "s")).astype("datetime64[s]")
+        return "'" + str(ts).replace("T", " ") + "'"
+    if col.startswith("i"):
+        return str(int(rng.integers(-5, 105)))
+    v = float(np.round(rng.normal() * 8, 3))
+    return repr(v)
+
+
+def _random_cond(rng, depth: int = 2) -> tuple:
+    roll = rng.random()
+    if depth > 0 and roll < 0.35:
+        op = "AND" if rng.random() < 0.5 else "OR"
+        a = _random_cond(rng, depth - 1)
+        b = _random_cond(rng, depth - 1)
+        node = ("bool", op, a, b)
+        return ("not", node) if rng.random() < 0.15 else node
+    col = str(rng.choice(_NUM_COLS + (_TS_COL,)))
+    kind = rng.random()
+    if kind < 0.15:
+        neg = "NOT " if rng.random() < 0.5 else ""
+        return ("leaf", f"{col} IS {neg}NULL")
+    if kind < 0.3:
+        lo, hi = sorted([_lit(rng, col), _lit(rng, col)])
+        return ("leaf", f"{col} BETWEEN {lo} AND {hi}")
+    if kind < 0.45 and col != _TS_COL:
+        vals = ", ".join(_lit(rng, col) for _ in range(int(rng.integers(1, 4))))
+        neg = "NOT " if rng.random() < 0.3 else ""
+        return ("leaf", f"{col} {neg}IN ({vals})")
+    op = str(rng.choice(["=", "!=", "<", "<=", ">", ">="]))
+    return ("leaf", f"{col} {op} {_lit(rng, col)}")
+
+
+def _render_cond(c) -> str:
+    if c[0] == "leaf":
+        return c[1]
+    if c[0] == "not":
+        return f"NOT ({_render_cond(c[1])})"
+    _, op, a, b = c
+    return f"({_render_cond(a)} {op} {_render_cond(b)})"
+
+
+def _random_expr(rng, depth: int = 2) -> str:
+    roll = rng.random()
+    if depth == 0 or roll < 0.35:
+        return str(rng.choice(_NUM_COLS))
+    if roll < 0.45:
+        return _lit(rng, str(rng.choice(("i1", "f1"))))
+    if roll < 0.55:
+        return f"abs({_random_expr(rng, depth - 1)})"
+    if roll < 0.62:
+        return f"coalesce({rng.choice(_FLOAT_COLS)}, {_random_expr(rng, depth - 1)})"
+    if roll < 0.72:
+        cond = _render_cond(_random_cond(rng, 1))
+        a = _random_expr(rng, depth - 1)
+        b = _random_expr(rng, depth - 1)
+        tail = f" ELSE {b} END" if rng.random() < 0.8 else " END"
+        return f"CASE WHEN {cond} THEN {a}{tail}"
+    op = str(rng.choice(["+", "-", "*", "/"]))
+    return f"({_random_expr(rng, depth - 1)} {op} {_random_expr(rng, depth - 1)})"
+
+
+def random_query(rng: np.random.Generator) -> QuerySpec:
+    shape = rng.random()
+    where = _random_cond(rng) if rng.random() < 0.7 else None
+    if shape < 0.45:  # row-level projection
+        n_items = int(rng.integers(1, 4))
+        items = []
+        for j in range(n_items):
+            if rng.random() < 0.4:
+                items.append(str(rng.choice(_NUM_COLS + (_TS_COL, "s1"))))
+            else:
+                items.append(f"{_random_expr(rng)} AS e{j}")
+        items = list(dict.fromkeys(items))  # duplicate bare columns drop
+        limit = int(rng.integers(1, 50)) if rng.random() < 0.2 else None
+        return QuerySpec("rowlevel", tuple(items), where, limit=limit)
+    if shape < 0.8:  # aggregate
+        n_keys = int(rng.integers(0, 3))
+        keys = tuple(
+            dict.fromkeys(
+                str(rng.choice(_NUM_COLS + (_TS_COL,)))
+                for _ in range(n_keys)
+            )
+        )
+        items = list(keys)
+        for j in range(int(rng.integers(1, 4))):
+            agg = str(rng.choice(["count", "sum", "avg", "min", "max"]))
+            src = "*" if agg == "count" and rng.random() < 0.3 else str(
+                rng.choice(_NUM_COLS)
+            )
+            items.append(f"{agg}({src}) AS a{j}")
+        return QuerySpec("aggregate", tuple(items), where, group=keys)
+    # whole-partition window
+    agg = str(rng.choice(["count", "sum", "avg", "min", "max"]))
+    src = str(rng.choice(_NUM_COLS))
+    parts = ", ".join(
+        dict.fromkeys(
+            str(rng.choice(_NUM_COLS)) for _ in range(int(rng.integers(1, 3)))
+        )
+    )
+    items = (src, f"{agg}({src}) OVER (PARTITION BY {parts}) AS w0")
+    return QuerySpec("window", items, where)
+
+
+# ------------------------------------------------------------ the check
+def compare_tables(ti: Table, tc: Table) -> str | None:
+    """None when equal under the pinned semantics; else a description."""
+    if list(ti.columns) != list(tc.columns):
+        return f"columns {list(ti.columns)} != {list(tc.columns)}"
+    if len(ti) != len(tc):
+        return f"row count {len(ti)} != {len(tc)}"
+    for c in ti.columns:
+        vi, vc = ti.column(c), tc.column(c)
+        if vi.dtype.kind != vc.dtype.kind:
+            return f"column {c!r} dtype {vi.dtype} != {vc.dtype}"
+        if vi.dtype.kind == "f":
+            if not np.array_equal(np.isnan(vi), np.isnan(vc)):
+                return f"column {c!r} null masks differ"
+            if not np.allclose(vi, vc, rtol=1e-9, atol=1e-12, equal_nan=True):
+                return f"column {c!r} values differ: {vi[:5]} vs {vc[:5]}"
+        elif vi.dtype.kind == "M":
+            # NaT != NaT: compare null masks and the non-null values
+            ni, nc = np.isnat(vi), np.isnat(vc)
+            if not np.array_equal(ni, nc):
+                return f"column {c!r} null masks differ"
+            if not np.array_equal(vi[~ni], vc[~nc]):
+                return f"column {c!r} values differ: {vi[:5]} vs {vc[:5]}"
+        else:
+            if not np.array_equal(vi, vc):
+                return f"column {c!r} values differ: {vi[:5]} vs {vc[:5]}"
+    return None
+
+
+def check_spec(spec: QuerySpec, table: Table) -> str | None:
+    """Run one spec on both executors.  → None (parity), a mismatch
+    description, or None-with-skip when the plan legitimately falls back
+    (the generator aims inside the subset, but e.g. a string projection
+    item next to GROUP BY may step out)."""
+    from .sql import SqlCompileUnsupported, execute
+
+    q = spec.sql()
+
+    def resolve(_name: str) -> Table:
+        return table
+
+    try:
+        tc = execute(q, resolve, mode="compile")
+    except SqlCompileUnsupported:
+        return None  # legitimate fallback — not a parity case
+    except Exception as e:  # compiled crash where interpreter works IS a bug
+        try:
+            execute(q, resolve, mode="interpret")
+        except Exception:
+            return None  # both raise: error parity (messages may differ)
+        return f"compiled path raised {type(e).__name__}: {e}"
+    try:
+        ti = execute(q, resolve, mode="interpret")
+    except Exception as e:
+        return f"interpreter raised {type(e).__name__}: {e} (compiled ran)"
+    return compare_tables(ti, tc)
+
+
+def _shrink_candidates(spec: QuerySpec):
+    """Structurally smaller specs, most aggressive first."""
+    if spec.where is not None:
+        yield replace(spec, where=None)
+        c = spec.where
+        if c[0] == "bool":
+            yield replace(spec, where=c[2])
+            yield replace(spec, where=c[3])
+        elif c[0] == "not":
+            yield replace(spec, where=c[1])
+    if spec.limit is not None:
+        yield replace(spec, limit=None)
+    if len(spec.items) > 1:
+        for k in range(len(spec.items)):
+            kept = spec.items[:k] + spec.items[k + 1 :]
+            if spec.kind == "aggregate":
+                # keep the items/keys relationship coherent: dropping a
+                # key item drops the key too
+                dropped = spec.items[k]
+                group = tuple(g for g in spec.group if g != dropped)
+                if not any(it not in group for it in kept):
+                    continue  # would leave keys only — not a valid list
+                yield replace(spec, items=kept, group=group)
+            else:
+                yield replace(spec, items=kept)
+
+
+def shrink(spec: QuerySpec, table: Table, max_steps: int = 200) -> QuerySpec:
+    """Greedy minimization: keep applying the first still-failing
+    reduction until none applies."""
+    steps = 0
+    while steps < max_steps:
+        for cand in _shrink_candidates(spec):
+            if check_spec(cand, table):
+                spec = cand
+                steps += 1
+                break
+        else:
+            return spec
+    return spec
+
+
+def run_fuzz(
+    n_queries: int = 40, seed: int = 0, n_rows: int | None = None
+) -> list[tuple[str, str]]:
+    """→ list of (minimal_query_sql, mismatch) — empty means parity is
+    green across the sampled subset."""
+    rng = np.random.default_rng(seed)
+    failures: list[tuple[str, str]] = []
+    table = random_table(rng, n_rows)
+    for i in range(n_queries):
+        if i and i % 10 == 0:
+            table = random_table(rng, n_rows)  # fresh data periodically
+        spec = random_query(rng)
+        bad = check_spec(spec, table)
+        if bad:
+            small = shrink(spec, table)
+            failures.append((small.sql(), check_spec(small, table) or bad))
+    return failures

@@ -12,7 +12,7 @@ work happens *after* the table is lowered to a sharded ``jax.Array`` via
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Mapping, Sequence
 
 import numpy as np
@@ -37,6 +37,14 @@ def _coerce(values: Any, f: Field) -> np.ndarray:
 class Table:
     schema: Schema
     columns: dict[str, np.ndarray]
+    # device-column cache (ISSUE 7): (name, row_bucket) → committed
+    # jax.Array, filled lazily by the compiled SQL executor so repeated
+    # queries over the same snapshot never re-transfer a column.  Not
+    # part of the value (compare=False); sound because Table is
+    # immutable — every relational op builds a NEW Table.
+    _device_cache: dict = field(
+        default_factory=dict, compare=False, repr=False
+    )
 
     # ------------------------------------------------------------- basics
     def __post_init__(self) -> None:
@@ -306,6 +314,55 @@ class Table:
         if not names:
             return np.empty((len(self), 0), dtype=dtype)
         return np.stack([self.columns[n].astype(dtype) for n in names], axis=1)
+
+    def device_column(self, name: str, bucket: int):
+        """The column as a device-resident array padded to ``bucket`` rows
+        (the compiled SQL executor's power-of-two row buckets), cached per
+        (name, bucket) so steady-state reruns of a query over this
+        snapshot transfer nothing.
+
+        Device representation (``core/sql_compile.py`` contract, x64):
+        float → float64 (NaN null), int/bool → int64 (null-free),
+        timestamp → int64 nanoseconds (NaT keeps its int64 sentinel).
+        Pad rows are zeros — every kernel masks by the true row count, so
+        their value is inert.  String/object columns never transfer.
+        """
+        key = (name, int(bucket))
+        arr = self._device_cache.get(key)
+        if arr is None:
+            import jax
+            from jax.experimental import enable_x64
+
+            col = self.columns[name]
+            k = col.dtype.kind
+            if k == "f":
+                host = np.zeros(bucket, np.float64)
+                host[: len(col)] = col
+            elif k in "iub":
+                host = np.zeros(bucket, np.int64)
+                host[: len(col)] = col
+            elif k == "M":
+                host = np.zeros(bucket, np.int64)
+                host[: len(col)] = col.astype("datetime64[ns]").view(np.int64)
+            else:
+                raise TypeError(
+                    f"column {name!r} ({col.dtype}) has no device "
+                    "representation — string columns stay on the host"
+                )
+            with enable_x64():
+                arr = jax.device_put(host)
+            self._device_cache[key] = arr
+        return arr
+
+    def device_cache_info(self) -> dict:
+        """Observability: cached (column, bucket) entries and their total
+        device bytes — the bench's no-re-transfer evidence."""
+        return {
+            "entries": sorted(
+                (n, b) for (n, b) in self._device_cache
+            ),
+            "bytes": int(sum(a.nbytes for a in self._device_cache.values())),
+        }
 
     def to_device(
         self,

@@ -48,6 +48,65 @@ class VectorAssembler:
             output_col=self.output_col,
         )
 
+    def transform_device(
+        self,
+        view,
+        label_col: str | None = None,
+        mesh=None,
+        na_drop: bool = True,
+        compact: bool = False,
+    ):
+        """Fused assembly (ISSUE 7): a compiled row-level query result
+        (:class:`~..core.sql_compile.DeviceView`) → a mesh-ready
+        :class:`~..parallel.sharding.DeviceDataset` WITHOUT touching the
+        host.  The filter mask becomes the validity weight column and
+        ``na_drop`` folds Spark's ``na.drop()`` over the feature/label
+        columns into the same kernel (invalid rows stay in place, zeroed,
+        weight 0 — the pad-and-weight training contract), so the
+        SQL-window → assemble → fit chain never round-trips through
+        host numpy.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..core.schema import LABEL_COL
+        from ..parallel.mesh import DATA_AXIS, default_mesh
+        from ..parallel.sharding import DeviceDataset
+
+        if label_col is None and LABEL_COL in view.out_names:
+            label_col = LABEL_COL
+        x, y, w = view.assemble(
+            self.input_cols, label_col=label_col, na_drop=na_drop
+        )
+        if compact:
+            # OPT-IN (decision record): one O(1) host sync (the
+            # valid-row count) plus an on-device gather moves the valid
+            # rows into their own power-of-two bucket, so a highly
+            # selective filter's fit stops paying for masked-out rows.
+            # Default OFF: on the CPU proxy the gather costs more than
+            # it saves (XLA:CPU scatter 74 ms / searchsorted 39 ms for a
+            # 524k→262k compaction vs ~20 ms of fit savings at d=4).
+            # Adjudication rule, PR 5 style: flip the default if a
+            # fenced TPU sweep shows compact=True ≥1.05× end-to-end on
+            # `bench.py sql_device` at ≤50% selectivity.
+            from ..core.sql_compile import bucket_for_rows, compact_dataset
+
+            n_valid = int(float(jax.device_get((w > 0).sum())))
+            out_bucket = bucket_for_rows(max(n_valid, 1))
+            if out_bucket < x.shape[0]:
+                x, y, w = compact_dataset(x, y, w, out_bucket)
+        mesh = mesh or default_mesh()
+        if mesh.size > 1 and x.shape[0] % mesh.shape[DATA_AXIS] == 0:
+            # power-of-two bucket, power-of-two data axis: the bucket is
+            # already divisible, so this is a pure device-to-device
+            # resharding (no host round trip)
+            row = NamedSharding(mesh, P(DATA_AXIS))
+            mat = NamedSharding(mesh, P(DATA_AXIS, None))
+            x = jax.device_put(x, mat)
+            y = jax.device_put(y, row)
+            w = jax.device_put(w, row)
+        return DeviceDataset(x=x, y=y, w=w)
+
 
 @dataclass(frozen=True)
 class AssembledTable:

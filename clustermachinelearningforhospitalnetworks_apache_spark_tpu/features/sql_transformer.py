@@ -51,14 +51,7 @@ class SQLTransformer:
     def from_artifacts(cls, params, arrays):
         return cls(statement=params["statement"])
 
-    def transform(self, table: Table) -> Table:
-        from ..core.sql import execute
-
-        if not isinstance(table, Table):
-            raise TypeError(
-                f"SQLTransformer transforms a Table; got {type(table).__name__}"
-            )
-
+    def _resolver(self, table: Table):
         def resolve(name: str) -> Table:
             if name == "__this__":
                 return table
@@ -69,4 +62,29 @@ class SQLTransformer:
                 f"{sorted(self.tables) or 'no extra tables'}"
             )
 
-        return execute(self.statement.replace(_THIS, "__this__"), resolve)
+        return resolve
+
+    def transform(self, table: Table) -> Table:
+        """Runs through ``core.sql.execute``'s dispatcher (ISSUE 7): the
+        canonical SQLTransformer shapes — ``SELECT *, (v1 + v2) AS v3
+        FROM __THIS__`` star-plus arithmetic, numeric filters — lower to
+        the compiled XLA executor; statements outside the subset fall
+        back to the interpreter (``explain`` shows which per node)."""
+        from ..core.sql import execute
+
+        if not isinstance(table, Table):
+            raise TypeError(
+                f"SQLTransformer transforms a Table; got {type(table).__name__}"
+            )
+        return execute(
+            self.statement.replace(_THIS, "__this__"), self._resolver(table)
+        )
+
+    def explain(self, table: Table) -> dict:
+        """Planner view of this stage's statement against ``table`` —
+        route, fingerprint, per-node supported/fallback decisions."""
+        from ..core.sql import explain
+
+        return explain(
+            self.statement.replace(_THIS, "__this__"), self._resolver(table)
+        )

@@ -104,14 +104,28 @@ def _run(
         if drain_stream:
             query.process_available()
 
-    # §5: training window (the reference's exact SQL shape, :123-128)
+    # §5: training window (the reference's exact SQL shape, :123-128) —
+    # routed through the split engine's dispatcher: this plan is inside
+    # the compiled subset (scan → timestamp BETWEEN filter → star
+    # projection), so the predicate runs as a jitted columnar kernel
+    # over device-held columns (ISSUE 7; the route is logged so a
+    # regression to the interpreter is visible in pipeline output)
+    window_query = (
+        f"SELECT * FROM {cfg.output_table} WHERE event_time BETWEEN "
+        f"'{cfg.training_window_start}' AND '{cfg.training_window_end}'"
+    )
     with metrics.stage("window"):
-        training_df = spark.sql(
-            f"SELECT * FROM {cfg.output_table} WHERE event_time BETWEEN "
-            f"'{cfg.training_window_start}' AND '{cfg.training_window_end}'"
-        ).na_drop()
+        training_df = spark.sql(window_query).na_drop()
     n_rows = training_df.num_rows
-    log.info("training window extracted", rows=n_rows)
+    from ..core import sql as _sql
+
+    _disp = _sql.last_dispatch()
+    log.info(
+        "training window extracted",
+        rows=n_rows,
+        sql_route=_disp.route if _disp else "unknown",
+        sql_fallback=list(_disp.reasons) if _disp else [],
+    )
     if n_rows < 10:
         raise ValueError(
             f"training window has only {n_rows} rows; check input_path/"

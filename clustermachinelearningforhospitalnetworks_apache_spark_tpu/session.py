@@ -120,10 +120,79 @@ class Session:
         """SQL over registered tables (``core/sql.py``) — a real parsed
         subset, not just the reference's windowed SELECT (:123-128):
         projections, aggregates (COUNT/SUM/AVG/MIN/MAX), WHERE with
-        AND/OR/BETWEEN/comparisons, GROUP BY, ORDER BY, LIMIT."""
+        AND/OR/BETWEEN/comparisons, GROUP BY, ORDER BY, LIMIT.
+
+        Dispatch (ISSUE 7): fully-supported single-table plans run as
+        jitted columnar XLA kernels over device-held columns; the long
+        tail runs the numpy interpreter (``sql_explain`` shows which,
+        and why, per plan node)."""
         from .core.sql import execute
 
         return execute(query, self.table)
+
+    def sql_explain(self, query: str) -> dict:
+        """Planner view of ``query`` without running it: the route
+        (compiled | interpreter), the plan fingerprint, and every plan
+        node's supported/fallback decision."""
+        from .core.sql import explain
+
+        return explain(query, self.table)
+
+    def sql_to_device(
+        self,
+        query: str,
+        feature_cols=None,
+        label_col: str | None = None,
+        mesh=None,
+        na_drop: bool = True,
+        clock=None,
+        mode: str = "auto",
+    ):
+        """The fused training path (ISSUE 7): SQL window extract →
+        feature assembly → a mesh-ready ``DeviceDataset``, entirely on
+        device when the plan compiles — ingest (PR 4) → SQL → assemble →
+        fit (PR 5) then never round-trips through the host.
+
+        Falls back to interpreter + host assembly when the plan has
+        fallback nodes (``mode="compile"`` raises instead;
+        ``core.sql.last_dispatch()`` records the route).  ``na_drop``
+        mirrors the reference's ``na.drop()`` over the feature/label
+        columns.  ``clock`` (a ``StageClock``) brackets the
+        transfer/sql/assemble stages for the host-detour evidence.
+        """
+        from contextlib import nullcontext
+
+        from .core.schema import FEATURE_COLS, LABEL_COL
+        from .core.sql import execute
+        from .core.sql_compile import compile_rowlevel
+        from .features.assembler import VectorAssembler
+
+        feature_cols = tuple(feature_cols or FEATURE_COLS)
+        assembler = VectorAssembler(feature_cols)
+        view = compile_rowlevel(query, self.table, mode=mode, clock=clock)
+        stage = clock.stage if clock is not None else (lambda _: nullcontext())
+        if view is not None:
+            with stage("assemble"):
+                return assembler.transform_device(
+                    view, label_col=label_col, mesh=mesh or self.mesh,
+                    na_drop=na_drop,
+                )
+        # host fallback: interpreter (or compiled materialization) +
+        # host-side assembly — one transfer at the to_device boundary
+        with stage("sql"):
+            t = execute(query, self.table)
+        if label_col is None and LABEL_COL in t.schema:
+            label_col = LABEL_COL
+        if na_drop:
+            t = t.na_drop(
+                subset=list(feature_cols)
+                + ([label_col] if label_col else [])
+            )
+        with stage("assemble"):
+            assembled = assembler.transform(t)
+            return assembled.to_device(
+                label_col=label_col, mesh=mesh or self.mesh
+            )
 
     # streaming read ----------------------------------------------------
     @property

@@ -506,3 +506,59 @@ class ModelUpdateConsumer:
             # update failure (they'd silently diverge from serial)
             self._buf = buf[applied:] + self._buf
             raise
+
+
+def make_sql_feature_stage(
+    statement: str,
+    feature_cols,
+    label_col: str | None = None,
+    min_compiled_rows: int = 4096,
+):
+    """Stage-hook factory (ISSUE 7): run a SQL statement over each
+    micro-batch's accepted rows on the prefetch worker, then extract the
+    float32 feature matrix (and label) for the update consumer.
+
+    The statement references the batch as ``__THIS__`` (the
+    SQLTransformer convention) and goes through ``core.sql.execute``'s
+    dispatcher, so supported plans — numeric filters, derived-feature
+    arithmetic, the LOS window shapes — run on the compiled XLA executor.
+    Batches under ``min_compiled_rows`` force the interpreter: a
+    micro-batch's table is fresh (cold device-column cache), and for
+    small batches the transfer + dispatch costs more than host numpy.
+
+    Returns HOST arrays (``x`` or ``(x, y)``) per the stage contract
+    pinned in PR 4: staged payloads must be re-stageable bit-identically
+    on the commit thread for watermark/replay parity, so the device put
+    stays with the consumer.
+    """
+    from ..core.sql import execute
+
+    feature_cols = list(feature_cols)
+    stmt = statement.replace("__THIS__", "__this__")
+
+    def _resolver(table: Table):
+        # per-call closure (the worker and a commit-thread re-stage may
+        # run concurrently); only the batch itself is visible — a wrong
+        # FROM (a session table name, a typo) must fail loudly, not
+        # silently run against the micro-batch
+        def resolve(name: str) -> Table:
+            if name == "__this__":
+                return table
+            raise KeyError(
+                f"unknown table {name!r}; a streaming SQL stage sees "
+                "only __THIS__ (the micro-batch)"
+            )
+
+        return resolve
+
+    def stage(table: Table):
+        import numpy as np
+
+        mode = "auto" if len(table) >= min_compiled_rows else "interpret"
+        out = execute(stmt, _resolver(table), mode=mode)
+        x = out.numeric_matrix(feature_cols).astype(np.float32)
+        if label_col is None:
+            return x
+        return x, out.column(label_col).astype(np.float32)
+
+    return stage

@@ -71,21 +71,39 @@ class UnboundedTable:
 
     def read(self) -> Table:
         """Snapshot of all committed rows (the reference's ``spark.sql``
-        over the output table reads exactly this view, ``:123-128``)."""
+        over the output table reads exactly this view, ``:123-128``).
+
+        Memoized per commit-log state: between appends, every ``read()``
+        returns the SAME ``Table`` instance, so the compiled SQL
+        executor's device-column cache (``Table.device_column``) survives
+        across repeated queries over the unbounded table — the
+        no-re-transfer contract of ISSUE 7.  An append (or a replay that
+        changes any commit entry) changes the key and drops the snapshot.
+        """
         import pyarrow.parquet as pq
         import pyarrow as pa
 
         entries = self.committed_batches()
+        key = tuple(
+            (bid, entries[bid]["file"], entries[bid]["rows"])
+            for bid in sorted(entries)
+        )
+        cached = getattr(self, "_snapshot", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         parts = []
         for bid in sorted(entries):
             p = os.path.join(self.path, entries[bid]["file"])
             if os.path.exists(p) and entries[bid]["rows"] > 0:
                 parts.append(pq.read_table(p))
         if not parts:
-            return Table.empty(self.schema)
-        # schema inferred from the data: committed batches carry derived
-        # columns (ingest_time, :82) beyond the declared source schema
-        return Table.from_arrow(pa.concat_tables(parts))
+            t = Table.empty(self.schema)
+        else:
+            # schema inferred from the data: committed batches carry derived
+            # columns (ingest_time, :82) beyond the declared source schema
+            t = Table.from_arrow(pa.concat_tables(parts))
+        self._snapshot = (key, t)
+        return t
 
     def num_rows(self) -> int:
         return sum(e["rows"] for e in self.committed_batches().values())

@@ -52,29 +52,44 @@ class StageClock:
 
 
 @contextmanager
-def host_sync_census() -> Iterator[dict]:
+def host_sync_census(count_puts: bool = False) -> Iterator[dict]:
     """Count blocking host↔device syncs (``jax.device_get`` calls) in the
     enclosed scope — the transfer-counter behind the boosting-fusion
     O(1)-syncs-per-fit contract (bench.py ``gbt20`` row,
-    tests/test_gbt_fused.py).
+    tests/test_gbt_fused.py) and the device-resident SQL path's
+    host-detour-elimination contract (ISSUE 7: the compiled
+    SQL → assemble → fit chain holds ``device_get`` at a small constant,
+    tests/test_sql_device.py).
 
-    Wraps ``jax.device_get`` module-wide for the scope's duration, so any
-    framework code that fetches via the canonical attribute is counted
-    (the fit paths all do).  NOT thread-safe — meant for single-threaded
-    measurement scopes, not production serving.  Yields a dict whose
-    ``device_get`` entry holds the running count."""
-    counter = {"device_get": 0}
-    real = jax.device_get
+    With ``count_puts=True`` the census also wraps ``jax.device_put`` —
+    the evidence that a warm device-column cache re-transfers nothing on
+    repeated queries.
 
-    def counting(*args, **kwargs):
+    Wraps the canonical module attributes for the scope's duration, so
+    any framework code that fetches via them is counted (the fit paths
+    all do).  NOT thread-safe — meant for single-threaded measurement
+    scopes, not production serving.  Yields a dict whose ``device_get`` /
+    ``device_put`` entries hold the running counts."""
+    counter = {"device_get": 0, "device_put": 0}
+    real_get = jax.device_get
+    real_put = jax.device_put
+
+    def counting_get(*args, **kwargs):
         counter["device_get"] += 1
-        return real(*args, **kwargs)
+        return real_get(*args, **kwargs)
 
-    jax.device_get = counting
+    def counting_put(*args, **kwargs):
+        counter["device_put"] += 1
+        return real_put(*args, **kwargs)
+
+    jax.device_get = counting_get
+    if count_puts:
+        jax.device_put = counting_put
     try:
         yield counter
     finally:
-        jax.device_get = real
+        jax.device_get = real_get
+        jax.device_put = real_put
 
 
 @contextmanager

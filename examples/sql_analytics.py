@@ -8,6 +8,10 @@ expects the rest of the verbs to follow):
 3. Top-2 stays per hospital via ROW_NUMBER() OVER (PARTITION BY …).
 4. Event-sequence deltas with LAG over admission order.
 5. Semi-join via IN (SELECT …) + set ops.
+6. The split engine's dispatcher (ISSUE 7): EXPLAIN shows which plans
+   compile to device-resident XLA kernels vs run the numpy
+   interpreter, and ``sql_to_device`` fuses the paper's window extract
+   straight into a mesh-ready training matrix.
 
     PYTHONPATH=. python examples/sql_analytics.py
 """
@@ -97,6 +101,28 @@ def main() -> None:
     )
     print(f"   flagged: {flagged}")
     print(f"   never exceeded 9.0: {sorted(r2.column('hospital_id'))}")
+
+    print("\n== 6. Compiled vs interpreter dispatch (device-resident SQL)")
+    numeric_q = (
+        "SELECT seasonality_index, length_of_stay, "
+        "(admission_count + emergency_visits) AS load "
+        "FROM events WHERE length_of_stay > 2.0"
+    )
+    for label, q in [
+        ("numeric filter + arithmetic", numeric_q),
+        ("string predicate (falls back)",
+         "SELECT length_of_stay FROM events WHERE hospital_id = 'H0'"),
+    ]:
+        info = spark.sql_explain(q)
+        why = "" if not info["fallback"] else (
+            " — " + "; ".join(f"{op}: {r}" for op, r in info["fallback"])
+        )
+        print(f"   {label}: route={info['route']}{why}")
+        spark.sql(q)  # runs on whichever route explain predicted
+    ds = spark.sql_to_device(numeric_q, feature_cols=("seasonality_index", "load"),
+                             label_col="length_of_stay")
+    print(f"   fused training matrix on device: x={tuple(ds.x.shape)} "
+          f"valid_rows={int(float(np.asarray(ds.count())))} (no host detour)")
     spark.stop()
 
 

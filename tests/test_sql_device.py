@@ -1,0 +1,440 @@
+"""Device-resident SQL + fused feature plans (ISSUE 7, the Flare move).
+
+Covers the split engine (parse → plan → execution): compiled-vs-
+interpreter parity (targeted + fuzz), the paper's window-extract query
+compiling with zero fallback nodes, the plan-executable cache's
+zero-recompile contract (counter + jit-cache cross-check, the serve
+discipline), the device-column no-re-transfer contract, and the fused
+SQL → assemble → fit chain holding host syncs at a small constant.
+"""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core import sql
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core import (
+    sql_fuzz,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import (
+    SqlCompileUnsupported,
+    execute,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_compile import (
+    bucket_for_rows,
+    clear_executable_cache,
+    compile_rowlevel,
+    executable_cache_info,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table import (
+    Table,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.profiling import (
+    StageClock,
+    host_sync_census,
+)
+
+pytestmark = pytest.mark.fast
+
+WINDOW_QUERY = (
+    "SELECT * FROM events WHERE event_time BETWEEN "
+    "'2025-03-31 22:00:00' AND '2025-03-31 22:03:00'"
+)
+
+
+@pytest.fixture
+def session(hospital_table):
+    s = ht.Session.builder.app_name("sql-device-test").get_or_create()
+    s.register_table("events", hospital_table)
+    yield s
+    s.stop()
+
+
+def _parity(query, table, limit_slack=False):
+    resolve = lambda _n: table  # noqa: E731
+    ti = execute(query, resolve, mode="interpret")
+    tc = execute(query, resolve, mode="compile")
+    mismatch = sql_fuzz.compare_tables(ti, tc)
+    assert mismatch is None, f"{query}: {mismatch}"
+    return ti
+
+
+# ------------------------------------------------------------- routing
+def test_window_extract_compiles_no_fallback(session):
+    """Satellite 2: the paper's exact window-extract shape
+    (mllearnforhospitalnetwork.py:123-128) must compile end to end —
+    zero fallback nodes."""
+    info = session.sql_explain(WINDOW_QUERY)
+    assert info["route"] == "compiled"
+    assert info["fallback"] == []
+    assert {n["op"] for n in info["nodes"]} == {"scan", "filter", "project"}
+    out = session.sql(WINDOW_QUERY)
+    assert sql.last_dispatch().route == "compiled"
+    assert len(out) > 0
+
+
+def test_window_extract_parity(session, hospital_table):
+    out = _parity(WINDOW_QUERY, hospital_table)
+    ref = hospital_table.between(
+        "event_time", "2025-03-31 22:00:00", "2025-03-31 22:03:00"
+    )
+    assert len(out) == len(ref) > 0
+
+
+def test_fallback_reasons_are_per_node(session):
+    info = session.sql_explain(
+        "SELECT hospital_id, length_of_stay FROM events "
+        "WHERE hospital_id = 'H00' ORDER BY length_of_stay"
+    )
+    assert info["route"] == "interpreter"
+    ops = dict(info["fallback"])
+    assert "filter" in ops and "string" in ops["filter"]
+    assert "sort" in ops
+    # and the dispatcher actually recorded the interpreter route
+    session.sql(
+        "SELECT hospital_id FROM events WHERE hospital_id = 'H00'"
+    )
+    rec = sql.last_dispatch()
+    assert rec.route == "interpreter"
+    assert rec.reasons
+
+
+def test_mode_compile_raises_on_unsupported(session, hospital_table):
+    with pytest.raises(SqlCompileUnsupported, match="interpreter"):
+        execute(
+            "SELECT * FROM events ORDER BY length_of_stay",
+            lambda _n: hospital_table,
+            mode="compile",
+        )
+
+
+def test_dispatch_off_switch(session, monkeypatch):
+    monkeypatch.setenv("CMLHN_SQL_COMPILE", "0")
+    session.sql(WINDOW_QUERY)
+    assert sql.last_dispatch().route == "interpreter"
+    # the kill switch covers the fused path and explain too (review
+    # finding: compile_rowlevel used to bypass it)
+    ds = session.sql_to_device(WINDOW_QUERY, label_col="length_of_stay")
+    assert sql.last_dispatch().route == "interpreter"
+    assert float(np.asarray(ds.count())) > 0
+    assert session.sql_explain(WINDOW_QUERY)["route"] == "interpreter"
+
+
+# ------------------------------------------------------- fuzz parity
+def test_fuzz_parity_green():
+    """Satellite 1: N random queries over random tables, compiled ==
+    interpreter; mismatches would arrive pre-shrunk to a minimal repro.
+    24 queries in tier-1 (each distinct plan is a cold-cache XLA
+    compile — budget); the slow-marked deep run covers 250."""
+    failures = sql_fuzz.run_fuzz(n_queries=24, seed=0)
+    assert failures == [], "\n".join(
+        f"{q}  ->  {why}" for q, why in failures
+    )
+
+
+@pytest.mark.slow
+def test_fuzz_parity_deep():
+    failures = sql_fuzz.run_fuzz(n_queries=250, seed=7)
+    assert failures == [], "\n".join(
+        f"{q}  ->  {why}" for q, why in failures
+    )
+
+
+def test_fuzz_shrinker_minimizes(monkeypatch):
+    """The shrinker strips items/predicates that don't matter to a
+    failure (here: an injected one keyed on f1 being selected)."""
+    rng = np.random.default_rng(3)
+    table = sql_fuzz.random_table(rng, 50)
+    spec = sql_fuzz.QuerySpec(
+        "rowlevel",
+        ("f1", "f2", "i1"),
+        ("bool", "AND", ("leaf", "i2 > 10"), ("leaf", "f2 < 1.0")),
+        limit=5,
+    )
+    fake = lambda s, t: "boom" if "f1" in s.items else None  # noqa: E731
+    monkeypatch.setattr(sql_fuzz, "check_spec", fake)
+    small = sql_fuzz.shrink(spec, table)
+    assert small.items == ("f1",)
+    assert small.where is None and small.limit is None
+
+
+# ------------------------------------------- executable cache discipline
+def test_zero_recompiles_within_bucket(session, hospital_table):
+    """Satellite 4: rerunning a plan at varying row counts inside one
+    power-of-two bucket reuses the executable — build counter AND
+    jit-cache size cross-check, serve's zero-recompile discipline."""
+    clear_executable_cache()
+    t = hospital_table
+    for n in (100, 150, 37, 256):
+        sub = t.limit(n)
+        out = execute(WINDOW_QUERY, lambda _x: sub, mode="compile")
+        assert bucket_for_rows(n) == 256
+    info = executable_cache_info()
+    assert info["kernels"] == 1
+    assert info["builds"] == 1
+    # one executable per kernel: n is a traced operand, not a static arg
+    assert info["jit_entries"] == 1
+
+
+def test_new_bucket_compiles_once(session, hospital_table):
+    clear_executable_cache()
+    execute(WINDOW_QUERY, lambda _x: hospital_table.limit(100), mode="compile")
+    b1 = executable_cache_info()["builds"]
+    execute(WINDOW_QUERY, lambda _x: hospital_table, mode="compile")  # 400 rows
+    info = executable_cache_info()
+    assert info["builds"] == b1 + 1  # bucket 512 is a new executable ...
+    execute(WINDOW_QUERY, lambda _x: hospital_table, mode="compile")
+    assert executable_cache_info()["builds"] == b1 + 1  # ... exactly once
+
+
+def test_device_cache_no_retransfer(session, hospital_table):
+    """Repeated queries over one Table snapshot re-transfer nothing: the
+    second run does zero device_put and one batched device_get (the
+    result materialization)."""
+    q = (
+        "SELECT admission_count + emergency_visits AS load FROM events "
+        "WHERE length_of_stay > 3.0"
+    )
+    resolve = lambda _n: hospital_table  # noqa: E731
+    execute(q, resolve, mode="compile")  # warm: cache fill + compile
+    with host_sync_census(count_puts=True) as c:
+        execute(q, resolve, mode="compile")
+    assert c["device_put"] == 0
+    assert c["device_get"] == 1
+    cache = hospital_table.device_cache_info()
+    assert cache["entries"], "device-column cache unexpectedly empty"
+
+
+def test_unbounded_table_read_memoized(tmp_path):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.unbounded_table import (
+        UnboundedTable,
+    )
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.schema import (
+        FLOAT,
+    )
+
+    schema = ht.Schema([("v", FLOAT)])
+    ut = UnboundedTable(str(tmp_path / "ut"), schema)
+    ut.append_batch(Table.from_dict({"v": np.arange(4.0)}, schema), 0)
+    t1 = ut.read()
+    assert ut.read() is t1  # same snapshot → device cache survives
+    ut.append_batch(Table.from_dict({"v": np.arange(2.0)}, schema), 1)
+    t2 = ut.read()
+    assert t2 is not t1 and len(t2) == 6
+
+
+# ------------------------------------------------------ fused assembly
+def test_fused_assemble_matches_host_path(session, hospital_table):
+    clock = StageClock()
+    ds = session.sql_to_device(
+        WINDOW_QUERY, label_col="length_of_stay", clock=clock
+    )
+    assert sql.last_dispatch().route == "compiled"
+    host = session.sql(WINDOW_QUERY).na_drop()
+    assert float(np.asarray(ds.count())) == len(host)
+    # stage evidence threaded through the chain
+    assert {"transfer", "sql", "assemble"} <= set(clock.seconds)
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        LinearRegression,
+    )
+
+    m_dev = LinearRegression().fit(ds)
+    m_host = LinearRegression().fit(
+        ht.VectorAssembler(ht.FEATURE_COLS).transform(host),
+        label_col="length_of_stay",
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_dev.coefficients),
+        np.asarray(m_host.coefficients),
+        rtol=5e-4, atol=5e-5,
+    )
+
+
+def test_fused_na_drop_zeroes_invalid_rows(session):
+    n = 64
+    rng = np.random.default_rng(0)
+    f = rng.normal(size=n)
+    f[::7] = np.nan
+    t = Table.from_dict({"a": f, "b": rng.normal(size=n), "y": rng.normal(size=n)})
+    s = ht.Session.builder.get_or_create()
+    s.register_table("tt", t)
+    try:
+        ds = s.sql_to_device(
+            "SELECT * FROM tt", feature_cols=("a", "b"), label_col="y"
+        )
+        x, w = np.asarray(ds.x), np.asarray(ds.w)
+        expected_valid = int(np.sum(~np.isnan(f)))
+        assert int(w.sum()) == expected_valid
+        assert np.all(np.isfinite(x))  # NaN rows zero-filled, never NaN
+        assert np.all(x[w == 0] == 0)
+    finally:
+        s.stop()
+
+
+def test_compact_gather_parity(session, hospital_table):
+    """The opt-in on-device compaction (decision record in
+    VectorAssembler.transform_device) keeps rows, order, and weights."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.features.assembler import (
+        VectorAssembler,
+    )
+
+    view = compile_rowlevel(WINDOW_QUERY, session.table)
+    asm = VectorAssembler(ht.FEATURE_COLS)
+    ds_pad = asm.transform_device(view, label_col="length_of_stay")
+    ds_cmp = asm.transform_device(
+        view, label_col="length_of_stay", compact=True
+    )
+    xp, wp = np.asarray(ds_pad.x), np.asarray(ds_pad.w)
+    xc, wc, yc = np.asarray(ds_cmp.x), np.asarray(ds_cmp.w), np.asarray(ds_cmp.y)
+    nv = int(wp.sum())
+    assert int(wc.sum()) == nv
+    assert ds_cmp.n_padded <= ds_pad.n_padded
+    # valid rows, in source order, bit-identical; tail fully zeroed
+    np.testing.assert_array_equal(xc[:nv], xp[wp > 0])
+    assert np.all(xc[nv:] == 0) and np.all(wc[nv:] == 0) and np.all(yc[nv:] == 0)
+
+
+def test_fused_falls_back_outside_subset(session):
+    # a string GROUP BY cannot fuse — the host fallback must still
+    # produce a working dataset
+    ds = session.sql_to_device(
+        "SELECT * FROM events WHERE hospital_id = 'H00'",
+        label_col="length_of_stay",
+    )
+    assert sql.last_dispatch().route == "interpreter"
+    assert float(np.asarray(ds.count())) > 0
+
+
+def test_sql_transformer_compiled_route(session, hospital_table):
+    tr = ht.SQLTransformer(
+        "SELECT *, (admission_count + emergency_visits) AS load "
+        "FROM __THIS__ WHERE length_of_stay > 2.0"
+    )
+    info = tr.explain(hospital_table)
+    assert info["route"] == "compiled"
+    out = tr.transform(hospital_table)
+    assert sql.last_dispatch().route == "compiled"
+    ref = hospital_table.mask(hospital_table.column("length_of_stay") > 2.0)
+    np.testing.assert_array_equal(
+        out.column("load"),
+        ref.column("admission_count") + ref.column("emergency_visits"),
+    )
+
+
+def test_streaming_sql_feature_stage(hospital_table):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.pipeline import (
+        make_sql_feature_stage,
+    )
+
+    stage = make_sql_feature_stage(
+        "SELECT * FROM __THIS__ WHERE length_of_stay > 2.0",
+        ht.FEATURE_COLS,
+        label_col="length_of_stay",
+    )
+    x, y = stage(hospital_table)
+    ref = hospital_table.mask(hospital_table.column("length_of_stay") > 2.0)
+    assert x.dtype == np.float32 and y.dtype == np.float32
+    assert x.shape == (len(ref), len(ht.FEATURE_COLS))
+    np.testing.assert_allclose(
+        y, ref.column("length_of_stay").astype(np.float32)
+    )
+
+
+# ------------------------------------------------------------ edge cases
+def test_empty_table_and_empty_result(session):
+    t = Table.from_dict(
+        {"a": np.empty(0, np.float64), "b": np.empty(0, np.int64)}
+    )
+    for q in (
+        "SELECT a, b FROM t0 WHERE a > 1.0",
+        "SELECT b, count(*) AS n, avg(a) AS m FROM t0 GROUP BY b",
+        "SELECT count(*) AS n, sum(a) AS s FROM t0",
+    ):
+        _parity(q, t)
+    # non-empty table, filter matches nothing
+    t2 = Table.from_dict({"a": np.arange(5.0), "b": np.arange(5)})
+    _parity("SELECT a, a * 2 AS d FROM t1 WHERE a > 99", t2)
+    _parity("SELECT b, min(a) AS lo FROM t1 WHERE a > 99 GROUP BY b", t2)
+
+
+def test_three_valued_logic_and_null_aggregates(session):
+    t = Table.from_dict(
+        {
+            "a": np.array([1.0, np.nan, 3.0, np.nan, 5.0]),
+            "b": np.array([1, 1, 2, 2, 2]),
+        }
+    )
+    _parity("SELECT a FROM t WHERE NOT (a > 2 AND a < 99)", t)
+    _parity("SELECT a FROM t WHERE a NOT IN (1.0, 3.0)", t)
+    _parity("SELECT a FROM t WHERE a IS NULL OR a >= 5", t)
+    _parity(
+        "SELECT b, count(a) AS c, sum(a) AS s, avg(a) AS m FROM t GROUP BY b",
+        t,
+    )
+    # all-null group: sum/avg/min/max null, count 0
+    t2 = Table.from_dict(
+        {"a": np.array([np.nan, np.nan, 7.0]), "b": np.array([1, 1, 2])}
+    )
+    out = _parity(
+        "SELECT b, count(a) AS c, max(a) AS hi FROM t2 GROUP BY b", t2
+    )
+    assert out.column("c").tolist() == [0, 1]
+    assert np.isnan(out.column("hi")[0]) and out.column("hi")[1] == 7.0
+
+
+def test_timestamp_group_keys_and_window_partition(session):
+    rng = np.random.default_rng(5)
+    n = 200
+    ts = (
+        np.datetime64("2025-03-31T22:00:00")
+        + rng.integers(0, 5, n).astype("timedelta64[m]")
+    ).astype("datetime64[ns]")
+    ts[::11] = np.datetime64("NaT")
+    t = Table.from_dict(
+        {"t1": ts, "v": rng.normal(size=n), "g": rng.integers(0, 3, n)}
+    )
+    _parity("SELECT t1, count(*) AS n, avg(v) AS m FROM t GROUP BY t1", t)
+    _parity(
+        "SELECT v, sum(v) OVER (PARTITION BY g) AS s, "
+        "count(v) OVER (PARTITION BY g) AS c FROM t WHERE v > -1.0",
+        t,
+    )
+
+
+def test_compiled_limit_matches_interpreter(session, hospital_table):
+    _parity(
+        "SELECT event_time, length_of_stay FROM events "
+        "WHERE length_of_stay > 2.0 LIMIT 9",
+        hospital_table,
+    )
+
+
+# --------------------------------------------------- host-sync contract
+@pytest.mark.perf
+def test_fused_chain_host_syncs_constant(session, hospital_table):
+    """Satellite 3: on the compiled fused path, SQL → assemble → fit
+    performs a small CONSTANT number of host syncs — independent of row
+    count — and zero device_puts once the column cache is warm."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        LinearRegression,
+    )
+
+    est = LinearRegression()
+    # warm: compile + device-column cache
+    est.fit(session.sql_to_device(WINDOW_QUERY, label_col="length_of_stay"))
+    counts = []
+    for _ in range(3):
+        with host_sync_census(count_puts=True) as c:
+            ds = session.sql_to_device(
+                WINDOW_QUERY, label_col="length_of_stay"
+            )
+            est.fit(ds)
+        counts.append((c["device_get"], c["device_put"]))
+    for gets, puts in counts:
+        assert gets <= 2, counts   # fit-internal fetches only, O(1)
+        # warm cache: no column re-transfer; the ≤3 allows the x/y/w
+        # device-to-device mesh reshard on multi-device meshes
+        assert puts <= 3, counts
+    assert len({c for c in counts}) == 1, f"sync count not constant: {counts}"
