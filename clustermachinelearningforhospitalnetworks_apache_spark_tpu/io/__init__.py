@@ -12,6 +12,7 @@ from .fit_checkpoint import FitCheckpointer
 from .integrity import crc32c, crc32c_hex
 from .model_io import (
     CorruptArtifactError,
+    artifact_fingerprint,
     attach_data_profile,
     load_data_profile,
     load_model,
@@ -23,6 +24,7 @@ from .native import native_available
 __all__ = [
     "CorruptArtifactError",
     "FitCheckpointer",
+    "artifact_fingerprint",
     "RowReject",
     "SalvageResult",
     "attach_data_profile",

@@ -56,14 +56,32 @@ def data_fingerprint(x, w=None, sample: int = 1024) -> str:
     return h.hexdigest()[:16]
 
 
-def _fsync_dir(path: str) -> None:
+def array_fingerprint(a) -> str:
+    """Identity hash of one (host or device) array — warm-start state and
+    other trajectory-shaping tensors go into checkpoint signatures through
+    this, so resuming against a different start raises like any other
+    config mismatch."""
+    import jax
+
+    h = hashlib.sha1(
+        np.ascontiguousarray(np.asarray(jax.device_get(a))).tobytes()
+    )
+    return h.hexdigest()[:16]
+
+
+def fsync_dir(path: str) -> None:
     """fsync a directory so renames inside it are durable across power
-    loss, not just process crash."""
+    loss, not just process crash.  Shared by every module whose rename
+    is a commit point (fit checkpoints, the lifecycle feedback spool)."""
     fd = os.open(path, os.O_RDONLY)
     try:
         os.fsync(fd)
     finally:
         os.close(fd)
+
+
+# internal alias kept for this module's historical call sites
+_fsync_dir = fsync_dir
 
 
 def _atomic_write_json(path: str, payload: dict) -> None:

@@ -293,6 +293,23 @@ def load_data_profile(path: str) -> dict | None:
     return meta.get("data_profile")
 
 
+def artifact_fingerprint(path: str) -> str | None:
+    """Content identity of a saved artifact: the CRC32C already in its
+    integrity manifest (None for composite/legacy artifacts without one).
+    The lifecycle controller uses it as the model id in journal entries
+    and health snapshots, and tests use it to assert a rollback left the
+    prior artifact byte-for-byte untouched — without re-reading payloads.
+    """
+    repair_artifact_dir(path)
+    try:
+        with open(os.path.join(path, METADATA_FILE)) as f:
+            meta = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    rec = (meta.get("integrity") or {}).get(ARRAYS_FILE)
+    return None if rec is None else str(rec.get("crc32c"))
+
+
 def load_model(path: str) -> Any:
     """Load any saved artifact, verifying content checksums when the
     manifest carries them.  Raises :class:`CorruptArtifactError` on torn

@@ -1,0 +1,51 @@
+"""Continuous learning: drift-triggered warm retrain, shadow/canary
+promotion, chaos-hardened lifecycle controller (ROADMAP item 5).
+
+* :mod:`journal`   — CRC-verified WAL of state transitions (the spine)
+* :mod:`feedback`  — served predictions + outcomes re-enter ingest
+* :mod:`promotion` — shadow scorer, parity gate, canary router
+* :mod:`controller`— the SERVING → … → PROMOTED | ROLLED_BACK machine
+
+See docs/ARCHITECTURE.md §Continuous learning for the state diagram and
+the per-transition durability invariants.
+"""
+
+from .controller import (
+    KMeansRetrainer,
+    LifecycleController,
+    STATE_CANARY,
+    STATE_DRIFT_SUSPECTED,
+    STATE_PROMOTED,
+    STATE_RETRAINING,
+    STATE_ROLLED_BACK,
+    STATE_SERVING,
+    STATE_SHADOW,
+    STATES,
+    kmeans_cost,
+)
+from .feedback import FeedbackBuffer, OUTCOME_COL, PREDICTION_COL, feedback_schema
+from .journal import LifecycleJournal
+from .promotion import CanaryRouter, GateDecision, ParityGate, ShadowScorer
+
+__all__ = [
+    "CanaryRouter",
+    "FeedbackBuffer",
+    "GateDecision",
+    "KMeansRetrainer",
+    "LifecycleController",
+    "LifecycleJournal",
+    "OUTCOME_COL",
+    "PREDICTION_COL",
+    "ParityGate",
+    "STATES",
+    "STATE_CANARY",
+    "STATE_DRIFT_SUSPECTED",
+    "STATE_PROMOTED",
+    "STATE_RETRAINING",
+    "STATE_ROLLED_BACK",
+    "STATE_SERVING",
+    "STATE_SHADOW",
+    "ShadowScorer",
+    "feedback_schema",
+    "kmeans_cost",
+]

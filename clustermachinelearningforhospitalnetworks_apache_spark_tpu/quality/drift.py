@@ -64,6 +64,7 @@ class DriftMonitor:
         self._hot_windows = 0       # consecutive windows above threshold
         self._trip_pending = False  # a hot window closed since last signal
         self.trips = 0              # lifetime trip signals emitted
+        self.rebases = 0            # lifetime reference swaps (promotions)
 
     # ------------------------------------------------------------ observe
     def observe(self, x: np.ndarray) -> None:
@@ -97,6 +98,28 @@ class DriftMonitor:
                 self._trip_pending = False  # recovered
             self._live = DataProfile.like(self.reference)
             self._window_seen = 0
+
+    def rebase(self, reference: DataProfile) -> None:
+        """Swap the PSI reference — the promotion half of the continuous
+        learning loop.  A promoted candidate was *trained on* the drifted
+        distribution, so the traffic that tripped this monitor is exactly
+        what the new model expects; scoring it against the old training
+        profile would re-trip the breaker forever.  The caller must make
+        this atomic with the registry flip (``InferenceServer.swap_model``
+        holds one lock around both) so no window closes against the stale
+        reference after the new model starts answering.
+
+        Resets the open window, scores, and the hot-window/trip state:
+        drift is measured against the NEW reference from row zero."""
+        with self._lock:
+            self.reference = reference
+            self._live = DataProfile.like(reference)
+            self._window_seen = 0
+            self._scores = {}
+            self._noise_floor = 0.0
+            self._hot_windows = 0
+            self._trip_pending = False
+            self.rebases += 1
 
     def _hot_bar(self) -> float:
         """Drift bar for the last window: threshold + small-sample noise."""
@@ -146,6 +169,7 @@ class DriftMonitor:
                 "windows": self._windows,
                 "hot_windows": self._hot_windows,
                 "trips": self.trips,
+                "rebases": self.rebases,
             }
 
 

@@ -30,6 +30,7 @@ from .queue import (
     Request,
     RequestQueue,
     ServeResult,
+    STATUS_CANARY,
     STATUS_DEADLINE_EXCEEDED,
     STATUS_ERROR,
     STATUS_INVALID_INPUT,
@@ -60,6 +61,7 @@ __all__ = [
     "ServingMetrics",
     "ServingModel",
     "ShardedScorer",
+    "STATUS_CANARY",
     "STATUS_DEADLINE_EXCEEDED",
     "STATUS_ERROR",
     "STATUS_INVALID_INPUT",

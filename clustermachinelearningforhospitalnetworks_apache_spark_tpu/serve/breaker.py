@@ -50,6 +50,7 @@ class CircuitBreaker:
         self.short_circuited = 0       # calls refused while open
         self.tripped_count = 0         # forced opens via trip()
         self._last_trip_reason = ""
+        self._last_reset_reason = ""   # forced closes via reset()
 
     # ------------------------------------------------------------ internals
     def _to(self, state: str) -> None:
@@ -105,6 +106,21 @@ class CircuitBreaker:
             else:  # already open: restart the recovery clock
                 self._opened_at = self._clock()
 
+    def reset(self, reason: str = "") -> None:
+        """Force the breaker CLOSED — the promotion-side counterpart of
+        :meth:`trip`.  A freshly promoted model must answer immediately:
+        the opens its predecessor accumulated (drift trips included) say
+        nothing about the new executable, so the failure count and the
+        recovery clock start over.  ``last_trip_reason`` is left intact —
+        an operator auditing why the breaker ever opened must see the
+        trip's cause, not the reset's label."""
+        with self._lock:
+            self._last_reset_reason = reason
+            if self._state != STATE_CLOSED:
+                self._to(STATE_CLOSED)
+            else:
+                self._consecutive_failures = 0
+
     def record_failure(self) -> None:
         with self._lock:
             if self._state == STATE_HALF_OPEN:
@@ -147,4 +163,5 @@ class CircuitBreaker:
                 "short_circuited": self.short_circuited,
                 "tripped_count": self.tripped_count,
                 "last_trip_reason": self._last_trip_reason,
+                "last_reset_reason": self._last_reset_reason,
             }

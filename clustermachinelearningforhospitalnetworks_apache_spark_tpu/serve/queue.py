@@ -42,6 +42,10 @@ STATUS_SHUTDOWN = "shutdown"
 #: under the ``reject`` policy — a 400, not a 503, so NOT a degraded
 #: status (a made-up answer to a garbage question helps nobody)
 STATUS_INVALID_INPUT = "invalid_input"
+#: lifecycle canary (ISSUE 9): a full-quality answer scored by the
+#: CANDIDATE model during its canary traffic split — ``ok`` is True, the
+#: tag exists so clients/audits can attribute the answer to the candidate
+STATUS_CANARY = "canary"
 
 #: statuses answered by the fallback path (degraded but not failed)
 DEGRADED_STATUSES = (
@@ -62,7 +66,9 @@ class ServeResult:
 
     @property
     def ok(self) -> bool:
-        return self.status == STATUS_OK
+        # canary answers are full-quality predictions (just attributed to
+        # the candidate model), not a degradation
+        return self.status in (STATUS_OK, STATUS_CANARY)
 
 
 @dataclass

@@ -195,6 +195,19 @@ class ModelRegistry:
             buckets=buckets, warmup=warmup,
         )
 
+    def install(self, name: str, sm: ServingModel) -> ServingModel:
+        """Install an already-built (e.g. pre-warmed) :class:`ServingModel`
+        under ``name`` — the hot-swap entry point: the previous executable
+        keeps answering until this one atomic dict swap, so a promotion
+        never serves a cold or half-registered model."""
+        with self._lock:
+            self._models[name] = sm
+        log.info(
+            "model installed (hot swap)", name=name,
+            family=type(sm.model).__name__,
+        )
+        return sm
+
     def get(self, name: str) -> ServingModel:
         with self._lock:
             if name not in self._models:

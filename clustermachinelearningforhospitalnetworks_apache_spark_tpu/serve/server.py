@@ -9,15 +9,17 @@ metrics sink is shared so one ``stats()`` call reports the whole server.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Sequence
 
 import numpy as np
 
-from ..io.model_io import load_data_profile
+from ..io.model_io import load_data_profile, load_model
 from ..models.base import Model
 from ..quality.drift import DriftMonitor, InputGuard, POLICY_REJECT
 from ..quality.sketches import DataProfile, PSI_DRIFT
+from ..utils.faults import fault_point
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
 from .batcher import DEFAULT_MAX_WAIT_S, Fallback, MicroBatcher
@@ -63,7 +65,16 @@ class InferenceServer:
         #: per-model input guards / drift monitors (PR 3 data firewall)
         self._guards: dict[str, InputGuard] = {}
         self._monitors: dict[str, DriftMonitor] = {}
+        #: per-model (threshold, window_rows, trip_after) from add_model,
+        #: so a swap_model that has to CREATE a monitor keeps the tuning
+        self._drift_params: dict[str, tuple[float, int, int]] = {}
         self._monitor_width_warned: set[str] = set()
+        #: attached lifecycle controller (ISSUE 9): canary routing, shadow
+        #: scoring, and the health() lifecycle fragment all hang off it
+        self._lifecycle = None
+        #: serializes hot swaps so the registry flip and the drift-
+        #: reference rebase land as one operation
+        self._swap_lock = threading.Lock()
         self._started = False
 
     def _breaker_for(self, name: str) -> CircuitBreaker:
@@ -113,6 +124,9 @@ class InferenceServer:
             sm = self.registry.register(
                 name, model, n_features=n_features, buckets=buckets
             )
+        self._drift_params[name] = (
+            drift_threshold, drift_window_rows, drift_trip_after
+        )
         if data_profile is not None:
             profile = DataProfile.from_dict(data_profile)
             self._monitors[name] = DriftMonitor(
@@ -135,6 +149,105 @@ class InferenceServer:
                 metrics=self.metrics, breaker=self._breaker_for(name),
             ).start()
         return sm
+
+    def swap_model(
+        self,
+        name: str,
+        model: Model | str,
+        n_features: int | None = None,
+        buckets: Sequence[int] | None = None,
+        data_profile: dict | DataProfile | None = None,
+    ) -> ServingModel:
+        """Hot-swap the model behind ``name`` — the promotion primitive.
+
+        The new executable is built and warmed FIRST (no request ever
+        pays its compile), then under one lock:
+
+        1. the drift monitor's PSI reference is **rebased** to
+           ``data_profile`` (the candidate's training profile) — atomic
+           with the flip, because scoring post-flip traffic against the
+           OLD training profile would re-trip the breaker forever: the
+           drift that triggered the retrain is exactly the distribution
+           the new model was trained on;
+        2. the registry entry and the live batcher's model flip;
+        3. the circuit breaker resets — opens accumulated against the
+           predecessor (drift trips included) say nothing about the
+           successor.
+
+        Rebase lands *before* the flip, so the worst interleaving is one
+        window of old-model traffic scored against the new reference
+        (same distribution — harmless), never new-model traffic against
+        the stale one.  Requests in flight on the old executable finish
+        on it; nothing is ever refused because of a swap.
+        """
+        if isinstance(model, str):
+            if data_profile is None:
+                data_profile = load_data_profile(model)
+            model = load_model(model)
+        if buckets is None:
+            try:
+                buckets = self.registry.get(name).buckets
+            except KeyError:
+                buckets = DEFAULT_BUCKETS
+        sm = ServingModel(
+            model, n_features=n_features, buckets=buckets,
+            metrics=self.metrics,
+        )
+        if self._started:
+            sm.warmup()
+        profile = None
+        if data_profile is not None:
+            profile = (
+                data_profile if isinstance(data_profile, DataProfile)
+                else DataProfile.from_dict(data_profile)
+            )
+        elif name in self._monitors:
+            # the re-trip hazard this method exists to fix, reintroduced
+            # by omission: the new model will be PSI-scored against its
+            # predecessor's training profile — say so loudly
+            log.warning(
+                "model swapped WITHOUT a data_profile: drift reference "
+                "stays on the predecessor's training profile and may "
+                "re-trip the breaker on the new model's own distribution",
+                model=name,
+            )
+        fault_point("lifecycle.registry.swap", model=name)
+        with self._swap_lock:
+            if profile is not None:
+                mon = self._monitors.get(name)
+                if mon is not None:
+                    mon.rebase(profile)
+                else:
+                    th, wr, ta = self._drift_params.get(
+                        name, (PSI_DRIFT, 512, 3)
+                    )
+                    self._monitors[name] = DriftMonitor(
+                        profile, threshold=th, window_rows=wr, trip_after=ta
+                    )
+                guard = self._guards.get(name)
+                if guard is not None:
+                    self._guards[name] = InputGuard(
+                        profile, policy=guard.policy
+                    )
+            self.registry.install(name, sm)
+            batcher = self._batchers.get(name)
+            if batcher is not None:
+                batcher.model = sm
+            breaker = self._breakers.get(name)
+            if breaker is not None:
+                breaker.reset("model swap")
+            self._monitor_width_warned.discard(name)
+        log.info(
+            "model hot-swapped", name=name, family=type(model).__name__,
+            profile_rebased=profile is not None,
+        )
+        return sm
+
+    def attach_lifecycle(self, controller) -> None:
+        """Wire a :class:`~..lifecycle.controller.LifecycleController` into
+        the request path: canary routing (``on_request``), shadow/drift
+        observation (``on_result``), and the ``lifecycle`` health key."""
+        self._lifecycle = controller
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceServer":
@@ -231,15 +344,48 @@ class InferenceServer:
         x, refused = self._guard_input(name, x)
         if refused is not None:
             return refused
+        lc = self._lifecycle
+        if lc is not None:
+            # canary split: during CANARY the controller answers a
+            # deterministic fraction of requests with the candidate,
+            # tagged STATUS_CANARY (ok=True — a full-quality answer,
+            # attributed); None keeps the request on the primary path.
+            # The clock starts BEFORE the candidate predict so the
+            # latency the client (and the p50/p99 reservoir) sees is the
+            # real candidate compute cost, not ~0.
+            t0 = time.monotonic()
+            canary = lc.on_request(name, x)
+            if canary is not None:
+                req = Request(
+                    x=np.atleast_2d(np.asarray(x, dtype=np.float64)),
+                    enqueued_at=t0, deadline=None,
+                )
+                req.complete(canary)
+                self.metrics.record_request(canary.latency_s, canary.status)
+                return req
         return batcher.submit(x, deadline_s=deadline_s)
 
     def predict(
         self, name: str, x: np.ndarray, deadline_s: float | None = None,
         wait_timeout_s: float | None = 30.0,
     ) -> ServeResult:
-        return self.submit(name, x, deadline_s=deadline_s).wait(
-            wait_timeout_s
-        )
+        req = self.submit(name, x, deadline_s=deadline_s)
+        result = req.wait(wait_timeout_s)
+        lc = self._lifecycle
+        if lc is not None and result.status != STATUS_INVALID_INPUT:
+            # post-answer observation: drift windows, the metric-decay
+            # trigger, shadow scoring, canary accounting.  Observes
+            # req.x — the GUARDED rows the model actually saw (imputed,
+            # never the refused garbage), so one NaN request cannot
+            # poison the evaluation window a promotion gate scores on.
+            # The async submit() path skips this hook (no rendezvous to
+            # observe); lifecycle-governed traffic goes through predict().
+            try:
+                lc.on_result(name, req.x, result)
+            except Exception as e:  # noqa: BLE001 — observation must
+                # never cost a client its (already computed) answer
+                log.warning("lifecycle on_result failed", error=repr(e))
+        return result
 
     # ------------------------------------------------------------ observe
     def stats(self) -> dict[str, Any]:
@@ -276,12 +422,20 @@ class InferenceServer:
             self.ingest_metrics.counters if self.ingest_metrics is not None
             else serve_c  # a shared registry folds ingest counters in
         )
+        lifecycle = None
+        if self._lifecycle is not None:
+            try:
+                lifecycle = self._lifecycle.health_fragment()
+            except Exception as e:  # noqa: BLE001 — a broken controller
+                # must not take down the health endpoint reporting it
+                lifecycle = {"error": repr(e)}
         return {
             "status": (
                 "stopped" if not self._started
                 else "degraded" if degraded else "ok"
             ),
             "started": self._started,
+            "lifecycle": lifecycle,
             "models_serving": sorted(self._batchers),
             "breakers": breakers,
             "drift": drift,

@@ -69,9 +69,14 @@ class UnboundedTable:
             out[int(e["batch_id"])] = e  # later replay wins
         return out
 
-    def read(self) -> Table:
+    def read(self, upto_batch_id: int | None = None) -> Table:
         """Snapshot of all committed rows (the reference's ``spark.sql``
         over the output table reads exactly this view, ``:123-128``).
+
+        ``upto_batch_id`` pins the snapshot to batches with id ≤ it — the
+        lifecycle controller journals that id when a retrain begins, so a
+        killed-and-resumed retrain reads EXACTLY the rows the original
+        attempt saw even while ingest keeps appending underneath it.
 
         Memoized per commit-log state: between appends, every ``read()``
         returns the SAME ``Table`` instance, so the compiled SQL
@@ -84,13 +89,21 @@ class UnboundedTable:
         import pyarrow as pa
 
         entries = self.committed_batches()
+        if upto_batch_id is not None:
+            entries = {
+                bid: e for bid, e in entries.items() if bid <= upto_batch_id
+            }
         key = tuple(
             (bid, entries[bid]["file"], entries[bid]["rows"])
             for bid in sorted(entries)
         )
-        cached = getattr(self, "_snapshot", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        # keyed (not single-slot) memo: a pinned retrain read
+        # (upto_batch_id) must not evict the full snapshot the compiled
+        # SQL path holds device columns against, and vice versa
+        cache: dict = getattr(self, "_snapshots", None) or {}
+        self._snapshots = cache
+        if key in cache:
+            return cache[key]
         parts = []
         for bid in sorted(entries):
             p = os.path.join(self.path, entries[bid]["file"])
@@ -102,7 +115,9 @@ class UnboundedTable:
             # schema inferred from the data: committed batches carry derived
             # columns (ingest_time, :82) beyond the declared source schema
             t = Table.from_arrow(pa.concat_tables(parts))
-        self._snapshot = (key, t)
+        while len(cache) >= 4:  # a few live views, never unbounded growth
+            cache.pop(next(iter(cache)))
+        cache[key] = t
         return t
 
     def num_rows(self) -> int:

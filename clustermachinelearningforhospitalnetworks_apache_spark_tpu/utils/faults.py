@@ -42,6 +42,22 @@ a chaos test replays the identical dirty bytes every run:
   classic silent hours→minutes unit change)
 * ``nan_burst``      — blank a contiguous run of one column's values
 
+Lifecycle sites (ISSUE 9) — the continuous-learning controller names a
+fault site at every state-transition boundary, so the chaos matrix can
+kill the loop anywhere and assert it self-heals (tests/test_lifecycle.py,
+tools/run_chaos.sh):
+
+* ``lifecycle.journal.append``  — before a transition's WAL entry lands
+* ``lifecycle.retrain.commit``  — after the candidate artifact commits,
+  before the SHADOW transition is journaled
+* ``lifecycle.shadow.start``    — arming the candidate for shadow scoring
+* ``lifecycle.registry.flip``   — the promotion decision, pre-journal
+* ``lifecycle.registry.swap``   — applying the flip to the live server
+* ``lifecycle.rollback``        — refusing a candidate, pre-journal
+* ``lifecycle.feedback.flush``  — spooled feedback rows → ingest CSV
+* ``lifecycle.feedback.compact``— after flush commit, before the WAL
+  compaction (the double-flush hazard window)
+
 Everything is counted (calls per site, fires per rule) so tests can assert
 a fault actually happened — a chaos test whose fault never fired proves
 nothing.

@@ -1,0 +1,197 @@
+"""One full continuous-learning cycle, with a mid-cycle kill.
+
+The loop the hospital-network paper gestures at (``ML()`` over a stream
+snapshot) taken to production semantics: a KMeans cohort model serves
+live traffic; served predictions and later-arriving outcomes re-enter
+the SAME exactly-once ingest as any hospital feed; the feed then drifts
+(a unit/protocol change shifts every feature), the PSI monitor confirms
+sustained drift, and the lifecycle controller
+
+1. journals RETRAINING with a pinned ingest-table snapshot,
+2. warm-starts a refit from the serving artifact's centers (resumable
+   through fit checkpoints),
+3. shadow-scores the candidate on live traffic and passes the parity
+   gate,
+4. canary-routes a deterministic fraction of real answers to it
+   (responses tagged ``canary``),
+5. promotes: one atomic registry flip + PSI-reference rebase + breaker
+   reset — and the journal records every hop.
+
+Halfway through, this script KILLS the controller at the retrain-commit
+boundary (the same seeded fault machinery the chaos suite uses) and
+rebuilds everything from disk — the restarted loop resumes exactly
+where it died and finishes the promotion.
+
+    PYTHONPATH=. python examples/continuous_learning.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+try:  # installed copy (pip install -e .) takes precedence
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu  # noqa: F401
+except ImportError:  # running from a raw checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.lifecycle import (
+    FeedbackBuffer,
+    KMeansRetrainer,
+    LifecycleController,
+    STATE_SERVING,
+    feedback_schema,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+    KMeans,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.quality.sketches import (
+    DataProfile,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+    InferenceServer,
+    STATUS_CANARY,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+    FileStreamSource,
+    StreamCheckpoint,
+    StreamExecution,
+    UnboundedTable,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+
+FEATS = ("admissions", "occupancy", "acuity")
+K = 4
+CENTERS = np.array(
+    [[0, 0, 0], [4, 0, 0], [0, 4, 0], [4, 4, 4]], dtype=np.float64
+)
+
+
+def cohorts(rng, n, shift=0.0):
+    """Patient-cohort feature rows; ``shift`` models the protocol change."""
+    return (CENTERS + shift)[rng.integers(0, K, n)] + rng.normal(
+        scale=0.3, size=(n, 3)
+    )
+
+
+def build(work):
+    """One process incarnation over the durable state in ``work`` —
+    calling it again after a crash IS the restart."""
+    schema = feedback_schema(FEATS)
+    incoming = os.path.join(work, "incoming")
+    os.makedirs(incoming, exist_ok=True)
+    stream = StreamExecution(
+        source=FileStreamSource(incoming, schema),
+        sink=UnboundedTable(os.path.join(work, "table"), schema),
+        checkpoint=StreamCheckpoint(os.path.join(work, "ckpt")),
+        add_ingest_time=False,
+    )
+    server = InferenceServer(breaker_recovery_s=0.2)
+    controller = LifecycleController(
+        os.path.join(work, "lifecycle"), server, "cohorts",
+        KMeansRetrainer(FEATS, k=K, max_iter=40, tol=1e-4),
+        stream=stream,
+        feedback=FeedbackBuffer(
+            os.path.join(work, "feedback"), FEATS, incoming
+        ),
+        buckets=(1, 8, 32),
+        drift_window_rows=64, drift_trip_after=2,
+        shadow_min_rows=128, canary_fraction=0.25, canary_min_rows=32,
+        eval_rows=128,
+    )
+    server.attach_lifecycle(controller)
+    return server, stream, controller
+
+
+def main() -> None:
+    work = tempfile.mkdtemp(prefix="continuous_learning_")
+    rng = np.random.default_rng(0)
+
+    # ---- §1 baseline: train, profile, bootstrap version 0 --------------
+    x0 = cohorts(rng, 2000).astype(np.float32)
+    baseline = KMeans(k=K, seed=0, max_iter=20).fit(x0)
+    profile = DataProfile.from_matrix(x0.astype(np.float64), FEATS)
+    server, stream, ctrl = build(work)
+    ctrl.bootstrap(baseline, profile, train_x=x0)
+    server.start()
+    print(f"§1 serving baseline v0 (cost/row "
+          f"{ctrl.baseline_metric:.3f}), journal at {ctrl.journal.path}")
+
+    # ---- §2 the feedback loop: predictions + outcomes re-enter ingest --
+    traffic = np.random.default_rng(1)
+    for _ in range(12):
+        row = cohorts(traffic, 1).astype(np.float32)
+        r = server.predict("cohorts", row, wait_timeout_s=10.0)
+        fid = ctrl.record_served(row[0], float(np.asarray(r.value)[0]))
+        ctrl.record_outcome(fid, float(np.asarray(r.value)[0]))  # confirmed
+    ctrl.ingest_once()  # flush joined rows -> incoming -> unbounded table
+    print(f"§2 feedback: {stream.sink.num_rows()} joined rows back in the "
+          "unbounded table (exactly-once, firewall-eligible)")
+
+    # ---- §3 the feed drifts: protocol change shifts every feature ------
+    SHIFT = 6.0
+    drift_rng = np.random.default_rng(2)
+    schema = feedback_schema(FEATS)
+    for i in range(2):
+        x = cohorts(drift_rng, 300, SHIFT)
+        cols = {n: x[:, j] for j, n in enumerate(FEATS)}
+        cols["prediction"] = np.zeros(len(x))
+        cols["outcome"] = np.zeros(len(x))
+        ht.io.write_csv(
+            ht.Table.from_dict(cols, schema),
+            os.path.join(work, "incoming", f"drifted-{i}.csv"),
+        )
+    while stream.run_once() is not None:
+        pass
+    print(f"§3 drifted feed ingested ({stream.sink.num_rows()} rows total)")
+
+    # ---- §4 drive the loop — and kill it at the retrain commit ---------
+    faults.install(faults.FaultPlan().crash("lifecycle.retrain.commit"))
+    statuses: dict[str, int] = {}
+    crashed = False
+    step = 0
+    while True:
+        step += 1
+        try:
+            xb = cohorts(traffic, 8, SHIFT).astype(np.float32)
+            r = server.predict("cohorts", xb, wait_timeout_s=10.0)
+            statuses[r.status] = statuses.get(r.status, 0) + 1
+            ctrl.poll()
+        except faults.InjectedCrash:
+            crashed = True
+            faults.clear()
+            print(f"§4 KILLED at lifecycle.retrain.commit (step {step}) — "
+                  "rebuilding from disk…")
+            server.stop()
+            server, stream, ctrl = build(work)   # the supervisor restart
+            server.start()
+            print(f"    resumed in state {ctrl.state!r} "
+                  f"(cycle {ctrl.cycle}) — the journal remembers")
+            continue
+        if ctrl.state == STATE_SERVING and (ctrl.active_version or 0) > 0:
+            break
+    assert crashed, "the demo kill never fired"
+
+    # ---- §5 promoted: new reference, clean breaker, full audit trail ---
+    h = server.health()["lifecycle"]
+    print(f"§5 PROMOTED after {step} traffic steps: serving "
+          f"v{h['active_version']} (artifact crc {h['active_model_id']}), "
+          f"cost/row {h['baseline_metric']:.3f}")
+    print(f"    canary answers served: {statuses.get(STATUS_CANARY, 0)} "
+          f"(status {STATUS_CANARY!r}), primary: {statuses.get('ok', 0)}")
+    print(f"    drift after rebase: max PSI "
+          f"{h['drift']['max_psi']:.3f} (reference now the candidate's "
+          f"training profile; rebases={h['drift']['rebases']})")
+    print("    journal:", " → ".join(
+        e["state"] for e in ctrl.journal.entries()
+    ))
+    server.stop()
+    print(f"\nartifacts kept under {work} (models/v0, models/v1, journal)")
+
+
+if __name__ == "__main__":
+    main()

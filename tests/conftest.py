@@ -94,6 +94,12 @@ def pytest_configure(config):
         "perf: performance-contract tests — pipelined-vs-serial parity, "
         "donation/zero-recompile, bench plumbing (pytest -m perf)",
     )
+    config.addinivalue_line(
+        "markers",
+        "lifecycle: continuous-learning loop tests — drift-triggered "
+        "retrain, shadow/canary promotion, journal recovery "
+        "(pytest -m lifecycle)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

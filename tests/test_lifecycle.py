@@ -1,0 +1,993 @@
+"""Continuous-learning loop suite (ISSUE 9).
+
+The acceptance story: a lifecycle controller that closes drift detection →
+warm retrain → shadow → canary → promotion into one journaled state
+machine, and SURVIVES a process kill at every stage boundary — the chaos
+matrix asserts the resumed loop converges on a final served model
+bit-identical to an uninterrupted run, the parity gate blocks a degraded
+candidate, rollback leaves the prior artifact byte-for-byte untouched,
+and feedback rows spooled for re-ingest are never lost.
+
+Every injected fault is asserted to have FIRED (a chaos test whose fault
+never triggered proves nothing), same discipline as tests/test_chaos.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+    artifact_fingerprint,
+    load_model,
+    write_csv,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.lifecycle import (
+    FeedbackBuffer,
+    KMeansRetrainer,
+    LifecycleController,
+    LifecycleJournal,
+    STATE_CANARY,
+    STATE_DRIFT_SUSPECTED,
+    STATE_RETRAINING,
+    STATE_ROLLED_BACK,
+    STATE_SERVING,
+    STATE_SHADOW,
+    feedback_schema,
+    kmeans_cost,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+    KMeans,
+    KMeansModel,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.quality.drift import (
+    DriftMonitor,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.quality.sketches import (
+    DataProfile,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+    DEGRADED_STATUSES,
+    InferenceServer,
+    STATUS_CANARY,
+    ServeResult,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+    FileStreamSource,
+    StreamCheckpoint,
+    StreamExecution,
+    UnboundedTable,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+
+pytestmark = pytest.mark.lifecycle
+
+FEATS = ("f0", "f1", "f2")
+K = 4
+SHIFT = 6.0
+BLOB_CENTERS = np.array(
+    [[0, 0, 0], [4, 0, 0], [0, 4, 0], [4, 4, 4]], dtype=np.float64
+)
+
+
+def _blobs(rng, n, shift=0.0):
+    idx = rng.integers(0, K, n)
+    return (BLOB_CENTERS + shift)[idx] + rng.normal(scale=0.3, size=(n, 3))
+
+
+def _drop_file(incoming, path_name, x):
+    schema = feedback_schema(FEATS)
+    cols = {n: x[:, j] for j, n in enumerate(FEATS)}
+    cols["prediction"] = np.zeros(len(x))
+    cols["outcome"] = np.zeros(len(x))
+    write_csv(ht.Table.from_dict(cols, schema), os.path.join(incoming, path_name))
+
+
+# ------------------------------------------------------------------ harness
+@pytest.fixture(scope="module")
+def baseline():
+    """One baseline fit shared by every test: model + training profile."""
+    rng = np.random.default_rng(0)
+    x0 = _blobs(rng, 1500).astype(np.float32)
+    model = KMeans(k=K, seed=0, max_iter=20).fit(x0)
+    profile = DataProfile.from_matrix(x0.astype(np.float64), FEATS)
+    return model, profile, x0
+
+
+def _build(work, retrainer=None, **overrides):
+    """One 'process incarnation': server + ingest stream + controller over
+    the durable state in ``work`` — calling it again IS the restart."""
+    incoming = os.path.join(work, "incoming")
+    os.makedirs(incoming, exist_ok=True)
+    schema = feedback_schema(FEATS)
+    stream = StreamExecution(
+        source=FileStreamSource(incoming, schema),
+        sink=UnboundedTable(os.path.join(work, "table"), schema),
+        checkpoint=StreamCheckpoint(os.path.join(work, "ckpt")),
+        add_ingest_time=False,
+    )
+    srv = InferenceServer(breaker_recovery_s=0.1)
+    kwargs = dict(
+        stream=stream,
+        buckets=(1, 8, 32),
+        drift_window_rows=64,
+        drift_trip_after=2,
+        shadow_min_rows=128,
+        canary_fraction=0.25,
+        canary_min_rows=32,
+        eval_rows=128,
+    )
+    kwargs.update(overrides)
+    ctrl = LifecycleController(
+        os.path.join(work, "lc"), srv, "kmeans",
+        retrainer or KMeansRetrainer(FEATS, k=K, max_iter=30, tol=1e-4),
+        **kwargs,
+    )
+    srv.attach_lifecycle(ctrl)
+    return srv, stream, ctrl
+
+
+def _seed_world(work, baseline, n_files=2, rows=300):
+    """Bootstrap v0 and ingest the full drifted dataset up front, so the
+    retrain snapshot is identical across every (killed or not) run."""
+    model, profile, x0 = baseline
+    srv, stream, ctrl = _build(work)
+    ctrl.bootstrap(model, profile, train_x=x0)
+    drng = np.random.default_rng(7)
+    for i in range(n_files):
+        _drop_file(
+            os.path.join(work, "incoming"), f"drift-{i}.csv",
+            _blobs(drng, rows, SHIFT),
+        )
+    while stream.run_once() is not None:
+        pass
+    return srv, stream, ctrl
+
+
+def _drive(srv, ctrl, *, until, max_steps=600, poll=True, shift=SHIFT, seed=1):
+    """Deterministic drifted traffic until ``until(ctrl)`` holds."""
+    trng = np.random.default_rng(seed)
+    for _ in range(max_steps):
+        xb = _blobs(trng, 8, shift).astype(np.float32)
+        srv.predict("kmeans", xb, wait_timeout_s=10.0)
+        if poll:
+            ctrl.poll()
+        if until(ctrl):
+            return
+    raise AssertionError(
+        f"condition never reached; state={ctrl.state} "
+        f"cycle={ctrl.cycle} active=v{ctrl.active_version}"
+    )
+
+
+def _promoted(ctrl):
+    return (
+        ctrl.state == STATE_SERVING
+        and ctrl.active_version is not None
+        and ctrl.active_version > 0
+    )
+
+
+def _run_to_promotion(work, baseline, kill_site=None):
+    """Full cycle, restarting through InjectedCrash like a supervisor
+    would; → (controller, crash count)."""
+    srv, stream, ctrl = _seed_world(work, baseline)
+    srv.start()
+    crashes = 0
+    plan = None
+    if kill_site:
+        plan = faults.FaultPlan().crash(kill_site)
+        faults.install(plan)
+    try:
+        while True:
+            try:
+                _drive(srv, ctrl, until=_promoted)
+                break
+            except faults.InjectedCrash:
+                crashes += 1
+                faults.clear()
+                srv.stop()
+                srv, stream, ctrl = _build(work)  # the restart
+                srv.start()
+    finally:
+        faults.clear()
+        srv.stop()
+    if kill_site:
+        assert plan.fired(kill_site) >= 1, f"{kill_site} never fired"
+        assert crashes >= 1
+    return ctrl, crashes
+
+
+@pytest.fixture(scope="module")
+def reference_run(tmp_path_factory, baseline):
+    """The uninterrupted drift→retrain→promote cycle every chaos case is
+    compared against."""
+    work = str(tmp_path_factory.mktemp("lc_reference"))
+    ctrl, crashes = _run_to_promotion(work, baseline)
+    assert crashes == 0
+    model = load_model(os.path.join(work, "lc", "models", "v1"))
+    return np.asarray(model.cluster_centers)
+
+
+# ------------------------------------------------------------------ journal
+def test_journal_roundtrip_crc_detects_corruption(tmp_path):
+    j = LifecycleJournal(str(tmp_path / "journal.log"))
+    j.append(STATE_SERVING, 0, {"active_version": 0})
+    j.append(STATE_DRIFT_SUSPECTED, 0, {"reason": "psi"})
+    j.append(STATE_RETRAINING, 1, {"candidate_version": 1})
+    assert [e["state"] for e in j.entries()] == [
+        STATE_SERVING, STATE_DRIFT_SUSPECTED, STATE_RETRAINING,
+    ]
+    # flip one byte inside the middle entry's payload: CRC must catch what
+    # JSON parsing alone would happily accept
+    with open(j.path, "rb") as f:
+        lines = f.readlines()
+    line = bytearray(lines[1])
+    i = line.index(b"psi")
+    line[i] = ord(b"q")
+    lines[1] = bytes(line)
+    with open(j.path, "wb") as f:
+        f.writelines(lines)
+    j2 = LifecycleJournal(j.path)
+    states = [e["state"] for e in j2.entries()]
+    assert states == [STATE_SERVING, STATE_RETRAINING]
+    assert j2.corrupt_skipped == 1
+    assert j2.last()["state"] == STATE_RETRAINING
+
+
+def test_journal_torn_append_loses_only_the_tail(tmp_path):
+    j = LifecycleJournal(str(tmp_path / "journal.log"))
+    j.append(STATE_SERVING, 0, {})
+    plan = faults.FaultPlan().tear(
+        "wal.append", at_byte=10,
+        when=lambda ctx: str(ctx.get("path", "")).endswith("journal.log"),
+    )
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            j.append(STATE_DRIFT_SUSPECTED, 0, {})
+    assert plan.fired("wal.append") == 1
+    j2 = LifecycleJournal(j.path)
+    assert j2.last()["state"] == STATE_SERVING  # torn entry dropped
+    j2.append(STATE_DRIFT_SUSPECTED, 0, {})    # and the log keeps working
+    assert j2.last()["state"] == STATE_DRIFT_SUSPECTED
+
+
+# --------------------------------------------------------------- warm start
+def test_warm_start_shape_validation():
+    x = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+    with pytest.raises(ValueError, match="warm_start_centers"):
+        KMeans(k=K, warm_start_centers=np.zeros((K, 7))).fit(x)
+
+
+@pytest.fixture(scope="module")
+def hard_mixture():
+    """An OVERLAPPING 16-cluster 8-d mixture (well-separated blobs let
+    even cold k-means++ converge in 2 Lloyd steps — no trajectory to
+    save): pre-drift data, post-drift data (+0.3 shift), and the cold
+    pre-drift fit whose centers seed the warm starts."""
+    rng = np.random.default_rng(3)
+    true = rng.normal(scale=1.5, size=(16, 8))
+
+    def draw(shift):
+        idx = rng.integers(0, 16, 6000)
+        return (
+            (true + shift)[idx] + rng.normal(scale=1.0, size=(6000, 8))
+        ).astype(np.float32)
+
+    xa, xb = draw(0.0), draw(0.3)
+    model_a = KMeans(k=16, seed=5, max_iter=80, tol=1e-5).fit(xa)
+    return xa, xb, model_a
+
+
+def test_warm_start_converges_in_fewer_iterations(hard_mixture):
+    # warm-start from a converged solution must terminate almost
+    # immediately (the skipped trajectory IS the warm-retrain win), while
+    # the cold fit on the same overlapping mixture pays the full path
+    _, xb, _ = hard_mixture
+    cold_iters, warm_iters = [], []
+    cold_model = KMeans(k=16, seed=5, max_iter=80, tol=1e-5).fit(
+        xb, on_iteration=lambda it, c, m: cold_iters.append(it)
+    )
+    warm_centers = np.asarray(cold_model.cluster_centers, dtype=np.float32)
+    warm_model = KMeans(
+        k=16, seed=5, max_iter=80, tol=1e-5, warm_start_centers=warm_centers
+    ).fit(xb, on_iteration=lambda it, c, m: warm_iters.append(it))
+    assert 1 <= len(warm_iters) <= 3 < len(cold_iters), (
+        f"warm start did not skip the trajectory: warm={len(warm_iters)} "
+        f"cold={len(cold_iters)} iterations"
+    )
+    assert warm_model.training_cost <= cold_model.training_cost * 1.001
+
+
+def test_warm_start_signature_guards_checkpoint_resume(
+    tmp_path, hard_mixture
+):
+    _, xb, model_a = hard_mixture
+    ckpt = str(tmp_path / "ckpt")
+    warm_a = (np.asarray(model_a.cluster_centers) + 0.3).astype(np.float32)
+
+    def kill_at_3(it, cost, move):
+        if it >= 3:
+            raise faults.InjectedCrash("mid-fit kill")
+
+    est = KMeans(k=16, seed=5, max_iter=40, tol=1e-5, checkpoint_dir=ckpt,
+                 checkpoint_every=1, warm_start_centers=warm_a)
+    with pytest.raises(faults.InjectedCrash):
+        est.fit(xb, on_iteration=kill_at_3)
+    # resuming with DIFFERENT warm centers is a different trajectory:
+    # the signature must refuse, not silently continue
+    with pytest.raises(ValueError, match="signature mismatch"):
+        KMeans(k=16, seed=5, max_iter=40, tol=1e-5, checkpoint_dir=ckpt,
+               checkpoint_every=1,
+               warm_start_centers=warm_a + 1.0).fit(xb)
+    resumed = est.fit(xb)
+    uninterrupted = KMeans(
+        k=16, seed=5, max_iter=40, tol=1e-5, warm_start_centers=warm_a
+    ).fit(xb)
+    np.testing.assert_array_equal(
+        resumed.cluster_centers, uninterrupted.cluster_centers
+    )
+
+
+# ------------------------------------------------------------- happy path
+def test_full_cycle_promotes_new_model(tmp_path, baseline):
+    work = str(tmp_path)
+    ctrl, crashes = _run_to_promotion(work, baseline)
+    assert crashes == 0
+    states = [e["state"] for e in ctrl.journal.entries()]
+    assert states == [
+        STATE_SERVING, STATE_DRIFT_SUSPECTED, STATE_RETRAINING, STATE_SHADOW,
+        STATE_CANARY, "promoted", STATE_SERVING,
+    ]
+    assert ctrl.active_version == 1
+    # the promoted model actually fits the drifted distribution
+    drifted = _blobs(np.random.default_rng(9), 256, SHIFT)
+    new_model = load_model(os.path.join(work, "lc", "models", "v1"))
+    old_model = load_model(os.path.join(work, "lc", "models", "v0"))
+    assert kmeans_cost(new_model, drifted) < 0.1 * kmeans_cost(
+        old_model, drifted
+    )
+    # retrain was warm-started and journaled so
+    shadow = next(
+        e for e in ctrl.journal.entries() if e["state"] == STATE_SHADOW
+    )
+    assert shadow["info"]["warm_started"] is True
+    assert shadow["info"]["train_rows"] == 600
+
+
+# ------------------------------------------------------------ chaos matrix
+KILL_SITES = [
+    "lifecycle.journal.append",
+    "lifecycle.retrain.commit",
+    "lifecycle.shadow.start",
+    "lifecycle.registry.flip",
+    "lifecycle.registry.swap",
+]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", KILL_SITES)
+def test_kill_and_resume_converges_bit_identical(
+    tmp_path, baseline, reference_run, site
+):
+    """Kill the controller at each transition boundary; the restarted loop
+    must self-heal to PROMOTED with the final served model bit-identical
+    to the uninterrupted run's."""
+    ctrl, crashes = _run_to_promotion(str(tmp_path), baseline, kill_site=site)
+    assert crashes >= 1
+    assert ctrl.active_version == 1
+    final = load_model(
+        os.path.join(str(tmp_path), "lc", "models", "v1")
+    )
+    np.testing.assert_array_equal(
+        np.asarray(final.cluster_centers), reference_run
+    )
+
+
+class _DegradedRetrainer(KMeansRetrainer):
+    """Trains fine, then ships garbage centers — the candidate the parity
+    gate exists to refuse."""
+
+    def __call__(self, warm_model, table, ckpt_dir, seed):
+        model, profile = super().__call__(warm_model, table, ckpt_dir, seed)
+        bad = np.asarray(model.cluster_centers) + 50.0
+        return KMeansModel(
+            cluster_centers=bad,
+            distance_measure=model.distance_measure,
+            training_cost=model.training_cost,
+            n_iter=model.n_iter,
+            cluster_sizes=model.cluster_sizes,
+        ), profile
+
+
+def _rolled_back(ctrl):
+    return ctrl.state == STATE_SERVING and any(
+        e["state"] == STATE_ROLLED_BACK for e in ctrl.journal.entries()
+    )
+
+
+def _run_to_rollback(work, baseline, kill_site=None):
+    model, profile, x0 = baseline
+    srv, stream, ctrl = _build(
+        work, retrainer=_DegradedRetrainer(FEATS, k=K, max_iter=30, tol=1e-4)
+    )
+    ctrl.bootstrap(model, profile, train_x=x0)
+    drng = np.random.default_rng(7)
+    for i in range(2):
+        _drop_file(
+            os.path.join(work, "incoming"), f"drift-{i}.csv",
+            _blobs(drng, 300, SHIFT),
+        )
+    while stream.run_once() is not None:
+        pass
+    srv.attach_lifecycle(ctrl)
+    srv.start()
+    crashes = 0
+    plan = None
+    if kill_site:
+        plan = faults.FaultPlan().crash(kill_site)
+        faults.install(plan)
+    try:
+        while True:
+            try:
+                _drive(srv, ctrl, until=_rolled_back)
+                break
+            except faults.InjectedCrash:
+                crashes += 1
+                faults.clear()
+                srv.stop()
+                srv, stream, ctrl = _build(
+                    work,
+                    retrainer=_DegradedRetrainer(
+                        FEATS, k=K, max_iter=30, tol=1e-4
+                    ),
+                )
+                srv.attach_lifecycle(ctrl)
+                srv.start()
+    finally:
+        faults.clear()
+        srv.stop()
+    if kill_site:
+        assert plan.fired(kill_site) >= 1, f"{kill_site} never fired"
+        assert crashes >= 1
+    return srv, ctrl, crashes
+
+
+def test_shadow_gate_blocks_degraded_candidate(tmp_path, baseline):
+    srv, ctrl, _ = _run_to_rollback(str(tmp_path), baseline)
+    states = [e["state"] for e in ctrl.journal.entries()]
+    assert STATE_ROLLED_BACK in states
+    assert STATE_CANARY not in states  # refused at the shadow gate
+    assert ctrl.active_version == 0    # still the original baseline
+    rb = next(
+        e for e in ctrl.journal.entries() if e["state"] == STATE_ROLLED_BACK
+    )
+    assert "shadow parity" in rb["info"]["reason"]
+
+
+@pytest.mark.chaos
+def test_kill_at_rollback_resumes_to_prior_baseline(tmp_path, baseline):
+    srv, ctrl, crashes = _run_to_rollback(
+        str(tmp_path), baseline, kill_site="lifecycle.rollback"
+    )
+    assert crashes >= 1
+    assert ctrl.active_version == 0
+    assert ctrl.state == STATE_SERVING
+
+
+def test_rollback_restores_prior_artifact_byte_for_byte(tmp_path, baseline):
+    work = str(tmp_path)
+    v0 = os.path.join(work, "lc", "models", "v0")
+
+    def artifact_bytes():
+        out = {}
+        for name in sorted(os.listdir(v0)):
+            with open(os.path.join(v0, name), "rb") as f:
+                out[name] = f.read()
+        return out
+
+    model, profile, x0 = baseline
+    srv, stream, ctrl = _build(
+        work, retrainer=_DegradedRetrainer(FEATS, k=K, max_iter=30, tol=1e-4)
+    )
+    ctrl.bootstrap(model, profile, train_x=x0)
+    before = artifact_bytes()
+    fp_before = artifact_fingerprint(v0)
+    _drop_file(
+        os.path.join(work, "incoming"), "drift-0.csv",
+        _blobs(np.random.default_rng(7), 600, SHIFT),
+    )
+    while stream.run_once() is not None:
+        pass
+    srv.attach_lifecycle(ctrl)
+    with srv:
+        _drive(srv, ctrl, until=_rolled_back)
+    assert artifact_bytes() == before, "rollback modified the prior artifact"
+    assert artifact_fingerprint(v0) == fp_before
+    # the refused candidate stays on disk as evidence
+    assert os.path.isdir(os.path.join(work, "lc", "models", "v1"))
+
+
+# -------------------------------------------------------------- canary path
+def test_canary_tagging_and_health_fragment(tmp_path, baseline):
+    work = str(tmp_path)
+    srv, stream, ctrl = _seed_world(work, baseline)
+    # park the machine IN the canary phase: the decision needs more rows
+    # than this test will send
+    ctrl.canary_min_rows = 10**9
+    srv.start()
+    try:
+        _drive(srv, ctrl, until=lambda c: c.state == STATE_CANARY)
+        trng = np.random.default_rng(11)
+        statuses = []
+        for _ in range(40):
+            xb = _blobs(trng, 4, SHIFT).astype(np.float32)
+            r = srv.predict("kmeans", xb, wait_timeout_s=10.0)
+            statuses.append(r.status)
+            if r.status == STATUS_CANARY:
+                # canary answers are full-quality, never degraded — even
+                # while sustained drift holds the PRIMARY's breaker open
+                assert r.ok
+                assert r.value is not None and len(r.value) == 4
+                assert r.latency_s > 0.0
+            else:
+                # primary answers may legitimately degrade under the
+                # sustained drift that triggered this whole cycle
+                assert r.status in ("ok", "unavailable"), r.status
+        n_canary = statuses.count(STATUS_CANARY)
+        assert n_canary == 10, (  # stride 4 at fraction 0.25, counter-based
+            f"expected exactly 1-in-4 canary answers, got {n_canary}/40"
+        )
+        h = srv.health()
+        frag = h["lifecycle"]
+        assert frag["phase"] == STATE_CANARY
+        assert frag["candidate_version"] == 1
+        assert frag["candidate_model_id"] is not None
+        assert frag["shadow"]["rows_observed"] >= 128
+        assert frag["canary"]["fraction"] == 0.25
+        assert frag["canary"]["routed_to_candidate"] >= 10
+        assert frag["canary"]["canary_rows"] >= 40
+        assert frag["drift"] is not None
+    finally:
+        srv.stop()
+
+
+def test_status_canary_semantics():
+    assert ServeResult(np.zeros(1), STATUS_CANARY).ok
+    assert STATUS_CANARY not in DEGRADED_STATUSES
+
+
+# ---------------------------------------------------- drift-reference fix
+def test_promotion_rebases_psi_reference_regression(baseline):
+    """The re-trip bug: after a promotion, live traffic must be PSI-scored
+    against the CANDIDATE's training profile.  Scored against the stale
+    reference (the old registry.register route) the breaker re-trips on
+    perfectly healthy traffic; swap_model must not."""
+    model, profile, x0 = baseline
+    rng = np.random.default_rng(21)
+    drifted = _blobs(rng, 4000, SHIFT)
+    candidate = KMeans(k=K, seed=1, max_iter=20).fit(
+        drifted.astype(np.float32)
+    )
+    cand_profile = DataProfile.from_matrix(drifted, FEATS)
+
+    def feed(srv):
+        t = np.random.default_rng(22)
+        trips = 0
+        for _ in range(40):
+            xb = _blobs(t, 16, SHIFT).astype(np.float32)
+            srv.predict("kmeans", xb, wait_timeout_s=10.0)
+            snap = srv.health()
+            trips = snap["drift"]["kmeans"]["trips"]
+        return trips
+
+    # the BUG route: flip the registry without touching the monitor
+    srv = InferenceServer(breaker_recovery_s=30.0)
+    srv.add_model(
+        "kmeans", model, buckets=(1, 16, 32),
+        data_profile=profile.to_dict(),
+        drift_window_rows=64, drift_trip_after=2,
+    )
+    with srv:
+        srv.registry.register("kmeans", candidate, buckets=(1, 16, 32))
+        srv._batchers["kmeans"].model = srv.registry.get("kmeans")
+        assert feed(srv) >= 1, "stale reference should re-trip (bug repro)"
+
+    # the FIX: swap_model rebases the reference atomically with the flip
+    srv = InferenceServer(breaker_recovery_s=30.0)
+    srv.add_model(
+        "kmeans", model, buckets=(1, 16, 32),
+        data_profile=profile.to_dict(),
+        drift_window_rows=64, drift_trip_after=2,
+    )
+    with srv:
+        srv.swap_model(
+            "kmeans", candidate, data_profile=cand_profile.to_dict()
+        )
+        assert feed(srv) == 0, "rebased reference must not re-trip"
+        snap = srv.health()["drift"]["kmeans"]
+        assert snap["rebases"] == 1
+        assert snap["max_psi"] < 0.5
+        assert srv.health()["breakers"]["kmeans"]["state"] == "closed"
+
+
+def test_drift_monitor_rebase_resets_window_state(baseline):
+    _, profile, _ = baseline
+    mon = DriftMonitor(profile, window_rows=64, trip_after=1)
+    rng = np.random.default_rng(5)
+    drifted = _blobs(rng, 256, SHIFT)
+    mon.observe(drifted)
+    assert mon.should_trip()
+    new_ref = DataProfile.from_matrix(drifted, FEATS)
+    mon.rebase(new_ref)
+    assert mon.rebases == 1
+    assert not mon.drifting and mon.max_psi == 0.0
+    mon.observe(_blobs(rng, 256, SHIFT))
+    assert not mon.should_trip()
+    assert mon.max_psi < 0.5
+
+
+def test_swap_model_resets_breaker(baseline):
+    model, profile, _ = baseline
+    srv = InferenceServer(breaker_recovery_s=60.0)
+    srv.add_model("kmeans", model, buckets=(1, 8))
+    with srv:
+        srv._breaker_for("kmeans").trip("operator")
+        assert srv.health()["breakers"]["kmeans"]["state"] == "open"
+        srv.swap_model("kmeans", model, data_profile=profile.to_dict())
+        assert srv.health()["breakers"]["kmeans"]["state"] == "closed"
+        r = srv.predict(
+            "kmeans", np.zeros((1, 3), np.float32), wait_timeout_s=10.0
+        )
+        assert r.ok
+
+
+# ----------------------------------------------------------------- feedback
+def test_feedback_join_flush_and_restart(tmp_path):
+    root = str(tmp_path / "fb")
+    incoming = str(tmp_path / "incoming")
+    buf = FeedbackBuffer(root, FEATS, incoming)
+    ids = [buf.record_prediction([float(i), 0.0, 1.0], float(i)) for i in range(6)]
+    for i in ids[:4]:
+        buf.record_outcome(i, 10.0 + i)
+    assert buf.pending_outcomes() == 2
+    path = buf.flush()
+    assert path is not None and os.path.exists(path)
+    assert buf.flush() is None  # nothing new joined
+    # restart: spool state survives the WAL round-trip
+    buf2 = FeedbackBuffer(root, FEATS, incoming)
+    assert buf2.pending_outcomes() == 2
+    assert buf2.joined_unflushed() == []
+    buf2.record_outcome(ids[4], 99.0)
+    p2 = buf2.flush()
+    assert p2 is not None and p2 != path
+    t = ht.read_csv(path, feedback_schema(FEATS))
+    assert len(t) == 4
+    np.testing.assert_allclose(t.column("outcome"), [10.0, 11.0, 12.0, 13.0])
+
+
+@pytest.mark.chaos
+def test_feedback_flush_killed_between_intent_and_commit(tmp_path):
+    """A kill after the flush intent (and CSV) but before the commit marker
+    replays the SAME flush — same id, same rows, byte-identical file —
+    never a loss, never a duplicate."""
+    root = str(tmp_path / "fb")
+    incoming = str(tmp_path / "incoming")
+    buf = FeedbackBuffer(root, FEATS, incoming)
+    for i in range(5):
+        fid = buf.record_prediction([float(i), 2.0, 3.0], float(i))
+        buf.record_outcome(fid, float(i) * 2)
+    wal = os.path.join(root, "feedback.log")
+    plan = faults.FaultPlan().crash(
+        "wal.append", after=1,  # intent passes, the COMMIT append dies
+        when=lambda ctx: str(ctx.get("path", "")) == wal,
+    )
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            buf.flush()
+    assert plan.fired("wal.append") == 1
+    csv_path = os.path.join(incoming, "feedback-000000.csv")
+    assert os.path.exists(csv_path)  # the file landed before the kill
+    with open(csv_path, "rb") as f:
+        before = f.read()
+    buf2 = FeedbackBuffer(root, FEATS, incoming)
+    replayed = buf2.flush()
+    assert replayed == csv_path
+    with open(csv_path, "rb") as f:
+        assert f.read() == before  # byte-identical replay
+    assert buf2.flush() is None
+    assert len(os.listdir(incoming)) == 1  # exactly one feedback file
+
+
+@pytest.mark.chaos
+def test_feedback_rows_survive_stream_kill_and_replay(tmp_path):
+    """Flushed feedback rows ride the normal exactly-once ingest: a kill
+    between sink append and commit replays the batch, and the unbounded
+    table ends with every feedback row exactly once."""
+    root = str(tmp_path / "fb")
+    incoming = str(tmp_path / "incoming")
+    buf = FeedbackBuffer(root, FEATS, incoming)
+    for i in range(8):
+        fid = buf.record_prediction([float(i), 1.0, 1.0], float(i))
+        buf.record_outcome(fid, float(i))
+    buf.flush()
+    schema = feedback_schema(FEATS)
+
+    def mk_stream():
+        return StreamExecution(
+            source=FileStreamSource(incoming, schema),
+            sink=UnboundedTable(str(tmp_path / "table"), schema),
+            checkpoint=StreamCheckpoint(str(tmp_path / "ckpt")),
+            add_ingest_time=False,
+        )
+
+    plan = faults.FaultPlan().crash("stream.after_sink")
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            mk_stream().run_once()
+    assert plan.fired("stream.after_sink") == 1
+    s2 = mk_stream()  # the restart: replays exactly the in-flight batch
+    done = s2.run(max_batches=1, timeout_s=10.0)
+    assert len(done) == 1
+    table = s2.sink.read()
+    assert len(table) == 8
+    np.testing.assert_allclose(
+        np.sort(np.asarray(table.column("prediction"), dtype=np.float64)),
+        np.arange(8, dtype=np.float64),
+    )
+
+
+@pytest.mark.chaos
+def test_feedback_kill_between_commit_and_compact_never_double_flushes(
+    tmp_path
+):
+    """A kill after flush_commit but before compaction replays the
+    flushed rows into memory on restart; a LATER flush's compaction must
+    not rewrite them as live records (shedding their flushed status) —
+    that would double-flush them on the following restart."""
+    root = str(tmp_path / "fb")
+    incoming = str(tmp_path / "incoming")
+    buf = FeedbackBuffer(root, FEATS, incoming)
+    for i in range(4):
+        fid = buf.record_prediction([float(i), 0.0, 0.0], float(i))
+        buf.record_outcome(fid, float(i))
+    plan = faults.FaultPlan().crash("lifecycle.feedback.compact")
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            buf.flush()  # CSV + commit landed; compaction never ran
+    assert plan.fired("lifecycle.feedback.compact") == 1
+    buf2 = FeedbackBuffer(root, FEATS, incoming)  # replays uncompacted WAL
+    assert buf2.joined_unflushed() == []  # flushed rows stay flushed
+    fid = buf2.record_prediction([9.0, 0.0, 0.0], 9.0)
+    buf2.record_outcome(fid, 9.0)
+    buf2.flush()  # compacts — must NOT resurrect the earlier flush's rows
+    buf3 = FeedbackBuffer(root, FEATS, incoming)
+    assert buf3.joined_unflushed() == []
+    assert buf3.flush() is None
+    # exactly one copy of every row across all CSVs ever written
+    seen = []
+    for name in sorted(os.listdir(incoming)):
+        t = ht.read_csv(
+            os.path.join(incoming, name), feedback_schema(FEATS)
+        )
+        seen.extend(np.asarray(t.column("prediction"), dtype=float))
+    assert sorted(seen) == [0.0, 1.0, 2.0, 3.0, 9.0]
+
+
+def test_feedback_wal_compacts_after_commit(tmp_path):
+    """A committed flush drops its rows from memory AND the WAL, while
+    id/flush numbering survives compaction — a long-lived server must
+    not spool its whole serving history."""
+    root = str(tmp_path / "fb")
+    incoming = str(tmp_path / "incoming")
+    buf = FeedbackBuffer(root, FEATS, incoming)
+    for i in range(50):
+        fid = buf.record_prediction([float(i), 0.0, 0.0], float(i))
+        buf.record_outcome(fid, float(i))
+    wal = os.path.join(root, "feedback.log")
+    size_before = os.path.getsize(wal)
+    buf.flush()
+    assert os.path.getsize(wal) < size_before / 4  # 100 records -> 1 meta
+    assert buf.joined_unflushed() == [] and buf.pending_outcomes() == 0
+    buf2 = FeedbackBuffer(root, FEATS, incoming)  # restart over compacted WAL
+    assert buf2.record_prediction([1.0, 0.0, 0.0], 1.0) == 50  # ids continue
+    buf2.record_outcome(50, 2.0)
+    p = buf2.flush()
+    assert p is not None and p.endswith("feedback-000001.csv")  # flush ids too
+
+
+# ----------------------------------------------------------- decay trigger
+def test_metric_decay_triggers_retrain_without_psi(tmp_path, baseline):
+    """Same per-feature marginals, scrambled joint structure: PSI stays
+    quiet, the evaluation metric decays, and the decay trigger still
+    reaches RETRAINING — the breaker path PSI can't see."""
+    model, profile, x0 = baseline
+    srv, stream, ctrl = _build(
+        str(tmp_path),
+        drift_threshold=100.0,  # PSI can never fire in this test
+        metric_decay_ratio=2.0,
+        eval_rows=96,
+    )
+    ctrl.bootstrap(model, profile, train_x=x0)
+    srv.attach_lifecycle(ctrl)
+    base = _blobs(np.random.default_rng(30), 4000)
+    scramble_rng = np.random.default_rng(31)
+
+    def scrambled(n):
+        # each column sampled independently from ITS marginal: per-feature
+        # PSI ~ 0, joint structure (and the kmeans cost) destroyed
+        return np.column_stack(
+            [scramble_rng.choice(base[:, j], size=n) for j in range(3)]
+        ).astype(np.float32)
+
+    with srv:
+        for _ in range(200):
+            srv.predict("kmeans", scrambled(8), wait_timeout_s=10.0)
+            if ctrl.state == STATE_RETRAINING:
+                break
+        assert ctrl.state == STATE_RETRAINING
+    entries = ctrl.journal.entries()
+    suspected = next(
+        e for e in entries if e["state"] == STATE_DRIFT_SUSPECTED
+    )
+    assert "metric decay" in suspected["info"]["reason"]
+    assert ctrl._monitor.trips == 0  # PSI never fired
+
+
+def test_drift_suspected_recovers_when_signal_does_not_persist(
+    tmp_path, baseline
+):
+    """A transient drift burst suspends, then calm traffic de-escalates
+    back to SERVING (the 'recovered' edge) — suspicion must not park
+    forever waiting to treat any later noise as confirmation."""
+    model, profile, x0 = baseline
+    # decay-only trigger (PSI disabled): signals fire ONLY at eval
+    # boundaries, so the de-escalation path is deterministic
+    srv, stream, ctrl = _build(
+        str(tmp_path),
+        drift_threshold=100.0,
+        eval_rows=128, metric_decay_ratio=2.0,
+        recover_after_rows=192,
+    )
+    ctrl.bootstrap(model, profile, train_x=x0)
+    srv.attach_lifecycle(ctrl)
+    with srv:
+        trng = np.random.default_rng(50)
+        # one drifted burst: the first metric eval suspects
+        for _ in range(40):
+            srv.predict(
+                "kmeans", _blobs(trng, 8, SHIFT).astype(np.float32),
+                wait_timeout_s=10.0,
+            )
+            if ctrl.state == STATE_DRIFT_SUSPECTED:
+                break
+        assert ctrl.state == STATE_DRIFT_SUSPECTED
+        # then clean traffic: by the next eval the window is clean-only,
+        # so the suspicion must decay, never confirm
+        for _ in range(80):
+            srv.predict(
+                "kmeans", _blobs(trng, 8, 0.0).astype(np.float32),
+                wait_timeout_s=10.0,
+            )
+            if ctrl.state == STATE_SERVING:
+                break
+        assert ctrl.state == STATE_SERVING
+    recovered = [
+        e for e in ctrl.journal.entries()
+        if e["state"] == STATE_SERVING
+        and "recovered" in str(e["info"].get("reason", ""))
+    ]
+    assert recovered, "recovery transition was never journaled"
+    assert ctrl.active_version == 0  # no retrain happened
+
+
+# ------------------------------------------------------------ snapshot pin
+def test_retrain_snapshot_pinned_at_journal_time(tmp_path, baseline):
+    """Rows committed AFTER the RETRAINING journal entry must not leak
+    into the retrain — the snapshot batch id pins the training set."""
+    work = str(tmp_path)
+    srv, stream, ctrl = _seed_world(work, baseline)  # 600 rows, batch 0..1
+    srv.start()
+    try:
+        _drive(srv, ctrl, until=lambda c: c.state == STATE_RETRAINING,
+               poll=False)
+        # late data lands and commits before the controller polls
+        _drop_file(
+            os.path.join(work, "incoming"), "late.csv",
+            _blobs(np.random.default_rng(40), 500, SHIFT),
+        )
+        while stream.run_once() is not None:
+            pass
+        assert stream.sink.num_rows() == 1100
+        ctrl.poll()  # runs the retrain
+    finally:
+        srv.stop()
+    shadow = next(
+        e for e in ctrl.journal.entries() if e["state"] == STATE_SHADOW
+    )
+    assert shadow["info"]["train_rows"] == 600  # not 1100
+
+
+def test_unbounded_table_read_upto(tmp_path):
+    schema = feedback_schema(FEATS)
+    sink = UnboundedTable(str(tmp_path / "t"), schema)
+    for bid, n in enumerate((10, 20, 30)):
+        x = np.zeros((n, 3))
+        cols = {name: x[:, j] for j, name in enumerate(FEATS)}
+        cols["prediction"] = np.zeros(n)
+        cols["outcome"] = np.zeros(n)
+        sink.append_batch(ht.Table.from_dict(cols, schema), bid)
+    assert len(sink.read()) == 60
+    assert len(sink.read(upto_batch_id=1)) == 30
+    assert len(sink.read(upto_batch_id=0)) == 10
+    assert len(sink.read()) == 60  # memo key includes the pin
+
+
+def test_recovery_abandons_cycle_when_retrain_record_is_corrupt(
+    tmp_path, baseline
+):
+    """Post-commit bit rot can eat the RETRAINING line while a later
+    SHADOW line survives — the candidate is then unidentifiable and
+    recovery must abandon the cycle (journaled) and keep serving the
+    baseline, not crash every future construction."""
+    model, profile, x0 = baseline
+    work = str(tmp_path)
+    srv, stream, ctrl = _build(work)
+    ctrl.bootstrap(model, profile, train_x=x0)
+    ctrl.journal.append(STATE_RETRAINING, 1, {
+        "candidate_version": 1, "snapshot_batch_id": 0, "seed": 1,
+        "reason": "test",
+    })
+    ctrl.journal.append(STATE_SHADOW, 1, {"candidate_version": 1})
+    with open(ctrl.journal.path, "rb") as f:
+        lines = f.readlines()
+    assert b'"retraining"' in lines[1]
+    assert b'"test"' in lines[1]
+    lines[1] = lines[1].replace(b'"test"', b'"tesu"', 1)  # break the CRC
+    with open(ctrl.journal.path, "wb") as f:
+        f.writelines(lines)
+    srv2, stream2, ctrl2 = _build(work)  # must not raise
+    assert ctrl2.state == STATE_SERVING
+    assert ctrl2.active_version == 0
+    rb = next(
+        e for e in ctrl2.journal.entries()
+        if e["state"] == STATE_ROLLED_BACK
+    )
+    assert "journal damage" in rb["info"]["reason"]
+
+
+def test_canary_latency_is_measured_not_zero(tmp_path, baseline):
+    """Canary answers must report the candidate's real compute latency,
+    not the ~0 of a pre-answered request."""
+    work = str(tmp_path)
+    srv, stream, ctrl = _seed_world(work, baseline)
+    ctrl.canary_min_rows = 10**9
+    srv.start()
+    try:
+        _drive(srv, ctrl, until=lambda c: c.state == STATE_CANARY)
+        trng = np.random.default_rng(13)
+        canary = []
+        for _ in range(16):
+            xb = _blobs(trng, 4, SHIFT).astype(np.float32)
+            r = srv.predict("kmeans", xb, wait_timeout_s=10.0)
+            if r.status == STATUS_CANARY:
+                canary.append(r.latency_s)
+        assert canary, "no canary answers observed"
+        assert all(lat > 0.0 for lat in canary)
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- idempotence
+def test_recovery_is_idempotent_without_a_crash(tmp_path, baseline):
+    model, profile, x0 = baseline
+    work = str(tmp_path)
+    srv, stream, ctrl = _build(work)
+    ctrl.bootstrap(model, profile, train_x=x0)
+    n_entries = len(ctrl.journal.entries())
+    srv2, stream2, ctrl2 = _build(work)
+    assert ctrl2.state == STATE_SERVING
+    assert ctrl2.active_version == 0
+    assert len(ctrl2.journal.entries()) == n_entries  # recovery wrote nothing
+    assert ctrl2.baseline_metric == pytest.approx(ctrl.baseline_metric)

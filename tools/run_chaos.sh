@@ -14,9 +14,15 @@
 # faults (serve.predict), the data-corruption kinds at the ingest
 # text boundary (ingest.csv_text: mangle_field / shuffle_columns /
 # unit_scale / nan_burst — the chaos half of tests/test_quality.py),
-# and the GBT fit-checkpoint path (tests/test_gbt_fused.py kills the
+# the GBT fit-checkpoint path (tests/test_gbt_fused.py kills the
 # out-of-core boost inside the save protocol and asserts the resumed
-# model equals the fused device-resident fit).
+# model equals the fused device-resident fit), and the continuous-
+# learning loop (tests/test_lifecycle.py kills the lifecycle controller
+# at every state-transition boundary — lifecycle.journal.append /
+# retrain.commit / shadow.start / registry.flip / registry.swap /
+# rollback / feedback.flush — and asserts the restarted loop self-heals
+# to PROMOTED with the final served model bit-identical to an
+# uninterrupted run, plus feedback-spool exactly-once under kills).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -28,6 +34,7 @@ fi
 LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
     tests/test_stream_pipeline.py tests/test_gbt_fused.py \
+    tests/test_lifecycle.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -42,7 +49,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle)\.py::(\S+)",
         line,
     )
     if not m:
