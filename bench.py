@@ -2330,6 +2330,190 @@ def _bench_lifecycle() -> dict:
         shutil.rmtree(work_root, ignore_errors=True)
 
 
+def _bench_obs_overhead() -> dict:
+    """Observability-cost gate (ISSUE 10): the serve request path and the
+    pipelined streaming ingest, each measured with FULL instrumentation
+    (tracer installed → every request/batch/stage emits spans into a real
+    JSONL span log, snapshot exporter exercised) vs instrumentation OFF
+    (the shipped default: registry counters only, span() returning the
+    no-op singleton).  Gate: ≤2% throughput cost on both; plus the
+    allocation pin — the exporters-off hot path must not allocate per
+    call (``sys.getallocatedblocks`` delta over 200k no-op spans ≈ 0).
+    """
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        LinearRegression,
+        StreamingKMeans,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs import (
+        export as obs_export,
+        trace as obs_trace,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+        FileStreamSource,
+        ModelUpdateConsumer,
+        PipelinedStreamExecution,
+        StreamCheckpoint,
+        UnboundedTable,
+    )
+
+    platform, on_tpu, rows, _, mesh, n_chips = _bench_setup(200_000)
+    d = 8
+    rng = np.random.default_rng(0)
+
+    # ---- allocation pin: no-op span identity + zero per-call garbage --
+    assert not obs_trace.enabled()
+    noop = obs_trace.span("serve.request")
+    noop_identity = noop is obs_trace.span("stream.batch")
+    for _ in range(1000):  # warm up allocator pools / method caches
+        with obs_trace.span("serve.request") as sp:
+            sp.note  # attribute load only — the hot-path usage shape
+    blocks0 = sys.getallocatedblocks()
+    for _ in range(200_000):
+        with obs_trace.span("serve.request"):
+            pass
+    alloc_delta = sys.getallocatedblocks() - blocks0
+
+    work = tempfile.mkdtemp(prefix="cmlhn_obs_bench_")
+    serve_seconds = float(os.environ.get("BENCH_OBS_SERVE_SECONDS", 1.2))
+
+    import threading
+
+    x = _make_data(20_000, d, 8)
+    y = (x @ rng.normal(size=(d,)).astype(np.float32)).astype(np.float32)
+    model = LinearRegression().fit((x, y))
+
+    def serve_leg(traced: bool) -> float:
+        # saturated concurrent load (the _bench_serve shape, trimmed):
+        # under saturation throughput reflects total work, so the span
+        # cost shows up as itself instead of as single-client
+        # rendezvous-phase jitter
+        srv = InferenceServer(max_queue_rows=8192)
+        srv.add_model("los", model, buckets=(1, 2, 4, 8, 16, 32, 64))
+        tracer = obs_trace.Tracer(
+            os.path.join(work, f"spans-serve-{time.monotonic_ns()}.jsonl")
+        ) if traced else None
+        nthreads = 4
+        served = [0] * nthreads
+        stop = threading.Event()
+
+        def client(i: int) -> None:
+            j = 0
+            while not stop.is_set():
+                r = srv.predict("los", x[(j * 8) % 10_000:][:8])
+                if r.ok:
+                    served[i] += 8
+                j += 1
+
+        with srv:
+            if tracer is not None:
+                obs_trace.install(tracer)
+            try:
+                threads = [
+                    threading.Thread(target=client, args=(i,), daemon=True)
+                    for i in range(nthreads)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                time.sleep(serve_seconds)
+                stop.set()
+                for t in threads:
+                    t.join(5.0)
+                dt = time.perf_counter() - t0
+            finally:
+                if tracer is not None:
+                    obs_trace.clear()
+        if traced:
+            obs_export.write_snapshot(os.path.join(work, "snap.jsonl"))
+        return sum(served) / dt
+
+    def stream_leg(traced: bool) -> float:
+        n_files, rows_per_file = 4, 25_000
+        sub = os.path.join(work, f"stream-{'on' if traced else 'off'}-"
+                           f"{time.monotonic_ns()}")
+        incoming = os.path.join(sub, "incoming")
+        os.makedirs(incoming)
+        _pipeline_csv_fleet(incoming, n_files, rows_per_file)
+        schema = ht.hospital_event_schema()
+        feature_cols = list(ht.FEATURE_COLS)
+        sk = StreamingKMeans(k=8, seed=0)
+        sk.set_initial_centers(
+            np.random.default_rng(0).normal(
+                size=(8, len(feature_cols))
+            ).astype(np.float32)
+        )
+        exec_ = PipelinedStreamExecution(
+            source=FileStreamSource(incoming, schema, max_files_per_batch=1),
+            sink=UnboundedTable(os.path.join(sub, "table"), schema),
+            checkpoint=StreamCheckpoint(os.path.join(sub, "ckpt")),
+            foreach_batch=None, pipeline_depth=2,
+        )
+        exec_.stage = lambda tab: tab.numeric_matrix(feature_cols).astype(
+            np.float32
+        )
+        consumer = ModelUpdateConsumer(sk, pipeline=exec_, mesh=mesh)
+        exec_.foreach_batch = consumer
+        tracer = obs_trace.Tracer(
+            os.path.join(sub, "spans.jsonl")
+        ) if traced else None
+        try:
+            if tracer is not None:
+                obs_trace.install(tracer)
+            t0 = time.perf_counter()
+            infos = exec_.run(max_batches=n_files, timeout_s=300.0)
+            consumer.flush()
+            _fence(sk._centers)
+            dt = time.perf_counter() - t0
+        finally:
+            if tracer is not None:
+                obs_trace.clear()
+            exec_.close()
+        total = sum(i.num_appended_rows for i in infos)
+        assert total == n_files * rows_per_file
+        return total / dt
+
+    try:
+        # interleaved best-of-3 per variant: on a 1-core proxy host the
+        # run-to-run jitter is ±2% — the same order as the gate — so the
+        # variants alternate and each takes its best
+        serve_off, serve_on, stream_off, stream_on = 0.0, 0.0, 0.0, 0.0
+        for _ in range(3):
+            serve_off = max(serve_off, serve_leg(False))
+            serve_on = max(serve_on, serve_leg(True))
+            stream_off = max(stream_off, stream_leg(False))
+            stream_on = max(stream_on, stream_leg(True))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    serve_ratio = serve_on / serve_off
+    stream_ratio = stream_on / stream_off
+    worst = min(serve_ratio, stream_ratio)
+    return {
+        "metric": (
+            "obs overhead: instrumented/uninstrumented throughput "
+            f"(serve predict + pipelined ingest, {platform})"
+        ),
+        "value": round(worst, 4),
+        "unit": "ratio",
+        "vs_baseline": round(worst, 4),   # the ≥0.98 (≤2% cost) gate
+        "gate_pass": bool(worst >= 0.98),
+        "serve_ratio": round(serve_ratio, 4),
+        "serve_rps_off": round(serve_off, 1),
+        "serve_rps_on": round(serve_on, 1),
+        "stream_ratio": round(stream_ratio, 4),
+        "stream_rps_off": round(stream_off, 1),
+        "stream_rps_on": round(stream_on, 1),
+        "noop_span_identity": bool(noop_identity),
+        "noop_alloc_delta_blocks": int(alloc_delta),
+        "hot_path_alloc_free": bool(alloc_delta <= 8),
+        "platform": platform,
+    }
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -2349,6 +2533,7 @@ CONFIGS = {
     "quality": lambda: _bench_quality(),                        # data firewall
     "sql_device": lambda: _bench_sql_device(),                  # ISSUE 7 A/B
     "lifecycle": lambda: _bench_lifecycle(),                    # ISSUE 9 loop
+    "obs_overhead": lambda: _bench_obs_overhead(),              # ISSUE 10 gate
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets

@@ -84,6 +84,8 @@ from .sql_parse import (
     _expr_has_agg,
     parse,
 )
+from ..obs import trace as _trace
+from ..obs.registry import global_registry as _global_registry
 from .table import Table
 
 
@@ -822,10 +824,18 @@ def record_dispatch(
     query: str, route: str, reasons=(), fingerprint: str | None = None
 ) -> None:
     """Append one dispatch decision — the shared bookkeeping behind
-    :func:`last_dispatch`, used by ``execute`` and the fused path."""
+    :func:`last_dispatch`, used by ``execute`` and the fused path.
+    Every decision also lands on the process metrics registry
+    (``sql.dispatch.compiled`` / ``sql.dispatch.interpreter``,
+    ``sql.fallback_nodes``), so exporters see the route mix without
+    walking the bounded transcript."""
     _DISPATCH_LOG.append(
         DispatchRecord(query, route, tuple(reasons), fingerprint)
     )
+    g = _global_registry()
+    g.inc(f"sql.dispatch.{route}")
+    if reasons:
+        g.inc("sql.fallback_nodes", len(reasons))
 
 
 def last_dispatch() -> DispatchRecord | None:
@@ -890,7 +900,24 @@ def execute(query: str, resolve_table, mode: str = "auto") -> Table:
     ``mode``: "auto" (default) picks per the plan; "interpret" forces the
     numpy interpreter; "compile" requires the compiled path and raises
     :class:`SqlCompileUnsupported` when the plan has fallback nodes.
+
+    With a tracer installed (ISSUE 10) the whole dispatch runs under an
+    ``sql.query`` span carrying the route taken and the plan fingerprint
+    — the link between a streaming batch's trace and the fit it feeds.
     """
+    sp = _trace.span("sql.query")
+    with sp:
+        out = _execute_dispatched(query, resolve_table, mode)
+        if sp.trace_id is not None:
+            d = last_dispatch()
+            if d is not None and d.query == query:
+                sp.note("route", d.route)
+                if d.fingerprint is not None:
+                    sp.note("fingerprint", d.fingerprint)
+        return out
+
+
+def _execute_dispatched(query: str, resolve_table, mode: str) -> Table:
     if mode not in ("auto", "interpret", "compile"):
         raise ValueError(f"execute mode must be auto|interpret|compile, got {mode!r}")
     q = parse(query)

@@ -44,6 +44,8 @@ from ..io.model_io import (
     load_data_profile,
     load_model,
 )
+from ..obs import flight_recorder as _flight
+from ..obs import trace as _trace
 from ..quality.drift import DriftMonitor
 from ..quality.sketches import DataProfile, PSI_DRIFT
 from ..serve.bucketing import DEFAULT_BUCKETS
@@ -307,10 +309,13 @@ class LifecycleController:
             baseline = float(
                 self.metric_fn(model, np.asarray(train_x)[: self.eval_rows * 4])
             )
-        self.journal.append(
-            STATE_SERVING, 0,
-            {"active_version": 0, "baseline_metric": baseline},
-        )
+        with _trace.span(
+            "lifecycle.transition", {"state": STATE_SERVING, "cycle": 0}
+        ):
+            self.journal.append(
+                STATE_SERVING, 0,
+                {"active_version": 0, "baseline_metric": baseline},
+            )
         self._recover()
 
     # ---------------------------------------------------------- recovery
@@ -425,9 +430,17 @@ class LifecycleController:
 
     # ----------------------------------------------------------- journal
     def _journal_to(self, state: str, info: dict | None = None) -> None:
-        with self._lock:
-            self.journal.append(state, self.cycle, info)
-            self.state = state
+        # every journal hop is a span (ISSUE 10): the durable append is
+        # the transition, so its span IS the lifecycle leg of a trace
+        sp = _trace.span("lifecycle.transition")
+        with sp:
+            if sp.trace_id is not None:
+                sp.note("state", state)
+                sp.note("cycle", int(self.cycle))
+            with self._lock:
+                self.journal.append(state, self.cycle, info)
+                self.state = state
+        _flight.note("lifecycle", state, cycle=int(self.cycle))
         log.info("lifecycle transition", state=state, cycle=self.cycle,
                  **{k: v for k, v in (info or {}).items()
                     if isinstance(v, (int, float, str, bool, type(None)))})
@@ -629,6 +642,10 @@ class LifecycleController:
         )
 
     def _run_retrain(self) -> None:
+        with _trace.span("lifecycle.retrain", {"cycle": int(self.cycle)}):
+            self._run_retrain_inner()
+
+    def _run_retrain_inner(self) -> None:
         if self.sink is None:
             raise RuntimeError(
                 "RETRAINING requires a sink (the unbounded ingest table)"
@@ -734,6 +751,12 @@ class LifecycleController:
             self._rollback("canary regression: " + "; ".join(decision.reasons))
 
     def _promote(self, decision) -> None:
+        with _trace.span(
+            "lifecycle.promote", {"candidate": self.candidate_version}
+        ):
+            self._promote_inner(decision)
+
+    def _promote_inner(self, decision) -> None:
         cand = self.candidate_version
         fault_point("lifecycle.registry.flip", version=cand)
         new_baseline = decision.stats["candidate_metric"]
@@ -768,14 +791,23 @@ class LifecycleController:
 
     def _rollback(self, reason: str) -> None:
         cand = self.candidate_version
-        fault_point("lifecycle.rollback", version=cand)
-        # the prior artifact was never touched — the journal entry IS the
-        # rollback; the candidate's artifact stays on disk as evidence
-        self._journal_to(STATE_ROLLED_BACK, {
-            "active_version": self.active_version,
-            "candidate_version": cand,
-            "reason": reason,
-        })
+        # a refused candidate is a postmortem moment: dump the flight
+        # ring BEFORE the transition, so the artifact holds the shadow/
+        # canary evidence that led to the refusal
+        _flight.notify(
+            "lifecycle_rollback", "lifecycle.rollback",
+            candidate_version=cand, reason=reason,
+        )
+        with _trace.span("lifecycle.rollback", {"candidate": cand}):
+            fault_point("lifecycle.rollback", version=cand)
+            # the prior artifact was never touched — the journal entry IS
+            # the rollback; the candidate's artifact stays on disk as
+            # evidence
+            self._journal_to(STATE_ROLLED_BACK, {
+                "active_version": self.active_version,
+                "candidate_version": cand,
+                "reason": reason,
+            })
         log.error("candidate rolled back", candidate_version=cand,
                   reason=reason)
         self._finish_cycle()

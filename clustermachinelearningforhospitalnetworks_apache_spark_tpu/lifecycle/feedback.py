@@ -165,6 +165,12 @@ class FeedbackBuffer:
         """Write the joined-but-unflushed rows as one CSV into the ingest
         directory (exactly-once; see module docstring).  Returns the file
         path, or None when nothing is ready."""
+        from ..obs import trace as _trace
+
+        with _trace.span("lifecycle.feedback"):
+            return self._flush_inner()
+
+    def _flush_inner(self) -> str | None:
         fault_point("lifecycle.feedback.flush", pending=len(self._preds))
         if self._pending_intent is not None:
             intent = self._pending_intent

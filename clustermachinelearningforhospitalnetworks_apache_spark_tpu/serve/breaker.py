@@ -41,6 +41,9 @@ class CircuitBreaker:
         self._clock = clock
         self._on_transition = on_transition
         self._lock = threading.Lock()
+        #: open-transition postmortem payload, staged under the lock and
+        #: DUMPED AFTER it releases (see _flush_open_dump)
+        self._pending_dump: dict | None = None
         self._state = STATE_CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -58,6 +61,16 @@ class CircuitBreaker:
         if state == STATE_OPEN:
             self._opened_at = self._clock()
             self.opened_count += 1
+            # a breaker opening IS a failure event: STAGE a postmortem.
+            # The dump itself (metrics collect + fsync'd write) must run
+            # OUTSIDE self._lock — it snapshots every breaker on the
+            # server, so dumping in here would stall concurrent allow()
+            # calls and deadlock ABBA when two breakers open at once.
+            self._pending_dump = {
+                "from_state": old,
+                "reason": self._last_trip_reason or "failures",
+                "consecutive_failures": self._consecutive_failures,
+            }
         if state == STATE_HALF_OPEN:
             self._half_open_inflight = 0
             self._half_open_successes = 0
@@ -65,6 +78,17 @@ class CircuitBreaker:
             self._consecutive_failures = 0
         if self._on_transition is not None and old != state:
             self._on_transition(old, state)
+
+    def _flush_open_dump(self) -> None:
+        """Write the staged open-transition postmortem — called by every
+        public mutator AFTER its lock block, so the flight-recorder dump
+        (which re-reads breaker snapshots via the metrics collectors)
+        never runs while this breaker's lock is held."""
+        payload, self._pending_dump = self._pending_dump, None
+        if payload is not None:
+            from ..obs.flight_recorder import notify
+
+            notify("breaker_trip", "serve.breaker", **payload)
 
     # ------------------------------------------------------------ protocol
     def allow(self) -> bool:
@@ -105,6 +129,7 @@ class CircuitBreaker:
                 self._to(STATE_OPEN)
             else:  # already open: restart the recovery clock
                 self._opened_at = self._clock()
+        self._flush_open_dump()
 
     def reset(self, reason: str = "") -> None:
         """Force the breaker CLOSED — the promotion-side counterpart of
@@ -125,13 +150,14 @@ class CircuitBreaker:
         with self._lock:
             if self._state == STATE_HALF_OPEN:
                 self._to(STATE_OPEN)  # failed probe: back off again
-                return
-            self._consecutive_failures += 1
-            if (
-                self._state == STATE_CLOSED
-                and self._consecutive_failures >= self.failure_threshold
-            ):
-                self._to(STATE_OPEN)
+            else:
+                self._consecutive_failures += 1
+                if (
+                    self._state == STATE_CLOSED
+                    and self._consecutive_failures >= self.failure_threshold
+                ):
+                    self._to(STATE_OPEN)
+        self._flush_open_dump()
 
     # ------------------------------------------------------------ observe
     @property

@@ -1,10 +1,14 @@
 """Serving metrics: tail latency, queue depth, batch fill, recompiles.
 
-Rides :mod:`..utils.metrics` (the same registry the training pipeline
-stages feed) rather than inventing a second metrics surface: counters and
-gauges land in a ``MetricsRegistry`` under a ``serve.`` prefix, and the
-latency distribution is kept here as a bounded reservoir so p50/p99 are
-computable without unbounded memory on a long-lived server.
+Rides :mod:`..obs.registry` — the ONE metrics surface (ISSUE 10) — so
+serve counters, gauges, and distributions live in the same
+``MetricsRegistry`` the exporters read and the training pipeline feeds.
+The latency and batch-fill distributions are **fixed-bucket mergeable
+histograms** (``obs.registry.FixedHistogram``, the ``quality/sketches``
+discipline) instead of the pre-ISSUE-10 sampled reservoir: p50/p99 come
+from bounded state that merges exactly across replicas, ``_sum/_count``
+keep the exact mean, and the Prometheus exporter gets real ``_bucket``
+series instead of two pre-baked percentiles.
 """
 
 from __future__ import annotations
@@ -13,13 +17,15 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any
 
-import numpy as np
+from ..obs.registry import (
+    LATENCY_EDGES_S,
+    MetricsRegistry,
+    RATIO_EDGES,
+)
 
-from ..utils.metrics import MetricsRegistry
-
-#: reservoir capacity: enough for stable p99 estimates, small enough that
-#: a week-long server never grows (uniform reservoir sampling past the cap)
-_RESERVOIR = 8192
+#: registry keys for the two serving distributions
+LATENCY_HIST = "serve.latency_seconds"
+FILL_HIST = "serve.batch_fill"
 
 
 @dataclass
@@ -29,36 +35,28 @@ class ServingMetrics:
     Each sink owns its registry by default, so two servers (or two test
     cases) never bleed counters into each other; pass
     ``utils.metrics.global_metrics()`` explicitly to fold serve counters
-    into the process-wide training registry.
+    into the process-wide registry, or let :class:`~.server
+    .InferenceServer` register its pull-collector on the global one.
     """
 
     registry: MetricsRegistry = field(default_factory=MetricsRegistry)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
-    _latencies: list = field(default_factory=list, repr=False)
-    _fills: list = field(default_factory=list, repr=False)
-    _seen: int = 0
 
     # ------------------------------------------------------------ record
     def record_request(self, latency_s: float, status: str = "ok") -> None:
         with self._lock:
             self.registry.inc("serve.requests")
             self.registry.inc(f"serve.status.{status}")
-            self._seen += 1
-            if len(self._latencies) < _RESERVOIR:
-                self._latencies.append(latency_s)
-            else:  # uniform reservoir: every request keeps equal weight
-                j = np.random.randint(0, self._seen)
-                if j < _RESERVOIR:
-                    self._latencies[j] = latency_s
+            self.registry.observe(LATENCY_HIST, latency_s, LATENCY_EDGES_S)
 
     def record_batch(self, n_valid: int, bucket: int) -> None:
         with self._lock:
             self.registry.inc("serve.batches")
             self.registry.inc("serve.rows", float(n_valid))
             self.registry.inc("serve.padded_rows", float(bucket - n_valid))
-            self._fills.append(n_valid / bucket if bucket else 0.0)
-            if len(self._fills) > _RESERVOIR:
-                del self._fills[: -_RESERVOIR // 2]
+            self.registry.observe(
+                FILL_HIST, n_valid / bucket if bucket else 0.0, RATIO_EDGES
+            )
 
     def record_compile(self, bucket: int, warm: bool) -> None:
         """``warm`` marks planned warmup compiles; anything else is a
@@ -96,17 +94,18 @@ class ServingMetrics:
         return int(self.registry.counters.get("serve.recompiles", 0))
 
     def percentile(self, q: float) -> float | None:
-        with self._lock:
-            if not self._latencies:
-                return None
-            return float(np.percentile(np.asarray(self._latencies), q))
+        """Histogram-interpolated latency percentile (``q`` in 0..100)."""
+        h = self.registry.histograms.get(LATENCY_HIST)
+        if h is None or h.count <= 0:
+            return None
+        return max(h.quantile(q / 100.0), 0.0)
 
     def batch_fill_ratio(self) -> float | None:
-        """Mean real-rows fraction over recent batches."""
-        with self._lock:
-            if not self._fills:
-                return None
-            return float(np.mean(self._fills))
+        """Exact mean real-rows fraction (histogram ``sum/count``)."""
+        h = self.registry.histograms.get(FILL_HIST)
+        if h is None or h.count <= 0:
+            return None
+        return float(h.mean)
 
     def snapshot(self) -> dict[str, Any]:
         c = self.registry.counters

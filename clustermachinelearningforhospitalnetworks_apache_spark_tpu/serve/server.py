@@ -17,6 +17,8 @@ import numpy as np
 
 from ..io.model_io import load_data_profile, load_model
 from ..models.base import Model
+from ..obs import trace as _trace
+from ..obs.registry import global_registry
 from ..quality.drift import DriftMonitor, InputGuard, POLICY_REJECT
 from ..quality.sketches import DataProfile, PSI_DRIFT
 from ..utils.faults import fault_point
@@ -76,6 +78,69 @@ class InferenceServer:
         #: reference rebase land as one operation
         self._swap_lock = threading.Lock()
         self._started = False
+        self._register_obs()
+
+    def _register_obs(self) -> None:
+        """Fold this server into the process registry (ISSUE 10) as a
+        weakref pull-collector: ``serve.*`` counters, breaker states,
+        drift PSI, and the lifecycle phase all surface on the global
+        Prometheus/JSON exporters without the request path writing two
+        places.  Skipped when this server's ServingMetrics already
+        writes the global registry directly (double-count guard)."""
+        g = global_registry()
+        if self.metrics.registry is g:
+            return
+        g.register_collector(
+            f"serve:{id(self):x}", self, InferenceServer.obs_fragment
+        )
+
+    # ------------------------------------------------------------ obs
+    #: numeric encoding of breaker states for the state gauge
+    _BREAKER_CODE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+    def obs_fragment(self) -> dict:
+        """This server's contribution to a registry pull: its own
+        counters/gauges/histograms plus per-model breaker-state and
+        drift-PSI gauges (label syntax — ``obs/export.py`` splits them)
+        and the lifecycle phase."""
+        reg = self.metrics.registry
+        counters = dict(reg.counters)
+        gauges = dict(reg.gauges)
+        for name, b in list(self._breakers.items()):
+            snap = b.snapshot()
+            lbl = f'{{model="{name}"}}'
+            gauges[f"serve.breaker_state{lbl}"] = self._BREAKER_CODE.get(
+                snap["state"], -1.0
+            )
+            counters[f"serve.breaker_opened{lbl}"] = float(
+                snap["opened_count"]
+            )
+        for name, m in list(self._monitors.items()):
+            s = m.snapshot()
+            lbl = f'{{model="{name}"}}'
+            gauges[f"serve.drift_max_psi{lbl}"] = float(s["max_psi"])
+            counters[f"serve.drift_windows{lbl}"] = float(s["windows"])
+        lc = self._lifecycle
+        if lc is not None and lc.state is not None:
+            gauges["lifecycle.cycle"] = float(lc.cycle)
+            gauges[f'lifecycle.phase{{phase="{lc.state}"}}'] = 1.0
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {
+                k: h.to_dict() for k, h in reg.histograms.items()
+            },
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition text for THIS server (own registry +
+        fragment) — what a ``/metrics`` endpoint would return."""
+        from ..obs.export import prometheus_text
+        from ..obs.registry import MetricsRegistry
+
+        view = MetricsRegistry()
+        view.register_collector("self", self, InferenceServer.obs_fragment)
+        return prometheus_text(view)
 
     def _breaker_for(self, name: str) -> CircuitBreaker:
         if name not in self._breakers:
@@ -369,8 +434,26 @@ class InferenceServer:
         self, name: str, x: np.ndarray, deadline_s: float | None = None,
         wait_timeout_s: float | None = 30.0,
     ) -> ServeResult:
+        # the serve.request span brackets admission→answer on the
+        # CALLER's thread, so its duration is the latency the client saw;
+        # span() is the shared no-op singleton when tracing is off — the
+        # hot path allocates nothing for it (obs_overhead bench gate)
+        sp = _trace.span("serve.request")
+        with sp:
+            result = self._predict_traced(sp, name, x, deadline_s,
+                                          wait_timeout_s)
+        return result
+
+    def _predict_traced(
+        self, sp, name: str, x: np.ndarray, deadline_s: float | None,
+        wait_timeout_s: float | None,
+    ) -> ServeResult:
         req = self.submit(name, x, deadline_s=deadline_s)
         result = req.wait(wait_timeout_s)
+        if sp.trace_id is not None:
+            sp.note("model", name)
+            sp.note("status", result.status)
+            sp.note("rows", int(req.x.shape[0]))
         lc = self._lifecycle
         if lc is not None and result.status != STATUS_INVALID_INPUT:
             # post-answer observation: drift windows, the metric-decay

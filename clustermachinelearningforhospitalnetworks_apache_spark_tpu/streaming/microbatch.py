@@ -55,6 +55,8 @@ from ..core.table import Table
 
 if TYPE_CHECKING:  # pragma: no cover — typing only, avoids import cycle
     from ..quality.firewall import DataFirewall
+from ..obs import flight_recorder as _flight
+from ..obs import trace as _trace
 from ..utils.faults import fault_point
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
@@ -99,6 +101,8 @@ class StreamExecution:
     replay_backoff: RetryPolicy = DEFAULT_REPLAY_BACKOFF
     metrics: MetricsRegistry = field(default_factory=MetricsRegistry)
     history: list[BatchInfo] = field(default_factory=list)
+    #: trace id of the most recent batch attempt (None when tracing off)
+    last_trace_id: str | None = None
     _next_batch_id: int = 0
     _pending: dict | None = None
     #: batches whose row-quarantine metrics were already counted — a
@@ -123,12 +127,32 @@ class StreamExecution:
         if self.watermark is not None and state["watermark_state"]:
             self.watermark.restore(state["watermark_state"])
         self._pending = state["pending"]
+        self._register_obs()
         if self._pending:
             log.info(
                 "recovering uncommitted batch",
                 batch_id=self._pending["batch_id"],
                 files=len(self._pending["files"]),
             )
+
+    def _register_obs(self) -> None:
+        """Fold this driver's ``stream.*`` counters into the process
+        registry (ISSUE 10) as a weakref pull-collector: exporters see
+        every live stream's totals summed, and a dead driver silently
+        unregisters.  Skipped when the driver already writes the global
+        registry directly — the collector would double-count it."""
+        from ..obs.registry import global_registry
+
+        g = global_registry()
+        if self.metrics is g:
+            return
+        g.register_collector(
+            f"stream:{id(self):x}", self,
+            lambda s: {
+                "counters": dict(s.metrics.counters),
+                "gauges": dict(s.metrics.gauges),
+            },
+        )
 
     # ------------------------------------------------------------ core
     def run_once(self) -> BatchInfo | None:
@@ -229,6 +253,27 @@ class StreamExecution:
                 time.sleep(self.replay_backoff.delay_for(attempts, self._rng))
 
     def _attempt(
+        self, batch_id: int, files: list[str], wm_state: dict, prefetched=None
+    ) -> BatchInfo:
+        """Span wrapper around :meth:`_attempt_inner` — one ``stream
+        .batch`` span per attempt (ISSUE 10), the trace root a streaming
+        unit of work hangs its SQL/fit/serve children off.  The span id
+        lands in ``last_trace_id`` so downstream consumers (the update
+        hook, tests) can correlate; an InjectedCrash/failure inside is
+        recorded on the span and re-raised untouched."""
+        sp = _trace.span("stream.batch")
+        with sp:
+            self.last_trace_id = sp.trace_id
+            if sp.trace_id is not None:
+                sp.note("batch_id", batch_id)
+                sp.note("files", len(files))
+                sp.note("prefetched", prefetched is not None)
+            info = self._attempt_inner(batch_id, files, wm_state, prefetched)
+            if sp.trace_id is not None:
+                sp.note("rows", info.num_appended_rows)
+            return info
+
+    def _attempt_inner(
         self, batch_id: int, files: list[str], wm_state: dict, prefetched=None
     ) -> BatchInfo:
         """One try at the batch lifecycle, fault sites at every boundary.
@@ -347,6 +392,16 @@ class StreamExecution:
         self.checkpoint.write_commit(batch_id, quarantined=True)
         self.source.commit_files(files)
         self.metrics.inc("stream.quarantined")
+        if _trace.enabled():
+            _trace.record_span(
+                "stream.quarantine", 0.0,
+                {"batch_id": batch_id, "attempts": attempts},
+            )
+        # a poison batch is a postmortem moment: dump the flight ring
+        _flight.notify(
+            "quarantine", "stream.quarantine",
+            batch_id=batch_id, attempts=attempts, error=repr(err),
+        )
         log.error(
             "batch quarantined",
             batch_id=batch_id, attempts=attempts, path=qpath, error=repr(err),

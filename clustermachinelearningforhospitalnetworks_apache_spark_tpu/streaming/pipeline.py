@@ -48,6 +48,7 @@ the same WAL entries as the serial driver (``tests/test_stream_pipeline
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from dataclasses import dataclass, field
@@ -114,6 +115,12 @@ class _Prefetcher(threading.Thread):
         #: discover+parse cycle (never across the queue hand-off), the
         #: replay path holds it for the serial re-read
         self.ingest_lock = threading.Lock()
+        #: observability context snapshot (ISSUE 10): a fresh thread gets
+        #: an EMPTY contextvars context, which would orphan the worker's
+        #: ``stage.*`` spans from the trace the driver runs under — the
+        #: loop executes inside a copy of the creator's context instead,
+        #: so prefetch-side spans carry the ambient trace id
+        self._obs_ctx = contextvars.copy_context()
 
     # ------------------------------------------------------------ control
     def stop(self) -> None:
@@ -168,7 +175,10 @@ class _Prefetcher(threading.Thread):
             new = new[: src.max_files_per_batch]
         return new
 
-    def run(self) -> None:  # pragma: no branch - loop structure
+    def run(self) -> None:
+        self._obs_ctx.run(self._loop)
+
+    def _loop(self) -> None:  # pragma: no branch - loop structure
         while not self._halt.is_set():
             # bounded acquire so stop() is never ignored: a replay on the
             # commit thread may hold the ingest lock for a while

@@ -29,8 +29,25 @@ def append_line(path: str, obj: dict) -> None:
     mid-append), a newline is written first so the new entry never merges
     into the torn one.
     """
-    fault_point("wal.append", path=path)
-    payload = (json.dumps(obj) + "\n").encode()
+    append_lines(path, [obj])
+
+
+def append_lines(
+    path: str, objs: list[dict], site: str | None = "wal.append"
+) -> None:
+    """Durably append a batch of JSON entries: same torn-tail repair as
+    :func:`append_line`, ONE write + fsync for the whole batch — the
+    amortized path the observability span log flushes through (a span
+    per fsync would tax the hot paths it measures).
+
+    ``site=None`` opts out of the ``wal.append`` fault hooks: the span
+    log is an *observer* of the durability story, not part of it, so a
+    chaos rule tearing the stream's offsets log must never be consumed
+    by a tracer flush that happens to run first.
+    """
+    if site is not None:
+        fault_point(site, path=path)
+    payload = "".join(json.dumps(o) + "\n" for o in objs).encode()
     with open(path, "ab+") as f:
         # torn-tail probe on the same descriptor: append mode pins every
         # write to EOF regardless of the read position this seek sets
@@ -39,14 +56,17 @@ def append_line(path: str, obj: dict) -> None:
             f.seek(-1, os.SEEK_END)
             if f.read(1) != b"\n":
                 payload = b"\n" + payload
-        payload = mangle_bytes("wal.append", payload, path=path)
-        cut = torn_point("wal.append", len(payload), path=path)
-        if cut is not None:
-            # injected torn write: persist exactly `cut` bytes, then "die"
-            f.write(payload[:cut])
-            f.flush()
-            os.fsync(f.fileno())
-            raise InjectedCrash(f"torn write at byte {cut} of {path}")
+        if site is not None:
+            payload = mangle_bytes(site, payload, path=path)
+            cut = torn_point(site, len(payload), path=path)
+            if cut is not None:
+                # injected torn write: persist exactly `cut` bytes, "die"
+                f.write(payload[:cut])
+                f.flush()
+                os.fsync(f.fileno())
+                raise InjectedCrash(
+                    f"torn write at byte {cut} of {path}", site=site
+                )
         f.write(payload)
         f.flush()
         os.fsync(f.fileno())

@@ -84,7 +84,25 @@ class InjectedCrash(BaseException):
     ``BaseException`` so no ``except Exception`` self-healing path can
     swallow it: code that survives an InjectedCrash by catching it would
     also "survive" a power cut, which is a lie.
+
+    Constructing one dumps the observability flight recorder (ISSUE 10):
+    a real ``kill -9`` is exactly the moment a postmortem ring buffer
+    exists for, so EVERY simulated death — fault-rule crashes, torn WAL
+    writes, crashes tests raise by hand — leaves a CRC-verified artifact
+    tagged with the killing ``site``, no matter which code path raised
+    it.  The dump is best-effort and can never mask or alter the crash.
     """
+
+    def __init__(self, *args, site: str | None = None):
+        super().__init__(*args)
+        self.site = site
+        try:
+            from ..obs.flight_recorder import crash_dump
+
+            crash_dump(self)
+        except Exception:  # noqa: BLE001 — the postmortem must never
+            # change what the chaos test observes
+            pass
 
 
 #: rule actions that rewrite ingest data rather than raising/sleeping
@@ -228,6 +246,18 @@ class FaultPlan:
             burst_len=length, when=when,
         ))
 
+    @staticmethod
+    def _ring_note(site: str, action: str) -> None:
+        """A rule FIRED: drop it into the flight-recorder ring, so a
+        postmortem shows the faults leading up to the failure (fires are
+        rare by construction; the un-fired hook path pays nothing)."""
+        try:
+            from ..obs.flight_recorder import note
+
+            note("fault", site, action=action)
+        except Exception:  # noqa: BLE001 — observability never breaks work
+            pass
+
     # ------------------------------------------------------------ inspection
     def fired(self, site_pattern: str = "*") -> int:
         with self._lock:
@@ -248,10 +278,13 @@ class FaultPlan:
                 if not (r.matches(site, ctx) and r.take()):
                     continue
                 self.log.append((site, r.action))
+                self._ring_note(site, r.action)
                 if r.action == "delay":
                     delay += r.delay_s
                 elif r.action == "crash":
-                    boom = InjectedCrash(f"injected crash at {site}")
+                    boom = InjectedCrash(
+                        f"injected crash at {site}", site=site
+                    )
                     break
                 else:
                     boom = (r.error or (lambda: FaultError(
@@ -272,6 +305,7 @@ class FaultPlan:
                 if not (r.matches(site, ctx) and r.take()):
                     continue
                 self.log.append((site, "corrupt"))
+                self._ring_note(site, "corrupt")
                 if not data:
                     continue
                 i = min(r.at_byte or 0, len(data) - 1)
@@ -298,6 +332,7 @@ class FaultPlan:
             for i, r in enumerate(self.rules):
                 if r.action in DATA_ACTIONS and r.matches(site, ctx) and r.take():
                     self.log.append((site, r.action))
+                    self._ring_note(site, r.action)
                     # snapshot the fire count INSIDE the lock: concurrent
                     # callers must each get their own deterministic seed
                     fired_rules.append((i, r, r.fired))
@@ -316,6 +351,7 @@ class FaultPlan:
                 if not (r.matches(site, ctx) and r.take()):
                     continue
                 self.log.append((site, "tear"))
+                self._ring_note(site, "tear")
                 cut = r.at_byte or 0
                 if cut < 0:  # negative = from the end (-1: all but last byte)
                     cut += length

@@ -1,77 +1,32 @@
-"""Metrics registry: counters, gauges, stage timers, throughput.
+"""Metrics registry — thin shim over :mod:`..obs.registry` (ISSUE 10).
 
-Feeds the BASELINE throughput metric (records/sec/chip) and the per-stage
-wall-clock accounting the reference entirely lacks (SURVEY.md §5 —
-tracing/metrics are listed as absent upstream and required here).
+This module used to hold its own counters/gauges/stage-timings registry;
+that implementation (grown a histogram type, collectors, and exporters)
+now lives in ``obs/registry.py`` as the repo's ONE metrics surface, and
+every import here resolves to it.  Kept because ``MetricsRegistry`` /
+``global_metrics`` are referenced across streaming, serving, bench, and
+tests — the public API is unchanged, only the home moved.
 """
 
 from __future__ import annotations
 
-import time
-from contextlib import contextmanager
-from dataclasses import dataclass, field
-from typing import Any, Iterator
+from ..obs.registry import (  # noqa: F401 — re-exported public surface
+    FixedHistogram,
+    MetricsRegistry,
+    StageTiming,
+    global_registry,
+)
 
-
-@dataclass
-class StageTiming:
-    name: str
-    seconds: float
-    rows: int | None = None
-
-    @property
-    def rows_per_sec(self) -> float | None:
-        if self.rows is None or self.seconds <= 0:
-            return None
-        return self.rows / self.seconds
-
-
-@dataclass
-class MetricsRegistry:
-    counters: dict[str, float] = field(default_factory=dict)
-    gauges: dict[str, float] = field(default_factory=dict)
-    timings: list[StageTiming] = field(default_factory=list)
-
-    def inc(self, name: str, value: float = 1.0) -> None:
-        self.counters[name] = self.counters.get(name, 0.0) + value
-
-    def set(self, name: str, value: float) -> None:
-        self.gauges[name] = value
-
-    @contextmanager
-    def stage(self, name: str, rows: int | None = None) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.timings.append(
-                StageTiming(name=name, seconds=time.perf_counter() - t0, rows=rows)
-            )
-
-    def time_stage(self, name: str, fn, *args, rows: int | None = None, **kw):
-        with self.stage(name, rows=rows):
-            return fn(*args, **kw)
-
-    def snapshot(self) -> dict[str, Any]:
-        return {
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "stages": [
-                {
-                    "name": t.name,
-                    "seconds": round(t.seconds, 6),
-                    "rows": t.rows,
-                    "rows_per_sec": None
-                    if t.rows_per_sec is None
-                    else round(t.rows_per_sec, 1),
-                }
-                for t in self.timings
-            ],
-        }
-
-
-_GLOBAL = MetricsRegistry()
+__all__ = [
+    "FixedHistogram",
+    "MetricsRegistry",
+    "StageTiming",
+    "global_metrics",
+    "global_registry",
+]
 
 
 def global_metrics() -> MetricsRegistry:
-    return _GLOBAL
+    """The process-global registry (now ``obs.registry.global_registry``:
+    training counters, serve collectors, and exporters all read it)."""
+    return global_registry()

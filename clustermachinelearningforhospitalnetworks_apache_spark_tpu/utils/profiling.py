@@ -14,6 +14,8 @@ from typing import Iterator
 
 import jax
 
+from ..obs import trace as _trace
+
 
 class StageClock:
     """Wall-clock accumulator per named pipeline stage.
@@ -23,7 +25,15 @@ class StageClock:
     commit thread), so the per-stage seconds are what proves the overlap:
     when stages overlap, ``sum(seconds.values())`` exceeds the elapsed
     wall time.  Thread-safe; ~two ``perf_counter`` calls of overhead per
-    stage entry."""
+    stage entry.
+
+    ISSUE 10: the clock is also a **span sink** — with a tracer
+    installed (``obs/trace.py``), every stage exit additionally emits
+    span ``stage.<name>`` under whatever unit of work is in flight on
+    the calling thread, so the same brackets that feed bench shares
+    land in the end-to-end trace instead of living as a parallel
+    mechanism.  Uninstalled, the extra cost is one module-global load
+    and an ``is None`` test."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -40,6 +50,8 @@ class StageClock:
             with self._lock:
                 self.seconds[name] = self.seconds.get(name, 0.0) + dt
                 self.counts[name] = self.counts.get(name, 0) + 1
+            if _trace.enabled():
+                _trace.record_span("stage." + name, dt)
 
     def shares(self) -> dict[str, float]:
         """Fraction of the summed stage time each stage took (NOT of the

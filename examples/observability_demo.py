@@ -1,0 +1,207 @@
+"""One trace from CSV file to canary answer (ISSUE 10, ``obs/``).
+
+The continuous-learning loop again — baseline serves, the feed drifts,
+the controller retrains/shadows/canaries/promotes — but this time run
+under the observability fabric, end to end:
+
+* a :class:`~...obs.trace.Tracer` writes every span to a JSONL span log
+  (WAL append/torn-tail discipline), and the WHOLE loop runs inside one
+  root span, so a single ``trace_id`` reconstructs the
+  ingest → SQL → fit → serve → promotion timeline;
+* the process :func:`~...obs.registry.global_registry` accumulates
+  ``stream.*`` / ``serve.*`` / ``sql.*`` counters and the per-model
+  breaker/drift gauges via the server's pull-collector, exported here
+  as Prometheus text and a JSON snapshot;
+* the flight recorder rides along (always on) — at the end the demo
+  trips the serving breaker on purpose and shows the CRC-verified
+  postmortem dump it leaves.
+
+    PYTHONPATH=. python examples/observability_demo.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+try:  # installed copy (pip install -e .) takes precedence
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu  # noqa: F401
+except ImportError:  # running from a raw checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import (
+    execute,
+    last_dispatch,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.lifecycle import (
+    KMeansRetrainer,
+    LifecycleController,
+    STATE_SERVING,
+    feedback_schema,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+    KMeans,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs import (
+    export as obs_export,
+    flight_recorder as obs_flight,
+    trace as obs_trace,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.quality.sketches import (
+    DataProfile,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+    InferenceServer,
+    STATUS_CANARY,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+    FileStreamSource,
+    StreamCheckpoint,
+    StreamExecution,
+    UnboundedTable,
+)
+
+FEATS = ("admissions", "occupancy", "acuity")
+K = 4
+CENTERS = np.array(
+    [[0, 0, 0], [4, 0, 0], [0, 4, 0], [4, 4, 4]], dtype=np.float64
+)
+
+
+def cohorts(rng, n, shift=0.0):
+    return (CENTERS + shift)[rng.integers(0, K, n)] + rng.normal(
+        scale=0.3, size=(n, 3)
+    )
+
+
+def main() -> None:
+    work = tempfile.mkdtemp(prefix="obs_demo_")
+    span_log = os.path.join(work, "spans.jsonl")
+    rng = np.random.default_rng(0)
+    schema = feedback_schema(FEATS)
+    incoming = os.path.join(work, "incoming")
+    os.makedirs(incoming)
+
+    # ---- baseline: train, profile, bootstrap v0 ------------------------
+    x0 = cohorts(rng, 2000).astype(np.float32)
+    baseline = KMeans(k=K, seed=0, max_iter=20).fit(x0)
+    profile = DataProfile.from_matrix(x0.astype(np.float64), FEATS)
+    stream = StreamExecution(
+        source=FileStreamSource(incoming, schema),
+        sink=UnboundedTable(os.path.join(work, "table"), schema),
+        checkpoint=StreamCheckpoint(os.path.join(work, "ckpt")),
+        add_ingest_time=False,
+    )
+    server = InferenceServer(breaker_recovery_s=0.2)
+    ctrl = LifecycleController(
+        os.path.join(work, "lifecycle"), server, "cohorts",
+        KMeansRetrainer(FEATS, k=K, max_iter=40, tol=1e-4),
+        stream=stream, buckets=(1, 8, 32),
+        drift_window_rows=64, drift_trip_after=2,
+        shadow_min_rows=128, canary_fraction=0.25, canary_min_rows=32,
+        eval_rows=128,
+    )
+    server.attach_lifecycle(ctrl)
+    ctrl.bootstrap(baseline, profile, train_x=x0)
+    server.start()
+
+    # ---- the traced unit of work: CSV file → … → canary answer ---------
+    SHIFT = 6.0
+    drift_rng = np.random.default_rng(2)
+    traffic = np.random.default_rng(1)
+    statuses: dict[str, int] = {}
+    with obs_trace.active(obs_trace.Tracer(span_log, flush_every=64)):
+        with obs_trace.span("obs.demo") as root:
+            # §1 ingest: drifted CSVs through the exactly-once stream
+            for i in range(2):
+                x = cohorts(drift_rng, 300, SHIFT)
+                cols = {n: x[:, j] for j, n in enumerate(FEATS)}
+                cols["prediction"] = np.zeros(len(x))
+                cols["outcome"] = np.zeros(len(x))
+                ht.io.write_csv(
+                    ht.Table.from_dict(cols, schema),
+                    os.path.join(incoming, f"drifted-{i}.csv"),
+                )
+            while stream.run_once() is not None:
+                pass
+
+            # §2 SQL over the unbounded table: the window-extract shape
+            # (spans carry route + plan fingerprint)
+            snapshot = stream.sink.read()
+            feed = execute(
+                "SELECT admissions, occupancy, acuity FROM feed "
+                "WHERE acuity IS NOT NULL",
+                lambda name: snapshot,
+            )
+            sql_route = last_dispatch().route
+
+            # §3 serve + drift detection + retrain + canary + promotion:
+            # traffic drives the machine; poll() runs the heavy hops
+            steps = 0
+            while not (
+                ctrl.state == STATE_SERVING and (ctrl.active_version or 0) > 0
+            ):
+                steps += 1
+                xb = cohorts(traffic, 8, SHIFT).astype(np.float32)
+                r = server.predict("cohorts", xb, wait_timeout_s=10.0)
+                statuses[r.status] = statuses.get(r.status, 0) + 1
+                ctrl.poll()
+            trace_id = root.trace_id
+
+    # ---- read the trace back: one id = the whole story -----------------
+    spans = obs_trace.read_spans(span_log)
+    tl = obs_trace.timeline(spans, trace_id)
+    print(f"trace {trace_id}: {len(tl)} spans over the full loop "
+          f"({steps} traffic steps; sql route={sql_route}; "
+          f"{len(feed)} rows through the window query)")
+    counts = obs_trace.by_name(tl)
+    for name in sorted(counts):
+        print(f"  {name:<24} × {counts[name]}")
+    print("\n== condensed timeline (first occurrence of each span name) ==")
+    seen: set = set()
+    firsts = [
+        s for s in tl
+        if not (s["name"] in seen or seen.add(s["name"]))
+    ]
+    print(obs_trace.format_timeline(firsts))
+
+    # ---- the registry view: one scrape covers every subsystem ----------
+    print("\n== prometheus (selected families) ==")
+    for line in obs_export.prometheus_text().splitlines():
+        if any(k in line for k in (
+            "stream_batches", "serve_requests", "sql_dispatch",
+            "breaker_state", "lifecycle_phase",
+        )):
+            print(" ", line)
+    snap = obs_export.write_snapshot(os.path.join(work, "metrics.jsonl"))
+    print(f"\njson snapshot: {len(snap['counters'])} counters, "
+          f"{len(snap['gauges'])} gauges, {len(snap['histograms'])} "
+          f"histograms -> {work}/metrics.jsonl")
+
+    # ---- the flight recorder: break something, read the postmortem -----
+    rec = obs_flight.FlightRecorder(dump_dir=os.path.join(work, "flight"))
+    old = obs_flight.recorder()
+    obs_flight.install(rec)
+    try:
+        server._breaker_for("cohorts").trip("operator drill")
+    finally:
+        obs_flight.install(old)
+    payload = obs_flight.read_dump(rec.last_dump_path)
+    print(f"\nflight dump (CRC-verified): site={payload['site']!r} "
+          f"reason={payload['reason']!r} ring={len(payload['events'])} "
+          f"events\n  -> {rec.last_dump_path}")
+
+    h = server.health()["lifecycle"]
+    print(f"\npromoted v{h['active_version']} "
+          f"(canary answers: {statuses.get(STATUS_CANARY, 0)}, "
+          f"status counts: {statuses})")
+    server.stop()
+    print(f"artifacts kept under {work}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,578 @@
+"""Observability fabric (ISSUE 10, ``obs/``): registry + exporters,
+span tracing with cross-subsystem ID propagation, and the crash flight
+recorder.
+
+The load-bearing promises tested here:
+
+* **schema stability** — the Prometheus text and JSON snapshot forms
+  are a scrape contract: the pinned-schema tests freeze (name, type,
+  label-keys) triples and the ``health()`` key set, so a downstream
+  scraper can rely on them;
+* **trace propagation** — one trace id survives the real unit-of-work
+  chain: streaming batch → SQL dispatch → stage clocks (including on
+  ``PipelinedStreamExecution``'s prefetch THREAD) → serve request →
+  lifecycle journal transition;
+* **uninstalled cost** — with no tracer, ``span()`` is a shared
+  singleton and the hot path allocates nothing (the obs_overhead bench
+  gate's unit-level twin);
+* **postmortems** — flight dumps round-trip CRC-intact, carry the
+  killing site, and are written by every trigger (InjectedCrash,
+  breaker trip, rollback);
+* **drift tripwire** — ``tools/check_obs.py`` passes against the
+  current source.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import write_csv
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+    LinearRegression,
+    StreamingKMeans,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+    KMeans,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs import (
+    FixedHistogram,
+    MetricsRegistry,
+    export as obs_export,
+    flight_recorder as obs_flight,
+    global_registry,
+    trace as obs_trace,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.quality.sketches import (
+    DataProfile,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+    CircuitBreaker,
+    InferenceServer,
+    ServingMetrics,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+    FileStreamSource,
+    PipelinedStreamExecution,
+    StreamCheckpoint,
+    StreamExecution,
+    UnboundedTable,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.pipeline import (
+    make_sql_feature_stage,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.profiling import (
+    StageClock,
+)
+
+FEATURES = list(ht.FEATURE_COLS)
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def flight(tmp_path):
+    """A fresh flight recorder dumping into tmp; the previous (global)
+    one is restored afterwards."""
+    rec = obs_flight.FlightRecorder(dump_dir=str(tmp_path / "flight"))
+    old = obs_flight.recorder()
+    obs_flight.install(rec)
+    yield rec
+    obs_flight.install(old)
+
+
+@pytest.fixture
+def tracer():
+    with obs_trace.active(obs_trace.Tracer()) as t:
+        yield t
+    assert not obs_trace.enabled()
+
+
+def _event_csv(path, n, rng, start_minute=0):
+    base = np.datetime64("2025-03-31T22:00:00") + np.timedelta64(
+        int(start_minute), "m"
+    )
+    t = ht.Table.from_dict(
+        {
+            "hospital_id": np.array(["H01"] * n, dtype=object),
+            "event_time": base + np.arange(n).astype("timedelta64[s]"),
+            "admission_count": rng.integers(0, 50, n),
+            "current_occupancy": rng.integers(20, 200, n),
+            "emergency_visits": rng.integers(0, 30, n),
+            "seasonality_index": rng.uniform(0.5, 1.5, n),
+            "length_of_stay": rng.uniform(1.0, 9.0, n),
+        },
+        ht.hospital_event_schema(),
+    )
+    write_csv(t, path)
+
+
+def _stream(tmp_path, pipelined=True, **kw):
+    cls = PipelinedStreamExecution if pipelined else StreamExecution
+    return cls(
+        source=FileStreamSource(
+            str(tmp_path / "incoming"), ht.hospital_event_schema(),
+            max_files_per_batch=1,
+        ),
+        sink=UnboundedTable(str(tmp_path / "table"), ht.hospital_event_schema()),
+        checkpoint=StreamCheckpoint(str(tmp_path / "ckpt")),
+        add_ingest_time=False,
+        **kw,
+    )
+
+
+# ===================================================================== registry
+class TestRegistry:
+    def test_counters_gauges_compat_surface(self):
+        r = MetricsRegistry()
+        r.inc("a.b")
+        r.inc("a.b", 2.0)
+        r.set("g", 0.5)
+        assert r.counters["a.b"] == 3.0
+        assert r.gauges["g"] == 0.5
+        snap = r.snapshot()
+        assert set(snap) == {"counters", "gauges", "histograms", "stages"}
+
+    def test_histogram_mean_exact_and_quantile_monotone(self):
+        h = FixedHistogram([0.0, 1.0, 2.0, 4.0])
+        vals = [0.1, 0.5, 1.5, 3.0, 3.5, 9.0]
+        h.observe(vals)
+        assert h.count == len(vals)
+        assert h.mean == pytest.approx(np.mean(vals))
+        qs = [h.quantile(q) for q in (0.1, 0.5, 0.9, 0.99)]
+        assert qs == sorted(qs)
+        assert qs[0] >= 0.0
+
+    def test_histogram_merge_is_exact_bin_addition(self):
+        a, b = FixedHistogram([0, 1, 2]), FixedHistogram([0, 1, 2])
+        a.observe([0.5, 1.5, 5.0])
+        b.observe([-1.0, 0.2])
+        both = FixedHistogram([0, 1, 2])
+        both.observe([0.5, 1.5, 5.0, -1.0, 0.2])
+        a.merge(b)
+        assert np.array_equal(a.counts, both.counts)
+        assert a.count == both.count and a.sum == pytest.approx(both.sum)
+        with pytest.raises(ValueError):
+            a.merge(FixedHistogram([0, 1, 3]))
+
+    def test_collector_sums_counters_and_prunes_dead_owners(self):
+        r = MetricsRegistry()
+
+        class Src:
+            def __init__(self, n):
+                self.n = n
+
+        a, b = Src(2.0), Src(3.0)
+        r.register_collector("a", a, lambda s: {"counters": {"x": s.n}})
+        r.register_collector("b", b, lambda s: {"counters": {"x": s.n}})
+        assert r.collect()["counters"]["x"] == 5.0
+        del b
+        import gc
+
+        gc.collect()
+        assert r.collect()["counters"]["x"] == 2.0
+        assert r.collector_keys() == ["a"]
+
+    def test_broken_collector_flagged_not_fatal(self):
+        r = MetricsRegistry()
+
+        class Bad:
+            pass
+
+        bad = Bad()
+        r.register_collector(
+            "bad", bad, lambda s: (_ for _ in ()).throw(RuntimeError("x"))
+        )
+        out = r.collect()
+        assert out["gauges"]["obs.collector_broken.bad"] == 1.0
+
+
+# ================================================================ serve metrics
+class TestServingMetrics:
+    def test_latency_percentiles_from_histogram(self):
+        sm = ServingMetrics()
+        assert sm.percentile(50) is None
+        for ms in (1, 2, 3, 50):
+            sm.record_request(ms / 1e3)
+        p50, p99 = sm.percentile(50), sm.percentile(99)
+        assert 0 < p50 < p99
+        snap = sm.snapshot()
+        assert snap["latency_p50_ms"] > 0
+        assert snap["requests"] == 4 and snap["statuses"] == {"ok": 4}
+
+    def test_fill_ratio_is_exact_mean(self):
+        sm = ServingMetrics()
+        sm.record_batch(2, 4)
+        sm.record_batch(4, 4)
+        assert sm.batch_fill_ratio() == pytest.approx(0.75)
+
+    def test_distributions_merge_across_sinks(self):
+        a, b = ServingMetrics(), ServingMetrics()
+        a.record_request(0.001)
+        b.record_request(0.1)
+        ha = a.registry.histograms["serve.latency_seconds"]
+        hb = b.registry.histograms["serve.latency_seconds"]
+        ha.merge(hb)
+        assert ha.count == 2
+
+
+# ================================================================ export schema
+class TestExportSchema:
+    def _representative(self) -> MetricsRegistry:
+        r = MetricsRegistry()
+        sm = ServingMetrics(registry=r)
+        sm.record_request(0.002)
+        sm.record_batch(3, 4)
+        sm.record_breaker_transition("closed", "open")
+        r.inc("stream.batches")
+        r.inc("stream.rows_rejected", 2)
+        r.set("stream.drift_psi", 0.11)
+        r.inc("sql.dispatch.compiled")
+        r.set('serve.breaker_state{model="los"}', 2.0)
+        return r
+
+    def test_pinned_scrape_schema(self):
+        """THE scrape contract: names, types, and label keys — frozen.
+        A change here is a breaking change for downstream scrapers and
+        must be deliberate."""
+        assert obs_export.schema(self._representative()) == [
+            ("cmlhn_serve_batch_fill", "histogram", ()),
+            ("cmlhn_serve_batches_total", "counter", ()),
+            ("cmlhn_serve_breaker_state", "gauge", ("model",)),
+            ("cmlhn_serve_breaker_to_open_total", "counter", ()),
+            ("cmlhn_serve_breaker_transitions_total", "counter", ()),
+            ("cmlhn_serve_latency_seconds", "histogram", ()),
+            ("cmlhn_serve_padded_rows_total", "counter", ()),
+            ("cmlhn_serve_requests_total", "counter", ()),
+            ("cmlhn_serve_rows_total", "counter", ()),
+            ("cmlhn_serve_status_ok_total", "counter", ()),
+            ("cmlhn_sql_dispatch_compiled_total", "counter", ()),
+            ("cmlhn_stream_batches_total", "counter", ()),
+            ("cmlhn_stream_drift_psi", "gauge", ()),
+            ("cmlhn_stream_rows_rejected_total", "counter", ()),
+        ]
+
+    def test_prometheus_text_invariants(self):
+        text = obs_export.prometheus_text(self._representative())
+        lines = text.strip().split("\n")
+        # one TYPE line per family, before its samples
+        assert "# TYPE cmlhn_serve_requests_total counter" in lines
+        assert "# TYPE cmlhn_stream_drift_psi gauge" in lines
+        assert "# TYPE cmlhn_serve_latency_seconds histogram" in lines
+        assert 'cmlhn_serve_breaker_state{model="los"} 2' in lines
+        # histogram: +Inf bucket equals _count (cumulative, complete)
+        inf = next(
+            ln for ln in lines
+            if ln.startswith('cmlhn_serve_latency_seconds_bucket{le="+Inf"}')
+        )
+        count = next(
+            ln for ln in lines
+            if ln.startswith("cmlhn_serve_latency_seconds_count")
+        )
+        assert inf.split()[-1] == count.split()[-1] == "1"
+
+    def test_json_snapshot_shape_and_roundtrip(self):
+        snap = obs_export.json_snapshot(self._representative())
+        assert set(snap) == {"time", "counters", "gauges", "histograms"}
+        again = json.loads(json.dumps(snap))
+        assert again["counters"]["stream.batches"] == 1
+        h = again["histograms"]["serve.latency_seconds"]
+        assert len(h["counts"]) == len(h["edges"]) + 1
+
+    def test_snapshot_log_append_and_read(self, tmp_path):
+        path = str(tmp_path / "snaps.jsonl")
+        obs_export.write_snapshot(path, self._representative())
+        obs_export.write_snapshot(path, self._representative())
+        with open(path, "a") as f:
+            f.write('{"torn')  # torn tail: reader must skip it
+        snaps = obs_export.read_snapshots(path)
+        assert len(snaps) == 2
+
+    def test_health_key_set_pinned(self):
+        x = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+        y = x.sum(axis=1)
+        srv = InferenceServer()
+        srv.add_model("los", LinearRegression().fit((x, y)), buckets=(1, 4))
+        with srv:
+            srv.predict("los", x[:2])
+            assert set(srv.health()) == {
+                "status", "started", "lifecycle", "models_serving",
+                "breakers", "drift", "quarantined_batches",
+                "quarantined_rows", "drift_events", "retry_totals",
+                "fallback_answers", "inputs_imputed", "inputs_rejected",
+                "drift_trips",
+            }
+            text = srv.metrics_text()
+        assert "# TYPE cmlhn_serve_requests_total counter" in text
+        assert 'cmlhn_serve_breaker_state{model="los"} 0' in text
+
+    def test_server_registers_on_global_registry(self):
+        x = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+        srv = InferenceServer()
+        srv.add_model(
+            "glos", LinearRegression().fit((x, x.sum(axis=1))), buckets=(1, 4)
+        )
+        with srv:
+            srv.predict("glos", x[:2])
+            counters = global_registry().collect()["counters"]
+            assert counters.get("serve.requests", 0) >= 1
+
+
+# ===================================================================== tracing
+class TestTrace:
+    def test_noop_span_is_shared_singleton(self):
+        assert not obs_trace.enabled()
+        a = obs_trace.span("serve.request")
+        b = obs_trace.span("stream.batch")
+        assert a is b
+        with a as sp:
+            sp.note("k", "v")  # must be a no-op, not an error
+        assert a.trace_id is None
+
+    def test_noop_span_allocation_free(self):
+        """The exporters-off hot path is pinned allocation-free: after
+        warmup, a no-op span cycle leaves the allocator block count
+        unchanged (the unit twin of the obs_overhead bench gate)."""
+        assert not obs_trace.enabled()
+        for _ in range(5000):
+            with obs_trace.span("serve.request"):
+                pass
+        base = sys.getallocatedblocks()
+        for _ in range(50_000):
+            with obs_trace.span("serve.request"):
+                pass
+        assert sys.getallocatedblocks() - base <= 16
+
+    def test_nesting_and_ids(self, tracer):
+        with obs_trace.span("obs.demo") as root:
+            assert obs_trace.current_trace_id() == root.trace_id
+            with obs_trace.span("sql.query") as child:
+                pass
+        assert obs_trace.current_trace_id() is None
+        spans = {s["name"]: s for s in tracer.spans}
+        assert spans["sql.query"]["trace_id"] == root.trace_id
+        assert spans["sql.query"]["parent_id"] == root.span_id
+        assert spans["obs.demo"]["parent_id"] is None
+
+    def test_span_records_exception_and_reraises(self, tracer):
+        with pytest.raises(ValueError):
+            with obs_trace.span("obs.demo"):
+                raise ValueError("boom")
+        [sp] = tracer.spans
+        assert "ValueError" in sp["attrs"]["error"]
+
+    def test_span_log_roundtrip_and_torn_tail(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        with obs_trace.active(obs_trace.Tracer(path, flush_every=2)):
+            for _ in range(5):
+                with obs_trace.span("obs.demo"):
+                    pass
+        assert len(obs_trace.read_spans(path)) == 5
+        with open(path, "a") as f:
+            f.write('{"torn"')
+        assert len(obs_trace.read_spans(path)) == 5  # torn line skipped
+
+    def test_stage_clock_is_a_span_sink(self, tracer):
+        clock = StageClock()
+        with obs_trace.span("obs.demo") as root:
+            with clock.stage("update"):
+                pass
+        names = {s["name"]: s for s in tracer.spans}
+        assert "stage.update" in names
+        assert names["stage.update"]["trace_id"] == root.trace_id
+        # and silent without a tracer (the uninstalled discipline)
+        obs_trace.clear()
+        with clock.stage("update"):
+            pass
+        assert clock.counts["update"] == 2
+
+    def test_sql_span_carries_route_and_fingerprint(self, tracer):
+        t = ht.Table.from_dict(
+            {"v": np.arange(8_192, dtype=np.float64)}, None
+        )
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import (
+            execute,
+        )
+
+        execute("SELECT v + 1 AS w FROM t WHERE v > 3", lambda name: t)
+        [sp] = [s for s in tracer.spans if s["name"] == "sql.query"]
+        assert sp["attrs"]["route"] in ("compiled", "interpreter")
+        if sp["attrs"]["route"] == "compiled":
+            assert sp["attrs"]["fingerprint"]
+
+    def test_trace_threads_batch_sql_fit_serve_lifecycle(
+        self, tmp_path, tracer
+    ):
+        """THE propagation contract: one ambient trace id survives the
+        whole chain — pipelined ingest (prefetch WORKER thread included),
+        the SQL feature stage, the stage-clocked model update, a serve
+        request, and a lifecycle journal transition."""
+        rng = np.random.default_rng(0)
+        os.makedirs(tmp_path / "incoming")
+        for i in range(2):
+            _event_csv(
+                str(tmp_path / "incoming" / f"f{i}.csv"), 60, rng,
+                start_minute=i,
+            )
+        sk = StreamingKMeans(k=2, seed=0)
+        exec_ = _stream(tmp_path, pipelined=True)
+        exec_.stage = make_sql_feature_stage(
+            "SELECT * FROM __THIS__", FEATURES
+        )
+        exec_.foreach_batch = lambda x, bid: sk.update(x)
+
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        srv = InferenceServer()
+        srv.add_model(
+            "los", LinearRegression().fit((x, x.sum(axis=1))), buckets=(1, 4)
+        )
+
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.lifecycle import (
+            KMeansRetrainer,
+            LifecycleController,
+        )
+
+        with obs_trace.span("obs.demo") as root:
+            with exec_:
+                infos = exec_.run(max_batches=2, timeout_s=60.0)
+            with srv:
+                r = srv.predict("los", x[:2])
+            ctrl = LifecycleController(
+                str(tmp_path / "lifecycle"), srv, "cohorts",
+                KMeansRetrainer(tuple(FEATURES), k=2, max_iter=2),
+                buckets=(1, 4),
+            )
+            km = KMeans(k=2, seed=0, max_iter=2).fit(x)
+            ctrl.bootstrap(
+                km, DataProfile.from_matrix(x.astype(np.float64), FEATURES)
+            )
+        assert len(infos) == 2 and r.ok
+        tid = root.trace_id
+        mine = [s for s in tracer.spans if s["trace_id"] == tid]
+        names = {s["name"] for s in mine}
+        assert {
+            "stream.batch", "sql.query", "stage.ingest", "stage.update",
+            "serve.request", "lifecycle.transition",
+        } <= names, f"chain broken; got {sorted(names)}"
+        # the prefetch worker's spans joined the SAME trace
+        worker = [s for s in mine if s["thread"] == "stream-prefetch"]
+        assert worker, "no spans from the prefetch thread"
+        assert {"stage.ingest"} <= {s["name"] for s in worker}
+        # and the batch attempt exposed its trace id for correlation
+        assert exec_.last_trace_id == tid
+        # timeline reconstruction is ordered and complete
+        tl = obs_trace.timeline(tracer.spans, tid)
+        assert [s["t0"] for s in tl] == sorted(s["t0"] for s in tl)
+        assert obs_trace.format_timeline(tl).count("\n") == len(tl) - 1
+
+    def test_serial_driver_roots_its_own_trace(self, tmp_path, tracer):
+        rng = np.random.default_rng(1)
+        os.makedirs(tmp_path / "incoming")
+        _event_csv(str(tmp_path / "incoming" / "f.csv"), 40, rng)
+        exec_ = _stream(tmp_path, pipelined=False)
+        exec_.run(max_batches=1, timeout_s=30.0)
+        [batch] = [s for s in tracer.spans if s["name"] == "stream.batch"]
+        assert batch["parent_id"] is None  # no ambient trace: a new root
+        assert batch["attrs"]["rows"] == 40
+        assert exec_.last_trace_id == batch["trace_id"]
+
+
+# =============================================================== flight recorder
+class TestFlightRecorder:
+    def test_dump_roundtrip_and_crc_detects_tamper(self, flight):
+        obs_flight.note("fault", "x.site", action="crash")
+        path = obs_flight.notify("test_trigger", "x.site", detail=1)
+        payload = obs_flight.read_dump(path)
+        assert payload["site"] == "x.site"
+        assert payload["trigger"] == {"detail": 1}
+        assert any(e["name"] == "x.site" for e in payload["events"])
+        assert "counters" in payload["metrics"]
+        # flip one byte inside the payload region → loud corruption
+        raw = open(path).read()
+        broken = raw.replace('"site":"x.site"', '"site":"y.site"')
+        with open(path, "w") as f:
+            f.write(broken)
+        with pytest.raises(ValueError, match="crc32c mismatch"):
+            obs_flight.read_dump(path)
+
+    def test_injected_crash_dumps_with_site(self, flight):
+        plan = faults.FaultPlan().crash("obs.test.kill")
+        with faults.active(plan):
+            with pytest.raises(faults.InjectedCrash):
+                faults.fault_point("obs.test.kill")
+        assert flight.dumps == 1
+        payload = obs_flight.read_dump(flight.last_dump_path)
+        assert payload["site"] == "obs.test.kill"
+        assert payload["reason"] == "injected_crash"
+        # the rule FIRE preceding the crash is in the ring too
+        kinds = {(e["kind"], e["name"]) for e in payload["events"]}
+        assert ("fault", "obs.test.kill") in kinds
+
+    def test_breaker_open_dumps(self, flight):
+        b = CircuitBreaker(failure_threshold=2)
+        b.record_failure()
+        assert flight.dumps == 0
+        b.record_failure()  # threshold: closed → open
+        assert flight.dumps == 1
+        assert obs_flight.read_dump(flight.last_dump_path)["site"] == (
+            "serve.breaker"
+        )
+        b.trip("drift")  # already open: clock restart, no second dump
+        assert flight.dumps == 1
+
+    def test_breaker_dump_runs_outside_its_lock(self, flight):
+        """Regression: the open-transition dump snapshots breakers via
+        the registry collectors — with the dump inside the breaker's own
+        lock this deadlocked (same-lock re-entry / ABBA across two
+        breakers).  A collector that snapshots the opening breaker must
+        complete."""
+        b = CircuitBreaker(failure_threshold=1)
+        global_registry().register_collector(
+            "bkr-regression", b,
+            lambda br: {
+                "gauges": {"t": float(br.snapshot()["opened_count"])}
+            },
+        )
+        try:
+            b.record_failure()  # closed → open → dump → collect → snapshot
+            assert flight.dumps == 1
+        finally:
+            global_registry().unregister_collector("bkr-regression")
+
+    def test_dump_dir_is_bounded(self, tmp_path):
+        rec = obs_flight.FlightRecorder(
+            dump_dir=str(tmp_path / "fl"), max_dumps=3
+        )
+        for i in range(6):
+            assert rec.dump("r", site=f"s{i}") is not None
+        files = [f for f in os.listdir(rec.dump_dir) if f.endswith(".json")]
+        assert len(files) == 3
+        assert all("s5" in f or "s4" in f or "s3" in f for f in files)
+
+    def test_ring_is_bounded(self, flight):
+        for i in range(flight.capacity + 50):
+            obs_flight.note("fault", f"s{i}")
+        assert len(flight.events) == flight.capacity
+
+    def test_dump_failure_is_counted_not_raised(self, tmp_path):
+        rec = obs_flight.FlightRecorder(
+            dump_dir=str(tmp_path / "flight-as-file")
+        )
+        open(rec.dump_dir, "w").close()  # makedirs will fail on a file
+        assert rec.dump("reason", site="s") is None
+        assert rec.dump_failures == 1
+
+
+# ================================================================== static check
+def test_check_obs_static_coverage():
+    """Instrumentation cannot silently drift: every fault site and
+    journal state maps to a registered span (tools/check_obs.py)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_obs.py")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"

@@ -23,6 +23,15 @@
 # rollback / feedback.flush — and asserts the restarted loop self-heals
 # to PROMOTED with the final served model bit-identical to an
 # uninterrupted run, plus feedback-spool exactly-once under kills).
+#
+# ISSUE 10: every InjectedCrash dumps the observability flight recorder
+# (bounded event ring + metrics snapshot, CRC32C-wrapped, atomic write).
+# The whole matrix runs with CMLHN_FLIGHT_DIR pointed at a fresh dir,
+# and the verification block below asserts that the kill rows left
+# postmortem artifacts that ROUND-TRIP: parseable, CRC-intact, tagged
+# with the killing site, the site present in the dump's own event ring,
+# and every major site family (stream/WAL, fit checkpoint, model IO,
+# lifecycle) represented.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -30,6 +39,8 @@ MARK="chaos"
 if [[ "${1:-}" != "--slow" ]]; then
     MARK="chaos and not slow"
 fi
+
+export CMLHN_FLIGHT_DIR=$(mktemp -d /tmp/chaos_flight.XXXXXX)
 
 LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
@@ -72,5 +83,68 @@ for site in sorted(tally):
 print()
 print("ALL SITES RECOVERED" if bad == 0 else f"{bad} CASE(S) FAILED")
 EOF
+
+echo
+echo "== flight recorder: postmortem round-trip =="
+JAX_PLATFORMS=cpu python - "$CMLHN_FLIGHT_DIR" <<'EOF'
+import os
+import sys
+from collections import Counter
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.flight_recorder import (
+    read_dump,
+)
+
+d = sys.argv[1]
+dumps = sorted(
+    os.path.join(d, f) for f in os.listdir(d) if f.endswith(".json")
+)
+sites = Counter()
+bad = []
+for path in dumps:
+    try:
+        payload = read_dump(path)          # CRC + shape verification
+    except (ValueError, OSError) as e:
+        bad.append(f"{os.path.basename(path)}: {e}")
+        continue
+    site = payload.get("site")
+    if not site:
+        bad.append(f"{os.path.basename(path)}: no killing site recorded")
+        continue
+    # the dump must contain the killing site's own event in its ring
+    if not any(e.get("name") == site for e in payload.get("events", [])):
+        bad.append(
+            f"{os.path.basename(path)}: site {site!r} absent from ring"
+        )
+        continue
+    sites[site] += 1
+
+width = max((len(s) for s in sites), default=10) + 2
+for site in sorted(sites):
+    print(f"{site:<{width}} {sites[site]:>4} dump(s)")
+
+# every kill family in the matrix must have left at least one artifact
+import fnmatch
+FAMILIES = ["stream.after_*", "wal.append", "fit_ckpt.*",
+            "model_io.save.*", "lifecycle.*"]
+missing = [
+    fam for fam in FAMILIES
+    if not any(fnmatch.fnmatchcase(s, fam) for s in sites)
+]
+print()
+if not dumps:
+    print("NO FLIGHT DUMPS WRITTEN"); sys.exit(1)
+if bad:
+    print(f"{len(bad)} CORRUPT/INCOMPLETE DUMP(S):")
+    for b in bad:
+        print(f"  - {b}")
+    sys.exit(1)
+if missing:
+    print(f"SITE FAMILIES WITHOUT A POSTMORTEM: {missing}"); sys.exit(1)
+print(f"ALL {len(dumps)} DUMP(S) CRC-INTACT; every kill family covered")
+EOF
+frc=$?
+rm -rf "$CMLHN_FLIGHT_DIR"
+[[ $frc -ne 0 ]] && exit "$frc"
 
 exit "$rc"
