@@ -2514,6 +2514,223 @@ def _bench_obs_overhead() -> dict:
     }
 
 
+def _bench_model_farm() -> dict:
+    """Model farm A/B (ISSUE 11): T per-hospital models fit + served as
+    ONE compiled dispatch vs a Python loop of per-tenant dispatches of
+    the SAME kernels (identical padded shapes, one executable each side
+    — so the measured gap is pure dispatch/fusion overhead, certified by
+    a bitwise parity check on a sampled tenant set).
+
+    Reports tenants/s-fit (farm vs looped, the headline), pred/s (one
+    mixed-tenant batch vs per-tenant dispatches), a sampled k-means fit
+    A/B, and the zero-recompile certificate across serve request sizes.
+    Gate: fit speedup ≥ 20 on the CPU proxy (ROADMAP expects ≥ 50
+    on-chip, where each looped dispatch additionally pays the tunnel
+    round trip), with exact parity and recompiles = 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm import (
+        FarmLinearRegression,
+        pack_tenants,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm.farm import (
+        _farm_linear_fit,
+        _init_farm_centers,
+        _make_farm_kmeans_loop,
+        _single_linear_fit,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        ModelRegistry,
+    )
+
+    _apply_forced_platform()
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    tenants = int(os.environ.get("BENCH_FARM_TENANTS", 4096))
+    d = 8
+
+    # ragged fleet: hospital sizes 4–48 rows (incl. a few tiny ones)
+    rng = np.random.default_rng(0)
+    theta0 = rng.normal(size=d)
+    data = {}
+    for t in range(tenants):
+        n = int(rng.integers(4, 48))
+        x = rng.normal(size=(n, d))
+        y = x @ (theta0 + 0.2 * rng.normal(size=d)) + 0.01 * rng.normal(size=n)
+        data[f"H{t:05d}"] = (x, y)
+    batch = pack_tenants(data)
+    total_rows = int(batch.n_rows.sum())
+    x_dev = jnp.asarray(batch.x)
+    y_dev = jnp.asarray(batch.y)
+    w_dev = jnp.asarray(batch.w)
+    reg = jnp.float32(0.1)
+    zero = jnp.float32(0.0)
+    zeros = jnp.zeros((d + 1,), jnp.float32)
+
+    # ---- fit A/B: one dispatch vs T dispatches of the same kernel ----
+    farm_out = _farm_linear_fit(x_dev, y_dev, w_dev, reg, zero, True)
+    _fence(farm_out)  # warm (compile) before any timed window
+
+    def farm_fit_rate():
+        t0 = time.perf_counter()
+        out = _farm_linear_fit(x_dev, y_dev, w_dev, reg, zero, True)
+        _fence(out)
+        return tenants / (time.perf_counter() - t0)
+
+    _fence(_single_linear_fit(x_dev[0], y_dev[0], w_dev[0], reg, zero, zeros, True))
+
+    def loop_fit_rate():
+        t0 = time.perf_counter()
+        outs = [
+            _single_linear_fit(
+                x_dev[i], y_dev[i], w_dev[i], reg, zero, zeros, True
+            )
+            for i in range(tenants)
+        ]
+        _fence(outs[-1])
+        return tenants / (time.perf_counter() - t0)
+
+    farm_fit, farm_var = _best_of(farm_fit_rate)
+    loop_fit, loop_var = _best_of(loop_fit_rate)
+    fit_speedup = farm_fit / loop_fit
+
+    # parity certificate on a sampled tenant set: params bit-equal
+    theta_farm = np.asarray(jax.device_get(farm_out[0]))
+    sample = rng.choice(tenants, size=min(64, tenants), replace=False)
+    parity = all(
+        np.array_equal(
+            np.asarray(
+                _single_linear_fit(
+                    x_dev[i], y_dev[i], w_dev[i], reg, zero, zeros, True
+                )
+            ),
+            theta_farm[i],
+        )
+        for i in sample
+    )
+
+    # ---- predict A/B: one mixed-tenant batch vs per-tenant dispatches
+    model = FarmLinearRegression(reg_param=0.1, pool=0.0).fit(batch)
+    fn = jax.jit(model.serving_predict_fn())
+    mixed = np.concatenate(
+        [
+            model.route_request(tid, data[tid][0])
+            for tid in list(data)
+        ]
+    ).astype(np.float32)
+    mixed_dev = jnp.asarray(mixed)
+    _fence(fn(mixed_dev))
+
+    def farm_pred_rate():
+        t0 = time.perf_counter()
+        _fence(fn(mixed_dev))
+        return mixed.shape[0] / (time.perf_counter() - t0)
+
+    per_tenant = {
+        tid: jnp.asarray(
+            model.route_request(tid, data[tid][0]), jnp.float32
+        )
+        for tid in list(data)[: min(512, tenants)]
+    }
+    for v in per_tenant.values():
+        _fence(fn(v))
+        break  # shapes vary per tenant; timing loop compiles the rest
+
+    def loop_pred_rate():
+        rows = 0
+        t0 = time.perf_counter()
+        last = None
+        for v in per_tenant.values():
+            last = fn(v)
+            rows += v.shape[0]
+        _fence(last)
+        return rows / (time.perf_counter() - t0)
+
+    loop_pred_rate()  # warm every ragged shape before the timed run
+    farm_pred, _ = _best_of(farm_pred_rate)
+    loop_pred, _ = _best_of(loop_pred_rate)
+
+    # ---- sampled k-means A/B (the second farmed family) --------------
+    km_tenants = min(512, tenants)
+    km_ids = list(data)[:km_tenants]
+    km_batch = pack_tenants({t: data[t][0] for t in km_ids})
+    _fence(
+        _make_farm_kmeans_loop(10, 1e-8)(
+            jnp.asarray(km_batch.x), jnp.asarray(km_batch.w),
+            *map(jnp.asarray, _init_farm_centers(km_batch.x, km_batch.w, 4, 1)),
+        )
+    )
+    loop_km = _make_farm_kmeans_loop(10, 1e-8)
+
+    def farm_km_rate():
+        c0, cv = _init_farm_centers(km_batch.x, km_batch.w, 4, 1)
+        t0 = time.perf_counter()
+        out = loop_km(
+            jnp.asarray(km_batch.x), jnp.asarray(km_batch.w),
+            jnp.asarray(c0), jnp.asarray(cv),
+        )
+        _fence(out)
+        return km_tenants / (time.perf_counter() - t0)
+
+    xk = jnp.asarray(km_batch.x)
+    wk = jnp.asarray(km_batch.w)
+    c0_all, cv_all = _init_farm_centers(km_batch.x, km_batch.w, 4, 1)
+    _fence(loop_km(xk[:1], wk[:1], jnp.asarray(c0_all[:1]), jnp.asarray(cv_all[:1])))
+
+    def loop_km_rate():
+        t0 = time.perf_counter()
+        out = None
+        for i in range(km_tenants):
+            out = loop_km(
+                xk[i : i + 1], wk[i : i + 1],
+                jnp.asarray(c0_all[i : i + 1]), jnp.asarray(cv_all[i : i + 1]),
+            )
+        _fence(out)
+        return km_tenants / (time.perf_counter() - t0)
+
+    km_farm, _ = _best_of(farm_km_rate)
+    km_loop, _ = _best_of(loop_km_rate)
+
+    # ---- serve-path recompile certificate ----------------------------
+    sreg = ModelRegistry()
+    sm = sreg.register("farm", model, warmup=True)
+    ids = list(data)
+    for size in (1, 7, 32, 3, 17, 1, 32):
+        tid = ids[int(rng.integers(len(ids)))]
+        sm.predict(model.route_request(tid, rng.normal(size=(size, d))))
+    recompiles = sm.metrics.recompile_count
+
+    gate = 50.0 if on_tpu else 20.0
+    return {
+        "metric": (
+            f"model farm: {tenants} per-hospital fits as one dispatch, "
+            f"farm/looped tenants-per-s ({platform})"
+        ),
+        "value": round(fit_speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(fit_speedup, 2),
+        "gate_pass": bool(
+            fit_speedup >= gate and parity and recompiles == 0
+        ),
+        "gate": gate,
+        "tenants": tenants,
+        "total_rows": total_rows,
+        "fit_tenants_per_s_farm": round(farm_fit, 1),
+        "fit_tenants_per_s_looped": round(loop_fit, 1),
+        "fit_variance": {"farm": farm_var, "looped": loop_var},
+        "pred_rows_per_s_farm": round(farm_pred, 1),
+        "pred_rows_per_s_looped": round(loop_pred, 1),
+        "pred_speedup": round(farm_pred / loop_pred, 2),
+        "kmeans_tenants": km_tenants,
+        "kmeans_speedup": round(km_farm / km_loop, 2),
+        "parity_sampled_tenants": int(sample.size),
+        "parity_bitwise": bool(parity),
+        "recompiles_across_sizes": int(recompiles),
+        "platform": platform,
+    }
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -2534,6 +2751,7 @@ CONFIGS = {
     "sql_device": lambda: _bench_sql_device(),                  # ISSUE 7 A/B
     "lifecycle": lambda: _bench_lifecycle(),                    # ISSUE 9 loop
     "obs_overhead": lambda: _bench_obs_overhead(),              # ISSUE 10 gate
+    "model_farm": lambda: _bench_model_farm(),                  # ISSUE 11 A/B
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets
@@ -2773,9 +2991,9 @@ def _child_main(name: str) -> None:
 #: recovers mid-window: headline first (north star, then the A/B the
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
-    "kmeans256", "pallas_ab", "kmeans_fused_ab", "sql_device", "rf20",
-    "gbt20", "nb", "gmm32", "bisecting", "streaming", "streaming_pipeline",
-    "kmeans8", "serve",
+    "kmeans256", "pallas_ab", "kmeans_fused_ab", "model_farm", "sql_device",
+    "rf20", "gbt20", "nb", "gmm32", "bisecting", "streaming",
+    "streaming_pipeline", "kmeans8", "serve",
 ]
 
 

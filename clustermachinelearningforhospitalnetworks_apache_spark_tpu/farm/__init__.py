@@ -1,0 +1,33 @@
+"""Model farm: thousands of per-hospital models fit and served as ONE
+compiled dispatch (ROADMAP item 3 — the scenario that makes "millions of
+users" concrete for a hospital *network*).
+
+``vmap`` over a leading tenant axis turns 1k–10k tiny per-hospital fits
+from a Python loop of dispatches into one XLA program; ragged tenant
+sizes ride the repo's pad-and-weight contract (``parallel/sharding``),
+per-tenant convergence is a masked ``lax.while_loop``, and optional
+hierarchical partial pooling shrinks small-hospital parameters toward
+the pooled global model.  One saved artifact carries every tenant's
+parameters plus mergeable per-tenant feature sketches; serving routes a
+request to its tenant's slice with a shape-bucketed gather; lifecycle
+refits only the drifted subset.
+"""
+
+from .farm import (
+    FarmKMeans,
+    FarmLinearRegression,
+    ModelFarmModel,
+    TenantBatch,
+    pack_tenants,
+)
+from .drift import drifted_tenants, tenant_psi
+
+__all__ = [
+    "FarmKMeans",
+    "FarmLinearRegression",
+    "ModelFarmModel",
+    "TenantBatch",
+    "pack_tenants",
+    "drifted_tenants",
+    "tenant_psi",
+]

@@ -1,0 +1,1010 @@
+"""The model farm: per-tenant estimators over a leading tenant axis.
+
+MLlib (arXiv 1505.06807) motivates a uniform many-estimator surface; on
+TPU the right realization is ``vmap``: stack every hospital's (tiny)
+dataset along a leading tenant axis — ragged sizes padded with a weight
+mask, the same contract every estimator here already consumes — and run
+ONE compiled program that fits all of them simultaneously.  A looped
+baseline pays one dispatch (and, for ragged shapes, one compile) per
+hospital; the farm pays one dispatch per *fleet*.
+
+Families (designed so trees can follow — the contract is "per-tenant
+sufficient statistics under ``vmap``, masked convergence, stacked
+parameter arrays with a trailing GLOBAL slot"):
+
+* **linear** — per-tenant weighted least squares with Spark-style ridge
+  (``reg_param`` scaled by tenant weight, intercept unpenalized) plus
+  hierarchical partial pooling: ``pool`` acts as that many pseudo-rows
+  of the pooled global fit, so a 3-row hospital lands near the global
+  model while a 10k-row hospital keeps its own parameters.  The global
+  (pooled, exact all-tenant WLS) fit rides in the same jit from the
+  already-computed per-tenant Gram sums.
+* **kmeans** — per-tenant Lloyd with per-tenant convergence handled by
+  a masked ``lax.while_loop``: a converged tenant's centers freeze while
+  the rest keep iterating, so one program serves every hospital's
+  trajectory.  The global slot is a pooled-sample fit through the same
+  kernel.
+
+Quality stance (``quality/``): NaN is MISSING, not wrong — a non-finite
+row gets weight 0 at pack time, an all-NaN tenant degrades to an empty
+tenant (global parameters under pooling, zeros without), and nothing a
+single hospital sends can poison the farm's reductions.
+
+Every model slice remains a first-class citizen: ``tenant_model(tid)``
+materializes the ordinary ``LinearRegressionModel``/``KMeansModel``,
+and the whole farm saves as ONE ``io/model_io`` artifact (one manifest,
+stacked arrays, per-tenant feature sketches — mergeable, so drift
+scoring needs no second pass over training data).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..io.model_io import register_model
+from ..obs import trace as _trace
+from ..obs.registry import cohort_label, global_registry
+from ..parallel.sharding import slot_mask, stack_ragged
+from ..quality.sketches import DataProfile, FeatureSketch
+from .profiles import build_profile_stack, profile_of
+
+#: sentinel distance for invalid centroids (np scalar: a module-level jnp
+#: constant would initialize the backend at import time)
+_BIG = np.float32(1e30)
+
+#: base Tikhonov floor on every per-tenant solve — keeps a 1-row
+#: hospital's rank-1 Gram solvable in f32 instead of returning garbage
+_EPS = 1e-6
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+# ==========================================================================
+# Tenant packing: ragged per-hospital data → (T, R, d) + weight mask
+# ==========================================================================
+
+
+@dataclass
+class TenantBatch:
+    """Ragged per-tenant datasets stacked along a leading tenant axis.
+
+    ``x``: (T, R, d) features, ``y``: (T, R) labels (zeros when absent),
+    ``w``: (T, R) validity/sample weights (0 past each tenant's rows AND
+    on rows carrying non-finite values), ``n_rows``: valid rows per
+    tenant, ``masked_rows``: rows zero-weighted for non-finite values
+    (the quality stance: missing, not fatal)."""
+
+    tenant_ids: tuple[str, ...]
+    x: np.ndarray
+    y: np.ndarray
+    w: np.ndarray
+    n_rows: np.ndarray
+    masked_rows: np.ndarray
+
+    @property
+    def n_tenants(self) -> int:
+        return len(self.tenant_ids)
+
+    @property
+    def n_features(self) -> int:
+        return self.x.shape[2]
+
+    @property
+    def pad_rows(self) -> int:
+        return self.x.shape[1]
+
+
+def pack_tenants(
+    data: Mapping[str, Any],
+    pad_to: int | None = None,
+) -> TenantBatch:
+    """Pack ``{tenant_id: x | (x, y) | (x, y, w)}`` into a
+    :class:`TenantBatch`.
+
+    ``pad_to`` pins the row-padded length R (refits reuse the original
+    farm's R so executables are shared); otherwise R is the next power
+    of two ≥ the largest tenant — the serve bucket discipline applied to
+    the fit path, so growing a tenant by a few rows doesn't recompile.
+    Rows with any non-finite value get weight 0 and are counted in
+    ``masked_rows``."""
+    items = [(str(t), v) for t, v in data.items()]
+    ids = tuple(t for t, _ in items)
+    if not ids:
+        raise ValueError("pack_tenants needs at least one tenant")
+    if len(set(ids)) != len(ids):
+        raise ValueError("tenant ids collide after str() normalization")
+    xs: list[np.ndarray] = []
+    ys: list[np.ndarray] = []
+    ws: list[np.ndarray] = []
+    masked = np.zeros((len(ids),), dtype=np.int64)
+    for i, (tid, v) in enumerate(items):
+        if isinstance(v, tuple):
+            xv = np.atleast_2d(np.asarray(v[0], dtype=np.float64))
+            yv = (
+                np.asarray(v[1], dtype=np.float64).reshape(-1)
+                if len(v) > 1 and v[1] is not None
+                else np.zeros((xv.shape[0],))
+            )
+            wv = (
+                np.asarray(v[2], dtype=np.float64).reshape(-1)
+                if len(v) > 2 and v[2] is not None
+                else np.ones((xv.shape[0],))
+            )
+        else:
+            xv = np.atleast_2d(np.asarray(v, dtype=np.float64))
+            yv = np.zeros((xv.shape[0],))
+            wv = np.ones((xv.shape[0],))
+        if xv.shape[0] != yv.shape[0] or xv.shape[0] != wv.shape[0]:
+            raise ValueError(
+                f"tenant {tid!r}: x has {xv.shape[0]} rows, y "
+                f"{yv.shape[0]}, w {wv.shape[0]}"
+            )
+        if np.any(wv < 0):
+            raise ValueError(f"tenant {tid!r}: sample weights must be >= 0")
+        finite = np.isfinite(xv).all(axis=1) & np.isfinite(yv)
+        masked[i] = int(xv.shape[0] - finite.sum())
+        wv = np.where(finite, wv, 0.0)
+        xv = np.where(finite[:, None], xv, 0.0)  # inert under w=0
+        yv = np.where(finite, yv, 0.0)
+        xs.append(xv)
+        ys.append(yv.reshape(-1, 1))
+        ws.append(wv)
+    d = xs[0].shape[1]
+    for tid, xv in zip(ids, xs):
+        if xv.shape[1] != d:
+            raise ValueError(
+                f"tenant {tid!r} has {xv.shape[1]} features, expected {d}"
+            )
+    max_rows = max(x.shape[0] for x in xs)
+    R = pad_to if pad_to is not None else _next_pow2(max(max_rows, 1))
+    x_stack, w_stack = stack_ragged(xs, ws, pad_to=R)
+    y_stack, _ = stack_ragged(ys, None, pad_to=R)
+    n_rows = np.array([int((wv > 0).sum()) for wv in ws], dtype=np.int64)
+    return TenantBatch(
+        tenant_ids=ids,
+        x=x_stack,
+        y=y_stack[:, :, 0],
+        w=w_stack,
+        n_rows=n_rows,
+        masked_rows=masked,
+    )
+
+
+# ==========================================================================
+# Linear family kernels
+# ==========================================================================
+
+
+def _linear_stats(xa, y, w):
+    """Per-tenant WLS sufficient statistics on the (R, dd) augmented
+    design: (Gram, moment, Σw).  The one copy both the vmapped farm fit
+    and the looped single-tenant baseline trace through."""
+    xw = xa * w[:, None]
+    return xw.T @ xa, xw.T @ y, jnp.sum(w)
+
+
+def _posdef_solve(a, b):
+    """Gauss-Jordan solve for the (small, SPD) per-tenant systems.
+
+    Written in outer-product form — every operation is elementwise or a
+    broadcast, with NO reductions — because reduction-bearing solves
+    (batched LAPACK-style ``jnp.linalg.solve``) produce ulp-different
+    results batched vs single, and the farm's bit-parity contract is
+    that the vmapped fleet fit equals the looped per-tenant baseline
+    EXACTLY.  The matmul'd Gram statistics already match bit-for-bit
+    (measured); this keeps the solve from being the one divergent stage.
+    SPD systems need no pivoting; the caller guarantees a positive
+    diagonal (ridge + ε floor)."""
+    dd = a.shape[-1]
+    idx = jnp.arange(dd)
+
+    def step(i, carry):
+        a, b = carry
+        piv = a[i, i]
+        m = jnp.where(idx != i, a[:, i] / piv, 0.0)
+        a = a - m[:, None] * a[i][None, :]
+        b = b - m * b[i]
+        return a, b
+
+    a, b = lax.fori_loop(0, dd, step, (a, b))
+    return b / jnp.diagonal(a)
+
+
+def _linear_solve(gram, mom, nt, reg, pool, theta_g, pen):
+    """(Gram, moment) → θ with Spark-style ridge (``reg·Σw`` on the
+    penalized dims) plus partial pooling: ``pool`` pseudo-rows of the
+    global fit θ_g — solve (G + reg·Σw·diag(pen) + (pool+ε)I)θ =
+    m + pool·θ_g.  An empty tenant (G = m = 0) lands on θ_g exactly as
+    pool/(pool+ε) → θ_g."""
+    dd = gram.shape[0]
+    eye = jnp.eye(dd, dtype=gram.dtype)
+    a = gram + jnp.diag(reg * nt * pen) + (pool + _EPS) * eye
+    return _posdef_solve(a, mom + pool * theta_g)
+
+
+def _augment(x, fit_intercept: bool):
+    if not fit_intercept:
+        return x
+    return jnp.concatenate([x, jnp.ones_like(x[..., :1])], axis=-1)
+
+
+def _linear_prologue(x, y, w, fit_intercept: bool):
+    """The one copy of the linear kernels' shared preamble (f32 cast,
+    intercept augmentation, ridge-penalty mask with the intercept
+    unpenalized) — fit, refit, and the looped single-tenant baseline all
+    trace through it, so a future change cannot silently break their
+    bit-parity contract."""
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    xa = _augment(x, fit_intercept)
+    pen = jnp.ones((xa.shape[-1],), jnp.float32)
+    if fit_intercept:
+        pen = pen.at[x.shape[-1]:].set(0.0)
+    return xa, y, w, pen
+
+
+def _route_index(col, g: int):
+    """Tenant-index column → safe farm index: anything non-finite,
+    negative, fractional-garbage, or past the GLOBAL slot routes to the
+    GLOBAL slot — a malformed request must never be answered with some
+    other hospital's private parameters.  Clip happens on the FLOAT
+    (int-cast of huge floats is undefined), then the validity test."""
+    raw = jnp.nan_to_num(col, nan=-1.0, posinf=-1.0, neginf=-1.0)
+    idx = jnp.clip(raw, -1.0, float(g)).astype(jnp.int32)
+    return jnp.where((idx >= 0) & (idx <= g), idx, g)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _farm_linear_fit(x, y, w, reg, pool, fit_intercept: bool):
+    """ONE program fitting every tenant: vmapped stats → pooled global
+    solve → vmapped per-tenant shrinkage solve.  → (θ (T, dd), θ_g)."""
+    xa, y, w, pen = _linear_prologue(x, y, w, fit_intercept)
+    gram, mom, nt = jax.vmap(_linear_stats)(xa, y, w)
+    zeros = jnp.zeros((xa.shape[-1],), jnp.float32)
+    theta_g = _linear_solve(
+        gram.sum(0), mom.sum(0), nt.sum(), reg, jnp.float32(0.0), zeros, pen
+    )
+    theta = jax.vmap(
+        _linear_solve, in_axes=(0, 0, 0, None, None, None, None)
+    )(gram, mom, nt, reg, pool, theta_g, pen)
+    return theta, theta_g
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _single_linear_fit(x, y, w, reg, pool, theta_g, fit_intercept: bool):
+    """The looped-per-tenant baseline: the SAME stats+solve on one (R, d)
+    tenant — one dispatch per hospital instead of one per fleet.  Bench
+    and the parity tests loop this; the farm must match it bit-for-bit."""
+    xa, y, w, pen = _linear_prologue(x, y, w, fit_intercept)
+    gram, mom, nt = _linear_stats(xa, y, w)
+    return _linear_solve(gram, mom, nt, reg, pool, theta_g, pen)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _farm_linear_refit(x, y, w, reg, pool, theta_g, fit_intercept: bool):
+    """Masked refit of a drifted SUBSET: per-tenant solves against the
+    FROZEN global θ_g (recomputing the global from a drifted subset
+    would drag every stable tenant's prior toward the drift)."""
+    xa, y, w, pen = _linear_prologue(x, y, w, fit_intercept)
+    gram, mom, nt = jax.vmap(_linear_stats)(xa, y, w)
+    return jax.vmap(
+        _linear_solve, in_axes=(0, 0, 0, None, None, None, None)
+    )(gram, mom, nt, reg, pool, theta_g, pen)
+
+
+# ==========================================================================
+# KMeans family kernels
+# ==========================================================================
+
+
+def _kmeans_assign_stats(x, w, centers, c_valid):
+    """Per-tenant Lloyd sufficient statistics on (R, d) rows × (k, d)
+    centers: (sums, counts, cost).  Cross-term distance form — no
+    (R, k, d) intermediate, so the vmapped farm version stays within a
+    (T, R, k) working set."""
+    from ..ops.distance import pairwise_sqdist
+
+    d2 = pairwise_sqdist(x, centers)
+    d2 = jnp.where(c_valid[None, :] > 0, d2, _BIG)
+    arg = jnp.argmin(d2, axis=1)
+    mind = jnp.maximum(jnp.min(d2, axis=1), 0.0)
+    oh = jax.nn.one_hot(arg, centers.shape[0], dtype=x.dtype) * w[:, None]
+    return oh.T @ x, jnp.sum(oh, axis=0), jnp.sum(mind * w)
+
+
+def _kmeans_update(x, w, centers, c_valid):
+    """One Lloyd update for one tenant → (new_centers, move²).  Empty
+    clusters keep their previous center (Spark behavior, same rule as
+    ``models/kmeans._centroid_rule``)."""
+    sums, counts, _ = _kmeans_assign_stats(x, w, centers, c_valid)
+    new_centers = jnp.where(
+        (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], centers
+    )
+    move = jnp.max(jnp.sum((new_centers - centers) ** 2, axis=1) * c_valid)
+    return new_centers, move
+
+
+@lru_cache(maxsize=32)
+def _make_farm_kmeans_step(tol_sq: float):
+    """One masked farm Lloyd iteration: tenants not yet converged apply
+    the update and count the iteration; converged tenants' centers stay
+    frozen (their wasted lanes are the price of one program — far below
+    the dispatch-per-tenant price of the loop)."""
+
+    def step(x, w, centers, c_valid, done, n_iter):
+        new_centers, move = jax.vmap(_kmeans_update)(x, w, centers, c_valid)
+        apply = ~done
+        centers = jnp.where(apply[:, None, None], new_centers, centers)
+        n_iter = n_iter + apply.astype(jnp.int32)
+        done = done | (move <= tol_sq)
+        return centers, done, n_iter
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=32)
+def _make_farm_kmeans_loop(max_iter: int, tol_sq: float):
+    """The whole farm Lloyd trajectory as ONE device computation: a
+    ``lax.while_loop`` that runs until every tenant converges (or
+    max_iter), with per-tenant freezing — one host sync per farm fit."""
+    step = _make_farm_kmeans_step(tol_sq)
+
+    def loop(x, w, centers, c_valid):
+        x = x.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        t = x.shape[0]
+        done0 = jnp.zeros((t,), bool)
+        n0 = jnp.zeros((t,), jnp.int32)
+
+        def cond(carry):
+            it, _, done, _ = carry
+            return (it < max_iter) & jnp.any(~done)
+
+        def body(carry):
+            it, cen, done, n_iter = carry
+            cen, done, n_iter = step(x, w, cen, c_valid, done, n_iter)
+            return it + 1, cen, done, n_iter
+
+        _, cen, done, n_iter = lax.while_loop(
+            cond, body, (jnp.int32(0), centers, done0, n0)
+        )
+        # final stats pass: cost/sizes describe the RETURNED centers
+        _, counts, cost = jax.vmap(_kmeans_assign_stats)(x, w, cen, c_valid)
+        return cen, counts, cost, n_iter
+
+    return jax.jit(loop)
+
+
+@jax.jit
+def _farm_kmeans_final(x, w, centers, c_valid):
+    x = x.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    _, counts, cost = jax.vmap(_kmeans_assign_stats)(x, w, centers, c_valid)
+    return counts, cost
+
+
+def _init_farm_centers(
+    x: np.ndarray, w: np.ndarray, k: int, seed: int, base_index: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side per-tenant init: k distinct valid rows drawn from a
+    per-tenant seeded stream (``[seed, base_index + t]`` — the fold
+    keeps the draw identical whether the tenant is fit in the full farm,
+    a looped baseline, or a refit subset).  Tenants with fewer than k
+    valid rows get that many valid centers; empty tenants get none."""
+    t_n, _, d = x.shape
+    centers = np.zeros((t_n, k, d), dtype=np.float32)
+    c_valid = np.zeros((t_n, k), dtype=np.float32)
+    for t in range(t_n):
+        valid = np.flatnonzero(w[t] > 0)
+        if valid.size == 0:
+            continue
+        rng = np.random.default_rng([seed, base_index + t])
+        take = min(k, valid.size)
+        pick = rng.choice(valid, size=take, replace=False)
+        centers[t, :take] = x[t, pick]
+        c_valid[t, :take] = 1.0
+    return centers, c_valid
+
+
+# ==========================================================================
+# The farm model (one artifact, every tenant + the global slot)
+# ==========================================================================
+
+
+@register_model("ModelFarmModel")
+@dataclass(eq=False)  # array-holding dict fields make generated __eq__
+# ambiguous; identity comparison is the meaningful one for artifacts
+class ModelFarmModel:
+    """Every tenant's parameters stacked along a leading axis, with one
+    extra trailing GLOBAL slot (index ``n_tenants``) holding the pooled
+    model — the fallback slice unknown tenants route to.
+
+    The serving contract is the repo's standard row-local pure function,
+    with the tenant carried IN-BAND: requests are ``(batch, 1 + d)``
+    where column 0 is the farm index (``route_request`` prepends it from
+    a tenant id) and the predict gathers each row's parameter slice on
+    device — shape-bucketed by the serve layer exactly like any other
+    family, zero steady-state recompiles."""
+
+    family: str                       # "linear" | "kmeans"
+    tenant_ids: tuple[str, ...]
+    arrays: dict[str, np.ndarray]
+    config: dict
+
+    def __post_init__(self):
+        self.tenant_ids = tuple(str(t) for t in self.tenant_ids)
+        self._index = {t: i for i, t in enumerate(self.tenant_ids)}
+        self._fn_cache: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ shape
+    @property
+    def n_tenants(self) -> int:
+        return len(self.tenant_ids)
+
+    @property
+    def global_index(self) -> int:
+        return self.n_tenants
+
+    @property
+    def d(self) -> int:
+        return int(self.config["d"])
+
+    @property
+    def num_features(self) -> int:
+        """d features + the in-band tenant-index column."""
+        return self.d + 1
+
+    def tenant_index(self, tenant_id: str, strict: bool = False) -> int:
+        i = self._index.get(str(tenant_id))
+        if i is None:
+            if strict:
+                raise KeyError(
+                    f"unknown tenant {tenant_id!r} (farm has "
+                    f"{self.n_tenants} tenants)"
+                )
+            return self.global_index
+        return i
+
+    # ------------------------------------------------------------ predict
+    def serving_predict_fn(self):
+        """Pure row-local ``(batch, 1+d) -> (batch,)`` predict: gather
+        each row's tenant slice (column 0 = farm index; non-finite or
+        out-of-range indices clamp to the GLOBAL slot), then the family
+        rule on the remaining d feature columns."""
+        with self._lock:
+            fn = self._fn_cache.get("serving")
+            if fn is not None:
+                return fn
+        g = self.global_index
+        if self.family == "linear":
+            coef = jnp.asarray(self.arrays["coefficients"], jnp.float32)
+            intercept = jnp.asarray(self.arrays["intercepts"], jnp.float32)
+
+            def fn(x):
+                x = x.astype(jnp.float32)
+                idx = _route_index(x[:, 0], g)
+                f = x[:, 1:]
+                return jnp.sum(f * coef[idx], axis=1) + intercept[idx]
+
+        elif self.family == "kmeans":
+            centers = jnp.asarray(self.arrays["centers"], jnp.float32)
+            c_valid = jnp.asarray(self.arrays["center_valid"], jnp.float32)
+
+            def fn(x):
+                x = x.astype(jnp.float32)
+                idx = _route_index(x[:, 0], g)
+                f = x[:, 1:]
+                c = centers[idx]                       # (n, k, d) gather
+                d2 = jnp.sum((f[:, None, :] - c) ** 2, axis=-1)
+                d2 = jnp.where(c_valid[idx] > 0, d2, _BIG)
+                return jnp.argmin(d2, axis=1).astype(jnp.float32)
+
+        else:  # pragma: no cover — from_artifacts validates
+            raise ValueError(f"unknown farm family {self.family!r}")
+        with self._lock:
+            self._fn_cache["serving"] = fn
+        return fn
+
+    def predict(self, x) -> jax.Array:
+        from ..models.base import check_features
+
+        check_features(x, self.num_features, "ModelFarmModel")
+        return self.serving_predict_fn()(jnp.asarray(x))
+
+    def route_request(self, tenant_id: str, x: np.ndarray) -> np.ndarray:
+        """tenant id + (n, d) features → the (n, 1+d) in-band request the
+        serve layer's bucket ladder consumes.  Unknown tenants route to
+        the GLOBAL slot; the routed cohort is counted (bounded labels —
+        obs.cohort_label, never one series per tenant)."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        idx = self.tenant_index(tenant_id)
+        global_registry().inc(
+            f'farm.requests{{cohort="{cohort_label(tenant_id)}"}}'
+        )
+        if idx == self.global_index and tenant_id not in self._index:
+            global_registry().inc("farm.requests_unknown_tenant")
+        return np.concatenate(
+            [np.full((x.shape[0], 1), float(idx)), x], axis=1
+        )
+
+    def predict_tenant(self, tenant_id: str, x: np.ndarray) -> np.ndarray:
+        """Host-side convenience: route + predict + unpad for one tenant
+        (serving goes through ``serve/`` instead — same routed form)."""
+        with _trace.span(
+            "farm.predict", {"cohort": cohort_label(tenant_id)}
+        ):
+            xt = self.route_request(tenant_id, x)
+            out = self.predict(jnp.asarray(xt, jnp.float32))
+            return np.asarray(jax.device_get(out))
+
+    # ------------------------------------------------------------ slices
+    def tenant_model(self, tenant_id: str):
+        """Materialize one tenant's slice as the ordinary family model —
+        the farm is a packing, not a new estimator family."""
+        i = self.tenant_index(tenant_id, strict=True)
+        return self._slice_model(i)
+
+    def global_model(self):
+        """The pooled global slice (what unknown tenants answer with)."""
+        return self._slice_model(self.global_index)
+
+    def _slice_model(self, i: int):
+        if self.family == "linear":
+            from ..models.linear_regression import LinearRegressionModel
+
+            return LinearRegressionModel(
+                coefficients=jnp.asarray(
+                    self.arrays["coefficients"][i], jnp.float32
+                ),
+                intercept=jnp.asarray(
+                    self.arrays["intercepts"][i], jnp.float32
+                ),
+            )
+        from ..models.kmeans import KMeansModel
+
+        valid = self.arrays["center_valid"][i] > 0
+        if not valid.any():
+            raise ValueError(
+                "tenant has no valid centers (empty tenant); predictions "
+                "route to cluster 0 — there is no per-tenant model to slice"
+            )
+        return KMeansModel(
+            cluster_centers=np.asarray(
+                self.arrays["centers"][i][valid], np.float32
+            ),
+            training_cost=float(self.arrays["costs"][i]),
+            n_iter=int(self.arrays["n_iter"][i]),
+            cluster_sizes=np.asarray(self.arrays["sizes"][i][valid]),
+        )
+
+    # ------------------------------------------------------------ profiles
+    def tenant_profile(self, tenant_id: str) -> DataProfile:
+        """The tenant's training-time feature sketches (the per-tenant
+        drift reference), rebuilt from the stacked arrays."""
+        i = self.tenant_index(tenant_id, strict=True)
+        return profile_of(self.arrays, self.feature_names, i)
+
+    @property
+    def feature_names(self) -> tuple[str, ...]:
+        return tuple(self.config["feature_names"])
+
+    def live_profile(self) -> DataProfile:
+        """An empty profile over the farm's shared reference edges — the
+        live-side accumulator for PSI scoring."""
+        edges = self.arrays["profile_edges"]
+        names = self.feature_names
+        return DataProfile(
+            names=names,
+            sketches={
+                n: FeatureSketch(edges=edges[j].copy())
+                for j, n in enumerate(names)
+            },
+        )
+
+    # ------------------------------------------------------------ refit
+    def refit(self, data: Mapping[str, Any], seed: int | None = None) -> "ModelFarmModel":
+        """Masked refit of a tenant SUBSET (the drifted ones): repack just
+        those tenants at the farm's original padded row length, refit
+        them against the FROZEN global slot, and scatter the results into
+        a new farm — every untouched tenant's parameters (and the global
+        slot) are byte-identical to the old artifact's.
+
+        The subset's tenant axis is padded to a power of two with inert
+        zero-weight dummies, so repeated refits of varying drift-set
+        sizes reuse a bounded executable set (the serve bucket
+        discipline, applied to retraining)."""
+        data = {str(t): v for t, v in data.items()}
+        ids = list(data)
+        if not ids:
+            return self
+        idx = np.array(
+            [self.tenant_index(t, strict=True) for t in ids], dtype=np.int64
+        )
+        sp = _trace.span("farm.refit", {"tenants": len(ids)})
+        with sp:
+            # pack ONCE at the farm's padded length (grown only if some
+            # tenant outgrew it) — refits share the fit's executables
+            max_rows = max(
+                (np.atleast_2d(np.asarray(v[0] if isinstance(v, tuple) else v))
+                 .shape[0])
+                for v in data.values()
+            )
+            r_pad = max(
+                int(self.config["pad_rows"]), _next_pow2(max(max_rows, 1))
+            )
+            batch = pack_tenants(data, pad_to=r_pad)
+            s_pad = _next_pow2(len(ids), floor=2)
+            x = np.zeros((s_pad, r_pad, self.d), np.float32)
+            y = np.zeros((s_pad, r_pad), np.float32)
+            w = np.zeros((s_pad, r_pad), np.float32)
+            x[: len(ids)] = batch.x
+            y[: len(ids)] = batch.y
+            w[: len(ids)] = batch.w
+            arrays = {k: v.copy() for k, v in self.arrays.items()}
+            cfg = dict(self.config)
+            if self.family == "linear":
+                theta_g = np.concatenate(
+                    [
+                        arrays["coefficients"][self.global_index],
+                        arrays["intercepts"][self.global_index : self.global_index + 1],
+                    ]
+                ) if cfg["fit_intercept"] else arrays["coefficients"][self.global_index]
+                theta = _farm_linear_refit(
+                    x, y, w,
+                    jnp.float32(cfg["reg_param"]), jnp.float32(cfg["pool"]),
+                    jnp.asarray(theta_g, jnp.float32), cfg["fit_intercept"],
+                )
+                theta = np.asarray(jax.device_get(theta))[: len(ids)]
+                d = self.d
+                arrays["coefficients"][idx] = theta[:, :d]
+                arrays["intercepts"][idx] = (
+                    theta[:, d] if cfg["fit_intercept"] else 0.0
+                )
+            else:
+                k = int(cfg["k"])
+                centers0 = np.zeros((s_pad, k, self.d), np.float32)
+                c_valid = np.zeros((s_pad, k), np.float32)
+                for j, t_glob in enumerate(idx):
+                    c, v = _init_farm_centers(
+                        batch.x[j : j + 1], batch.w[j : j + 1], k,
+                        int(cfg["seed"] if seed is None else seed),
+                        base_index=int(t_glob),
+                    )
+                    centers0[j], c_valid[j] = c[0], v[0]
+                loop = _make_farm_kmeans_loop(
+                    int(cfg["max_iter"]), float(cfg["tol"]) ** 2
+                )
+                cen, counts, cost, n_iter = loop(
+                    jnp.asarray(x), jnp.asarray(w),
+                    jnp.asarray(centers0), jnp.asarray(c_valid),
+                )
+                cen = np.asarray(jax.device_get(cen))[: len(ids)]
+                counts = np.asarray(jax.device_get(counts))[: len(ids)]
+                cost = np.asarray(jax.device_get(cost))[: len(ids)]
+                n_iter = np.asarray(jax.device_get(n_iter))[: len(ids)]
+                arrays["centers"][idx] = cen
+                arrays["center_valid"][idx] = c_valid[: len(ids)]
+                arrays["sizes"][idx] = counts
+                arrays["costs"][idx] = cost
+                arrays["n_iter"][idx] = n_iter
+            # refreshed tenants get refreshed sketches (same shared edges
+            # — profiles stay mergeable across the whole farm's history)
+            prof = build_profile_stack(
+                batch.x, batch.w, self.feature_names,
+                edges=arrays["profile_edges"],
+            )
+            arrays["profile_counts"][idx] = prof["profile_counts"]
+            arrays["profile_stats"][idx] = prof["profile_stats"]
+            arrays["tenant_rows"][idx] = batch.n_rows
+            arrays["masked_rows"][idx] = batch.masked_rows
+            reg = global_registry()
+            reg.inc("farm.refit_tenants", float(len(ids)))
+            reg.inc("farm.refit_rows", float(batch.n_rows.sum()))
+            if sp.trace_id is not None:
+                sp.note("rows", int(batch.n_rows.sum()))
+        return ModelFarmModel(
+            family=self.family,
+            tenant_ids=self.tenant_ids,
+            arrays=arrays,
+            config=cfg,
+        )
+
+    # ------------------------------------------------------------ persist
+    def _artifacts(self):
+        params = dict(self.config)
+        params["family"] = self.family
+        params["tenant_ids"] = list(self.tenant_ids)
+        return "ModelFarmModel", params, dict(self.arrays)
+
+    @classmethod
+    def from_artifacts(cls, params, arrays):
+        params = dict(params)
+        family = params.pop("family")
+        tenant_ids = tuple(params.pop("tenant_ids"))
+        if family not in ("linear", "kmeans"):
+            raise ValueError(f"unknown farm family {family!r}")
+        return cls(
+            family=family,
+            tenant_ids=tenant_ids,
+            arrays={k: np.asarray(v) for k, v in arrays.items()},
+            config=params,
+        )
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from ..io.model_io import save_model
+
+        name, meta, arrays = self._artifacts()
+        save_model(path, name, meta, arrays, overwrite=overwrite)
+
+
+# ==========================================================================
+# Estimators
+# ==========================================================================
+
+
+def _common_config(batch: TenantBatch, feature_names, profile_bins) -> dict:
+    names = (
+        tuple(feature_names)
+        if feature_names is not None
+        else tuple(f"f{j}" for j in range(batch.n_features))
+    )
+    if len(names) != batch.n_features:
+        raise ValueError(
+            f"{len(names)} feature names for {batch.n_features} features"
+        )
+    return {
+        "d": batch.n_features,
+        "pad_rows": batch.pad_rows,
+        "feature_names": list(names),
+        "profile_bins": int(profile_bins),
+    }
+
+
+def _record_fit(sp, batch: TenantBatch, family: str) -> None:
+    reg = global_registry()
+    reg.inc("farm.fit_tenants", float(batch.n_tenants))
+    reg.inc("farm.fit_rows", float(batch.n_rows.sum()))
+    reg.set("farm.tenants", float(batch.n_tenants))
+    if sp.trace_id is not None:
+        sp.note("family", family)
+        sp.note("tenants", batch.n_tenants)
+        sp.note("rows", int(batch.n_rows.sum()))
+
+
+@dataclass(frozen=True)
+class FarmLinearRegression:
+    """Per-hospital weighted least squares over the tenant axis.
+
+    ``pool`` is the partial-pooling strength in pseudo-rows of the
+    pooled global fit: 0 = fully independent per-tenant fits (the
+    looped-baseline semantics), larger values shrink small hospitals
+    toward the network-wide model (an empty hospital lands ON it).
+    ``reg_param`` is Spark-style ridge on unstandardized coefficients
+    (intercept unpenalized)."""
+
+    reg_param: float = 0.0
+    pool: float = 0.0
+    fit_intercept: bool = True
+    feature_names: Sequence[str] | None = None
+    profile_bins: int = 16
+
+    def fit(self, data: Mapping[str, Any] | TenantBatch) -> ModelFarmModel:
+        batch = data if isinstance(data, TenantBatch) else pack_tenants(data)
+        sp = _trace.span("farm.fit", {"family": "linear"})
+        with sp:
+            theta, theta_g = _farm_linear_fit(
+                jnp.asarray(batch.x), jnp.asarray(batch.y),
+                jnp.asarray(batch.w),
+                jnp.float32(self.reg_param), jnp.float32(self.pool),
+                self.fit_intercept,
+            )
+            theta = np.asarray(jax.device_get(theta))
+            theta_g = np.asarray(jax.device_get(theta_g))
+            d = batch.n_features
+            stacked = np.concatenate([theta, theta_g[None, :]], axis=0)
+            coef = stacked[:, :d].astype(np.float32)
+            intercept = (
+                stacked[:, d].astype(np.float32)
+                if self.fit_intercept
+                else np.zeros((stacked.shape[0],), np.float32)
+            )
+            cfg = _common_config(batch, self.feature_names, self.profile_bins)
+            cfg.update(
+                reg_param=float(self.reg_param), pool=float(self.pool),
+                fit_intercept=bool(self.fit_intercept),
+            )
+            arrays = {
+                "coefficients": coef,
+                "intercepts": intercept,
+                "tenant_rows": batch.n_rows.astype(np.int64),
+                "masked_rows": batch.masked_rows.astype(np.int64),
+            }
+            arrays.update(
+                build_profile_stack(
+                    batch.x, batch.w, cfg["feature_names"],
+                    bins=self.profile_bins,
+                )
+            )
+            _record_fit(sp, batch, "linear")
+        return ModelFarmModel(
+            family="linear", tenant_ids=batch.tenant_ids,
+            arrays=arrays, config=cfg,
+        )
+
+
+@dataclass(frozen=True)
+class FarmKMeans:
+    """Per-hospital k-means over the tenant axis: one masked while_loop
+    fits every hospital's Lloyd trajectory simultaneously; the GLOBAL
+    slot is a pooled-sample fit through the same kernel.
+
+    ``checkpoint_dir`` swaps the fused loop for a per-iteration host
+    loop with ``io/fit_checkpoint`` commits, so a preempted 10k-tenant
+    farm fit resumes from the last commit bit-identically (chaos-tested)
+    instead of restarting the fleet."""
+
+    k: int = 4
+    max_iter: int = 20
+    tol: float = 1e-4
+    seed: int = 0
+    global_sample: int = 8192
+    feature_names: Sequence[str] | None = None
+    profile_bins: int = 16
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 5
+
+    def fit(self, data: Mapping[str, Any] | TenantBatch) -> ModelFarmModel:
+        batch = data if isinstance(data, TenantBatch) else pack_tenants(data)
+        sp = _trace.span("farm.fit", {"family": "kmeans"})
+        with sp:
+            model = self._fit_inner(batch)
+            _record_fit(sp, batch, "kmeans")
+        return model
+
+    def _fit_inner(self, batch: TenantBatch) -> ModelFarmModel:
+        t_n, r_pad, d = batch.x.shape
+        tol_sq = float(self.tol) ** 2
+        centers0, c_valid = _init_farm_centers(
+            batch.x, batch.w, self.k, self.seed
+        )
+        x_dev = jnp.asarray(batch.x, jnp.float32)
+        w_dev = jnp.asarray(batch.w, jnp.float32)
+        cv_dev = jnp.asarray(c_valid)
+
+        ckpt = None
+        resumed = None
+        if self.checkpoint_dir:
+            from ..io.fit_checkpoint import FitCheckpointer, data_fingerprint
+
+            signature = {
+                "estimator": "FarmKMeans", "T": t_n, "R": r_pad,
+                "k": self.k, "d": d,
+                "data": data_fingerprint(
+                    batch.x.reshape(-1, d), batch.w.reshape(-1)
+                ),
+                "seed": self.seed, "tol": self.tol,
+            }
+            ckpt = FitCheckpointer(self.checkpoint_dir, signature)
+            resumed = ckpt.resume()
+
+        if ckpt is None:
+            loop = _make_farm_kmeans_loop(self.max_iter, tol_sq)
+            cen, counts, cost, n_iter = loop(
+                x_dev, w_dev, jnp.asarray(centers0), cv_dev
+            )
+        else:
+            # host loop: iteration-boundary commits, exact resume
+            step = _make_farm_kmeans_step(tol_sq)
+            start_it = 1
+            if resumed is not None:
+                step0, arrs, _ = resumed
+                cen = jnp.asarray(arrs["centers"], jnp.float32)
+                done = jnp.asarray(arrs["done"].astype(bool))
+                n_iter = jnp.asarray(arrs["n_iter"].astype(np.int32))
+                start_it = step0 + 1
+            else:
+                cen = jnp.asarray(centers0)
+                done = jnp.zeros((t_n,), bool)
+                n_iter = jnp.zeros((t_n,), jnp.int32)
+            for it in range(start_it, self.max_iter + 1):
+                cen, done, n_iter = step(
+                    x_dev, w_dev, cen, cv_dev, done, n_iter
+                )
+                if it % max(self.checkpoint_every, 1) == 0:
+                    ckpt.save(it, {
+                        "centers": np.asarray(jax.device_get(cen)),
+                        "done": np.asarray(jax.device_get(done)).astype(np.uint8),
+                        "n_iter": np.asarray(jax.device_get(n_iter)),
+                    })
+                if bool(jax.device_get(jnp.all(done))):
+                    break
+            counts, cost = _farm_kmeans_final(x_dev, w_dev, cen, cv_dev)
+
+        cen = np.asarray(jax.device_get(cen))
+        counts = np.asarray(jax.device_get(counts))
+        cost = np.asarray(jax.device_get(cost))
+        n_iter = np.asarray(jax.device_get(n_iter))
+
+        # global slot: pooled-sample fit through the SAME kernel (T=1)
+        g_cen, g_valid, g_counts, g_cost, g_iter = self._fit_global(batch)
+        cfg = _common_config(batch, self.feature_names, self.profile_bins)
+        cfg.update(
+            k=int(self.k), max_iter=int(self.max_iter), tol=float(self.tol),
+            seed=int(self.seed),
+        )
+        arrays = {
+            "centers": np.concatenate([cen, g_cen[None]], axis=0),
+            "center_valid": np.concatenate([c_valid, g_valid[None]], axis=0),
+            "sizes": np.concatenate([counts, g_counts[None]], axis=0),
+            "costs": np.concatenate(
+                [cost, np.float32(g_cost)[None]], axis=0
+            ).astype(np.float32),
+            "n_iter": np.concatenate(
+                [n_iter, np.int32(g_iter)[None]], axis=0
+            ).astype(np.int32),
+            "tenant_rows": batch.n_rows.astype(np.int64),
+            "masked_rows": batch.masked_rows.astype(np.int64),
+        }
+        arrays.update(
+            build_profile_stack(
+                batch.x, batch.w, cfg["feature_names"], bins=self.profile_bins
+            )
+        )
+        return ModelFarmModel(
+            family="kmeans", tenant_ids=batch.tenant_ids,
+            arrays=arrays, config=cfg,
+        )
+
+    def _fit_global(self, batch: TenantBatch):
+        """Pooled-sample k-means for the GLOBAL slot (unknown-tenant
+        fallback): a bounded uniform sample of valid rows across every
+        tenant, fit through the same vmapped kernel at T=1."""
+        valid = batch.w.reshape(-1) > 0
+        pool_rows = batch.x.reshape(-1, batch.n_features)[valid]
+        if pool_rows.shape[0] == 0:
+            k = self.k
+            return (
+                np.zeros((k, batch.n_features), np.float32),
+                np.zeros((k,), np.float32),
+                np.zeros((k,), np.float32),
+                0.0, 0,
+            )
+        rng = np.random.default_rng([self.seed, batch.n_tenants])
+        if pool_rows.shape[0] > self.global_sample:
+            pick = rng.choice(
+                pool_rows.shape[0], size=self.global_sample, replace=False
+            )
+            pool_rows = pool_rows[np.sort(pick)]
+        r_g = _next_pow2(pool_rows.shape[0])
+        xg = np.zeros((1, r_g, batch.n_features), np.float32)
+        xg[0, : pool_rows.shape[0]] = pool_rows
+        wg = slot_mask(pool_rows.shape[0], r_g)[None, :]
+        c0, cv = _init_farm_centers(
+            xg, wg, self.k, self.seed, base_index=batch.n_tenants
+        )
+        loop = _make_farm_kmeans_loop(self.max_iter, float(self.tol) ** 2)
+        cen, counts, cost, n_iter = loop(
+            jnp.asarray(xg), jnp.asarray(wg), jnp.asarray(c0), jnp.asarray(cv)
+        )
+        return (
+            np.asarray(jax.device_get(cen))[0],
+            cv[0],
+            np.asarray(jax.device_get(counts))[0],
+            float(np.asarray(jax.device_get(cost))[0]),
+            int(np.asarray(jax.device_get(n_iter))[0]),
+        )

@@ -5,6 +5,7 @@ promotion, chaos-hardened lifecycle controller (ROADMAP item 5).
 * :mod:`feedback`  — served predictions + outcomes re-enter ingest
 * :mod:`promotion` — shadow scorer, parity gate, canary router
 * :mod:`controller`— the SERVING → … → PROMOTED | ROLLED_BACK machine
+* :mod:`farm`      — drifted-subset retraining for model farms
 
 See docs/ARCHITECTURE.md §Continuous learning for the state diagram and
 the per-transition durability invariants.
@@ -23,6 +24,7 @@ from .controller import (
     STATES,
     kmeans_cost,
 )
+from .farm import retrain_drifted
 from .feedback import FeedbackBuffer, OUTCOME_COL, PREDICTION_COL, feedback_schema
 from .journal import LifecycleJournal
 from .promotion import CanaryRouter, GateDecision, ParityGate, ShadowScorer
@@ -37,6 +39,7 @@ __all__ = [
     "OUTCOME_COL",
     "PREDICTION_COL",
     "ParityGate",
+    "retrain_drifted",
     "STATES",
     "STATE_CANARY",
     "STATE_DRIFT_SUSPECTED",

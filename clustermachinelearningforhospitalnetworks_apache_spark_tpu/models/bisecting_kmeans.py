@@ -53,7 +53,8 @@ from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, default_mesh
 from ..parallel.sharding import DeviceDataset
 from .base import Estimator, as_device_dataset
-from .kmeans import KMeansModel, _chunked
+from ..parallel.sharding import chunk_layout, chunked_pad
+from .kmeans import KMeansModel
 
 # np scalar, not jnp: a module-level jnp constant would initialize
 # the backend at import time (hangs when the TPU tunnel is down)
@@ -79,7 +80,7 @@ def _make_fit_loop(
     scatters (failed splits) need no dynamic shapes.  Returns (centers,
     sizes, sse, n_splits) — one host transfer per fit.
     """
-    n_chunks, chunk = _chunked(n_loc, chunk_rows)
+    n_chunks, chunk = chunk_layout(n_loc, chunk_rows)
     pad_to = n_chunks * chunk
     K2 = 2 * L
     child_iota = jnp.arange(K2, dtype=jnp.int32)
@@ -136,10 +137,7 @@ def _make_fit_loop(
         return lax.psum(counts, DATA_AXIS), lax.psum(sse, DATA_AXIS), bits
 
     def shard_fn(x, w, key, min_div, is_frac):
-        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
-        wp = jnp.pad(w, (0, pad_to - n_loc))
-        x_c = xp.reshape(n_chunks, chunk, d)
-        w_c = wp.reshape(n_chunks, chunk)
+        x_c, w_c = chunked_pad(x, w, n_chunks, chunk)
 
         # ---- root leaf: weighted mean, then a per-row SSE pass ----------
         def mean_body(carry, inputs):
@@ -217,7 +215,7 @@ def _make_fit_loop(
             cen0 = c01.reshape(K2, d)
 
             pos = slot_of[jnp.clip(jnp.pad(assign, (0, pad_to - n_loc)), 0, k)]
-            pos = jnp.where(wp > 0, pos, -1)
+            pos = jnp.where(w_c.reshape(pad_to) > 0, pos, -1)
             pos_c = pos.reshape(n_chunks, chunk)
 
             # -- constrained 2-means Lloyd loop over ALL splitting leaves

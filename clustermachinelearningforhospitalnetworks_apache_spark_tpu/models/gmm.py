@@ -39,7 +39,8 @@ from ..parallel.mesh import DATA_AXIS, default_mesh
 from ..parallel.outofcore import add_stats as _gmm_add_stats
 from ..parallel.sharding import DeviceDataset
 from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
-from .kmeans import _chunked, _kmeans_pp_init, _lloyd_refine
+from ..parallel.sharding import chunk_layout, chunked_pad
+from .kmeans import _kmeans_pp_init, _lloyd_refine
 
 
 def _chol_log_pdf(x, mean, chol):
@@ -192,15 +193,11 @@ def _make_em_loop(
     Convergence: |ll_t − ll_{t−1}| < tol, Spark semantics on the TOTAL
     log-likelihood.
     """
-    n_chunks, chunk = _chunked(n_loc, chunk_rows)
-    pad_to = n_chunks * chunk
+    n_chunks, chunk = chunk_layout(n_loc, chunk_rows)
     em_pass = _em_pass_builder(k, d, precision)
 
     def shard_fn(x, w, shift, means, covs, weights, reg_covar, tol):
-        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
-        wp = jnp.pad(w, (0, pad_to - n_loc))
-        x_c = xp.reshape(n_chunks, chunk, d)
-        w_c = wp.reshape(n_chunks, chunk)
+        x_c, w_c = chunked_pad(x, w, n_chunks, chunk)
         eye = jnp.eye(d, dtype=jnp.float32)
 
         def cond(carry):
@@ -276,17 +273,12 @@ def _make_em_stats_step(
     """Per-BLOCK E-step sufficient statistics (nk, Σr·x, Σr·xxᵀ, ll) —
     the out-of-core driver accumulates these across host row blocks, then
     applies one :func:`_gmm_m_step` per EM iteration."""
-    n_chunks, chunk = _chunked(n_loc, chunk_rows)
-    pad_to = n_chunks * chunk
+    n_chunks, chunk = chunk_layout(n_loc, chunk_rows)
     em_pass = _em_pass_builder(k, d, precision)
 
     def shard_fn(x, w, shift, logw, means, chols):
-        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
-        wp = jnp.pad(w, (0, pad_to - n_loc))
-        return em_pass(
-            xp.reshape(n_chunks, chunk, d), wp.reshape(n_chunks, chunk),
-            shift, logw, means, chols,
-        )
+        x_c, w_c = chunked_pad(x, w, n_chunks, chunk)
+        return em_pass(x_c, w_c, shift, logw, means, chols)
 
     return jax.jit(
         jax.shard_map(

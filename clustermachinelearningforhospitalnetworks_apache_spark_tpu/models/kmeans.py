@@ -41,7 +41,14 @@ from ..io.model_io import register_model
 from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 from ..parallel.outofcore import add_stats as _add_stats
-from ..parallel.sharding import DeviceDataset
+from ..parallel.sharding import (
+    DeviceDataset,
+    chunk_layout,
+    chunked_pad,
+    pad_slots,
+    padded_slots,
+    slot_mask,
+)
 from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
 
 # np scalar, not jnp: a module-level jnp constant would initialize
@@ -79,11 +86,9 @@ def _finalize_lloyd(sums, counts, cost, centers, c_valid, cosine: bool):
     return new_centers, counts, cost, move
 
 
-def _chunked(n_loc: int, target: int) -> tuple[int, int]:
-    """(n_chunks, chunk) covering n_loc with static shapes."""
-    chunk = min(max(target, 1), n_loc) if n_loc > 0 else 1
-    n_chunks = -(-n_loc // chunk) if n_loc > 0 else 1
-    return n_chunks, chunk
+# shared scan-chunk geometry (parallel/sharding.py); the old private name
+# stays importable for the sibling families that grew up on it
+_chunked = chunk_layout
 
 
 def _lloyd_shard_stats(
@@ -109,18 +114,14 @@ def _lloyd_shard_stats(
     ``_make_train_loop``)."""
     if fuse_stats and precision != "bf16":
         raise ValueError("fuse_stats requires matmul_precision='bf16'")
-    n_chunks, chunk = _chunked(n_loc, chunk_rows)
-    pad_to = n_chunks * chunk
+    n_chunks, chunk = chunk_layout(n_loc, chunk_rows)
     k_loc = k_pad // m
 
     def stats(x, w, centers, c_valid):
         # x: (n_loc, d) data-shard; centers: (k_loc, d) model-shard;
         # c_valid: (k_loc,) 1.0 for real centroids, 0.0 for k-padding.
         my_m = lax.axis_index(MODEL_AXIS)
-        xp = jnp.pad(x, ((0, pad_to - n_loc), (0, 0)))
-        wp = jnp.pad(w, (0, pad_to - n_loc))
-        xc = xp.reshape(n_chunks, chunk, d)
-        wc = wp.reshape(n_chunks, chunk)
+        xc, wc = chunked_pad(x, w, n_chunks, chunk)
         c_sq = sq_norms(centers)
         cen_bf = centers.astype(jnp.bfloat16) if fuse_stats else None
 
@@ -627,7 +628,7 @@ class KMeans(Estimator):
         cosine = self.distance_measure == "cosine"
         d = hd.n_features
         m = mesh.shape[MODEL_AXIS]
-        k_pad = -(-self.k // m) * m
+        k_pad = padded_slots(self.k, m)
 
         ckpt = None
         resumed = None
@@ -662,10 +663,8 @@ class KMeans(Estimator):
                 centers0 = self._init_from_sample(
                     hd.sample_rows(self.init_sample_size, self.seed)
                 )
-            cen = np.zeros((k_pad, d), dtype=np.float32)
-            cen[: self.k] = centers0
-        c_valid = np.zeros((k_pad,), dtype=np.float32)
-        c_valid[: self.k] = 1.0
+            cen = pad_slots(centers0, k_pad)
+        c_valid = slot_mask(self.k, k_pad)
         centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
         c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
 
@@ -757,7 +756,7 @@ class KMeans(Estimator):
             # unit vectors (they enter via the weighted stats instead)
 
         m = mesh.shape[MODEL_AXIS]
-        k_pad = -(-self.k // m) * m
+        k_pad = padded_slots(self.k, m)
         d = x.shape[1]
 
         ckpt = None
@@ -793,10 +792,8 @@ class KMeans(Estimator):
                 centers0 = self._init_centers(
                     DeviceDataset(x, ds.y, ds.w), mesh
                 )
-            cen = np.zeros((k_pad, d), dtype=np.float32)
-            cen[: self.k] = centers0
-        c_valid = np.zeros((k_pad,), dtype=np.float32)
-        c_valid[: self.k] = 1.0
+            cen = pad_slots(centers0, k_pad)
+        c_valid = slot_mask(self.k, k_pad)
         centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
         c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
 

@@ -327,7 +327,7 @@ class StreamingKMeans:
         if not batches:
             return self
         from ..parallel.mesh import DATA_AXIS
-        from ..parallel.sharding import pad_rows
+        from ..parallel.sharding import pad_rows, stack_ragged
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = microbatch_mesh(
@@ -342,18 +342,11 @@ class StreamingKMeans:
             if not batches:
                 return self
         n_pad = pad_rows(max(b.shape[0] for b, _ in batches), mesh.shape[DATA_AXIS])
-        d = batches[0][0].shape[1]
-        B = len(batches)
-        # np.empty + explicit pad-tail zeroing: the stack is rebuilt every
-        # drain and for mostly-equal-length batches the tail is tiny, so
-        # this skips zeroing the whole (B, n_pad, d) block
-        xs = np.empty((B, n_pad, d), dtype=np.float32)
-        ws = np.zeros((B, n_pad), dtype=np.float32)
-        for i, (b, bw) in enumerate(batches):
-            m = b.shape[0]
-            xs[i, :m] = b
-            xs[i, m:] = 0.0
-            ws[i, :m] = bw
+        # ragged batches -> one padded stack + weight mask (the shared
+        # pad-and-weight contract; np.empty + tail-zero idiom lives there)
+        xs, ws = stack_ragged(
+            [b for b, _ in batches], [bw for _, bw in batches], pad_to=n_pad
+        )
         xs = jax.device_put(xs, NamedSharding(mesh, P(None, DATA_AXIS, None)))
         ws = jax.device_put(ws, NamedSharding(mesh, P(None, DATA_AXIS)))
         self._place_state_mesh(mesh)
@@ -363,7 +356,7 @@ class StreamingKMeans:
             xs, ws, self._centers, self._weights, self._weights_lo,
             np.int32(self._steps),
         )
-        self._steps += B
+        self._steps += len(batches)
         return self
 
     def _place_state(self, ds: DeviceDataset) -> None:

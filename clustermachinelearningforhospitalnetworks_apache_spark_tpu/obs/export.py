@@ -28,30 +28,26 @@ import re
 import time
 from typing import Any
 
-from .registry import MetricsRegistry, global_registry, is_finite_number
+from .registry import (
+    MetricsRegistry,
+    global_registry,
+    is_finite_number,
+    split_labels,  # noqa: F401 — the label parser lives with the label
+    # writers in registry.py (cohort_label / cap_label_cardinality need
+    # it too); re-exported here because exporters are its public home.
+    # Both exporters read collect(), which has already applied the
+    # label-cardinality backstop — a runaway tenant-labeled family
+    # reaches the scrape page cohort-bucketed, never 10k series wide.
+)
 
 PREFIX = "cmlhn"
 
 _BAD = re.compile(r"[^a-zA-Z0-9_]")
-_LABELED = re.compile(r"^(?P<name>[^{]+)\{(?P<labels>.*)\}$")
-_LABEL = re.compile(r'(?P<k>[a-zA-Z0-9_.]+)="(?P<v>[^"]*)"')
 
 
 def prom_name(name: str) -> str:
     """Internal dotted name → Prometheus metric name."""
     return f"{PREFIX}_{_BAD.sub('_', name.strip())}"
-
-
-def split_labels(name: str) -> tuple[str, dict[str, str]]:
-    """``'x.y{model="los",state="open"}'`` → ``("x.y", {...})``."""
-    m = _LABELED.match(name)
-    if m is None:
-        return name, {}
-    labels = {
-        lm.group("k"): lm.group("v")
-        for lm in _LABEL.finditer(m.group("labels"))
-    }
-    return m.group("name"), labels
 
 
 def label_str(labels: dict[str, str], extra: str = "") -> str:

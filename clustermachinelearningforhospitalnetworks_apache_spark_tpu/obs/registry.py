@@ -31,9 +31,12 @@ isolated for tests and fold upward through collectors.
 from __future__ import annotations
 
 import math
+import os
+import re
 import threading
 import time
 import weakref
+import zlib
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Sequence
@@ -302,7 +305,10 @@ class MetricsRegistry:
             with self._collector_lock:
                 for key in dead:
                     self._collectors.pop(key, None)
-        return out
+        # export-side cardinality backstop: a family that grew past the
+        # series budget (per-tenant labels that skipped cohort_label)
+        # leaves collect() cohort-bucketed, never 10k series wide
+        return cap_label_cardinality(out)
 
     def merge_registry(self, other: "MetricsRegistry") -> None:
         """Fold another registry's state in (counters add, gauges take
@@ -321,6 +327,135 @@ _GLOBAL = MetricsRegistry()
 def global_registry() -> MetricsRegistry:
     """The process-wide registry every exporter reads."""
     return _GLOBAL
+
+
+# --------------------------------------------------------------------------
+# Label-cardinality guard.
+#
+# A per-TENANT label on a farm metric is a 10,000-series Prometheus export
+# waiting to happen (every scrape carries every series ever written).  Two
+# defenses, both here so every writer and every exporter share them:
+#
+# * :func:`cohort_label` is the WRITE-side discipline — the farm labels
+#   its metrics by a bounded tenant *cohort* (stable hash of the tenant id
+#   into :data:`N_COHORTS` buckets), never by raw tenant id;
+# * :func:`cap_label_cardinality` is the EXPORT-side backstop applied by
+#   :meth:`MetricsRegistry.collect` — any labeled family that still grows
+#   past :data:`MAX_SERIES_PER_FAMILY` distinct label combinations gets
+#   its label VALUES cohort-bucketed at collect time (counters sum into
+#   the bucket, gauges keep the max — the conservative alarm view — and
+#   same-edge histograms merge), with an ``obs.cardinality_capped{metric=}``
+#   counter recording that the cap fired.
+# --------------------------------------------------------------------------
+
+#: distinct label-combination budget per metric family at export; override
+#: with the CMLHN_OBS_MAX_SERIES env var
+MAX_SERIES_PER_FAMILY = int(os.environ.get("CMLHN_OBS_MAX_SERIES", "256"))
+
+#: cohort bucket count for high-cardinality label values (tenant ids)
+N_COHORTS = 32
+
+_LABELED_RE = re.compile(r"^(?P<name>[^{]+)\{(?P<labels>.*)\}$")
+_LABEL_RE = re.compile(r'(?P<k>[a-zA-Z0-9_.]+)="(?P<v>[^"]*)"')
+
+
+def split_labels(name: str) -> tuple[str, dict[str, str]]:
+    """``'x.y{model="los",state="open"}'`` → ``("x.y", {...})`` — the one
+    parser for the brace-label convention (exporters re-use it)."""
+    m = _LABELED_RE.match(name)
+    if m is None:
+        return name, {}
+    labels = {
+        lm.group("k"): lm.group("v")
+        for lm in _LABEL_RE.finditer(m.group("labels"))
+    }
+    return m.group("name"), labels
+
+
+def join_labels(base: str, labels: dict[str, str]) -> str:
+    if not labels:
+        return base
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{base}{{{inner}}}"
+
+
+def cohort_label(value: str, n_cohorts: int = N_COHORTS) -> str:
+    """Stable bounded bucket for a high-cardinality label value: the
+    tenant-cohort name the farm labels its metrics with (``"c07"``)."""
+    return f"c{zlib.crc32(str(value).encode()) % n_cohorts:02d}"
+
+
+def _merge_hist_dicts(a: dict, b: dict) -> dict:
+    """Bin-addition merge of two ``FixedHistogram.to_dict`` fragments when
+    the edges agree; otherwise keep ``b`` (last wins, as collect does for
+    same-name fragments)."""
+    if a.get("edges") == b.get("edges"):
+        return {
+            "edges": a["edges"],
+            "counts": [x + y for x, y in zip(a["counts"], b["counts"])],
+            "count": a.get("count", 0.0) + b.get("count", 0.0),
+            "sum": a.get("sum", 0.0) + b.get("sum", 0.0),
+        }
+    return b
+
+
+def cap_label_cardinality(
+    snap: dict[str, Any], max_series: int | None = None
+) -> dict[str, Any]:
+    """Enforce the per-family series budget on a collected snapshot
+    (in place; returns it).  Families within budget pass through
+    untouched — per-model breaker gauges etc. keep their exact labels."""
+    budget = MAX_SERIES_PER_FAMILY if max_series is None else max_series
+    if budget <= 0:
+        return snap
+    for kind in ("counters", "gauges", "histograms"):
+        table = snap.get(kind)
+        if not table:
+            continue
+        fams: dict[str, list[str]] = {}
+        for raw in table:
+            base, labels = split_labels(raw)
+            if labels:
+                fams.setdefault(base, []).append(raw)
+        for base, raws in fams.items():
+            if len(raws) <= budget:
+                continue
+            # bucket ONLY the label keys whose distinct-value count blew
+            # the budget — a low-cardinality companion label (model=,
+            # state=) keeps attributing series exactly
+            values_by_key: dict[str, set] = {}
+            for raw in raws:
+                for k, v in split_labels(raw)[1].items():
+                    values_by_key.setdefault(k, set()).add(v)
+            hot_keys = {
+                k for k, vals in values_by_key.items() if len(vals) > budget
+            } or set(values_by_key)  # combinatorial blowup with no single
+            # hot key: bucket everything rather than export 10k series
+            capped: dict[str, Any] = {}
+            for raw in raws:
+                _, labels = split_labels(raw)
+                new_raw = join_labels(
+                    base,
+                    {
+                        k: cohort_label(v) if k in hot_keys else v
+                        for k, v in labels.items()
+                    },
+                )
+                old = capped.get(new_raw)
+                v = table.pop(raw)
+                if old is None:
+                    capped[new_raw] = v
+                elif kind == "counters":
+                    capped[new_raw] = old + v
+                elif kind == "gauges":
+                    capped[new_raw] = max(old, v)
+                else:
+                    capped[new_raw] = _merge_hist_dicts(old, v)
+            table.update(capped)
+            c = snap.setdefault("counters", {})
+            key = f'obs.cardinality_capped{{metric="{base}"}}'
+            c[key] = c.get(key, 0.0) + float(len(raws))
+    return snap
 
 
 def is_finite_number(v: Any) -> bool:

@@ -58,6 +58,9 @@ REGISTERED_SPANS = (
     "lifecycle.promote",
     "lifecycle.rollback",
     "lifecycle.feedback",
+    "farm.fit",          # model-farm fleet fit (one dispatch, T tenants)
+    "farm.refit",        # drifted-subset masked refit
+    "farm.predict",      # tenant-routed predict (host convenience path)
     "obs.demo",          # example/bench root spans
 )
 

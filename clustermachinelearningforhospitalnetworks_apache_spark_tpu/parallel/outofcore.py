@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from .mesh import DATA_AXIS, default_mesh
-from .sharding import DeviceDataset, device_dataset, pad_rows
+from .sharding import DeviceDataset, device_dataset, pad_block_host, pad_rows
 
 # Pytree accumulator for per-block sufficient statistics — shared by every
 # out-of-core estimator driver (KMeans / LinearRegression / GMM).
@@ -202,17 +202,14 @@ class HostDataset:
             s = i * b
             e = min(s + b, self.n)
             m = e - s
-            xb = np.zeros((b, self.n_features), dtype=dtype)
-            xb[:m] = self.x[s:e]
-            wb = np.zeros((b,), dtype=dtype)
-            if self.w is not None:
-                wb[:m] = self.w[s:e]
-            else:
-                wb[:m] = 1.0
-            yb = None
-            if self.y is not None:
-                yb = np.zeros((b,), dtype=dtype)
-                yb[:m] = self.y[s:e]
+            xb = pad_block_host(self.x[s:e], b, dtype)
+            wb = pad_block_host(
+                self.w[s:e] if self.w is not None else np.ones(m, dtype), b, dtype
+            )
+            yb = (
+                pad_block_host(self.y[s:e], b, dtype)
+                if self.y is not None else None
+            )
             return device_dataset(xb, yb, mesh=mesh, weights=wb)
 
         nxt = make(seq[0])

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 from functools import lru_cache
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -134,6 +135,114 @@ def pad_rows(n: int, multiple: int) -> int:
     if n == 0:
         return multiple
     return ((n + multiple - 1) // multiple) * multiple
+
+
+# --------------------------------------------------------------------------
+# The pad-and-weight contract, shared pieces.
+#
+# Every estimator here meets ragged data the same way: pad to a static
+# shape, carry a 0/1 (or fractional) weight/validity mask, and make every
+# reduction mask-weighted so the padding is inert.  These helpers are the
+# ONE copy of the recurring mechanical steps — previously re-implemented
+# in kmeans/gmm/bisecting (chunk-scan padding), the out-of-core block
+# builder, streaming k-means' drain stacking, and now the model farm's
+# tenant packing.
+# --------------------------------------------------------------------------
+
+
+def chunk_layout(n_loc: int, target: int) -> tuple[int, int]:
+    """(n_chunks, chunk) covering ``n_loc`` rows with static shapes — the
+    scan-chunk geometry of every chunk-scanned estimator step."""
+    chunk = min(max(target, 1), n_loc) if n_loc > 0 else 1
+    n_chunks = -(-n_loc // chunk) if n_loc > 0 else 1
+    return n_chunks, chunk
+
+
+def chunked_pad(x, w, n_chunks: int, chunk: int):
+    """Pad shard-local ``(n_loc, d)`` rows + weights to ``n_chunks*chunk``
+    and reshape into scan chunks ``(n_chunks, chunk, d)`` / ``(n_chunks,
+    chunk)``.  Pad rows get weight 0, so they are inert under the
+    weighted-reduction contract.  Traceable (jnp)."""
+    n_loc = x.shape[0]
+    pad_to = n_chunks * chunk
+    xc = jnp.pad(x, ((0, pad_to - n_loc), (0, 0))).reshape(
+        n_chunks, chunk, x.shape[1]
+    )
+    wc = jnp.pad(w, (0, pad_to - n_loc)).reshape(n_chunks, chunk)
+    return xc, wc
+
+
+def padded_slots(count: int, multiple: int) -> int:
+    """Smallest slot-axis length >= count divisible by ``multiple`` — the
+    model-axis analogue of :func:`pad_rows` (centroids padded so the
+    model axis divides evenly)."""
+    return -(-count // multiple) * multiple
+
+
+def slot_mask(n_valid: int, n_total: int, dtype=np.float32) -> np.ndarray:
+    """0/1 validity mask over a padded slot axis: ``[:n_valid] = 1``."""
+    m = np.zeros((n_total,), dtype=dtype)
+    m[:n_valid] = 1.0
+    return m
+
+
+def pad_slots(arr: np.ndarray, n_total: int, dtype=np.float32) -> np.ndarray:
+    """Zero-extend ``arr`` along axis 0 to ``n_total`` slots (host-side)."""
+    arr = np.asarray(arr, dtype=dtype)
+    out = np.zeros((n_total,) + arr.shape[1:], dtype=dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def pad_block_host(arr: np.ndarray, rows: int, dtype=np.float32) -> np.ndarray:
+    """Host-side row padding to a static block shape: ``arr`` zero-extended
+    along axis 0 to ``rows`` — the out-of-core block builder's one idiom
+    (zeros past the data are inert under the weight contract)."""
+    arr = np.asarray(arr)
+    out = np.zeros((rows,) + arr.shape[1:], dtype=dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def stack_ragged(
+    mats: Sequence[np.ndarray],
+    weights: Sequence[np.ndarray] | None = None,
+    pad_to: int | None = None,
+    dtype=np.float32,
+):
+    """Ragged row blocks → one padded stack + weight mask.
+
+    ``mats`` is B arrays of shape (n_b, d); the result is ``(xs, ws)``
+    with ``xs`` of shape (B, R, d) and ``ws`` of shape (B, R), where
+    ``R = pad_to or max(n_b)``.  Rows past each block's length get
+    weight 0 — the pad-and-weight contract along a leading batch/tenant
+    axis.  ``weights`` (optional per-block row weights) fold into the
+    mask; otherwise valid rows get weight 1.
+
+    np.empty + explicit tail zeroing (not a full np.zeros) because for
+    mostly-equal-length blocks the pad tail is tiny and the stack is
+    rebuilt per call (streaming k-means' drain measured this)."""
+    B = len(mats)
+    if B == 0:
+        raise ValueError("stack_ragged needs at least one block")
+    d = mats[0].shape[1] if mats[0].ndim == 2 else 1
+    R = pad_to if pad_to is not None else max(int(m.shape[0]) for m in mats)
+    R = max(R, 1)
+    xs = np.empty((B, R, d), dtype=dtype)
+    ws = np.zeros((B, R), dtype=dtype)
+    for i, m in enumerate(mats):
+        n = int(m.shape[0])
+        if n > R:
+            raise ValueError(
+                f"block {i} has {n} rows > padded length {R}"
+            )
+        xs[i, :n] = m.reshape(n, d)
+        xs[i, n:] = 0.0
+        if weights is not None:
+            ws[i, :n] = np.asarray(weights[i], dtype=dtype).reshape(-1)[:n]
+        else:
+            ws[i, :n] = 1.0
+    return xs, ws
 
 
 def shard_rows(x: np.ndarray, mesh: Mesh | None = None) -> jax.Array:

@@ -444,6 +444,32 @@ class InferenceServer:
                                           wait_timeout_s)
         return result
 
+    def predict_tenant(
+        self, name: str, tenant_id: str, x: np.ndarray,
+        deadline_s: float | None = None, wait_timeout_s: float | None = 30.0,
+    ) -> ServeResult:
+        """Route a per-hospital request to its tenant's slice of a model
+        farm: tenant id → farm index (the farm's own table; unknown
+        tenants fall back to the pooled GLOBAL slot), carried in-band as
+        the request's leading column so the standard bucket ladder +
+        on-device gather answer it — zero steady-state recompiles across
+        tenants and batch sizes, one executable set for the whole fleet.
+        """
+        sm = self.registry.get(name)
+        route = getattr(sm.model, "route_request", None)
+        if route is None:
+            raise TypeError(
+                f"model {name!r} ({type(sm.model).__name__}) is not "
+                "tenant-routable; serve a ModelFarmModel under this name "
+                "or use predict()"
+            )
+        xt = route(
+            tenant_id, np.atleast_2d(np.asarray(x, dtype=np.float64))
+        )
+        return self.predict(
+            name, xt, deadline_s=deadline_s, wait_timeout_s=wait_timeout_s
+        )
+
     def _predict_traced(
         self, sp, name: str, x: np.ndarray, deadline_s: float | None,
         wait_timeout_s: float | None,

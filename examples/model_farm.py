@@ -1,0 +1,184 @@
+"""The model farm end to end: 4,096 hospitals, one compiled dispatch.
+
+The paper's domain is a hospital *network* — thousands of small
+per-hospital problems.  This example runs the whole farm story:
+
+1. **Fit**: 4,096 ragged per-hospital length-of-stay regressions
+   (4–48 rows each, a few sending NaNs) fit as ONE vmapped program,
+   with partial pooling shrinking tiny hospitals toward the pooled
+   network model — and a timed looped-baseline comparison.
+2. **Save**: the whole fleet persists as ONE `io/model_io` artifact —
+   one manifest, stacked parameter arrays, per-tenant feature sketches.
+3. **Serve**: an `InferenceServer` routes per-hospital requests by
+   tenant id (in-band farm index + on-device gather) through the
+   standard shape-bucket ladder — zero steady-state recompiles, and
+   unknown hospitals answer with the pooled GLOBAL slice.
+4. **Drift → masked retrain**: one hospital's feed shifts scale; its
+   per-tenant PSI (scored against the artifact's own sketches) crosses
+   the bar, `lifecycle.retrain_drifted` refits ONLY that hospital
+   against the frozen global prior, saves the successor artifact, and
+   hot-swaps it — every other hospital's parameters byte-identical.
+
+    PYTHONPATH=. python examples/model_farm.py
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm import (  # noqa: E402
+    FarmLinearRegression,
+    pack_tenants,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm.farm import (  # noqa: E402
+    _single_linear_fit,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.model_io import (  # noqa: E402
+    load_model,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.lifecycle import (  # noqa: E402
+    retrain_drifted,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (  # noqa: E402
+    InferenceServer,
+)
+
+N_HOSPITALS = int(os.environ.get("FARM_HOSPITALS", 4096))
+D = 8
+FEATURES = [
+    "admission_count", "current_occupancy", "emergency_visits",
+    "seasonality_index", "staff_on_shift", "icu_load", "transfer_rate",
+    "weekend_flag",
+]
+
+
+def make_fleet(rng: np.random.Generator) -> dict:
+    theta0 = rng.normal(size=D)
+    fleet = {}
+    for t in range(N_HOSPITALS):
+        n = int(rng.integers(4, 48))
+        x = rng.normal(size=(n, D))
+        y = x @ (theta0 + 0.2 * rng.normal(size=D)) + 3.0
+        if t % 911 == 0:  # a few hospitals send broken rows
+            x[: max(1, n // 8)] = np.nan
+        fleet[f"H{t:05d}"] = (x, y)
+    return fleet
+
+
+def main() -> None:
+    rng = np.random.default_rng(7)
+    fleet = make_fleet(rng)
+    batch = pack_tenants(fleet)
+    print(
+        f"§1 fleet: {batch.n_tenants} hospitals, "
+        f"{int(batch.n_rows.sum())} rows, padded to R={batch.pad_rows}, "
+        f"{int(batch.masked_rows.sum())} NaN rows masked (quality stance)"
+    )
+
+    est = FarmLinearRegression(reg_param=0.1, pool=8.0, feature_names=FEATURES)
+    t0 = time.perf_counter()
+    farm = est.fit(batch)
+    farm_s = time.perf_counter() - t0
+    print(
+        f"   farm fit: ONE dispatch, {farm_s:.3f}s cold — incl. XLA "
+        f"compile + per-tenant sketches; bench.py model_farm times the "
+        f"warm kernel ({batch.n_tenants / farm_s:,.0f} tenants/s even so)"
+    )
+
+    # looped baseline on a sample, same kernel, one dispatch per hospital
+    sample = min(256, batch.n_tenants)
+    zeros = jnp.zeros((D + 1,), jnp.float32)
+    t0 = time.perf_counter()
+    for i in range(sample):
+        _single_linear_fit(
+            jnp.asarray(batch.x[i]), jnp.asarray(batch.y[i]),
+            jnp.asarray(batch.w[i]),
+            jnp.float32(0.1), jnp.float32(8.0), zeros, True,
+        )
+    loop_s = (time.perf_counter() - t0) / sample * batch.n_tenants
+    print(
+        f"   looped baseline (projected from {sample} tenants): "
+        f"{loop_s:.1f}s → farm is ~{loop_s / farm_s:.0f}x"
+    )
+
+    tiny = min(fleet, key=lambda t: len(fleet[t][1]))
+    print(
+        f"   pooling: {tiny} has {len(fleet[tiny][1])} rows; its "
+        "coefficients sit "
+        f"{np.linalg.norm(farm.arrays['coefficients'][farm.tenant_index(tiny)] - farm.arrays['coefficients'][farm.global_index]):.3f} "
+        "from the pooled global model"
+    )
+
+    with tempfile.TemporaryDirectory() as work:
+        path = os.path.join(work, "farm_v1")
+        farm.save(path)
+        size_mb = sum(
+            os.path.getsize(os.path.join(path, f)) for f in os.listdir(path)
+        ) / 1e6
+        print(
+            f"§2 saved {batch.n_tenants} models as ONE artifact "
+            f"({sorted(os.listdir(path))}, {size_mb:.1f} MB) and reloaded"
+        )
+        farm = load_model(path)
+
+        with InferenceServer() as srv:
+            srv.add_model("los_farm", farm)
+            h = "H00042"
+            res = srv.predict_tenant("los_farm", h, fleet[h][0][:3])
+            print(
+                f"§3 serve: {h} answered {np.round(res.value, 2)} "
+                f"(status={res.status})"
+            )
+            res_u = srv.predict_tenant("los_farm", "H_NEW_SITE", fleet[h][0][:3])
+            print(
+                "   unknown hospital → pooled GLOBAL slice: "
+                f"{np.round(res_u.value, 2)}"
+            )
+            stats = srv.stats()["models"]["los_farm"]
+            print(
+                f"   jit cache {stats['jit_cache_size']} executables for "
+                f"the whole fleet; recompiles stay 0 across sizes/tenants"
+            )
+
+            # §4 one hospital's feed shifts scale (hours → minutes)
+            drifted_id = "H00007"
+            x_new = np.asarray(fleet[drifted_id][0]) * 60.0
+            y_new = np.asarray(fleet[drifted_id][1])
+            new_data = dict(fleet)
+            new_data[drifted_id] = (x_new, y_new)
+            farm2, report = retrain_drifted(
+                farm, new_data, threshold=0.25, min_rows=1,
+                save_path=os.path.join(work, "farm_v2"),
+                server=srv, serving_name="los_farm",
+            )
+            changed = [
+                t for t in farm.tenant_ids
+                if not np.array_equal(
+                    farm2.arrays["coefficients"][farm.tenant_index(t)],
+                    farm.arrays["coefficients"][farm.tenant_index(t)],
+                )
+            ]
+            print(
+                f"§4 drift: scored {report['scored']} hospitals, flagged "
+                f"{list(report['drifted'])} (PSI "
+                f"{max(report['drifted'].values()):.2f}); masked refit "
+                f"changed {changed} and NOTHING else; successor saved + "
+                "hot-swapped"
+            )
+            res2 = srv.predict_tenant("los_farm", drifted_id, x_new[:3])
+            print(
+                f"   post-swap answer for {drifted_id}: "
+                f"{np.round(res2.value, 2)} (status={res2.status})"
+            )
+
+
+if __name__ == "__main__":
+    main()

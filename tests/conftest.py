@@ -100,6 +100,11 @@ def pytest_configure(config):
         "retrain, shadow/canary promotion, journal recovery "
         "(pytest -m lifecycle)",
     )
+    config.addinivalue_line(
+        "markers",
+        "farm: model-farm tests — vmapped per-tenant fits, looped-baseline "
+        "bit-parity, tenant routing, drifted-subset refit (pytest -m farm)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

@@ -1,0 +1,635 @@
+"""Model-farm tests (ISSUE 11): vmapped per-tenant fits as ONE program.
+
+The load-bearing assertions:
+
+1. **Bit-parity** — the farm fit (one dispatch for T tenants) equals a
+   Python loop of per-tenant dispatches of the same kernel EXACTLY, for
+   fit parameters AND predictions, linear and k-means both.  This is
+   what makes the ≥20×/≥50× bench number a pure-overhead win, not a
+   different algorithm.
+2. **Ragged degradation** — 1-row, empty, and all-NaN tenants follow the
+   quality stance (NaN is missing; an evidence-free tenant lands on the
+   pooled global model under pooling) without poisoning anyone else.
+3. **One artifact** — save/load round-trips the whole fleet (manifest +
+   stacked arrays + per-tenant sketches) through io/model_io unchanged.
+4. **Serve routing** — tenant-id → farm index rides in-band through the
+   standard bucket ladder: zero steady-state recompiles across tenants
+   and batch sizes.
+5. **Drifted-subset refit** — only the drifted tenants' parameters
+   change; every other slice (and the global slot) stays byte-identical.
+6. **Chaos** — a farm fit killed inside the checkpoint save protocol
+   resumes bit-identically.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm import (
+    FarmKMeans,
+    FarmLinearRegression,
+    ModelFarmModel,
+    drifted_tenants,
+    pack_tenants,
+    tenant_psi,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm.farm import (
+    _init_farm_centers,
+    _make_farm_kmeans_loop,
+    _single_linear_fit,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io.model_io import (
+    load_model,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+
+pytestmark = pytest.mark.farm
+
+D = 4
+THETA = np.array([1.0, -2.0, 0.5, 3.0])
+
+
+def _fleet(n_tenants: int = 24, seed: int = 0, min_rows: int = 2,
+           max_rows: int = 40) -> dict:
+    """Ragged per-hospital regression datasets with a shared signal and
+    per-tenant perturbations."""
+    rng = np.random.default_rng(seed)
+    data = {}
+    for t in range(n_tenants):
+        n = int(rng.integers(min_rows, max_rows))
+        x = rng.normal(size=(n, D))
+        theta_t = THETA + 0.2 * rng.normal(size=D)
+        y = x @ theta_t + 0.7 + 0.01 * rng.normal(size=n)
+        data[f"H{t:03d}"] = (x, y)
+    return data
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    return _fleet()
+
+
+@pytest.fixture(scope="module")
+def linear_farm(fleet):
+    return FarmLinearRegression(reg_param=0.1, pool=0.0).fit(fleet)
+
+
+@pytest.fixture(scope="module")
+def kmeans_farm(fleet):
+    return FarmKMeans(k=3, max_iter=12, seed=1).fit(
+        {t: v[0] for t, v in fleet.items()}
+    )
+
+
+# ===================================================================== parity
+def test_linear_farm_vs_looped_bit_parity(fleet, linear_farm):
+    """Farm fit == a loop of per-tenant dispatches of the SAME kernel,
+    bit-for-bit, params and predictions — every tenant."""
+    batch = pack_tenants(fleet)
+    zeros = jnp.zeros((D + 1,), jnp.float32)
+    for i, tid in enumerate(batch.tenant_ids):
+        theta = np.asarray(
+            _single_linear_fit(
+                jnp.asarray(batch.x[i]), jnp.asarray(batch.y[i]),
+                jnp.asarray(batch.w[i]),
+                jnp.float32(0.1), jnp.float32(0.0), zeros, True,
+            )
+        )
+        got = np.concatenate(
+            [
+                linear_farm.arrays["coefficients"][i],
+                [linear_farm.arrays["intercepts"][i]],
+            ]
+        )
+        np.testing.assert_array_equal(theta, got)
+    # prediction parity: ONE mixed-tenant dispatch == a loop of
+    # per-tenant dispatches of the same serving kernel, bit-for-bit
+    ids = list(batch.tenant_ids)[:8]
+    big = np.concatenate(
+        [linear_farm.route_request(t, np.asarray(fleet[t][0])) for t in ids]
+    )
+    big_out = np.asarray(linear_farm.predict(jnp.asarray(big, jnp.float32)))
+    ofs = 0
+    for t in ids:
+        n = len(fleet[t][1])
+        looped = linear_farm.predict_tenant(t, np.asarray(fleet[t][0]))
+        np.testing.assert_array_equal(big_out[ofs : ofs + n], looped)
+        # ... and the materialized per-tenant family slice agrees to ulp
+        sliced = linear_farm.tenant_model(t).predict_numpy(
+            np.asarray(fleet[t][0], dtype=np.float32)
+        )
+        np.testing.assert_allclose(looped, sliced, atol=1e-5)
+        ofs += n
+
+
+def test_kmeans_farm_vs_looped_bit_parity(fleet, kmeans_farm):
+    """Same for k-means: centers AND assignments, with the per-tenant
+    seeded init stream shared between both paths."""
+    kdata = {t: v[0] for t, v in fleet.items()}
+    batch = pack_tenants(kdata)
+    loop = _make_farm_kmeans_loop(12, float(1e-4) ** 2)
+    for i, tid in enumerate(batch.tenant_ids):
+        c0, cv = _init_farm_centers(
+            batch.x[i : i + 1], batch.w[i : i + 1], 3, 1, base_index=i
+        )
+        cen, _, _, _ = loop(
+            jnp.asarray(batch.x[i : i + 1]), jnp.asarray(batch.w[i : i + 1]),
+            jnp.asarray(c0), jnp.asarray(cv),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cen)[0], kmeans_farm.arrays["centers"][i]
+        )
+    # assignments through the routed predict match the tenant slice
+    tid = batch.tenant_ids[3]
+    x = np.asarray(kdata[tid], dtype=np.float32)
+    routed = kmeans_farm.predict_tenant(tid, x)
+    sliced = kmeans_farm.tenant_model(tid).predict_numpy(x)
+    np.testing.assert_array_equal(routed.astype(int), sliced.astype(int))
+
+
+def test_linear_matches_batch_family(fleet):
+    """A 1-tenant farm reproduces the ordinary LinearRegression fit
+    (unstandardized) to f32 noise — the farm is a packing, not a new
+    algorithm."""
+    tid = "H005"
+    x, y = np.asarray(fleet[tid][0]), np.asarray(fleet[tid][1])
+    lr = ht.models.LinearRegression(reg_param=0.0, standardize=False).fit(
+        (x, y)
+    )
+    fm = FarmLinearRegression(reg_param=0.0, pool=0.0).fit({tid: fleet[tid]})
+    np.testing.assert_allclose(
+        np.asarray(lr.coefficients),
+        fm.arrays["coefficients"][0], atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        float(lr.intercept), fm.arrays["intercepts"][0], atol=1e-4
+    )
+
+
+# ============================================================== ragged edges
+def test_one_row_tenant_is_finite_and_pooled():
+    data = _fleet(6)
+    data["tiny"] = (np.array([[1.0, 0.0, 0.0, 0.0]]), np.array([5.0]))
+    m = FarmLinearRegression(reg_param=0.0, pool=50.0).fit(data)
+    i = m.tenant_index("tiny")
+    coef = m.arrays["coefficients"][i]
+    assert np.all(np.isfinite(coef))
+    # heavy pooling: the 1-row hospital sits near the global model
+    g = m.arrays["coefficients"][m.global_index]
+    assert np.linalg.norm(coef - g) < 0.5 * np.linalg.norm(g)
+
+
+def test_empty_tenant_lands_on_global_with_pooling():
+    data = _fleet(6)
+    data["empty"] = (np.empty((0, D)), np.empty((0,)))
+    m = FarmLinearRegression(pool=10.0).fit(data)
+    i = m.tenant_index("empty")
+    np.testing.assert_allclose(
+        m.arrays["coefficients"][i],
+        m.arrays["coefficients"][m.global_index], atol=1e-3,
+    )
+    assert int(m.arrays["tenant_rows"][i]) == 0
+
+
+def test_all_nan_tenant_degrades_like_empty():
+    """Quality stance: NaN is missing — an all-NaN hospital is an empty
+    hospital, and its garbage never reaches the global fit."""
+    data = _fleet(6)
+    clean = FarmLinearRegression(pool=10.0).fit(data)
+    data_nan = dict(data)
+    data_nan["allnan"] = (np.full((7, D), np.nan), np.full((7,), np.nan))
+    m = FarmLinearRegression(pool=10.0).fit(data_nan)
+    i = m.tenant_index("allnan")
+    assert np.all(np.isfinite(m.arrays["coefficients"][i]))
+    assert int(m.arrays["masked_rows"][i]) == 7
+    assert int(m.arrays["tenant_rows"][i]) == 0
+    # the global slot ignores the NaN tenant entirely
+    np.testing.assert_allclose(
+        m.arrays["coefficients"][m.global_index],
+        clean.arrays["coefficients"][clean.global_index], atol=1e-5,
+    )
+
+
+def test_nan_rows_equal_filtered_rows():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(20, D))
+    y = x @ THETA + 1.0
+    x_dirty = x.copy()
+    x_dirty[5, 2] = np.nan
+    x_dirty[11, 0] = np.inf
+    keep = np.ones(20, bool)
+    keep[[5, 11]] = False
+    m_dirty = FarmLinearRegression().fit(
+        pack_tenants({"h": (x_dirty, y)}, pad_to=32)
+    )
+    m_clean = FarmLinearRegression().fit(
+        pack_tenants({"h": (x[keep], y[keep])}, pad_to=32)
+    )
+    np.testing.assert_array_equal(
+        m_dirty.arrays["coefficients"][0], m_clean.arrays["coefficients"][0]
+    )
+
+
+def test_kmeans_empty_tenant_no_slice_but_predicts():
+    data = {"a": np.random.default_rng(0).normal(size=(30, D)),
+            "empty": np.empty((0, D))}
+    m = FarmKMeans(k=3, seed=0).fit(data)
+    with pytest.raises(ValueError, match="no valid centers"):
+        m.tenant_model("empty")
+    # routed predict still answers (cluster 0 by convention)
+    out = m.predict_tenant("empty", np.zeros((2, D)))
+    assert out.shape == (2,)
+
+
+def test_malformed_tenant_index_routes_to_global(linear_farm):
+    """A corrupted in-band tenant index (negative, ±inf, NaN, huge,
+    past-the-end) must answer with the pooled GLOBAL slot — never some
+    other hospital's private parameters (review-round regression: the
+    old clip sent negatives to tenant 0)."""
+    g = linear_farm.global_index
+    x = np.random.default_rng(1).normal(size=(1, D)).astype(np.float32)
+    fn = linear_farm.serving_predict_fn()
+
+    def answer(idx_val):
+        row = np.concatenate([[[idx_val]], x], axis=1).astype(np.float32)
+        return float(np.asarray(fn(jnp.asarray(row)))[0])
+
+    ref = answer(float(g))
+    for bad in (-1.0, -np.inf, np.nan, np.inf, 1e12, float(g + 7)):
+        assert answer(bad) == ref, bad
+    # a real tenant still answers with its own slice
+    assert answer(0.0) != ref
+
+
+def test_non_string_tenant_ids_work_end_to_end():
+    """Int/np tenant ids (a DB's natural keys) normalize to one string id
+    space across pack → fit → route → refit → lifecycle (review-round
+    regression: pack_tenants stringified keys then indexed the original
+    mapping, KeyError on the first int id)."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.lifecycle import (
+        retrain_drifted,
+    )
+
+    rng = np.random.default_rng(4)
+    data = {}
+    for t in range(6):
+        x = rng.normal(size=(30, D))
+        data[t] = (x, x @ THETA)  # int keys on purpose
+    m = FarmLinearRegression(pool=1.0).fit(data)
+    assert m.tenant_ids == tuple(str(t) for t in range(6))
+    np.testing.assert_array_equal(
+        m.predict_tenant(3, np.asarray(data[3][0][:2])),
+        m.predict_tenant("3", np.asarray(data[3][0][:2])),
+    )
+    m2 = m.refit({2: data[2]})
+    np.testing.assert_array_equal(
+        m2.arrays["coefficients"][0], m.arrays["coefficients"][0]
+    )
+    # lifecycle path with int keys: drifted id resolves into refit data
+    shifted = dict(data)
+    shifted[1] = (np.asarray(data[1][0]) + 6.0, np.asarray(data[1][1]))
+    m3, report = retrain_drifted(m, shifted, threshold=0.25, min_rows=1)
+    assert list(report["drifted"]) == ["1"]
+    assert m3 is not m
+
+
+def test_pack_validation():
+    with pytest.raises(ValueError, match="at least one tenant"):
+        pack_tenants({})
+    with pytest.raises(ValueError, match="rows"):
+        pack_tenants({"a": (np.zeros((3, D)), np.zeros(2))})
+    with pytest.raises(ValueError, match="features"):
+        pack_tenants({"a": np.zeros((3, D)), "b": np.zeros((3, D + 1))})
+    with pytest.raises(ValueError, match=">= 0"):
+        pack_tenants({"a": (np.zeros((2, D)), np.zeros(2), np.array([1.0, -1.0]))})
+
+
+# ================================================================== pooling
+def test_partial_pooling_shrinks_small_tenants_more():
+    rng = np.random.default_rng(9)
+    theta_odd = THETA + 3.0
+    big_x = rng.normal(size=(400, D))
+    small_x = rng.normal(size=(4, D))
+    data = {
+        "big": (big_x, big_x @ theta_odd),
+        "small": (small_x, small_x @ theta_odd),
+    }
+    # global pull comes from a third, dominant tenant on THETA
+    base_x = rng.normal(size=(800, D))
+    data["base"] = (base_x, base_x @ THETA)
+    m = FarmLinearRegression(pool=20.0).fit(data)
+    g = m.arrays["coefficients"][m.global_index]
+    d_big = np.linalg.norm(
+        m.arrays["coefficients"][m.tenant_index("big")] - theta_odd
+    )
+    d_small = np.linalg.norm(
+        m.arrays["coefficients"][m.tenant_index("small")] - theta_odd
+    )
+    # the big hospital keeps its own signal; the small one is pulled
+    # toward the global model (away from its own few rows' signal)
+    assert d_big < 0.5
+    assert d_small > 2 * d_big
+    assert np.all(np.isfinite(g))
+
+
+# ================================================================== artifact
+def test_save_load_one_artifact(tmp_path, linear_farm, fleet):
+    path = str(tmp_path / "farm")
+    linear_farm.save(path)
+    assert os.path.isdir(path)
+    assert sorted(os.listdir(path)) == ["arrays.npz", "metadata.json"]
+    m2 = load_model(path)
+    assert isinstance(m2, ModelFarmModel)
+    assert m2.tenant_ids == linear_farm.tenant_ids
+    for k, v in linear_farm.arrays.items():
+        np.testing.assert_array_equal(v, m2.arrays[k])
+    tid = "H007"
+    np.testing.assert_array_equal(
+        linear_farm.predict_tenant(tid, fleet[tid][0]),
+        m2.predict_tenant(tid, fleet[tid][0]),
+    )
+    # per-tenant sketches round-trip into ordinary DataProfiles
+    prof = m2.tenant_profile(tid)
+    assert prof.total_rows == float(len(fleet[tid][1]))
+
+
+def test_profiles_merge_to_pooled(linear_farm, fleet):
+    """Per-tenant sketches share edges, so merging every tenant's profile
+    reproduces the pooled distribution exactly (count/mean/histogram) —
+    the property lifecycle's fleet-level drift view relies on."""
+    ids = linear_farm.tenant_ids
+    merged = linear_farm.tenant_profile(ids[0])
+    for tid in ids[1:]:
+        merged.merge(linear_farm.tenant_profile(tid))
+    total_rows = sum(len(v[1]) for v in fleet.values())
+    assert merged.total_rows == float(total_rows)
+    pooled = np.concatenate([np.asarray(v[0]) for v in fleet.values()])
+    sk = merged.sketches[linear_farm.feature_names[0]]
+    np.testing.assert_allclose(sk.mean, pooled[:, 0].mean(), rtol=1e-6)
+    assert sk.counts.sum() == total_rows
+
+
+# =================================================================== serving
+def test_serving_zero_recompiles_across_tenants_and_sizes(linear_farm, fleet):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        ModelRegistry,
+    )
+
+    reg = ModelRegistry()
+    sm = reg.register("farm", linear_farm, warmup=True)
+    rng = np.random.default_rng(0)
+    ids = list(fleet)
+    for size in (1, 7, 32, 3, 1, 17):
+        tid = ids[int(rng.integers(len(ids)))]
+        x = rng.normal(size=(size, D))
+        out = sm.predict(linear_farm.route_request(tid, x))
+        expect = linear_farm.predict_tenant(tid, x)
+        np.testing.assert_allclose(out, expect, atol=1e-5)
+    assert sm.metrics.recompile_count == 0
+    cache = sm.jit_cache_size()
+    assert cache is None or cache <= len(sm.buckets)
+
+
+def test_server_routes_tenant_and_unknown_falls_back(linear_farm, fleet):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+    )
+
+    with InferenceServer() as srv:
+        srv.add_model("farm", linear_farm)
+        tid = "H004"
+        x = np.asarray(fleet[tid][0][:5])
+        res = srv.predict_tenant("farm", tid, x)
+        assert res.ok
+        np.testing.assert_allclose(
+            res.value, linear_farm.predict_tenant(tid, x), atol=1e-5
+        )
+        # unknown hospital → the pooled GLOBAL slice answers
+        res_u = srv.predict_tenant("farm", "NOT_A_HOSPITAL", x)
+        assert res_u.ok
+        g = linear_farm.global_model()
+        np.testing.assert_allclose(
+            res_u.value, g.predict_numpy(x.astype(np.float32)), atol=1e-5
+        )
+        srv.add_model(
+            "plain",
+            ht.models.LinearRegression().fit(
+                (np.asarray(fleet[tid][0]), np.asarray(fleet[tid][1]))
+            ),
+        )
+        with pytest.raises(TypeError, match="not tenant-routable"):
+            srv.predict_tenant("plain", tid, x)
+
+
+# ============================================================ drift + refit
+def test_refit_touches_only_the_subset(linear_farm, fleet):
+    shifted = {
+        "H002": (np.asarray(fleet["H002"][0]) + 4.0, np.asarray(fleet["H002"][1])),
+        "H009": (np.asarray(fleet["H009"][0]) * 2.0, np.asarray(fleet["H009"][1])),
+    }
+    m2 = linear_farm.refit(shifted)
+    assert m2 is not linear_farm
+    for tid in linear_farm.tenant_ids:
+        i = linear_farm.tenant_index(tid)
+        same = np.array_equal(
+            m2.arrays["coefficients"][i], linear_farm.arrays["coefficients"][i]
+        )
+        if tid in shifted:
+            assert not same, f"{tid} should have been refit"
+        else:
+            assert same, f"{tid} must be byte-identical after subset refit"
+    # global slot frozen
+    np.testing.assert_array_equal(
+        m2.arrays["coefficients"][m2.global_index],
+        linear_farm.arrays["coefficients"][linear_farm.global_index],
+    )
+    # refreshed sketches for the refit tenants only
+    i2 = linear_farm.tenant_index("H002")
+    assert not np.array_equal(
+        m2.arrays["profile_counts"][i2],
+        linear_farm.arrays["profile_counts"][i2],
+    )
+
+
+def test_kmeans_refit_same_data_reproduces_fit(kmeans_farm, fleet):
+    """The refit init stream folds in the tenant's GLOBAL index, so a
+    refit on unchanged data lands on the exact fit-time centers."""
+    tid = "H006"
+    m2 = kmeans_farm.refit({tid: fleet[tid][0]})
+    i = kmeans_farm.tenant_index(tid)
+    np.testing.assert_array_equal(
+        m2.arrays["centers"][i], kmeans_farm.arrays["centers"][i]
+    )
+
+
+def test_drift_flags_only_shifted_tenant(kmeans_farm, fleet):
+    live = {
+        "H003": np.asarray(fleet["H003"][0]) + 6.0,   # unit-scale shift
+        "H008": np.asarray(fleet["H008"][0]),          # unchanged
+    }
+    flagged = drifted_tenants(kmeans_farm, live, min_rows=1)
+    assert "H003" in flagged and flagged["H003"] > 0.25
+    assert "H008" not in flagged
+    psi = tenant_psi(kmeans_farm, "H008", live["H008"])
+    assert max(psi.values()) < 0.25
+    # unknown tenants are skipped, not crashed on
+    assert drifted_tenants(
+        kmeans_farm, {"nope": np.zeros((50, D))}, min_rows=1
+    ) == {}
+
+
+def test_lifecycle_retrain_drifted_end_to_end(tmp_path, fleet):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.lifecycle import (
+        retrain_drifted,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+    )
+
+    farm0 = FarmLinearRegression(reg_param=0.1, pool=1.0).fit(fleet)
+    # hospital H001's feature distribution moved; its data follows
+    new_data = dict(fleet)
+    x1 = np.asarray(fleet["H001"][0]) + 5.0
+    new_data["H001"] = (x1, x1 @ (THETA + 1.0))
+    path = str(tmp_path / "farm_v2")
+    with InferenceServer() as srv:
+        srv.add_model("farm", farm0)
+        m2, report = retrain_drifted(
+            farm0, new_data, threshold=0.25, min_rows=1,
+            save_path=path, server=srv, serving_name="farm",
+        )
+        assert list(report["drifted"]) == ["H001"]
+        assert report["swapped"] == "farm"
+        # stable tenant untouched, drifted tenant changed
+        i_stable = farm0.tenant_index("H000")
+        np.testing.assert_array_equal(
+            m2.arrays["coefficients"][i_stable],
+            farm0.arrays["coefficients"][i_stable],
+        )
+        i1 = farm0.tenant_index("H001")
+        assert not np.array_equal(
+            m2.arrays["coefficients"][i1], farm0.arrays["coefficients"][i1]
+        )
+        # the server now answers with the successor
+        res = srv.predict_tenant("farm", "H001", x1[:4])
+        np.testing.assert_allclose(
+            res.value, m2.predict_tenant("H001", x1[:4]), atol=1e-5
+        )
+    # and the successor artifact is on disk, loadable
+    assert load_model(path).tenant_ids == farm0.tenant_ids
+    # nothing drifted → same object back, no save
+    m3, rep3 = retrain_drifted(farm0, fleet, threshold=0.25, min_rows=1)
+    assert m3 is farm0 and rep3["drifted"] == {}
+
+
+# ==================================================================== chaos
+@pytest.mark.chaos
+def test_farm_fit_kill_and_resume_bit_identical(tmp_path):
+    """Kill a checkpointed farm k-means fit at the commit fault site;
+    rerunning the same config must land on EXACTLY the uninterrupted
+    fit's centers for every tenant."""
+    data = {t: v[0] for t, v in _fleet(12, seed=5, min_rows=8).items()}
+
+    def est(ckpt_dir):
+        return FarmKMeans(
+            k=3, max_iter=8, tol=0.0, seed=2,
+            checkpoint_dir=str(ckpt_dir), checkpoint_every=1,
+        )
+
+    ref = est(tmp_path / "ref").fit(data)
+
+    plan = faults.FaultPlan().crash("fit_ckpt.save.commit", after=2)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            est(tmp_path / "crashed").fit(data)
+    assert plan.fired("fit_ckpt.save.commit") == 1
+
+    resumed = est(tmp_path / "crashed").fit(data)
+    np.testing.assert_array_equal(
+        resumed.arrays["centers"], ref.arrays["centers"]
+    )
+    np.testing.assert_array_equal(
+        resumed.arrays["n_iter"], ref.arrays["n_iter"]
+    )
+
+
+# ============================================================== obs plumbing
+def test_cohort_label_bounded_and_stable():
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.registry import (
+        N_COHORTS,
+        cohort_label,
+    )
+
+    labels = {cohort_label(f"H{i:04d}") for i in range(5000)}
+    assert len(labels) <= N_COHORTS
+    assert cohort_label("H0001") == cohort_label("H0001")
+
+
+def test_label_cardinality_guard_caps_export():
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.export import (
+        prometheus_text,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.registry import (
+        MetricsRegistry,
+        N_COHORTS,
+        split_labels,
+    )
+
+    reg = MetricsRegistry()
+    n_tenants = 600
+    for i in range(n_tenants):
+        reg.inc(f'farm.rows{{tenant="H{i:04d}"}}', 2.0)
+    reg.inc("farm.fit_tenants", 1.0)  # unlabeled family passes through
+    snap = reg.collect()
+    series = [k for k in snap["counters"] if k.startswith("farm.rows{")]
+    assert 0 < len(series) <= N_COHORTS
+    # mass is preserved: counters SUM into their cohort buckets
+    assert sum(snap["counters"][k] for k in series) == 2.0 * n_tenants
+    assert all(
+        set(split_labels(k)[1]) == {"tenant"} for k in series
+    )
+    assert any(
+        k.startswith("obs.cardinality_capped") for k in snap["counters"]
+    )
+    # a small labeled family keeps its exact labels
+    reg2 = MetricsRegistry()
+    reg2.inc('serve.breaker{model="los"}', 1.0)
+    assert 'serve.breaker{model="los"}' in reg2.collect()["counters"]
+    # a capped family only buckets the HOT key: the low-cardinality
+    # model= companion label keeps attributing series exactly
+    reg3 = MetricsRegistry()
+    for i in range(400):
+        reg3.inc(f'farm.rows{{model="los",tenant="H{i:04d}"}}', 1.0)
+        reg3.inc(f'farm.rows{{model="readmit",tenant="H{i:04d}"}}', 1.0)
+    snap3 = reg3.collect()
+    rows3 = [k for k in snap3["counters"] if k.startswith("farm.rows{")]
+    models = {split_labels(k)[1]["model"] for k in rows3}
+    assert models == {"los", "readmit"}
+    assert all(split_labels(k)[1]["tenant"].startswith("c") for k in rows3)
+    assert sum(snap3["counters"][k] for k in rows3) == 800.0
+    # the Prometheus page renders the capped view without blowing up
+    text = prometheus_text(reg)
+    assert text.count("cmlhn_farm_rows_total{") <= N_COHORTS
+
+
+def test_farm_metrics_use_cohorts(linear_farm, fleet):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.registry import (
+        global_registry,
+    )
+
+    before = {
+        k: v for k, v in global_registry().counters.items()
+        if k.startswith("farm.requests{")
+    }
+    linear_farm.predict_tenant("H001", np.asarray(fleet["H001"][0][:2]))
+    after = {
+        k: v for k, v in global_registry().counters.items()
+        if k.startswith("farm.requests{")
+    }
+    assert sum(after.values()) == sum(before.values()) + 1
+    assert all("cohort=" in k and "tenant" not in k for k in after)

@@ -179,6 +179,25 @@ def main() -> int:
                 f"lifecycle span {required!r} is not emitted — journal "
                 "transitions have drifted from the instrumentation"
             )
+    # 5. model-farm instrumentation: the fleet fit / drifted-subset
+    # refit / tenant-routed predict must stay spanned, and NO metric may
+    # carry a raw per-tenant label (a 10k-series Prometheus export) —
+    # tenant breakdowns go through obs.registry.cohort_label
+    for required in ("farm.fit", "farm.refit", "farm.predict"):
+        if required not in emitted:
+            problems.append(
+                f"farm span {required!r} is not emitted — the farm has "
+                "drifted from its instrumentation"
+            )
+    tenant_label = re.compile(r"\{tenant(?:_id)?=")
+    for path in pkg_files:
+        src = open(path).read()
+        if tenant_label.search(src):
+            problems.append(
+                f"{os.path.relpath(path, ROOT)}: metric labeled by raw "
+                "tenant id — use obs.registry.cohort_label (bounded "
+                "cardinality) instead"
+            )
 
     if problems:
         print("check_obs: INSTRUMENTATION DRIFT")
