@@ -2731,6 +2731,269 @@ def _bench_model_farm() -> dict:
     }
 
 
+def _bench_serve_fleet() -> dict:
+    """Serving-fleet config (ISSUE 12): N replicas + tenant router +
+    SLO admission vs ONE unmanaged server, under the replayable
+    open-loop Poisson load profile (``serve/fleet/loadgen.py``).
+
+    The comparison is run PAST saturation (offered ≈ overload × the raw
+    executable rate) with identical per-class deadlines, because that is
+    where the fabric earns its keep: the bare server's single FIFO queue
+    fills with bulk traffic, every admitted interactive request queues
+    behind it past the interactive deadline, and in-SLO interactive
+    goodput collapses toward zero (the classic deadline deathspiral —
+    busy chip, no useful answers).  The fleet's class ladder sheds
+    best_effort, then batch, AT THE DOOR of the routed replica, so its
+    SLO-sized queues stay short and interactive rides through.  The
+    headline is therefore **interactive predictions/s delivered within
+    the pinned SLO** (p99 bounded by the pin by construction), plus the
+    degradation curve (per-class shed fractions vs offered load — the
+    class ORDER is the contract), one end-to-end routed trace
+    (fleet.request ⊃ router.route ⊃ serve.request under a single trace
+    id), and a replica-kill chaos leg (zero unhandled).
+
+    1-core CPU-proxy caveat (honest accounting, PR 4 discipline): the
+    replicas share one physical core here, so TOTAL goodput cannot
+    scale with N — the fleet's win is the admission/routing layer, and
+    ``pred_s_per_chip`` divides by the replica count.  On a real pod
+    each replica owns its slice and both numbers scale.
+    """
+    import jax
+
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs import (
+        trace as obs_trace,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        fleet as F,
+    )
+
+    platform, on_tpu, _, _, _, n_chips = _bench_setup(6000)
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 4))
+    overload = float(os.environ.get("BENCH_FLEET_OVERLOAD", 1.7))
+    dur = float(os.environ.get("BENCH_FLEET_SECONDS", 4.0))
+
+    # the served model: a k=1024 resource-profile clusterer — heavy
+    # enough per row that queueing (not dispatch overhead) dominates
+    rng = np.random.default_rng(0)
+    n_train, d, k = 6000, 64, 1024
+    x = rng.normal(size=(n_train, d)).astype(np.float32)
+    model = ht.KMeans(k=k, max_iter=2, seed=0).fit(x)
+    buckets = (1, 2, 4, 8, 16, 32, 64, 128)
+
+    classes = F.default_slo_classes()
+    deadlines = {name: c.default_deadline_s for name, c in classes.items()}
+    pin_s = deadlines["interactive"]
+
+    # fixed tenant mix: many small interactive hospitals + bulk classes
+    mix = tuple(
+        [F.TenantMix(f"H{i:02d}", 1.0, "interactive", 16) for i in range(8)]
+        + [F.TenantMix(f"J{i:02d}", 1.0, "batch", 64) for i in range(8)]
+        + [F.TenantMix(f"B{i:02d}", 1.0, "best_effort", 96) for i in range(6)]
+    )
+    rows_per_req = sum(m.weight * m.rows for m in mix) / sum(
+        m.weight for m in mix
+    )
+
+    # raw executable rate at the top bucket: the capacity yardstick the
+    # offered overload scales from (platform-portable)
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.registry import (
+        ServingModel,
+    )
+
+    probe_sm = ServingModel(model, buckets=(buckets[-1],))
+    probe_sm.warmup()
+    probe_x = x[: buckets[-1]]
+    t0 = time.perf_counter()
+    probed = 0
+    while time.perf_counter() - t0 < 0.6:
+        probe_sm.predict_bucketed(probe_x)
+        probed += buckets[-1]
+    raw_rate = probed / (time.perf_counter() - t0)
+
+    def schedule_at(rows_per_s: float, seconds: float, seed: int = 42):
+        profile = F.LoadProfile(
+            base_rate_rps=rows_per_s / rows_per_req, tenants=mix, seed=seed,
+            burst_start_s=seconds / 3.0, burst_dur_s=seconds / 3.0,
+            burst_mult=1.5,
+        )
+        return F.build_schedule(profile, seconds)
+
+    def x_for(a):
+        return x[: a.rows]
+
+    def run_single(sched, queue_rows):
+        srv = InferenceServer(max_queue_rows=queue_rows)
+        srv.add_model("km", model, buckets=buckets)
+        with srv:
+            return F.replay(
+                lambda a: srv.submit(
+                    "km", x_for(a), deadline_s=deadlines[a.slo]
+                ),
+                sched, wait_timeout_s=8.0,
+            )
+
+    def make_fleet():
+        fs = F.ReplicaSet(n_replicas=n_replicas, max_queue_rows=384)
+        fs.add_model("km", model, buckets=buckets)
+        return fs
+
+    def run_fleet(sched, mid_hook=None):
+        fs = make_fleet()
+        with fs:
+            rep = F.replay(
+                lambda a: fs.submit(
+                    "km", x_for(a), tenant_id=a.tenant_id, slo=a.slo,
+                    deadline_s=deadlines[a.slo],
+                ),
+                sched, wait_timeout_s=8.0, mid_hook=mid_hook,
+            )
+            health = fs.health()
+        return rep, health
+
+    # ---------------------------------------------------- A/B past saturation
+    # TWO baselines, both the full aggregate load on one server:
+    #   * default — the shipped pre-fleet config (max_queue_rows=4096,
+    #     throughput-sized): its full-queue sojourn exceeds the
+    #     interactive pin, the deathspiral the docstring describes;
+    #   * tuned — the same server given the FLEET's total buffering
+    #     (n_replicas x 384, SLO-sized): queue-size asymmetry removed,
+    #     so what remains is the class-blind FIFO — interactive still
+    #     loses its share to bulk traffic at the door.
+    # Reporting both keeps the headline from resting on a queue-size
+    # configuration choice alone.
+    offered_rate = overload * raw_rate
+    sched = schedule_at(offered_rate, dur)
+    rep_single = run_single(sched, 4096)
+    rep_tuned = run_single(sched, 384 * n_replicas)
+    rep_fleet, health = run_fleet(sched)
+
+    def int_in_slo(rep):
+        r = rep["reports"].get("interactive")
+        if r is None:
+            return {"rows": 0, "p50_ms": None, "p99_ms": None}, 0.0
+        hit = r.in_slo(pin_s)
+        return hit, hit["rows"] / rep["gen_wall_s"]
+
+    single_slo, single_rate = int_in_slo(rep_single)
+    tuned_slo, tuned_rate = int_in_slo(rep_tuned)
+    fleet_slo, fleet_rate = int_in_slo(rep_fleet)
+
+    # -------------------------------------------------- degradation curve
+    curve = []
+    ordering_ok = True
+    for mult in (0.35, 0.9, 1.7, 2.6):
+        crep, _ = run_fleet(schedule_at(mult * raw_rate, 1.2, seed=7))
+        point = {"offered_x_raw": mult}
+        fracs = {}
+        for slo in F.SLO_SHED_ORDER:
+            c = crep["per_class"].get(slo)
+            fracs[slo] = 0.0 if c is None else c["shed_fraction"]
+            point[f"shed_{slo}"] = fracs[slo]
+        curve.append(point)
+        ordering_ok = ordering_ok and (
+            fracs["best_effort"] >= fracs["batch"] >= fracs["interactive"]
+        )
+
+    # ----------------------------------------------------- route trace
+    tracer = obs_trace.Tracer()
+    trace_fleet = make_fleet()
+    with trace_fleet:
+        with obs_trace.active(tracer):
+            res = trace_fleet.predict(
+                "km", x[:4], tenant_id="H00", slo="interactive"
+            )
+    routed = [s for s in tracer.spans if s["name"] == "fleet.request"]
+    trace_evidence = {}
+    if routed:
+        tid = routed[-1]["trace_id"]
+        chain = obs_trace.timeline(tracer.spans, tid)
+        trace_evidence = {
+            "trace_id": tid,
+            "spans": [s["name"] for s in chain],
+            "replica": routed[-1]["attrs"].get("replica"),
+            "status": res.status,
+        }
+    route_proven = (
+        {"fleet.request", "router.route", "serve.request"}
+        <= set(trace_evidence.get("spans", []))
+    )
+
+    # ------------------------------------------------------- chaos leg
+    chaos_sched = schedule_at(0.9 * raw_rate, 2.5, seed=9)
+    chaos_fleet = make_fleet()
+    chaos_unhandled = 0
+    with chaos_fleet:
+        try:
+            chaos_rep = F.replay(
+                lambda a: chaos_fleet.submit(
+                    "km", x_for(a), tenant_id=a.tenant_id, slo=a.slo,
+                    deadline_s=deadlines[a.slo],
+                ),
+                chaos_sched, wait_timeout_s=8.0,
+                mid_hook=lambda: chaos_fleet.kill_replica(1),
+            )
+        except Exception:  # noqa: BLE001 — the measurement IS "no raise"
+            chaos_unhandled += 1
+            chaos_rep = {"unanswered": -1, "ok_rows": 0}
+        post_kill_ok = all(
+            chaos_fleet.predict("km", x[:2], tenant_id=f"T{i}").ok
+            for i in range(5)
+        )
+        chaos_health = chaos_fleet.health()
+    chaos_unhandled += max(chaos_rep["unanswered"], 0)
+
+    return {
+        "metric": (
+            f"serve_fleet interactive pred/s within the {pin_s * 1e3:.0f}ms "
+            f"SLO at {overload:.1f}x raw-rate overload "
+            f"(KMeans k={k} d={d}, {n_replicas} replicas, {platform})"
+        ),
+        "value": round(fleet_rate, 1),
+        "unit": "in-SLO interactive rows/sec",
+        "vs_baseline": round(fleet_rate / max(single_rate, 1e-9), 2),
+        "single_replica_in_slo_rows_per_s": round(single_rate, 1),
+        "vs_tuned_single": round(fleet_rate / max(tuned_rate, 1e-9), 2),
+        "tuned_single_in_slo_rows_per_s": round(tuned_rate, 1),
+        "tuned_single_queue_rows": 384 * n_replicas,
+        "tuned_single_int_p99_ms": tuned_slo["p99_ms"],
+        "gate_min_ratio": 3.0,
+        "raw_rate_rows_per_s": round(raw_rate, 1),
+        "offered_rows_per_s": round(offered_rate, 1),
+        "offered_realized_rows_per_s": round(
+            rep_fleet["offered_rows"] / rep_fleet["gen_wall_s"], 1
+        ),
+        "fleet_int_p99_ms": fleet_slo["p99_ms"],
+        "single_int_p99_ms": single_slo["p99_ms"],
+        "p99_pin_ms": pin_s * 1e3,
+        "fleet_total_ok_rows_per_s": round(
+            rep_fleet["ok_rows"] / rep_fleet["gen_wall_s"], 1
+        ),
+        "single_total_ok_rows_per_s": round(
+            rep_single["ok_rows"] / rep_single["gen_wall_s"], 1
+        ),
+        "pred_s_per_chip": round(
+            rep_fleet["ok_rows"] / rep_fleet["gen_wall_s"] / n_replicas, 1
+        ),
+        "shared_core_proxy": not on_tpu,
+        "degradation_curve": curve,
+        "shed_order_best_effort_first": ordering_ok,
+        "fleet_shed_requests": health["shed"],
+        "trace_evidence": trace_evidence,
+        "route_trace_proven": route_proven,
+        "chaos_unhandled": chaos_unhandled,
+        "chaos_all_answered": chaos_rep["unanswered"] == 0,
+        "chaos_post_kill_ok": post_kill_ok,
+        "chaos_rerouted": chaos_health["rerouted"],
+        "max_pacing_lag_s": rep_fleet["max_pacing_lag_s"],
+        "n_replicas": n_replicas,
+        "platform": platform,
+    }
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -2752,6 +3015,7 @@ CONFIGS = {
     "lifecycle": lambda: _bench_lifecycle(),                    # ISSUE 9 loop
     "obs_overhead": lambda: _bench_obs_overhead(),              # ISSUE 10 gate
     "model_farm": lambda: _bench_model_farm(),                  # ISSUE 11 A/B
+    "serve_fleet": lambda: _bench_serve_fleet(),                # ISSUE 12 fleet
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets
@@ -2991,8 +3255,8 @@ def _child_main(name: str) -> None:
 #: recovers mid-window: headline first (north star, then the A/B the
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
-    "kmeans256", "pallas_ab", "kmeans_fused_ab", "model_farm", "sql_device",
-    "rf20", "gbt20", "nb", "gmm32", "bisecting", "streaming",
+    "kmeans256", "pallas_ab", "kmeans_fused_ab", "model_farm", "serve_fleet",
+    "sql_device", "rf20", "gbt20", "nb", "gmm32", "bisecting", "streaming",
     "streaming_pipeline", "kmeans8", "serve",
 ]
 

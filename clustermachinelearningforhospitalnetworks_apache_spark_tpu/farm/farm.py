@@ -542,6 +542,13 @@ class ModelFarmModel:
             [np.full((x.shape[0], 1), float(idx)), x], axis=1
         )
 
+    def affinity_key(self, tenant_id) -> str:
+        """The key the serving fleet's consistent-hash router sticks a
+        tenant to — the SAME normalized id space ``tenant_index`` uses,
+        so an int/np database key and its string form land on the same
+        replica (and the same in-band farm slice)."""
+        return str(tenant_id)
+
     def predict_tenant(self, tenant_id: str, x: np.ndarray) -> np.ndarray:
         """Host-side convenience: route + predict + unpad for one tenant
         (serving goes through ``serve/`` instead — same routed form)."""

@@ -197,6 +197,14 @@ class LifecycleController:
     Traffic reaches the machine through the serve hooks (attach with
     ``server.attach_lifecycle(controller)``); ``poll()`` advances the
     heavy transitions (retrain, gates, flip) on the caller's thread.
+
+    ``server`` is anything with the promotion surface the controller
+    drives — ``add_model`` / ``swap_model`` / ``registry.names()`` /
+    ``attach_lifecycle``: a single :class:`~..serve.InferenceServer`,
+    or a :class:`~..serve.fleet.ReplicaSet` (ISSUE 12), in which case
+    every PROMOTED flip — and the re-applied flip after a rollback or
+    crash recovery (``_install_active``) — lands on EVERY replica
+    atomically through the fleet's prepare-all-then-commit swap.
     """
 
     def __init__(

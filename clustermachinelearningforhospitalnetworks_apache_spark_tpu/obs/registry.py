@@ -385,6 +385,26 @@ def cohort_label(value: str, n_cohorts: int = N_COHORTS) -> str:
     return f"c{zlib.crc32(str(value).encode()) % n_cohorts:02d}"
 
 
+#: replica-count ceiling for per-replica metric labels — a fleet is a
+#: few to a few dozen replicas, never tenant-shaped cardinality
+MAX_REPLICAS_LABELED = 256
+
+
+def replica_label(index: int) -> str:
+    """Bounded, format-pinned label value for a serving-fleet replica
+    (``"r03"``).  The ONLY sanctioned way to put a replica label on a
+    metric — ``tools/check_obs.py`` fails the build on a brace-label
+    built any other way, the same discipline that keeps tenant labels
+    behind :func:`cohort_label`."""
+    i = int(index)
+    if not 0 <= i < MAX_REPLICAS_LABELED:
+        raise ValueError(
+            f"replica index {index} outside the labeled range "
+            f"[0, {MAX_REPLICAS_LABELED})"
+        )
+    return f"r{i:02d}"
+
+
 def _merge_hist_dicts(a: dict, b: dict) -> dict:
     """Bin-addition merge of two ``FixedHistogram.to_dict`` fragments when
     the edges agree; otherwise keep ``b`` (last wins, as collect does for

@@ -61,6 +61,9 @@ REGISTERED_SPANS = (
     "farm.fit",          # model-farm fleet fit (one dispatch, T tenants)
     "farm.refit",        # drifted-subset masked refit
     "farm.predict",      # tenant-routed predict (host convenience path)
+    "fleet.request",     # serving-fleet front door: admission→route→answer
+    "fleet.promote",     # atomic fleet-wide swap (every replica or none)
+    "router.route",      # the routing decision (policy, chosen replica)
     "obs.demo",          # example/bench root spans
 )
 
@@ -85,6 +88,7 @@ SITE_COVERAGE = {
     "lifecycle.registry.swap": "lifecycle.promote",
     "lifecycle.rollback": "lifecycle.rollback",
     "lifecycle.feedback.*": "lifecycle.feedback",
+    "fleet.swap.*": "fleet.promote",
 }
 
 _CTX: contextvars.ContextVar = contextvars.ContextVar("obs_trace", default=None)

@@ -12,8 +12,11 @@ ever recompiling in steady state:
 * :mod:`scoring`   — sharded bulk scoring over the training data mesh
 * :mod:`metrics`   — p50/p99 latency, queue depth, fill ratio, recompiles
 * :mod:`server`    — the composed front door (:class:`InferenceServer`)
+* :mod:`fleet`     — N replicas behind a tenant-aware router with
+  per-tenant SLO admission (:class:`fleet.ReplicaSet`)
 
-See docs/ARCHITECTURE.md §Serving layer for the design rationale.
+See docs/ARCHITECTURE.md §Serving layer and §Serving fleet for the
+design rationale.
 """
 
 from .batcher import DEFAULT_MAX_WAIT_S, MicroBatcher
@@ -41,7 +44,8 @@ from .queue import (
 )
 from .registry import ModelRegistry, ServingModel
 from .scoring import ShardedScorer, bulk_score
-from .server import InferenceServer
+from .server import InferenceServer, NotRoutableError
+from . import fleet
 
 __all__ = [
     "CircuitBreaker",
@@ -55,7 +59,9 @@ __all__ = [
     "STATUS_UNAVAILABLE",
     "MicroBatcher",
     "ModelRegistry",
+    "NotRoutableError",
     "Request",
+    "fleet",
     "RequestQueue",
     "ServeResult",
     "ServingMetrics",

@@ -1,0 +1,80 @@
+"""Serving fleet: N replicas, a tenant-aware router, per-tenant SLOs.
+
+The fabric over :mod:`..server` (ROADMAP item 1) — the MLlib move of one
+uniform surface over many executors, applied to serving:
+
+* :mod:`placement` — replica→devices assignment as a first-class object
+  (the RecML ``Partitioner`` shape)
+* :mod:`router`    — least-loaded / consistent-hash-per-tenant routing,
+  health-aware, minimal reshuffle on membership change
+* :mod:`admission` — per-tenant token-bucket quotas + SLO classes with
+  ORDERED shed thresholds (best_effort → batch → interactive)
+* :mod:`replica_set` — the composed front door: atomic fleet-wide
+  promotion, replica kill/drain, pull-collector health
+* :mod:`loadgen`   — replayable open-loop Poisson load (diurnal bursts,
+  fixed tenant mix) for the ``serve_fleet`` bench
+
+See docs/ARCHITECTURE.md §Serving fleet.
+"""
+
+from .admission import (
+    AdmissionController,
+    AdmissionDecision,
+    SLO_BATCH,
+    SLO_BEST_EFFORT,
+    SLO_INTERACTIVE,
+    SLO_SHED_ORDER,
+    SLOClass,
+    TokenBucket,
+    default_slo_classes,
+)
+from .loadgen import Arrival, ClassReport, LoadProfile, TenantMix, build_schedule, replay
+from .placement import EvenPlacement, PinnedPlacement, Placement, ReplicaSlice
+from .replica_set import (
+    DEFAULT_ADMISSION,
+    REPLICA_DEAD,
+    REPLICA_DRAINING,
+    REPLICA_LIVE,
+    Replica,
+    ReplicaSet,
+)
+from .router import (
+    ConsistentHashRing,
+    NoReplicaAvailable,
+    POLICY_CONSISTENT_HASH,
+    POLICY_LEAST_LOADED,
+    Router,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "Arrival",
+    "ClassReport",
+    "ConsistentHashRing",
+    "DEFAULT_ADMISSION",
+    "EvenPlacement",
+    "LoadProfile",
+    "NoReplicaAvailable",
+    "POLICY_CONSISTENT_HASH",
+    "POLICY_LEAST_LOADED",
+    "PinnedPlacement",
+    "Placement",
+    "REPLICA_DEAD",
+    "REPLICA_DRAINING",
+    "REPLICA_LIVE",
+    "Replica",
+    "ReplicaSet",
+    "ReplicaSlice",
+    "Router",
+    "SLOClass",
+    "SLO_BATCH",
+    "SLO_BEST_EFFORT",
+    "SLO_INTERACTIVE",
+    "SLO_SHED_ORDER",
+    "TenantMix",
+    "TokenBucket",
+    "build_schedule",
+    "default_slo_classes",
+    "replay",
+]

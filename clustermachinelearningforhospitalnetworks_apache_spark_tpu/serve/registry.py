@@ -62,11 +62,15 @@ class ServingModel:
         metrics: ServingMetrics | None = None,
         dtype=jnp.float32,
         donate: bool | None = None,
+        device=None,
     ):
         self.model = model
         self.buckets = validate_buckets(buckets)
         self.metrics = metrics or ServingMetrics()
         self.dtype = dtype
+        #: replica placement (serve/fleet): executables compile for and run
+        #: on this committed device; None keeps jax's default placement
+        self.device = device
         n = n_features if n_features is not None else model.num_features
         if n is None:
             raise ValueError(
@@ -80,6 +84,12 @@ class ServingModel:
         self._warmed: set[int] = set()
         self._lock = threading.Lock()
 
+    def _put(self, x: np.ndarray) -> jax.Array:
+        """Host batch → device operand; a committed ``device`` pins the
+        executable to the replica's slice of the mesh."""
+        a = jnp.asarray(x)
+        return a if self.device is None else jax.device_put(a, self.device)
+
     # ------------------------------------------------------------ compile
     def warmup(self, buckets: Sequence[int] | None = None) -> "ServingModel":
         """Compile (and execute once) every bucket shape so steady-state
@@ -91,7 +101,7 @@ class ServingModel:
                 self._warmed.add(b)
             self.metrics.record_compile(b, warm=True)
             z = np.zeros((b, self.n_features), dtype=np.dtype(self.dtype))
-            jax.block_until_ready(self._jitted(jnp.asarray(z)))
+            jax.block_until_ready(self._jitted(self._put(z)))
         return self
 
     def jit_cache_size(self) -> int | None:
@@ -128,7 +138,7 @@ class ServingModel:
         if cold:
             log.warning("steady-state compile", bucket=b, n=n)
             self.metrics.record_compile(b, warm=False)
-        out = self._jitted(jnp.asarray(pad_to_bucket(x, b)))
+        out = self._jitted(self._put(pad_to_bucket(x, b)))
         self.metrics.record_batch(n, b)
         return np.asarray(jax.device_get(out))[:n]
 
@@ -165,10 +175,11 @@ class ModelRegistry:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         warmup: bool = False,
         dtype=jnp.float32,
+        device=None,
     ) -> ServingModel:
         sm = ServingModel(
             model, n_features=n_features, buckets=buckets,
-            metrics=self.metrics, dtype=dtype,
+            metrics=self.metrics, dtype=dtype, device=device,
         )
         if warmup:
             sm.warmup()
@@ -187,12 +198,13 @@ class ModelRegistry:
         n_features: int | None = None,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         warmup: bool = False,
+        device=None,
     ) -> ServingModel:
         """``io/model_io.load_model`` + wrap: any family the persistence
         registry knows round-trips straight into serving."""
         return self.register(
             name, load_model(path), n_features=n_features,
-            buckets=buckets, warmup=warmup,
+            buckets=buckets, warmup=warmup, device=device,
         )
 
     def install(self, name: str, sm: ServingModel) -> ServingModel:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np
@@ -34,6 +35,38 @@ from .registry import ModelRegistry, ServingModel
 log = get_logger("serve")
 
 
+class NotRoutableError(TypeError):
+    """A tenant-addressed request named a model that has no tenant
+    routing (``route_request``) — a client/config error (400-shaped),
+    never a server fault.  Carries the model name and family so the
+    shed answer (and logs) can say exactly which registration is wrong.
+
+    Subclasses :class:`TypeError` so pre-existing callers that caught
+    the old duck-typing failure keep working.
+    """
+
+    def __init__(self, model_name: str, family: str):
+        self.model_name = model_name
+        self.family = family
+        super().__init__(
+            f"model {model_name!r} ({family}) is not tenant-routable; "
+            "serve a ModelFarmModel under this name or use predict()"
+        )
+
+
+@dataclass
+class PreparedSwap:
+    """A built-and-warmed successor executable plus its resolved drift
+    profile — everything :meth:`InferenceServer.commit_swap` needs to
+    flip, with nothing left that can fail.  The fleet's atomic promotion
+    prepares one of these per replica BEFORE any replica flips."""
+
+    name: str
+    sm: ServingModel
+    profile: "DataProfile | None"
+    family: str
+
+
 class InferenceServer:
     """Online inference over one or more registered models.
 
@@ -53,8 +86,12 @@ class InferenceServer:
         breaker_failure_threshold: int = 5,
         breaker_recovery_s: float = 5.0,
         ingest_metrics: MetricsRegistry | None = None,
+        device=None,
     ):
         self.registry = registry or ModelRegistry()
+        #: replica placement (serve/fleet): every executable this server
+        #: builds — add_model and swap alike — compiles for this device
+        self.device = device
         self.metrics: ServingMetrics = self.registry.metrics
         self.max_queue_rows = max_queue_rows
         self.max_wait_s = max_wait_s
@@ -183,11 +220,13 @@ class InferenceServer:
             if data_profile is None:
                 data_profile = load_data_profile(model)
             sm = self.registry.load(
-                name, model, n_features=n_features, buckets=buckets
+                name, model, n_features=n_features, buckets=buckets,
+                device=self.device,
             )
         else:
             sm = self.registry.register(
-                name, model, n_features=n_features, buckets=buckets
+                name, model, n_features=n_features, buckets=buckets,
+                device=self.device,
             )
         self._drift_params[name] = (
             drift_threshold, drift_window_rows, drift_trip_after
@@ -244,7 +283,28 @@ class InferenceServer:
         (same distribution — harmless), never new-model traffic against
         the stale one.  Requests in flight on the old executable finish
         on it; nothing is ever refused because of a swap.
+
+        Split into :meth:`prepare_swap` (everything that can fail: load,
+        build, warm) and :meth:`commit_swap` (pure in-memory flips) so
+        the serving fleet can prepare EVERY replica's successor before
+        any replica flips — the all-or-none promotion contract.
         """
+        return self.commit_swap(self.prepare_swap(
+            name, model, n_features=n_features, buckets=buckets,
+            data_profile=data_profile,
+        ))
+
+    def prepare_swap(
+        self,
+        name: str,
+        model: Model | str,
+        n_features: int | None = None,
+        buckets: Sequence[int] | None = None,
+        data_profile: dict | DataProfile | None = None,
+    ) -> PreparedSwap:
+        """Phase 1 of a hot swap: load/build/warm the successor executable
+        and resolve its drift profile.  Raises on any failure; installs
+        nothing — the live model keeps answering untouched."""
         if isinstance(model, str):
             if data_profile is None:
                 data_profile = load_data_profile(model)
@@ -256,7 +316,7 @@ class InferenceServer:
                 buckets = DEFAULT_BUCKETS
         sm = ServingModel(
             model, n_features=n_features, buckets=buckets,
-            metrics=self.metrics,
+            metrics=self.metrics, device=self.device,
         )
         if self._started:
             sm.warmup()
@@ -267,7 +327,7 @@ class InferenceServer:
                 else DataProfile.from_dict(data_profile)
             )
         elif name in self._monitors:
-            # the re-trip hazard this method exists to fix, reintroduced
+            # the re-trip hazard swap_model exists to fix, reintroduced
             # by omission: the new model will be PSI-scored against its
             # predecessor's training profile — say so loudly
             log.warning(
@@ -276,7 +336,25 @@ class InferenceServer:
                 "re-trip the breaker on the new model's own distribution",
                 model=name,
             )
-        fault_point("lifecycle.registry.swap", model=name)
+        return PreparedSwap(
+            name=name, sm=sm, profile=profile,
+            family=type(model).__name__,
+        )
+
+    def commit_swap(
+        self, prepared: PreparedSwap, fire_fault_point: bool = True
+    ) -> ServingModel:
+        """Phase 2 of a hot swap: rebase the drift reference, flip the
+        registry entry and live batcher, reset the breaker — all under
+        one lock, nothing here can fail short of process death.
+
+        ``fire_fault_point=False`` is for the fleet's commit loop: its
+        injectable kill site is ``fleet.swap.commit``, fired ONCE before
+        any replica flips — a per-replica site inside the loop would be
+        a failure point mid-way through an all-or-none commit."""
+        name, sm, profile = prepared.name, prepared.sm, prepared.profile
+        if fire_fault_point:
+            fault_point("lifecycle.registry.swap", model=name)
         with self._swap_lock:
             if profile is not None:
                 mon = self._monitors.get(name)
@@ -303,7 +381,7 @@ class InferenceServer:
                 breaker.reset("model swap")
             self._monitor_width_warned.discard(name)
         log.info(
-            "model hot-swapped", name=name, family=type(model).__name__,
+            "model hot-swapped", name=name, family=prepared.family,
             profile_rebased=profile is not None,
         )
         return sm
@@ -444,6 +522,19 @@ class InferenceServer:
                                           wait_timeout_s)
         return result
 
+    def route_tenant(self, name: str, tenant_id: str, x: np.ndarray) -> np.ndarray:
+        """tenant id + features → the in-band routed request for ``name``.
+        Raises :class:`NotRoutableError` (carrying the model name) when
+        the registered model has no tenant routing — the typed form of
+        what used to be a bare duck-typing ``TypeError``."""
+        sm = self.registry.get(name)
+        route = getattr(sm.model, "route_request", None)
+        if route is None:
+            raise NotRoutableError(name, type(sm.model).__name__)
+        return route(
+            tenant_id, np.atleast_2d(np.asarray(x, dtype=np.float64))
+        )
+
     def predict_tenant(
         self, name: str, tenant_id: str, x: np.ndarray,
         deadline_s: float | None = None, wait_timeout_s: float | None = 30.0,
@@ -454,18 +545,19 @@ class InferenceServer:
         the request's leading column so the standard bucket ladder +
         on-device gather answer it — zero steady-state recompiles across
         tenants and batch sizes, one executable set for the whole fleet.
+
+        A tenant request against a NON-farm model is a malformed request,
+        not a server fault: it answers ``invalid_input`` (the 400 lane —
+        no fallback, no breaker count), never a 500-equivalent.  Use
+        :meth:`route_tenant` directly to get the typed
+        :class:`NotRoutableError` instead of a shed answer.
         """
-        sm = self.registry.get(name)
-        route = getattr(sm.model, "route_request", None)
-        if route is None:
-            raise TypeError(
-                f"model {name!r} ({type(sm.model).__name__}) is not "
-                "tenant-routable; serve a ModelFarmModel under this name "
-                "or use predict()"
-            )
-        xt = route(
-            tenant_id, np.atleast_2d(np.asarray(x, dtype=np.float64))
-        )
+        try:
+            xt = self.route_tenant(name, tenant_id, x)
+        except NotRoutableError as e:
+            self.metrics.record_request(0.0, STATUS_INVALID_INPUT)
+            self.metrics.registry.inc("serve.not_routable")
+            return ServeResult(None, STATUS_INVALID_INPUT, detail=str(e))
         return self.predict(
             name, xt, deadline_s=deadline_s, wait_timeout_s=wait_timeout_s
         )

@@ -105,6 +105,11 @@ def pytest_configure(config):
         "farm: model-farm tests — vmapped per-tenant fits, looped-baseline "
         "bit-parity, tenant routing, drifted-subset refit (pytest -m farm)",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: serving-fleet tests — placement, tenant routing, SLO "
+        "admission, atomic promotion, replica chaos (pytest -m fleet)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

@@ -1,0 +1,666 @@
+"""serve/fleet — placement, routing, admission, atomic promotion, chaos.
+
+The subsystem's contracts (ISSUE 12):
+
+1. placement is explicit and real — each replica's executables are
+   committed to its assigned device slice, not wherever jax defaults;
+2. router invariants — consistent-hash reshuffle stays ≤ 1/N on a
+   single add/remove, a breaker-OPEN replica is never picked, tenant
+   stickiness survives a fleet-wide hot swap;
+3. admission — per-tenant quotas shed the noisy hospital only, and the
+   SLO ladder sheds best_effort before batch before interactive;
+4. promotion is atomic fleet-wide — a failure while ANY replica
+   prepares leaves EVERY replica on the old model;
+5. a replica killed mid-load answers or cleanly sheds every in-flight
+   request (zero unhandled) and the router reroutes around it;
+6. fleet health() merges replica snapshots through the obs registry
+   pull-collector path with a PINNED key set.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+    STATUS_INVALID_INPUT,
+    STATUS_REJECTED,
+    NotRoutableError,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+    fleet as F,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import (
+    faults,
+)
+
+pytestmark = [pytest.mark.fast, pytest.mark.fleet]
+
+D = 4
+BUCKETS = (1, 8)
+
+
+@pytest.fixture
+def xy(rng):
+    n = 128
+    x = rng.normal(size=(n, D)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5, 0.25], np.float32) + 0.3).astype(
+        np.float32
+    )
+    return x, y
+
+
+@pytest.fixture
+def model(xy):
+    return ht.LinearRegression().fit(xy)
+
+
+def make_fleet(model, n=3, **kw):
+    kw.setdefault("max_queue_rows", 256)
+    fs = F.ReplicaSet(n_replicas=n, **kw)
+    fs.add_model("los", model, buckets=BUCKETS)
+    return fs
+
+
+# =========================================================================
+# placement
+# =========================================================================
+
+
+class _Dev:
+    def __init__(self, i):
+        self.id = i
+
+    def __repr__(self):
+        return f"dev{self.id}"
+
+
+def test_even_placement_splits_contiguously():
+    devs = [_Dev(i) for i in range(8)]
+    slices = F.EvenPlacement().assign(4, devs)
+    assert [s.replica_id for s in slices] == [0, 1, 2, 3]
+    assert [len(s.devices) for s in slices] == [2, 2, 2, 2]
+    flat = [d for s in slices for d in s.devices]
+    assert flat == devs  # full coverage, no overlap, order preserved
+    assert slices[0].primary is devs[0]
+    # remainder spreads over the first replicas
+    slices = F.EvenPlacement().assign(3, devs)
+    assert [len(s.devices) for s in slices] == [3, 3, 2]
+
+
+def test_even_placement_oversubscribes_round_robin():
+    devs = [_Dev(i) for i in range(2)]
+    slices = F.EvenPlacement().assign(5, devs)
+    assert [s.primary.id for s in slices] == [0, 1, 0, 1, 0]
+
+
+def test_pinned_placement_validates():
+    devs = [_Dev(i) for i in range(4)]
+    slices = F.PinnedPlacement({0: (3, 2), 1: (0,), 2: (1,)}).assign(3, devs)
+    assert slices[0].primary.id == 3 and len(slices[0].devices) == 2
+    with pytest.raises(ValueError, match="missing replicas"):
+        F.PinnedPlacement({0: (0,)}).assign(2, devs)
+    with pytest.raises(ValueError, match="pinned to both"):
+        F.PinnedPlacement({0: (0, 1), 1: (1,)}).assign(2, devs)
+    with pytest.raises(ValueError, match="outside"):
+        F.PinnedPlacement({0: (9,)}).assign(1, devs)
+
+
+def test_replicas_pinned_to_distinct_devices(model):
+    """Placement is real: each replica's ServingModel is committed to its
+    slice's primary device, and its executable output lands THERE."""
+    import jax
+
+    fs = make_fleet(model, n=4)
+    primaries = [r.slice.primary for r in fs.replicas]
+    assert len(set(primaries)) == 4  # distinct devices on the 8-dev mesh
+    with fs:
+        for r in fs.replicas:
+            sm = r.server.registry.get("los")
+            assert sm.device is r.slice.primary
+            out = sm._jitted(sm._put(np.zeros((1, D), np.float32)))
+            assert next(iter(out.devices())) is r.slice.primary
+            jax.block_until_ready(out)
+
+
+# =========================================================================
+# router invariants
+# =========================================================================
+
+
+def _owners(ring, keys):
+    return {k: ring.owner(k) for k in keys}
+
+
+def test_consistent_hash_reshuffle_bounded():
+    """Single replica add/remove moves ≤ 1/N of the tenant space, and
+    ONLY keys owned by the changed replica move (everyone else's warm
+    slice is untouched).  Deterministic — the ring has no RNG."""
+    keys = [f"hospital-{i}" for i in range(2000)]
+    ring = F.ConsistentHashRing(vnodes=160)
+    for rid in range(4):
+        ring.add(rid)
+    before = _owners(ring, keys)
+    ring.add(4)  # 4 -> 5
+    after = _owners(ring, keys)
+    moved = [k for k in keys if before[k] != after[k]]
+    assert len(moved) / len(keys) <= 1 / 4
+    assert all(after[k] == 4 for k in moved)  # moves only ONTO the new one
+    ring.remove(4)  # 5 -> 4: exactly the new replica's keys move back
+    restored = _owners(ring, keys)
+    assert restored == before
+    moved_back = [k for k in keys if after[k] != restored[k]]
+    assert len(moved_back) / len(keys) <= 1 / 4
+    assert all(after[k] == 4 for k in moved_back)
+
+
+class _StubReplica:
+    def __init__(self, index, load=0, healthy=True, open_models=()):
+        self.index = index
+        self._load = load
+        self._healthy = healthy
+        self._open = set(open_models)
+
+    def healthy(self):
+        return self._healthy
+
+    def load_rows(self):
+        return self._load
+
+    def breaker_open(self, model):
+        return model in self._open
+
+
+def test_least_loaded_picks_min_and_skips_open_breaker():
+    reps = [
+        _StubReplica(0, load=50),
+        _StubReplica(1, load=5),
+        _StubReplica(2, load=20),
+    ]
+    router = F.Router(reps, policy=F.POLICY_LEAST_LOADED)
+    assert router.route(model="m").index == 1
+    reps[1]._open.add("m")
+    for _ in range(50):
+        assert router.route(model="m").index != 1
+    # a different model's breaker state is independent
+    assert router.route(model="other").index == 1
+
+
+def test_router_skips_unhealthy_and_raises_when_none_left():
+    reps = [_StubReplica(0), _StubReplica(1)]
+    router = F.Router(reps, policy=F.POLICY_CONSISTENT_HASH)
+    reps[0]._healthy = False
+    for t in ("a", "b", "c", "d"):
+        assert router.route(tenant_id=t).index == 1
+    reps[1]._healthy = False
+    with pytest.raises(F.NoReplicaAvailable):
+        router.route(tenant_id="a")
+
+
+def test_sticky_failover_returns_home():
+    """A dead replica's tenants land on their ring successor (the SAME
+    one every time), and return to the home replica when it revives."""
+    reps = [_StubReplica(i) for i in range(4)]
+    router = F.Router(reps, policy=F.POLICY_CONSISTENT_HASH)
+    tenants = [f"t{i}" for i in range(200)]
+    home = {t: router.route(tenant_id=t).index for t in tenants}
+    victims = [t for t in tenants if home[t] == 2]
+    assert victims  # hash spreads over 4 replicas
+    reps[2]._healthy = False
+    over = {t: router.route(tenant_id=t).index for t in tenants}
+    for t in tenants:
+        if home[t] != 2:
+            assert over[t] == home[t]  # unaffected tenants do not move
+    assert all(over[t] != 2 for t in victims)
+    # failover is deterministic: same successor on a second ask
+    again = {t: router.route(tenant_id=t).index for t in victims}
+    assert again == {t: over[t] for t in victims}
+    reps[2]._healthy = True
+    assert {t: router.route(tenant_id=t).index for t in tenants} == home
+
+
+def test_sticky_affinity_survives_fleet_swap(model, xy):
+    x, y = xy
+    fs = make_fleet(model, n=3)
+    with fs:
+        tenants = [f"H{i:03d}" for i in range(40)]
+        before = {
+            t: fs.router.route(tenant_id=t, model="los").index
+            for t in tenants
+        }
+        successor = ht.LinearRegression(reg_param=0.7).fit((x, y))
+        fs.swap_model("los", successor)
+        after = {
+            t: fs.router.route(tenant_id=t, model="los").index
+            for t in tenants
+        }
+        assert after == before
+        # and the swap really changed the served model everywhere
+        for r in fs.replicas:
+            assert r.server.registry.get("los").model is successor
+
+
+# =========================================================================
+# admission: quotas + SLO ladder
+# =========================================================================
+
+
+def test_token_bucket_refills_on_injected_clock():
+    now = [0.0]
+    b = F.TokenBucket(rate=100.0, burst=50.0, clock=lambda: now[0])
+    assert b.take(50)
+    assert not b.take(1)
+    now[0] += 0.25  # refill 25 rows
+    assert b.take(25)
+    assert not b.take(1)
+
+
+def test_admission_ladder_orders_sheds_by_class():
+    ctl = F.AdmissionController()
+    for load, expect in (
+        (0.10, {"best_effort": True, "batch": True, "interactive": True}),
+        (0.30, {"best_effort": False, "batch": True, "interactive": True}),
+        (0.60, {"best_effort": False, "batch": False, "interactive": True}),
+        (1.00, {"best_effort": False, "batch": False, "interactive": False}),
+    ):
+        for slo, admitted in expect.items():
+            d = ctl.admit("t", slo, 8, load)
+            assert d.admitted == admitted, (load, slo)
+            if not d.admitted:
+                assert d.reason == f"slo_load:{slo}"
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        ctl.admit("t", "platinum", 1, 0.0)
+
+
+def test_quota_sheds_only_the_noisy_tenant(model):
+    now = [0.0]
+    ctl = F.AdmissionController(
+        tenant_quotas={"noisy": (100.0, 16.0)}, clock=lambda: now[0]
+    )
+    fs = make_fleet(model, n=2, admission=ctl)
+    with fs:
+        ok_noisy = shed_noisy = 0
+        for _ in range(8):
+            r = fs.predict("los", np.zeros((8, D), np.float32),
+                           tenant_id="noisy")
+            if r.status == STATUS_REJECTED:
+                shed_noisy += 1
+                assert "quota:noisy" in r.detail
+            else:
+                ok_noisy += 1
+        # burst 16 rows admits the first two 8-row requests, sheds the rest
+        assert ok_noisy == 2 and shed_noisy == 6
+        # the quiet hospital is untouched by its neighbor's flood
+        for _ in range(8):
+            assert fs.predict(
+                "los", np.zeros((8, D), np.float32), tenant_id="quiet"
+            ).ok
+        h = fs.health()
+        assert h["shed_quota"] == 6
+        assert h["shed"]["interactive"] == 6
+
+
+def test_unknown_slo_rejected_before_counting(model):
+    """Caller-supplied SLO strings are metric labels AND intern keys:
+    garbage is refused up front, with no counter minted for it — in
+    both admission modes."""
+    for admission in (F.DEFAULT_ADMISSION, None):
+        fs = make_fleet(model, n=1, admission=admission)
+        with fs:
+            with pytest.raises(ValueError, match="unknown SLO class"):
+                fs.predict("los", np.zeros((1, D), np.float32),
+                           slo="platinum")
+        assert "platinum" not in str(fs.metrics.counters)
+        assert fs.metrics.counters.get("fleet.requests", 0) == 0
+
+
+def test_latency_histogram_excludes_shed_answers(model):
+    """Sheds answer in ~0 s; folding them into the per-class latency
+    histogram would make p99 read healthiest during an outage — only
+    OK answers are observed."""
+    ctl = F.AdmissionController(tenant_quotas={"t": (1.0, 8.0)})
+    fs = make_fleet(model, n=1, admission=ctl)
+    with fs:
+        assert fs.predict("los", np.zeros((8, D), np.float32),
+                          tenant_id="t").ok
+        for _ in range(3):  # bucket drained: these shed at the door
+            assert not fs.predict("los", np.zeros((8, D), np.float32),
+                                  tenant_id="t").ok
+        h = fs.metrics.histograms['fleet.latency_seconds{slo="interactive"}']
+        assert h.count == 1  # the one OK answer; zero shed samples
+
+
+# =========================================================================
+# atomic fleet-wide promotion
+# =========================================================================
+
+
+def test_swap_flips_every_replica_or_none(model, xy):
+    x, y = xy
+    probe = x[:4]
+    old_pred = np.asarray(model.predict(probe))
+    successor = ht.LinearRegression(reg_param=2.0).fit((x, y))
+    new_pred = np.asarray(successor.predict(probe))
+    assert not np.allclose(old_pred, new_pred)
+
+    fs = make_fleet(model, n=3)
+    with fs:
+        # phase-1 failure on the LAST replica's prepare: replicas 0 and 1
+        # already prepared successfully — none may flip
+        plan = faults.FaultPlan().fail(
+            "fleet.swap.prepare", after=2,
+            error=lambda: RuntimeError("injected prepare failure"),
+        )
+        faults.install(plan)
+        try:
+            with pytest.raises(RuntimeError, match="injected"):
+                fs.swap_model("los", successor)
+        finally:
+            faults.clear()
+        for r in fs.replicas:  # all-or-none: everyone still on the old model
+            assert r.server.registry.get("los").model is model
+            np.testing.assert_allclose(
+                r.server.predict("los", probe).value, old_pred, rtol=1e-5
+            )
+        # clean swap: every replica flips
+        fs.swap_model("los", successor)
+        for r in fs.replicas:
+            np.testing.assert_allclose(
+                r.server.predict("los", probe).value, new_pred, rtol=1e-5
+            )
+        assert fs.health()["promotions"] == 1
+
+
+def test_swap_resets_breakers_fleet_wide(model, xy):
+    """The promotion contract a lifecycle PROMOTED transition relies on:
+    commit resets every replica's breaker (opens accumulated against the
+    predecessor say nothing about the successor)."""
+    fs = make_fleet(model, n=2)
+    with fs:
+        for r in fs.replicas:
+            r.server._breaker_for("los").trip("test drift")
+            assert r.breaker_open("los")
+        fs.swap_model("los", model)
+        for r in fs.replicas:
+            assert not r.breaker_open("los")
+
+
+def test_fleet_exposes_the_lifecycle_controller_surface(model):
+    """lifecycle/controller.py drives promotion through server.swap_model
+    / add_model / registry.names() / attach_lifecycle — the fleet serves
+    the same surface, so a controller promotes all replicas atomically
+    without knowing it holds a fleet."""
+    fs = make_fleet(model, n=2)
+    assert fs.registry.names() == ["los"]
+    for attr in ("add_model", "swap_model", "attach_lifecycle"):
+        assert callable(getattr(fs, attr))
+    sentinel = object()
+    fs.attach_lifecycle(sentinel)
+    for r in fs.replicas:
+        assert r.server._lifecycle is sentinel
+
+
+# =========================================================================
+# fleet health through the collector path
+# =========================================================================
+
+#: the pinned fleet-health schema (PR 8 discipline): a key added or
+#: renamed without updating this pin is a deliberate decision, not drift
+HEALTH_KEYS = {
+    "status", "started", "replicas", "models_serving", "requests",
+    "served_requests", "shed", "shed_quota", "shed_load", "no_replica",
+    "rerouted", "promotions", "replicas_killed", "fallback_answers",
+    "drift_trips", "queue_rows_total", "load_factor",
+}
+
+REPLICA_KEYS = {"state", "queue_rows", "breakers"}
+
+
+def test_health_key_set_pinned_and_merged_via_collectors(model):
+    fs = make_fleet(model, n=2)
+    with fs:
+        for _ in range(3):
+            assert fs.predict("los", np.zeros((4, D), np.float32)).ok
+        fs.replicas[1].server._breaker_for("los").trip("drifted")
+        h = fs.health()
+    assert set(h) == HEALTH_KEYS
+    assert set(h["replicas"]) == {"r00", "r01"}
+    for rep in h["replicas"].values():
+        assert set(rep) == REPLICA_KEYS
+    # merged THROUGH the registry collectors: per-replica serve counters
+    # summed into the fleet total, breaker state decoded from the gauge
+    assert h["served_requests"] >= 3
+    assert h["replicas"]["r01"]["breakers"]["los"] == "open"
+    assert h["status"] == "degraded"
+    assert h["requests"] == 3
+    # the raw collect() carries the per-replica labeled series themselves
+    snap = fs.stats()
+    assert 'fleet.replica_state{replica="r00"}' in snap["gauges"]
+    assert (
+        'fleet.breaker_state{model="los",replica="r01"}' in snap["gauges"]
+    )
+
+
+def test_replica_label_is_bounded():
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.registry import (
+        replica_label,
+    )
+
+    assert replica_label(0) == "r00"
+    assert replica_label(31) == "r31"
+    with pytest.raises(ValueError):
+        replica_label(-1)
+    with pytest.raises(ValueError):
+        replica_label(100000)
+
+
+# =========================================================================
+# load generator
+# =========================================================================
+
+
+def _profile(**kw):
+    kw.setdefault("base_rate_rps", 200.0)
+    kw.setdefault("tenants", (
+        F.TenantMix("A", 2.0, "interactive", 2),
+        F.TenantMix("B", 1.0, "batch", 4),
+        F.TenantMix("C", 1.0, "best_effort", 8),
+    ))
+    return F.LoadProfile(**kw)
+
+
+def test_schedule_is_replayable_bit_for_bit():
+    p = _profile(seed=7, diurnal_amplitude=0.4, diurnal_period_s=2.0,
+                 burst_start_s=0.5, burst_dur_s=0.25, burst_mult=2.0)
+    s1 = F.build_schedule(p, 2.0)
+    s2 = F.build_schedule(p, 2.0)
+    assert s1 == s2
+    assert s1 != F.build_schedule(_profile(seed=8), 2.0)
+    assert all(0.0 <= a.t < 2.0 for a in s1)
+    assert {a.tenant_id for a in s1} == {"A", "B", "C"}
+
+
+def test_burst_and_diurnal_shape_the_rate():
+    p = _profile(seed=3, base_rate_rps=400.0,
+                 burst_start_s=1.0, burst_dur_s=0.5, burst_mult=3.0)
+    s = F.build_schedule(p, 2.0)
+    in_burst = sum(1 for a in s if 1.0 <= a.t < 1.5)
+    before = sum(1 for a in s if 0.5 <= a.t < 1.0)
+    assert in_burst > 2.0 * before  # 3x nominal, noisy Poisson slack
+    assert p.rate_at(1.2) == pytest.approx(1200.0)
+    assert p.rate_at(0.2) == pytest.approx(400.0)
+
+
+def test_replay_answers_everything_and_tallies_by_class(model):
+    fs = make_fleet(model, n=2)
+    sched = F.build_schedule(_profile(seed=1, base_rate_rps=300.0), 1.0)
+    with fs:
+        rep = F.replay(
+            lambda a: fs.submit("los", np.zeros((a.rows, D), np.float32),
+                                tenant_id=a.tenant_id, slo=a.slo),
+            sched, speed=2.0,
+        )
+    assert rep["unanswered"] == 0
+    assert rep["offered_requests"] == len(sched)
+    total = sum(
+        c["ok_rows"] + c["shed_rows"] + c["deadline_rows"] + c["other_rows"]
+        for c in rep["per_class"].values()
+    )
+    assert total == rep["offered_rows"]  # every row accounted for
+    assert set(rep["per_class"]) <= set(F.SLO_SHED_ORDER)
+    r = rep["reports"]["interactive"]
+    assert r.in_slo(10.0)["rows"] <= r.ok_rows
+
+
+# =========================================================================
+# chaos: replica death mid-load
+# =========================================================================
+
+
+@pytest.mark.chaos
+def test_replica_kill_mid_load_zero_unhandled(model):
+    """Kill a replica while the fleet is under open-loop load: every
+    in-flight request is answered or cleanly shed (zero unhandled, zero
+    stranded waits), the router reroutes around the corpse, and traffic
+    AFTER the kill is served by the survivors."""
+    fs = make_fleet(model, n=3, max_queue_rows=512)
+    sched = F.build_schedule(_profile(seed=5, base_rate_rps=400.0), 1.5)
+    victim = 1
+    killed = threading.Event()
+
+    def kill():
+        fs.kill_replica(victim)
+        killed.set()
+
+    with fs:
+        rep = F.replay(
+            lambda a: fs.submit("los", np.zeros((a.rows, D), np.float32),
+                                tenant_id=a.tenant_id, slo=a.slo),
+            sched, speed=1.5, mid_hook=kill,
+        )
+        assert killed.is_set()
+        # post-kill, the fleet still answers (survivors took the tenants)
+        for t in ("A", "B", "C", "D", "E"):
+            res = fs.predict("los", np.zeros((2, D), np.float32), tenant_id=t)
+            assert res.ok, res.status
+        h = fs.health()
+    assert rep["unanswered"] == 0  # nobody stranded: answered or shed
+    assert h["replicas"]["r01"]["state"] == "dead"
+    assert h["replicas_killed"] == 1
+    assert h["status"] == "degraded"
+    # the schedule kept being served: ok rows on both sides of the kill
+    assert rep["ok_rows"] > 0
+
+
+@pytest.mark.chaos
+def test_drain_replica_answers_everything_then_stops(model):
+    fs = make_fleet(model, n=2)
+    with fs:
+        reqs = [
+            fs.submit("los", np.zeros((2, D), np.float32), tenant_id=f"t{i}")
+            for i in range(20)
+        ]
+        assert fs.drain_replica(0, timeout_s=5.0)
+        for req in reqs:
+            res = req.wait(5.0)
+            assert res.status in ("ok", "shutdown", "rejected")
+        assert fs.replicas[0].state == "dead"
+        # survivors keep serving
+        assert fs.predict("los", np.zeros((2, D), np.float32)).ok
+
+
+# =========================================================================
+# predict_tenant / NotRoutableError (ISSUE 12 satellite)
+# =========================================================================
+
+
+def test_not_routable_is_typed_and_answers_invalid_input(model):
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+    )
+
+    with InferenceServer() as srv:
+        srv.add_model("plain", model, buckets=BUCKETS)
+        # path 1: the typed error from the routing primitive
+        with pytest.raises(NotRoutableError) as ei:
+            srv.route_tenant("plain", "H001", np.zeros((2, D), np.float32))
+        assert ei.value.model_name == "plain"
+        assert isinstance(ei.value, TypeError)  # legacy catch keeps working
+        # path 2: the serving surface answers a 400, never a 500
+        res = srv.predict_tenant("plain", "H001", np.zeros((2, D), np.float32))
+        assert res.status == STATUS_INVALID_INPUT
+        assert not res.ok and not res.degraded
+        assert "plain" in res.detail
+        c = srv.metrics.registry.counters
+        assert c.get("serve.not_routable", 0) == 1
+        assert c.get("serve.status.invalid_input", 0) == 1
+        # the breaker never saw it: a client error is not a model failure
+        assert c.get("serve.primary_failures", 0) == 0
+
+
+def test_fleet_predict_tenant_not_routable(model):
+    fs = make_fleet(model, n=2)
+    with fs:
+        res = fs.predict_tenant("los", "H001", np.zeros((2, D), np.float32))
+        assert res.status == STATUS_INVALID_INPUT
+
+
+def test_fleet_predict_tenant_routes_farm_sticky(rng):
+    """Farm + fleet: the SAME tenant key drives the consistent-hash
+    replica choice and the in-band slice gather — int and str forms of a
+    tenant id land identically (farm.affinity_key normalization)."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm import (
+        FarmLinearRegression,
+    )
+
+    data = {
+        str(t): (
+            rng.normal(size=(12, D)).astype(np.float32),
+            rng.normal(size=(12,)).astype(np.float32),
+        )
+        for t in range(6)
+    }
+    farm = FarmLinearRegression().fit(data)
+    fs = F.ReplicaSet(n_replicas=2, max_queue_rows=256)
+    fs.add_model("farm", farm, buckets=BUCKETS)
+    with fs:
+        x = data["3"][0][:2]
+        res = fs.predict_tenant("farm", 3, x)
+        assert res.ok
+        np.testing.assert_allclose(
+            res.value, farm.predict_tenant("3", x), atol=1e-5
+        )
+        assert farm.affinity_key(3) == farm.affinity_key("3")
+
+
+# =========================================================================
+# SLO-ordered degradation under real saturation (small-scale)
+# =========================================================================
+
+
+def test_best_effort_sheds_before_interactive_under_load(model):
+    """With the routed replica's queue half full, a best_effort (and
+    batch) request sheds at the door while an interactive request is
+    still admitted and answered — degradation ordered by class, not
+    arrival.  The queue depth is pinned by overriding the replica's
+    load accessor, so the ladder decision itself is what's under test."""
+    fs = F.ReplicaSet(n_replicas=1, max_queue_rows=64)
+    fs.add_model("los", model, buckets=BUCKETS)
+    with fs:
+        fs.replicas[0].load_rows = lambda: 32  # load factor 0.5, pinned
+        be = fs.predict("los", np.zeros((1, D), np.float32),
+                        tenant_id="t", slo="best_effort")
+        assert be.status == STATUS_REJECTED
+        assert "slo_load:best_effort" in be.detail
+        # 0.5 ≥ the 0.45 batch threshold: batch sheds here too
+        batch = fs.predict("los", np.zeros((1, D), np.float32),
+                           tenant_id="t", slo="batch")
+        assert batch.status == STATUS_REJECTED
+        inter = fs.predict("los", np.zeros((1, D), np.float32),
+                           tenant_id="t", slo="interactive")
+        assert inter.ok  # admitted AND answered
+        h = fs.health()
+        assert h["shed"]["best_effort"] == 1
+        assert h["shed"]["batch"] == 1
+        assert h["shed"]["interactive"] == 0

@@ -422,8 +422,18 @@ def test_server_routes_tenant_and_unknown_falls_back(linear_farm, fleet):
                 (np.asarray(fleet[tid][0]), np.asarray(fleet[tid][1]))
             ),
         )
-        with pytest.raises(TypeError, match="not tenant-routable"):
-            srv.predict_tenant("plain", tid, x)
+        # ISSUE 12: a tenant request against a non-farm model is a 400
+        # (invalid_input answer), not an exception from the serving
+        # surface; the typed NotRoutableError lives on route_tenant
+        res_nr = srv.predict_tenant("plain", tid, x)
+        assert res_nr.status == "invalid_input"
+        assert "plain" in res_nr.detail
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+            NotRoutableError,
+        )
+
+        with pytest.raises(NotRoutableError, match="not tenant-routable"):
+            srv.route_tenant("plain", tid, x)
 
 
 # ============================================================ drift + refit

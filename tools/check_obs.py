@@ -198,6 +198,31 @@ def main() -> int:
                 "tenant id — use obs.registry.cohort_label (bounded "
                 "cardinality) instead"
             )
+    # 6. serving-fleet instrumentation (ISSUE 12): the front door, the
+    # routing decision, and the atomic promotion must stay spanned — a
+    # routed request's trace (fleet.request ⊃ router.route ⊃
+    # serve.request) is the bench's route evidence — and every
+    # ``replica=``-labeled metric must mint its value through
+    # obs.registry.replica_label (bounded + format-pinned), the same
+    # write-side discipline the PR 9 cohort guard gives tenant labels.
+    for required in ("fleet.request", "fleet.promote", "router.route"):
+        if required not in emitted:
+            problems.append(
+                f"fleet span {required!r} is not emitted — the serving "
+                "fleet has drifted from its instrumentation"
+            )
+    # matches a replica label VALUE being written in any position —
+    # first label, after a comma, or on its own f-string line
+    replica_label_re = re.compile(r'replica="')
+    for path in pkg_files:
+        rel = os.path.relpath(path, ROOT)
+        for lineno, line in enumerate(open(path), 1):
+            if replica_label_re.search(line) and "replica_label(" not in line:
+                problems.append(
+                    f"{rel}:{lineno}: metric labeled replica= without "
+                    "obs.registry.replica_label — raw replica ids bypass "
+                    "the cardinality/format guard"
+                )
 
     if problems:
         print("check_obs: INSTRUMENTATION DRIFT")

@@ -25,7 +25,10 @@
 # uninterrupted run, plus feedback-spool exactly-once under kills),
 # and the model farm's checkpointed fleet fit (tests/test_model_farm.py
 # kills a 12-hospital FarmKMeans fit at fit_ckpt.save.commit and
-# asserts the resumed farm's centers are bit-identical per tenant).
+# asserts the resumed farm's centers are bit-identical per tenant),
+# and the serving fleet (tests/test_fleet.py kills a replica under
+# open-loop load — every in-flight request answered or cleanly shed,
+# zero unhandled, router reroutes — and drains one gracefully).
 #
 # ISSUE 10: every InjectedCrash dumps the observability flight recorder
 # (bounded event ring + metrics snapshot, CRC32C-wrapped, atomic write).
@@ -48,7 +51,7 @@ export CMLHN_FLIGHT_DIR=$(mktemp -d /tmp/chaos_flight.XXXXXX)
 LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
     tests/test_stream_pipeline.py tests/test_gbt_fused.py \
-    tests/test_lifecycle.py tests/test_model_farm.py \
+    tests/test_lifecycle.py tests/test_model_farm.py tests/test_fleet.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -63,7 +66,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet)\.py::(\S+)",
         line,
     )
     if not m:
