@@ -421,6 +421,9 @@ def _build_rowlevel(sig: tuple, bucket: int):
                 comp.append(win_vals[o[4]])
         return keep, tuple(comp)
 
+    # Built only through _get_kernel's _KERNELS LRU memo keyed
+    # (plan sig, dtypes, bucket): one build per key.
+    # cmlhn: disable=jit-in-function — memoized by _get_kernel/_KERNELS
     return jax.jit(kernel)
 
 
@@ -498,6 +501,9 @@ def _build_aggregate(sig: tuple, bucket: int):
                 )
         return n_groups, tuple(outs)
 
+    # Built only through _get_kernel's _KERNELS LRU memo keyed
+    # (plan sig, dtypes, bucket): one build per key.
+    # cmlhn: disable=jit-in-function — memoized by _get_kernel/_KERNELS
     return jax.jit(kernel)
 
 
@@ -618,6 +624,9 @@ class DeviceView:
                     y = jnp.where(w, lab.astype(jnp.float32), 0.0)
                 return x, y, w.astype(jnp.float32)
 
+            # build() runs only on a _KERNELS memo miss (_get_kernel):
+            # one build per key.
+            # cmlhn: disable=jit-in-function — memoized by _get_kernel/_KERNELS
             return jax.jit(kernel)
 
         fn = _get_kernel("assemble", sig, self.bucket, build)
@@ -661,6 +670,9 @@ def compact_dataset(x, y, w, out_bucket: int):
                 jnp.where(tail, w[perm], 0.0),
             )
 
+        # build() runs only on a _KERNELS memo miss (_get_kernel): one
+        # build per key.
+        # cmlhn: disable=jit-in-function — memoized by _get_kernel/_KERNELS
         return jax.jit(kernel)
 
     fn = _get_kernel("compact", sig, out_bucket, build)

@@ -21,7 +21,7 @@ Spark's treeAggregate of hand-derived per-row gradients with
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,38 @@ def _fit_aft(x, logy, censor, w, max_iter: int, fit_intercept: bool, tol=1e-6):
 
     theta0 = jnp.zeros((d + (2 if fit_intercept else 1),), jnp.float32)
     return lbfgs_minimize(loss_fn, theta0, max_iter, tol)
+
+
+@lru_cache(maxsize=32)
+def _make_block_step(d: int, fit_intercept: bool):
+    """One jitted out-of-core Adam step per (d, fit_intercept), cached so
+    repeated fits (CV folds, lifecycle warm retrains) reuse the traced
+    executable — an inline per-fit ``@jax.jit`` closure recompiled every
+    fit (ISSUE 13 ``jit-in-function``; the PR 5 retrace-per-fit class)."""
+    import optax
+
+    opt = optax.adam(1e-2)
+
+    @jax.jit
+    def block_step(theta, state, x, logy, cen, w):
+        wsum = jnp.maximum(jnp.sum(w), 1.0)
+
+        def loss_fn(t):
+            beta = t[:d]
+            b = t[d] if fit_intercept else 0.0
+            log_sigma = t[-1]
+            sigma = jnp.exp(log_sigma)
+            z = (logy - x @ beta - b) / sigma
+            ez = jnp.exp(z)
+            ll = jnp.where(cen > 0, -log_sigma + z - ez, -ez)
+            return -jnp.sum(ll * w) / wsum
+
+        l, grads = jax.value_and_grad(loss_fn)(theta)
+        updates, state_new = opt.update(grads, state)
+        return optax.apply_updates(theta, updates), state_new, l
+
+    return block_step
+
 
 
 @register_model("AFTSurvivalRegressionModel")
@@ -216,25 +248,7 @@ class AFTSurvivalRegression(Estimator):
         theta = jnp.zeros((d + (2 if self.fit_intercept else 1),), jnp.float32)
         opt = optax.adam(1e-2)
         state = opt.init(theta)
-        fit_intercept = self.fit_intercept
-
-        @jax.jit
-        def block_step(theta, state, x, logy, cen, w):
-            wsum = jnp.maximum(jnp.sum(w), 1.0)
-
-            def loss_fn(t):
-                beta = t[:d]
-                b = t[d] if fit_intercept else 0.0
-                log_sigma = t[-1]
-                sigma = jnp.exp(log_sigma)
-                z = (logy - x @ beta - b) / sigma
-                ez = jnp.exp(z)
-                ll = jnp.where(cen > 0, -log_sigma + z - ez, -ez)
-                return -jnp.sum(ll * w) / wsum
-
-            l, grads = jax.value_and_grad(loss_fn)(theta)
-            updates, state_new = opt.update(grads, state)
-            return optax.apply_updates(theta, updates), state_new, l
+        block_step = _make_block_step(d, self.fit_intercept)
 
         n_blocks, b = hd.block_shape(mesh)
         shuffle = np.random.default_rng(1)

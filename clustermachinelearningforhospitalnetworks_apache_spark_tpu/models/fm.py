@@ -17,7 +17,7 @@ L2 ``reg_param`` on w and V (intercept unpenalized, the house rule).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -65,6 +65,40 @@ def _fit_fm(w0, w, v, x, y, wt, reg, step_size, max_iter: int, loss: str):
         step, ((w0, w, v), state), None, length=max_iter
     )
     return params, losses
+
+
+@lru_cache(maxsize=32)
+def _make_block_step(loss: str, step_size: float, reg_param: float):
+    """One jitted out-of-core Adam step per (loss, step_size, reg) —
+    cached so repeated fits reuse the traced executable instead of
+    rebuilding a per-fit ``@jax.jit`` closure (ISSUE 13
+    ``jit-in-function``; the PR 5 retrace-per-fit class)."""
+    import optax
+
+    opt = optax.adam(step_size)
+    reg = jnp.float32(reg_param)
+
+    @jax.jit
+    def block_step(params, state, x, y, wt):
+        wsum = jnp.maximum(jnp.sum(wt), 1.0)
+
+        def loss_fn(p):
+            w0_, w_, v_ = p
+            raw = _fm_raw(w0_, w_, v_, x)
+            if loss == "squared":
+                per_row = (raw - y) ** 2
+            else:
+                ypm = 2.0 * y - 1.0
+                per_row = jax.nn.softplus(-ypm * raw)
+            data = jnp.sum(per_row * wt) / wsum
+            return data + reg * (jnp.sum(w_ * w_) + jnp.sum(v_ * v_))
+
+        l, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state_new = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state_new, l
+
+    return block_step
+
 
 
 @register_model("FMModel")
@@ -212,26 +246,9 @@ class _FMParams:
         )
         opt = optax.adam(self.step_size)
         state = opt.init(params)
-        reg = jnp.float32(self.reg_param)
-
-        @jax.jit
-        def block_step(params, state, x, y, wt):
-            wsum = jnp.maximum(jnp.sum(wt), 1.0)
-
-            def loss_fn(p):
-                w0_, w_, v_ = p
-                raw = _fm_raw(w0_, w_, v_, x)
-                if loss == "squared":
-                    per_row = (raw - y) ** 2
-                else:
-                    ypm = 2.0 * y - 1.0
-                    per_row = jax.nn.softplus(-ypm * raw)
-                data = jnp.sum(per_row * wt) / wsum
-                return data + reg * (jnp.sum(w_ * w_) + jnp.sum(v_ * v_))
-
-            l, grads = jax.value_and_grad(loss_fn)(params)
-            updates, state_new = opt.update(grads, state)
-            return optax.apply_updates(params, updates), state_new, l
+        block_step = _make_block_step(
+            loss, float(self.step_size), float(self.reg_param)
+        )
 
         n_blocks, _ = hd.block_shape(mesh)
         shuffle = np.random.default_rng(self.seed + 1)

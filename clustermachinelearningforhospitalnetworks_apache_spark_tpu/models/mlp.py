@@ -18,7 +18,7 @@ rule (pad rows carry w=0 and contribute nothing).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,35 @@ def _forward(params, x):
         h = jax.nn.sigmoid(h @ w + b[None, :])
     w, b = params[-1]
     return h @ w + b[None, :]
+
+
+@lru_cache(maxsize=1)
+def _make_block_step():
+    """The jitted out-of-core Adam step, built once per process — an
+    inline per-fit ``@jax.jit`` closure recompiled every fit (ISSUE 13
+    ``jit-in-function``; the PR 5 retrace-per-fit class).  Layer shapes
+    are not baked in: jit re-specializes per params signature and keeps
+    each specialization cached across fits."""
+    import optax
+
+    opt = optax.adam(1e-2)
+
+    @jax.jit
+    def block_step(params, state, x, y, w):
+        yi = y.astype(jnp.int32)
+        wsum = jnp.maximum(jnp.sum(w), 1.0)
+
+        def loss_fn(p):
+            logits = _forward(p, x)
+            ll = jax.nn.log_softmax(logits, axis=1)
+            nll = -jnp.take_along_axis(ll, yi[:, None], axis=1)[:, 0]
+            return jnp.sum(nll * w) / wsum
+
+        l, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state_new = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state_new, l
+
+    return block_step
 
 
 @partial(jax.jit, static_argnames=("max_iter",))
@@ -226,21 +255,7 @@ class MultilayerPerceptronClassifier(Estimator):
         # minibatch Adam at the L-BFGS-comparable default rate
         opt = optax.adam(1e-2)
         state = opt.init(params)
-
-        @jax.jit
-        def block_step(params, state, x, y, w):
-            yi = y.astype(jnp.int32)
-            wsum = jnp.maximum(jnp.sum(w), 1.0)
-
-            def loss_fn(p):
-                logits = _forward(p, x)
-                ll = jax.nn.log_softmax(logits, axis=1)
-                nll = -jnp.take_along_axis(ll, yi[:, None], axis=1)[:, 0]
-                return jnp.sum(nll * w) / wsum
-
-            l, grads = jax.value_and_grad(loss_fn)(params)
-            updates, state_new = opt.update(grads, state)
-            return optax.apply_updates(params, updates), state_new, l
+        block_step = _make_block_step()
 
         prev = np.inf
         n_blocks, _ = hd.block_shape(mesh)

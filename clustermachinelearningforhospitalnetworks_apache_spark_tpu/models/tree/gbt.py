@@ -351,6 +351,10 @@ class _GBTParams:
             p = min(max(ybar, 1e-6), 1.0 - 1e-6)
             f0 = 0.5 * float(np.log(p / (1.0 - p)))
 
+        # Closes over this fit's y/w by design: compiled once per fit and
+        # amortized over all M rounds (the fused hot path goes through the
+        # lru-cached _make_boost_scan instead).
+        # cmlhn: disable=jit-in-function — per-fit closure amortized over M rounds
         @jax.jit
         def residual(f):
             if loss == "squared":
@@ -377,6 +381,9 @@ class _GBTParams:
         thr_dev = jnp.asarray(thr, jnp.float32)
         is_cat_dev = jnp.asarray(is_cat_host)
 
+        # Closes over this fit's x / categorical masks by design; compiled
+        # once per fit, amortized over M rounds.
+        # cmlhn: disable=jit-in-function — per-fit closure amortized over M rounds
         @jax.jit
         def advance(f, sf, th, val, cm):
             # categorical rounds must route by the set mask here too — the
@@ -384,6 +391,8 @@ class _GBTParams:
             pred = predict_forest(x, sf, th, val, cm, cat_flags)[0, :, 0]
             return f + jnp.float32(self.step_size) * pred
 
+        # Validation-split path only; closes over this fit's held-out y/w.
+        # cmlhn: disable=jit-in-function — per-fit closure, validation path only
         @jax.jit
         def val_err(f):
             # mean held-out loss: squared error | Spark LogLoss 2·log(1+e^(−2y±F))
@@ -478,6 +487,8 @@ class _GBTParams:
             # round's tree stays a device tensor (device_tree_arrays),
             # round t+1's residuals chain off it, and every round's
             # winner tensors are fetched in one device_get at the end.
+            # Legacy A/B leg (fused_rounds=False); once per fit over M rounds.
+            # cmlhn: disable=jit-in-function — legacy A/B leg, per-fit closure
             @jax.jit
             def advance_deferred(f, level_out):
                 # device_tree_arrays already zeroes the catmask for

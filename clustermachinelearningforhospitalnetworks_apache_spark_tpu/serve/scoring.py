@@ -12,6 +12,8 @@ shape so the whole scan runs through a single compiled executable — a
 
 from __future__ import annotations
 
+import threading
+import weakref
 from typing import Any
 
 import jax
@@ -25,6 +27,40 @@ from ..utils.profiling import device_fence
 #: default rows per sharded scoring chunk (multiple of any data-axis size
 #: that divides a power of two)
 DEFAULT_CHUNK_ROWS = 262_144
+
+#: per-model jitted predict cache for ad-hoc ``bulk_score`` calls —
+#: ``serving_predict_fn()`` returns a fresh closure per call, so jitting
+#: it inline retraced+recompiled EVERY job (ISSUE 13 jit-in-function
+#: finding; the PR 5 retrace-per-fit class).  Keyed by ``id(model)``
+#: with a weakref identity check (model dataclasses are eq-based, hence
+#: unhashable — a WeakKeyDictionary can't hold them; the ref guards
+#: against id() reuse after gc).  The jitted closure itself keeps the
+#: model's arrays alive, so a finalizer could never fire — the cache is
+#: LRU-capped instead (the sql_compile ``_KERNELS`` discipline): repeat
+#: jobs against one live model reuse the warm executable, a fleet of
+#: one-off models can't grow it unboundedly.
+_BULK_FN_CACHE: dict[int, tuple] = {}
+_BULK_FN_CACHE_CAP = 64
+_BULK_FN_LOCK = threading.Lock()
+
+
+def _bulk_fn(model: Model):
+    # bulk_score is called from scoring-service threads: the pop/evict/
+    # insert sequence must be atomic (an unsynchronized LRU evict races
+    # to a KeyError).  jax.jit() only builds the wrapper — tracing and
+    # compilation happen at first CALL, outside this lock.
+    key = id(model)
+    with _BULK_FN_LOCK:
+        got = _BULK_FN_CACHE.pop(key, None)  # re-insert = move to MRU end
+        if got is not None and got[0]() is model:
+            _BULK_FN_CACHE[key] = got
+            return got[1]
+        while len(_BULK_FN_CACHE) >= _BULK_FN_CACHE_CAP:
+            _BULK_FN_CACHE.pop(next(iter(_BULK_FN_CACHE)))  # evict LRU
+        entry = _BULK_FN_CACHE[key] = (
+            weakref.ref(model), jax.jit(model.serving_predict_fn())
+        )
+        return entry[1]
 
 
 def bulk_score(
@@ -40,7 +76,7 @@ def bulk_score(
     mesh = mesh or default_mesh()
     x = np.atleast_2d(np.asarray(x))
     n = x.shape[0]
-    fn = jax.jit(model.serving_predict_fn())
+    fn = _bulk_fn(model)
     if n <= chunk_rows:
         ds = device_dataset(x, mesh=mesh)
         return unpad(fn(ds.x), n)

@@ -411,7 +411,7 @@ class InferenceServer:
         return self
 
     def stop(self) -> None:
-        for b in self._batchers.values():
+        for b in list(self._batchers.values()):
             b.stop()
         self._batchers.clear()
         self._started = False
@@ -591,6 +591,8 @@ class InferenceServer:
     # ------------------------------------------------------------ observe
     def stats(self) -> dict[str, Any]:
         out = self.metrics.snapshot()
+        # snapshot before iterating: a concurrent add_model/swap/stop
+        # mutates these dicts mid-walk (the PR 10 RuntimeError class)
         out["models"] = {
             name: {
                 "buckets": list(b.model.buckets),
@@ -600,7 +602,7 @@ class InferenceServer:
                 "breaker": self._breakers[name].state
                 if name in self._breakers else None,
             }
-            for name, b in self._batchers.items()
+            for name, b in list(self._batchers.items())
         }
         return out
 
@@ -608,8 +610,12 @@ class InferenceServer:
         """Liveness/degradation snapshot: breaker state per model plus the
         self-healing counters (quarantined batches, retry totals) — what a
         ``/healthz`` endpoint or an orchestrator's probe would poll."""
-        breakers = {name: b.snapshot() for name, b in self._breakers.items()}
-        drift = {name: m.snapshot() for name, m in self._monitors.items()}
+        breakers = {
+            name: b.snapshot() for name, b in list(self._breakers.items())
+        }
+        drift = {
+            name: m.snapshot() for name, m in list(self._monitors.items())
+        }
         # status derives from breaker state only: SUSTAINED drift reaches
         # it through trip() (trip_after consecutive hot windows), while a
         # single hot window merely shows in the per-model "drifting"

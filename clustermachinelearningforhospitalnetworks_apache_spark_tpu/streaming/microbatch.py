@@ -109,7 +109,9 @@ class StreamExecution:
     #: replayed attempt re-produces the same rejects, and the counters
     #: must match the (idempotent) quarantine files, not the attempt count
     _quarantine_counted: set = field(default_factory=set, repr=False)
-    # entropy-seeded: replaying drivers must not back off in lockstep
+    # entropy-seeded ON PURPOSE: replaying drivers must not back off in
+    # lockstep (PR 2 review); backoff jitter affects timing only, never data
+    # cmlhn: disable=unseeded-random — deliberate entropy-seeded replay jitter
     _rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def __post_init__(self) -> None:

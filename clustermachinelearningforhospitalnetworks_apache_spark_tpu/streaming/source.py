@@ -61,7 +61,9 @@ class FileStreamSource:
     # guards _seen: the pipelined driver's worker thread snapshots it
     # while the commit thread marks files committed
     _seen_lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
-    # entropy-seeded: a fleet of sources must not jitter in lockstep
+    # entropy-seeded ON PURPOSE: a fleet of sources must not retry-jitter
+    # in lockstep (PR 2 review); jitter affects timing only, never data
+    # cmlhn: disable=unseeded-random — deliberate entropy-seeded retry jitter
     _rng: random.Random = field(default_factory=random.Random, repr=False)
 
     def list_files(self) -> list[str]:

@@ -110,6 +110,12 @@ def pytest_configure(config):
         "fleet: serving-fleet tests — placement, tenant routing, SLO "
         "admission, atomic promotion, replica chaos (pytest -m fleet)",
     )
+    config.addinivalue_line(
+        "markers",
+        "lint: framework-invariant-linter tests — per-rule fixtures, "
+        "suppression/baseline machinery, the tier-1 repo-clean meta-test "
+        "(pytest -m lint)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

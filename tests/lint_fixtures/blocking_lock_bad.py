@@ -1,0 +1,17 @@
+"""Fixture: blocking-under-lock — fsync while a lock is held (the PR 8
+flight-recorder dump-under-lock ABBA class)."""
+
+import os
+import threading
+
+
+class Journal:
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+        self._pending = {}
+
+    def append(self, entry):
+        with self._lock:
+            self._pending[entry["id"]] = entry
+            os.fsync(self._f.fileno())  # BAD: every waiter stalls on IO

@@ -1,0 +1,21 @@
+"""Fixture: blocking-under-lock clean — stage under the lock, perform
+the blocking write after release (the PR 8 fix shape)."""
+
+import os
+import threading
+
+
+class Journal:
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+        self._pending = {}
+
+    def append(self, entry):
+        with self._lock:
+            self._pending[entry["id"]] = entry
+            staged = dict(self._pending)
+        self._write(staged)
+
+    def _write(self, staged):
+        os.fsync(self._f.fileno())  # outside any lock

@@ -1,0 +1,16 @@
+"""Fixture: unseeded-random + wallclock-in-kernel in model code."""
+
+import random
+import time
+from numpy.random import default_rng
+
+import numpy as np
+
+
+def subsample(x):
+    t0 = time.time()                       # BAD: wallclock in a kernel
+    idx = np.random.randint(0, 10, 4)      # BAD: numpy global RNG
+    pick = random.choice([1, 2, 3])        # BAD: process-global RNG
+    rng = np.random.default_rng()          # BAD: entropy-seeded
+    bare = default_rng()                         # BAD: direct import
+    return t0, idx, pick, rng, bare

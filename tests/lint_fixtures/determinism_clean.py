@@ -1,0 +1,11 @@
+"""Fixture: determinism clean — explicit seeded generators only."""
+
+import random
+
+import numpy as np
+
+
+def subsample(x, seed):
+    rng = np.random.default_rng(seed)
+    jitter = random.Random(seed + 1)
+    return rng.integers(0, 10, 4), jitter.random()

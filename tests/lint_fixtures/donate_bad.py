@@ -1,0 +1,12 @@
+"""Fixture: donated-arg-reused — reading a buffer after donating it
+(use-after-free on device; silently "works" on CPU)."""
+
+import jax
+
+step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+
+def run(state, x):
+    new_state = step(state, x)
+    total = state.sum()  # BAD: state's buffer was donated
+    return new_state, total

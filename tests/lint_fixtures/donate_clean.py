@@ -1,0 +1,11 @@
+"""Fixture: donated-arg-reused clean — the rebind idiom."""
+
+import jax
+
+step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+
+def run(state, xs):
+    for x in xs:
+        state = step(state, x)  # result rebinds the donated name
+    return state

@@ -1,0 +1,9 @@
+"""Fixture: jit-in-function — a fresh jax.jit wrapper per call (the
+PR 5 ``_make_boost_scan`` retrace-per-fit class)."""
+
+import jax
+
+
+def score(model, x):
+    fn = jax.jit(model.predict_fn())  # BAD: retraces every call
+    return fn(x)

@@ -1,0 +1,18 @@
+"""Fixture: jit-in-function clean — the three sanctioned shapes:
+module-level jit, lru_cache'd factory, instance-stored wrapper."""
+
+from functools import lru_cache
+
+import jax
+
+top_level = jax.jit(lambda x: x + 1)
+
+
+@lru_cache(maxsize=8)
+def make_fn(k):
+    return jax.jit(lambda x: x * k)
+
+
+class Scorer:
+    def __init__(self, model):
+        self._fn = jax.jit(model.predict_fn())  # instance IS the cache

@@ -1,0 +1,19 @@
+"""Fixture: lock-iter-snapshot — iterating a mutated dict attr of a
+lock-owning class without the lock or a snapshot (the PR 10
+``ReplicaSet.health()`` RuntimeError class).  Never imported."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+
+    def add(self, name, model):
+        with self._lock:
+            self._models[name] = model
+
+    def health(self):
+        # BAD: a concurrent add() raises RuntimeError mid-iteration
+        return {name: m for name, m in self._models.items()}

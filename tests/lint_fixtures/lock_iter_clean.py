@@ -1,0 +1,28 @@
+"""Fixture: lock-iter-snapshot clean — snapshot copy and under-lock
+iteration are both fine; a dict that is only rebound is fine too."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+        self._frozen = {}
+
+    def add(self, name, model):
+        with self._lock:
+            self._models[name] = model
+
+    def rebind(self, new):
+        self._frozen = dict(new)  # rebound, never mutated in place
+
+    def health(self):
+        return {name: m for name, m in list(self._models.items())}
+
+    def health_locked(self):
+        with self._lock:
+            return {name: m for name, m in self._models.items()}
+
+    def frozen_view(self):
+        return [k for k in self._frozen]  # rebind-only: no race

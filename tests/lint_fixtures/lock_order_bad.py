@@ -1,0 +1,19 @@
+"""Fixture: lock-order-cycle — two functions take the same two locks in
+opposite orders (the breaker/registry ABBA deadlock class)."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def backward():
+    with LOCK_B:
+        with LOCK_A:  # BAD: A->B in forward(), B->A here
+            pass

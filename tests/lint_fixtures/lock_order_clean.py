@@ -1,0 +1,41 @@
+"""Fixture: lock-order-cycle clean — one global acquisition order."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def also_forward():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+GLOBAL_LOCK = threading.Lock()
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def work(self):
+        with self._lock:
+            with GLOBAL_LOCK:
+                pass
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()  # a DIFFERENT lock than A._lock
+
+    def work(self):
+        with GLOBAL_LOCK:
+            with self._lock:
+                pass

@@ -1,0 +1,24 @@
+"""Fixture: raw-metric-label — tenant/replica label values not minted
+through the bounded helpers (unbounded Prometheus series)."""
+
+
+def fragment(registry, tenant_id, index):
+    registry.counter(f'farm.requests{{cohort="{tenant_id}"}}')     # BAD
+    registry.gauge(f'fleet.state{{replica="{index}"}}', 1.0)       # BAD
+    registry.counter(f'farm.requests{{tenant="{str(tenant_id)}"}}')  # BAD
+
+
+def concat_fragment(registry, tenant_id, index):
+    registry.counter('farm.requests{cohort="' + tenant_id + '"}')      # BAD
+    registry.gauge('fleet.state{replica="{}"}'.format(index), 1.0)     # BAD
+
+
+def mints_elsewhere(registry, index, replica_label):
+    lbl = replica_label(index)
+    return lbl
+
+
+def raw_param(registry, lbl):
+    # BAD: `lbl` here is a caller-supplied raw value — the minted alias
+    # of the SAME NAME in mints_elsewhere() must not legitimize it
+    registry.gauge(f'fleet.state{{replica="{lbl}"}}', 1.0)

@@ -1,0 +1,17 @@
+"""Fixture: raw-metric-label clean — minted values, incl. the
+once-assigned alias the regex rules could not follow (ISSUE 13
+bugfix regression)."""
+
+
+def fragment(registry, tenant_id, index, cohort_label, replica_label):
+    registry.counter(f'farm.requests{{cohort="{cohort_label(tenant_id)}"}}')
+    lbl = replica_label(index)
+    registry.gauge(f'fleet.state{{replica="{lbl}"}}', 1.0)  # alias: ok
+    registry.gauge(f'serve.breaker{{model="{tenant_id}"}}', 1.0)  # unguarded key
+
+
+def concat_fragment(registry, tenant_id, cohort_label):
+    registry.counter(
+        'farm.requests{cohort="' + cohort_label(tenant_id) + '"}'
+    )
+    registry.gauge('fleet.state{model="{}"}'.format(tenant_id), 1.0)

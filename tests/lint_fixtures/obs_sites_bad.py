@@ -1,0 +1,27 @@
+"""Fixture: fault sites the regex scanner silently skipped (ISSUE 13
+bugfix regression) — an f-string-built site with no coverage entry, and
+a genuinely dynamic site."""
+
+_KIND = "uncovered"
+
+
+def fault_point(site, **ctx):
+    pass
+
+
+def work():
+    fault_point(f"custom.{_KIND}.site")  # resolves; NOT in SITE_COVERAGE
+
+
+def hook(site):
+    fault_point(site)  # genuinely dynamic: its own violation
+
+
+def helper():
+    name = "wal.append"
+    fault_point(name)  # resolves: local single assignment
+
+
+def other():
+    fault_point(name)  # `name` is helper's LOCAL — must flag dynamic,
+    # not silently resolve through a leaked module-const table

@@ -1,0 +1,21 @@
+"""Fixture: obs sites clean — literal, once-assigned alias, and
+parameter-default forwarding (the streaming/wal.py shape) all resolve
+to covered sites."""
+
+site_name = "wal.append"
+
+
+def fault_point(site, **ctx):
+    pass
+
+
+def direct():
+    fault_point("serve.predict")
+
+
+def aliased():
+    fault_point(site_name)  # single-assignment alias: resolves
+
+
+def forwarding(site="stream.after_commit"):
+    fault_point(site)  # parameter default: resolves

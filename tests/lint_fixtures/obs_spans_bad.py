@@ -1,0 +1,20 @@
+"""Fixture: span emissions — an unregistered literal, a genuinely
+dynamic name, and a constant-prefix glob that is not a registered
+sink."""
+
+
+def span(name, attrs=None):
+    pass
+
+
+def work():
+    with span("not.registered"):  # span-unregistered
+        pass
+
+
+def emit(name):
+    span(name)  # dynamic-span-name
+
+
+def prefix_emit(kind):
+    span("custom." + kind)  # dynamic-span-name: custom.* not a sink

@@ -1,0 +1,19 @@
+"""Fixture: span emissions clean — registered literal and the
+registered ``stage.*`` glob sink (the StageClock shape)."""
+
+
+def span(name, attrs=None):
+    pass
+
+
+def record_span(name, dur_s, attrs=None):
+    pass
+
+
+def work():
+    with span("serve.request"):
+        pass
+
+
+def stage_sink(name, dt):
+    record_span("stage." + name, dt)  # registered glob sink: ok

@@ -1,0 +1,10 @@
+"""Fixture: a bare suppression does NOT silence, and is itself a
+finding (suppression-missing-reason)."""
+
+import numpy as np
+
+
+def subsample():
+    # cmlhn: disable=unseeded-random
+    rng = np.random.default_rng()
+    return rng

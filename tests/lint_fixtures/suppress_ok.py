@@ -1,0 +1,9 @@
+"""Fixture: a violation silenced by a suppression WITH a reason."""
+
+import numpy as np
+
+
+def subsample():
+    # cmlhn: disable=unseeded-random — fixture: deliberate jitter, documented
+    rng = np.random.default_rng()
+    return rng

@@ -1,0 +1,35 @@
+"""Fixture: host-sync-in-jit + bool-mask-in-jit inside a traced body."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x):
+    total = float(jnp.sum(x))  # BAD: concretizes a traced value
+    pos = x[x > 0]             # BAD: data-dependent shape
+    return jnp.sum(pos) + total
+
+
+def loop(xs):
+    def body(carry, row):
+        return carry + row.item(), None  # BAD: .item() in a scanned body
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def while_body(x0):
+    def cond(c):
+        return c[0] < 10
+
+    def body(c):
+        return c[0] + c[1].item(), c[1]  # BAD: .item() in a while body
+
+    return jax.lax.while_loop(cond, body, x0)
+
+
+def fori(xs):
+    def body(i, acc):
+        return acc + float(xs[i])  # BAD: float() in a fori body
+
+    return jax.lax.fori_loop(0, 10, body, 0.0)

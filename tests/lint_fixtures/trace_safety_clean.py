@@ -1,0 +1,17 @@
+"""Fixture: trace-safety clean — pad-and-weight instead of masks, shape
+reads are static, host coercions live outside the traced region."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x):
+    n = float(x.shape[0])  # static: shape read, not a traced value
+    w = (x > 0).astype(jnp.float32)
+    return jnp.sum(x * w) / n
+
+
+def fit(x):
+    out = kernel(x)
+    return float(out)  # host coercion OUTSIDE the jitted region

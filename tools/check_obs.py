@@ -1,238 +1,48 @@
 #!/usr/bin/env python
-"""Static observability-coverage check (ISSUE 10 satellite).
+"""Static observability-coverage check — now a thin shim over the
+framework invariant linter (ISSUE 13).
 
-Instrumentation drifts silently: someone adds a fault site or a journal
-state, the chaos matrix grows, and nothing forces the new failure mode
-to be visible in a trace or a postmortem.  This check makes that drift
-a tier-1 FAILURE (``tests/test_obs.py`` runs it) by cross-checking the
-SOURCE against the literal registries in ``obs/trace.py``:
+The six rules that lived here as regexes (fault-site coverage,
+span-registry cross-checks, journal-state spans, farm/fleet span sets,
+tenant/replica label minting) are AST passes in ``tools/lint/``:
+``lint/passes/obs_coverage.py`` and ``lint/passes/metric_labels.py``.
+The AST port also resolves names the regexes silently skipped —
+f-strings, once-assigned aliases, parameter defaults (the
+``streaming/wal.py`` forwarding hook) — and flags genuinely dynamic
+names as their own violation.
 
-1. every named fault site passed to ``fault_point`` / ``torn_point`` /
-   ``mangle_bytes`` / ``corrupt_data`` (or bound to a ``*_SITE``
-   constant) in the package must match a glob in
-   ``obs.trace.SITE_COVERAGE`` — i.e. someone has decided which span
-   that site's failures show up under;
-2. every ``SITE_COVERAGE`` target must be a registered span name;
-3. every span name the source emits (``span("…")`` /
-   ``record_span("…")`` across the package, bench, examples) must be
-   registered in ``obs.trace.REGISTERED_SPANS`` — and every registered
-   name must actually be emitted somewhere (no aspirational entries);
-4. every lifecycle journal state (``STATE_* = "…"`` in
-   ``lifecycle/controller.py``) must be covered by the journaled-
-   transition span, and the retrain/promote/rollback phases must carry
-   their own spans.
-
-Pure text scan — no imports of jax, no runtime — so it stays fast and
-runs anywhere.  Exit 0 = covered; 1 = drift (each violation printed).
+This entry point keeps the historical contract for ``tests/test_obs.py``
+and ``tools/run_chaos.sh``: exit 0 = covered, 1 = drift (each violation
+printed).  Full-engine runs: ``python tools/lint.py``.
 """
 
 from __future__ import annotations
 
-import fnmatch
 import os
-import re
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(
-    ROOT, "clustermachinelearningforhospitalnetworks_apache_spark_tpu"
-)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: fault-site hook call with a literal site (``\s`` spans newlines for
-#: multi-line call layouts)
-_SITE_CALL = re.compile(
-    r"(?:fault_point|torn_point|mangle_bytes|corrupt_data|"
-    r"data_rules_active)\(\s*\"([a-z_][a-z0-9_.]*)\"",
-)
-#: sites bound to constants (e.g. ``CSV_TEXT_SITE = "ingest.csv_text"``)
-_SITE_CONST = re.compile(r"[A-Z0-9_]*SITE[A-Z0-9_]*\s*=\s*\"([a-z_.]+)\"")
-#: span emission with a literal name
-_SPAN_CALL = re.compile(
-    r"(?:\bspan|record_span)\(\s*\"([a-z_][a-z0-9_.]*)\""
-)
-#: the StageClock dynamic sink (span name built as "stage." + name)
-_DYNAMIC_STAGE = '"stage." + name'
-_STATE_CONST = re.compile(r"^STATE_[A-Z_]+\s*=\s*\"([a-z_]+)\"", re.M)
-
-
-def _py_files(*roots: str) -> list[str]:
-    out = []
-    for root in roots:
-        if os.path.isfile(root):
-            out.append(root)
-            continue
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-            out.extend(
-                os.path.join(dirpath, f)
-                for f in filenames
-                if f.endswith(".py")
-            )
-    return sorted(out)
-
-
-def _load_trace_registries() -> tuple[tuple[str, ...], dict[str, str]]:
-    """Read REGISTERED_SPANS / SITE_COVERAGE from obs/trace.py WITHOUT
-    importing the package (no jax, no side effects): exec just the two
-    literal assignments."""
-    src = open(os.path.join(PKG, "obs", "trace.py")).read()
-    ns: dict = {}
-    for name in ("REGISTERED_SPANS", "SITE_COVERAGE"):
-        m = re.search(
-            rf"^{name}\s*=\s*(\(|\{{)", src, re.M
-        )
-        if m is None:
-            raise SystemExit(f"obs/trace.py: {name} literal not found")
-        # take the balanced literal starting at the match
-        start = m.end() - 1
-        depth, i = 0, start
-        while i < len(src):
-            c = src[i]
-            if c in "({[":
-                depth += 1
-            elif c in ")}]":
-                depth -= 1
-                if depth == 0:
-                    break
-            i += 1
-        ns[name] = eval(src[start : i + 1], {}, {})  # noqa: S307 — a
-        # literal from our own source, parsed without importing jax
-    return tuple(ns["REGISTERED_SPANS"]), dict(ns["SITE_COVERAGE"])
-
-
-def _matches(name: str, patterns) -> bool:
-    return any(fnmatch.fnmatchcase(name, p) for p in patterns)
+from lint import load_baseline, passes_by_name, run  # noqa: E402 — tools/lint/
+from lint.cli import BASELINE_PATH  # noqa: E402
 
 
 def main() -> int:
-    registered, coverage = _load_trace_registries()
-    pkg_files = _py_files(PKG)
-    emit_files = _py_files(
-        PKG,
-        os.path.join(ROOT, "bench.py"),
-        os.path.join(ROOT, "examples"),
+    report = run(
+        passes=passes_by_name(["obs_coverage", "metric_labels"]),
+        complete=None,  # default full roots → completeness rules active
+        baseline=load_baseline(BASELINE_PATH),  # same gating set as lint.py
     )
-
-    sites: dict[str, list[str]] = {}
-    for path in pkg_files:
-        if path.endswith(os.path.join("obs", "trace.py")):
-            continue  # the registry itself
-        src = open(path).read()
-        rel = os.path.relpath(path, ROOT)
-        for pat in (_SITE_CALL, _SITE_CONST):
-            for site in pat.findall(src):
-                if "*" in site:
-                    continue  # a rule glob, not a site
-                sites.setdefault(site, []).append(rel)
-
-    emitted: set[str] = set()
-    for path in emit_files:
-        src = open(path).read()
-        emitted.update(_SPAN_CALL.findall(src))
-        if _DYNAMIC_STAGE in src:
-            emitted.add("stage.*")
-
-    states = _STATE_CONST.findall(
-        open(os.path.join(PKG, "lifecycle", "controller.py")).read()
-    )
-
-    problems: list[str] = []
-    # 1. every fault site is mapped to a span
-    for site, where in sorted(sites.items()):
-        if not _matches(site, coverage):
-            problems.append(
-                f"fault site {site!r} ({where[0]}) has no "
-                "obs.trace.SITE_COVERAGE entry"
-            )
-    # 2. coverage targets are registered spans
-    for glob, span_name in sorted(coverage.items()):
-        if not _matches(span_name, registered):
-            problems.append(
-                f"SITE_COVERAGE[{glob!r}] -> {span_name!r} is not in "
-                "REGISTERED_SPANS"
-            )
-    # 3a. emitted spans are registered
-    for name in sorted(emitted):
-        if not _matches(name, registered):
-            problems.append(
-                f"span {name!r} is emitted but not in REGISTERED_SPANS"
-            )
-    # 3b. registered spans are emitted (no aspirational entries)
-    for name in registered:
-        if name == "stage.*":
-            ok = "stage.*" in emitted
-        else:
-            ok = any(fnmatch.fnmatchcase(e, name) for e in emitted)
-        if not ok:
-            problems.append(
-                f"REGISTERED_SPANS entry {name!r} is never emitted"
-            )
-    # 4. journal transitions are spanned, phase spans exist
-    if not states:
-        problems.append("lifecycle/controller.py: no STATE_* constants found")
-    for required in (
-        "lifecycle.transition", "lifecycle.retrain",
-        "lifecycle.promote", "lifecycle.rollback",
-    ):
-        if required not in emitted:
-            problems.append(
-                f"lifecycle span {required!r} is not emitted — journal "
-                "transitions have drifted from the instrumentation"
-            )
-    # 5. model-farm instrumentation: the fleet fit / drifted-subset
-    # refit / tenant-routed predict must stay spanned, and NO metric may
-    # carry a raw per-tenant label (a 10k-series Prometheus export) —
-    # tenant breakdowns go through obs.registry.cohort_label
-    for required in ("farm.fit", "farm.refit", "farm.predict"):
-        if required not in emitted:
-            problems.append(
-                f"farm span {required!r} is not emitted — the farm has "
-                "drifted from its instrumentation"
-            )
-    tenant_label = re.compile(r"\{tenant(?:_id)?=")
-    for path in pkg_files:
-        src = open(path).read()
-        if tenant_label.search(src):
-            problems.append(
-                f"{os.path.relpath(path, ROOT)}: metric labeled by raw "
-                "tenant id — use obs.registry.cohort_label (bounded "
-                "cardinality) instead"
-            )
-    # 6. serving-fleet instrumentation (ISSUE 12): the front door, the
-    # routing decision, and the atomic promotion must stay spanned — a
-    # routed request's trace (fleet.request ⊃ router.route ⊃
-    # serve.request) is the bench's route evidence — and every
-    # ``replica=``-labeled metric must mint its value through
-    # obs.registry.replica_label (bounded + format-pinned), the same
-    # write-side discipline the PR 9 cohort guard gives tenant labels.
-    for required in ("fleet.request", "fleet.promote", "router.route"):
-        if required not in emitted:
-            problems.append(
-                f"fleet span {required!r} is not emitted — the serving "
-                "fleet has drifted from its instrumentation"
-            )
-    # matches a replica label VALUE being written in any position —
-    # first label, after a comma, or on its own f-string line
-    replica_label_re = re.compile(r'replica="')
-    for path in pkg_files:
-        rel = os.path.relpath(path, ROOT)
-        for lineno, line in enumerate(open(path), 1):
-            if replica_label_re.search(line) and "replica_label(" not in line:
-                problems.append(
-                    f"{rel}:{lineno}: metric labeled replica= without "
-                    "obs.registry.replica_label — raw replica ids bypass "
-                    "the cardinality/format guard"
-                )
-
+    problems = report.active
     if problems:
         print("check_obs: INSTRUMENTATION DRIFT")
-        for p in problems:
-            print(f"  - {p}")
+        for f in problems:
+            print(f"  - {f.path}:{f.line}: [{f.rule}] {f.message}")
         return 1
     print(
-        f"check_obs: OK — {len(sites)} fault sites covered, "
-        f"{len(emitted)} span names emitted+registered, "
-        f"{len(states)} journal states spanned"
+        "check_obs: OK — obs coverage + label hygiene clean over "
+        f"{report.files_scanned} files ({report.runtime_s:.2f}s, "
+        f"{report.suppressed} suppressed)"
     )
     return 0
 

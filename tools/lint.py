@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Framework invariant linter — CLI entry point (ISSUE 13).
+
+The engine lives in ``tools/lint/`` (the package shadows this script on
+the import path by design — a directory package takes precedence over a
+same-named module).  Pure AST, no jax import, < 10s over the full
+package: runnable as a pre-commit hook (``--changed-only``), the chaos
+preflight, and the tier-1 meta-test (``tests/test_lint.py``).
+
+See ``python tools/lint.py --help`` and docs/ARCHITECTURE.md §Static
+analysis.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint.cli import main  # noqa: E402 — resolves to tools/lint/
+
+if __name__ == "__main__":
+    sys.exit(main())

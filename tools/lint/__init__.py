@@ -1,0 +1,10 @@
+"""Framework invariant linter (ISSUE 13) — see ``engine.py`` for the
+architecture and ``docs/ARCHITECTURE.md`` §Static analysis for the rule
+catalog.  Entry points: ``tools/lint.py`` (CLI), ``lint.engine.run``
+(programmatic), ``tools/check_obs.py`` (the obs-rules shim)."""
+
+from .engine import (  # noqa: F401
+    ENGINE_VERSION, Finding, Pass, Project, Report,
+    load_baseline, run, write_baseline,
+)
+from .passes import all_passes, passes_by_name  # noqa: F401

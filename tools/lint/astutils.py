@@ -1,0 +1,268 @@
+"""Shared AST analysis helpers for the lint passes.
+
+Everything here is pure ``ast`` — no imports of the package under
+analysis, no jax — so the engine stays runnable anywhere in well under
+the 10s budget (the ``tools/check_obs.py`` discipline, kept).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+
+def build_parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    """Child → parent map for upward walks (scope/lock/decorator context)."""
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST, parents: dict) -> Iterator[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+def enclosing_functions(node: ast.AST, parents: dict) -> list[ast.AST]:
+    """Innermost-first chain of enclosing FunctionDef/AsyncFunctionDef/
+    Lambda nodes (the *lexical* nesting the jit-hygiene pass cares about)."""
+    return [
+        a for a in ancestors(node, parents)
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+    ]
+
+
+def enclosing_class(node: ast.AST, parents: dict) -> ast.ClassDef | None:
+    for a in ancestors(node, parents):
+        if isinstance(a, ast.ClassDef):
+            return a
+    return None
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.jit`` / ``functools.lru_cache`` / ``span`` as a dotted string,
+    or None for anything that isn't a plain Name/Attribute chain."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted_name(node.func)
+
+
+def decorator_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    """Dotted names of every decorator; for ``@partial(f, ...)`` /
+    ``@lru_cache(...)`` the *called* name plus, for partial, the name of
+    its first argument (so ``@partial(jax.jit, ...)`` yields both
+    ``functools.partial`` and ``jax.jit``)."""
+    out: list[str] = []
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if name:
+                out.append(name)
+            if name and name.split(".")[-1] == "partial" and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner:
+                    out.append(inner)
+        else:
+            name = dotted_name(dec)
+            if name:
+                out.append(name)
+    return out
+
+
+def has_decorator(fn, *tails: str) -> bool:
+    """True when any decorator's dotted name ends with one of ``tails``
+    (``lru_cache`` matches both ``functools.lru_cache`` and a bare
+    ``lru_cache``)."""
+    for name in decorator_names(fn):
+        last = name.split(".")[-1]
+        if last in tails:
+            return True
+    return False
+
+
+class ConstStrResolver:
+    """Resolve a span/site name expression to a literal string.
+
+    The check_obs regexes missed names passed through f-strings or a
+    variable assigned once — and silently skipped them (ISSUE 13 bugfix
+    satellite).  This resolver handles, in order:
+
+    * ``ast.Constant`` strings — the plain case;
+    * f-strings (``ast.JoinedStr``) whose parts are all constants;
+    * a ``Name`` assigned exactly once in the enclosing function or at
+      module level with a resolvable value (one aliasing hop);
+    * a ``Name`` that is an enclosing function's *parameter* with a
+      string default (the ``streaming/wal.py::append_lines(site=
+      "wal.append")`` forwarding-hook shape);
+    * ``"prefix." + dynamic`` / f-strings with a constant prefix resolve
+      to a glob ``"prefix.*"`` (the StageClock sink) — reported with
+      ``is_glob=True``.
+
+    Anything else resolves to ``None`` — *genuinely* dynamic, which the
+    obs pass flags as its own violation instead of skipping.
+    """
+
+    def __init__(self, tree: ast.Module, parents: dict):
+        self.parents = parents
+        self.module_consts = _single_assign_strings(tree)
+        self._fn_consts: dict[ast.AST, dict[str, str]] = {}
+
+    def resolve(self, node: ast.AST) -> tuple[str | None, bool]:
+        """→ (resolved name or None, is_glob)."""
+        got = self._resolve(node, depth=0)
+        if got is None:
+            return None, False
+        return got
+
+    def _resolve(self, node: ast.AST, depth: int):
+        if depth > 4:  # alias-chain bound; real code is 0-1 hops
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value, False
+        if isinstance(node, ast.JoinedStr):
+            prefix: list[str] = []
+            for part in node.values:
+                if isinstance(part, ast.Constant):
+                    prefix.append(str(part.value))
+                else:
+                    inner = self._resolve(part.value, depth + 1) if isinstance(
+                        part, ast.FormattedValue
+                    ) else None
+                    if inner is not None and not inner[1]:
+                        prefix.append(inner[0])
+                    else:
+                        return ("".join(prefix) + "*", True) if prefix else None
+            return "".join(prefix), False
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = self._resolve(node.left, depth + 1)
+            if left is None or left[1]:
+                return None
+            right = self._resolve(node.right, depth + 1)
+            if right is not None and not right[1]:
+                return left[0] + right[0], False
+            return left[0] + "*", True
+        if isinstance(node, ast.Name):
+            for fn in enclosing_functions(node, self.parents):
+                if isinstance(fn, ast.Lambda):
+                    continue
+                consts = self._fn_consts.get(fn)
+                if consts is None:
+                    consts = _single_assign_strings(fn)
+                    self._fn_consts[fn] = consts
+                if node.id in consts:
+                    return consts[node.id], False
+                got = _param_default_string(fn, node.id)
+                if got is not None:
+                    return got, False
+                if _binds(fn, node.id):
+                    return None  # rebound dynamically in this scope
+            if node.id in self.module_consts:
+                return self.module_consts[node.id], False
+        return None
+
+
+def _binds(fn, name: str) -> bool:
+    """Whether ``name`` is a parameter of / assigned anywhere in ``fn``."""
+    args = fn.args
+    all_args = [*args.posonlyargs, *args.args, *args.kwonlyargs]
+    if args.vararg:
+        all_args.append(args.vararg)
+    if args.kwarg:
+        all_args.append(args.kwarg)
+    if any(a.arg == name for a in all_args):
+        return True
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            if sub.id == name:
+                return True
+    return False
+
+
+def _param_default_string(fn, name: str) -> str | None:
+    """String default of parameter ``name`` (the forwarding-hook case)."""
+    args = fn.args
+    pos = [*args.posonlyargs, *args.args]
+    for arg, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if arg.arg == name and isinstance(default, ast.Constant) \
+                and isinstance(default.value, str):
+            return default.value
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if arg.arg == name and isinstance(default, ast.Constant) \
+                and isinstance(default.value, str):
+            return default.value
+    return None
+
+
+def _scope_walk(scope: ast.AST):
+    """Walk ``scope`` WITHOUT descending into nested scopes (functions,
+    lambdas, classes) — a local string in one function must never
+    resolve a name referenced in another (the scope-leak would silently
+    accept wrong span/site names instead of flagging them dynamic)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _single_assign_strings(scope: ast.AST) -> dict[str, str]:
+    """Names assigned exactly once in ``scope``'s own body (nested
+    scopes excluded — see :func:`_scope_walk`) whose value is a literal
+    string."""
+    counts: dict[str, int] = {}
+    values: dict[str, str] = {}
+    for node in _scope_walk(scope):
+        targets: list[ast.expr] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, (ast.AugAssign, ast.For, ast.comprehension)):
+            t = node.target
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    counts[sub.id] = counts.get(sub.id, 0) + 2  # not single
+            continue
+        else:
+            continue
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    counts[sub.id] = counts.get(sub.id, 0) + 1
+                    if isinstance(value, ast.Constant) and isinstance(
+                        value.value, str
+                    ):
+                        values[sub.id] = value.value
+    return {
+        k: v for k, v in values.items() if counts.get(k) == 1
+    }
+
+
+def literal_eval_assign(tree: ast.Module, name: str):
+    """``ast.literal_eval`` the module-level assignment ``name = <literal>``
+    (how the obs pass reads REGISTERED_SPANS/SITE_COVERAGE from
+    ``obs/trace.py`` without importing it)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return ast.literal_eval(node.value)
+    raise LookupError(name)

@@ -1,0 +1,137 @@
+"""CLI for the framework invariant linter.
+
+::
+
+    python tools/lint.py                  # full scan, text output
+    python tools/lint.py --json           # machine-readable (schema pinned
+                                          #   by tests/test_lint.py)
+    python tools/lint.py --changed-only   # only files in `git diff` vs
+                                          #   --base (default HEAD) —
+                                          #   the pre-commit mode
+    python tools/lint.py --write-baseline # grandfather current findings
+    python tools/lint.py path.py …        # explicit files (fixtures)
+
+Exit codes: 0 clean (after suppressions + baseline), 1 active findings,
+2 engine/usage error.  Never imports jax; full-package runtime is gated
+< 10s by the tier-1 meta-test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from .engine import (
+    ROOT, default_roots, load_baseline, run, write_baseline,
+)
+from .passes import all_passes, passes_by_name
+
+BASELINE_PATH = os.path.join(ROOT, "tools", "lint_baseline.json")
+
+
+def _changed_files(base: str) -> list[str]:
+    got = subprocess.run(
+        ["git", "diff", "--name-only", "--diff-filter=d", base, "--"],
+        cwd=ROOT, capture_output=True, text=True, check=True,
+    )
+    tracked = {
+        line.strip() for line in got.stdout.splitlines() if line.strip()
+    }
+    # untracked new files are part of "what changed" for pre-commit use
+    extra = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=ROOT, capture_output=True, text=True, check=True,
+    )
+    tracked.update(l.strip() for l in extra.stdout.splitlines() if l.strip())
+    scan_set = {os.path.relpath(p, ROOT) for p in default_roots()}
+    out = []
+    for rel in sorted(tracked):
+        if not rel.endswith(".py"):
+            continue
+        absolute = os.path.join(ROOT, rel)
+        if not os.path.exists(absolute):
+            continue
+        # only files a full scan would visit
+        if any(
+            rel == s or rel.startswith(s.rstrip("/") + "/")
+            for s in scan_set
+        ):
+            out.append(absolute)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint", description="framework invariant linter (ISSUE 13)"
+    )
+    ap.add_argument("paths", nargs="*", help="explicit files/dirs "
+                    "(default: package + bench.py + examples)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs --base (git diff)")
+    ap.add_argument("--base", default="HEAD",
+                    help="git ref for --changed-only (default HEAD)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as grandfathered")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset (default: all)")
+    ap.add_argument("--root", default=ROOT,
+                    help="repo root for relative paths / scoped passes "
+                    "(tests point this at fixture trees)")
+    args = ap.parse_args(argv)
+
+    try:
+        passes = (
+            passes_by_name([p.strip() for p in args.passes.split(",")])
+            if args.passes else all_passes()
+        )
+    except KeyError as e:
+        print(f"lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    paths: list[str] | None = None
+    if args.changed_only:
+        try:
+            paths = _changed_files(args.base)
+        except subprocess.CalledProcessError as e:
+            print(f"lint: git diff failed: {e.stderr.strip()}",
+                  file=sys.stderr)
+            return 2
+        # an empty change set still flows through run(paths=[]) so the
+        # --json output keeps the FULL pinned schema (a hand-rolled
+        # short dict broke schema consumers in the most common
+        # pre-commit case — review-round regression)
+    elif args.paths:
+        paths = [os.path.abspath(p) for p in args.paths]
+
+    baseline = load_baseline(args.baseline)
+    report = run(
+        paths=paths, passes=passes, baseline=baseline, root=args.root
+    )
+
+    if args.write_baseline:
+        write_baseline(args.baseline, report)
+        print(
+            f"lint: baseline written — {len(report.findings)} finding(s) "
+            f"grandfathered to {os.path.relpath(args.baseline, ROOT)}"
+        )
+        return 0
+
+    if args.as_json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        for f in report.active:
+            sym = f"  [{f.symbol}]" if f.symbol else ""
+            print(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}{sym}")
+        n_base = len(report.findings) - len(report.active)
+        print(
+            f"lint: {len(report.active)} active finding(s), "
+            f"{n_base} baselined, {report.suppressed} suppressed — "
+            f"{report.files_scanned} files in {report.runtime_s:.2f}s "
+            f"({len(report.passes)} passes)"
+        )
+    return 1 if report.active else 0

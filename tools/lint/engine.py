@@ -1,0 +1,381 @@
+"""Framework invariant linter — engine (ISSUE 13).
+
+Ten PRs of review rounds kept re-finding the same defect classes:
+iterate-while-mutated shared state, fsync/dump-under-lock ABBA
+deadlocks, per-call ``jax.jit`` closures that retrace every fit,
+unbounded metric labels, data-dependent shapes inside jitted code.
+This engine turns each class into a registered *pass* over every file's
+AST so the next instance is a tier-1 build failure, not a review-round
+catch (``docs/ARCHITECTURE.md`` §Static analysis has the rule catalog
+with the incident each rule descends from).
+
+Discipline (inherited from ``tools/check_obs.py``): pure ``ast`` +
+``tokenize``, **no jax import**, full-package runtime < 10s — so it can
+run as a pre-commit hook, a chaos preflight, and a tier-1 meta-test.
+
+Suppressions: ``# cmlhn: disable=<rule>[,<rule>] — <reason>`` on the
+offending line (or the line above, or any line the flagged node spans).
+The reason is MANDATORY — a bare disable is itself a finding
+(``suppression-missing-reason``): the comment is the review record for
+why the invariant doesn't apply, and an unexplained one is
+indistinguishable from a silenced bug.
+
+Baseline: ``tools/lint_baseline.json`` holds fingerprints of
+grandfathered findings (it ships empty — every pre-existing true
+positive was fixed in ISSUE 13, and the file exists so a future rule
+tightening can land without blocking on a fleet-wide cleanup).
+Fingerprints hash the *stripped source line*, not the line number, so
+unrelated edits above a baselined finding don't resurrect it.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import time
+import tokenize
+from dataclasses import dataclass, field
+
+from .astutils import build_parents, ConstStrResolver
+
+ENGINE_VERSION = 1
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PKG_NAME = "clustermachinelearningforhospitalnetworks_apache_spark_tpu"
+
+#: suppression comment — the em dash (or ``--``) separates rule list
+#: from the mandatory reason
+_SUPPRESS_RE = re.compile(
+    r"cmlhn:\s*disable=([A-Za-z0-9_,\-]+)\s*(?:—|--)?\s*(.*)$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    col: int
+    message: str
+    symbol: str = ""   # enclosing Class.function, for humans
+
+    def fingerprint(self, source_line: str) -> str:
+        h = hashlib.sha1(
+            f"{self.rule}:{self.path}:{source_line.strip()}".encode()
+        ).hexdigest()[:12]
+        return f"{self.rule}:{self.path}:{h}"
+
+
+@dataclass
+class FileContext:
+    """One parsed file, shared by every pass."""
+
+    path: str                      # absolute
+    rel: str                       # repo-relative (the reporting key)
+    source: str
+    tree: ast.Module
+    parents: dict
+    resolver: ConstStrResolver
+    lines: list[str]
+    #: line → set of disabled rule names ("*" = all)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+    #: findings raised by suppression parsing itself
+    suppression_problems: list[Finding] = field(default_factory=list)
+
+    def line_text(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    def symbol_at(self, node: ast.AST) -> str:
+        parts = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def is_suppressed(self, finding: Finding, node: ast.AST | None = None) -> bool:
+        lines = {finding.line, finding.line - 1}
+        if node is not None and getattr(node, "end_lineno", None):
+            start = node.lineno
+            # decorators precede a def's reported line — a directive
+            # above the decorator stack must still attach
+            for dec in getattr(node, "decorator_list", ()):
+                start = min(start, dec.lineno)
+            lines.update(range(start - 1, node.end_lineno + 1))
+        for ln in lines:
+            rules = self.suppressions.get(ln)
+            if rules and ("*" in rules or finding.rule in rules):
+                return True
+        return False
+
+
+class Pass:
+    """Base: subclasses set ``name``/``rules`` and implement
+    ``check_file`` (per-file findings) and/or ``finalize`` (whole-program
+    findings — lock-order cycles, obs coverage completeness)."""
+
+    name: str = ""
+    rules: tuple[str, ...] = ()
+
+    def applies_to(self, rel: str) -> bool:
+        """Library code only by default: the package dir, not bench or
+        examples (passes that need the wider emit set override this)."""
+        return rel.startswith(PKG_NAME + "/")
+
+    def check_file(self, ctx: FileContext, project: "Project"):
+        return ()
+
+    def finalize(self, project: "Project"):
+        return ()
+
+
+@dataclass
+class Project:
+    root: str
+    contexts: list[FileContext]
+    #: False for partial scans (explicit paths, --changed-only): program-
+    #: completeness rules (span-never-emitted, required-span-missing,
+    #: lock-order cycles across files) only make sense over the full set
+    complete: bool = True
+    #: scratch area passes use to accumulate cross-file state
+    state: dict = field(default_factory=dict)
+
+    def context(self, rel: str) -> FileContext | None:
+        for ctx in self.contexts:
+            if ctx.rel == rel:
+                return ctx
+        return None
+
+
+def _parse_suppressions(source: str, path: str, rel: str):
+    suppressions: dict[int, set[str]] = {}
+    problems: list[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            line = tok.start[0]
+            if not reason:
+                problems.append(Finding(
+                    rule="suppression-missing-reason",
+                    path=rel, line=line, col=tok.start[1],
+                    message=(
+                        "suppression without a reason — write "
+                        "'# cmlhn: disable=<rule> — <why the invariant "
+                        "does not apply here>'"
+                    ),
+                ))
+                continue  # an unexplained disable does NOT suppress
+            suppressions.setdefault(line, set()).update(rules)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # unparseable files are reported by load_file
+    return suppressions, problems
+
+
+def load_file(path: str, root: str = ROOT) -> FileContext | Finding:
+    rel = os.path.relpath(path, root)
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return Finding(
+            rule="syntax-error", path=rel, line=e.lineno or 1,
+            col=e.offset or 0, message=f"file does not parse: {e.msg}",
+        )
+    parents = build_parents(tree)
+    ctx = FileContext(
+        path=path, rel=rel, source=source, tree=tree, parents=parents,
+        resolver=ConstStrResolver(tree, parents),
+        lines=source.splitlines(),
+    )
+    ctx.suppressions, ctx.suppression_problems = _parse_suppressions(
+        source, path, rel
+    )
+    return ctx
+
+
+def default_roots(root: str = ROOT) -> list[str]:
+    """What a full run scans: the package (library code), plus bench.py
+    and examples/ (span-emission sources — check_obs rule 3 parity)."""
+    return [
+        os.path.join(root, PKG_NAME),
+        os.path.join(root, "bench.py"),
+        os.path.join(root, "examples"),
+    ]
+
+
+def collect_files(roots: list[str]) -> list[str]:
+    out: list[str] = []
+    for r in roots:
+        if os.path.isfile(r):
+            out.append(r)
+            continue
+        for dirpath, dirnames, filenames in os.walk(r):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(
+                os.path.join(dirpath, f) for f in filenames
+                if f.endswith(".py")
+            )
+    return sorted(set(out))
+
+
+@dataclass
+class Report:
+    findings: list[Finding]
+    fingerprints: dict          # id(finding-index) parallel list (fp str)
+    baselined: set[str]
+    suppressed: int
+    files_scanned: int
+    runtime_s: float
+    passes: list[str]
+    rules: list[str]
+
+    @property
+    def active(self) -> list[Finding]:
+        """Findings that gate the build (not grandfathered)."""
+        return [
+            f for f in self.findings
+            if self.fingerprints[id(f)] not in self.baselined
+        ]
+
+    def to_json(self) -> dict:
+        return {
+            "version": ENGINE_VERSION,
+            "passes": self.passes,
+            "rules": self.rules,
+            "files_scanned": self.files_scanned,
+            "runtime_s": round(self.runtime_s, 3),
+            "counts": {
+                "total": len(self.findings),
+                "baselined": len(self.findings) - len(self.active),
+                "suppressed": self.suppressed,
+                "active": len(self.active),
+            },
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "message": f.message,
+                    "symbol": f.symbol,
+                    "fingerprint": self.fingerprints[id(f)],
+                    "baselined": self.fingerprints[id(f)] in self.baselined,
+                }
+                for f in self.findings
+            ],
+        }
+
+
+def load_baseline(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("fingerprints", []))
+
+
+def write_baseline(path: str, report: Report) -> None:
+    fps = sorted({report.fingerprints[id(f)] for f in report.findings})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": ENGINE_VERSION, "fingerprints": fps}, f,
+                  indent=2)
+        f.write("\n")
+
+
+def run(
+    paths: list[str] | None = None,
+    passes: list[Pass] | None = None,
+    root: str = ROOT,
+    baseline: set[str] | None = None,
+    complete: bool | None = None,
+) -> Report:
+    """Run ``passes`` over ``paths`` (default: the full scan set).
+
+    ``complete`` defaults to True only for the default full scan —
+    program-completeness rules are skipped on partial scans so
+    ``--changed-only`` and fixture runs don't false-fire on "span never
+    emitted".
+    """
+    from .passes import all_passes  # local import: registry pulls passes in
+
+    t0 = time.perf_counter()
+    if passes is None:
+        passes = all_passes()
+    if complete is None:
+        complete = paths is None
+    files = collect_files(paths if paths is not None else default_roots(root))
+
+    contexts: list[FileContext] = []
+    findings: list[Finding] = []
+    for path in files:
+        got = load_file(path, root)
+        if isinstance(got, Finding):
+            findings.append(got)
+            continue
+        contexts.append(got)
+
+    project = Project(root=root, contexts=contexts, complete=complete)
+
+    suppressed = 0
+    for ctx in contexts:
+        findings.extend(ctx.suppression_problems)
+        for p in passes:
+            if not p.applies_to(ctx.rel):
+                continue
+            for f in p.check_file(ctx, project):
+                node = getattr(f, "_node", None)
+                if ctx.is_suppressed(f, node):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    for p in passes:
+        for f in p.finalize(project):
+            ctx = project.context(f.path)
+            if ctx is not None and ctx.is_suppressed(f):
+                suppressed += 1
+            else:
+                findings.append(f)
+
+    fingerprints = {}
+    for f in findings:
+        ctx = project.context(f.path)
+        line = ctx.line_text(f.line) if ctx else ""
+        fp = f.fingerprint(line)
+        # duplicate fingerprints (two findings of one rule on one line
+        # shape) collapse — acceptable for a baseline key
+        fingerprints[id(f)] = fp
+
+    return Report(
+        findings=sorted(
+            findings, key=lambda f: (f.path, f.line, f.col, f.rule)
+        ),
+        fingerprints=fingerprints,
+        baselined=baseline if baseline is not None else set(),
+        suppressed=suppressed,
+        files_scanned=len(files),
+        runtime_s=time.perf_counter() - t0,
+        passes=[p.name for p in passes],
+        rules=sorted({r for p in passes for r in p.rules}),
+    )
+
+
+def attach_node(finding: Finding, node: ast.AST) -> Finding:
+    """Remember the AST node so multi-line constructs honor suppressions
+    written on any physical line they span (frozen dataclass → object
+    attribute on the side)."""
+    object.__setattr__(finding, "_node", node)
+    return finding

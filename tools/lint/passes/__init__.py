@@ -1,0 +1,34 @@
+"""Pass registry: one place that knows every invariant pass.
+
+Adding a pass = write the module, import it here, append to
+``all_passes()`` (docs/ARCHITECTURE.md §Static analysis walks through
+the steps)."""
+
+from __future__ import annotations
+
+from .concurrency import ConcurrencyPass
+from .determinism import DeterminismPass
+from .jit_hygiene import JitHygienePass
+from .metric_labels import MetricLabelsPass
+from .obs_coverage import ObsCoveragePass
+from .trace_safety import TraceSafetyPass
+
+
+def all_passes():
+    return [
+        ConcurrencyPass(),
+        JitHygienePass(),
+        TraceSafetyPass(),
+        DeterminismPass(),
+        MetricLabelsPass(),
+        ObsCoveragePass(),
+    ]
+
+
+def passes_by_name(names) -> list:
+    byname = {p.name: p for p in all_passes()}
+    missing = [n for n in names if n not in byname]
+    if missing:
+        raise KeyError(f"unknown pass(es): {missing}; "
+                       f"known: {sorted(byname)}")
+    return [byname[n] for n in names]

@@ -1,0 +1,425 @@
+"""Concurrency-discipline pass (ISSUE 13 tentpole rule 1).
+
+Incident lineage:
+
+* ``lock-iter-snapshot`` — PR 10 review: ``ReplicaSet.health()`` walked
+  ``self._load_rows``/``obs_fragment`` dicts while a concurrent
+  ``kill_replica`` cleared batchers mid-walk → ``RuntimeError: dict
+  changed size during iteration`` out of the serving front door.  The
+  discipline: in a class that owns a ``threading.Lock``, iterating a
+  ``self.*`` dict/set attribute is only safe under that lock or over a
+  snapshot copy (``list(...)``/``dict(...)``/``.copy()``).
+* ``blocking-under-lock`` — PR 8 review: the breaker's open-transition
+  flight dump ran inside the breaker lock; collecting every breaker's
+  snapshot from there deadlocked (ABBA) with a registry collector and
+  stalled every concurrent ``allow()`` behind an fsync.  Blocking work
+  (fsync, sleep, file opens/renames, flight-recorder dumps) must be
+  staged under the lock and performed after release.
+* ``lock-order-cycle`` — same incident, generalized: the breaker→
+  registry and registry→breaker acquisition orders formed a cycle.
+  This rule builds the lexical lock-acquisition graph (one hop through
+  same-class methods) and flags any cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutils import call_name, dotted_name
+from ..engine import Finding, Pass, attach_node
+
+#: wrapping the iterable in any of these is a snapshot
+SNAPSHOT_FNS = {"list", "tuple", "dict", "set", "sorted", "frozenset"}
+#: direct calls that block (or do IO) and must not run lock-held
+BLOCKING_CALLS = {
+    "os.fsync", "os.fdatasync", "time.sleep", "os.replace", "os.rename",
+    "open", "shutil.move", "shutil.copy", "shutil.copytree",
+    "shutil.rmtree", "subprocess.run", "subprocess.check_call",
+    "subprocess.check_output",
+}
+#: method *names* that block regardless of receiver: the flight
+#: recorder's ``dump``, the WAL's fsync'd appends
+BLOCKING_METHOD_TAILS = {"fsync", "dump", "append_line", "append_lines"}
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _LOCK_CTORS:
+            return True
+        # dataclass field(default_factory=threading.Lock)
+        if name and name.split(".")[-1] == "field":
+            for kw in node.keywords:
+                if kw.arg == "default_factory" and \
+                        dotted_name(kw.value) in _LOCK_CTORS:
+                    return True
+    return False
+
+
+_CONTAINER_CTORS = {"dict", "set", "defaultdict", "OrderedDict", "Counter",
+                    "WeakValueDictionary", "WeakKeyDictionary"}
+
+
+def _is_container_ctor(node: ast.AST) -> bool:
+    """Dict/set constructions — the containers whose mutation during
+    iteration raises RuntimeError (lists mis-iterate but don't raise;
+    they stay out of scope to keep the rule high-precision)."""
+    if isinstance(node, (ast.Dict, ast.Set, ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name and name.split(".")[-1] in _CONTAINER_CTORS:
+            return True
+        if name and name.split(".")[-1] == "field":
+            for kw in node.keywords:
+                if kw.arg == "default_factory" and (
+                    dotted_name(kw.value) or ""
+                ).split(".")[-1] in _CONTAINER_CTORS:
+                    return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` → ``"X"``."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.locks: set[str] = set()       # self attrs holding locks
+        self.containers: set[str] = set()  # self attrs holding dict/set
+        self.mutated: set[str] = set()     # container attrs written to
+
+
+def _classify(cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None and isinstance(t, ast.Name):
+                    attr = t.id  # dataclass field at class level
+                if attr is None:
+                    continue
+                if _is_lock_ctor(node.value):
+                    info.locks.add(attr)
+                elif _is_container_ctor(node.value):
+                    info.containers.add(attr)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            attr = _self_attr(node.target)
+            if attr is None and isinstance(node.target, ast.Name):
+                attr = node.target.id
+            if attr is None:
+                continue
+            if _is_lock_ctor(node.value):
+                info.locks.add(attr)
+            elif _is_container_ctor(node.value):
+                info.containers.add(attr)
+    # mutations: self.X[k] = / del self.X[k] / self.X.pop/clear/update/add...
+    _MUTATORS = {"pop", "popitem", "clear", "update", "setdefault", "add",
+                 "discard", "remove"}
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = node.targets if isinstance(node, (ast.Assign, ast.Delete)) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr in info.containers:
+                        info.mutated.add(attr)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                attr = _self_attr(f.value)
+                if attr in info.containers:
+                    info.mutated.add(attr)
+    return info
+
+
+def _lock_id(expr: ast.AST, cls_name: str | None) -> str | None:
+    """Identity of a lock acquisition target for the order graph."""
+    attr = _self_attr(expr)
+    name = dotted_name(expr)
+    if attr is not None:
+        return f"{cls_name or '?'}.{attr}"
+    if name is not None:
+        return name
+    return None
+
+
+def _looks_like_lock(expr: ast.AST, locks: set[str], module_locks: set[str]) -> bool:
+    attr = _self_attr(expr)
+    if attr is not None:
+        return attr in locks or attr.endswith("lock")
+    name = dotted_name(expr)
+    if name is not None:
+        tail = name.split(".")[-1]
+        return name in module_locks or tail.endswith("lock")
+    return False
+
+
+def _with_lock_exprs(node: ast.With, locks, module_locks):
+    out = []
+    for item in node.items:
+        ce = item.context_expr
+        if isinstance(ce, ast.Call):  # lock.acquire() isn't a ctx mgr; skip
+            continue
+        if _looks_like_lock(ce, locks, module_locks):
+            out.append(ce)
+    return out
+
+
+class ConcurrencyPass(Pass):
+    name = "concurrency"
+    rules = ("lock-iter-snapshot", "blocking-under-lock", "lock-order-cycle")
+
+    def check_file(self, ctx, project):
+        module_locks = {
+            t.id
+            for node in ast.walk(ctx.tree) if isinstance(node, ast.Assign)
+            and _is_lock_ctor(node.value)
+            for t in node.targets if isinstance(t, ast.Name)
+        }
+        edges = project.state.setdefault("lock_edges", {})
+
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            info = _classify(cls)
+            # methods that acquire a lock, for the one-hop order graph
+            method_locks: dict[str, set[str]] = {}
+            for m in cls.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    held = set()
+                    for w in ast.walk(m):
+                        if isinstance(w, ast.With):
+                            for ce in _with_lock_exprs(
+                                w, info.locks, module_locks
+                            ):
+                                lid = _lock_id(ce, cls.name)
+                                if lid:
+                                    held.add(lid)
+                    if held:
+                        method_locks[m.name] = held
+
+            if info.locks:
+                yield from self._check_iteration(ctx, cls, info, module_locks)
+            yield from self._check_under_lock(
+                ctx, cls, info, module_locks, method_locks, edges
+            )
+
+        # module-level lock nesting (no class context)
+        yield from self._module_level_edges(ctx, module_locks, edges)
+
+    # ------------------------------------------------------ iteration
+    def _iter_exprs(self, fn):
+        """(iterable-expr, report-node) pairs: for loops + comprehensions."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For):
+                yield node.iter, node
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    yield gen.iter, node
+
+    def _container_iter_attr(self, expr: ast.AST, info) -> str | None:
+        """``self.X`` / ``self.X.items()|values()|keys()`` with X a known
+        dict/set container attr → X."""
+        attr = _self_attr(expr)
+        if attr in info.containers:
+            return attr
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in ("items", "values", "keys") \
+                and not expr.args:
+            attr = _self_attr(expr.func.value)
+            if attr in info.containers:
+                return attr
+        return None
+
+    def _under_lock(self, node, ctx, locks, module_locks) -> bool:
+        cur = ctx.parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            if isinstance(cur, ast.With) and _with_lock_exprs(
+                cur, locks, module_locks
+            ):
+                return True
+            cur = ctx.parents.get(cur)
+        # the iteration may itself be lexically inside the with body; the
+        # parent walk above covers that (With is an ancestor statement)
+        return False
+
+    def _check_iteration(self, ctx, cls, info, module_locks):
+        flagged: set[int] = set()
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for it, node in self._iter_exprs(fn):
+                # list(self.X.items()) / sorted(self.X) … = snapshot
+                if isinstance(it, ast.Call):
+                    nm = call_name(it)
+                    if nm in SNAPSHOT_FNS:
+                        continue
+                    if isinstance(it.func, ast.Attribute) and \
+                            it.func.attr == "copy":
+                        continue
+                attr = self._container_iter_attr(it, info)
+                if attr is None or attr not in info.mutated:
+                    # a dict that is only ever REBOUND (self.x = {...})
+                    # cannot change size mid-iteration — only in-place
+                    # mutation (subscript store, .pop/.clear/…) races
+                    continue
+                if self._under_lock(node, ctx, info.locks, module_locks):
+                    continue
+                if node.lineno in flagged:
+                    continue  # one report per line (nested comprehensions)
+                flagged.add(node.lineno)
+                yield attach_node(Finding(
+                    rule="lock-iter-snapshot",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"iterates self.{attr} (a dict/set mutated in "
+                        f"place elsewhere in lock-owning class {cls.name}) "
+                        "without holding the lock or snapshotting — a "
+                        "concurrent mutation raises RuntimeError "
+                        "mid-iteration; wrap in list()/dict() or take "
+                        "the lock"
+                    ),
+                    symbol=f"{cls.name}.{fn.name}",
+                ), node)
+
+    # ------------------------------------------------------ under-lock body
+    def _check_under_lock(self, ctx, cls, info, module_locks,
+                          method_locks, edges):
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for w in ast.walk(fn):
+                if not isinstance(w, ast.With):
+                    continue
+                lock_exprs = _with_lock_exprs(w, info.locks, module_locks)
+                if not lock_exprs:
+                    continue
+                outer_ids = [
+                    lid for ce in lock_exprs
+                    if (lid := _lock_id(ce, cls.name))
+                ]
+                for sub in ast.walk(w):
+                    if sub is w:
+                        continue
+                    # nested lock acquisition → order-graph edge
+                    if isinstance(sub, ast.With):
+                        for ce in _with_lock_exprs(
+                            sub, info.locks, module_locks
+                        ):
+                            inner = _lock_id(ce, cls.name)
+                            for outer in outer_ids:
+                                if inner and inner != outer:
+                                    edges.setdefault(
+                                        (outer, inner), []
+                                    ).append((ctx.rel, sub.lineno))
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = call_name(sub)
+                    tail = (name or "").split(".")[-1]
+                    # one-hop: self.method() that acquires another lock
+                    if isinstance(sub.func, ast.Attribute) and \
+                            _self_attr(sub.func) is not None and \
+                            sub.func.attr in method_locks:
+                        for inner in method_locks[sub.func.attr]:
+                            for outer in outer_ids:
+                                if inner != outer:
+                                    edges.setdefault(
+                                        (outer, inner), []
+                                    ).append((ctx.rel, sub.lineno))
+                    if name in BLOCKING_CALLS or (
+                        isinstance(sub.func, ast.Attribute)
+                        and tail in BLOCKING_METHOD_TAILS
+                    ):
+                        yield attach_node(Finding(
+                            rule="blocking-under-lock",
+                            path=ctx.rel, line=sub.lineno,
+                            col=sub.col_offset,
+                            message=(
+                                f"{name or tail}() runs while "
+                                f"{' / '.join(outer_ids)} is held — "
+                                "blocking IO under a lock stalls every "
+                                "waiter and invites ABBA deadlock; stage "
+                                "under the lock, perform after release"
+                            ),
+                            symbol=f"{cls.name}.{fn.name}",
+                        ), sub)
+
+    def _module_level_edges(self, ctx, module_locks, edges):
+        from ..astutils import enclosing_class
+
+        for w in ast.walk(ctx.tree):
+            if not isinstance(w, ast.With):
+                continue
+            if enclosing_class(w, ctx.parents) is not None:
+                # class methods were walked with their class's lock set;
+                # re-walking them here would name every self.*lock attr
+                # '?.<attr>' and conflate locks of DIFFERENT classes
+                # into phantom cycles
+                continue
+            outer_ids = [
+                lid for ce in _with_lock_exprs(w, set(), module_locks)
+                if (lid := _lock_id(ce, None))
+            ]
+            if not outer_ids:
+                continue
+            for sub in ast.walk(w):
+                if sub is w or not isinstance(sub, ast.With):
+                    continue
+                for ce in _with_lock_exprs(sub, set(), module_locks):
+                    inner = _lock_id(ce, None)
+                    for outer in outer_ids:
+                        if inner and inner != outer:
+                            edges.setdefault((outer, inner), []).append(
+                                (ctx.rel, sub.lineno)
+                            )
+        return ()
+
+    # ------------------------------------------------------ cycles
+    def finalize(self, project):
+        if not project.complete:
+            return
+        edges: dict = project.state.get("lock_edges", {})
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+
+        seen_cycles: set[tuple] = set()
+
+        def dfs(start, node, path):
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    yield tuple(path)
+                elif nxt not in path:
+                    yield from dfs(start, nxt, path + [nxt])
+
+        for start in sorted(graph):
+            for cycle in dfs(start, start, [start]):
+                key = tuple(sorted(cycle))
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                a, b = cycle[0], cycle[1 % len(cycle)]
+                rel, line = edges[(a, b)][0]
+                yield Finding(
+                    rule="lock-order-cycle",
+                    path=rel, line=line, col=0,
+                    message=(
+                        "lock acquisition order forms a cycle: "
+                        + " -> ".join(cycle) + " -> " + cycle[0]
+                        + " — two threads taking opposite ends deadlock "
+                        "(the PR 8 breaker/registry ABBA class); pick one "
+                        "global order or stage work outside the lock"
+                    ),
+                )

@@ -1,0 +1,172 @@
+"""Determinism pass (ISSUE 13 tentpole rule 4).
+
+The repo's contract is seeded-stream determinism end to end: every fit
+is reproducible from (data, seed), chaos tests replay byte-identical,
+and the farm/looped bit-parity gates depend on it.  Global-state RNG
+(``random.random()``, ``np.random.rand()``), unseeded generators
+(``np.random.default_rng()`` / ``random.Random()`` with no seed), and
+wall-clock reads inside numeric kernels all break that silently.
+
+Sanctioned sites (the ISSUE 13 list):
+
+* ``obs/trace.py`` — the span-id base is ``os.urandom`` on purpose
+  (process uniqueness, not reproducibility);
+* ``utils/retry.py`` — retry jitter is *entropy-seeded on purpose* so a
+  fleet of replaying sources doesn't back off in lockstep (PR 2
+  review); other deliberate jitter RNGs carry inline suppressions.
+
+Wall-clock (``time.time``/``datetime.now``) is only flagged in the
+numeric-kernel dirs (``models/``, ``farm/``, ``ops/``, ``stat/``,
+``core/``, ``features/``, ``tuning/``) — serving/streaming measure real
+latency and stamp real ingest times; kernels must not.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutils import call_name, dotted_name
+from ..engine import Finding, Pass, attach_node, PKG_NAME
+
+_KERNEL_DIRS = tuple(
+    f"{PKG_NAME}/{d}/" for d in
+    ("models", "farm", "ops", "stat", "core", "features", "tuning")
+)
+
+_SANCTIONED = {
+    "unseeded-random": (f"{PKG_NAME}/utils/retry.py",),
+    "urandom-in-library": (f"{PKG_NAME}/obs/trace.py",),
+}
+
+#: global-state RNG functions on the ``random`` module
+_RANDOM_GLOBALS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate",
+    "expovariate", "seed", "getrandbits", "random_bytes",
+}
+#: global-state RNG functions on ``np.random`` (the legacy non-Generator
+#: surface); ``default_rng``/``Generator``/``SeedSequence`` are the
+#: sanctioned constructors — seeded — and handled separately
+_NP_RANDOM_GLOBALS = {
+    "rand", "randn", "randint", "random", "random_sample", "choice",
+    "shuffle", "permutation", "uniform", "normal", "standard_normal",
+    "seed", "binomial", "poisson", "beta", "gamma", "exponential",
+}
+
+_WALLCLOCK = {"time.time", "time.time_ns", "datetime.now",
+              "datetime.utcnow", "datetime.datetime.now",
+              "datetime.datetime.utcnow"}
+
+
+def _sanctioned(rule: str, rel: str) -> bool:
+    return rel in _SANCTIONED.get(rule, ())
+
+
+class DeterminismPass(Pass):
+    name = "determinism"
+    rules = ("unseeded-random", "wallclock-in-kernel", "urandom-in-library")
+
+    def check_file(self, ctx, project):
+        in_kernel = ctx.rel.startswith(_KERNEL_DIRS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            parts = name.split(".")
+
+            f = None
+            if name == "os.urandom" and not _sanctioned(
+                "urandom-in-library", ctx.rel
+            ):
+                f = Finding(
+                    rule="urandom-in-library",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        "os.urandom in library code — entropy outside the "
+                        "sanctioned id-base site breaks replay; derive "
+                        "from the seeded stream (fold_in) instead"
+                    ),
+                    symbol=ctx.symbol_at(node),
+                )
+            elif not _sanctioned("unseeded-random", ctx.rel):
+                if len(parts) == 2 and parts[0] == "random" \
+                        and parts[1] in _RANDOM_GLOBALS:
+                    f = Finding(
+                        rule="unseeded-random",
+                        path=ctx.rel, line=node.lineno, col=node.col_offset,
+                        message=(
+                            f"{name}() uses the process-global RNG — "
+                            "unseeded and shared across subsystems; use a "
+                            "seeded random.Random(seed) / np.random."
+                            "default_rng(seed) stream"
+                        ),
+                        symbol=ctx.symbol_at(node),
+                    )
+                elif parts[-1] in _NP_RANDOM_GLOBALS and len(parts) >= 2 \
+                        and parts[-2] == "random" and parts[0] in (
+                            "np", "numpy"):
+                    f = Finding(
+                        rule="unseeded-random",
+                        path=ctx.rel, line=node.lineno, col=node.col_offset,
+                        message=(
+                            f"{name}() uses numpy's global RNG — use a "
+                            "seeded np.random.default_rng(seed) Generator"
+                        ),
+                        symbol=ctx.symbol_at(node),
+                    )
+                elif parts[-1] in ("default_rng", "Random", "RandomState") \
+                        and not node.args and not node.keywords \
+                        and (len(parts) == 1
+                             or parts[0] in ("np", "numpy", "random")):
+                    # len(parts)==1 covers direct imports:
+                    # `from numpy.random import default_rng; default_rng()`
+                    f = Finding(
+                        rule="unseeded-random",
+                        path=ctx.rel, line=node.lineno, col=node.col_offset,
+                        message=(
+                            f"{name}() without a seed draws from entropy "
+                            "— pass an explicit seed (or suppress with "
+                            "the documented jitter rationale)"
+                        ),
+                        symbol=ctx.symbol_at(node),
+                    )
+            if f is None and parts[-1] == "field" and not _sanctioned(
+                "unseeded-random", ctx.rel
+            ):
+                # dataclass field(default_factory=random.Random): an
+                # unseeded generator per instance
+                for kw in node.keywords:
+                    if kw.arg != "default_factory":
+                        continue
+                    factory = dotted_name(kw.value)
+                    if factory and factory.split(".")[-1] in (
+                        "Random", "default_rng", "RandomState"
+                    ):
+                        f = Finding(
+                            rule="unseeded-random",
+                            path=ctx.rel, line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"default_factory={factory} constructs an "
+                                "unseeded (entropy) generator per instance "
+                                "— seed it, or suppress with the "
+                                "documented jitter rationale"
+                            ),
+                            symbol=ctx.symbol_at(node),
+                        )
+            if f is None and in_kernel and name in _WALLCLOCK:
+                f = Finding(
+                    rule="wallclock-in-kernel",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"{name}() inside a numeric-kernel module — "
+                        "wall-clock in fit/transform paths breaks "
+                        "replayability (timestamps belong to ingest/"
+                        "serving layers; timing belongs to StageClock)"
+                    ),
+                    symbol=ctx.symbol_at(node),
+                )
+            if f is not None:
+                yield attach_node(f, node)

@@ -1,0 +1,260 @@
+"""jit-hygiene pass (ISSUE 13 tentpole rule 2).
+
+Incident lineage:
+
+* ``jit-in-function`` — PR 5 review: the fused boost scan was built as
+  a per-fit ``@jax.jit`` closure, so EVERY fit retraced and recompiled
+  it; repeated fits (and the bench) measured compile, not throughput
+  (fix lifted the cpu-proxy fused rate 11.4k→27.8k rec/s).  The
+  discipline: ``jax.jit`` applied inside a function/method body must be
+  reachable only through an ``lru_cache``/``cache``-decorated factory
+  (the ``_make_*`` pattern every model kernel uses) — a fresh jit
+  wrapper per call starts with an empty trace cache.
+* ``donated-arg-reused`` — donation (``donate_argnums``) invalidates
+  the caller's buffer; reading the donated array after the call is
+  use-after-free on device (garbage or a crash on TPU, silently "works"
+  on CPU).  Flagged when the donated positional argument is a plain
+  name that is read again after the call without being rebound.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutils import (
+    call_name, dotted_name, enclosing_functions, has_decorator,
+)
+from ..engine import Finding, Pass, attach_node
+
+_CACHE_DECOS = ("lru_cache", "cache", "cached_property")
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    return name is not None and (
+        name == "jit" or name.endswith(".jit")
+    ) and "pjit" not in name
+
+
+def _jit_decorated(fn) -> bool:
+    for name in (n for n in _decorator_dotted(fn)):
+        if name == "jit" or name.endswith(".jit"):
+            return True
+    return False
+
+
+def _decorator_dotted(fn):
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if name:
+                yield name
+            if name and name.split(".")[-1] == "partial":
+                for a in dec.args:
+                    inner = dotted_name(a)
+                    if inner:
+                        yield inner
+        else:
+            name = dotted_name(dec)
+            if name:
+                yield name
+
+
+def _donated_positions(call: ast.Call) -> list[int]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return []
+            if isinstance(val, int):
+                return [val]
+            if isinstance(val, (tuple, list)):
+                return [int(v) for v in val]
+    return []
+
+
+class JitHygienePass(Pass):
+    name = "jit_hygiene"
+    rules = ("jit-in-function", "donated-arg-reused")
+
+    def check_file(self, ctx, project):
+        yield from self._check_nested_jit(ctx)
+        yield from self._check_donated_reuse(ctx)
+
+    # ------------------------------------------------- retrace-per-call
+    def _check_nested_jit(self, ctx):
+        for node in ast.walk(ctx.tree):
+            jit_site = None
+            if isinstance(node, ast.Call) and _is_jit_call(node):
+                jit_site = node
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _jit_decorated(node):
+                jit_site = node
+            if jit_site is None:
+                continue
+            chain = [
+                fn for fn in enclosing_functions(jit_site, ctx.parents)
+                if not isinstance(fn, ast.Lambda)
+            ]
+            if isinstance(jit_site, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # for a decorated def, the *def*'s enclosing chain matters
+                chain = [fn for fn in chain if fn is not jit_site]
+            if not chain:
+                continue  # module/class level: compiled once per process
+            if any(has_decorator(fn, *_CACHE_DECOS) for fn in chain):
+                continue  # the sanctioned _make_* cached-factory pattern
+            if self._stored_on_instance(ctx, jit_site):
+                continue  # self._fn = jax.jit(…): the instance IS the cache
+            if self._cache_guarded(ctx, jit_site):
+                continue  # module-level dict/WeakKeyDictionary cache insert
+            fn_names = ", ".join(f.name for f in chain)
+            yield attach_node(Finding(
+                rule="jit-in-function",
+                path=ctx.rel, line=jit_site.lineno, col=jit_site.col_offset,
+                message=(
+                    f"jax.jit applied inside function body ({fn_names}) "
+                    "without an lru_cache'd factory — every call builds a "
+                    "fresh wrapper with an empty trace cache and "
+                    "recompiles (the PR 5 _make_boost_scan retrace-per-"
+                    "fit class); lift to module level or cache the "
+                    "factory with functools.lru_cache"
+                ),
+                symbol=ctx.symbol_at(jit_site),
+            ), jit_site)
+
+    def _stored_on_instance(self, ctx, node) -> bool:
+        """``self.X = jax.jit(...)`` (directly or through a wrapping
+        call): the jit wrapper lives as long as the object — a warm
+        per-instance executable, not a per-call rebuild."""
+        cur = ctx.parents.get(node)
+        while cur is not None and isinstance(cur, (ast.Call, ast.Tuple,
+                                                   ast.IfExp)):
+            cur = ctx.parents.get(cur)
+        if isinstance(cur, ast.Assign):
+            for t in cur.targets:
+                if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name
+                ) and t.value.id == "self":
+                    return True
+        if isinstance(cur, ast.AnnAssign) and isinstance(
+            cur.target, ast.Attribute
+        ) and isinstance(cur.target.value, ast.Name) \
+                and cur.target.value.id == "self":
+            return True
+        return False
+
+    def _cache_guarded(self, ctx, node) -> bool:
+        """``_CACHE[key] = jax.jit(...)`` / ``cache.setdefault(key,
+        jax.jit(...))`` — an explicit memo insert is a cache by
+        construction."""
+        cur = ctx.parents.get(node)
+        while cur is not None and isinstance(
+            cur, (ast.Call, ast.IfExp, ast.Tuple, ast.List)
+        ):
+            if isinstance(cur, ast.Call) and isinstance(
+                cur.func, ast.Attribute
+            ) and cur.func.attr == "setdefault":
+                return True
+            cur = ctx.parents.get(cur)
+        if isinstance(cur, ast.Assign):
+            return any(isinstance(t, ast.Subscript) for t in cur.targets)
+        return False
+
+    # ------------------------------------------------- donated reuse
+    def _check_donated_reuse(self, ctx):
+        # donated callables bound in this module: name -> donated positions
+        donated: dict[str, list[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and _is_jit_call(node.value):
+                pos = _donated_positions(node.value)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            donated[t.id] = pos
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and (
+                        _is_jit_call(dec)
+                        or (call_name(dec) or "").split(".")[-1] == "partial"
+                        and dec.args and (dotted_name(dec.args[0]) or ""
+                                          ).endswith("jit")
+                    ):
+                        pos = _donated_positions(dec)
+                        if pos:
+                            donated[node.name] = pos
+
+        if not donated:
+            return
+
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = [
+                c for c in ast.walk(fn)
+                if isinstance(c, ast.Call) and isinstance(c.func, ast.Name)
+                and c.func.id in donated
+            ]
+            for call in calls:
+                rebound = self._rebinds_result(ctx, call)
+                for pos in donated[call.func.id]:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    if arg.id in rebound:
+                        continue  # state = f(state, …) — the donation idiom
+                    use = self._first_use_after(fn, call, arg.id)
+                    if use is not None:
+                        yield attach_node(Finding(
+                            rule="donated-arg-reused",
+                            path=ctx.rel, line=use.lineno,
+                            col=use.col_offset,
+                            message=(
+                                f"'{arg.id}' was donated to "
+                                f"{call.func.id}() (donate_argnums={pos}) "
+                                f"at line {call.lineno} and is read again "
+                                "here — the buffer is invalidated by "
+                                "donation; rebind the result or drop "
+                                "donation for this argument"
+                            ),
+                            symbol=ctx.symbol_at(call),
+                        ), use)
+
+    def _rebinds_result(self, ctx, call: ast.Call) -> set[str]:
+        """Names the call's result is assigned to (incl. tuple unpack)."""
+        parent = ctx.parents.get(call)
+        # unwrap e.g. tuple-returning calls: x, y = f(...)
+        while parent is not None and isinstance(parent, (ast.Tuple, ast.Starred)):
+            parent = ctx.parents.get(parent)
+        out: set[str] = set()
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(parent, (ast.AnnAssign, ast.AugAssign)):
+            for sub in ast.walk(parent.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        return out
+
+    def _first_use_after(self, fn, call: ast.Call, name: str):
+        """First Name node for ``name`` after the call line; a Load →
+        violation node, a Store → rebound, safe.  Line-ordered — a
+        deliberate lexical approximation (loops that swing back are rare
+        in kernel call sites and suppressible)."""
+        end = getattr(call, "end_lineno", call.lineno)
+        nodes = [
+            n for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and n.id == name
+            and n.lineno > end
+        ]
+        nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+        for n in nodes:
+            if isinstance(n.ctx, ast.Store):
+                return None
+            return n
+        return None

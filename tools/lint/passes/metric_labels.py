@@ -1,0 +1,194 @@
+"""Metric/label-hygiene pass (ISSUE 13 tentpole rule 5).
+
+Incident lineage: PR 9/10 reviews — per-tenant and caller-supplied
+label values written raw into metric names made every distinct runtime
+value a new Prometheus series (unbounded cardinality) and an interning
+key.  The write-side discipline: ``tenant``-shaped breakdowns go
+through ``obs.registry.cohort_label`` (crc32 → 32 buckets) and replica
+breakdowns through ``obs.registry.replica_label`` (bounded r00-r255,
+format-pinned).
+
+This is check_obs rules 5–6 generalized from regex to AST: labels are
+found as ``key="{value}"`` segments of f-strings, the value expression
+is resolved through one aliasing hop (``lbl = replica_label(i)`` …
+``f'…replica="{lbl}"'`` passes — the regex version could only accept
+same-line minting), and ``str()``/raw names of runtime data fail.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutils import call_name
+from ..engine import Finding, Pass, attach_node
+
+#: label keys whose values MUST be minted by the named bounded minter
+GUARDED_KEYS = {
+    "tenant": "cohort_label",
+    "tenant_id": "cohort_label",
+    "cohort": "cohort_label",
+    "replica": "replica_label",
+}
+
+#: a constant f-string segment ending in `key="` or `key=` right before
+#: a formatted value
+_KEY_BEFORE_VALUE = re.compile(r"(\w+)=\"?$")
+
+
+class MetricLabelsPass(Pass):
+    name = "metric_labels"
+    rules = ("raw-metric-label",)
+
+    def applies_to(self, rel: str) -> bool:
+        if rel.endswith("obs/registry.py") or rel.endswith("obs/export.py"):
+            return False  # the minters and the parser themselves
+        return super().applies_to(rel)
+
+    def check_file(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.JoinedStr):
+                yield from self._check_segments(
+                    ctx, node, self._fstring_segments(node)
+                )
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+                # only check the OUTERMOST Add of a concat chain
+                parent = ctx.parents.get(node)
+                if isinstance(parent, ast.BinOp) and isinstance(
+                    parent.op, ast.Add
+                ):
+                    continue
+                yield from self._check_segments(
+                    ctx, node, self._concat_segments(node)
+                )
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr == "format" and isinstance(
+                node.func.value, ast.Constant
+            ) and isinstance(node.func.value.value, str):
+                yield from self._check_format(ctx, node)
+
+    def _fstring_segments(self, node: ast.JoinedStr):
+        """(constant-text, value-expr) pairs from an f-string."""
+        parts = node.values
+        for i, part in enumerate(parts):
+            if isinstance(part, ast.FormattedValue) and i > 0 and \
+                    isinstance(parts[i - 1], ast.Constant):
+                yield str(parts[i - 1].value), part.value
+
+    def _concat_segments(self, node: ast.BinOp):
+        """(constant-text, value-expr) pairs from a `"…" + expr + …`
+        chain — the shape the old regex caught and the f-string-only
+        port missed."""
+        flat: list = []
+
+        def flatten(n):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+                flatten(n.left)
+                flatten(n.right)
+            else:
+                flat.append(n)
+
+        flatten(node)
+        for prev, cur in zip(flat, flat[1:]):
+            if isinstance(prev, ast.Constant) and isinstance(prev.value, str):
+                yield prev.value, cur
+
+    def _check_segments(self, ctx, node, segments):
+        for prev, value in segments:
+            m = _KEY_BEFORE_VALUE.search(prev)
+            if m is None:
+                continue
+            key = m.group(1)
+            minter = GUARDED_KEYS.get(key)
+            if minter is None:
+                continue
+            if self._minted(ctx, value, minter):
+                continue
+            yield attach_node(Finding(
+                rule="raw-metric-label",
+                path=ctx.rel, line=value.lineno, col=value.col_offset,
+                message=(
+                    f'label {key}="…" built from a raw runtime value '
+                    f"— every distinct value becomes its own metric "
+                    f"series (unbounded cardinality); mint it with "
+                    f"obs.registry.{minter}(…)"
+                ),
+                symbol=ctx.symbol_at(node),
+            ), node)
+
+    _FORMAT_FIELD = re.compile(r"(\w+)=\"?\{")
+
+    def _check_format(self, ctx, node: ast.Call):
+        """``'…tenant=\"{}\"'.format(t)`` — if the template labels a
+        guarded key with a placeholder, every argument must be minted
+        (conservative: field→arg mapping is not reconstructed; the
+        suppression mechanism covers deliberate exceptions)."""
+        template = node.func.value.value
+        keys = {
+            m.group(1) for m in self._FORMAT_FIELD.finditer(template)
+            if m.group(1) in GUARDED_KEYS
+        }
+        if not keys:
+            return
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for key in sorted(keys):
+            minter = GUARDED_KEYS[key]
+            if all(self._minted(ctx, a, minter) for a in args):
+                continue
+            yield attach_node(Finding(
+                rule="raw-metric-label",
+                path=ctx.rel, line=node.lineno, col=node.col_offset,
+                message=(
+                    f'label {key}="…" filled via .format() from a raw '
+                    f"runtime value — unbounded metric cardinality; "
+                    f"mint it with obs.registry.{minter}(…)"
+                ),
+                symbol=ctx.symbol_at(node),
+            ), node)
+
+    def _minted(self, ctx, expr: ast.AST, minter: str) -> bool:
+        """The value expr is (an alias of) a call to the required
+        bounded minter."""
+        if isinstance(expr, ast.Call):
+            name = call_name(expr)
+            return name is not None and name.split(".")[-1] == minter
+        if isinstance(expr, ast.FormattedValue):
+            return self._minted(ctx, expr.value, minter)
+        if isinstance(expr, ast.Name):
+            # one aliasing hop: lbl = replica_label(i); f'…="{lbl}"' —
+            # resolved in the ENCLOSING scope only (a mint in one
+            # function must not legitimize a same-named raw value in
+            # another; review-round regression, same class as the
+            # ConstStrResolver scope leak)
+            from ..astutils import _scope_walk, enclosing_functions
+
+            fns = enclosing_functions(expr, ctx.parents)
+            scope = fns[0] if fns else ctx.tree
+            if not isinstance(scope, ast.Lambda):
+                if _is_param(scope, expr.id):
+                    return False  # caller-supplied: raw by definition
+            assigns = [
+                n for n in _scope_walk(scope)
+                if isinstance(n, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in n.targets
+                )
+            ]
+            if len(assigns) == 1 and isinstance(assigns[0].value, ast.Call):
+                name = call_name(assigns[0].value)
+                return name is not None and name.split(".")[-1] == minter
+        return False
+
+
+def _is_param(fn, name: str) -> bool:
+    if not hasattr(fn, "args"):
+        return False
+    a = fn.args
+    params = [*a.posonlyargs, *a.args, *a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg)
+    if a.kwarg:
+        params.append(a.kwarg)
+    return any(p.arg == name for p in params)

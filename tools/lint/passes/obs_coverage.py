@@ -1,0 +1,302 @@
+"""Observability-coverage pass — the AST port of ``tools/check_obs.py``
+rules 1–6 (ISSUE 13 satellite; the label halves of rules 5–6 live in
+:mod:`.metric_labels`).
+
+Cross-checks the source against the literal registries in
+``obs/trace.py`` (read via ``ast.literal_eval`` — still no import, no
+jax):
+
+1. every named fault site (``fault_point``/``torn_point``/
+   ``mangle_bytes``/``corrupt_data``/``data_rules_active`` call, or a
+   ``*_SITE`` constant) must match a ``SITE_COVERAGE`` glob;
+2. every ``SITE_COVERAGE`` target must be a registered span;
+3. every emitted span name must be registered, and (full scans only)
+   every registered name must be emitted somewhere;
+4. lifecycle journal states exist and the transition/retrain/promote/
+   rollback spans are emitted;  5/6. the farm and fleet span sets stay
+   emitted.
+
+Bugfix vs the regex version (ISSUE 13 satellite): names that reach the
+hook through an f-string, a once-assigned alias, or a parameter default
+(``streaming/wal.py::append_lines(site="wal.append")``) are RESOLVED
+and checked — the regexes silently skipped them.  A name the resolver
+cannot pin down is its own violation (``dynamic-span-name`` /
+``dynamic-fault-site``) instead of a silent gap; a constant-prefix
+dynamic name (the StageClock ``"stage." + name`` sink) passes only when
+the derived glob is itself a registered entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+
+from ..astutils import call_name, literal_eval_assign
+from ..engine import Finding, Pass, attach_node, PKG_NAME
+
+_SITE_HOOKS = {
+    "fault_point", "torn_point", "mangle_bytes", "corrupt_data",
+    "data_rules_active",
+}
+_SPAN_HOOKS = {"span", "record_span"}
+_SITE_CONST = re.compile(r"^[A-Z0-9_]*SITE[A-Z0-9_]*$")
+
+_TRACE_REL = f"{PKG_NAME}/obs/trace.py"
+#: the hook implementation: its defs forward ``site`` parameters by
+#: construction — caller sites are where literals are checked
+_FAULTS_REL = f"{PKG_NAME}/utils/faults.py"
+
+_REQUIRED_SPANS = {
+    "lifecycle": ("lifecycle.transition", "lifecycle.retrain",
+                  "lifecycle.promote", "lifecycle.rollback"),
+    "farm": ("farm.fit", "farm.refit", "farm.predict"),
+    "fleet": ("fleet.request", "fleet.promote", "router.route"),
+}
+
+_STATE_CONST = re.compile(r"^STATE_[A-Z_]+$")
+
+
+class ObsCoveragePass(Pass):
+    name = "obs_coverage"
+    rules = (
+        "fault-site-uncovered", "coverage-target-unregistered",
+        "span-unregistered", "span-never-emitted", "required-span-missing",
+        "dynamic-span-name", "dynamic-fault-site",
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        # span emissions also come from bench.py and examples/
+        return rel.startswith(PKG_NAME + "/") or rel == "bench.py" \
+            or rel.startswith("examples/")
+
+    # ---------------------------------------------------------- collect
+    def check_file(self, ctx, project):
+        st = project.state.setdefault("obs", {
+            "sites": {},          # site -> (rel, line) first seen
+            "emitted": set(),
+            "emitted_globs": set(),
+            "states": [],
+            "has_controller": False,
+        })
+        if ctx.rel == _TRACE_REL:
+            return  # the registry itself
+
+        in_pkg = ctx.rel.startswith(PKG_NAME + "/")
+
+        if in_pkg and ctx.rel != _FAULTS_REL:
+            yield from self._collect_sites(ctx, st)
+        yield from self._collect_spans(ctx, st)
+
+        if ctx.rel.endswith("lifecycle/controller.py"):
+            st["has_controller"] = True
+            for node in ctx.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and \
+                                _STATE_CONST.match(t.id) and isinstance(
+                                    node.value, ast.Constant):
+                            st["states"].append(node.value.value)
+
+    def _collect_sites(self, ctx, st):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and _SITE_CONST.match(t.id) \
+                            and isinstance(node.value, ast.Constant) \
+                            and isinstance(node.value.value, str):
+                        site = node.value.value
+                        if "*" not in site:
+                            st["sites"].setdefault(
+                                site, (ctx.rel, node.lineno)
+                            )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None or name.split(".")[-1] not in _SITE_HOOKS:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and _SITE_CONST.match(arg.id):
+                # a *_SITE constant imported from its defining module —
+                # the definition site registers it (the const collector)
+                continue
+            site, is_glob = ctx.resolver.resolve(arg)
+            if site is None or is_glob:
+                yield attach_node(Finding(
+                    rule="dynamic-fault-site",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        "fault-site name cannot be resolved to a literal "
+                        "— a dynamic site silently escapes SITE_COVERAGE "
+                        "checking; pass a literal/once-assigned constant "
+                        "(the regexes used to skip these silently)"
+                    ),
+                    symbol=ctx.symbol_at(node),
+                ), node)
+                continue
+            if "*" in site:
+                continue  # a rule glob, not a site
+            st["sites"].setdefault(site, (ctx.rel, node.lineno))
+
+    def _collect_spans(self, ctx, st):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None or name.split(".")[-1] not in _SPAN_HOOKS:
+                continue
+            if not node.args:
+                continue
+            span_name, is_glob = ctx.resolver.resolve(node.args[0])
+            if span_name is None:
+                yield attach_node(Finding(
+                    rule="dynamic-span-name",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        "span name cannot be resolved to a literal or a "
+                        "constant-prefix glob — dynamic span names "
+                        "escape the REGISTERED_SPANS check and can "
+                        "explode the span vocabulary; use a literal, a "
+                        "once-assigned constant, or a registered "
+                        "'prefix.*' sink"
+                    ),
+                    symbol=ctx.symbol_at(node),
+                ), node)
+                continue
+            if is_glob:
+                st["emitted_globs"].add(
+                    (span_name, ctx.rel, node.lineno, node.col_offset)
+                )
+            else:
+                st["emitted"].add(span_name)
+
+    # ---------------------------------------------------------- check
+    def finalize(self, project):
+        st = project.state.get("obs")
+        if st is None:
+            return
+        trace_ctx = project.context(_TRACE_REL)
+        if trace_ctx is None:
+            if project.complete:
+                yield Finding(
+                    rule="coverage-target-unregistered", path=_TRACE_REL,
+                    line=1, col=0,
+                    message="obs/trace.py not in scan set — registries "
+                            "unavailable",
+                )
+            return
+        try:
+            registered = tuple(literal_eval_assign(
+                trace_ctx.tree, "REGISTERED_SPANS"
+            ))
+            coverage = dict(literal_eval_assign(
+                trace_ctx.tree, "SITE_COVERAGE"
+            ))
+        except LookupError as e:
+            yield Finding(
+                rule="coverage-target-unregistered", path=_TRACE_REL,
+                line=1, col=0,
+                message=f"obs/trace.py: {e.args[0]} literal not found",
+            )
+            return
+
+        reg_line = self._assign_line(trace_ctx.tree, "REGISTERED_SPANS")
+        cov_line = self._assign_line(trace_ctx.tree, "SITE_COVERAGE")
+
+        # constant-prefix dynamic spans: pass only as a registered glob
+        emitted = set(st["emitted"])
+        for glob, rel, line, col in st["emitted_globs"]:
+            if glob in registered:
+                emitted.add(glob)
+            else:
+                yield Finding(
+                    rule="dynamic-span-name", path=rel, line=line, col=col,
+                    message=(
+                        f"dynamic span name with constant prefix "
+                        f"{glob!r} is not a registered glob sink — "
+                        "register the 'prefix.*' entry or use a literal"
+                    ),
+                )
+
+        # 1. every fault site mapped to a span
+        for site, (rel, line) in sorted(st["sites"].items()):
+            if not any(fnmatch.fnmatchcase(site, p) for p in coverage):
+                yield Finding(
+                    rule="fault-site-uncovered", path=rel, line=line, col=0,
+                    message=(
+                        f"fault site {site!r} has no obs.trace."
+                        "SITE_COVERAGE entry — decide which span its "
+                        "failures show up under"
+                    ),
+                )
+        # 2. coverage targets are registered spans
+        for glob, span_name in sorted(coverage.items()):
+            if not any(fnmatch.fnmatchcase(span_name, p) for p in registered):
+                yield Finding(
+                    rule="coverage-target-unregistered", path=_TRACE_REL,
+                    line=cov_line, col=0,
+                    message=(
+                        f"SITE_COVERAGE[{glob!r}] -> {span_name!r} is not "
+                        "in REGISTERED_SPANS"
+                    ),
+                )
+        # 3a. emitted spans are registered
+        for name in sorted(emitted):
+            if name in registered:
+                continue
+            if not any(fnmatch.fnmatchcase(name, p) for p in registered):
+                yield Finding(
+                    rule="span-unregistered", path=_TRACE_REL,
+                    line=reg_line, col=0,
+                    message=(
+                        f"span {name!r} is emitted but not in "
+                        "REGISTERED_SPANS"
+                    ),
+                )
+
+        if not project.complete:
+            return  # completeness rules need the full emit set
+
+        # 3b. registered spans are emitted (no aspirational entries)
+        for name in registered:
+            ok = name in emitted or any(
+                fnmatch.fnmatchcase(e, name) for e in emitted
+            )
+            if not ok:
+                yield Finding(
+                    rule="span-never-emitted", path=_TRACE_REL,
+                    line=reg_line, col=0,
+                    message=f"REGISTERED_SPANS entry {name!r} is never "
+                            "emitted",
+                )
+        # 4/5/6. journal states + required span sets
+        if st["has_controller"] and not st["states"]:
+            yield Finding(
+                rule="required-span-missing",
+                path=f"{PKG_NAME}/lifecycle/controller.py", line=1, col=0,
+                message="no STATE_* journal-state constants found — the "
+                        "journaled state machine has drifted",
+            )
+        for family, names in _REQUIRED_SPANS.items():
+            for required in names:
+                if required not in emitted:
+                    yield Finding(
+                        rule="required-span-missing", path=_TRACE_REL,
+                        line=reg_line, col=0,
+                        message=(
+                            f"{family} span {required!r} is not emitted — "
+                            f"the {family} subsystem has drifted from its "
+                            "instrumentation"
+                        ),
+                    )
+
+    def _assign_line(self, tree, name: str) -> int:
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return node.lineno
+        return 1

@@ -1,0 +1,197 @@
+"""Trace-safety pass (ISSUE 13 tentpole rule 3).
+
+The pad-and-weight contract's static half: inside code that XLA traces
+(``@jax.jit`` bodies, ``lax.scan``/``while_loop``/``fori_loop``/
+``cond`` branch functions), shapes must be static and values must stay
+on device.  Data-dependent shapes (boolean-mask indexing) either fail
+to trace or silently fall back to per-shape recompiles; host coercions
+(``.item()``, ``float()``, ``np.asarray``, ``jax.device_get``) insert
+a device→host sync per call — the O(M·depth) host-round-trip class
+PR 5 eliminated from boosting.
+
+Scope: the numeric-kernel surfaces named by ISSUE 13 — ``models/``,
+``farm/``, ``core/sql_compile.py`` — where the contract is load-bearing
+(serve/streaming host code coerces legitimately all over).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutils import call_name, dotted_name
+from ..engine import Finding, Pass, attach_node, PKG_NAME
+
+_SCOPES = (
+    f"{PKG_NAME}/models/",
+    f"{PKG_NAME}/farm/",
+    f"{PKG_NAME}/core/sql_compile.py",
+)
+
+#: tracing consumer → which argument positions hold traced callables
+#: (while_loop traces cond AND body; fori_loop's body is arg 2; cond's
+#: branches are args 1-2; switch takes every branch after the index)
+_TRACING_CONSUMERS: dict[str, tuple[int, ...]] = {
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "switch": (1, 2, 3, 4, 5, 6, 7),
+    "map": (0,),
+    "associative_scan": (0,),
+    "checkpoint": (0,),
+    "custom_vjp": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "shard_map": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+}
+
+_HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "np.frombuffer",
+}
+
+
+def _is_jit_like(name: str | None) -> bool:
+    return name is not None and (name == "jit" or name.endswith(".jit"))
+
+
+def _shape_static(node: ast.AST) -> bool:
+    """float()/int() of shapes, lengths, dtypes, constants is static —
+    not a trace-time host sync."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name == "len":
+            return True
+        if name and name.split(".")[-1] in ("prod", "ceil", "floor", "log2"):
+            return all(_shape_static(a) for a in node.args)
+    if isinstance(node, ast.Attribute):
+        if node.attr in ("shape", "ndim", "size", "dtype"):
+            return True
+        return _shape_static(node.value)
+    if isinstance(node, ast.Subscript):
+        return _shape_static(node.value)
+    if isinstance(node, ast.BinOp):
+        return _shape_static(node.left) and _shape_static(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _shape_static(node.operand)
+    return False
+
+
+class TraceSafetyPass(Pass):
+    name = "trace_safety"
+    rules = ("host-sync-in-jit", "bool-mask-in-jit")
+
+    def applies_to(self, rel: str) -> bool:
+        return any(rel.startswith(s) or rel == s.rstrip("/") for s in _SCOPES)
+
+    # -------------------------------------------------- traced bodies
+    def _traced_functions(self, ctx) -> list[ast.AST]:
+        """FunctionDef/Lambda nodes whose bodies XLA traces."""
+        traced: list[ast.AST] = []
+        local_defs: dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(node.name, node)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dec_name = dotted_name(
+                        dec.func if isinstance(dec, ast.Call) else dec
+                    )
+                    if _is_jit_like(dec_name):
+                        traced.append(node)
+                    elif isinstance(dec, ast.Call) and (
+                        dec_name or ""
+                    ).split(".")[-1] == "partial" and dec.args and \
+                            _is_jit_like(dotted_name(dec.args[0])):
+                        traced.append(node)
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                tail = (name or "").split(".")[-1]
+                if _is_jit_like(name):
+                    positions: tuple[int, ...] = (0,)
+                elif tail in _TRACING_CONSUMERS:
+                    positions = _TRACING_CONSUMERS[tail]
+                else:
+                    continue
+                for pos in positions:
+                    if pos >= len(node.args):
+                        continue
+                    arg = node.args[pos]
+                    if isinstance(arg, ast.Lambda):
+                        traced.append(arg)
+                    elif isinstance(arg, ast.Name) and arg.id in local_defs:
+                        traced.append(local_defs[arg.id])
+        return traced
+
+    def check_file(self, ctx, project):
+        reported: set[int] = set()
+        for fn in self._traced_functions(ctx):
+            for node in ast.walk(fn):
+                f = self._check_node(ctx, node)
+                if f is not None and f.line not in reported:
+                    reported.add(f.line)
+                    yield f
+
+    def _check_node(self, ctx, node) -> Finding | None:
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                return attach_node(Finding(
+                    rule="host-sync-in-jit",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        ".item() inside a traced body forces a device→"
+                        "host sync per trace — keep the value on device "
+                        "(jnp ops) or hoist the readback outside the "
+                        "jitted region"
+                    ),
+                    symbol=ctx.symbol_at(node),
+                ), node)
+            if name in _HOST_SYNC_CALLS:
+                return attach_node(Finding(
+                    rule="host-sync-in-jit",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"{name}() inside a traced body concretizes a "
+                        "traced value (host round trip / trace error) — "
+                        "use jnp equivalents on device"
+                    ),
+                    symbol=ctx.symbol_at(node),
+                ), node)
+            if name in ("float", "int", "bool") and node.args and \
+                    not _shape_static(node.args[0]):
+                return attach_node(Finding(
+                    rule="host-sync-in-jit",
+                    path=ctx.rel, line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"{name}() coercion of a (potentially traced) "
+                        "value inside a traced body — concretization "
+                        "error or per-call sync; compute with jnp and "
+                        "coerce outside the traced region"
+                    ),
+                    symbol=ctx.symbol_at(node),
+                ), node)
+        elif isinstance(node, ast.Subscript):
+            index = node.slice
+            elems = index.elts if isinstance(index, ast.Tuple) else [index]
+            for e in elems:
+                if isinstance(e, (ast.Compare, ast.BoolOp)):
+                    return attach_node(Finding(
+                        rule="bool-mask-in-jit",
+                        path=ctx.rel, line=node.lineno, col=node.col_offset,
+                        message=(
+                            "boolean-mask indexing inside a traced body "
+                            "is a data-dependent shape — XLA cannot "
+                            "compile it; use jnp.where weighting (the "
+                            "pad-and-weight contract) instead"
+                        ),
+                        symbol=ctx.symbol_at(node),
+                    ), node)
+        return None

@@ -46,6 +46,15 @@ if [[ "${1:-}" != "--slow" ]]; then
     MARK="chaos and not slow"
 fi
 
+# ISSUE 13 preflight: the framework invariant linter must be clean before
+# burning minutes on the kill matrix — a concurrency/obs-coverage
+# violation is exactly the kind of bug this matrix would chase for hours
+echo "== lint preflight =="
+if ! python tools/lint.py; then
+    echo "lint preflight FAILED — fix (or suppress with a reason) before running chaos"
+    exit 1
+fi
+
 export CMLHN_FLIGHT_DIR=$(mktemp -d /tmp/chaos_flight.XXXXXX)
 
 LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
