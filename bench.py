@@ -2087,6 +2087,155 @@ def _bench_sql_device() -> dict:
         session.stop()
 
 
+def _bench_sql_incremental() -> dict:
+    """ISSUE 14: incremental streaming SQL — device-maintained
+    materialized views vs per-batch full recompute.
+
+    The trajectory: N committed batches stream into an unbounded table
+    carrying (a) a GROUP BY aggregate view (mergeable partials, the
+    paper's per-hospital stats shape, watermark-sealed compaction) and
+    (b) a row-level window-extract view (the retrain's training window).
+    Per batch, three measured legs:
+
+    * **maintain + serve** — the incremental path: fold the batch's
+      jitted partial/delta into view state, then answer from it
+      (O(batch) + O(groups));
+    * **full recompute** — the PR 6 status quo: rebuild the snapshot and
+      run the compiled plan over ALL history (O(history) per batch);
+    * **retrain read** — the ingest→retrain-snapshot latency, view path
+      vs snapshot+SQL path, early vs late in the run (the view's must
+      not grow with history).
+
+    Gates: exact per-batch parity (``compare_tables``, the PR 6 float64
+    discipline) between view state and full recompute on EVERY commit;
+    ``vs_baseline`` = full/incremental per-batch cost over the last 4
+    batches (acceptance ≥ 3, expected ≥ 5× by ≥ 32 batches on the CPU
+    proxy); ``maintain_flatness`` ~ 1 shows per-batch cost flat as the
+    table grows."""
+    import shutil
+    import tempfile
+
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import (
+        execute,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_fuzz import (
+        compare_tables,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_views import (
+        ViewRegistry,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.unbounded_table import (
+        UnboundedTable,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.watermark import (
+        WatermarkTracker,
+    )
+
+    platform, on_tpu, n, _, _mesh, _n_chips = _bench_setup(2_000_000)
+    # floor 12: the early (4:8) / late (-4:) medians need non-empty
+    # windows, or the row would carry NaN (non-strict JSON)
+    n_batches = max(int(os.environ.get("BENCH_SQL_BATCHES", "40")), 12)
+    rows = max(n // n_batches, 256)
+    rng = np.random.default_rng(0)
+    base_ts = np.datetime64("2025-03-31T00:00:00")
+
+    def make_batch(b: int):
+        t = (
+            base_ts
+            + (b * 3600 + rng.integers(0, 3600, rows)).astype("timedelta64[s]")
+        ).astype("datetime64[ns]")
+        return ht.Table.from_dict(
+            {
+                "hospital": rng.integers(0, 16, rows),
+                "event_time": t,
+                "admissions": rng.integers(0, 50, rows),
+                "occupancy": rng.normal(250.0, 40.0, rows),
+            }
+        )
+
+    agg_q = (
+        "SELECT hospital, count(*) AS c, sum(admissions) AS adm,"
+        " avg(occupancy) AS occ, max(occupancy) AS peak"
+        " FROM events GROUP BY hospital"
+    )
+    win_q = (
+        "SELECT admissions, occupancy FROM events"
+        " WHERE event_time >= '2025-03-31 00:00:00'"
+    )
+    d = tempfile.mkdtemp(prefix="bench_sql_inc_")
+    try:
+        sink = UnboundedTable(d, make_batch(0).schema, name="events")
+        wt = WatermarkTracker("event_time", 120.0)  # 2 h: old batches seal
+        reg = ViewRegistry()
+        agg_view = reg.register("hospital_stats", agg_q, sink, watermark=wt)
+        win_view = reg.register("train_window", win_q, sink)
+
+        inc_ms, full_ms = [], []
+        rt_view_ms, rt_full_ms = [], []
+        parity_exact = True
+        for b in range(n_batches):
+            tb = make_batch(b)
+            wt.filter_late(tb)  # advance event time like the stream would
+            sink.append_batch(tb, b)
+            t0 = time.perf_counter()
+            reg.maintain(sink, b)
+            got = agg_view.read()
+            t1 = time.perf_counter()
+            # the status quo pays the snapshot rebuild + full plan run
+            want = execute(agg_q, lambda _n: sink.read(), mode="auto")
+            t2 = time.perf_counter()
+            inc_ms.append((t1 - t0) * 1e3)
+            full_ms.append((t2 - t1) * 1e3)
+            if compare_tables(want, got) is not None:
+                parity_exact = False
+            t3 = time.perf_counter()
+            win_view.read(upto_batch_id=b)
+            t4 = time.perf_counter()
+            execute(
+                win_q,
+                lambda _n: sink.read(upto_batch_id=b),
+                mode="interpret",
+            )
+            t5 = time.perf_counter()
+            rt_view_ms.append((t4 - t3) * 1e3)
+            rt_full_ms.append((t5 - t4) * 1e3)
+
+        def med(xs):
+            return float(np.median(xs)) if xs else float("nan")
+
+        early = slice(4, 8)
+        late = slice(-4, None)
+        speedup = med(full_ms[late]) / max(med(inc_ms[late]), 1e-9)
+        return {
+            "metric": (
+                f"incremental view maintain+serve vs per-batch full "
+                f"recompute ({n_batches} batches x {rows} rows, {platform})"
+            ),
+            "value": round(speedup, 2),
+            "unit": "x_full_recompute_per_batch",
+            "vs_baseline": round(speedup, 2),  # acceptance gate: >= 3
+            "parity_exact_every_batch": parity_exact,
+            "batches": n_batches,
+            "rows_per_batch": rows,
+            "maintain_serve_ms_early": round(med(inc_ms[early]), 3),
+            "maintain_serve_ms_late": round(med(inc_ms[late]), 3),
+            "maintain_flatness": round(
+                med(inc_ms[late]) / max(med(inc_ms[early]), 1e-9), 2
+            ),
+            "full_recompute_ms_early": round(med(full_ms[early]), 3),
+            "full_recompute_ms_late": round(med(full_ms[late]), 3),
+            "retrain_read_ms_view_early": round(med(rt_view_ms[early]), 3),
+            "retrain_read_ms_view_late": round(med(rt_view_ms[late]), 3),
+            "retrain_read_ms_full_early": round(med(rt_full_ms[early]), 3),
+            "retrain_read_ms_full_late": round(med(rt_full_ms[late]), 3),
+            "agg_view": agg_view.describe(),
+            "platform": platform,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _bench_lifecycle() -> dict:
     """Continuous-learning config (ISSUE 9): the closed loop, measured.
 
@@ -3012,6 +3161,7 @@ CONFIGS = {
     "chaos": lambda: _bench_chaos(),                            # fault recovery
     "quality": lambda: _bench_quality(),                        # data firewall
     "sql_device": lambda: _bench_sql_device(),                  # ISSUE 7 A/B
+    "sql_incremental": lambda: _bench_sql_incremental(),        # ISSUE 14 views
     "lifecycle": lambda: _bench_lifecycle(),                    # ISSUE 9 loop
     "obs_overhead": lambda: _bench_obs_overhead(),              # ISSUE 10 gate
     "model_farm": lambda: _bench_model_farm(),                  # ISSUE 11 A/B
@@ -3256,8 +3406,8 @@ def _child_main(name: str) -> None:
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
     "kmeans256", "pallas_ab", "kmeans_fused_ab", "model_farm", "serve_fleet",
-    "sql_device", "rf20", "gbt20", "nb", "gmm32", "bisecting", "streaming",
-    "streaming_pipeline", "kmeans8", "serve",
+    "sql_device", "sql_incremental", "rf20", "gbt20", "nb", "gmm32",
+    "bisecting", "streaming", "streaming_pipeline", "kmeans8", "serve",
 ]
 
 

@@ -800,10 +800,12 @@ class SqlCompileUnsupported(ValueError):
 class DispatchRecord:
     """One ``execute`` decision: which executor ran and, on a fallback,
     the per-plan-node reasons — the observability surface ISSUE 7 asks
-    for ("fallback decisions recorded per plan node")."""
+    for ("fallback decisions recorded per plan node").  ISSUE 14 adds
+    the ``"view"`` route: the query was answered from a fresh
+    materialized view matched by plan fingerprint."""
 
     query: str
-    route: str                     # "compiled" | "interpreter"
+    route: str                     # "compiled" | "interpreter" | "view"
     reasons: tuple = ()            # ((node_op, reason), ...) when fallback
     fingerprint: str | None = None
 
@@ -852,9 +854,14 @@ def dispatch_counts() -> dict[str, int]:
 
 def explain(query: str, resolve_table) -> dict:
     """Planner view of a query WITHOUT running it: route it would take,
-    plan fingerprint, and one entry per plan node with its supported/
-    fallback decision."""
+    plan fingerprint, one entry per plan node with its supported/
+    fallback decision, and (ISSUE 14) each node's **incremental**
+    decision — ``"incremental"`` vs ``"full-recompute:<reason>"`` — so
+    materialized-view coverage is observable per clause.  The top-level
+    ``view_maintenance`` key summarizes: ``"incremental"`` when a view
+    over this plan would be delta-maintained, else the sorted reasons."""
     from .sql_plan import plan_query
+    from .sql_views import plan_is_incremental
 
     node = parse(query)
     if not isinstance(node, _Query):
@@ -862,6 +869,7 @@ def explain(query: str, resolve_table) -> dict:
             "route": "interpreter",
             "nodes": [],
             "fallback": [REASON_SETOP],
+            "view_maintenance": [REASON_SETOP[1]],
         }
     plan = plan_query(node, resolve_table)
     if plan is None:
@@ -869,6 +877,7 @@ def explain(query: str, resolve_table) -> dict:
             "route": "interpreter",
             "nodes": [],
             "fallback": [REASON_JOIN_SUBQUERY],
+            "view_maintenance": [REASON_JOIN_SUBQUERY[1]],
         }
     fallback = list(plan.fallback_reasons())
     route = "compiled" if plan.fully_supported else "interpreter"
@@ -876,18 +885,24 @@ def explain(query: str, resolve_table) -> dict:
         # report what execute() will actually do under the kill switch
         route = "interpreter"
         fallback = [REASON_DISABLED]
+    inc_ok, inc_reasons = plan_is_incremental(plan)
+    if not _compile_enabled():
+        # the kill switch stops view maintenance too (the partials are
+        # compiled kernels) — explain must not report "incremental"
+        # while every view is serving full recomputes
+        from .sql_views import FULL_COMPILE_DISABLED
+
+        inc_ok, inc_reasons = False, [FULL_COMPILE_DISABLED]
     return {
         "route": route,
         "fingerprint": plan.fingerprint,
-        "nodes": [
-            {"op": n.op, "supported": n.supported, "reason": n.reason}
-            for n in plan.nodes
-        ],
+        "nodes": plan.explain(),  # ONE copy of the per-node dict shape
         "fallback": fallback,
+        "view_maintenance": "incremental" if inc_ok else inc_reasons,
     }
 
 
-def execute(query: str, resolve_table, mode: str = "auto") -> Table:
+def execute(query: str, resolve_table, mode: str = "auto", views=None) -> Table:
     """Run a query; ``resolve_table(name) -> Table`` supplies FROM/JOIN.
 
     Dispatch (the Flare move, ISSUE 7): single-table SELECTs whose whole
@@ -896,6 +911,14 @@ def execute(query: str, resolve_table, mode: str = "auto") -> Table:
     everything else — strings in compute, joins, set ops, ordered
     windows, the long tail — runs on the numpy interpreter below, with
     the per-node fallback reasons recorded in :func:`last_dispatch`.
+
+    ``views`` (ISSUE 14): a ``core.sql_views.ViewRegistry`` — when a
+    registered materialized view matches the plan's fingerprint and is
+    fresh (its delta-maintained state covers exactly the snapshot's
+    rows), the query is answered from the view instead of re-executing
+    over history (route ``"view"``; ``sql.view.{hit,miss}`` counters).
+    Only ``mode="auto"`` consults views — "interpret"/"compile" force a
+    real recompute, which is what the parity harnesses compare against.
 
     ``mode``: "auto" (default) picks per the plan; "interpret" forces the
     numpy interpreter; "compile" requires the compiled path and raises
@@ -907,7 +930,7 @@ def execute(query: str, resolve_table, mode: str = "auto") -> Table:
     """
     sp = _trace.span("sql.query")
     with sp:
-        out = _execute_dispatched(query, resolve_table, mode)
+        out = _execute_dispatched(query, resolve_table, mode, views)
         if sp.trace_id is not None:
             d = last_dispatch()
             if d is not None and d.query == query:
@@ -917,7 +940,7 @@ def execute(query: str, resolve_table, mode: str = "auto") -> Table:
         return out
 
 
-def _execute_dispatched(query: str, resolve_table, mode: str) -> Table:
+def _execute_dispatched(query: str, resolve_table, mode: str, views=None) -> Table:
     if mode not in ("auto", "interpret", "compile"):
         raise ValueError(f"execute mode must be auto|interpret|compile, got {mode!r}")
     q = parse(query)
@@ -928,6 +951,30 @@ def _execute_dispatched(query: str, resolve_table, mode: str) -> Table:
             from .sql_plan import plan_query
 
             plan = plan_query(q, resolve_table)
+        if (
+            views is not None
+            and mode == "auto"
+            and plan is not None
+            and plan.fully_supported
+        ):
+            try:
+                served = views.serve_for(plan)
+            except Exception as e:  # defensive, same contract as the
+                # compiled branch below: a view-layer runtime failure
+                # (kernel error, corrupt persisted state) must degrade
+                # to the real executors, never take the query down
+                served = None
+                from ..utils.logging import get_logger
+
+                _global_registry().inc("sql.view.serve_errors")
+                get_logger("sql").warning(
+                    "materialized-view serve failed; falling through to "
+                    "the compiled/interpreter path",
+                    error=repr(e),
+                )
+            if served is not None:
+                record_dispatch(query, "view", (), plan.fingerprint)
+                return served
         if plan is not None and plan.fully_supported:
             from .sql_compile import run_plan
 

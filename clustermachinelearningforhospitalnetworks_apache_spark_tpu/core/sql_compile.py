@@ -39,7 +39,7 @@ Null semantics are the interpreter's, pinned by the fuzz harness
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from functools import reduce
 from typing import Any
 
@@ -677,6 +677,111 @@ def compact_dataset(x, y, w, out_bucket: int):
 
     fn = _get_kernel("compact", sig, out_bucket, build)
     return fn(x, y, w)
+
+
+# --------------------------------------------------- incremental partials
+def partial_plan_outputs(outputs: tuple, group_keys: tuple):
+    """Aggregate outputs → the mergeable-partials rewrite the incremental
+    view layer (``core/sql_views.py``) maintains per committed batch.
+
+    The original count/sum/avg/min/max outputs are rewritten to raw
+    **accumulators** — per-source non-null count + sum (avg = sum/count at
+    finalize), min, max, and the row count — the ``quality/sketches.py``
+    discipline: every accumulator merges across batches by addition (or
+    monotone min/max), so a view's state folds exactly-once per batch
+    instead of re-scanning history.
+
+    → ``(partial_outputs, accs, finalize)``:
+
+    * ``partial_outputs`` — the derived plan's output spec: one ``("key",
+      i, "__k<i>")`` per group key plus one aggregate per accumulator
+      (aliases ``__a<j>``), runnable through the SAME jitted segment
+      kernels as a full aggregate;
+    * ``accs`` — ordered accumulator ids: ``("rows",)`` | ``("n", src)``
+      | ``("s", src)`` | ``("min", src)`` | ``("max", src)``;
+    * ``finalize`` — per original output, how to read the answer back out
+      of merged accumulators: ``("key", idx, alias)`` | ``("rows", j,
+      alias)`` | ``("count", j, alias)`` | ``("sum"|"avg", s_j, n_j,
+      alias)`` | ``("min"|"max", m_j, n_j, alias)``.
+    """
+    accs: list[tuple] = []
+
+    def acc(key: tuple) -> int:
+        if key not in accs:
+            accs.append(key)
+        return accs.index(key)
+
+    finalize: list[tuple] = []
+    for o in outputs:
+        if o[0] == "key":
+            finalize.append(("key", o[1], o[2]))
+        elif o[0] == "count_star":
+            finalize.append(("rows", acc(("rows",)), o[1]))
+        else:
+            _, agg, src, alias = o
+            if agg == "count":
+                finalize.append(("count", acc(("n", src)), alias))
+            elif agg in ("sum", "avg"):
+                finalize.append(
+                    (agg, acc(("s", src)), acc(("n", src)), alias)
+                )
+            else:  # min | max need the non-null count for the all-null gate
+                finalize.append(
+                    (agg, acc((agg, src)), acc(("n", src)), alias)
+                )
+    partial: list[tuple] = [
+        ("key", i, f"__k{i}") for i in range(len(group_keys))
+    ]
+    for j, a in enumerate(accs):
+        alias = f"__a{j}"
+        if a[0] == "rows":
+            partial.append(("count_star", alias))
+        elif a[0] == "n":
+            partial.append(("agg", "count", a[1], alias))
+        elif a[0] == "s":
+            partial.append(("agg", "sum", a[1], alias))
+        else:
+            partial.append(("agg", a[0], a[1], alias))
+    return tuple(partial), tuple(accs), tuple(finalize)
+
+
+def run_partial_aggregate(plan, table: Table, clock=None):
+    """One committed batch's mergeable partial of an aggregate plan — the
+    delta half of the view layer's delta-merge: the accumulator rewrite of
+    :func:`partial_plan_outputs` run through the jitted segment machinery
+    over ONLY the batch's rows (one cached executable per (plan shape,
+    batch bucket); the merge is O(groups) host work in ``sql_views``).
+
+    → ``(key_arrays, acc_matrix, accs)`` where ``key_arrays`` holds one
+    raw host array per group key (float64 with NaN nulls for ``f``; int64
+    for ``i``; int64 nanoseconds with the NaT sentinel for ``t``) and
+    ``acc_matrix`` is float64 ``[n_groups, len(accs)]`` (sums of all-null
+    groups come back NaN — the caller zero-gates them on the matching
+    count before folding).
+    """
+    p_out, accs, _fin = partial_plan_outputs(plan.outputs, plan.group_keys)
+    dplan = replace(plan, outputs=p_out, limit=None, source=None)
+    out = _run_aggregate(dplan, table, clock)
+    keys = []
+    for i, (_src, ch) in enumerate(plan.group_keys):
+        col = out.column(f"__k{i}")
+        if ch == "t":
+            keys.append(col.astype("datetime64[ns]").view(np.int64))
+        elif ch == "f":
+            keys.append(np.asarray(col, dtype=np.float64))
+        else:
+            keys.append(np.asarray(col, dtype=np.int64))
+    if accs:
+        mat = np.stack(
+            [
+                np.asarray(out.column(f"__a{j}"), dtype=np.float64)
+                for j in range(len(accs))
+            ],
+            axis=1,
+        )
+    else:  # pure GROUP BY keys, no aggregates: group existence only
+        mat = np.zeros((len(out), 0), dtype=np.float64)
+    return keys, mat, accs
 
 
 # ------------------------------------------------------------ execution

@@ -267,13 +267,21 @@ def _shrink_candidates(spec: QuerySpec):
                 yield replace(spec, items=kept)
 
 
-def shrink(spec: QuerySpec, table: Table, max_steps: int = 200) -> QuerySpec:
+def shrink(
+    spec: QuerySpec, table, max_steps: int = 200, check=None
+) -> QuerySpec:
     """Greedy minimization: keep applying the first still-failing
-    reduction until none applies."""
+    reduction until none applies.  ``check(spec, ctx)`` defaults to the
+    two-executor parity check (resolved at call time, so tests can
+    monkeypatch it); the incremental leg passes :func:`check_view_spec`
+    with its batch/replay sequence as ``ctx`` — one shrinker for both
+    harnesses."""
+    if check is None:
+        check = check_spec
     steps = 0
     while steps < max_steps:
         for cand in _shrink_candidates(spec):
-            if check_spec(cand, table):
+            if check(cand, table):
                 spec = cand
                 steps += 1
                 break
@@ -298,4 +306,101 @@ def run_fuzz(
         if bad:
             small = shrink(spec, table)
             failures.append((small.sql(), check_spec(small, table) or bad))
+    return failures
+
+
+# ------------------------------------------------- incremental-view leg
+@dataclass(frozen=True)
+class ReplaySeq:
+    """One randomized ingest history: initial batches (ids 0..n−1) then
+    replays — ``(batch_id, new_table)`` overwrites of an already-
+    committed batch, the late-row/retraction path."""
+
+    batches: tuple
+    replays: tuple = ()
+
+
+def mergeable_query(rng: np.random.Generator) -> QuerySpec:
+    """A random query inside the view layer's mergeable subset: no
+    whole-partition windows, no LIMIT (both are full-recompute-only —
+    ``core/sql_views.py`` reason constants)."""
+    while True:
+        spec = random_query(rng)
+        if spec.kind != "window" and spec.limit is None:
+            return spec
+
+
+def check_view_spec(spec: QuerySpec, seq: ReplaySeq) -> str | None:
+    """ISSUE 14 satellite: replay one randomized batch/late-row sequence
+    through an unbounded table with a registered materialized view and
+    assert, **exactly after every commit**, view state == full recompute
+    (the numpy interpreter over the table's snapshot).  → None (parity)
+    or a mismatch description."""
+    import shutil
+    import tempfile
+
+    from ..streaming.unbounded_table import UnboundedTable
+    from .sql import execute
+    from .sql_views import ViewRegistry
+
+    q = spec.sql()
+    d = tempfile.mkdtemp(prefix="sql_view_fuzz_")
+    try:
+        sink = UnboundedTable(d, seq.batches[0].schema, name="fuzz")
+        reg = ViewRegistry()
+        view = reg.register("fuzz_view", q, sink)
+
+        def compare(step: str) -> str | None:
+            got = view.read()
+            snap = sink.read()
+            want = execute(q, lambda _n: snap, mode="interpret")
+            bad = compare_tables(want, got)
+            return f"{step}: {bad}" if bad else None
+
+        for bid, t in enumerate(seq.batches):
+            sink.append_batch(t, bid)
+            reg.maintain(sink, bid)
+            bad = compare(f"after batch {bid}")
+            if bad:
+                return bad
+        for bid, t in seq.replays:
+            sink.append_batch(t, bid)
+            reg.maintain(sink, bid)
+            bad = compare(f"after replaying batch {bid}")
+            if bad:
+                return bad
+        return None
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def run_fuzz_incremental(
+    n_queries: int = 10, seed: int = 0
+) -> list[tuple[str, str]]:
+    """Incremental leg of the harness: random mergeable-subset queries
+    over randomized batch/late-row sequences, view state checked against
+    a full recompute after every commit; mismatches come back shrunk
+    (the same structural shrinker as :func:`run_fuzz`)."""
+    rng = np.random.default_rng(seed)
+    failures: list[tuple[str, str]] = []
+    for _ in range(n_queries):
+        n_batches = int(rng.integers(2, 5))
+        batches = tuple(
+            random_table(rng, int(rng.integers(0, 120)))
+            for _ in range(n_batches)
+        )
+        replays = ()
+        if rng.random() < 0.6:
+            replays = (
+                (
+                    int(rng.integers(0, n_batches)),
+                    random_table(rng, int(rng.integers(1, 120))),
+                ),
+            )
+        seq = ReplaySeq(batches, replays)
+        spec = mergeable_query(rng)
+        bad = check_view_spec(spec, seq)
+        if bad:
+            small = shrink(spec, seq, check=check_view_spec)
+            failures.append((small.sql(), check_view_spec(small, seq) or bad))
     return failures

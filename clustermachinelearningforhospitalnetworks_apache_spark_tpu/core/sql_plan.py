@@ -115,6 +115,24 @@ class LogicalPlan:
         return (self.kind, self.filter, self.outputs, self.group_keys,
                 self.col_types)
 
+    def explain(self) -> list[dict]:
+        """Per-clause-node view: the supported/fallback decision plus
+        (ISSUE 14) the **incremental** decision — ``"incremental"`` when
+        a materialized view maintains this clause per committed batch,
+        else a ``"full-recompute:<reason>"`` constant
+        (``core/sql_views.py``'s reason-constant set)."""
+        from .sql_views import incremental_decisions  # lazy: avoids cycle
+
+        return [
+            {
+                "op": n.op,
+                "supported": n.supported,
+                "reason": n.reason,
+                "incremental": d,
+            }
+            for n, d in zip(self.nodes, incremental_decisions(self))
+        ]
+
 
 def _col_char(table, name: str) -> str:
     """Device dtype char from the ACTUAL numpy dtype (schema INT columns

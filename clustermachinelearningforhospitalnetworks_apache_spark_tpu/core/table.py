@@ -329,6 +329,18 @@ class Table:
         """
         key = (name, int(bucket))
         arr = self._device_cache.get(key)
+        # cache effectiveness on the process registry (ISSUE 14): a miss
+        # is a fresh host→device transfer; the view layer changes how
+        # often queries pay it, and before these counters that pressure
+        # was invisible.  Named per subsystem (device vs snapshot memo)
+        # so the ~1-per-column device increments can't statistically
+        # drown the snapshot memo's O(history) rebuild signal.
+        from ..obs.registry import global_registry
+
+        global_registry().inc(
+            "sql.cache.device.hit" if arr is not None
+            else "sql.cache.device.miss"
+        )
         if arr is None:
             import jax
             from jax.experimental import enable_x64

@@ -231,6 +231,7 @@ class LifecycleController:
         canary_min_rows: int = 48,
         recover_after_rows: int | None = None,
         base_seed: int = 0,
+        training_view=None,
     ):
         self.root = root
         self.server = server
@@ -240,6 +241,18 @@ class LifecycleController:
         self.sink = sink if sink is not None else (
             stream.sink if stream is not None else None
         )
+        #: materialized view (ISSUE 14) the retrain reads its training
+        #: window from — already delta-maintained per committed batch, so
+        #: the ingest→retrain-snapshot path stops paying O(history); the
+        #: journaled snapshot pin still applies (``read(upto_batch_id)``)
+        self.training_view = training_view
+        if training_view is not None and self.sink is not None and (
+            os.path.abspath(training_view.source.path)
+            != os.path.abspath(self.sink.path)
+        ):
+            raise ValueError(
+                "training_view must be a view over the controller's sink"
+            )
         self.metric_fn = metric_fn
         self.feedback = feedback
         self.fallback = fallback
@@ -662,7 +675,13 @@ class LifecycleController:
         cand = int(info["candidate_version"])
         seed = int(info["seed"])
         upto = info.get("snapshot_batch_id")
-        table = self.sink.read(upto_batch_id=upto)
+        if self.training_view is not None:
+            # the view is already current per committed batch — the pinned
+            # read folds retained deltas ≤ the journaled snapshot id
+            # instead of re-scanning the table's history
+            table = self.training_view.read(upto_batch_id=upto)
+        else:
+            table = self.sink.read(upto_batch_id=upto)
         if len(table) == 0:
             raise RuntimeError("retrain snapshot is empty")
         t0 = time.perf_counter()

@@ -52,6 +52,8 @@ REGISTERED_SPANS = (
     "stream.quarantine",
     "stage.*",
     "sql.query",
+    "sql.view.maintain",  # per-commit delta fold into a materialized view
+    "sql.view.serve",     # answering a query/read from view state
     "serve.request",
     "lifecycle.transition",
     "lifecycle.retrain",
@@ -89,6 +91,7 @@ SITE_COVERAGE = {
     "lifecycle.rollback": "lifecycle.rollback",
     "lifecycle.feedback.*": "lifecycle.feedback",
     "fleet.swap.*": "fleet.promote",
+    "sql.view.maintain": "sql.view.maintain",
 }
 
 _CTX: contextvars.ContextVar = contextvars.ContextVar("obs_trace", default=None)

@@ -69,6 +69,12 @@ class Session:
         self.metrics = MetricsRegistry()
         self._tables: dict[str, Any] = {}
         self._streams: list[StreamExecution] = []
+        # materialized views (ISSUE 14): one registry per session; the
+        # streaming commit path maintains them, Session.sql serves from
+        # them when a plan fingerprint matches a fresh view
+        from .core.sql_views import ViewRegistry
+
+        self.views = ViewRegistry()
         _ACTIVE_SESSION = self
 
     # builder ----------------------------------------------------------
@@ -125,18 +131,57 @@ class Session:
         Dispatch (ISSUE 7): fully-supported single-table plans run as
         jitted columnar XLA kernels over device-held columns; the long
         tail runs the numpy interpreter (``sql_explain`` shows which,
-        and why, per plan node)."""
+        and why, per plan node).  ISSUE 14: when a registered
+        materialized view matches the plan's fingerprint and is fresh,
+        the answer comes from the view's delta-maintained state instead
+        of re-executing over the table's full history."""
         from .core.sql import execute
 
-        return execute(query, self.table)
+        return execute(query, self.table, views=self.views)
 
     def sql_explain(self, query: str) -> dict:
         """Planner view of ``query`` without running it: the route
-        (compiled | interpreter), the plan fingerprint, and every plan
-        node's supported/fallback decision."""
+        (compiled | interpreter), the plan fingerprint, every plan
+        node's supported/fallback decision, and each node's incremental
+        decision (``incremental`` vs ``full-recompute:<reason>`` —
+        whether a materialized view would maintain it per batch)."""
         from .core.sql import explain
 
         return explain(query, self.table)
+
+    def create_view(
+        self, name: str, query: str, watermark=None
+    ) -> Any:
+        """Register a materialized view (ISSUE 14) over a registered
+        :class:`~.streaming.unbounded_table.UnboundedTable`: the view is
+        maintained incrementally per committed batch (mergeable
+        aggregate partials / per-batch row deltas) and ``Session.sql``
+        transparently answers matching queries from it.  ``watermark``
+        (a ``WatermarkTracker``, typically the stream's) enables sealing
+        + compaction of aggregate partials below the event-time
+        watermark.  Non-incrementalizable queries still register but
+        serve loud full recomputes (``sql_explain`` shows why per
+        node)."""
+        from .core.sql_parse import _Query, parse
+
+        node = parse(query)
+        if (
+            not isinstance(node, _Query)
+            or not isinstance(node.table[0], str)
+            or node.joins
+        ):
+            raise ValueError(
+                "a materialized view needs a single-table SELECT over a "
+                "registered unbounded table"
+            )
+        source = self._tables.get(node.table[0])
+        if not isinstance(source, UnboundedTable):
+            raise ValueError(
+                f"view {name!r}: {node.table[0]!r} is not a registered "
+                "UnboundedTable (views materialize over the streaming "
+                "sink; plain tables are already in memory)"
+            )
+        return self.views.register(name, query, source, watermark=watermark)
 
     def sql_to_device(
         self,
@@ -178,9 +223,13 @@ class Session:
                     na_drop=na_drop,
                 )
         # host fallback: interpreter (or compiled materialization) +
-        # host-side assembly — one transfer at the to_device boundary
+        # host-side assembly — one transfer at the to_device boundary.
+        # A fresh fingerprint-matched materialized view answers here too
+        # (ISSUE 14): the fused device path above stays view-free (it
+        # never materializes), but the host path's table may as well
+        # come from folded view state instead of a history re-scan.
         with stage("sql"):
-            t = execute(query, self.table)
+            t = execute(query, self.table, views=self.views)
         if label_col is None and LABEL_COL in t.schema:
             label_col = LABEL_COL
         if na_drop:
@@ -300,6 +349,9 @@ class StreamWriter:
             checkpoint=StreamCheckpoint(ckpt_path),
             watermark=self.frame.watermark,
             foreach_batch=self._foreach,
+            # the session's materialized views fold each committed
+            # batch's delta in on the commit path (ISSUE 14)
+            views=self.frame.session.views,
         )
         self.frame.session.register_table(name, sink)
         self.frame.session._streams.append(execution)

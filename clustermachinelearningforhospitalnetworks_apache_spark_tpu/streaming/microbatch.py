@@ -94,6 +94,12 @@ class StreamExecution:
     #: data-quality firewall: when set, source reads salvage + validate
     #: per row and rejects land in ``<ckpt>/quarantine/rows/``
     firewall: "DataFirewall | None" = None
+    #: materialized-view registry (ISSUE 14, ``core/sql_views.py``): when
+    #: set, every view over this sink folds the batch's delta in right
+    #: after the commit record lands — exactly once per committed batch
+    #: (the view's high-water mark skips replays; a crash mid-maintenance
+    #: is healed by the next refresh from the commit log)
+    views: object = None
     add_ingest_time: bool = True
     #: total tries a batch gets — across replays AND process restarts —
     #: before it is quarantined instead of replayed forever
@@ -349,6 +355,22 @@ class StreamExecution:
         fault_point("stream.after_sink", batch_id=batch_id)
         self.checkpoint.write_commit(batch_id)
         fault_point("stream.after_commit", batch_id=batch_id)
+        if self.views is not None:
+            # view maintenance rides the commit: the batch is durable, so
+            # a crash inside (the sql.view.maintain fault site) replays
+            # NOTHING — the next refresh folds the committed delta in
+            # exactly once.  A non-crash failure must not fail the
+            # attempt either (the batch already committed; replaying it
+            # would re-run foreach): views heal lazily instead.
+            try:
+                self.views.maintain(self.sink, batch_id)
+            except Exception as e:  # noqa: BLE001 — InjectedCrash
+                # (BaseException) still propagates like a real kill
+                self.metrics.inc("stream.view_maintain_errors")
+                log.warning(
+                    "view maintenance failed; views catch up lazily",
+                    batch_id=batch_id, error=repr(e),
+                )
         self.source.commit_files(files)
         self.metrics.inc("stream.batches")
 

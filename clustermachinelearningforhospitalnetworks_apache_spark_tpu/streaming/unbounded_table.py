@@ -63,6 +63,25 @@ class UnboundedTable:
         append_line(os.path.join(self.path, COMMIT_LOG), entry)
 
     # -------------------------------------------------------------- read
+    def _part_stat(self, fname: str) -> tuple[int, int]:
+        """(size, mtime_ns) of a part file — content identity beyond the
+        commit entry's (file, rows), which a same-count replay leaves
+        unchanged."""
+        try:
+            st = os.stat(os.path.join(self.path, fname))
+            return int(st.st_size), int(st.st_mtime_ns)
+        except OSError:
+            return (-1, -1)
+
+    def commit_log_stat(self) -> tuple[int, int]:
+        """(size, mtime_ns) of the commit log — a cheap change detector.
+        Every append AND every replay appends a commit line, so an
+        unchanged stat means the committed state is unchanged; readers
+        that reconcile against ``committed_batches()`` (the view layer's
+        per-query refresh) can skip the O(batches) log parse + part
+        stats when it matches their last reconcile."""
+        return self._part_stat(COMMIT_LOG)
+
     def committed_batches(self) -> dict[int, dict]:
         out: dict[int, dict] = {}
         for e in read_lines(os.path.join(self.path, COMMIT_LOG)):
@@ -88,22 +107,54 @@ class UnboundedTable:
         import pyarrow.parquet as pq
         import pyarrow as pa
 
+        from ..obs.registry import global_registry
+
+        # keyed (not single-slot) memo: a pinned retrain read
+        # (upto_batch_id) must not evict the full snapshot the compiled
+        # SQL path holds device columns against, and vice versa.  Hit/miss
+        # land on the process registry (``sql.cache.snapshot.*``, ISSUE
+        # 14; the device-column cache counts separately as
+        # ``sql.cache.device.*``): a memo miss is an O(history) parquet
+        # re-concat, and the view layer changes how often readers pay it
+        # — the counters make that pressure visible.
+        cache: dict = getattr(self, "_snapshots", None) or {}
+        self._snapshots = cache
+        # commit-log stat fast path: every append/replay appends a commit
+        # line, so an unchanged (size, mtime_ns) proves the committed
+        # state unchanged — skip re-deriving the memo key (an O(batches)
+        # log parse + part-stat sweep) per query.  (The one divergence —
+        # a part rewritten in place with its commit line still in flight
+        # — correctly keeps serving the last COMMITTED snapshot.)
+        stat = self.commit_log_stat()
+        memo_keys: dict = getattr(self, "_memo_keys", None) or {}
+        self._memo_keys = memo_keys
+        fast = memo_keys.get(upto_batch_id)
+        if fast is not None and fast[0] == stat and fast[1] in cache:
+            global_registry().inc("sql.cache.snapshot.hit")
+            return cache[fast[1]]
         entries = self.committed_batches()
         if upto_batch_id is not None:
             entries = {
                 bid: e for bid, e in entries.items() if bid <= upto_batch_id
             }
+        # the key includes each part's (size, mtime_ns): a replayed batch
+        # with the SAME row count still rewrites its part file, and the
+        # memo must not serve the stale snapshot (ISSUE 14 — the view
+        # layer's retraction detector found this blind spot)
         key = tuple(
-            (bid, entries[bid]["file"], entries[bid]["rows"])
+            (
+                bid, entries[bid]["file"], entries[bid]["rows"],
+                self._part_stat(entries[bid]["file"]),
+            )
             for bid in sorted(entries)
         )
-        # keyed (not single-slot) memo: a pinned retrain read
-        # (upto_batch_id) must not evict the full snapshot the compiled
-        # SQL path holds device columns against, and vice versa
-        cache: dict = getattr(self, "_snapshots", None) or {}
-        self._snapshots = cache
+        memo_keys[upto_batch_id] = (stat, key)
+        while len(memo_keys) > 8:  # pins come and go; never unbounded
+            memo_keys.pop(next(iter(memo_keys)))
         if key in cache:
+            global_registry().inc("sql.cache.snapshot.hit")
             return cache[key]
+        global_registry().inc("sql.cache.snapshot.miss")
         parts = []
         for bid in sorted(entries):
             p = os.path.join(self.path, entries[bid]["file"])

@@ -224,6 +224,42 @@ def test_unbounded_table_read_memoized(tmp_path):
     assert t2 is not t1 and len(t2) == 6
 
 
+def test_unbounded_table_read_stat_fast_path(tmp_path, monkeypatch):
+    """With the commit log unchanged, repeated reads skip the O(batches)
+    log parse + part-stat sweep entirely (the memo KEY itself is cached
+    against the log's stat); a new commit — or a same-count replay,
+    which also appends a commit line — re-derives it and drops the
+    stale snapshot."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.unbounded_table import (
+        UnboundedTable,
+    )
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.schema import (
+        FLOAT,
+    )
+
+    schema = ht.Schema([("v", FLOAT)])
+    ut = UnboundedTable(str(tmp_path / "ut"), schema)
+    ut.append_batch(Table.from_dict({"v": np.arange(4.0)}, schema), 0)
+    t1 = ut.read()
+    calls = {"n": 0}
+    orig = ut.committed_batches
+
+    def counting():
+        calls["n"] += 1
+        return orig()
+
+    monkeypatch.setattr(ut, "committed_batches", counting)
+    for _ in range(5):
+        assert ut.read() is t1
+    assert calls["n"] == 0  # stat-only: no log parse, no part stats
+    ut.append_batch(Table.from_dict({"v": np.arange(3.0, 6.0)}, schema), 0)
+    t2 = ut.read()  # same-count replay appended a commit line
+    assert calls["n"] == 1
+    assert t2 is not t1
+    assert float(t2.column("v")[0]) == 3.0  # the replayed bytes, not stale
+
+
 # ------------------------------------------------------ fused assembly
 def test_fused_assemble_matches_host_path(session, hospital_table):
     clock = StageClock()

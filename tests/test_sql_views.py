@@ -1,0 +1,794 @@
+"""Incremental streaming SQL — materialized views (ISSUE 14).
+
+Covers the delta-maintenance contract end to end: per-batch parity of
+view state vs full recompute (aggregate partials + row-level deltas,
+targeted and fuzzed), exactly-once maintenance across replays and
+repeated hooks, watermark-aware retraction and sealed-prefix compaction,
+the loud full-recompute fallback for non-incrementalizable plans, the
+dispatcher's fingerprint-matched ``route="view"`` serve, per-clause
+incremental decisions in explain, and — chaos-marked — kill-and-resume
+at the ``sql.view.maintain`` boundary leaving view state bit-identical
+to an uninterrupted run, plus the replayed-batch double-apply probe.
+"""
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core import (
+    sql as core_sql,
+    sql_fuzz,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import (
+    execute,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_plan import (
+    plan_query,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_parse import (
+    parse,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_views import (
+    DECISION_INCREMENTAL,
+    FULL_COMPILE_DISABLED,
+    FULL_LIMIT,
+    FULL_NOT_COMPILED,
+    FULL_WINDOW,
+    ViewRegistry,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.io import (
+    write_csv,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.registry import (
+    global_registry,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import (
+    FileStreamSource,
+    StreamCheckpoint,
+    StreamExecution,
+    UnboundedTable,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.watermark import (
+    WatermarkTracker,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+
+pytestmark = pytest.mark.fast
+
+AGG_Q = (
+    "SELECT i1, count(*) AS c, sum(f1) AS s, avg(f1) AS a,"
+    " min(f1) AS lo, max(f1) AS hi FROM events GROUP BY i1"
+)
+ROW_Q = "SELECT f1, i1, abs(f1) AS af FROM events WHERE i1 >= 1"
+
+
+def _batch(rng, n, null_rate=0.15):
+    f1 = rng.normal(size=n) * 10
+    if n:
+        f1[rng.random(n) < null_rate] = np.nan
+    t1 = (
+        np.datetime64("2025-03-31T22:00:00")
+        + rng.integers(0, 7200, n).astype("timedelta64[s]")
+    ).astype("datetime64[ns]")
+    return ht.Table.from_dict(
+        {"f1": f1, "i1": rng.integers(-2, 4, n), "t1": t1}
+    )
+
+
+def _mk_sink(tmp_path, rng):
+    return UnboundedTable(
+        str(tmp_path / "table"), _batch(rng, 1).schema, name="events"
+    )
+
+
+def _full(query, sink, upto=None):
+    return execute(
+        query, lambda _n: sink.read(upto_batch_id=upto), mode="interpret"
+    )
+
+
+def _assert_parity(query, sink, view, upto=None, ctx=""):
+    bad = sql_fuzz.compare_tables(
+        _full(query, sink, upto), view.read(upto_batch_id=upto)
+    )
+    assert bad is None, f"{ctx}: {bad}"
+
+
+def _assert_bit_identical(a, b):
+    assert list(a.columns) == list(b.columns)
+    assert len(a) == len(b)
+    for c in a.columns:
+        va, vb = a.column(c), b.column(c)
+        assert va.dtype == vb.dtype, c
+        if va.dtype == object:
+            assert list(va) == list(vb), c
+        else:
+            assert va.tobytes() == vb.tobytes(), c
+
+
+# ============================================================ parity
+def test_aggregate_view_parity_over_batches(tmp_path):
+    """Mergeable partials fold to exactly what a full recompute returns,
+    after EVERY commit — null group keys, all-null groups, timestamp
+    keys included."""
+    rng = np.random.default_rng(0)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    view = reg.register("agg", AGG_Q, sink)
+    by_ts = reg.register(
+        "by_ts",
+        "SELECT t1, count(f1) AS c, avg(f1) AS a FROM events GROUP BY t1",
+        sink,
+    )
+    for bid in range(5):
+        sink.append_batch(_batch(rng, int(rng.integers(0, 180))), bid)
+        reg.maintain(sink, bid)
+        _assert_parity(AGG_Q, sink, view, ctx=f"agg batch {bid}")
+        _assert_parity(
+            "SELECT t1, count(f1) AS c, avg(f1) AS a FROM events "
+            "GROUP BY t1",
+            sink, by_ts, ctx=f"ts batch {bid}",
+        )
+    assert view.describe()["incremental"]
+    assert view.describe()["last_applied"] == 4
+
+
+def test_whole_table_aggregate_and_empty_sink(tmp_path):
+    rng = np.random.default_rng(1)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    q = "SELECT count(*) AS c, sum(f1) AS s, min(f1) AS lo FROM events"
+    view = reg.register("tot", q, sink)
+    _assert_parity(q, sink, view, ctx="zero batches")
+    sink.append_batch(_batch(rng, 0), 0)  # an EMPTY committed batch
+    reg.maintain(sink, 0)
+    _assert_parity(q, sink, view, ctx="empty batch")
+    sink.append_batch(_batch(rng, 120), 1)
+    reg.maintain(sink, 1)
+    _assert_parity(q, sink, view, ctx="data batch")
+
+
+def test_rowlevel_view_parity_and_pinned_read(tmp_path):
+    """Row-level deltas concat to the full recompute's rows, and the
+    pinned read (the lifecycle retrain's journaled snapshot id) serves
+    batches ≤ the pin — the ingest→retrain read path."""
+    rng = np.random.default_rng(2)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    view = reg.register("win", ROW_Q, sink)
+    for bid in range(4):
+        sink.append_batch(_batch(rng, 150), bid)
+        reg.maintain(sink, bid)
+        _assert_parity(ROW_Q, sink, view, ctx=f"batch {bid}")
+    for upto in (0, 2):
+        _assert_parity(ROW_Q, sink, view, upto=upto, ctx=f"pinned {upto}")
+
+
+def test_fuzz_incremental_leg():
+    """ISSUE 14 satellite: random mergeable-subset queries over random
+    batch/replay sequences — view state == full recompute after every
+    commit (shrunk repro on failure)."""
+    failures = sql_fuzz.run_fuzz_incremental(n_queries=5, seed=0)
+    assert failures == [], f"incremental view parity failures: {failures}"
+
+
+@pytest.mark.slow
+def test_fuzz_incremental_deep():
+    failures = sql_fuzz.run_fuzz_incremental(n_queries=40, seed=11)
+    assert failures == [], f"incremental view parity failures: {failures}"
+
+
+# ===================================================== exactly-once
+def test_maintain_is_idempotent(tmp_path):
+    """Re-running the hook (a replayed commit notification) never
+    double-applies a delta — the high-water mark skips it."""
+    rng = np.random.default_rng(3)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    view = reg.register("agg", AGG_Q, sink)
+    sink.append_batch(_batch(rng, 100), 0)
+    for _ in range(5):
+        reg.maintain(sink, 0)
+    assert view.applied_rows() == 100
+    _assert_parity(AGG_Q, sink, view)
+
+
+def test_late_row_retraction_parity(tmp_path):
+    """A replayed batch ('later replay wins' in the commit log) with
+    late rows is RETRACTED and re-applied: old delta dropped, new one
+    folded, answer equal to a full recompute — including a replay with
+    the SAME row count (detected by part-file bytes, not just the
+    commit entry)."""
+    rng = np.random.default_rng(4)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    agg = reg.register("agg", AGG_Q, sink)
+    row = reg.register("win", ROW_Q, sink)
+    for bid in range(3):
+        sink.append_batch(_batch(rng, 80), bid)
+        reg.maintain(sink, bid)
+    before = global_registry().counters.get("sql.view.retractions", 0)
+    # replay batch 1 with a different row count
+    sink.append_batch(_batch(rng, 50), 1)
+    reg.maintain(sink, 1)
+    _assert_parity(AGG_Q, sink, agg, ctx="replay (count change)")
+    _assert_parity(ROW_Q, sink, row, ctx="replay (count change)")
+    # replay batch 1 again: SAME row count, different values
+    sink.append_batch(_batch(rng, 50), 1)
+    reg.maintain(sink, 1)
+    _assert_parity(AGG_Q, sink, agg, ctx="replay (same count)")
+    _assert_parity(ROW_Q, sink, row, ctx="replay (same count)")
+    assert global_registry().counters.get("sql.view.retractions", 0) > before
+    assert agg.applied_rows() == 80 + 50 + 80
+
+
+def test_watermark_compaction_seals_prefix(tmp_path):
+    """With an event-time watermark, aggregate partials wholly below it
+    compact into one base partial (bounded state), answers stay exact,
+    and a replay UNDER the seal forces a loud full rebuild that is still
+    correct — the retraction-vs-watermark contract."""
+    rng = np.random.default_rng(5)
+    base = np.datetime64("2025-03-31T00:00:00")
+
+    def timed_batch(b, n=60):
+        t = (
+            base + (b * 3600 + rng.integers(0, 3600, n)).astype(
+                "timedelta64[s]"
+            )
+        ).astype("datetime64[ns]")
+        return ht.Table.from_dict(
+            {"f1": rng.normal(size=n), "i1": rng.integers(0, 4, n), "t1": t}
+        )
+
+    sink = UnboundedTable(
+        str(tmp_path / "table"), timed_batch(0).schema, name="events"
+    )
+    wt = WatermarkTracker("t1", 90.0)  # 1.5 h: batches seal 2-3 behind
+    reg = ViewRegistry()
+    q = "SELECT i1, count(*) AS c, sum(f1) AS s FROM events GROUP BY i1"
+    view = reg.register("agg", q, sink, watermark=wt)
+    for bid in range(8):
+        tb = timed_batch(bid)
+        wt.filter_late(tb)
+        sink.append_batch(tb, bid)
+        reg.maintain(sink, bid)
+        _assert_parity(q, sink, view, ctx=f"batch {bid}")
+    d = view.describe()
+    assert d["compacted_upto"] is not None and d["compacted_upto"] >= 3
+    assert d["batches_retained"] < 8
+    # replay a SEALED batch: individually retained state is gone — the
+    # view must rebuild loudly and still answer correctly
+    rebuilds = global_registry().counters.get("sql.view.rebuilds", 0)
+    sink.append_batch(timed_batch(0, n=30), 0)
+    reg.maintain(sink, 0)
+    _assert_parity(q, sink, view, ctx="sealed replay")
+    assert global_registry().counters.get("sql.view.rebuilds", 0) > rebuilds
+
+
+# ============================================== fallback + dispatch
+def test_non_incremental_plan_falls_back_loudly(tmp_path):
+    """Window functions / LIMIT / interpreter-fallback plans register
+    but serve FULL RECOMPUTES — correct answers, visible decisions, and
+    the ``sql.view.full_recompute`` counter moving."""
+    rng = np.random.default_rng(6)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    q = "SELECT f1, sum(f1) OVER (PARTITION BY i1) AS w FROM events"
+    view = reg.register("windowed", q, sink)
+    assert not view.describe()["incremental"]
+    assert FULL_WINDOW in view.describe()["decisions"]
+    sink.append_batch(_batch(rng, 90), 0)
+    before = global_registry().counters.get("sql.view.full_recompute", 0)
+    _assert_parity(q, sink, view, ctx="window fallback")
+    assert global_registry().counters.get("sql.view.full_recompute", 0) > before
+
+    lim = reg.register("limited", "SELECT f1 FROM events LIMIT 3", sink)
+    assert FULL_LIMIT in lim.describe()["decisions"]
+    got = lim.read()
+    assert len(got) == min(3, len(sink.read()))
+
+    tail = reg.register(
+        "ordered", "SELECT f1 FROM events ORDER BY f1", sink
+    )
+    assert FULL_NOT_COMPILED in tail.describe()["decisions"]
+    bad = sql_fuzz.compare_tables(_full(
+        "SELECT f1 FROM events ORDER BY f1", sink), tail.read())
+    assert bad is None
+
+
+def test_session_sql_serves_from_matching_view(tmp_path):
+    """The dispatcher answers from a fresh fingerprint-matched view
+    (route "view", hit counter); a non-matching plan stays compiled and
+    counts a miss; interpret/compile modes bypass views entirely."""
+    rng = np.random.default_rng(7)
+    s = ht.Session.builder.app_name("views-serve-test").get_or_create()
+    try:
+        sink = _mk_sink(tmp_path, rng)
+        s.register_table("events", sink)
+        sink.append_batch(_batch(rng, 120), 0)
+        s.create_view("agg", AGG_Q)
+        sink.append_batch(_batch(rng, 90), 1)  # view is now stale…
+        g = global_registry()
+        hits = g.counters.get("sql.view.hit", 0)
+        out = s.sql(AGG_Q)  # …but serve_for refreshes before matching
+        assert core_sql.last_dispatch().route == "view"
+        assert g.counters.get("sql.view.hit", 0) == hits + 1
+        bad = sql_fuzz.compare_tables(_full(AGG_Q, sink), out)
+        assert bad is None
+        misses = g.counters.get("sql.view.miss", 0)
+        s.sql("SELECT i1, count(*) AS c FROM events GROUP BY i1")
+        assert core_sql.last_dispatch().route == "compiled"
+        assert g.counters.get("sql.view.miss", 0) == misses + 1
+        execute(AGG_Q, s.table, mode="compile")  # parity tooling path
+        assert core_sql.last_dispatch().route == "compiled"
+    finally:
+        s.stop()
+
+
+def test_create_view_rejects_plain_tables_and_joins(tmp_path):
+    rng = np.random.default_rng(9)
+    s = ht.Session.builder.app_name("views-reject-test").get_or_create()
+    try:
+        s.register_table("plain", ht.Table.from_dict({"x": [1.0, 2.0]}))
+        with pytest.raises(ValueError, match="UnboundedTable"):
+            s.create_view("v", "SELECT x FROM plain")
+        with pytest.raises(ValueError, match="single-table"):
+            s.create_view(
+                "v2", "SELECT x FROM (SELECT x FROM plain) q"
+            )
+        # a JOIN parses with a plain single-name FROM table, so it used
+        # to register fine — and then KeyError on EVERY read when the
+        # resolver met the other table.  Must fail at registration.
+        sink = _mk_sink(tmp_path, rng)
+        s.register_table("events", sink)
+        with pytest.raises(ValueError, match="single-table"):
+            s.create_view(
+                "v3",
+                "SELECT e.f1 FROM events e JOIN plain p ON e.i1 = p.x",
+            )
+        with pytest.raises(ValueError, match="single-table"):
+            ViewRegistry().register(
+                "v4",
+                "SELECT e.f1 FROM events e JOIN plain p ON e.i1 = p.x",
+                sink,
+            )
+    finally:
+        s.stop()
+
+
+def test_explain_reports_incremental_decision_per_node(tmp_path):
+    """Satellite 1: ``sql_explain`` / ``LogicalPlan.explain`` carry the
+    per-clause incremental decision, reason-constant discipline."""
+    rng = np.random.default_rng(8)
+    s = ht.Session.builder.app_name("views-explain-test").get_or_create()
+    try:
+        sink = _mk_sink(tmp_path, rng)
+        s.register_table("events", sink)
+        sink.append_batch(_batch(rng, 50), 0)
+
+        info = s.sql_explain(AGG_Q)
+        assert info["view_maintenance"] == "incremental"
+        assert all(
+            n["incremental"] == DECISION_INCREMENTAL for n in info["nodes"]
+        )
+
+        info = s.sql_explain(
+            "SELECT f1, count(*) OVER (PARTITION BY i1) AS c FROM events"
+        )
+        assert info["view_maintenance"] == [FULL_WINDOW]
+        assert {n["incremental"] for n in info["nodes"]} == {
+            DECISION_INCREMENTAL, FULL_WINDOW,
+        }
+
+        info = s.sql_explain("SELECT f1 FROM events LIMIT 2")
+        assert info["view_maintenance"] == [FULL_LIMIT]
+
+        info = s.sql_explain("SELECT f1 FROM events ORDER BY f1")
+        assert FULL_NOT_COMPILED in info["view_maintenance"]
+
+        plan = plan_query(parse(AGG_Q), s.table)
+        nodes = plan.explain()
+        assert [n["op"] for n in nodes] == ["scan", "aggregate"]
+        assert all(n["incremental"] == DECISION_INCREMENTAL for n in nodes)
+    finally:
+        s.stop()
+
+
+# ================================================ stream integration
+def _event_csv(path, start_minute, n):
+    base = np.datetime64("2025-03-31T22:00:00") + np.timedelta64(
+        start_minute, "m"
+    )
+    t = ht.Table.from_dict(
+        {
+            "hospital_id": np.array(["H01"] * n, dtype=object),
+            "event_time": base + np.arange(n).astype("timedelta64[s]"),
+            "admission_count": np.arange(n),
+            "current_occupancy": np.full(n, 100),
+            "emergency_visits": np.full(n, 5),
+            "seasonality_index": np.full(n, 1.0),
+            "length_of_stay": np.full(n, 4.0),
+        },
+        ht.hospital_event_schema(),
+    )
+    write_csv(t, path)
+    return t
+
+
+STATS_Q = (
+    "SELECT count(*) AS c, sum(admission_count) AS adm,"
+    " avg(length_of_stay) AS alos FROM events"
+)
+
+
+def _mk_stream(tmp_path, views):
+    incoming = tmp_path / "incoming"
+    incoming.mkdir(exist_ok=True)
+    return incoming, StreamExecution(
+        source=FileStreamSource(str(incoming), ht.hospital_event_schema()),
+        sink=UnboundedTable(
+            str(tmp_path / "table"), ht.hospital_event_schema()
+        ),
+        checkpoint=StreamCheckpoint(str(tmp_path / "ckpt")),
+        views=views,
+    )
+
+
+def test_stream_commit_path_maintains_views(tmp_path):
+    """The driver's commit hook folds each committed batch into every
+    registered view — after ``run_once`` the view is already current
+    (no lazy catch-up left to do)."""
+    reg = ViewRegistry()
+    incoming, exec_ = _mk_stream(tmp_path, reg)
+    _event_csv(str(incoming / "a.csv"), 0, 30)
+    assert exec_.run_once().num_appended_rows == 30
+    view = reg.register("stats", STATS_Q, exec_.sink)
+    _event_csv(str(incoming / "b.csv"), 1, 20)
+    assert exec_.run_once().num_appended_rows == 20
+    assert view.applied_rows() == 50  # maintained ON the commit path
+    _assert_parity(STATS_Q, exec_.sink, view)
+
+
+def test_session_streaming_wires_views(tmp_path):
+    """The fluent Session surface: write_stream hands the session's
+    registry to the driver, so create_view + process_available leaves a
+    current view that Session.sql serves from."""
+    s = ht.Session.builder.app_name("views-stream-test").get_or_create()
+    try:
+        incoming = tmp_path / "incoming"
+        incoming.mkdir()
+        sdf = s.read_stream.schema(ht.hospital_event_schema()).csv(
+            str(incoming)
+        )
+        q = sdf.write_stream.option(
+            "checkpointLocation", str(tmp_path / "ckpt")
+        ).table("events")
+        _event_csv(str(incoming / "a.csv"), 0, 25)
+        q.process_available()
+        view = s.create_view("stats", STATS_Q)
+        _event_csv(str(incoming / "b.csv"), 2, 35)
+        q.process_available()
+        assert view.applied_rows() == 60
+        out = s.sql(STATS_Q)
+        assert core_sql.last_dispatch().route == "view"
+        assert int(out.column("c")[0]) == 60
+    finally:
+        s.stop()
+
+
+# ========================================================== chaos
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", ["sql.view.maintain"])
+def test_kill_at_view_maintain_resumes_bit_identical(tmp_path, site):
+    """Kill view maintenance right after a batch's commit; the restarted
+    registry (fresh objects over the same dirs) must catch up from the
+    commit log and end bit-identical — column for column, byte for byte
+    — to an uninterrupted run over the same input."""
+
+    def run(root, kill_at_batch=None):
+        reg = ViewRegistry()
+        incoming, exec_ = _mk_stream(root, reg)
+        view = reg.register("stats", STATS_Q, exec_.sink)
+        for b in range(4):
+            _event_csv(str(incoming / f"f{b}.csv"), b, 20 + b)
+            if b == kill_at_batch:
+                plan = faults.FaultPlan().crash(site)
+                with faults.active(plan):
+                    with pytest.raises(faults.InjectedCrash):
+                        exec_.run_once()
+                assert plan.fired(site) == 1
+                # restart: fresh driver + registry over the same dirs
+                reg = ViewRegistry()
+                incoming, exec_ = _mk_stream(root, reg)
+                view = reg.register("stats", STATS_Q, exec_.sink)
+                assert exec_.run_once() is None  # batch committed pre-kill
+            else:
+                assert exec_.run_once() is not None
+        return exec_, view
+
+    clean_root = tmp_path / "clean"
+    clean_root.mkdir()
+    killed_root = tmp_path / "killed"
+    killed_root.mkdir()
+    _, clean_view = run(clean_root)
+    exec_, killed_view = run(killed_root, kill_at_batch=1)
+    got, want = killed_view.read(), clean_view.read()
+    _assert_bit_identical(want, got)
+    _assert_parity(STATS_Q, exec_.sink, killed_view, ctx="after resume")
+
+
+@pytest.mark.chaos
+def test_replayed_batch_never_double_applies(tmp_path):
+    """The double-apply probe: a crash between sink append and commit
+    replays the batch (part file rewritten, then committed once) — and
+    however many times maintenance observes it, its delta folds in
+    exactly once."""
+    reg = ViewRegistry()
+    incoming, exec_ = _mk_stream(tmp_path, reg)
+    view = reg.register("stats", STATS_Q, exec_.sink)
+    _event_csv(str(incoming / "a.csv"), 0, 30)
+    assert exec_.run_once().num_appended_rows == 30
+
+    _event_csv(str(incoming / "b.csv"), 1, 20)
+    plan = faults.FaultPlan().crash("stream.after_sink")
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            exec_.run_once()  # part visible, commit missing → replay
+    assert plan.fired("stream.after_sink") == 1
+
+    reg2 = ViewRegistry()
+    _, exec2 = _mk_stream(tmp_path, reg2)
+    view2 = reg2.register("stats", STATS_Q, exec2.sink)
+    info = exec2.run_once()  # the replay: rewrites the part, commits
+    assert info is not None and info.batch_id == 1
+    for _ in range(3):  # replayed maintenance notifications
+        reg2.maintain(exec2.sink, 1)
+    assert view2.applied_rows() == 50
+    out = view2.read()
+    assert int(out.column("c")[0]) == 50  # 30 + 20: exactly once
+    _assert_parity(STATS_Q, exec2.sink, view2, ctx="after replay")
+
+
+# =================================================== review-round fixes
+def test_kill_switch_governs_views(tmp_path, monkeypatch):
+    """CMLHN_SQL_COMPILE=0 must govern views too: maintenance stops
+    running the compiled partial kernels, reads answer via the loud
+    interpreter full recompute, and flipping the switch back lets the
+    view catch up exactly-once."""
+    rng = np.random.default_rng(11)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    view = reg.register("agg", AGG_Q, sink)
+    sink.append_batch(_batch(rng, 60), 0)
+    reg.maintain(sink, 0)
+    assert view.applied_rows() == 60
+    monkeypatch.setenv("CMLHN_SQL_COMPILE", "0")
+    sink.append_batch(_batch(rng, 40), 1)
+    reg.maintain(sink, 1)
+    assert view.applied_rows() == 60  # no compiled-kernel fold
+    before = global_registry().collect()["counters"].get(
+        "sql.view.full_recompute", 0
+    )
+    _assert_parity(AGG_Q, sink, view, ctx="kill-switch read")
+    after = global_registry().collect()["counters"].get(
+        "sql.view.full_recompute", 0
+    )
+    assert after > before  # served loudly, via the interpreter
+    ex = core_sql.explain(AGG_Q, lambda _n: sink.read())
+    assert ex["view_maintenance"] == [FULL_COMPILE_DISABLED]
+    monkeypatch.delenv("CMLHN_SQL_COMPILE")
+    _assert_parity(AGG_Q, sink, view, ctx="switch back on")
+    assert view.applied_rows() == 100
+
+
+def test_group_key_dtype_drift_poisons_not_crashes(tmp_path):
+    """An int GROUP BY key drifting to float (nulls introduced
+    upstream) must poison the view to full recompute — never crash
+    refresh canonicalizing int(NaN)."""
+    rng = np.random.default_rng(12)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    view = reg.register("agg", AGG_Q, sink)
+    sink.append_batch(_batch(rng, 50), 0)
+    reg.maintain(sink, 0)
+    drifted = _batch(rng, 50)
+    cols = {c: drifted.column(c) for c in drifted.columns}
+    i1 = cols["i1"].astype(np.float64)
+    i1[0] = np.nan
+    cols["i1"] = i1
+    sink.append_batch(ht.Table.from_dict(cols), 0)  # drifted replay
+    reg.maintain(sink, 0)  # must not raise
+    d = view.describe()
+    assert not d["incremental"] and d["poisoned"]
+    _assert_parity(AGG_Q, sink, view, ctx="poisoned still correct")
+
+
+def test_missing_part_file_does_not_strand_freshness(tmp_path):
+    """applied_rows counts actually-FOLDED rows: a part file deleted
+    out from under the table (retention) is skipped by the snapshot
+    read too, so the dispatcher freshness check still matches and the
+    view keeps serving."""
+    import os
+
+    rng = np.random.default_rng(13)
+    sink = _mk_sink(tmp_path, rng)
+    sink.append_batch(_batch(rng, 40), 0)
+    sink.append_batch(_batch(rng, 30), 1)
+    os.remove(os.path.join(sink.path, sink.committed_batches()[0]["file"]))
+    reg = ViewRegistry()
+    view = reg.register("agg", AGG_Q, sink)
+    snap = sink.read()
+    assert len(snap) == 30
+    assert view.applied_rows() == 30
+    plan = plan_query(parse(AGG_Q), lambda _n: snap)
+    assert view.serve_if_fresh(plan) is not None
+
+
+def test_dispatcher_serve_skips_reconcile_when_log_unchanged(
+    tmp_path, monkeypatch
+):
+    """The hot serve path: an UNCHANGED commit log means zero O(batches)
+    log parses + part stats per query (the commit-log stat
+    short-circuit), and a new commit forces exactly one reconcile —
+    per-query serve cost must not grow with retained history."""
+    rng = np.random.default_rng(14)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    view = reg.register("agg", AGG_Q, sink)
+    sink.append_batch(_batch(rng, 80), 0)
+    reg.maintain(sink, 0)
+    snap = sink.read()
+    plan = plan_query(parse(AGG_Q), lambda _n: snap)
+    calls = {"n": 0}
+    orig = sink.committed_batches
+
+    def counting():
+        calls["n"] += 1
+        return orig()
+
+    monkeypatch.setattr(sink, "committed_batches", counting)
+    for _ in range(5):
+        out = reg.serve_for(plan)
+        assert out is not None
+    assert calls["n"] == 0  # stat-only refreshes: nothing committed
+    assert sql_fuzz.compare_tables(_full(AGG_Q, sink), out) is None
+    sink.append_batch(_batch(rng, 20), 1)  # a new commit line
+    snap2 = sink.read()
+    plan2 = plan_query(parse(AGG_Q), lambda _n: snap2)
+    calls["n"] = 0
+    out2 = reg.serve_for(plan2)
+    assert out2 is not None
+    assert calls["n"] == 1  # exactly one reconcile catches it up
+    assert sql_fuzz.compare_tables(_full(AGG_Q, sink), out2) is None
+
+
+def test_view_serve_failure_degrades_not_raises(tmp_path, monkeypatch):
+    """A view-layer runtime failure (corrupt state, kernel error) must
+    fall through to the real executors — same contract as the compiled
+    branch's interpreter fallback — never take the query down."""
+    rng = np.random.default_rng(15)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    reg.register("agg", AGG_Q, sink)
+    sink.append_batch(_batch(rng, 40), 0)
+    reg.maintain(sink, 0)
+
+    def boom(plan):
+        raise RuntimeError("corrupt view state")
+
+    monkeypatch.setattr(reg, "serve_for", boom)
+    before = global_registry().collect()["counters"].get(
+        "sql.view.serve_errors", 0
+    )
+    out = execute(AGG_Q, lambda _n: sink.read(), views=reg)
+    assert core_sql.last_dispatch().route in ("compiled", "interpreter")
+    assert sql_fuzz.compare_tables(_full(AGG_Q, sink), out) is None
+    assert global_registry().collect()["counters"].get(
+        "sql.view.serve_errors", 0
+    ) == before + 1
+
+
+def test_all_nat_batch_does_not_wedge_compaction(tmp_path):
+    """A non-empty batch whose watermark column is all-NaT can never
+    fall below the watermark — it must SEAL like an empty batch does,
+    not block the contiguous prefix forever (unbounded state on a 24/7
+    stream); answers stay exact and a replay of it costs the loud
+    rebuild, which is the sealed contract."""
+    rng = np.random.default_rng(18)
+    base = np.datetime64("2025-03-31T00:00:00")
+
+    def timed_batch(b, n=30, nat=False):
+        if nat:
+            t = np.full(n, np.datetime64("NaT"), dtype="datetime64[ns]")
+        else:
+            t = (
+                base + (b * 3600 + rng.integers(0, 3600, n)).astype(
+                    "timedelta64[s]"
+                )
+            ).astype("datetime64[ns]")
+        return ht.Table.from_dict(
+            {"f1": rng.normal(size=n), "i1": rng.integers(0, 4, n), "t1": t}
+        )
+
+    sink = UnboundedTable(
+        str(tmp_path / "table"), timed_batch(0).schema, name="events"
+    )
+    wt = WatermarkTracker("t1", 90.0)
+    reg = ViewRegistry()
+    q = "SELECT i1, count(*) AS c, sum(f1) AS s FROM events GROUP BY i1"
+    view = reg.register("agg", q, sink, watermark=wt)
+    for bid in range(6):
+        tb = timed_batch(bid, nat=(bid == 1))  # batch 1: no event times
+        if bid != 1:
+            wt.filter_late(tb)
+        sink.append_batch(tb, bid)
+        reg.maintain(sink, bid)
+        _assert_parity(q, sink, view, ctx=f"batch {bid}")
+    d = view.describe()
+    assert d["compacted_upto"] is not None and d["compacted_upto"] >= 2
+    _assert_parity(q, sink, view, ctx="sealed through the NaT batch")
+
+
+def test_gap_fill_below_seal_rebuilds_loudly(tmp_path):
+    """A commit-log entry appearing BELOW the compacted seal that was
+    never sealed (a gap-fill replay) must force the same loud rebuild
+    as a sealed replay — silently skipping it would drop its rows from
+    view state while a full recompute includes them."""
+    rng = np.random.default_rng(17)
+    base = np.datetime64("2025-03-31T00:00:00")
+
+    def timed_batch(b, n=40):
+        t = (
+            base + (b * 3600 + rng.integers(0, 3600, n)).astype(
+                "timedelta64[s]"
+            )
+        ).astype("datetime64[ns]")
+        return ht.Table.from_dict(
+            {"f1": rng.normal(size=n), "i1": rng.integers(0, 4, n), "t1": t}
+        )
+
+    sink = UnboundedTable(
+        str(tmp_path / "table"), timed_batch(0).schema, name="events"
+    )
+    wt = WatermarkTracker("t1", 90.0)
+    reg = ViewRegistry()
+    q = "SELECT i1, count(*) AS c, sum(f1) AS s FROM events GROUP BY i1"
+    view = reg.register("agg", q, sink, watermark=wt)
+    for bid in (0, 1, 2, 4, 5, 6, 7):  # bid 3 never committed: a gap
+        tb = timed_batch(bid)
+        wt.filter_late(tb)
+        sink.append_batch(tb, bid)
+        reg.maintain(sink, bid)
+    d = view.describe()
+    assert d["compacted_upto"] is not None and d["compacted_upto"] >= 4
+    rebuilds = global_registry().counters.get("sql.view.rebuilds", 0)
+    sink.append_batch(timed_batch(3), 3)  # the gap fills in, under seal
+    reg.maintain(sink, 3)
+    assert global_registry().counters.get("sql.view.rebuilds", 0) > rebuilds
+    _assert_parity(q, sink, view, ctx="gap-fill below the seal")
+    assert view.applied_rows() == 8 * 40
+
+
+def test_retraction_rewrites_delta_under_fresh_path(tmp_path):
+    """Retract-and-reapply gives the rowlevel delta a FRESH epoch-
+    qualified path and the landed state sweeps the orphan — a stale
+    staged write can never resurrect pre-replay rows after a restart."""
+    import os
+
+    rng = np.random.default_rng(16)
+    sink = _mk_sink(tmp_path, rng)
+    reg = ViewRegistry()
+    view = reg.register("win", ROW_Q, sink)
+    sink.append_batch(_batch(rng, 60), 0)
+    reg.maintain(sink, 0)
+    first = view._batches[0]["delta_file"]
+    sink.append_batch(_batch(rng, 60), 0)  # replay with new content
+    reg.maintain(sink, 0)
+    second = view._batches[0]["delta_file"]
+    assert first is not None and second is not None and first != second
+    on_disk = sorted(
+        f for f in os.listdir(view.state_dir) if f.startswith("delta-")
+    )
+    assert on_disk == [second]  # the pre-replay orphan was swept
+    v2 = ViewRegistry().register("win", ROW_Q, sink)  # restart
+    _assert_parity(ROW_Q, sink, v2, ctx="after replay + restart")

@@ -28,7 +28,12 @@
 # asserts the resumed farm's centers are bit-identical per tenant),
 # and the serving fleet (tests/test_fleet.py kills a replica under
 # open-loop load — every in-flight request answered or cleanly shed,
-# zero unhandled, router reroutes — and drains one gracefully).
+# zero unhandled, router reroutes — and drains one gracefully),
+# and the incremental SQL views (tests/test_sql_views.py kills view
+# maintenance at sql.view.maintain mid-stream and asserts the resumed
+# view state is bit-identical to an uninterrupted run, plus the
+# replayed-batch double-apply probe: a replayed/committed batch must
+# never fold its delta in twice).
 #
 # ISSUE 10: every InjectedCrash dumps the observability flight recorder
 # (bounded event ring + metrics snapshot, CRC32C-wrapped, atomic write).
@@ -61,6 +66,7 @@ LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
     tests/test_stream_pipeline.py tests/test_gbt_fused.py \
     tests/test_lifecycle.py tests/test_model_farm.py tests/test_fleet.py \
+    tests/test_sql_views.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -75,7 +81,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet|sql_views)\.py::(\S+)",
         line,
     )
     if not m:
