@@ -60,6 +60,7 @@ from typing import Any
 
 import numpy as np
 
+from ..io.fit_checkpoint import fsync_dir
 from ..obs import trace as _trace
 from ..obs.registry import global_registry as _global_registry
 from ..utils.faults import fault_point
@@ -252,7 +253,9 @@ def _finalize_aggregate(
 # ----------------------------------------------------------- persistence
 def _write_json_atomic(path: str, payload: dict) -> None:
     """Atomic durable snapshot — the quarantine-file discipline (tmp +
-    fsync + rename; a torn state file must never exist)."""
+    fsync + rename + directory fsync; a torn state file must never
+    exist, and a power loss must not undo a rename the commit log has
+    already outlived — ISSUE 15 rename-without-dirsync)."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -260,6 +263,7 @@ def _write_json_atomic(path: str, payload: dict) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
 
 
 def _read_json(path: str) -> dict | None:
@@ -276,7 +280,13 @@ def _write_parquet_atomic(path: str, table: Table) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"
     pq.write_table(table.to_arrow(), tmp)
+    # fsync bytes + rename + directory: a torn delta heals via
+    # recompute, but a delta the state snapshot references must not
+    # vanish on power loss after the snapshot landed (ISSUE 15)
+    with open(tmp, "rb+") as f:
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
 
 
 def _read_parquet(path: str) -> Table | None:
@@ -469,8 +479,10 @@ class MaterializedView:
         # commit log on the next refresh — the log is the truth.
         with self._io_lock:
             for path, tbl in pending_files:
+                # cmlhn: disable=blocking-under-lock — _io_lock EXISTS to serialize this IO; serves take only _lock and never wait here
                 _write_parquet_atomic(path, tbl)
             if payload["epoch"] >= self._persisted_epoch:
+                # cmlhn: disable=blocking-under-lock — same _io_lock contract: a dedicated write-serialization lock, not the serve lock
                 _write_json_atomic(self._state_path, payload)
                 self._persisted_epoch = payload["epoch"]
                 self._sweep_orphan_deltas(payload)
@@ -559,14 +571,17 @@ class MaterializedView:
         return [size, mtime] != list(meta.get("stat", (size, mtime)))
 
     def _apply(
-        self, bid: int, entry: dict, pending_files: list | None = None
+        self, bid: int, entry: dict, pending_files: list
     ) -> bool:
         """Apply one committed batch's delta exactly once.  The named
         fault site fires FIRST: a kill here leaves the batch committed
         but unapplied, and the next refresh picks it up — never twice.
-        Row-level delta files are staged into ``pending_files`` for the
-        caller to write after the lock drops (or written inline when no
-        staging list is handed in)."""
+        Row-level delta files are ALWAYS staged into ``pending_files``
+        for the caller to write after the lock drops — an inline-write
+        fallback here used to put os.replace on the lock-held refresh
+        path (ISSUE 15 deep blocking-under-lock true positive; the
+        branch was dead — refresh is the only caller and always
+        stages)."""
         fault_point("sql.view.maintain", view=self.name, batch_id=bid)
         meta: dict = {
             "file": entry["file"],
@@ -616,10 +631,7 @@ class MaterializedView:
                     # reapplied batch's file with pre-replay rows
                     fname = f"delta-{bid:010d}-{self._epoch + 1:08d}.parquet"
                     fpath = os.path.join(self.state_dir, fname)
-                    if pending_files is not None:
-                        pending_files.append((fpath, delta))
-                    else:
-                        _write_parquet_atomic(fpath, delta)
+                    pending_files.append((fpath, delta))
                     meta["delta_file"] = fname
                     self._delta_cache[bid] = delta
                 else:

@@ -119,6 +119,7 @@ class FitCheckpointer:
         """Repair the directory after a crash mid-``save``: restore any
         displaced committed step whose replacement never landed, then drop
         leftover staging dirs."""
+        repaired = False
         for name in os.listdir(self.path):
             if name.startswith(".old-step-"):
                 step_dir = os.path.join(self.path, name.replace(".old-", "", 1))
@@ -127,8 +128,15 @@ class FitCheckpointer:
                     # crash between displacing the old step and installing
                     # the new one — the displaced copy is the real state
                     os.replace(old_dir, step_dir)
+                    repaired = True
                 else:
                     shutil.rmtree(old_dir, ignore_errors=True)
+        if repaired:
+            # the restore must be directory-durable before a subsequent
+            # save displaces/prunes again — power loss after that save's
+            # commit could otherwise resurrect the .old dir and shadow a
+            # newer committed step (ISSUE 15 rename-without-dirsync)
+            _fsync_dir(self.path)
         for name in os.listdir(self.path):
             if name.startswith(".tmp-step-"):
                 shutil.rmtree(os.path.join(self.path, name), ignore_errors=True)

@@ -174,12 +174,19 @@ class FlightRecorder:
         tag = _SAFE.sub("_", (site or reason))[:48]
         path = os.path.join(d, f"flight-{os.getpid()}-{seq:04d}-{tag}.json")
         tmp = path + ".tmp"
+        # The flight recorder is a best-effort OBSERVER of the
+        # durability story, not a member of it (PR 8): a postmortem
+        # lost to power loss costs evidence, never state, and routing
+        # it through the sanctioned helpers would put an fsync_dir on
+        # the breaker-trip path this module exists to keep cheap.
+        # cmlhn: disable=raw-durable-write — best-effort postmortem observer, loss costs evidence never state
         with open(tmp, "w") as f:
             json.dump(
                 record, f, sort_keys=True, separators=(",", ":"), default=str
             )
             f.flush()
             os.fsync(f.fileno())
+        # cmlhn: disable=raw-durable-rename — best-effort postmortem observer, loss costs evidence never state
         os.replace(tmp, path)
         self.dumps += 1
         self.last_dump_path = path

@@ -28,6 +28,7 @@ import os
 import time
 from dataclasses import dataclass
 
+from ..io.fit_checkpoint import fsync_dir as _fsync_dir
 from .wal import append_line as _append_line, read_lines as _read_lines
 
 QUARANTINE_DIR = "quarantine"
@@ -146,6 +147,11 @@ class StreamCheckpoint:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, p)
+        # quarantine evidence is commit-as-skipped's justification: the
+        # commits.log entry is fsync'd, so the evidence rename must be
+        # directory-durable too or power loss leaves a skipped batch
+        # with no record of why (ISSUE 15 rename-without-dirsync)
+        _fsync_dir(qdir)
         return p
 
     def quarantine_rows(
@@ -182,6 +188,7 @@ class StreamCheckpoint:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, p)
+        _fsync_dir(qdir)   # same contract as the batch-quarantine write
         return p
 
     def quarantined_rows(self) -> list[dict]:

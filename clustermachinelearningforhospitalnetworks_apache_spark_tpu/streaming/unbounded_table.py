@@ -52,12 +52,21 @@ class UnboundedTable:
     def _write_parquet(self, table: Table, path: str) -> None:
         import pyarrow.parquet as pq
 
+        from ..io.fit_checkpoint import fsync_dir
         from ..utils.faults import fault_point
 
         fault_point("sink.write_part", path=path)
         tmp = path + ".tmp"
         pq.write_table(table.to_arrow(), tmp)
+        # fsync the bytes, then the rename, then the directory: the
+        # commit-log append (wal.py) IS fsync'd, so without these a
+        # power loss could keep the commit line while dropping the very
+        # part bytes it declares committed (ISSUE 15 rename-without-
+        # dirsync true positive)
+        with open(tmp, "rb+") as f:
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        fsync_dir(self.path)
 
     def _append_commit(self, entry: dict) -> None:
         append_line(os.path.join(self.path, COMMIT_LOG), entry)

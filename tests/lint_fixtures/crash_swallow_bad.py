@@ -1,0 +1,16 @@
+"""Fixture: crash-swallowed — handlers that eat InjectedCrash (a
+BaseException ON PURPOSE) without re-raising or delivering it."""
+
+
+def poll(source):
+    try:
+        return source.read()
+    except:  # noqa: E722 — BAD: bare except eats the chaos kill
+        return None
+
+
+def retry(fn):
+    try:
+        return fn()
+    except BaseException:  # BAD: swallows InjectedCrash, tests nothing
+        return None

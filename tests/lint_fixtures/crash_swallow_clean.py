@@ -1,0 +1,24 @@
+"""Fixture: crash-swallowed clean twin — Exception handlers can't eat a
+BaseException kill; BaseException handlers re-raise or hand the object
+onward (the pipelined prefetcher's capture-and-deliver shape)."""
+
+
+def poll(source):
+    try:
+        return source.read()
+    except Exception:  # cannot eat a BaseException chaos kill
+        return None
+
+
+def deliver(fn):
+    try:
+        return None, fn()
+    except BaseException as e:
+        return e, None  # capture-and-deliver: the object travels onward
+
+
+def reraise(fn):
+    try:
+        return fn()
+    except BaseException:
+        raise

@@ -1,0 +1,21 @@
+"""Fixture: rename-without-dirsync — the commit rename happens in a
+HELPER and no fsync_dir is reachable after it in the helper or along
+any caller chain.  The taint (tmp/final are durable only via the
+caller's argument) and the caller-chain reachability are both
+cross-function: the one-hop engine provably cannot see this.  Staged at
+a sanctioned module path by the test."""
+
+import os
+
+
+def _install(tmp, final_path):
+    os.replace(tmp, final_path)  # BAD: no dirsync here or in any caller
+
+
+def save_step(ckpt_dir, payload):
+    tmp = os.path.join(ckpt_dir, "step-000001.tmp")
+    with open(tmp, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    _install(tmp, os.path.join(ckpt_dir, "step-000001"))

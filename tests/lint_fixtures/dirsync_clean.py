@@ -1,0 +1,28 @@
+"""Fixture: rename-without-dirsync clean twin — the rename still lives
+in a helper, but the CALLER fsyncs the directory after the helper
+returns (the legal save()/finalize() split: reachability along the
+caller chain satisfies the rule)."""
+
+import os
+
+
+def fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _install(tmp, final_path):
+    os.replace(tmp, final_path)
+
+
+def save_step(ckpt_dir, payload):
+    tmp = os.path.join(ckpt_dir, "step-000001.tmp")
+    with open(tmp, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    _install(tmp, os.path.join(ckpt_dir, "step-000001"))
+    fsync_dir(ckpt_dir)

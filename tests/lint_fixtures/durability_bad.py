@@ -1,0 +1,35 @@
+"""Fixture: raw durable IO outside the sanctioned durability modules —
+raw-durable-write (direct and through a helper PARAMETER, the
+cross-call taint the one-hop engine provably cannot see),
+raw-durable-rename, and wal-append-bypass."""
+
+import os
+
+
+def save_state(ckpt_dir, payload):
+    path = os.path.join(ckpt_dir, "step-000001.json")
+    with open(path, "w") as f:  # BAD: raw-durable-write
+        f.write(payload)
+
+
+def _dump(path, payload):
+    # BAD: raw-durable-write — `path` is durable only via the CALLER's
+    # argument (parameter taint across the call boundary)
+    with open(path, "w") as f:
+        f.write(payload)
+
+
+def save_evidence(quarantine_dir, payload):
+    _dump(os.path.join(quarantine_dir, "evidence.json"), payload)
+
+
+def promote(ckpt_dir):
+    staged = os.path.join(ckpt_dir, "step-000002.tmp")
+    # BAD: raw-durable-rename — an unsanctioned commit point
+    os.replace(staged, os.path.join(ckpt_dir, "step-000002"))
+
+
+def log_offsets(checkpoint_path, line):
+    # BAD: wal-append-bypass — appends route through wal.append_lines
+    with open(checkpoint_path + "/offsets.log", "a") as f:
+        f.write(line)

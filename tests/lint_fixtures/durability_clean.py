@@ -1,0 +1,27 @@
+"""Fixture: durability clean twin — non-durable scratch writes and
+read-mode opens on durable paths are all legal outside the sanctioned
+modules."""
+
+import os
+
+
+def save_report(report_dir, payload):
+    # a scratch report is not durable state — no protocol applies
+    with open(os.path.join(report_dir, "summary.json"), "w") as f:
+        f.write(payload)
+
+
+def read_state(ckpt_dir):
+    # read-mode open of a durable path is always fine
+    with open(os.path.join(ckpt_dir, "step-000001.json")) as f:
+        return f.read()
+
+
+def _dump(path, payload):
+    with open(path, "w") as f:
+        f.write(payload)
+
+
+def save_summary(report_dir, payload):
+    # helper parameter stays untainted: no durable caller
+    _dump(os.path.join(report_dir, "summary.json"), payload)

@@ -1,0 +1,24 @@
+"""Fixture: blocking-under-lock THROUGH a helper call — the fsync is
+two frames down, so only the interprocedural engine (deep=True) can see
+it; the PR 11 lexical/one-hop engine provably misses this file."""
+
+import os
+import threading
+
+
+class Journal:
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+        self._pending = {}
+
+    def append(self, entry):
+        with self._lock:
+            self._pending[entry["id"]] = entry
+            self._flush()  # BAD (deep): _flush -> _sync -> os.fsync
+
+    def _flush(self):
+        self._sync()
+
+    def _sync(self):
+        os.fsync(self._f.fileno())

@@ -1,0 +1,24 @@
+"""Fixture: interprocedural blocking-under-lock clean twin — stage
+under the lock, run the fsync-reaching helper after release."""
+
+import os
+import threading
+
+
+class Journal:
+    def __init__(self, f):
+        self._lock = threading.Lock()
+        self._f = f
+        self._pending = {}
+
+    def append(self, entry):
+        with self._lock:
+            self._pending[entry["id"]] = entry
+            staged = dict(self._pending)
+        self._flush(staged)
+
+    def _flush(self, staged):
+        self._sync()
+
+    def _sync(self):
+        os.fsync(self._f.fileno())

@@ -1,0 +1,18 @@
+"""Fixture: donated-arg-reused ACROSS a call boundary — the helper
+forwards its parameter into a donate_argnums position, so the caller's
+buffer is invalidated through the call; only the deep summary engine
+sees it (the single-file rule provably misses this)."""
+
+import jax
+
+_step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+
+def apply_step(state, x):
+    return _step(state, x)
+
+
+def run(state, x):
+    new_state = apply_step(state, x)
+    total = state.sum()  # BAD (deep): state was donated inside apply_step
+    return new_state, total

@@ -1,0 +1,16 @@
+"""Fixture: interprocedural donation clean twin — the donation idiom:
+rebind the caller's name to the result, never touch the old buffer."""
+
+import jax
+
+_step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+
+def apply_step(state, x):
+    return _step(state, x)
+
+
+def run(state, x):
+    state = apply_step(state, x)  # rebound: the old buffer is dead
+    total = state.sum()
+    return state, total

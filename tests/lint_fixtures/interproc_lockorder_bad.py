@@ -1,0 +1,34 @@
+"""Fixture: lock-order-cycle through the CALL GRAPH — each chain's
+second acquisition is two call hops from the first, so the lexical
+nesting walk and the one-hop method rule both provably miss it; only
+the deep same-module callee walk sees the A->B / B->A cycle."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+STATE = {}
+
+
+def forward():
+    with LOCK_A:
+        _fwd_helper()
+
+
+def _fwd_helper():
+    _fwd_inner()
+
+
+def _fwd_inner():
+    with LOCK_B:
+        STATE["f"] = 1
+
+
+def backward():
+    with LOCK_B:
+        _bwd_helper()
+
+
+def _bwd_helper():
+    with LOCK_A:  # BAD: B->A while forward's chain is A->B
+        STATE["b"] = 1

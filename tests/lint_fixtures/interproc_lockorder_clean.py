@@ -1,0 +1,32 @@
+"""Fixture: interprocedural lock-order clean twin — both chains
+acquire in the same global order (A before B), cycle-free."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+STATE = {}
+
+
+def forward():
+    with LOCK_A:
+        _fwd_helper()
+
+
+def _fwd_helper():
+    _fwd_inner()
+
+
+def _fwd_inner():
+    with LOCK_B:
+        STATE["f"] = 1
+
+
+def backward():
+    with LOCK_A:
+        _bwd_helper()
+
+
+def _bwd_helper():
+    with LOCK_B:  # same A->B order: no cycle
+        STATE["b"] = 1

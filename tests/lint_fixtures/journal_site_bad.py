@@ -1,0 +1,13 @@
+"""Fixture: journal-mutation-unfaulted — a durable mutation in a
+sanctioned module with NO named fault site firing in the function, its
+callees, or any caller chain: durable state the chaos matrix can never
+kill at.  Staged at a sanctioned module path by the test."""
+
+import os
+
+
+def commit_step(ckpt_dir, payload):
+    tmp = os.path.join(ckpt_dir, "step-000001.tmp")
+    with open(tmp, "w") as f:  # BAD: unkillable durable mutation
+        f.write(payload)
+    os.replace(tmp, os.path.join(ckpt_dir, "step-000001"))  # BAD: same

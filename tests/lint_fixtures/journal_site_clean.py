@@ -1,0 +1,32 @@
+"""Fixture: journal-mutation-unfaulted clean twin — the mutation's own
+function fires a covered site, or a CALLER on the path does (the
+microbatch driver ladder shape: the ancestors walk must find it)."""
+
+import os
+
+
+def fault_point(site, **info):
+    """Stands in for utils.faults.fault_point — the pass matches the
+    call NAME and resolves the site argument, it never imports."""
+
+
+def commit_step(ckpt_dir, payload):
+    fault_point("fit_ckpt.save.commit", path=ckpt_dir)
+    tmp = os.path.join(ckpt_dir, "step-000001.tmp")
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, os.path.join(ckpt_dir, "step-000001"))
+
+
+def _write_state(state_path, payload):
+    # no site HERE — the caller brackets it, which the ancestors walk
+    # must accept
+    tmp = state_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, state_path)
+
+
+def save(ckpt_dir, payload):
+    fault_point("fit_ckpt.save.arrays", path=ckpt_dir)
+    _write_state(os.path.join(ckpt_dir, "step-000001"), payload)

@@ -187,7 +187,7 @@ def test_baseline_round_trip(tmp_path):
     )
     # fingerprints key the stripped source line, not the line number
     data = json.loads(open(bl).read())
-    assert data["version"] == 1 and data["fingerprints"]
+    assert data["version"] == 2 and data["fingerprints"]
 
 
 def test_shipped_baseline_is_empty():
@@ -227,7 +227,10 @@ def test_json_schema_pinned(tmp_path):
     data = json.loads(r.stdout)
     assert set(data) == _REPORT_KEYS
     assert set(data["counts"]) == _COUNT_KEYS
-    assert data["version"] == 1
+    assert data["version"] == 2, (
+        "ISSUE 15 bumped the engine version: the interprocedural layer "
+        "changes what a scan means, so schema consumers must see it"
+    )
     assert data["findings"], "fixture must produce findings"
     for f in data["findings"]:
         assert set(f) == _FINDING_KEYS
@@ -265,9 +268,11 @@ def test_unknown_pass_is_usage_error():
 # ============================================================ the tier-1 gate
 def test_repo_is_lint_clean_fast_and_jaxfree():
     """THE meta-test: the engine runs clean over the live package with
-    ≥ 5 passes in < 10 s — and the subprocess proves the run never
-    imports jax or numpy (``-S`` keeps the image's sitecustomize from
-    pre-importing jax on its own)."""
+    ≥ 8 passes in ≤ 15 s (re-pinned for ISSUE 15 — the call-graph build,
+    the durable-taint fixpoint, and the durability/crash_protocol
+    families ride the same single parse per file) — and the subprocess
+    proves the run never imports jax or numpy (``-S`` keeps the image's
+    sitecustomize from pre-importing jax on its own)."""
     code = (
         "import sys, json\n"
         f"sys.path.insert(0, {TOOLS!r})\n"
@@ -292,11 +297,11 @@ def test_repo_is_lint_clean_fast_and_jaxfree():
         "the live package must be lint-clean:\n"
         + "\n".join(f"  {p}:{ln} {rule}" for rule, p, ln in data["active"])
     )
-    assert len(data["passes"]) >= 5
+    assert len(data["passes"]) >= 8
     assert data["files"] > 100, "full scan set went missing"
-    assert data["runtime_s"] < 10.0, (
-        f"engine took {data['runtime_s']:.1f}s — the <10s pre-commit "
-        "budget is part of the contract"
+    assert data["runtime_s"] <= 15.0, (
+        f"engine took {data['runtime_s']:.1f}s — the ≤15s pre-commit "
+        "budget is part of the contract (ISSUE 15 re-pin)"
     )
 
 

@@ -1,0 +1,639 @@
+"""Interprocedural lint (ISSUE 15) — call-graph resolution, durable
+taint, the three new pass families, and the provably-misses contract.
+
+Layers:
+
+* **call-graph unit suite** — the documented resolution rules
+  (module-level alias, ``self._helper``, one-assignment attribute,
+  annotation types, parameter-default indirection, cross-module
+  imports, recursion terminates);
+* **durable-taint units** — parameter and return-value propagation;
+* **per-rule fixtures** — bad+clean pairs for every new rule
+  (durability family, crash_protocol family, the interprocedural
+  concurrency/donation upgrades);
+* **provably-misses** — every interprocedural fixture is run through
+  the PR 11 one-hop engine (``deep=False`` / pre-ISSUE-15 pass set) and
+  must produce ZERO findings there: the new engine's value is exactly
+  the delta;
+* **regression per fixed true positive** — the old buggy shape of each
+  in-tree fix (unbounded_table part write, quarantine evidence,
+  sql_views snapshot, the _apply inline-write-under-lock) staged at its
+  sanctioned path must fire, and the one-hop engine must miss it;
+* **CLI** — the ``--format=github`` annotation schema pin.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+PKG = "clustermachinelearningforhospitalnetworks_apache_spark_tpu"
+
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+from lint import run  # noqa: E402
+from lint.callgraph import ProjectGraph  # noqa: E402
+from lint.dataflow import DurableTaint  # noqa: E402
+from lint.engine import Project, load_file  # noqa: E402
+from lint.passes.concurrency import ConcurrencyPass  # noqa: E402
+from lint.passes.crash_protocol import CrashProtocolPass  # noqa: E402
+from lint.passes.durability import DurabilityPass  # noqa: E402
+from lint.passes.jit_hygiene import JitHygienePass  # noqa: E402
+
+
+# ------------------------------------------------------------- helpers
+def build_project(tmp_path, sources: dict[str, str]):
+    """Write ``rel -> source`` under a temp root, parse, build the graph."""
+    root = tmp_path / "repo"
+    paths = []
+    for rel, src in sources.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        paths.append(str(p))
+    contexts = [load_file(p, str(root)) for p in paths]
+    project = Project(root=str(root), contexts=contexts)
+    project.graph = ProjectGraph(project)
+    return project
+
+
+def stage_and_run(
+    tmp_path, fixture: str, dest_rel: str, passes, complete: bool = True,
+    with_trace: bool = False,
+):
+    """Stage a fixture AT an explicit repo-relative path (the durability
+    rules are sanctioned-module-scoped, so the staged NAME matters) and
+    run the given pass instances over it."""
+    root = tmp_path / "repo"
+    target = root / dest_rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(FIXTURES, fixture), target)
+    paths = [str(target)]
+    if with_trace:
+        obs = root / PKG / "obs"
+        obs.mkdir(parents=True, exist_ok=True)
+        shutil.copy(
+            os.path.join(ROOT, PKG, "obs", "trace.py"), obs / "trace.py"
+        )
+        paths.append(str(obs / "trace.py"))
+    return run(paths=paths, passes=passes, root=str(root), complete=complete)
+
+
+def rules_of(report) -> set[str]:
+    return {f.rule for f in report.active}
+
+
+def fmt(report) -> str:
+    return "\n".join(
+        f"  {f.path}:{f.line} {f.rule} {f.message[:80]}"
+        for f in report.active
+    )
+
+
+# ------------------------------------------------- call-graph resolution
+def test_resolves_module_alias(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "def helper():\n    pass\n\ng = helper\n\n"
+        "def f():\n    g()\n"
+    )}).graph
+    (cs,) = g.callees(("m.py", "f"))
+    assert cs.target == ("m.py", "helper")
+
+
+def test_resolves_self_method(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "class C:\n"
+        "    def m(self):\n        self._helper()\n"
+        "    def _helper(self):\n        pass\n"
+    )}).graph
+    (cs,) = g.callees(("m.py", "C.m"))
+    assert cs.target == ("m.py", "C._helper")
+
+
+def test_resolves_one_assignment_attribute(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "class Writer:\n    def write(self):\n        pass\n\n"
+        "class A:\n"
+        "    def __init__(self):\n        self.w = Writer()\n"
+        "    def go(self):\n        self.w.write()\n"
+    )}).graph
+    targets = {cs.target for cs in g.callees(("m.py", "A.go"))}
+    assert ("m.py", "Writer.write") in targets
+
+
+def test_resolves_annotated_attribute(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "class Writer:\n    def write(self):\n        pass\n\n"
+        "class A:\n"
+        "    w: Writer\n"
+        "    def go(self):\n        self.w.write()\n"
+    )}).graph
+    targets = {cs.target for cs in g.callees(("m.py", "A.go"))}
+    assert ("m.py", "Writer.write") in targets
+
+
+def test_resolves_parameter_default(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "def helper():\n    pass\n\n"
+        "def run(hook=helper):\n    hook()\n"
+    )}).graph
+    (cs,) = g.callees(("m.py", "run"))
+    assert cs.target == ("m.py", "helper")
+
+
+def test_resolves_local_single_assignment(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "def helper():\n    pass\n\n"
+        "def f():\n    h = helper\n    h()\n"
+    )}).graph
+    (cs,) = g.callees(("m.py", "f"))
+    assert cs.target == ("m.py", "helper")
+
+
+def test_rebound_local_is_ambiguous(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "def helper():\n    pass\n\ndef other():\n    pass\n\n"
+        "def f(flag):\n"
+        "    h = helper\n"
+        "    if flag:\n        h = other\n"
+        "    h()\n"
+    )}).graph
+    (cs,) = g.callees(("m.py", "f"))
+    assert cs.target is None, "a rebound alias must not resolve"
+
+
+def test_resolves_cross_module_import(tmp_path):
+    g = build_project(tmp_path, {
+        "pkg/a.py": "def helper():\n    pass\n",
+        "pkg/b.py": (
+            "from .a import helper\n\n"
+            "def f():\n    helper()\n"
+        ),
+    }).graph
+    (cs,) = g.callees(("pkg/b.py", "f"))
+    assert cs.target == ("pkg/a.py", "helper")
+
+
+def test_recursion_does_not_loop(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "def f():\n    g()\n\n"
+        "def g():\n    f()\n"
+    )}).graph
+    reach = g.reachable(("m.py", "f"))
+    assert ("m.py", "g") in reach and ("m.py", "f") in reach
+
+
+def test_dynamic_callable_parameter_unresolved(tmp_path):
+    g = build_project(tmp_path, {"m.py": (
+        "def run(hook):\n    hook()\n"
+    )}).graph
+    (cs,) = g.callees(("m.py", "run"))
+    assert cs.target is None, "a no-default parameter is genuinely dynamic"
+
+
+# --------------------------------------------------------- durable taint
+def test_taint_flows_into_callee_parameter(tmp_path):
+    project = build_project(tmp_path, {"m.py": (
+        "import os\n\n"
+        "def _dump(path):\n    return path\n\n"
+        "def save(ckpt_dir):\n"
+        "    _dump(os.path.join(ckpt_dir, 'step-1'))\n"
+    )})
+    taint = DurableTaint(project.graph)
+    assert "path" in taint.params.get(("m.py", "_dump"), set())
+
+
+def test_taint_flows_out_of_return_value(tmp_path):
+    project = build_project(tmp_path, {"m.py": (
+        "def part_path(i):\n    return 'part-' + str(i)\n\n"
+        "def g():\n    p = part_path(0)\n    return p\n"
+    )})
+    taint = DurableTaint(project.graph)
+    assert ("m.py", "part_path") in taint.returns
+    assert "p" in taint.locals.get(("m.py", "g"), set())
+
+
+def test_plain_scratch_path_stays_untainted(tmp_path):
+    project = build_project(tmp_path, {"m.py": (
+        "import os\n\n"
+        "def save(report_dir):\n"
+        "    p = os.path.join(report_dir, 'summary.json')\n"
+        "    return p\n"
+    )})
+    taint = DurableTaint(project.graph)
+    assert "p" not in taint.locals.get(("m.py", "save"), set())
+
+
+# --------------------------------------------------- new-rule fixtures
+NEW_RULE_CASES = [
+    # (fixture, dest rel path, pass factory, expected rules, with_trace)
+    ("durability_bad.py", f"{PKG}/models/durability_bad.py",
+     lambda: [DurabilityPass()],
+     {"raw-durable-write", "raw-durable-rename", "wal-append-bypass"},
+     False),
+    ("dirsync_bad.py", f"{PKG}/streaming/checkpoint.py",
+     lambda: [DurabilityPass()], {"rename-without-dirsync"}, False),
+    ("crash_swallow_bad.py", f"{PKG}/models/crash_swallow_bad.py",
+     lambda: [CrashProtocolPass()], {"crash-swallowed"}, False),
+    ("journal_site_bad.py", f"{PKG}/io/fit_checkpoint.py",
+     lambda: [CrashProtocolPass()], {"journal-mutation-unfaulted"}, True),
+    ("interproc_blocking_bad.py", f"{PKG}/models/ipb.py",
+     lambda: [ConcurrencyPass()], {"blocking-under-lock"}, False),
+    ("interproc_lockorder_bad.py", f"{PKG}/models/ipl.py",
+     lambda: [ConcurrencyPass()], {"lock-order-cycle"}, False),
+    ("interproc_donate_bad.py", f"{PKG}/models/ipd.py",
+     lambda: [JitHygienePass()], {"donated-arg-reused"}, False),
+]
+
+
+@pytest.mark.parametrize(
+    "fixture,dest,factory,expected,with_trace", NEW_RULE_CASES,
+    ids=[c[0].removesuffix("_bad.py") for c in NEW_RULE_CASES],
+)
+def test_new_rule_fires_on_violation(
+    tmp_path, fixture, dest, factory, expected, with_trace
+):
+    report = stage_and_run(
+        tmp_path, fixture, dest, factory(), with_trace=with_trace
+    )
+    got = rules_of(report)
+    assert expected <= got, (
+        f"{fixture}: expected {sorted(expected)}, got {sorted(got)}:\n"
+        + fmt(report)
+    )
+
+
+@pytest.mark.parametrize(
+    "fixture,dest,factory,expected,with_trace", NEW_RULE_CASES,
+    ids=[c[0].removesuffix("_bad.py") for c in NEW_RULE_CASES],
+)
+def test_new_rule_clean_twin_stays_clean(
+    tmp_path, fixture, dest, factory, expected, with_trace
+):
+    clean = fixture.replace("_bad.py", "_clean.py")
+    dest = dest.replace("_bad.py", "_clean.py")
+    report = stage_and_run(
+        tmp_path, clean, dest, factory(), with_trace=with_trace
+    )
+    assert not report.active, f"{clean} should be clean:\n" + fmt(report)
+
+
+def test_durability_rules_complete_scan_only(tmp_path):
+    """--changed-only contract: the program-completeness durability rule
+    (rename-without-dirsync needs CALLERS) auto-disables on partial
+    scans, same as obs_coverage."""
+    report = stage_and_run(
+        tmp_path, "dirsync_bad.py", f"{PKG}/streaming/checkpoint.py",
+        [DurabilityPass()], complete=False,
+    )
+    assert "rename-without-dirsync" not in rules_of(report)
+    report = stage_and_run(
+        tmp_path, "journal_site_bad.py", f"{PKG}/io/fit_checkpoint.py",
+        [CrashProtocolPass()], complete=False, with_trace=True,
+    )
+    assert "journal-mutation-unfaulted" not in rules_of(report)
+
+
+# --------------------------------------------------- provably-misses
+OLD_ENGINE_CASES = [
+    ("interproc_blocking_bad.py", f"{PKG}/models/ipb.py",
+     lambda: [ConcurrencyPass(deep=False)]),
+    ("interproc_lockorder_bad.py", f"{PKG}/models/ipl.py",
+     lambda: [ConcurrencyPass(deep=False)]),
+    ("interproc_donate_bad.py", f"{PKG}/models/ipd.py",
+     lambda: [JitHygienePass(deep=False)]),
+]
+
+
+@pytest.mark.parametrize(
+    "fixture,dest,factory", OLD_ENGINE_CASES,
+    ids=[c[0].removesuffix("_bad.py") for c in OLD_ENGINE_CASES],
+)
+def test_one_hop_engine_provably_misses(tmp_path, fixture, dest, factory):
+    """The PR 11 engine (deep=False) finds NOTHING in the
+    interprocedural fixtures — the deep engine's findings are exactly
+    the cross-function delta the review rounds kept catching by hand."""
+    report = stage_and_run(tmp_path, fixture, dest, factory())
+    assert not report.active, (
+        f"one-hop engine unexpectedly sees {fixture}:\n" + fmt(report)
+    )
+
+
+def test_deep_donation_module_qualified_call_binding(tmp_path):
+    """Review-round regression: a module-qualified ``helpers.f(a, b)``
+    call is an Attribute but consumes NO self slot — the donated-
+    argument mapping was off by one (flagged the undonated arg, missed
+    the donated one).  The binding offset must apply only when the
+    callee's first parameter IS self/cls."""
+    project = build_project(tmp_path, {
+        "pkg/helpers.py": (
+            "import jax\n\n"
+            "_step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))\n\n\n"
+            "def run_step(params, batch):\n"
+            "    return _step(batch, params)\n"
+        ),
+        "pkg/caller.py": (
+            "from . import helpers\n\n\n"
+            "def train(p, b):\n"
+            "    out = helpers.run_step(p, b)\n"
+            "    return out, b.sum(), p.sum()\n"
+        ),
+    })
+    jp = JitHygienePass()
+    caller = project.context("pkg/caller.py")
+    findings = list(jp._check_donated_reuse_deep(caller, project))
+    assert findings, "the forwarded donation must be seen cross-module"
+    assert all("'b'" in f.message for f in findings), [
+        f.message[:60] for f in findings
+    ]
+    assert not any("'p'" in f.message for f in findings), (
+        "the undonated argument must NOT be flagged (off-by-one binding)"
+    )
+
+
+def test_deep_lockorder_cross_module_order_independent(tmp_path):
+    """Review-round regression: the per-function lock table was filled
+    lazily per file, so an edge into a module scanned LATER was dropped
+    and the reported cycle set depended on file iteration order.  A
+    cross-module ABBA (caller file sorts first) must still cycle."""
+    sources = {
+        f"{PKG}/models/aa.py": (
+            "import threading\n\n"
+            "from . import zz\n\n"
+            "LOCK_A = threading.Lock()\n\n\n"
+            "def fwd():\n"
+            "    with LOCK_A:\n"
+            "        zz.take_b()\n\n\n"
+            "def take_a():\n"
+            "    with LOCK_A:\n"
+            "        pass\n"
+        ),
+        f"{PKG}/models/zz.py": (
+            "import threading\n\n"
+            "from . import aa\n\n"
+            "LOCK_B = threading.Lock()\n\n\n"
+            "def take_b():\n"
+            "    with LOCK_B:\n"
+            "        pass\n\n\n"
+            "def bwd():\n"
+            "    with LOCK_B:\n"
+            "        aa.take_a()\n"
+        ),
+    }
+    root = tmp_path / "repo"
+    paths = []
+    for rel, src in sources.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        paths.append(str(p))
+    report = run(
+        paths=paths, passes=[ConcurrencyPass()], root=str(root),
+        complete=True,
+    )
+    assert "lock-order-cycle" in rules_of(report), fmt(report)
+
+
+# ------------------------------------- regressions: fixed true positives
+#: the OLD (pre-ISSUE-15) buggy shape of each in-tree fix, staged at its
+#: real sanctioned path; the durability pass must fire and the one-hop
+#: PR 11 pass set must stay silent (it had no durability rules at all,
+#: and the taint is cross-function besides)
+_OLD_PART_WRITE = '''\
+import os
+
+
+def _append_commit(log_path, line):
+    return (log_path, line)
+
+
+class UnboundedTable:
+    def __init__(self, path):
+        self.path = path
+
+    def _write_parquet(self, table, path):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(table)
+        os.replace(tmp, path)  # OLD BUG: no bytes fsync, no dirsync
+'''
+
+_OLD_QUARANTINE = '''\
+import os
+
+
+class StreamCheckpoint:
+    def __init__(self, path):
+        self.path = path
+
+    def quarantine(self, batch_id, payload):
+        qdir = os.path.join(self.path, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        p = os.path.join(qdir, f"batch-{batch_id}.json")
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)  # OLD BUG: evidence rename, no dirsync
+        return p
+'''
+
+_OLD_VIEW_SNAPSHOT = '''\
+import os
+
+
+def _write_json_atomic(path, payload):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # OLD BUG: snapshot rename, no dirsync
+
+
+class MaterializedView:
+    def __init__(self, state_dir):
+        self.state_dir = state_dir
+        self._state_path = os.path.join(state_dir, "_views", "state.json")
+
+    def persist(self, payload):
+        _write_json_atomic(self._state_path, payload)
+'''
+
+_OLD_APPLY_INLINE_WRITE = '''\
+import os
+import threading
+
+
+def _write_parquet_atomic(path, table):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(table)
+    os.replace(tmp, path)
+
+
+class MaterializedView:
+    def __init__(self, state_dir):
+        self.state_dir = state_dir
+        self._lock = threading.Lock()
+        self._batches = {}
+
+    def refresh(self, entries):
+        with self._lock:
+            for bid, entry in entries.items():
+                self._apply(bid, entry)  # OLD BUG: inline write branch
+                                         # put os.replace under _lock
+
+    def _apply(self, bid, entry):
+        fpath = os.path.join(self.state_dir, f"delta-{bid}.parquet")
+        _write_parquet_atomic(fpath, entry)
+        self._batches[bid] = fpath
+'''
+
+_REGRESSIONS = [
+    ("unbounded_table_part_write", _OLD_PART_WRITE,
+     f"{PKG}/streaming/unbounded_table.py",
+     lambda: [DurabilityPass()], {"rename-without-dirsync"}),
+    ("quarantine_evidence", _OLD_QUARANTINE,
+     f"{PKG}/streaming/checkpoint.py",
+     lambda: [DurabilityPass()], {"rename-without-dirsync"}),
+    ("view_snapshot", _OLD_VIEW_SNAPSHOT,
+     f"{PKG}/core/sql_views.py",
+     lambda: [DurabilityPass()], {"rename-without-dirsync"}),
+    ("apply_inline_write_under_lock", _OLD_APPLY_INLINE_WRITE,
+     f"{PKG}/core/sql_views.py",
+     lambda: [ConcurrencyPass()], {"blocking-under-lock"}),
+]
+
+
+@pytest.mark.parametrize(
+    "name,source,dest,factory,expected", _REGRESSIONS,
+    ids=[r[0] for r in _REGRESSIONS],
+)
+def test_fixed_true_positive_regression(
+    tmp_path, name, source, dest, factory, expected
+):
+    root = tmp_path / "repo"
+    target = root / dest
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(source)
+    report = run(
+        paths=[str(target)], passes=factory(), root=str(root), complete=True
+    )
+    got = rules_of(report)
+    assert expected <= got, (
+        f"{name}: the old buggy shape must fire {sorted(expected)}; "
+        f"got {sorted(got)}:\n" + fmt(report)
+    )
+
+
+@pytest.mark.parametrize(
+    "name,source,dest,factory,expected", _REGRESSIONS,
+    ids=[r[0] for r in _REGRESSIONS],
+)
+def test_one_hop_engine_missed_the_true_positive(
+    tmp_path, name, source, dest, factory, expected
+):
+    """Why these shipped: the PR 11 engine — its full pass set, lexical
+    one-hop mode, no durability family — reports nothing on the exact
+    code that carried the bug."""
+    from lint.passes.determinism import DeterminismPass
+    from lint.passes.metric_labels import MetricLabelsPass
+
+    old_engine = [
+        ConcurrencyPass(deep=False), JitHygienePass(deep=False),
+        DeterminismPass(), MetricLabelsPass(),
+    ]
+    root = tmp_path / "repo"
+    target = root / dest
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(source)
+    report = run(
+        paths=[str(target)], passes=old_engine, root=str(root), complete=True
+    )
+    assert not report.active, (
+        f"{name}: the PR 11 engine was supposed to miss this:\n"
+        + fmt(report)
+    )
+
+
+def test_live_repo_fixed_sites_clean():
+    """The in-tree fixes hold: the durability + crash_protocol + deep
+    concurrency families over the REAL sanctioned modules report
+    nothing (suppressions carry the deliberate non-fixes)."""
+    paths = [
+        os.path.join(ROOT, PKG, rel) for rel in (
+            "streaming/unbounded_table.py", "streaming/checkpoint.py",
+            "streaming/wal.py", "core/sql_views.py",
+            "io/fit_checkpoint.py", "io/model_io.py",
+        )
+    ]
+    report = run(
+        paths=paths,
+        passes=[DurabilityPass(), ConcurrencyPass(), JitHygienePass()],
+        complete=False,
+    )
+    assert not report.active, fmt(report)
+
+
+# ---------------------------------------------------------------- CLI
+_GITHUB_LINE = re.compile(
+    r"^::error file=[^,]+,line=\d+,col=\d+,title=lint/[a-z0-9\-]+::.+$"
+)
+
+
+def test_github_format_schema_pinned(tmp_path):
+    """--format=github emits one ::error workflow command per active
+    finding, matching the Actions annotation grammar exactly."""
+    root = tmp_path / "repo"
+    dest = root / PKG / "models"
+    dest.mkdir(parents=True)
+    shutil.copy(
+        os.path.join(FIXTURES, "determinism_bad.py"),
+        dest / "determinism_bad.py",
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "lint.py"),
+         "--format=github", "--passes", "determinism", "--root", str(root),
+         str(dest / "determinism_bad.py")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.startswith("::error")]
+    assert lines, "no annotations emitted"
+    for line in lines:
+        assert _GITHUB_LINE.match(line), f"malformed annotation: {line}"
+    assert any("unseeded-random" in l for l in lines)
+    # the summary line still closes the output (humans read CI logs too)
+    assert r.stdout.splitlines()[-1].startswith("lint:")
+
+
+def test_github_format_clean_exit(tmp_path):
+    root = tmp_path / "repo"
+    dest = root / PKG / "models"
+    dest.mkdir(parents=True)
+    shutil.copy(
+        os.path.join(FIXTURES, "determinism_clean.py"),
+        dest / "determinism_clean.py",
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "lint.py"),
+         "--format=github", "--passes", "determinism", "--root", str(root),
+         str(dest / "determinism_clean.py")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "::error" not in r.stdout

@@ -20,6 +20,86 @@ def build_parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
     return parents
 
 
+class ModuleIndex:
+    """One indexed table per parsed module, built in a single walk and
+    shared by every pass (ISSUE 15 satellite: the per-pass full-tree
+    re-walks and the call-graph build all read this instead of walking
+    again).
+
+    * ``parents`` — the child→parent map (same table build_parents made);
+    * ``by_type`` — every node bucketed by AST class, so a pass that
+      wants all ``Call``/``With``/``Assign`` nodes iterates a list;
+    * ``functions`` — dotted *qualname* → FunctionDef/AsyncFunctionDef
+      (``Class.method``, ``outer.inner`` for nested defs);
+    * ``fn_of`` — the reverse: def node → qualname;
+    * ``classes`` — class name → ClassDef (module-level and nested);
+    * ``imports`` — local name → ``(module, original, level)`` for both
+      ``import m``/``import m as a`` (original ``""``) and
+      ``from .m import f as a`` (relative ``level`` kept so the project
+      graph can resolve the target file);
+    * ``module_assigns`` — the module-body Assign nodes (alias tables).
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        self.by_type: dict[type, list[ast.AST]] = {}
+        self.functions: dict[str, ast.AST] = {}
+        self.fn_of: dict[ast.AST, str] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.imports: dict[str, tuple[str, str, int]] = {}
+        self.module_assigns: list[ast.Assign] = []
+
+        stack: list[tuple[ast.AST, str]] = [(tree, "")]
+        while stack:
+            node, prefix = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                self.by_type.setdefault(type(child), []).append(child)
+                sub_prefix = prefix
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = prefix + child.name
+                    # latest def wins on a redefinition — matches runtime
+                    self.functions[qn] = child
+                    self.fn_of[child] = qn
+                    sub_prefix = qn + "."
+                elif isinstance(child, ast.ClassDef):
+                    self.classes.setdefault(child.name, child)
+                    sub_prefix = prefix + child.name + "."
+                elif isinstance(child, ast.Import):
+                    for alias in child.names:
+                        local = alias.asname or alias.name.split(".")[0]
+                        self.imports[local] = (alias.name, "", 0)
+                elif isinstance(child, ast.ImportFrom):
+                    for alias in child.names:
+                        local = alias.asname or alias.name
+                        self.imports[local] = (
+                            child.module or "", alias.name, child.level
+                        )
+                elif isinstance(child, ast.Assign) and node is tree:
+                    self.module_assigns.append(child)
+                stack.append((child, sub_prefix))
+
+    def nodes(self, *types: type) -> list[ast.AST]:
+        """All nodes of the given AST classes (one bucketed lookup, no
+        re-walk); order is walk order within a bucket."""
+        if len(types) == 1:
+            return self.by_type.get(types[0], [])
+        out: list[ast.AST] = []
+        for t in types:
+            out.extend(self.by_type.get(t, []))
+        return out
+
+    def enclosing_function_qualname(self, node: ast.AST) -> str | None:
+        """Qualname of the innermost (non-lambda) def containing ``node``."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if cur in self.fn_of:
+                return self.fn_of[cur]
+            cur = self.parents.get(cur)
+        return None
+
+
 def ancestors(node: ast.AST, parents: dict) -> Iterator[ast.AST]:
     cur = parents.get(node)
     while cur is not None:

@@ -1,0 +1,446 @@
+"""Module-scoped call graph for the interprocedural passes (ISSUE 15).
+
+PR 11's engine was per-function lexical: ``blocking-under-lock`` saw
+only calls written directly inside the ``with`` block, ``lock-order-
+cycle`` followed exactly one ``self.method()`` hop, and the PR 12
+review rounds were dominated by cross-function protocol slips none of
+the passes could see.  This module builds, ONCE per run and off the
+shared :class:`~.astutils.ModuleIndex` (no extra parse), the call graph
+those passes walk.
+
+Resolution rules (the documented contract, unit-tested in
+``tests/test_lint_interproc.py``):
+
+* ``name(...)`` — a module-level def, a module-level single-assignment
+  alias (``g = helper``), a function-local single-assignment alias, or
+  a *parameter default* (``def run(hook=helper)``) — each followed at
+  most 4 hops;
+* ``self.m(...)`` — the enclosing class's method;
+* ``self.attr.m(...)`` — when ``self.attr`` is assigned exactly once in
+  the class from ``SomeClass(...)``, resolves to ``SomeClass.m`` (the
+  one-assignment indirection rule), including when ``SomeClass`` is
+  imported from another scanned module;
+* ``var.m(...)`` — same, for a function-local ``var = SomeClass(...)``;
+* ``mod.f(...)`` / ``ClassName.m(...)`` — import- and class-qualified
+  names, resolved through the module's import table;
+* ``ClassName(...)`` — resolves to ``ClassName.__init__`` when defined.
+
+Anything else resolves to ``None`` (an *external* call — stdlib, jax,
+an unresolvable dynamic target); passes treat unresolved calls
+conservatively per rule.  Recursion is safe by construction: every
+transitive walk is a visited-set BFS, never unbounded descent.
+
+Keys are ``(rel_path, qualname)`` pairs; module-level code owns the
+qualname ``""``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .astutils import dotted_name
+
+#: resolution key for a function: (repo-relative path, dotted qualname)
+Key = tuple
+
+MODULE_BODY = ""          # qualname of module-level code
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    raw: str | None            # the dotted source text of the callee
+    target: Key | None = None  # resolved (rel, qualname), or None
+
+
+@dataclass
+class FunctionEntry:
+    key: Key
+    node: ast.AST | None       # def node (None for the module body)
+    calls: list[CallSite] = field(default_factory=list)
+
+
+class _Module:
+    """Per-module resolution state derived from one ModuleIndex."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.index = ctx.index
+        #: module-level single-assignment aliases  name -> value expr
+        self.aliases: dict[str, ast.expr] = _single_assign_exprs(
+            self.index.module_assigns
+        )
+        #: (class name, attr) -> dotted type name, for self.attr assigned
+        #: exactly ONCE in the class from ``TypeName(...)`` — or declared
+        #: by an annotation (dataclass fields, ``self.x: T = …``);
+        #: annotations win, they are the stated contract
+        self.attr_types: dict[tuple[str, str], str] = {}
+        for cname, cls in self.index.classes.items():
+            counts: dict[str, int] = {}
+            types: dict[str, str] = {}
+            annotated: dict[str, str] = {}
+            for node in ast.walk(cls):
+                if isinstance(node, ast.AnnAssign):
+                    attr = None
+                    if isinstance(node.target, ast.Name) and any(
+                        node is b for b in cls.body
+                    ):
+                        attr = node.target.id        # dataclass field
+                    elif isinstance(node.target, ast.Attribute) and \
+                            isinstance(node.target.value, ast.Name) and \
+                            node.target.value.id == "self":
+                        attr = node.target.attr
+                    if attr is not None:
+                        tn = _annotation_type(node.annotation)
+                        if tn:
+                            annotated[attr] = tn
+                    continue
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                        t.value, ast.Name
+                    ) and t.value.id == "self":
+                        counts[t.attr] = counts.get(t.attr, 0) + 1
+                        if isinstance(node.value, ast.Call):
+                            tn = dotted_name(node.value.func)
+                            if tn:
+                                types[t.attr] = tn
+            for attr, tn in types.items():
+                if counts.get(attr) == 1:
+                    self.attr_types[(cname, attr)] = tn
+            for attr, tn in annotated.items():
+                self.attr_types[(cname, attr)] = tn
+
+    def enclosing_class_name(self, node: ast.AST) -> str | None:
+        cur = self.index.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.index.parents.get(cur)
+        return None
+
+
+def _annotation_type(ann: ast.AST) -> str | None:
+    """Dotted type name out of an annotation: ``T``, ``"T"``,
+    ``T | None``, ``Optional[T]`` — anything richer resolves to None."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        head = ann.value.split("|")[0].strip()
+        return head or None
+    if isinstance(ann, (ast.Name, ast.Attribute)):
+        return dotted_name(ann)
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        for side in (ann.left, ann.right):
+            got = _annotation_type(side)
+            if got and got != "None":
+                return got
+        return None
+    if isinstance(ann, ast.Subscript):
+        base = _annotation_type(ann.value)
+        if base and base.split(".")[-1] == "Optional":
+            return _annotation_type(ann.slice)
+    return None
+
+
+def _single_assign_exprs(assigns: list[ast.Assign]) -> dict[str, ast.expr]:
+    counts: dict[str, int] = {}
+    values: dict[str, ast.expr] = {}
+    for node in assigns:
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                counts[t.id] = counts.get(t.id, 0) + 1
+                values[t.id] = node.value
+    return {k: v for k, v in values.items() if counts.get(k) == 1}
+
+
+def _fn_local_assigns(fn: ast.AST) -> dict[str, list[ast.expr]]:
+    """name -> value exprs assigned in ``fn``'s own scope (nested defs
+    excluded — their locals must never resolve this scope's names)."""
+    out: dict[str, list[ast.expr]] = {}
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(node.value)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _param_default_expr(fn, name: str) -> ast.expr | None:
+    args = fn.args
+    pos = [*args.posonlyargs, *args.args]
+    for arg, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if arg.arg == name:
+            return default
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if arg.arg == name and default is not None:
+            return default
+    return None
+
+
+class ProjectGraph:
+    """The one interprocedural structure every pass shares.
+
+    Built in :func:`engine.run` after file loading; holds per-module
+    resolution state, forward edges (``entry(key).calls`` with resolved
+    targets), and reverse edges (:meth:`callers`).
+    """
+
+    def __init__(self, project):
+        self.project = project
+        self.modules: dict[str, _Module] = {}
+        self.entries: dict[Key, FunctionEntry] = {}
+        self.rev: dict[Key, list[tuple[Key, CallSite]]] = {}
+        #: rel path set, for import resolution
+        self._rels = {ctx.rel for ctx in project.contexts}
+        #: per-def local-assignment tables, computed once (the resolver
+        #: consults them once per call SITE — uncached this was the
+        #: single hottest spot in the whole engine)
+        self._locals_memo: dict[ast.AST, dict[str, list[ast.expr]]] = {}
+
+        for ctx in project.contexts:
+            self.modules[ctx.rel] = _Module(ctx)
+        for ctx in project.contexts:
+            self._build_module(ctx)
+        for key, entry in self.entries.items():
+            for cs in entry.calls:
+                if cs.target is not None:
+                    self.rev.setdefault(cs.target, []).append((key, cs))
+
+    # ------------------------------------------------------------ build
+    def _build_module(self, ctx) -> None:
+        mod = self.modules[ctx.rel]
+        body_key = (ctx.rel, MODULE_BODY)
+        self.entries[body_key] = FunctionEntry(key=body_key, node=None)
+        for qn, fn in mod.index.functions.items():
+            self.entries[(ctx.rel, qn)] = FunctionEntry(
+                key=(ctx.rel, qn), node=fn
+            )
+        for call in mod.index.nodes(ast.Call):
+            qn = mod.index.enclosing_function_qualname(call)
+            key = (ctx.rel, qn if qn is not None else MODULE_BODY)
+            cs = CallSite(node=call, raw=dotted_name(call.func))
+            cs.target = self._resolve_call(mod, key, call)
+            self.entries[key].calls.append(cs)
+
+    # ---------------------------------------------------------- resolve
+    def _locals(self, fn_node: ast.AST) -> dict[str, list[ast.expr]]:
+        got = self._locals_memo.get(fn_node)
+        if got is None:
+            got = self._locals_memo[fn_node] = _fn_local_assigns(fn_node)
+        return got
+
+    def _resolve_call(self, mod: _Module, caller: Key, call: ast.Call
+                      ) -> Key | None:
+        return self._resolve_callable(mod, caller, call.func, depth=0)
+
+    def _resolve_callable(self, mod: _Module, caller: Key,
+                          func: ast.expr, depth: int) -> Key | None:
+        if depth > 4:
+            return None
+        rel = caller[0]
+        if isinstance(func, ast.Name):
+            return self._resolve_name(mod, caller, func.id, depth)
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            base = func.value
+            # self.m() — enclosing class's method
+            if isinstance(base, ast.Name) and base.id == "self":
+                cname = mod.enclosing_class_name(func)
+                if cname and f"{cname}.{meth}" in mod.index.functions:
+                    return (rel, f"{cname}.{meth}")
+                return None
+            # self.attr.m() — one-assignment attribute type
+            if isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name
+            ) and base.value.id == "self":
+                cname = mod.enclosing_class_name(func)
+                tn = mod.attr_types.get((cname or "", base.attr))
+                if tn:
+                    return self._resolve_method_of(mod, tn, meth)
+                return None
+            if isinstance(base, ast.Name):
+                # var.m() — function-local one-assignment instance
+                fn_node = self.entries[caller].node
+                if fn_node is not None:
+                    assigns = self._locals(fn_node)
+                    vals = assigns.get(base.id)
+                    if vals is not None and len(vals) == 1 and isinstance(
+                        vals[0], ast.Call
+                    ):
+                        tn = dotted_name(vals[0].func)
+                        if tn:
+                            got = self._resolve_method_of(mod, tn, meth)
+                            if got is not None:
+                                return got
+                # mod_alias.f() — imported module
+                imp = mod.index.imports.get(base.id)
+                if imp is not None:
+                    target_rel = self._module_rel(
+                        mod.ctx.rel, imp[0] if not imp[1] else (
+                            f"{imp[0]}.{imp[1]}" if imp[0] else imp[1]
+                        ), imp[2],
+                    )
+                    if target_rel is not None:
+                        return self._lookup(target_rel, meth)
+                # ClassName.m() — class in this module
+                if base.id in mod.index.classes:
+                    if f"{base.id}.{meth}" in mod.index.functions:
+                        return (rel, f"{base.id}.{meth}")
+            return None
+        return None
+
+    def _resolve_name(self, mod: _Module, caller: Key, name: str,
+                      depth: int) -> Key | None:
+        rel = caller[0]
+        fn_node = self.entries[caller].node
+        if fn_node is not None:
+            assigns = self._locals(fn_node)
+            vals = assigns.get(name)
+            if vals is not None:
+                if len(vals) == 1:
+                    return self._resolve_callable(
+                        mod, caller, vals[0], depth + 1
+                    )
+                return None  # rebound: ambiguous
+            default = _param_default_expr(fn_node, name)
+            if default is not None:
+                # parameter-default indirection: resolve the default at
+                # MODULE scope (the body key), not through the params
+                return self._resolve_callable(
+                    mod, (rel, MODULE_BODY), default, depth + 1
+                )
+            if _is_param(fn_node, name):
+                return None  # a genuinely dynamic callable argument
+        if name in mod.index.functions:
+            return (rel, name)
+        if name in mod.index.classes:
+            ctor = f"{name}.__init__"
+            return (rel, ctor) if ctor in mod.index.functions else None
+        if name in mod.aliases:
+            return self._resolve_callable(
+                mod, (rel, MODULE_BODY), mod.aliases[name], depth + 1
+            )
+        imp = mod.index.imports.get(name)
+        if imp is not None:
+            module, original, level = imp
+            if original:
+                target_rel = self._module_rel(rel, module, level)
+                if target_rel is not None:
+                    got = self._lookup(target_rel, original)
+                    if got is not None:
+                        return got
+                # ``from .pkg import submodule`` shape
+                sub = f"{module}.{original}" if module else original
+                sub_rel = self._module_rel(rel, sub, level)
+                if sub_rel is not None:
+                    return None  # a module object is not callable
+        return None
+
+    def _resolve_method_of(self, mod: _Module, type_name: str,
+                           meth: str) -> Key | None:
+        """``TypeName.meth`` where TypeName is a class here or imported."""
+        tail = type_name.split(".")[-1]
+        if tail in mod.index.classes:
+            qn = f"{tail}.{meth}"
+            if qn in mod.index.functions:
+                return (mod.ctx.rel, qn)
+            return None
+        imp = mod.index.imports.get(type_name.split(".")[0])
+        if imp is not None:
+            module, original, level = imp
+            name = original or type_name.split(".")[0]
+            target_rel = self._module_rel(mod.ctx.rel, module, level)
+            if target_rel is not None:
+                got = self._lookup(target_rel, f"{name}.{meth}")
+                if got is not None:
+                    return got
+        return None
+
+    def _module_rel(self, rel: str, module: str, level: int) -> str | None:
+        """Repo-relative path of an imported module, or None when it is
+        outside the scan set (stdlib, jax, …)."""
+        if level > 0:
+            base = os.path.dirname(rel)
+            for _ in range(level - 1):
+                base = os.path.dirname(base)
+            parts = [p for p in module.split(".") if p]
+        else:
+            parts = module.split(".")
+            base = ""
+        cand = os.path.join(base, *parts) + ".py" if parts else None
+        if cand is None:
+            return None
+        cand = cand.replace(os.sep, "/")
+        if cand in self._rels:
+            return cand
+        init = os.path.join(base, *parts, "__init__.py").replace(os.sep, "/")
+        return init if init in self._rels else None
+
+    def _lookup(self, rel: str, qualname: str) -> Key | None:
+        mod = self.modules.get(rel)
+        if mod is None:
+            return None
+        if qualname in mod.index.functions:
+            return (rel, qualname)
+        if qualname in mod.index.classes:
+            ctor = f"{qualname}.__init__"
+            if ctor in mod.index.functions:
+                return (rel, ctor)
+        return None
+
+    # ------------------------------------------------------------ walks
+    def entry(self, key: Key) -> FunctionEntry | None:
+        return self.entries.get(key)
+
+    def callees(self, key: Key) -> list[CallSite]:
+        entry = self.entries.get(key)
+        return entry.calls if entry is not None else []
+
+    def callers(self, key: Key) -> list[tuple[Key, CallSite]]:
+        return self.rev.get(key, [])
+
+    def reachable(self, key: Key, same_module: bool = False
+                  ) -> set[Key]:
+        """All transitively-called resolved keys (visited-set BFS — a
+        recursive helper terminates instead of looping).  With
+        ``same_module=True`` edges never leave ``key``'s module (the
+        lock-order contract: one module's locks, one module's graph)."""
+        seen: set[Key] = set()
+        frontier = [key]
+        while frontier:
+            cur = frontier.pop()
+            for cs in self.callees(cur):
+                t = cs.target
+                if t is None or t in seen:
+                    continue
+                if same_module and t[0] != key[0]:
+                    continue
+                seen.add(t)
+                frontier.append(t)
+        return seen
+
+    def keys_in(self, rel: str):
+        mod = self.modules.get(rel)
+        if mod is None:
+            return
+        yield (rel, MODULE_BODY)
+        for qn in mod.index.functions:
+            yield (rel, qn)
+
+
+def _is_param(fn, name: str) -> bool:
+    args = fn.args
+    all_args = [*args.posonlyargs, *args.args, *args.kwonlyargs]
+    if args.vararg:
+        all_args.append(args.vararg)
+    if args.kwarg:
+        all_args.append(args.kwarg)
+    return any(a.arg == name for a in all_args)

@@ -5,11 +5,19 @@
     python tools/lint.py                  # full scan, text output
     python tools/lint.py --json           # machine-readable (schema pinned
                                           #   by tests/test_lint.py)
+    python tools/lint.py --format=github  # GitHub Actions ::error
+                                          #   annotations (CI mode)
     python tools/lint.py --changed-only   # only files in `git diff` vs
                                           #   --base (default HEAD) —
                                           #   the pre-commit mode
     python tools/lint.py --write-baseline # grandfather current findings
     python tools/lint.py path.py …        # explicit files (fixtures)
+
+Pre-commit hook: ``ln -sf ../../tools/pre-commit .git/hooks/pre-commit``
+(the shipped ``tools/pre-commit`` wraps ``--changed-only --base HEAD``;
+program-completeness rules — rename-without-dirsync, journal-mutation-
+unfaulted, the obs completeness set, lock-order cycles — auto-disable
+on such partial scans, the obs_coverage contract).
 
 Exit codes: 0 clean (after suppressions + baseline), 1 active findings,
 2 engine/usage error.  Never imports jax; full-package runtime is gated
@@ -69,7 +77,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("paths", nargs="*", help="explicit files/dirs "
                     "(default: package + bench.py + examples)")
-    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="shorthand for --format=json")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default=None, dest="fmt",
+                    help="output format: text (default), json (pinned "
+                    "schema), github (::error workflow annotations — "
+                    "one per active finding, schema pinned by test)")
     ap.add_argument("--changed-only", action="store_true",
                     help="lint only files changed vs --base (git diff)")
     ap.add_argument("--base", default="HEAD",
@@ -121,8 +135,23 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 0
 
-    if args.as_json:
+    fmt = args.fmt or ("json" if args.as_json else "text")
+    if fmt == "json":
         print(json.dumps(report.to_json(), indent=2))
+    elif fmt == "github":
+        # GitHub Actions workflow commands: one ::error per active
+        # finding, newlines %0A-escaped per the runner's contract
+        for f in report.active:
+            msg = f.message.replace("%", "%25").replace("\r", "%0D") \
+                .replace("\n", "%0A")
+            print(
+                f"::error file={f.path},line={f.line},col={f.col},"
+                f"title=lint/{f.rule}::{msg}"
+            )
+        print(
+            f"lint: {len(report.active)} active finding(s) — "
+            f"{report.files_scanned} files in {report.runtime_s:.2f}s"
+        )
     else:
         for f in report.active:
             sym = f"  [{f.symbol}]" if f.symbol else ""

@@ -1,0 +1,356 @@
+"""Intraprocedural reaching-defs + summary-based cross-call taint
+(ISSUE 15 tentpole, the durability half).
+
+Still pure ``ast`` — no jax/numpy import, wall-time pinned by the
+tier-1 meta-test.  Two layers:
+
+* **local**: per function, a small fixpoint over its own assignments
+  decides which names hold *durable-path* strings (tainted).  Sources
+  are (a) string literals carrying a durable component
+  (:data:`DURABLE_LITERALS` — ``_views``, ``COMMIT``, ``offsets.log``,
+  ``step-``, ``part-``, ``.tmp``…) and (b) identifiers whose tokens name
+  durable state (:data:`DURABLE_NAME_TOKENS` — ``wal``, ``ckpt``,
+  ``quarantine``…).  Taint propagates through f-strings, ``+`` concat,
+  ``%``/``.format``, ``os.path.join`` and subscripts.
+
+* **cross-call summaries**: a project fixpoint over the
+  :class:`~.callgraph.ProjectGraph` propagates taint into callee
+  *parameters* (``self._write(part)`` taints ``path`` inside
+  ``_write``), out of *return values* (``self._part_path(i)`` returns a
+  tainted string), and into once-assigned instance attributes
+  (``self._wal = os.path.join(…, "offsets.log")``).  This is what lets
+  the durability pass see a protocol spread across helper functions —
+  the exact shape the PR 12 review rounds kept catching by hand.
+
+:func:`reaches` / :func:`rfind_call` are the shared reachability
+helpers (visited-set BFS; recursion cannot loop) the interprocedural
+rules build on.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .astutils import dotted_name
+from .callgraph import Key, MODULE_BODY, ProjectGraph
+
+#: string components that mark a path as durable state (the repo's own
+#: protocol vocabulary: checkpoint steps, WAL logs, view snapshots,
+#: artifact staging, quarantine evidence, part/delta files)
+DURABLE_LITERALS = (
+    "_views", "COMMIT", "offsets.log", "commits.log", "attempts.log",
+    ".wal", "quarantine", "step-", "part-", "delta-", ".staging",
+    ".incomplete", ".old", ".tmp",
+)
+
+#: identifier tokens (underscore-split, lowercased) that mark a
+#: variable/attribute/parameter as holding a durable path
+DURABLE_NAME_TOKENS = {
+    "wal", "ckpt", "checkpoint", "quarantine", "artifact", "staging",
+    "journal", "durable",
+}
+#: exact identifier names (compound forms token-split would miss)
+DURABLE_NAMES = {"commit_log", "state_path", "part_path", "offsets",
+                 "commits", "spool"}
+
+_TOKEN_SPLIT = re.compile(r"[_\W]+")
+
+
+def name_is_durable(name: str) -> bool:
+    low = name.lower().lstrip("_")
+    if low in DURABLE_NAMES:
+        return True
+    return any(t in DURABLE_NAME_TOKENS for t in _TOKEN_SPLIT.split(low))
+
+
+def literal_is_durable(text: str) -> bool:
+    return any(m in text for m in DURABLE_LITERALS)
+
+
+def local_assigns(fn: ast.AST) -> dict[str, list[ast.expr]]:
+    """Reaching-defs, collapsed: name → every value expression assigned
+    to it in ``fn``'s own scope (nested defs excluded).  The passes use
+    a flow-insensitive join — any def reaches — which over-approximates
+    taint and under-approximates nothing the rules rely on."""
+    out: dict[str, list[ast.expr]] = {}
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            out.setdefault(node.target.id, []).append(node.value)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+_JOIN_FNS = {"join", "fspath", "abspath", "realpath", "normpath",
+             "dirname", "expanduser", "str"}
+
+
+class DurableTaint:
+    """Project-wide durable-path taint, computed once per run on first
+    use (the durability pass builds it lazily; partial scans pay only
+    for the files they load)."""
+
+    def __init__(self, graph: ProjectGraph):
+        self.graph = graph
+        #: per-function assignment tables, computed once per build
+        self._assigns: dict[Key, dict[str, list[ast.expr]]] = {}
+        self._returns_memo: dict[Key, list[ast.expr]] = {}
+        self._attr_assign_memo: dict[Key, list] = {}
+        self._callsite_memo: dict[Key, dict[int, object]] = {}
+        #: per-function extra tainted local names (beyond name markers)
+        self.locals: dict[Key, set[str]] = {}
+        #: per-function tainted parameter names (from call-site args)
+        self.params: dict[Key, set[str]] = {}
+        #: (rel, class name) -> tainted instance-attribute names
+        self.attrs: dict[tuple[str, str], set[str]] = {}
+        #: functions whose return value is tainted
+        self.returns: set[Key] = set()
+        self._build()
+
+    # ----------------------------------------------------------- build
+    def _build(self) -> None:
+        keys = [
+            k for rel in self.graph.modules for k in self.graph.keys_in(rel)
+            if k[1] != MODULE_BODY
+        ]
+        for _round in range(6):          # project fixpoint, small bound
+            changed = False
+            for key in keys:
+                changed |= self._update_function(key)
+            if not changed:
+                break
+
+    def _update_function(self, key: Key) -> bool:
+        entry = self.graph.entry(key)
+        if entry is None or entry.node is None:
+            return False
+        rel, qn = key
+        fn = entry.node
+        changed = False
+
+        # local fixpoint over this function's assignments
+        tainted = self.locals.setdefault(key, set())
+        assigns = self._assigns.get(key)
+        if assigns is None:
+            assigns = self._assigns[key] = local_assigns(fn)
+        before = len(tainted)
+        for _ in range(6):
+            grew = False
+            for name, values in assigns.items():
+                if name in tainted:
+                    continue
+                if any(self.expr_tainted(key, v) for v in values):
+                    tainted.add(name)
+                    grew = True
+            if not grew:
+                break
+        if len(tainted) != before:
+            changed = True
+        if self._update_attrs(key, fn):
+            changed = True
+
+        # return-value taint
+        if key not in self.returns:
+            rets = self._returns_memo.get(key)
+            if rets is None:
+                rets = self._returns_memo[key] = [
+                    n.value for n in ast.walk(fn)
+                    if isinstance(n, ast.Return) and n.value is not None
+                ]
+            if any(self.expr_tainted(key, v) for v in rets):
+                self.returns.add(key)
+                changed = True
+
+        # call-argument → callee-parameter taint
+        for cs in entry.calls:
+            t = cs.target
+            if t is None:
+                continue
+            callee = self.graph.entry(t)
+            if callee is None or callee.node is None:
+                continue
+            params = _param_names(callee.node)
+            # the self/cls slot is consumed by binding only for bound
+            # method calls (``obj.m(a)`` → a lands on params[1]) and
+            # constructor calls resolved to __init__
+            is_method = bool(params) and params[0] in ("self", "cls")
+            bound = is_method and (
+                isinstance(cs.node.func, ast.Attribute)
+                or t[1].endswith(".__init__")
+            )
+            offset = 1 if bound else 0
+            for i, arg in enumerate(cs.node.args):
+                pi = i + offset
+                if pi < len(params) and self.expr_tainted(key, arg):
+                    if params[pi] not in self.params.setdefault(t, set()):
+                        self.params[t].add(params[pi])
+                        changed = True
+            for kw in cs.node.keywords:
+                if kw.arg and kw.arg in params and self.expr_tainted(
+                    key, kw.value
+                ):
+                    if kw.arg not in self.params.setdefault(t, set()):
+                        self.params[t].add(kw.arg)
+                        changed = True
+        return changed
+
+    def _update_attrs(self, key: Key, fn: ast.AST) -> bool:
+        """``self.X = <tainted>`` contributes X to the class's taint set."""
+        rel, qn = key
+        if "." not in qn:
+            return False
+        cname = qn.rsplit(".", 1)[0]
+        changed = False
+        pairs = self._attr_assign_memo.get(key)
+        if pairs is None:
+            pairs = self._attr_assign_memo[key] = [
+                (t.attr, node.value)
+                for node in ast.walk(fn) if isinstance(node, ast.Assign)
+                for t in node.targets
+                if isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"
+            ]
+        for attr, value in pairs:
+            if self.expr_tainted(key, value):
+                attrs = self.attrs.setdefault((rel, cname), set())
+                if attr not in attrs:
+                    attrs.add(attr)
+                    changed = True
+        return changed
+
+    # ----------------------------------------------------------- query
+    def expr_tainted(self, key: Key, expr: ast.AST) -> bool:
+        """Whether ``expr`` (in function ``key``) holds a durable path."""
+        rel, qn = key
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, str) and literal_is_durable(
+                expr.value
+            )
+        if isinstance(expr, ast.JoinedStr):
+            return any(
+                (isinstance(p, ast.Constant) and literal_is_durable(
+                    str(p.value)))
+                or (isinstance(p, ast.FormattedValue)
+                    and self.expr_tainted(key, p.value))
+                for p in expr.values
+            )
+        if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.Add, ast.Mod)
+        ):
+            return self.expr_tainted(key, expr.left) or self.expr_tainted(
+                key, expr.right
+            )
+        if isinstance(expr, ast.Name):
+            if name_is_durable(expr.id):
+                return True
+            if expr.id in self.locals.get(key, ()):
+                return True
+            if expr.id in self.params.get(key, ()):
+                return True
+            mod = self.graph.modules.get(rel)
+            if mod is not None:
+                got, _ = mod.ctx.resolver.resolve(expr)
+                if got is not None and literal_is_durable(got):
+                    return True
+            return False
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                if name_is_durable(expr.attr):
+                    return True
+                cname = qn.rsplit(".", 1)[0] if "." in qn else ""
+                return expr.attr in self.attrs.get((rel, cname), ())
+            return name_is_durable(expr.attr)
+        if isinstance(expr, ast.Subscript):
+            return self.expr_tainted(key, expr.value)
+        if isinstance(expr, ast.Call):
+            fname = (dotted_name(expr.func) or "").split(".")[-1]
+            if fname in _JOIN_FNS:
+                return any(self.expr_tainted(key, a) for a in expr.args)
+            if fname == "format" and isinstance(expr.func, ast.Attribute):
+                return self.expr_tainted(key, expr.func.value) or any(
+                    self.expr_tainted(key, a) for a in expr.args
+                )
+            # a resolved call to a function whose return is tainted —
+            # O(1) per-entry node→site map (the linear scan over every
+            # call site sat inside the doubly-nested fixpoint)
+            cs = self._callsite(key, expr)
+            return cs is not None and cs.target in self.returns
+        return False
+
+    def _callsite(self, key: Key, node: ast.Call):
+        m = self._callsite_memo.get(key)
+        if m is None:
+            m = self._callsite_memo[key] = {
+                id(cs.node): cs for cs in self.graph.callees(key)
+            }
+        return m.get(id(node))
+
+
+def _param_names(fn) -> list[str]:
+    args = fn.args
+    return [a.arg for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]]
+
+
+# ---------------------------------------------------------------- walks
+def reaches(graph: ProjectGraph, start: Key, pred,
+            same_module: bool = False, include_start: bool = True) -> bool:
+    """True when ``pred(key)`` holds for ``start`` or any transitively
+    called function (visited-set BFS, cross-module edges unless
+    ``same_module``)."""
+    if include_start and pred(start):
+        return True
+    seen = {start}
+    frontier = [start]
+    while frontier:
+        cur = frontier.pop()
+        for cs in graph.callees(cur):
+            t = cs.target
+            if t is None or t in seen:
+                continue
+            if same_module and t[0] != start[0]:
+                continue
+            seen.add(t)
+            if pred(t):
+                return True
+            frontier.append(t)
+    return False
+
+
+def call_matches(graph: ProjectGraph, key: Key, name_pred) -> bool:
+    """Whether function ``key`` directly contains a call whose raw
+    dotted tail (or resolved target qualname tail) satisfies
+    ``name_pred``."""
+    for cs in graph.callees(key):
+        tail = (cs.raw or "").split(".")[-1]
+        if tail and name_pred(tail):
+            return True
+        if cs.target is not None and name_pred(cs.target[1].split(".")[-1]):
+            return True
+    return False
+
+
+def ancestors(graph: ProjectGraph, start: Key, max_depth: int = 8):
+    """``start`` plus every transitive caller (visited-set BFS, depth
+    bounded) — the crash_protocol pass asks whether any of these fires
+    a covered fault site."""
+    seen = {start}
+    frontier = [(start, 0)]
+    while frontier:
+        cur, d = frontier.pop()
+        yield cur
+        if d >= max_depth:
+            continue
+        for caller, _cs in graph.callers(cur):
+            if caller not in seen:
+                seen.add(caller)
+                frontier.append((caller, d + 1))

@@ -40,9 +40,9 @@ import time
 import tokenize
 from dataclasses import dataclass, field
 
-from .astutils import build_parents, ConstStrResolver
+from .astutils import ConstStrResolver, ModuleIndex
 
-ENGINE_VERSION = 1
+ENGINE_VERSION = 2
 
 ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 PKG_NAME = "clustermachinelearningforhospitalnetworks_apache_spark_tpu"
@@ -81,6 +81,10 @@ class FileContext:
     parents: dict
     resolver: ConstStrResolver
     lines: list[str]
+    #: the shared one-walk module table (defs, classes, imports,
+    #: by-type node buckets) — passes and the call-graph build read
+    #: this instead of re-walking the tree (ISSUE 15)
+    index: ModuleIndex = None
     #: line → set of disabled rule names ("*" = all)
     suppressions: dict[int, set[str]] = field(default_factory=dict)
     #: findings raised by suppression parsing itself
@@ -88,6 +92,10 @@ class FileContext:
 
     def line_text(self, line: int) -> str:
         return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    def nodes(self, *types) -> list:
+        """All nodes of the given AST classes from the shared index."""
+        return self.index.nodes(*types)
 
     def symbol_at(self, node: ast.AST) -> str:
         parts = []
@@ -145,6 +153,11 @@ class Project:
     complete: bool = True
     #: scratch area passes use to accumulate cross-file state
     state: dict = field(default_factory=dict)
+    #: the interprocedural layer (ISSUE 15): one ProjectGraph built per
+    #: run from the shared module indexes, used by every pass that
+    #: resolves calls (durability, crash_protocol, the deep concurrency
+    #: and jit upgrades) — None until run() builds it
+    graph: object = None
 
     def context(self, rel: str) -> FileContext | None:
         for ctx in self.contexts:
@@ -195,11 +208,11 @@ def load_file(path: str, root: str = ROOT) -> FileContext | Finding:
             rule="syntax-error", path=rel, line=e.lineno or 1,
             col=e.offset or 0, message=f"file does not parse: {e.msg}",
         )
-    parents = build_parents(tree)
+    index = ModuleIndex(tree)   # THE one walk per file
     ctx = FileContext(
-        path=path, rel=rel, source=source, tree=tree, parents=parents,
-        resolver=ConstStrResolver(tree, parents),
-        lines=source.splitlines(),
+        path=path, rel=rel, source=source, tree=tree, parents=index.parents,
+        resolver=ConstStrResolver(tree, index.parents),
+        lines=source.splitlines(), index=index,
     )
     ctx.suppressions, ctx.suppression_problems = _parse_suppressions(
         source, path, rel
@@ -329,6 +342,10 @@ def run(
         contexts.append(got)
 
     project = Project(root=root, contexts=contexts, complete=complete)
+    # the call graph is built ONCE per run off the shared module indexes
+    # (no extra parse) and shared by every pass
+    from .callgraph import ProjectGraph
+    project.graph = ProjectGraph(project)
 
     suppressed = 0
     for ctx in contexts:

@@ -7,7 +7,9 @@ the steps)."""
 from __future__ import annotations
 
 from .concurrency import ConcurrencyPass
+from .crash_protocol import CrashProtocolPass
 from .determinism import DeterminismPass
+from .durability import DurabilityPass
 from .jit_hygiene import JitHygienePass
 from .metric_labels import MetricLabelsPass
 from .obs_coverage import ObsCoveragePass
@@ -22,6 +24,8 @@ def all_passes():
         DeterminismPass(),
         MetricLabelsPass(),
         ObsCoveragePass(),
+        DurabilityPass(),
+        CrashProtocolPass(),
     ]
 
 

@@ -17,8 +17,19 @@ Incident lineage:
   staged under the lock and performed after release.
 * ``lock-order-cycle`` — same incident, generalized: the breaker→
   registry and registry→breaker acquisition orders formed a cycle.
-  This rule builds the lexical lock-acquisition graph (one hop through
-  same-class methods) and flags any cycle.
+  This rule builds the lexical lock-acquisition graph and flags any
+  cycle.
+
+ISSUE 15 makes both ``blocking-under-lock`` and the lock-order graph
+**interprocedural** (``deep=True``, the default): a call under a held
+lock is resolved through the module call graph
+(:class:`~..callgraph.ProjectGraph` — ``self.``/alias/one-assignment
+indirection), so a helper that fsyncs three frames down is the same
+finding as an inline fsync, and lock acquisitions anywhere in the
+same-module transitive callee set become order-graph edges instead of
+only one ``self.method()`` hop.  ``deep=False`` reproduces the PR 11
+one-hop behavior — the regression tests use it to prove the old engine
+misses the cross-function fixtures.
 """
 
 from __future__ import annotations
@@ -175,22 +186,59 @@ def _with_lock_exprs(node: ast.With, locks, module_locks):
     return out
 
 
+def _own_withs(fn: ast.AST):
+    """``With`` statements in ``fn``'s own scope — nested defs excluded
+    (their bodies run at *their* call time, not under this lock)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.With):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _deep_blocker(graph, target, callee_set) -> tuple[str, str] | None:
+    """First (helper qualname, blocking call name) found in ``target``'s
+    same-module transitive callee set, or None — the witness the deep
+    ``blocking-under-lock`` finding names."""
+    for k in sorted(callee_set):
+        for cs in graph.callees(k):
+            raw = cs.raw or ""
+            tail = raw.split(".")[-1]
+            if raw in BLOCKING_CALLS or (
+                isinstance(cs.node.func, ast.Attribute)
+                and tail in BLOCKING_METHOD_TAILS
+            ):
+                return (f"{k[1] or '<module>'}", raw or tail)
+    return None
+
+
 class ConcurrencyPass(Pass):
     name = "concurrency"
     rules = ("lock-iter-snapshot", "blocking-under-lock", "lock-order-cycle")
 
+    def __init__(self, deep: bool = True):
+        #: interprocedural mode — False reverts to the PR 11 one-hop /
+        #: lexical-only engine (kept for the provably-misses tests)
+        self.deep = deep
+
     def check_file(self, ctx, project):
         module_locks = {
             t.id
-            for node in ast.walk(ctx.tree) if isinstance(node, ast.Assign)
-            and _is_lock_ctor(node.value)
+            for node in ctx.nodes(ast.Assign)
+            if _is_lock_ctor(node.value)
             for t in node.targets if isinstance(t, ast.Name)
         }
         edges = project.state.setdefault("lock_edges", {})
 
-        for cls in [n for n in ast.walk(ctx.tree)
-                    if isinstance(n, ast.ClassDef)]:
-            info = _classify(cls)
+        classes = [n for n in ctx.nodes(ast.ClassDef)]
+        infos = {cls.name: _classify(cls) for cls in classes}
+
+        for cls in classes:
+            info = infos[cls.name]
             # methods that acquire a lock, for the one-hop order graph
             method_locks: dict[str, set[str]] = {}
             for m in cls.body:
@@ -215,6 +263,12 @@ class ConcurrencyPass(Pass):
 
         # module-level lock nesting (no class context)
         yield from self._module_level_edges(ctx, module_locks, edges)
+
+        # interprocedural half (ISSUE 15): resolve calls under held locks
+        # through the project call graph
+        if self.deep and project.graph is not None:
+            yield from self._deep_check(ctx, project, infos, module_locks,
+                                        edges)
 
     # ------------------------------------------------------ iteration
     def _iter_exprs(self, fn):
@@ -355,6 +409,132 @@ class ConcurrencyPass(Pass):
                             ),
                             symbol=f"{cls.name}.{fn.name}",
                         ), sub)
+
+    # ------------------------------------------------- interprocedural
+    def _fn_class_locks(self, ctx, project, fn, infos):
+        """(class name or None, that class's lock-attr set) for a def."""
+        from ..astutils import enclosing_class
+
+        cls = enclosing_class(fn, ctx.parents)
+        info = infos.get(cls.name) if cls is not None else None
+        locks = info.locks if info is not None else set()
+        return (cls.name if cls is not None else None), locks
+
+    def _all_fn_locks(self, project) -> dict:
+        """Per-function direct lock acquisitions for EVERY scanned file,
+        built once on first deep use.  Each function's locks carry its
+        OWN class identity (naming them with a shared ``?`` conflated
+        different classes' ``self._lock`` attrs into phantom cycles —
+        the PR 11 review regression, now structural).  Built project-
+        wide, not per-file: a lazily-filled table made edges into a
+        module scanned *later* silently vanish, so the reported cycle
+        set depended on file iteration order (review-round fix)."""
+        fn_locks = project.state.get("fn_locks")
+        if fn_locks is not None:
+            return fn_locks
+        fn_locks = project.state["fn_locks"] = {}
+        graph = project.graph
+        for octx in project.contexts:
+            module_locks = {
+                t.id
+                for node in octx.nodes(ast.Assign)
+                if _is_lock_ctor(node.value)
+                for t in node.targets if isinstance(t, ast.Name)
+            }
+            infos = {c.name: _classify(c) for c in octx.nodes(ast.ClassDef)}
+            for key in graph.keys_in(octx.rel):
+                entry = graph.entry(key)
+                if entry is None or entry.node is None:
+                    continue
+                fn = entry.node
+                cname, locks = self._fn_class_locks(octx, project, fn, infos)
+                held = set()
+                for w in _own_withs(fn):
+                    for ce in _with_lock_exprs(w, locks, module_locks):
+                        lid = _lock_id(ce, cname)
+                        if lid:
+                            held.add(lid)
+                if held:
+                    fn_locks[key] = held
+        return fn_locks
+
+    def _deep_check(self, ctx, project, infos, module_locks, edges):
+        """ISSUE 15: calls under a held lock resolved through the module
+        call graph.  A helper that fsyncs three frames down is the same
+        ``blocking-under-lock`` finding as an inline fsync, and lock
+        acquisitions anywhere in the same-module transitive callee set
+        become order-graph edges instead of only one ``self.method()``
+        hop.  Same-module by contract: one module's locks, one module's
+        graph (the PR 11 lock-order scoping, kept)."""
+        graph = project.graph
+        fn_locks = self._all_fn_locks(project)
+        blocks_memo = project.state.setdefault("deep_blocks_memo", {})
+        flagged: set[tuple[int, str]] = set()
+
+        for key in graph.keys_in(ctx.rel):
+            entry = graph.entry(key)
+            if entry is None or entry.node is None:
+                continue
+            fn = entry.node
+            by_node = {cs.node: cs for cs in entry.calls}
+            cname, locks = self._fn_class_locks(ctx, project, fn, infos)
+            for w in _own_withs(fn):
+                outer_ids = [
+                    lid for ce in _with_lock_exprs(w, locks, module_locks)
+                    if (lid := _lock_id(ce, cname))
+                ]
+                if not outer_ids:
+                    continue
+                for sub in ast.walk(w):
+                    # nested-def bodies resolve to their own key and are
+                    # not IN this with region at runtime — by_node drops
+                    # them by construction
+                    cs = by_node.get(sub)
+                    if cs is None or cs.target is None:
+                        continue
+                    target = cs.target
+                    callee_set = {target} | graph.reachable(
+                        target, same_module=True
+                    )
+                    for k in callee_set:
+                        for inner in fn_locks.get(k, ()):
+                            for outer in outer_ids:
+                                if inner != outer:
+                                    edges.setdefault(
+                                        (outer, inner), []
+                                    ).append((ctx.rel, sub.lineno))
+                    name = call_name(sub)
+                    tail = (name or "").split(".")[-1]
+                    if name in BLOCKING_CALLS or (
+                        isinstance(sub.func, ast.Attribute)
+                        and tail in BLOCKING_METHOD_TAILS
+                    ):
+                        continue  # the lexical walk owns direct blockers
+                    witness = blocks_memo.get(target)
+                    if witness is None and target not in blocks_memo:
+                        witness = blocks_memo[target] = _deep_blocker(
+                            graph, target, callee_set
+                        )
+                    if witness is None:
+                        continue
+                    at = (sub.lineno, witness[0])
+                    if at in flagged:
+                        continue  # nested withs re-walk the same call
+                    flagged.add(at)
+                    yield attach_node(Finding(
+                        rule="blocking-under-lock",
+                        path=ctx.rel, line=sub.lineno, col=sub.col_offset,
+                        message=(
+                            f"{tail or name}() reaches {witness[1]}() "
+                            f"(via {witness[0]}) while "
+                            f"{' / '.join(outer_ids)} is held — blocking "
+                            "IO under a lock stalls every waiter and "
+                            "invites ABBA deadlock even when the fsync "
+                            "is a helper away; stage under the lock, "
+                            "perform after release"
+                        ),
+                        symbol=key[1],
+                    ), sub)
 
     def _module_level_edges(self, ctx, module_locks, edges):
         from ..astutils import enclosing_class

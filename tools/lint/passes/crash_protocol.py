@@ -1,0 +1,213 @@
+"""Exception/fault-site hygiene pass (ISSUE 15 tentpole family 3).
+
+Two halves of one contract — chaos kills must PROPAGATE, and durable
+mutations must be KILLABLE:
+
+* ``crash-swallowed`` — ``utils/faults.py`` makes ``InjectedCrash`` a
+  ``BaseException`` precisely so ``except Exception`` cannot eat a
+  chaos kill.  A bare ``except:`` / ``except BaseException:`` /
+  ``except InjectedCrash:`` handler that neither re-raises nor hands
+  the exception object onward (returning/storing it for a later
+  re-raise — the pipelined prefetcher's capture-and-deliver shape)
+  un-kills the process: every kill-and-resume test downstream of it
+  silently tests nothing.
+* ``journal-mutation-unfaulted`` — every journaled/durable mutation in
+  the sanctioned durability modules (a WAL append, an atomic snapshot
+  write, a commit rename) must sit under a *named fault site* that
+  resolves into ``obs.trace.SITE_COVERAGE``: either the mutation's own
+  function fires one (``fit_ckpt.save.commit``), a callee does
+  (``wal.append_lines`` fires its ``site`` parameter), or some caller
+  on the path does (the microbatch driver's ``stream.after_*`` ladder).
+  A mutation no site brackets is durable state the chaos matrix can
+  never kill at — the crash-window bugs PR 12's review rounds caught by
+  hand land exactly there.  Needs the full caller graph, so it only
+  runs on complete scans.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from ..astutils import dotted_name
+from ..callgraph import MODULE_BODY
+from ..dataflow import ancestors, reaches
+from ..engine import Finding, Pass, attach_node, PKG_NAME
+from .durability import SANCTIONED, _open_mode
+
+_TRACE_REL = f"{PKG_NAME}/obs/trace.py"
+_WAL_REL = f"{PKG_NAME}/streaming/wal.py"
+
+_CRASH_NAMES = {"BaseException", "InjectedCrash"}
+_SITE_HOOK_TAILS = {"fault_point", "torn_point"}
+_WAL_APPEND_TAILS = {"append_line", "append_lines"}
+_RENAME_CALLS = {"os.replace", "os.rename"}
+
+
+def _handler_types(handler: ast.ExceptHandler) -> list[str]:
+    t = handler.type
+    if t is None:
+        return ["<bare>"]
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in exprs:
+        name = dotted_name(e)
+        if name:
+            out.append(name.split(".")[-1])
+    return out
+
+
+def _propagates(handler: ast.ExceptHandler) -> bool:
+    """A Raise anywhere in the handler, or the bound exception object
+    handed onward through a Return/Assign (capture-and-deliver)."""
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if bound is None:
+            continue
+        if isinstance(node, (ast.Return, ast.Assign)):
+            value = node.value
+            if value is not None and any(
+                isinstance(sub, ast.Name) and sub.id == bound
+                for sub in ast.walk(value)
+            ):
+                return True
+    return False
+
+
+class CrashProtocolPass(Pass):
+    name = "crash_protocol"
+    rules = ("crash-swallowed", "journal-mutation-unfaulted")
+
+    # ---------------------------------------------------------- collect
+    def check_file(self, ctx, project):
+        yield from self._check_handlers(ctx)
+        if ctx.rel in SANCTIONED and ctx.rel != _WAL_REL:
+            self._collect_mutations(ctx, project)
+
+    def _check_handlers(self, ctx):
+        for handler in ctx.nodes(ast.ExceptHandler):
+            caught = _handler_types(handler)
+            hit = [c for c in caught if c == "<bare>" or c in _CRASH_NAMES]
+            if not hit or _propagates(handler):
+                continue
+            what = "bare except" if hit == ["<bare>"] else \
+                f"except {'/'.join(n for n in caught if n in _CRASH_NAMES)}"
+            yield attach_node(Finding(
+                rule="crash-swallowed",
+                path=ctx.rel, line=handler.lineno, col=handler.col_offset,
+                message=(
+                    f"{what} swallows InjectedCrash (a BaseException ON "
+                    "PURPOSE — utils/faults.py) without re-raising or "
+                    "delivering the exception object onward; every "
+                    "kill-and-resume test through this path silently "
+                    "stops testing anything.  Catch Exception, or "
+                    "re-raise / hand the object to the thread that will"
+                ),
+                symbol=ctx.symbol_at(handler),
+            ), handler)
+
+    def _collect_mutations(self, ctx, project) -> None:
+        """Durable-mutation call sites in a sanctioned module, judged in
+        finalize once SITE_COVERAGE is loadable."""
+        from .durability import get_taint
+
+        taint = get_taint(project)
+        muts = project.state.setdefault("journal_mutations", [])
+        for call in ctx.nodes(ast.Call):
+            qn = ctx.index.enclosing_function_qualname(call)
+            key = (ctx.rel, qn if qn is not None else MODULE_BODY)
+            raw = dotted_name(call.func)
+            tail = (raw or "").split(".")[-1]
+            durable = False
+            if tail in _WAL_APPEND_TAILS:
+                durable = True
+            elif raw in _RENAME_CALLS:
+                durable = any(taint.expr_tainted(key, a) for a in call.args)
+            elif tail == "open":
+                mode = _open_mode(call)
+                durable = (
+                    mode is not None
+                    and any(c in mode for c in ("w", "a", "x"))
+                    and bool(call.args)
+                    and taint.expr_tainted(key, call.args[0])
+                )
+            if durable:
+                muts.append((key, call, ctx.rel))
+
+    # ----------------------------------------------------------- check
+    def finalize(self, project):
+        if not project.complete:
+            return
+        muts = project.state.get("journal_mutations")
+        if not muts:
+            return
+        trace_ctx = project.context(_TRACE_REL)
+        if trace_ctx is None:
+            return  # obs pass reports the missing registry
+        from ..astutils import literal_eval_assign
+
+        try:
+            coverage = dict(literal_eval_assign(
+                trace_ctx.tree, "SITE_COVERAGE"
+            ))
+        except LookupError:
+            return  # obs pass reports it
+
+        graph = project.graph
+        fires_memo: dict = {}
+
+        def covered_fire(key) -> bool:
+            got = fires_memo.get(key)
+            if got is None:
+                got = fires_memo[key] = self._fires_covered(
+                    graph, project, key, coverage
+                )
+            return got
+
+        flagged: set[tuple] = set()
+        for key, call, rel in muts:
+            if any(
+                reaches(graph, anc, covered_fire)
+                for anc in ancestors(graph, key)
+            ):
+                continue
+            at = (rel, call.lineno)
+            if at in flagged:
+                continue
+            flagged.add(at)
+            ctx = project.context(rel)
+            f = Finding(
+                rule="journal-mutation-unfaulted",
+                path=rel, line=call.lineno, col=call.col_offset,
+                message=(
+                    "durable mutation with no named fault site on any "
+                    "path to it — no fault_point() resolving into "
+                    "obs.trace.SITE_COVERAGE fires in this function, "
+                    "its callees, or any caller chain, so the chaos "
+                    "matrix can never kill at this commit point; add a "
+                    "named site (and its SITE_COVERAGE entry) bracketing "
+                    "the mutation"
+                ),
+                symbol=ctx.symbol_at(call) if ctx else "",
+            )
+            yield attach_node(f, call)
+
+    def _fires_covered(self, graph, project, key, coverage) -> bool:
+        """Does ``key`` DIRECTLY fire a fault site covered by
+        SITE_COVERAGE (site names resolved through the shared constant
+        resolver — literals, aliases, parameter defaults)?"""
+        ctx = project.context(key[0])
+        if ctx is None:
+            return False
+        for cs in graph.callees(key):
+            tail = (cs.raw or "").split(".")[-1]
+            if tail not in _SITE_HOOK_TAILS or not cs.node.args:
+                continue
+            site, _is_glob = ctx.resolver.resolve(cs.node.args[0])
+            if site is None:
+                continue
+            if any(fnmatch.fnmatchcase(site, p) for p in coverage):
+                return True
+        return False

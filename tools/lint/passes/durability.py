@@ -1,0 +1,297 @@
+"""Durability-protocol pass (ISSUE 15 tentpole family 1): the
+crash-consistency verifier for the durability ladder.
+
+The PR 12 review rounds were dominated by cross-function crash-protocol
+slips — a part-file rename whose directory was never fsync'd (the
+commit log could outlive the part bytes), staged files orphaned across
+an epoch, a snapshot memo keyed without part stats.  The repo's
+durability contract lives in a handful of *sanctioned* modules
+(:data:`SANCTIONED` — the fit-checkpoint commit protocol, the model-io
+staged swap, the WAL, the view snapshots, the quarantine/feedback
+spools); everything else must reach durable state THROUGH them.
+
+Rules (all driven by the :mod:`..dataflow` durable-path taint over the
+:mod:`..callgraph` project graph — a path stays durable through helper
+parameters, return values and once-assigned attributes):
+
+* ``raw-durable-write`` — ``open(path, "w"/"a"/…)`` (or a direct
+  ``write_table``) on a durable-tainted path outside the sanctioned
+  modules: the write skips the tmp+fsync+rename helpers, so a crash can
+  leave a torn file that the protocol modules would never produce.
+* ``raw-durable-rename`` — ``os.replace``/``os.rename``/``shutil.move``
+  on durable-tainted paths outside the sanctioned modules: an
+  unsanctioned commit point, invisible to the recovery/repair code.
+* ``rename-without-dirsync`` — inside the sanctioned modules, every
+  durable rename must be *followed by a reachable* ``fsync_dir`` (in
+  the same function after the rename, or along some caller chain after
+  the call returns — the save()/finalize() split is legal).  Without
+  it the rename is atomic against process crash but not power loss:
+  the fsync'd WAL/commit entry can survive while the rename vanishes.
+  Needs callers, so it only runs on complete scans (``--changed-only``
+  auto-disables it, the obs_coverage contract).
+* ``wal-append-bypass`` — an ``open(…, "a"/"ab")`` on a WAL-flavored
+  path outside ``streaming/wal.py``: appends must route through
+  ``wal.append_lines``'s shared descriptor (torn-tail repair + the
+  ``wal.append`` fault site live there; a second opener would race the
+  probe).  Whole-file atomic rewrites (the feedback compaction shape,
+  mode ``"w"`` + rename) are not appends and stay legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutils import dotted_name
+from ..callgraph import MODULE_BODY
+from ..dataflow import DurableTaint, call_matches, reaches
+from ..engine import Finding, Pass, attach_node, PKG_NAME
+
+#: the modules that IMPLEMENT the durability ladder — raw durable IO is
+#: legal only here (and is then held to the rename→dirsync rule)
+SANCTIONED = tuple(
+    f"{PKG_NAME}/{m}" for m in (
+        "io/fit_checkpoint.py", "io/model_io.py",
+        "streaming/wal.py", "streaming/checkpoint.py",
+        "streaming/unbounded_table.py",
+        "core/sql_views.py",
+        "lifecycle/feedback.py", "lifecycle/journal.py",
+    )
+)
+
+_WAL_REL = f"{PKG_NAME}/streaming/wal.py"
+
+_RENAME_CALLS = {"os.replace", "os.rename", "shutil.move"}
+_WRITE_MODES = ("w", "a", "x")
+
+_WAL_NAME_TOKENS = {"wal"}
+_WAL_NAMES = {"offsets", "commits", "commit_log", "attempts"}
+_WAL_LITERALS = ("offsets.log", "commits.log", "attempts.log", ".wal")
+
+_DIRSYNC_TAILS = {"fsync_dir", "_fsync_dir"}
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    """Literal mode of an ``open()`` call (default ``"r"``)."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if mode is None and (len(call.args) < 2
+                         and not any(k.arg == "mode" for k in call.keywords)):
+        return "r"
+    return mode if isinstance(mode, str) else None
+
+
+def _is_dirsync_name(tail: str) -> bool:
+    return tail in _DIRSYNC_TAILS
+
+
+def get_taint(project):
+    """The project-wide durable-path taint, built lazily once per run
+    and shared by the durability and crash_protocol passes."""
+    taint = project.state.get("durable_taint")
+    if taint is None:
+        taint = DurableTaint(project.graph)
+        project.state["durable_taint"] = taint
+    return taint
+
+
+class DurabilityPass(Pass):
+    name = "durability"
+    rules = (
+        "raw-durable-write", "raw-durable-rename",
+        "rename-without-dirsync", "wal-append-bypass",
+    )
+
+    # --------------------------------------------------------- helpers
+    def _wal_flavored(self, ctx, fn_key, expr, project, depth=0) -> bool:
+        """Narrow WAL-only taint: the append-routing rule must not fire
+        on every durable path, only log-shaped ones."""
+        if depth > 3:
+            return False
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, str) and any(
+                m in expr.value for m in _WAL_LITERALS
+            )
+        if isinstance(expr, ast.JoinedStr):
+            return any(
+                self._wal_flavored(ctx, fn_key, p.value, project, depth + 1)
+                if isinstance(p, ast.FormattedValue)
+                else (isinstance(p, ast.Constant) and any(
+                    m in str(p.value) for m in _WAL_LITERALS))
+                for p in expr.values
+            )
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return (
+                self._wal_flavored(ctx, fn_key, expr.left, project, depth + 1)
+                or self._wal_flavored(ctx, fn_key, expr.right, project,
+                                      depth + 1)
+            )
+        if isinstance(expr, ast.Call):
+            tail = "" if not isinstance(
+                expr.func, (ast.Name, ast.Attribute)
+            ) else (getattr(expr.func, "attr", None)
+                    or getattr(expr.func, "id", ""))
+            if tail == "join":
+                return any(
+                    self._wal_flavored(ctx, fn_key, a, project, depth + 1)
+                    for a in expr.args
+                )
+            return False
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            got, _ = ctx.resolver.resolve(expr)
+            if got is not None and any(m in got for m in _WAL_LITERALS):
+                return True
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        if name is None:
+            return False
+        low = name.lower().lstrip("_")
+        return low in _WAL_NAMES or any(
+            t in low.split("_") for t in _WAL_NAME_TOKENS
+        )
+
+    # ------------------------------------------------------ check_file
+    def check_file(self, ctx, project):
+        graph = project.graph
+        taint = get_taint(project)
+        sanctioned = ctx.rel in SANCTIONED
+
+        for call in ctx.nodes(ast.Call):
+            qn = ctx.index.enclosing_function_qualname(call)
+            key = (ctx.rel, qn if qn is not None else MODULE_BODY)
+            raw = dotted_name(call.func)
+            tail = (raw or "").split(".")[-1]
+
+            # ---- WAL append routing (applies everywhere but wal.py)
+            if tail == "open" and ctx.rel != _WAL_REL:
+                mode = _open_mode(call)
+                if mode is not None and "a" in mode:
+                    target = call.args[0] if call.args else None
+                    if target is not None and self._wal_flavored(
+                        ctx, key, target, project
+                    ):
+                        yield attach_node(Finding(
+                            rule="wal-append-bypass",
+                            path=ctx.rel, line=call.lineno,
+                            col=call.col_offset,
+                            message=(
+                                "direct append-mode open of a WAL path — "
+                                "appends must route through streaming/"
+                                "wal.py::append_lines (one shared "
+                                "descriptor: torn-tail repair and the "
+                                "wal.append fault site live there; a "
+                                "second opener races the probe)"
+                            ),
+                            symbol=ctx.symbol_at(call),
+                        ), call)
+                        continue
+
+            if sanctioned:
+                # ---- rename → reachable fsync_dir (complete scans)
+                if raw in _RENAME_CALLS and project.complete:
+                    if any(
+                        taint.expr_tainted(key, a) for a in call.args
+                    ) and not self._dirsync_reachable(
+                        project, graph, key, call
+                    ):
+                        yield attach_node(Finding(
+                            rule="rename-without-dirsync",
+                            path=ctx.rel, line=call.lineno,
+                            col=call.col_offset,
+                            message=(
+                                f"{raw}() commits durable state but no "
+                                "fsync_dir is reachable after it (same "
+                                "function or any caller chain) — the "
+                                "rename survives process crash but not "
+                                "power loss, so a durable WAL/commit "
+                                "entry can outlive the very bytes it "
+                                "declares committed; fsync the parent "
+                                "directory after the rename"
+                            ),
+                            symbol=ctx.symbol_at(call),
+                        ), call)
+                continue
+
+            # ---- raw durable IO outside the sanctioned modules
+            if tail == "open":
+                mode = _open_mode(call)
+                if mode is None or not any(c in mode for c in _WRITE_MODES):
+                    continue
+                target = call.args[0] if call.args else None
+                if target is not None and taint.expr_tainted(key, target):
+                    yield attach_node(Finding(
+                        rule="raw-durable-write",
+                        path=ctx.rel, line=call.lineno, col=call.col_offset,
+                        message=(
+                            "write-mode open of a durable path outside "
+                            "the sanctioned durability modules — route "
+                            "through the tmp+fsync+rename helpers "
+                            "(io/fit_checkpoint, io/model_io, "
+                            "streaming/wal, core/sql_views) so a crash "
+                            "can never leave a torn committed file"
+                        ),
+                        symbol=ctx.symbol_at(call),
+                    ), call)
+            elif raw in _RENAME_CALLS:
+                if any(taint.expr_tainted(key, a) for a in call.args):
+                    yield attach_node(Finding(
+                        rule="raw-durable-rename",
+                        path=ctx.rel, line=call.lineno, col=call.col_offset,
+                        message=(
+                            f"{raw}() on a durable path outside the "
+                            "sanctioned durability modules — an "
+                            "unsanctioned commit point the recovery/"
+                            "repair protocols cannot see; use the "
+                            "sanctioned helpers (or move the protocol "
+                            "into a sanctioned module)"
+                        ),
+                        symbol=ctx.symbol_at(call),
+                    ), call)
+
+    # ----------------------------------------------- dirsync reachability
+    def _dirsync_reachable(self, project, graph, key, rename_node,
+                           _depth: int = 0, _seen=None) -> bool:
+        """fsync_dir reachable after ``rename_node`` in ``key``, or after
+        the call to ``key`` along some caller chain (existential — the
+        prepare()/finalize() split means the sync legitimately lives in
+        a different function than the rename)."""
+        if self._dirsync_after(project, graph, key, rename_node.lineno):
+            return True
+        if _depth >= 4:
+            return False
+        seen = _seen if _seen is not None else {key}
+        for caller, cs in graph.callers(key):
+            if caller in seen:
+                continue
+            seen.add(caller)
+            if self._dirsync_reachable(
+                project, graph, caller, cs.node, _depth + 1, seen
+            ):
+                return True
+        return False
+
+    def _dirsync_after(self, project, graph, key, lineno: int) -> bool:
+        memo = project.state.setdefault("dirsync_reach_memo", {})
+        for cs in graph.callees(key):
+            if cs.node.lineno < lineno:
+                continue
+            tail = (cs.raw or "").split(".")[-1]
+            if _is_dirsync_name(tail):
+                return True
+            t = cs.target
+            if t is None:
+                continue
+            got = memo.get(t)
+            if got is None:
+                got = memo[t] = reaches(
+                    graph, t,
+                    lambda k: call_matches(graph, k, _is_dirsync_name),
+                )
+            if got:
+                return True
+        return False

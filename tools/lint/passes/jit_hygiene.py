@@ -15,6 +15,16 @@ Incident lineage:
   use-after-free on device (garbage or a crash on TPU, silently "works"
   on CPU).  Flagged when the donated positional argument is a plain
   name that is read again after the call without being rebound.
+
+ISSUE 15 makes the donation rule **interprocedural** (``deep=True``,
+the default): a *summary* fixpoint over the project call graph marks
+every function that forwards one of its parameters into a donated
+position (directly into a ``donate_argnums`` callable, or transitively
+through another forwarding helper), and a caller that reads its own
+variable after passing it to such a function is the same use-after-free
+as calling the jitted function directly — the donation crossed a call
+boundary, the invalidation did not stop at it.  ``deep=False``
+reproduces the PR 11 single-file behavior (the provably-misses tests).
 """
 
 from __future__ import annotations
@@ -74,13 +84,120 @@ def _donated_positions(call: ast.Call) -> list[int]:
     return []
 
 
+def _module_donated(tree: ast.Module) -> dict[str, list[int]]:
+    """Donated jit callables bound in a module: local name → the
+    ``donate_argnums`` positions (call-argument indices of the jitted
+    function)."""
+    donated: dict[str, list[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _is_jit_call(node.value):
+            pos = _donated_positions(node.value)
+            if pos:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donated[t.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    _is_jit_call(dec)
+                    or (call_name(dec) or "").split(".")[-1] == "partial"
+                    and dec.args and (dotted_name(dec.args[0]) or ""
+                                      ).endswith("jit")
+                ):
+                    pos = _donated_positions(dec)
+                    if pos:
+                        donated[node.name] = pos
+    return donated
+
+
+def _fn_param_names(fn) -> list[str]:
+    args = fn.args
+    return [a.arg for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]]
+
+
+def _donating_summaries(project) -> dict:
+    """Key → set of parameter indices (into the full parameter list,
+    ``self`` included) the function forwards into a donated position —
+    directly into a module-bound ``donate_argnums`` callable, or
+    transitively through another forwarding helper.  Small project
+    fixpoint over the call graph, built once per run."""
+    got = project.state.get("donating_params")
+    if got is not None:
+        return got
+    graph = project.graph
+    donating: dict = {}
+    project.state["donating_params"] = donating
+    module_donated = {
+        ctx.rel: _module_donated(ctx.tree) for ctx in project.contexts
+    }
+    for _round in range(5):
+        changed = False
+        for key, entry in graph.entries.items():
+            fn = entry.node
+            if fn is None:
+                continue
+            params = _fn_param_names(fn)
+            mine = donating.setdefault(key, set())
+            for cs in entry.calls:
+                for argpos in _donated_arg_indices(
+                    graph, cs, module_donated.get(key[0], {}), donating
+                ):
+                    if argpos >= len(cs.node.args):
+                        continue
+                    arg = cs.node.args[argpos]
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        pi = params.index(arg.id)
+                        if pi not in mine:
+                            mine.add(pi)
+                            changed = True
+        if not changed:
+            break
+    return donating
+
+
+def _donated_arg_indices(graph, cs, module_donated: dict, donating: dict
+                         ) -> list[int]:
+    """Call-argument indices of ``cs`` that land in a donated position —
+    via a module-bound donated callable (direct name call) or a resolved
+    target with a donating-parameter summary.  The ``self`` slot is
+    consumed by binding only when the callee's first parameter IS
+    self/cls (the dataflow argument-binding rule — a module-qualified
+    ``helpers.f(a, b)`` call must not shift the mapping off by one)."""
+    node = cs.node
+    if isinstance(node.func, ast.Name) and node.func.id in module_donated:
+        return list(module_donated[node.func.id])
+    t = cs.target
+    if t is None:
+        return []
+    pidx = donating.get(t)
+    if not pidx:
+        return []
+    callee = graph.entry(t)
+    if callee is None or callee.node is None:
+        return []
+    params = _fn_param_names(callee.node)
+    is_method = bool(params) and params[0] in ("self", "cls")
+    bound = 1 if is_method and (
+        isinstance(node.func, ast.Attribute) or t[1].endswith(".__init__")
+    ) else 0
+    return [pi - bound for pi in pidx if pi - bound >= 0]
+
+
 class JitHygienePass(Pass):
     name = "jit_hygiene"
     rules = ("jit-in-function", "donated-arg-reused")
 
+    def __init__(self, deep: bool = True):
+        #: interprocedural donation tracking — False reverts to the
+        #: PR 11 single-file engine (kept for the provably-misses tests)
+        self.deep = deep
+
     def check_file(self, ctx, project):
         yield from self._check_nested_jit(ctx)
         yield from self._check_donated_reuse(ctx)
+        if self.deep and project.graph is not None:
+            yield from self._check_donated_reuse_deep(ctx, project)
 
     # ------------------------------------------------- retrace-per-call
     def _check_nested_jit(self, ctx):
@@ -164,27 +281,7 @@ class JitHygienePass(Pass):
     # ------------------------------------------------- donated reuse
     def _check_donated_reuse(self, ctx):
         # donated callables bound in this module: name -> donated positions
-        donated: dict[str, list[int]] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
-                    and _is_jit_call(node.value):
-                pos = _donated_positions(node.value)
-                if pos:
-                    for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            donated[t.id] = pos
-            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if isinstance(dec, ast.Call) and (
-                        _is_jit_call(dec)
-                        or (call_name(dec) or "").split(".")[-1] == "partial"
-                        and dec.args and (dotted_name(dec.args[0]) or ""
-                                          ).endswith("jit")
-                    ):
-                        pos = _donated_positions(dec)
-                        if pos:
-                            donated[node.name] = pos
-
+        donated = _module_donated(ctx.tree)
         if not donated:
             return
 
@@ -221,6 +318,55 @@ class JitHygienePass(Pass):
                                 "donation for this argument"
                             ),
                             symbol=ctx.symbol_at(call),
+                        ), use)
+
+    def _check_donated_reuse_deep(self, ctx, project):
+        """ISSUE 15: reuse after donation ACROSS a call boundary — the
+        callee (resolved through the project graph) forwards the
+        argument into a ``donate_argnums`` position, so the caller's
+        buffer is just as invalidated as by a direct jitted call."""
+        donating = _donating_summaries(project)
+        graph = project.graph
+        local_donated = _module_donated(ctx.tree)
+        for key in graph.keys_in(ctx.rel):
+            entry = graph.entry(key)
+            if entry is None or entry.node is None:
+                continue
+            fn = entry.node
+            for cs in entry.calls:
+                call = cs.node
+                if isinstance(call.func, ast.Name) and \
+                        call.func.id in local_donated:
+                    continue  # the single-file check owns direct calls
+                if cs.target is None:
+                    continue
+                indices = _donated_arg_indices(graph, cs, {}, donating)
+                if not indices:
+                    continue
+                rebound = self._rebinds_result(ctx, call)
+                for pos in indices:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if not isinstance(arg, ast.Name) or arg.id in rebound:
+                        continue
+                    use = self._first_use_after(fn, call, arg.id)
+                    if use is not None:
+                        helper = cs.target[1] or "<module>"
+                        yield attach_node(Finding(
+                            rule="donated-arg-reused",
+                            path=ctx.rel, line=use.lineno,
+                            col=use.col_offset,
+                            message=(
+                                f"'{arg.id}' was passed to {helper}() at "
+                                f"line {call.lineno}, which forwards it "
+                                "into a donate_argnums position, and is "
+                                "read again here — donation crossed the "
+                                "call boundary but the invalidation did "
+                                "not stop at it; rebind the result or "
+                                "drop donation for this argument"
+                            ),
+                            symbol=key[1],
                         ), use)
 
     def _rebinds_result(self, ctx, call: ast.Call) -> set[str]:
