@@ -3143,6 +3143,138 @@ def _bench_serve_fleet() -> dict:
     }
 
 
+def _bench_federated() -> dict:
+    """Federated-fit config (ISSUE 16): a ≥4-silo cross-silo k-means fit
+    vs the pooled fit on the same rows.
+
+    The contract being priced: each round ships only (k, d) sufficient
+    statistics per silo — collect/merge/broadcast must be a rounding
+    error next to the silos' local device compute, or the federation
+    layer would be the bottleneck instead of the network's data
+    gravity.  Headline numbers: round wall-time decomposed into
+    local-compute / merge / fit / broadcast, the merge+broadcast
+    fraction (acceptance: < 25%), the bit-parity flag vs the pooled
+    fit, and the dropout-recovery overhead (same fit with one silo
+    failing twice per its first round, absorbed by the in-round retry
+    ladder — the recovered run must stay bit-identical and its
+    wall-time overhead is reported).
+    """
+    import jax
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.federated import (
+        FED_COLLECT_SITE,
+        FederatedConfig,
+        FederatedCoordinator,
+        Silo,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        KMeans,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import (
+        faults,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.retry import (
+        RetryPolicy,
+    )
+
+    platform, on_tpu, n, _, mesh, n_chips = _bench_setup(2_000_000)
+    n_silos = int(os.environ.get("BENCH_FED_SILOS", 4))
+    k, d = 64, 16
+    rows = (n // n_silos) if on_tpu else 100_000
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_silos * rows, d)).astype(np.float32)
+    x[: n_silos * rows // 2] += 4.0
+
+    # silo rows == pooled chunk_rows: the bit-parity configuration
+    km = KMeans(
+        k=k, max_iter=8, tol=0.0, warm_start_centers=x[:k].copy(),
+        chunk_rows=rows,
+    )
+
+    t0 = time.perf_counter()
+    pooled = km.fit(x, mesh=mesh)
+    _fence(pooled.cluster_centers)
+    pooled_s = time.perf_counter() - t0
+
+    def mk_silos():
+        return [
+            Silo(f"s{i:02d}", x[i * rows : (i + 1) * rows], mesh=mesh)
+            for i in range(n_silos)
+        ]
+
+    cfg = FederatedConfig(
+        retry=RetryPolicy(max_attempts=3, base_delay_s=0.0, max_delay_s=0.0),
+        breaker_recovery_s=0.0,
+    )
+    t0 = time.perf_counter()
+    res = FederatedCoordinator(km, mk_silos(), cfg).fit()
+    fed_s = time.perf_counter() - t0
+
+    t_collect = sum(r.t_collect for r in res.rounds)
+    t_merge = sum(r.t_merge for r in res.rounds)
+    t_fit = sum(r.t_fit for r in res.rounds)
+    t_bcast = sum(r.t_broadcast for r in res.rounds)
+    total = max(t_collect + t_merge + t_fit + t_bcast, 1e-9)
+    overhead_frac = (t_merge + t_bcast) / total
+
+    vs_pooled_bitwise = bool(
+        np.array_equal(
+            np.asarray(pooled.cluster_centers),
+            np.asarray(res.model.cluster_centers),
+        )
+        and float(pooled.training_cost) == float(res.model.training_cost)
+    )
+
+    # dropout-recovery leg: one silo fails twice in its first collect;
+    # the retry ladder absorbs it inside the round
+    plan = faults.FaultPlan().fail(
+        FED_COLLECT_SITE, times=2, when=lambda ctx: ctx.get("silo") == "s01"
+    )
+    t0 = time.perf_counter()
+    with faults.active(plan):
+        res_drop = FederatedCoordinator(km, mk_silos(), cfg).fit()
+    drop_s = time.perf_counter() - t0
+    drop_bitwise = bool(
+        np.array_equal(
+            np.asarray(res.model.cluster_centers),
+            np.asarray(res_drop.model.cluster_centers),
+        )
+    )
+
+    row = {
+        "metric": (
+            f"federated cross-silo KMeans k={k} merge+broadcast fraction "
+            f"of round wall ({n_silos} silos x {rows} rows, {platform})"
+        ),
+        "value": round(overhead_frac, 4),
+        "unit": "fraction_of_round_wall",
+        "vs_baseline": round(fed_s / max(pooled_s, 1e-9), 3),
+        "n_silos": n_silos,
+        "rows_per_silo": rows,
+        "k": k, "d": d,
+        "rounds": len(res.rounds),
+        "pooled_wall_s": round(pooled_s, 3),
+        "federated_wall_s": round(fed_s, 3),
+        "round_wall_s": {
+            "local_compute": round(t_collect, 4),
+            "merge": round(t_merge, 4),
+            "fit": round(t_fit, 4),
+            "broadcast": round(t_bcast, 4),
+        },
+        "merge_broadcast_frac": round(overhead_frac, 4),
+        "merge_broadcast_under_25pct": bool(overhead_frac < 0.25),
+        "vs_pooled_bitwise": vs_pooled_bitwise,
+        "dropout_recovery_wall_s": round(drop_s, 3),
+        "dropout_recovery_overhead": round(drop_s / max(fed_s, 1e-9) - 1.0, 4),
+        "dropout_recovered_bitwise": drop_bitwise,
+        "dropout_faults_fired": plan.fired(FED_COLLECT_SITE),
+        "platform": platform,
+        "n_chips": n_chips,
+    }
+    _sidecar_append({"kind": "federated_round_decomposition", **row})
+    return row
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -3166,6 +3298,7 @@ CONFIGS = {
     "obs_overhead": lambda: _bench_obs_overhead(),              # ISSUE 10 gate
     "model_farm": lambda: _bench_model_farm(),                  # ISSUE 11 A/B
     "serve_fleet": lambda: _bench_serve_fleet(),                # ISSUE 12 fleet
+    "federated": lambda: _bench_federated(),                    # ISSUE 16 silos
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets
@@ -3406,8 +3539,9 @@ def _child_main(name: str) -> None:
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
     "kmeans256", "pallas_ab", "kmeans_fused_ab", "model_farm", "serve_fleet",
-    "sql_device", "sql_incremental", "rf20", "gbt20", "nb", "gmm32",
-    "bisecting", "streaming", "streaming_pipeline", "kmeans8", "serve",
+    "federated", "sql_device", "sql_incremental", "rf20", "gbt20", "nb",
+    "gmm32", "bisecting", "streaming", "streaming_pipeline", "kmeans8",
+    "serve",
 ]
 
 

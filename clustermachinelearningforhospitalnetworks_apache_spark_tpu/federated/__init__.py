@@ -1,0 +1,40 @@
+"""Cross-silo federated fit over the mergeable-partials discipline.
+
+Hospitals keep their rows; the coordinator folds their device-computed
+sufficient statistics with the exact (bit-reproducible, ascending-silo-
+order, zero-initialized) reduction the estimators use internally, fits
+from the merged partials, and broadcasts the result back.  See
+``docs/ARCHITECTURE.md`` §Federated fit.
+"""
+
+from .coordinator import (
+    FED_BROADCAST_SITE,
+    FED_COLLECT_SITE,
+    FED_FIT_SITE,
+    FED_MERGE_SITE,
+    FederatedConfig,
+    FederatedCoordinator,
+    FederatedFitResult,
+    FederatedQuorumError,
+    RoundReport,
+)
+from .partials import (
+    FitState,
+    NoiseConfig,
+    Partials,
+    apply_clipped_noise,
+    family_mode,
+    merge_partials,
+    merge_profiles,
+    register_family,
+)
+from .silo import Silo
+
+__all__ = [
+    "FED_BROADCAST_SITE", "FED_COLLECT_SITE", "FED_FIT_SITE",
+    "FED_MERGE_SITE", "FederatedConfig", "FederatedCoordinator",
+    "FederatedFitResult", "FederatedQuorumError", "RoundReport",
+    "FitState", "NoiseConfig", "Partials", "apply_clipped_noise",
+    "family_mode", "merge_partials", "merge_profiles", "register_family",
+    "Silo",
+]

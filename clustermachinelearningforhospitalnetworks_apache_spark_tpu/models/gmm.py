@@ -465,6 +465,14 @@ class GaussianMixture(Estimator):
     checkpoint_dir: str | None = None
     checkpoint_every: int = 5
     weight_col: str | None = None  # Spark's weightCol (3.0+)
+    # Warm start (lifecycle + federated rounds): begin EM from these
+    # (weights (k,), means (k, d), covariances (k, d, d)) instead of the
+    # sample init — same role as KMeans.warm_start_centers.  A warm fit
+    # runs UNSHIFTED (shift = 0): the supplied means live in raw feature
+    # coordinates and re-deriving a sample shift would make the fit's
+    # arithmetic depend on the sampler, breaking the federated parity
+    # contract.  The checkpoint signature fingerprints the warm params.
+    warm_start_params: tuple | None = None
     # Matmul mode for the E-step log-pdf + moment contractions — same
     # naming as KMeans.matmul_precision.  Default "highest" keeps the
     # exact-f32, solve-form E-step (round-4 behavior, bit-comparable).
@@ -473,6 +481,35 @@ class GaussianMixture(Estimator):
     # matmul precision, so a tol much below the mode's rounding noise
     # (~1e-2 relative for "bf16") stops on noise, not EM progress.
     matmul_precision: str = "highest"
+
+    def _warm_params(self, d: int):
+        """Validated warm-start (weights, means, covs) as f32, or None."""
+        if self.warm_start_params is None:
+            return None
+        w, m, c = self.warm_start_params
+        w = np.asarray(w, np.float32)
+        m = np.asarray(m, np.float32)
+        c = np.asarray(c, np.float32)
+        if w.shape != (self.k,) or m.shape != (self.k, d) or \
+                c.shape != (self.k, d, d):
+            raise ValueError(
+                "warm_start_params must be (weights (k,), means (k, d), "
+                f"covariances (k, d, d)) for k={self.k}, d={d}; got "
+                f"{w.shape}, {m.shape}, {c.shape}"
+            )
+        return w, m, c
+
+    def _warm_fingerprint(self) -> str | None:
+        """Warm-start identity for the checkpoint signature."""
+        if self.warm_start_params is None:
+            return None
+        from ..io.fit_checkpoint import array_fingerprint
+
+        w, m, c = self.warm_start_params
+        return "|".join(
+            array_fingerprint(np.asarray(a, dtype=np.float32))
+            for a in (w, m, c)
+        )
 
     def fit(
         self, data, label_col: str | None = None, mesh=None, on_iteration=None
@@ -509,23 +546,30 @@ class GaussianMixture(Estimator):
                 "estimator": "GaussianMixture", "k": self.k, "d": d,
                 "data": data_fingerprint(x, w),
                 "n_padded": ds.n_padded, "seed": self.seed,
+                "warm": self._warm_fingerprint(),
                 "reg_covar": self.reg_covar, "tol": self.tol,
             }
             ckpt = FitCheckpointer(self.checkpoint_dir, signature)
             resumed = ckpt.resume()
 
-        # Init on a bounded host sample (only the sample leaves the
-        # device); the sample also supplies the recentering shift that
-        # keeps the f32 covariance refit stable on unstandardized data.
-        from ..parallel.sharding import sample_valid_rows
+        warm = self._warm_params(d)
+        if warm is None:
+            # Init on a bounded host sample (only the sample leaves the
+            # device); the sample also supplies the recentering shift that
+            # keeps the f32 covariance refit stable on unstandardized data.
+            from ..parallel.sharding import sample_valid_rows
 
-        valid = sample_valid_rows(
-            DeviceDataset(x, ds.y, w), self.init_sample_size, self.seed,
-            w_host=w_host,
-        )
-        shift = valid.mean(axis=0).astype(np.float32) if valid.shape[0] else np.zeros(
-            (d,), np.float32
-        )
+            valid = sample_valid_rows(
+                DeviceDataset(x, ds.y, w), self.init_sample_size, self.seed,
+                w_host=w_host,
+            )
+            shift = valid.mean(axis=0).astype(np.float32) if valid.shape[0] else np.zeros(
+                (d,), np.float32
+            )
+        else:
+            # warm fits run unshifted (see warm_start_params note)
+            valid = None
+            shift = np.zeros((d,), np.float32)
 
         start_it = 1
         prev_ll = -np.inf
@@ -537,6 +581,8 @@ class GaussianMixture(Estimator):
             weights = arrays["weights"].astype(np.float32)
             prev_ll = float(extra.get("prev_ll", -np.inf))
             start_it = step0 + 1
+        elif warm is not None:
+            weights, means, covs = warm
         else:
             # Init runs in SHIFTED coordinates, like the EM loop itself.
             means, covs, weights = _init_params(
@@ -608,6 +654,157 @@ class GaussianMixture(Estimator):
             n_iter=it,
         )
 
+    # ---------------------------------------------------- partials protocol
+    # Federated EM: silos run _make_em_stats_step (the out-of-core block
+    # kernel) on their private rows against the broadcast parameters, the
+    # coordinator's zero-init ascending fold reproduces the scan/psum
+    # summation, and _gmm_m_step + a host-f32 mirror of the while_loop's
+    # |ll − prev_ll| test replay the resident fast path bit-for-bit.
+    # Everything runs unshifted (the warm_start_params convention).
+    partials_family = "gmm"
+
+    def partials_max_rounds(self) -> int:
+        return self.max_iter
+
+    def init_partials_state(self, n_features: int, mesh=None):
+        from ..federated.partials import FitState
+
+        warm = self._warm_params(n_features)
+        if warm is None:
+            return None  # coordinator runs the candidate init round
+        weights, means, covs = warm
+        return FitState(
+            family=self.partials_family, version=0,
+            params={"weights": weights, "means": means, "covariances": covs},
+            # the device loop's convergence carry starts at +inf (the
+            # first cond compares ll₁ against it) — the host mirror must
+            # match to reproduce iteration counts
+            meta={"prev_ll": float("inf"), "ll": 0.0, "n": 0.0},
+        )
+
+    def local_init_stats(self, data, label_col: str | None = None, mesh=None):
+        """One silo's init contribution: local k-means++ candidates of its
+        sample (candidate centers cross the wire, never rows)."""
+        from ..federated.partials import Partials
+        from ..parallel.sharding import sample_valid_rows
+
+        mesh = mesh or default_mesh()
+        ds = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
+        sample = np.asarray(
+            sample_valid_rows(ds, self.init_sample_size, self.seed),
+            np.float64,
+        )
+        n_cand = min(max(4 * self.k, 2 * self.k + 8), sample.shape[0])
+        cand = _kmeans_pp_init(sample, n_cand, self.seed)
+        return Partials(
+            family="gmm.init",
+            stats={"candidates": np.asarray(cand, np.float64)},
+            n_rows=float(sample.shape[0]),
+        )
+
+    def init_state_from_merged(self, merged):
+        """Round-0 EM parameters from the concatenated per-silo candidates
+        (same `_init_params` recipe as the pooled sample init, run on the
+        candidate pool, unshifted)."""
+        from ..federated.partials import FitState
+
+        cand = np.asarray(merged.stats["candidates"], np.float64)
+        d = cand.shape[1]
+        means, covs, weights = _init_params(
+            cand, self.k, d, self.seed, self.reg_covar
+        )
+        return FitState(
+            family=self.partials_family, version=0,
+            params={
+                "weights": np.asarray(weights, np.float32),
+                "means": np.asarray(means, np.float32),
+                "covariances": np.asarray(covs, np.float32),
+            },
+            meta={"prev_ll": float("inf"), "ll": 0.0, "n": 0.0},
+        )
+
+    def partial_fit_stats(
+        self, data, label_col: str | None = None, mesh=None,
+        state=None, final: bool = False,
+    ):
+        from ..federated.partials import Partials
+
+        if state is None:
+            raise ValueError("gmm partials need the broadcast FitState")
+        validate_matmul_precision(self.matmul_precision)
+        mesh = mesh or default_mesh()
+        ds = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
+        x = ds.x.astype(jnp.float32)
+        d = x.shape[1]
+        n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+        step = _make_em_stats_step(
+            mesh, n_loc, self.k, d, self.chunk_rows, self.matmul_precision
+        )
+        covs_d = jnp.asarray(state.params["covariances"], jnp.float32)
+        chols = _gmm_chols(covs_d, jnp.float32(self.reg_covar))
+        logw = jnp.log(jnp.asarray(state.params["weights"], jnp.float32))
+        means_d = jnp.asarray(state.params["means"], jnp.float32)
+        nk, sums, outer, ll = step(
+            x, ds.w, jnp.zeros((d,), jnp.float32), logw, means_d, chols
+        )
+        return Partials(
+            family=self.partials_family,
+            stats={
+                "nk": np.asarray(jax.device_get(nk)),
+                "sums": np.asarray(jax.device_get(sums)),
+                "outer": np.asarray(jax.device_get(outer)),
+                "ll": np.asarray(jax.device_get(ll)),
+            },
+            n_rows=float(np.asarray(jax.device_get(jnp.sum(ds.w)))),
+            state_version=state.version,
+        )
+
+    def apply_partials(self, state, merged):
+        from ..federated.partials import FitState
+
+        means, covs, weights = _gmm_m_step(
+            jnp.asarray(merged.stats["nk"]),
+            jnp.asarray(merged.stats["sums"]),
+            jnp.asarray(merged.stats["outer"]),
+            jnp.float32(self.reg_covar),
+        )
+        ll = np.float32(np.asarray(merged.stats["ll"]))
+        prev_ll = np.float32(state.meta.get("prev_ll", float("inf")))
+        version = state.version + 1
+        # host-f32 mirror of the device `|ll − prev_ll| >= tol` exit —
+        # same f32 operands, same iteration counts
+        done = bool(np.abs(ll - prev_ll) < np.float32(self.tol))
+        done = done or version >= self.max_iter
+        return FitState(
+            family=self.partials_family, version=version,
+            params={
+                "weights": np.asarray(jax.device_get(weights)),
+                "means": np.asarray(jax.device_get(means)),
+                "covariances": np.asarray(jax.device_get(covs)),
+            },
+            meta={
+                "prev_ll": float(ll),
+                "ll": float(ll),
+                "n": float(merged.n_rows),
+            },
+        ), done
+
+    def fit_from_partials(self, merged, state=None) -> GaussianMixtureModel:
+        if state is None:
+            raise ValueError(
+                "gmm fit_from_partials needs the converged FitState"
+            )
+        ll = float(state.meta.get("ll", 0.0))
+        n = float(state.meta.get("n", 0.0))
+        return GaussianMixtureModel(
+            weights=np.asarray(state.params["weights"], np.float32),
+            means=np.asarray(state.params["means"], np.float32),
+            covariances=np.asarray(state.params["covariances"], np.float32),
+            log_likelihood=ll,
+            avg_log_likelihood=ll / max(n, 1.0),
+            n_iter=state.version,
+        )
+
     def _fit_outofcore(self, hd, mesh: Mesh, on_iteration=None) -> GaussianMixtureModel:
         """Rows ≫ HBM: per EM iteration, stream ``max_device_rows`` blocks
         through the mesh accumulating the SAME psum'd sufficient statistics
@@ -633,17 +830,24 @@ class GaussianMixture(Estimator):
                 "k": self.k, "d": d,
                 "data": data_fingerprint(hd.x, hd.w),
                 "n": hd.n, "seed": self.seed,
+                "warm": self._warm_fingerprint(),
                 "reg_covar": self.reg_covar, "tol": self.tol,
             }
             ckpt = FitCheckpointer(self.checkpoint_dir, signature)
             resumed = ckpt.resume()
 
-        valid = hd.sample_rows(self.init_sample_size, self.seed)
-        shift = (
-            valid.mean(axis=0).astype(np.float32)
-            if valid.shape[0]
-            else np.zeros((d,), np.float32)
-        )
+        warm = self._warm_params(d)
+        if warm is None:
+            valid = hd.sample_rows(self.init_sample_size, self.seed)
+            shift = (
+                valid.mean(axis=0).astype(np.float32)
+                if valid.shape[0]
+                else np.zeros((d,), np.float32)
+            )
+        else:
+            # warm fits run unshifted (see warm_start_params note)
+            valid = None
+            shift = np.zeros((d,), np.float32)
         start_it = 1
         prev_ll_resume = -np.inf
         if resumed is not None:
@@ -654,6 +858,8 @@ class GaussianMixture(Estimator):
             weights = arrays["weights"].astype(np.float32)
             prev_ll_resume = float(extra.get("prev_ll", -np.inf))
             start_it = step0 + 1
+        elif warm is not None:
+            weights, means, covs = warm
         else:
             means, covs, weights = _init_params(
                 valid - shift, self.k, d, self.seed, self.reg_covar

@@ -719,6 +719,159 @@ class KMeans(Estimator):
             cluster_sizes=np.asarray(jax.device_get(counts))[: self.k],
         )
 
+    # ---------------------------------------------------- partials protocol
+    # Federated rounds reuse the out-of-core machinery verbatim: each silo
+    # runs _make_stats_step on its private rows, the coordinator's merged
+    # fold reproduces the psum/scan summation (zero-init, ascending), and
+    # _centroid_update + a host-f32 mirror of the while_loop's convergence
+    # test replay the resident fast path bit-for-bit when silo boundaries
+    # sit on scan-chunk boundaries.
+    partials_family = "kmeans"
+
+    def partials_max_rounds(self) -> int:
+        return self.max_iter
+
+    def partials_final_collect(self) -> bool:
+        # cost/sizes must describe the RETURNED centers (Spark's
+        # summary.trainingCost) at exact precision — one closing collect
+        return True
+
+    def init_partials_state(self, n_features: int, mesh=None):
+        from ..federated.partials import FitState
+
+        c0 = self._warm_centers(n_features)
+        if c0 is None:
+            return None  # coordinator runs the candidate init round
+        return FitState(
+            family=self.partials_family, version=0,
+            params={"centers": c0.astype(np.float32)}, meta={},
+        )
+
+    def local_init_stats(self, data, label_col: str | None = None, mesh=None):
+        """One silo's init contribution: its local k-means++ candidates
+        (each a weighted summary of the silo's geometry — candidate
+        CENTERS cross the wire, never rows)."""
+        from ..federated.partials import Partials
+        from ..parallel.sharding import sample_valid_rows
+
+        mesh = mesh or default_mesh()
+        ds = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
+        sample = sample_valid_rows(ds, self.init_sample_size, self.seed)
+        cand = self._init_from_sample(np.asarray(sample, np.float64))
+        return Partials(
+            family="kmeans.init",
+            stats={"candidates": np.asarray(cand, np.float64)},
+            n_rows=float(sample.shape[0]),
+        )
+
+    def init_state_from_merged(self, merged):
+        """Round-0 centers from the concatenated per-silo candidates:
+        k-means++ re-seeds over the candidate pool (ascending silo
+        order), then a few host Lloyd polish passes — the distributed
+        analogue of the pooled sample init."""
+        from ..federated.partials import FitState
+
+        cand = np.asarray(merged.stats["candidates"], np.float64)
+        centers = _kmeans_pp_init(cand, self.k, self.seed)
+        centers = _lloyd_refine(cand, centers, iters=10)
+        if self.distance_measure == "cosine":
+            norms = np.sqrt(np.maximum((centers * centers).sum(axis=1), 1e-12))
+            centers = centers / norms[:, None]
+        return FitState(
+            family=self.partials_family, version=0,
+            params={"centers": centers.astype(np.float32)}, meta={},
+        )
+
+    def partial_fit_stats(
+        self, data, label_col: str | None = None, mesh=None,
+        state=None, final: bool = False,
+    ):
+        from ..federated.partials import Partials
+
+        if state is None:
+            raise ValueError("kmeans partials need the broadcast FitState")
+        mesh = mesh or default_mesh()
+        ds = as_device_dataset(data, mesh=mesh, weight_col=self.weight_col)
+        if self.distance_measure == "cosine":
+            x = _cosine_prep(ds.x, ds.w)
+        else:
+            x = ds.x.astype(jnp.float32)
+        m = mesh.shape[MODEL_AXIS]
+        k_pad = padded_slots(self.k, m)
+        d = x.shape[1]
+        cen = pad_slots(
+            np.asarray(state.params["centers"], np.float32), k_pad
+        )
+        centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+        c_valid = jax.device_put(
+            slot_mask(self.k, k_pad), NamedSharding(mesh, P(MODEL_AXIS))
+        )
+        n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
+        if final or self.matmul_precision == "highest":
+            # exact precision for the closing stats pass (same rule as
+            # _make_train_loop's final_step)
+            step = _make_stats_step(mesh, n_loc, k_pad, d, self.chunk_rows)
+        else:
+            step = _make_stats_step(
+                mesh, n_loc, k_pad, d, self.chunk_rows,
+                self.matmul_precision, self.fused_stats,
+            )
+        sums, counts, cost = step(x, ds.w, centers, c_valid)
+        # pad slots collect nothing (masked to _BIG) — slice them off so
+        # partials are mesh-layout-independent on the wire
+        counts_h = np.asarray(jax.device_get(counts))[: self.k]
+        return Partials(
+            family=self.partials_family,
+            stats={
+                "sums": np.asarray(jax.device_get(sums))[: self.k],
+                "counts": counts_h,
+                "cost": np.asarray(jax.device_get(cost)),
+            },
+            n_rows=float(counts_h.sum()),
+            state_version=state.version,
+        )
+
+    def apply_partials(self, state, merged):
+        from ..federated.partials import FitState
+
+        centers = jnp.asarray(state.params["centers"], jnp.float32)
+        c_valid = jnp.ones((centers.shape[0],), jnp.float32)
+        new_centers, move = _centroid_update(
+            jnp.asarray(merged.stats["sums"]),
+            jnp.asarray(merged.stats["counts"]),
+            centers, c_valid, self.distance_measure == "cosine",
+        )
+        version = state.version + 1
+        # host-f32 mirror of the device while_loop's `move > tol_sq` exit
+        # — same comparison, same f32 operands, same iteration counts
+        done = not bool(
+            np.float32(jax.device_get(move))
+            > np.float32(float(self.tol * self.tol))
+        )
+        done = done or version >= self.max_iter
+        return FitState(
+            family=self.partials_family, version=version,
+            params={"centers": np.asarray(jax.device_get(new_centers))},
+            meta={"cost": float(np.asarray(merged.stats["cost"]))},
+        ), done
+
+    def fit_from_partials(self, merged, state=None) -> KMeansModel:
+        """Final model from the closing exact-precision collect (``merged``)
+        at the converged ``state`` centers."""
+        if state is None:
+            raise ValueError(
+                "kmeans fit_from_partials needs the converged FitState"
+            )
+        return KMeansModel(
+            cluster_centers=np.asarray(
+                state.params["centers"], np.float32
+            )[: self.k],
+            distance_measure=self.distance_measure,
+            training_cost=float(np.asarray(merged.stats["cost"])),
+            n_iter=state.version,
+            cluster_sizes=np.asarray(merged.stats["counts"])[: self.k],
+        )
+
     def fit(
         self, data, label_col: str | None = None, mesh=None, on_iteration=None
     ) -> KMeansModel:

@@ -238,6 +238,58 @@ def _lr_solve_from_stats(
     return coef, intercept
 
 
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _wls_partial_stats(x, y, w, fit_intercept: bool):
+    """One silo's WLS sufficient statistics — the summation-mergeable
+    decomposition of :func:`_wls_fit`'s reductions: raw feature moments
+    (for the standardization scale), the intercept-augmented Gram, and
+    the moment vector.  Shipped as ``Partials``; summing them across
+    silos and feeding :func:`_wls_fit_from_stats` reproduces the pooled
+    fit (bit-tight when the per-silo sums are exact, e.g. integer-valued
+    features — float data matches to merge-reassociation rounding)."""
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    if fit_intercept:
+        xa = jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+    else:
+        xa = x
+    wcol = w[:, None]
+    return (
+        jnp.sum(w),                       # Σw
+        jnp.sum(x * wcol, axis=0),        # Σw·x
+        jnp.sum(x * x * wcol, axis=0),    # Σw·x²
+        (xa * wcol).T @ xa,               # XᵀWX (augmented)
+        (xa * wcol).T @ y,                # XᵀWy
+    )
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "standardize"))
+def _wls_fit_from_stats(
+    sw, sx, sxx, gram, mom, reg_param, fit_intercept: bool, standardize: bool
+):
+    """Merged statistics → (coef, intercept), mirroring the tail of
+    :func:`_wls_fit` operation-for-operation (same moments rule, same
+    Spark ridge vector, same jitter) so the federated solve and the
+    pooled solve share bits, not just math."""
+    n = jnp.maximum(sw, 1.0)
+    mean = sx / n
+    var = sxx / n - mean * mean
+    std = jnp.where(var > 1e-12, jnp.sqrt(jnp.maximum(var, 1e-12)), 1.0)
+    scale = std if standardize else jnp.ones_like(std)
+    nfeat = sx.shape[0]
+    dd = gram.shape[0]
+    ridge = jnp.zeros((dd,), gram.dtype).at[:nfeat].set(
+        reg_param * n * scale * scale
+    )
+    theta = jnp.linalg.solve(
+        gram + jnp.diag(ridge) + 1e-8 * jnp.eye(dd, dtype=gram.dtype), mom
+    )
+    coef = theta[:nfeat]
+    intercept = theta[nfeat] if fit_intercept else jnp.zeros((), gram.dtype)
+    return coef, intercept
+
+
 @register_model("LinearRegressionModel")
 @dataclass
 class LinearRegressionModel(Model):
@@ -331,6 +383,64 @@ class LinearRegression(Estimator):
             model, ds, self.reg_param, self.elastic_net_param, self.fit_intercept
         )
         return model
+
+    # ---------------------------------------------------- partials protocol
+    partials_family = "linear"
+
+    def supports_partials(self) -> bool:
+        # the elastic-net path centers the design around the POOLED mean
+        # before its FISTA Gram — that coupling does not decompose into
+        # per-silo summations, so it stays pooled-only
+        return not (self.elastic_net_param > 0.0 and self.reg_param > 0.0)
+
+    def init_partials_state(self, n_features: int, mesh=None):
+        return None  # single-shot family: no state between rounds
+
+    def partial_fit_stats(
+        self, data, label_col: str | None = None, mesh=None,
+        state=None, final: bool = False,
+    ):
+        from ..federated.partials import Partials
+
+        if not self.supports_partials():
+            raise NotImplementedError(
+                "elastic-net LinearRegression centers the design on the "
+                "pooled mean — not partials-decomposable; use reg_param "
+                "with elastic_net_param=0 (ridge) for federated fits"
+            )
+        ds = as_device_dataset(
+            data, label_col or self.label_col, mesh=mesh,
+            weight_col=self.weight_col,
+        )
+        sw, sx, sxx, gram, mom = _wls_partial_stats(
+            ds.x, ds.y, ds.w, self.fit_intercept
+        )
+        sw = np.asarray(jax.device_get(sw))
+        return Partials(
+            family=self.partials_family,
+            stats={
+                "sw": sw,
+                "sx": np.asarray(jax.device_get(sx)),
+                "sxx": np.asarray(jax.device_get(sxx)),
+                "gram": np.asarray(jax.device_get(gram)),
+                "mom": np.asarray(jax.device_get(mom)),
+            },
+            n_rows=float(sw),
+        )
+
+    def apply_partials(self, state, merged):
+        return state, True  # one update, then done
+
+    def fit_from_partials(self, merged, state=None) -> LinearRegressionModel:
+        coef, intercept = _wls_fit_from_stats(
+            jnp.asarray(merged.stats["sw"]),
+            jnp.asarray(merged.stats["sx"]),
+            jnp.asarray(merged.stats["sxx"]),
+            jnp.asarray(merged.stats["gram"]),
+            jnp.asarray(merged.stats["mom"]),
+            jnp.float32(self.reg_param), self.fit_intercept, self.standardize,
+        )
+        return LinearRegressionModel(coefficients=coef, intercept=intercept)
 
     def _fit_outofcore(self, hd, mesh=None) -> LinearRegressionModel:
         """Rows ≫ HBM: accumulate the WLS/elastic-net sufficient statistics

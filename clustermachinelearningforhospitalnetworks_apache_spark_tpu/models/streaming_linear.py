@@ -147,6 +147,40 @@ class StreamingLinearRegression:
         )
         self._state_mesh = mesh
 
+    def absorb_partials(self, merged) -> "StreamingLinearRegression":
+        """Fold a merged federated ``linear`` :class:`~..federated
+        .partials.Partials` into the decayed RLS state as ONE micro-batch
+        — the cross-silo form of :meth:`update`.  The merged Gram/moment
+        ARE the batch statistics ``_lin_batch_stats`` would have produced
+        on the concatenated silo rows (intercept-augmented, psum'd), so
+        the decayed accumulate is the identical ``a·state + g``
+        elementwise update and decay-1.0 keeps the all-rows-seen WLS
+        exactness across network rounds (bit-tight when the silo sums
+        are exact — the federated linear contract)."""
+        if merged.family != "linear":
+            raise ValueError(
+                f"absorb_partials folds 'linear' partials, got "
+                f"{merged.family!r}"
+            )
+        g = jnp.asarray(merged.stats["gram"], jnp.float32)
+        m = jnp.asarray(merged.stats["mom"], jnp.float32)
+        ws = jnp.float32(np.asarray(merged.stats["sw"]))
+        if g.shape[0] != m.shape[0]:
+            raise ValueError("merged gram/mom shapes disagree")
+        if self._gram is None:
+            d = g.shape[0]
+            self._gram = jnp.zeros((d, d), jnp.float32)
+            self._mom = jnp.zeros((d,), jnp.float32)
+            self._wsum = jnp.float32(0.0)
+        a = jnp.float32(self.decay_factor)
+        # eager a·state + g matches the fused jit step bitwise
+        # (elementwise, no reduction reorder — see _make_lin_update)
+        self._gram = a * self._gram + g
+        self._mom = a * self._mom + m
+        self._wsum = a * self._wsum + ws
+        self._n_batches += 1
+        return self
+
     @property
     def latest_model(self) -> LinearRegressionModel:
         if self._gram is None:

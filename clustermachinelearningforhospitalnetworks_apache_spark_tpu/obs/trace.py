@@ -67,6 +67,7 @@ REGISTERED_SPANS = (
     "fleet.promote",     # atomic fleet-wide swap (every replica or none)
     "router.route",      # the routing decision (policy, chosen replica)
     "obs.demo",          # example/bench root spans
+    "fed.round",         # one federated fit round: collect→merge→fit→broadcast
 )
 
 #: fault site (fnmatch glob) → the registered span that encloses or
@@ -92,6 +93,7 @@ SITE_COVERAGE = {
     "lifecycle.feedback.*": "lifecycle.feedback",
     "fleet.swap.*": "fleet.promote",
     "sql.view.maintain": "sql.view.maintain",
+    "fed.round.*": "fed.round",
 }
 
 _CTX: contextvars.ContextVar = contextvars.ContextVar("obs_trace", default=None)

@@ -116,6 +116,12 @@ def pytest_configure(config):
         "suppression/baseline machinery, the tier-1 repo-clean meta-test "
         "(pytest -m lint)",
     )
+    config.addinivalue_line(
+        "markers",
+        "federated: cross-silo federated-fit tests — partials/pooled "
+        "bit-parity per family, quorum/dropout ladder, round-journal "
+        "resume (pytest -m federated)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

@@ -1,0 +1,568 @@
+"""Cross-silo federated fit (ISSUE 16).
+
+The load-bearing claims, each pinned bitwise:
+
+* federated == pooled for linear/RLS, k-means, and GMM when silo
+  boundaries sit on the estimators' scan-chunk boundaries (the merge is
+  the same zero-init ascending fold the chunk scans run);
+* the result never depends on arrival order, only on silo ids;
+* a silo that drops and recovers *within* a round (retry ladder) costs
+  nothing — the fit stays bit-identical;
+* a coordinator killed at any ``fed.round.*`` site resumes from the
+  journal without re-asking silos for work they already did.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.federated import (
+    FED_BROADCAST_SITE,
+    FED_COLLECT_SITE,
+    FED_FIT_SITE,
+    FED_MERGE_SITE,
+    FederatedConfig,
+    FederatedCoordinator,
+    FederatedQuorumError,
+    NoiseConfig,
+    Partials,
+    Silo,
+    apply_clipped_noise,
+    merge_partials,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+    GaussianMixture,
+    KMeans,
+    LinearRegression,
+    StreamingLinearRegression,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.base import (
+    Estimator,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.retry import (
+    RetryPolicy,
+)
+
+pytestmark = pytest.mark.federated
+
+N_SILOS, ROWS, D = 4, 512, 4
+
+
+# ------------------------------------------------------------------ data
+def _int_xy(n_rows: int, d: int = D, seed: int = 0):
+    """Integer-valued f32 rows: every partial sum is exact in f32, so the
+    linear parity claims hold on ANY mesh/chunk layout."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(-8, 8, size=(n_rows, d)).astype(np.float32)
+    y = (x @ np.arange(1, d + 1).astype(np.float32) + 1.0).astype(np.float32)
+    return x, y
+
+
+def _blobs(n_rows: int, d: int = D, seed: int = 1):
+    rng = np.random.default_rng(seed)
+    x = np.concatenate(
+        [rng.normal(c, 1.0, size=(n_rows // 3 + 1, d)) for c in (0.0, 6.0, -6.0)]
+    )[:n_rows].astype(np.float32)
+    rng.shuffle(x)
+    return x
+
+
+def _silos(x, y=None, mesh=None, n=N_SILOS, rows=ROWS):
+    out = []
+    for i in range(n):
+        sl = slice(i * rows, (i + 1) * rows)
+        data = x[sl] if y is None else (x[sl], y[sl])
+        out.append(Silo(f"s{i}", data, mesh=mesh))
+    return out
+
+
+def _fast_cfg(**kw):
+    kw.setdefault(
+        "retry", RetryPolicy(max_attempts=3, base_delay_s=0.0, max_delay_s=0.0)
+    )
+    kw.setdefault("breaker_recovery_s", 0.0)
+    return FederatedConfig(**kw)
+
+
+def _km(**kw):
+    x = _blobs(N_SILOS * ROWS)
+    kw.setdefault("k", 3)
+    kw.setdefault("max_iter", 15)
+    kw.setdefault("warm_start_centers", x[: kw["k"]].copy())
+    kw.setdefault("chunk_rows", ROWS)
+    return KMeans(**kw), x
+
+
+def _gm(**kw):
+    x = _blobs(N_SILOS * ROWS, seed=2)
+    k = kw.setdefault("k", 3)
+    kw.setdefault("max_iter", 8)
+    kw.setdefault("tol", 1e-3)
+    kw.setdefault("chunk_rows", ROWS)
+    kw.setdefault(
+        "warm_start_params",
+        (
+            np.full((k,), 1.0 / k, np.float32),
+            x[:k].astype(np.float32),
+            np.stack([np.eye(D, dtype=np.float32) * 4.0] * k),
+        ),
+    )
+    return GaussianMixture(**kw), x
+
+
+def _assert_kmeans_equal(a, b):
+    assert np.array_equal(np.asarray(a.cluster_centers), np.asarray(b.cluster_centers))
+    assert float(a.training_cost) == float(b.training_cost)
+    assert a.n_iter == b.n_iter
+    assert np.array_equal(np.asarray(a.cluster_sizes), np.asarray(b.cluster_sizes))
+
+
+def _assert_gmm_equal(a, b):
+    # federated GMM runs unshifted; −0.0 vs +0.0 may differ from the
+    # pooled path's shift arithmetic — array_equal treats them as equal
+    assert np.array_equal(np.asarray(a.weights), np.asarray(b.weights))
+    assert np.array_equal(np.asarray(a.means), np.asarray(b.means))
+    assert np.array_equal(np.asarray(a.covariances), np.asarray(b.covariances))
+    assert float(a.log_likelihood) == float(b.log_likelihood)
+    assert a.n_iter == b.n_iter
+
+
+# ------------------------------------------------- per-family bit parity
+def test_linear_federated_matches_pooled_bitwise(mesh1):
+    x, y = _int_xy(N_SILOS * ROWS)
+    est = LinearRegression(reg_param=0.1)
+    pooled = est.fit((x, y), mesh=mesh1)
+    silos = _silos(x, y, mesh1)
+    res = FederatedCoordinator(est, silos, _fast_cfg()).fit()
+    assert np.array_equal(
+        np.asarray(pooled.coefficients), np.asarray(res.model.coefficients)
+    )
+    assert float(pooled.intercept) == float(res.model.intercept)
+    (r,) = res.rounds
+    assert r.contributed == ("s0", "s1", "s2", "s3") and r.done
+    assert all(len(s.received_models) == 1 for s in silos)
+
+
+def test_linear_federated_matches_pooled_mesh8(mesh8):
+    # integer-exact sums: parity survives the 8-way data sharding too
+    x, y = _int_xy(N_SILOS * ROWS, seed=3)
+    est = LinearRegression(reg_param=0.05, standardize=False)
+    pooled = est.fit((x, y), mesh=mesh8)
+    res = FederatedCoordinator(est, _silos(x, y, mesh8), _fast_cfg()).fit()
+    assert np.array_equal(
+        np.asarray(pooled.coefficients), np.asarray(res.model.coefficients)
+    )
+    assert float(pooled.intercept) == float(res.model.intercept)
+
+
+def test_kmeans_federated_matches_pooled_bitwise(mesh1):
+    km, x = _km()
+    pooled = km.fit(x, mesh=mesh1)
+    res = FederatedCoordinator(km, _silos(x, mesh=mesh1), _fast_cfg()).fit()
+    _assert_kmeans_equal(pooled, res.model)
+    # iterative family: every round broadcast the updated state
+    assert res.rounds[-1].done
+    assert res.state.version == pooled.n_iter
+
+
+def test_gmm_federated_matches_pooled_bitwise(mesh1):
+    gm, x = _gm()
+    pooled = gm.fit(x, mesh=mesh1)
+    res = FederatedCoordinator(gm, _silos(x, mesh=mesh1), _fast_cfg()).fit()
+    _assert_gmm_equal(pooled, res.model)
+
+
+def test_federated_result_independent_of_silo_registration_order(mesh1):
+    km, x = _km()
+    silos_fwd = _silos(x, mesh=mesh1)
+    silos_rev = list(reversed(_silos(x, mesh=mesh1)))
+    a = FederatedCoordinator(km, silos_fwd, _fast_cfg()).fit()
+    b = FederatedCoordinator(km, silos_rev, _fast_cfg()).fit()
+    _assert_kmeans_equal(a.model, b.model)
+
+
+# ------------------------------------------------ dropout / straggler
+def test_transient_silo_failure_recovers_bit_tight(mesh1):
+    """Two collect faults on one silo are absorbed by the in-round retry
+    ladder — the dropped-and-recovered fit is IDENTICAL to the clean one
+    (ISSUE 16 acceptance)."""
+    km, x = _km()
+    pooled = km.fit(x, mesh=mesh1)
+    silos = _silos(x, mesh=mesh1)
+    plan = faults.FaultPlan().fail(
+        FED_COLLECT_SITE, times=2, when=lambda ctx: ctx.get("silo") == "s2"
+    )
+    with faults.active(plan):
+        res = FederatedCoordinator(km, silos, _fast_cfg()).fit()
+    assert plan.fired(FED_COLLECT_SITE) == 2
+    _assert_kmeans_equal(pooled, res.model)
+    # the failed attempts never reached the silo's compute
+    s2 = next(s for s in silos if s.silo_id == "s2")
+    s0 = next(s for s in silos if s.silo_id == "s0")
+    assert s2.compute_calls == s0.compute_calls
+
+
+def test_linear_late_partial_folds_exactly(mesh1):
+    """A silo that misses round 0 entirely (retries exhausted) lands in a
+    later attempt round; the zero-init ascending merge folds its late
+    partial into the SAME bits as an on-time run."""
+    x, y = _int_xy(N_SILOS * ROWS, seed=4)
+    est = LinearRegression(reg_param=0.1)
+    pooled = est.fit((x, y), mesh=mesh1)
+    silos = _silos(x, y, mesh1)
+    plan = faults.FaultPlan().fail(
+        FED_COLLECT_SITE, times=3, when=lambda ctx: ctx.get("silo") == "s1"
+    )
+    with faults.active(plan):
+        res = FederatedCoordinator(est, silos, _fast_cfg()).fit()
+    assert plan.fired(FED_COLLECT_SITE) == 3
+    assert len(res.rounds) == 2
+    assert res.rounds[0].dropped == ("s1",) and not res.rounds[0].done
+    assert res.rounds[1].contributed == ("s0", "s1", "s2", "s3")
+    assert np.array_equal(
+        np.asarray(pooled.coefficients), np.asarray(res.model.coefficients)
+    )
+
+
+def test_hard_dropout_completes_round_with_quorum(mesh1):
+    km, x = _km(max_iter=5)
+    silos = _silos(x, mesh=mesh1)
+    plan = faults.FaultPlan().fail(
+        FED_COLLECT_SITE, times=None, when=lambda ctx: ctx.get("silo") == "s3"
+    )
+    with faults.active(plan):
+        res = FederatedCoordinator(km, silos, _fast_cfg(quorum=0.5)).fit()
+    assert all("s3" not in r.contributed for r in res.rounds)
+    assert res.model.n_iter >= 1
+    # the broadcast still reaches the dropped silo so it can rejoin
+    s3 = next(s for s in silos if s.silo_id == "s3")
+    assert len(s3.received_versions) == len(res.rounds)
+
+
+def test_quorum_failure_raises(mesh1):
+    km, x = _km(max_iter=3)
+    silos = _silos(x, mesh=mesh1)
+    plan = faults.FaultPlan().fail(
+        FED_COLLECT_SITE, times=None,
+        when=lambda ctx: ctx.get("silo") in ("s1", "s2", "s3"),
+    )
+    with faults.active(plan):
+        with pytest.raises(FederatedQuorumError):
+            FederatedCoordinator(km, silos, _fast_cfg(quorum=0.75)).fit()
+
+
+# ------------------------------------------------------- merge contract
+def test_merge_is_arrival_order_independent():
+    rng = np.random.default_rng(7)
+    parts = [
+        Partials(
+            family="linear",
+            stats={"g": rng.normal(size=(3, 3)).astype(np.float32)},
+            n_rows=10.0, silo_id=f"s{i}",
+        )
+        for i in range(5)
+    ]
+    ref = merge_partials(parts)
+    shuffled = [parts[i] for i in (3, 0, 4, 2, 1)]
+    out = merge_partials(shuffled)
+    assert np.array_equal(ref.stats["g"], out.stats["g"])
+    assert ref.sources == out.sources == ("s0", "s1", "s2", "s3", "s4")
+
+
+def test_merge_rejects_mixed_versions_and_families():
+    a = Partials(family="linear", stats={"g": np.ones(2, np.float32)},
+                 silo_id="a", state_version=0)
+    b = Partials(family="linear", stats={"g": np.ones(2, np.float32)},
+                 silo_id="b", state_version=1)
+    with pytest.raises(ValueError, match="state version"):
+        merge_partials([a, b])
+    c = Partials(family="kmeans", stats={"g": np.ones(2, np.float32)},
+                 silo_id="c", state_version=0)
+    with pytest.raises(ValueError, match="family"):
+        merge_partials([a, c])
+
+
+def test_partials_journal_payload_roundtrip_is_exact():
+    rng = np.random.default_rng(11)
+    p = Partials(
+        family="gmm",
+        stats={
+            "nk": rng.normal(size=(3,)).astype(np.float32),
+            "outer": rng.normal(size=(3, 4, 4)).astype(np.float32),
+        },
+        n_rows=123.0, silo_id="s1", round_id=4, state_version=4,
+    )
+    q = Partials.from_payload(p.to_payload())
+    for k in p.stats:
+        assert np.array_equal(p.stats[k], q.stats[k])
+        assert p.stats[k].dtype == q.stats[k].dtype
+    assert (q.silo_id, q.round_id, q.state_version) == ("s1", 4, 4)
+
+
+def test_weighting_scales_contribution_and_row_mass():
+    a = Partials(family="linear", stats={"g": np.full(2, 2.0, np.float32)},
+                 n_rows=10.0, silo_id="a")
+    b = Partials(family="linear", stats={"g": np.full(2, 4.0, np.float32)},
+                 n_rows=10.0, silo_id="b")
+    merged = merge_partials([a, b], weights={"a": 3.0, "b": 1.0})
+    assert np.array_equal(merged.stats["g"], np.full(2, 10.0, np.float32))
+    assert merged.n_rows == 40.0
+    # the unweighted fold skips the multiply entirely (bit-parity path)
+    plain = merge_partials([a, b])
+    assert np.array_equal(plain.stats["g"], np.full(2, 6.0, np.float32))
+
+
+# ------------------------------------------------------------- noise knob
+def test_clipped_noise_is_deterministic_and_flagged():
+    p = Partials(
+        family="linear",
+        stats={"g": np.full((4,), 100.0, np.float32)},
+        n_rows=5.0, silo_id="s0", round_id=2,
+    )
+    cfg = NoiseConfig(clip_norm=1.0, noise_multiplier=0.5, seed=9)
+    a, b = apply_clipped_noise(p, cfg), apply_clipped_noise(p, cfg)
+    assert a.noised and np.array_equal(a.stats["g"], b.stats["g"])
+    # clipping bound: the noised stats' norm ≤ clip + noise scale margin
+    assert not np.array_equal(a.stats["g"], p.stats["g"])
+    # no-op config ships the partial untouched (bit-parity preserved)
+    clean = apply_clipped_noise(p, NoiseConfig(clip_norm=1e9, noise_multiplier=0.0))
+    assert clean is p and not clean.noised
+
+
+def test_noise_knob_end_to_end_close_but_marked(mesh1):
+    x, y = _int_xy(N_SILOS * ROWS, seed=5)
+    est = LinearRegression(reg_param=0.1)
+    pooled = est.fit((x, y), mesh=mesh1)
+    noise = NoiseConfig(clip_norm=1e9, noise_multiplier=1e-9, seed=3)
+    res = FederatedCoordinator(
+        est, _silos(x, y, mesh1), _fast_cfg(noise=noise)
+    ).fit()
+    np.testing.assert_allclose(
+        np.asarray(pooled.coefficients), np.asarray(res.model.coefficients),
+        rtol=1e-3, atol=1e-3,
+    )
+    # deterministic: a rerun produces the identical noised model
+    res2 = FederatedCoordinator(
+        est, _silos(x, y, mesh1), _fast_cfg(noise=noise)
+    ).fit()
+    assert np.array_equal(
+        np.asarray(res.model.coefficients), np.asarray(res2.model.coefficients)
+    )
+
+
+# -------------------------------------------------------- federated init
+def test_kmeans_federated_init_without_warm_start(mesh1):
+    x = _blobs(N_SILOS * ROWS, seed=6)
+    km = KMeans(k=3, max_iter=10, chunk_rows=ROWS, init_sample_size=ROWS)
+    silos = _silos(x, mesh=mesh1)
+    res = FederatedCoordinator(km, silos, _fast_cfg()).fit()
+    assert res.model.cluster_centers.shape == (3, D)
+    assert float(res.model.training_cost) > 0.0
+    # candidate init counts as one extra collect per silo
+    assert silos[0].compute_calls == res.state.version + 2
+
+
+def test_gmm_federated_init_without_warm_start(mesh1):
+    x = _blobs(N_SILOS * ROWS, seed=8)
+    gm = GaussianMixture(k=2, max_iter=4, tol=1e-3, chunk_rows=ROWS,
+                         init_sample_size=ROWS)
+    res = FederatedCoordinator(gm, _silos(x, mesh=mesh1), _fast_cfg()).fit()
+    assert res.model.means.shape == (2, D)
+    assert np.isfinite(res.model.log_likelihood)
+    assert abs(float(np.sum(res.model.weights)) - 1.0) < 1e-5
+
+
+# -------------------------------------------------------- estimator API
+def test_partials_protocol_surface():
+    assert LinearRegression().supports_partials()
+    # the elastic-net path centers on the pooled mean — not decomposable
+    assert not LinearRegression(
+        reg_param=0.1, elastic_net_param=0.5
+    ).supports_partials()
+    assert KMeans().supports_partials() and KMeans().partials_final_collect()
+    assert GaussianMixture().supports_partials()
+    assert not GaussianMixture().partials_final_collect()
+
+    class Plain(Estimator):
+        def fit(self, data, label_col=None, mesh=None):  # pragma: no cover
+            return None
+
+    p = Plain()
+    assert not p.supports_partials()
+    with pytest.raises(NotImplementedError):
+        p.partial_fit_stats(None)
+    with pytest.raises(NotImplementedError):
+        p.fit_from_partials(None)
+
+
+def test_streaming_linear_absorbs_federated_round(mesh1):
+    """RLS coverage: the streaming estimator folds a merged federated
+    round as one micro-batch, bit-matching its own update on the pooled
+    rows (decay 1.0, integer-exact sums)."""
+    x, y = _int_xy(2 * ROWS, seed=9)
+    est = LinearRegression(reg_param=0.0)
+    silos = _silos(x, y, mesh1, n=2, rows=ROWS)
+    parts = [
+        s.compute_partials(est, state=None, round_id=0) for s in silos
+    ]
+    merged = merge_partials(parts)
+
+    fed = StreamingLinearRegression()
+    fed.absorb_partials(merged)
+    direct = StreamingLinearRegression()
+    direct.update((x, y), mesh=mesh1)
+    a, b = fed.latest_model, direct.latest_model
+    assert np.array_equal(np.asarray(a.coefficients), np.asarray(b.coefficients))
+    assert float(a.intercept) == float(b.intercept)
+    with pytest.raises(ValueError, match="linear"):
+        fed.absorb_partials(
+            Partials(family="kmeans", stats={}, silo_id="x")
+        )
+
+
+# ------------------------------------------------------------- profiles
+def test_merged_profile_matches_pooled_moments(mesh1):
+    x = _blobs(N_SILOS * ROWS, seed=10)
+    coord = FederatedCoordinator(
+        LinearRegression(), _silos(x, np.zeros(len(x), np.float32), mesh1),
+        _fast_cfg(),
+    )
+    prof = coord.merged_profile(names=[f"f{j}" for j in range(D)])
+    for j in range(D):
+        sk = prof.sketches[f"f{j}"]
+        assert sk.count == float(len(x))
+        np.testing.assert_allclose(
+            sk.mean, float(x[:, j].astype(np.float64).mean()), rtol=1e-7
+        )
+        assert sk.min == float(x[:, j].min()) and sk.max == float(x[:, j].max())
+
+
+# ------------------------------------------------------- silo ingestion
+def test_silo_from_csv_runs_local_stack(tmp_path, mesh1):
+    rows = 64
+    rng = np.random.default_rng(12)
+    f0 = rng.integers(0, 10, size=rows)
+    f1 = rng.integers(0, 10, size=rows)
+    los = f0 * 2 + f1 + 1
+    csv = tmp_path / "hospital_a.csv"
+    lines = ["f0,f1,length_of_stay"] + [
+        f"{a},{b},{c}" for a, b, c in zip(f0, f1, los)
+    ]
+    csv.write_text("\n".join(lines) + "\n")
+    schema = ht.Schema(
+        [("f0", "float"), ("f1", "float"), ("length_of_stay", "float")]
+    )
+    silo = Silo.from_csv(
+        "hosp_a", str(csv), schema, feature_cols=["f0", "f1"],
+        label_col="length_of_stay", mesh=mesh1,
+        table_dir=str(tmp_path / "tbl"),
+    )
+    assert silo.n_rows == rows
+    p = silo.compute_partials(LinearRegression(), state=None, round_id=0)
+    assert p.silo_id == "hosp_a" and p.n_rows == float(rows)
+    model = LinearRegression().fit_from_partials(merge_partials([p]))
+    pred = np.asarray(model.predict(silo.feature_matrix().astype(np.float32)))
+    np.testing.assert_allclose(pred, los.astype(np.float32), atol=1e-2)
+
+
+# --------------------------------------------------------- round journal
+FED_SITES = [FED_COLLECT_SITE, FED_MERGE_SITE, FED_FIT_SITE, FED_BROADCAST_SITE]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", FED_SITES)
+def test_coordinator_killed_mid_round_resumes_bit_equal(tmp_path, mesh1, site):
+    """Kill the coordinator at each round phase; a fresh coordinator over
+    the same journal finishes the fit bit-identical to an unkilled run —
+    and no silo recomputes a partial the journal already holds."""
+    km, x = _km(max_iter=6)
+    baseline_silos = _silos(x, mesh=mesh1)
+    baseline = FederatedCoordinator(km, baseline_silos, _fast_cfg()).fit()
+    per_silo_calls = baseline_silos[0].compute_calls
+
+    silos = _silos(x, mesh=mesh1)
+    jdir = str(tmp_path / "journal")
+    cfg = _fast_cfg(journal_dir=jdir)
+    plan = faults.FaultPlan().crash(site)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            FederatedCoordinator(km, silos, cfg).fit()
+    assert plan.fired(site) == 1
+
+    res = FederatedCoordinator(km, silos, cfg).fit()
+    _assert_kmeans_equal(baseline.model, res.model)
+    # journaled partials are folded, not recomputed: total work per silo
+    # matches the unkilled run exactly
+    for s in silos:
+        assert s.compute_calls == per_silo_calls, s.silo_id
+
+
+@pytest.mark.chaos
+def test_coordinator_killed_after_terminal_commit_rebroadcasts_only(
+    tmp_path, mesh1
+):
+    x, y = _int_xy(N_SILOS * ROWS, seed=13)
+    est = LinearRegression(reg_param=0.1)
+    silos = _silos(x, y, mesh1)
+    jdir = str(tmp_path / "j2")
+    cfg = _fast_cfg(journal_dir=jdir)
+    plan = faults.FaultPlan().crash(FED_BROADCAST_SITE)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            FederatedCoordinator(est, silos, cfg).fit()
+    calls = [s.compute_calls for s in silos]
+    res = FederatedCoordinator(est, silos, cfg).fit()
+    assert res.resumed_from_round is not None
+    # terminal commit was journaled before the crash — the resume only
+    # rebuilds + re-broadcasts, zero new silo work
+    assert [s.compute_calls for s in silos] == calls
+    assert all(len(s.received_models) == 1 for s in silos)
+    pooled = est.fit((x, y), mesh=mesh1)
+    assert np.array_equal(
+        np.asarray(pooled.coefficients), np.asarray(res.model.coefficients)
+    )
+
+
+def test_journal_signature_mismatch_refuses_resume(tmp_path, mesh1):
+    x, y = _int_xy(N_SILOS * ROWS, seed=14)
+    est = LinearRegression()
+    jdir = str(tmp_path / "j3")
+    FederatedCoordinator(est, _silos(x, y, mesh1), _fast_cfg(journal_dir=jdir)).fit()
+    other = _silos(x, y, mesh1, n=2)
+    with pytest.raises(ValueError, match="signature mismatch"):
+        FederatedCoordinator(est, other, _fast_cfg(journal_dir=jdir)).fit()
+
+
+# ------------------------------------------------------------------ soak
+@pytest.mark.slow
+def test_multi_round_soak_with_transient_dropouts(mesh1):
+    """Longer horizon: two silos flap across a deeper k-means run; every
+    failure is absorbed in-round, so the fit stays bit-identical to the
+    clean run."""
+    n, rows = 8, 512
+    x = _blobs(n * rows, seed=15)
+    km = KMeans(
+        k=4, max_iter=40, tol=1e-6, warm_start_centers=x[:4].copy(),
+        chunk_rows=rows,
+    )
+    pooled = km.fit(x, mesh=mesh1)
+    clean = FederatedCoordinator(
+        km, _silos(x, mesh=mesh1, n=n, rows=rows), _fast_cfg()
+    ).fit()
+    _assert_kmeans_equal(pooled, clean.model)
+
+    silos = _silos(x, mesh=mesh1, n=n, rows=rows)
+    plan = (
+        faults.FaultPlan()
+        .fail(FED_COLLECT_SITE, times=2,
+              when=lambda ctx: ctx.get("silo") == "s2")
+        .fail(FED_COLLECT_SITE, times=2, after=4,
+              when=lambda ctx: ctx.get("silo") == "s5")
+    )
+    with faults.active(plan):
+        flappy = FederatedCoordinator(km, silos, _fast_cfg()).fit()
+    assert plan.fired(FED_COLLECT_SITE) == 4
+    _assert_kmeans_equal(pooled, flappy.model)

@@ -33,7 +33,11 @@
 # maintenance at sql.view.maintain mid-stream and asserts the resumed
 # view state is bit-identical to an uninterrupted run, plus the
 # replayed-batch double-apply probe: a replayed/committed batch must
-# never fold its delta in twice).
+# never fold its delta in twice), and the federated coordinator
+# (tests/test_federated.py kills a cross-silo k-means fit at every
+# round phase — fed.round.{collect,merge,fit,broadcast} — and asserts
+# the journal-resumed coordinator finishes bit-identical without
+# re-asking silos for work already journaled).
 #
 # ISSUE 10: every InjectedCrash dumps the observability flight recorder
 # (bounded event ring + metrics snapshot, CRC32C-wrapped, atomic write).
@@ -66,7 +70,7 @@ LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
     tests/test_stream_pipeline.py tests/test_gbt_fused.py \
     tests/test_lifecycle.py tests/test_model_farm.py tests/test_fleet.py \
-    tests/test_sql_views.py \
+    tests/test_sql_views.py tests/test_federated.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -81,7 +85,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet|sql_views)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet|sql_views|federated)\.py::(\S+)",
         line,
     )
     if not m:
@@ -147,7 +151,7 @@ for site in sorted(sites):
 # every kill family in the matrix must have left at least one artifact
 import fnmatch
 FAMILIES = ["stream.after_*", "wal.append", "fit_ckpt.*",
-            "model_io.save.*", "lifecycle.*"]
+            "model_io.save.*", "lifecycle.*", "fed.round.*"]
 missing = [
     fam for fam in FAMILIES
     if not any(fnmatch.fnmatchcase(s, fam) for s in sites)
