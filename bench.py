@@ -3275,6 +3275,72 @@ def _bench_federated() -> dict:
     return row
 
 
+def _bench_soak() -> dict:
+    """Compressed-production-day soak config (ISSUE 17).
+
+    Replays the tier-1 smoke shape of the soak harness — the seeded
+    diurnal day (dirty CSV ingest through the firewall, incremental
+    views feeding per-tenant drift, drift-triggered retrains hot-swapped
+    mid-traffic, the seeded chaos schedule killing replicas and firing
+    InjectedCrash at named sites, one double-kill) — and machine-checks
+    the resulting SoakReport.  The headline number is the wall-clock
+    cost of the whole compressed day with EVERY invariant clean: zero
+    unhandled, unanswered=0, per-phase goodput over its SLO floor, every
+    kill recovered with a CRC-intact postmortem, bounded resource
+    growth, and the raw-CSV-row → promoted-model trace present.
+    ``violations`` must stay ``[]`` — a non-empty list is the regression.
+    """
+    import shutil
+    import tempfile
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.soak import (
+        SMOKE_CONFIG,
+        check_report,
+        run_soak,
+    )
+
+    platform, on_tpu, n, _, mesh, n_chips = _bench_setup(100_000)
+    work = tempfile.mkdtemp(prefix="bench_soak_")
+    try:
+        t0 = time.perf_counter()
+        payload, _path = run_soak(SMOKE_CONFIG, work)
+        wall = time.perf_counter() - t0
+        violations = check_report(payload)
+        kills = payload["kills"]
+        inter_rows = sum(p["offered_rows"] for p in payload["phases"])
+        return {
+            "metric": (
+                f"soak: compressed diurnal day wall-time, every invariant "
+                f"machine-checked ({len(SMOKE_CONFIG.phases)} phases, "
+                f"seed {SMOKE_CONFIG.seed}, {platform})"
+            ),
+            "value": round(wall, 3),
+            "unit": "s",
+            "violations": violations,        # MUST be [] — the gate
+            "clean": not violations,
+            "phases": {
+                p["name"]: {
+                    "goodput_frac": p["goodput_frac"],
+                    "floor": p["min_goodput_frac"],
+                    "offered_rows": p["offered_rows"],
+                    "unanswered": p["unanswered"],
+                }
+                for p in payload["phases"]
+            },
+            "offered_rows_total": int(inter_rows),
+            "unanswered_total": int(payload["unanswered_total"]),
+            "chaos_events": len(kills),
+            "recovered": sum(bool(k["recovered"]) for k in kills),
+            "double_kills": sum(k["kind"] == "double_kill" for k in kills),
+            "postmortems": sum(len(k.get("postmortems", [])) for k in kills),
+            "resources_bounded": bool(payload["resources"]["bounded"]),
+            "trace_spans": sorted(payload["trace"]["span_names"]),
+            "platform": platform,
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -3299,6 +3365,7 @@ CONFIGS = {
     "model_farm": lambda: _bench_model_farm(),                  # ISSUE 11 A/B
     "serve_fleet": lambda: _bench_serve_fleet(),                # ISSUE 12 fleet
     "federated": lambda: _bench_federated(),                    # ISSUE 16 silos
+    "soak": lambda: _bench_soak(),                              # ISSUE 17 day
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets

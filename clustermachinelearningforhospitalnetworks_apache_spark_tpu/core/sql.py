@@ -665,21 +665,15 @@ def _group_codes(col: np.ndarray) -> np.ndarray:
     if np.issubdtype(col.dtype, np.floating):
         return np.unique(col, return_inverse=True, equal_nan=True)[1]
     if col.dtype == object:
-        # np.unique SORTS, which raises on the None fills LEFT JOIN
-        # writes; insertion-order factorization needs no ordering and
-        # folds every null into one code
-        codes = np.empty(len(col), np.int64)
-        seen: dict = {}
-        null_code = -1
-        for i, v in enumerate(col):
-            if v is None or (isinstance(v, float) and v != v):
-                if null_code < 0:
-                    null_code = len(seen)
-                    seen["\0__null__"] = null_code
-                codes[i] = null_code
-            else:
-                codes[i] = seen.setdefault(v, len(seen))
-        return codes
+        # sorted-rank factorization shared with the compiled executor
+        # (raw np.unique would raise comparing the None fills LEFT JOIN
+        # writes against str): every null folds to ONE code sorting
+        # last, like float NaN.  Codes being order-isomorphic to the
+        # values is what lets compiled GROUP BY over strings — which
+        # encodes before filtering — land in exactly this group order.
+        from .sql_compile import string_group_codes
+
+        return string_group_codes(col)[0]
     return np.unique(col, return_inverse=True)[1]
 
 

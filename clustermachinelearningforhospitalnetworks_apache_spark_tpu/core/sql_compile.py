@@ -63,6 +63,32 @@ def bucket_for_rows(n: int) -> int:
     return b
 
 
+def string_group_codes(col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Object (string) column → ``(int64 codes, sorted distinct values)``.
+
+    A code is the value's rank among the SORTED distinct non-null
+    values; every null (``None``, or the float NaN a LEFT JOIN writes
+    into object cells) folds to the ONE code ``len(uniq)``, sorting
+    last — the slot np.unique gives float NaN.  Rank order is isomorphic
+    to the values' own lexicographic order, so a row's code never
+    depends on which *other* rows are present: the device kernel can
+    encode before filtering and its code-ascending group order still
+    matches the interpreter's post-filter order, and per-batch partials
+    in the view layer fold without any cross-batch code reconciliation.
+    This is the ONE factorization shared by the interpreter's grouping
+    identity (``sql._group_codes``) and the compiled executor.
+    """
+    null = np.fromiter(
+        (v is None or (isinstance(v, float) and v != v) for v in col),
+        bool,
+        count=len(col),
+    )
+    uniq, inv = np.unique(col[~null], return_inverse=True)
+    codes = np.full(len(col), len(uniq), dtype=np.int64)
+    codes[~null] = inv
+    return codes, uniq
+
+
 # ------------------------------------------------------- kernel registry
 #: (kind, kernel_sig, bucket) → jitted kernel.  Manual dict (not
 #: lru_cache) so the jit-cache cross-check can walk every executable.
@@ -754,7 +780,8 @@ def run_partial_aggregate(plan, table: Table, clock=None):
 
     → ``(key_arrays, acc_matrix, accs)`` where ``key_arrays`` holds one
     raw host array per group key (float64 with NaN nulls for ``f``; int64
-    for ``i``; int64 nanoseconds with the NaT sentinel for ``t``) and
+    for ``i``; int64 nanoseconds with the NaT sentinel for ``t``; object
+    values with None nulls for ``s``) and
     ``acc_matrix`` is float64 ``[n_groups, len(accs)]`` (sums of all-null
     groups come back NaN — the caller zero-gates them on the matching
     count before folding).
@@ -769,6 +796,9 @@ def run_partial_aggregate(plan, table: Table, clock=None):
             keys.append(col.astype("datetime64[ns]").view(np.int64))
         elif ch == "f":
             keys.append(np.asarray(col, dtype=np.float64))
+        elif ch == "s":
+            # already decoded by _run_aggregate: values, None for null
+            keys.append(np.asarray(col, dtype=object))
         else:
             keys.append(np.asarray(col, dtype=np.int64))
     if accs:
@@ -827,10 +857,23 @@ def _run_aggregate(plan, table: Table, clock=None) -> Table:
         "aggregate", sig, bucket, lambda: _build_aggregate(sig, bucket)
     )
     stage = clock.stage if clock is not None else (lambda _: nullcontext())
+    types = dict(plan.col_types)
+    sdicts: dict[str, np.ndarray] = {}
+
+    def operand(c: str):
+        if types.get(c) == "s":
+            # strings never transfer: encode host-side to sorted-rank
+            # int64 codes (null code = len(uniq), sorting last) and let
+            # the segment machinery group over the codes
+            codes, uniq = string_group_codes(table.column(c))
+            sdicts[c] = uniq
+            padded = np.zeros(bucket, dtype=np.int64)
+            padded[:n] = codes
+            return padded
+        return table.device_column(c, bucket)
+
     with stage("transfer"):
-        cols = tuple(
-            table.device_column(c, bucket) for c in kernel_columns(sig)
-        )
+        cols = tuple(operand(c) for c in kernel_columns(sig))
     with stage("sql"):
         with enable_x64():
             n_groups, outs = fn(np.int64(n), *cols)
@@ -846,6 +889,14 @@ def _run_aggregate(plan, table: Table, clock=None) -> Table:
             src, ch = plan.group_keys[o[1]]
             if ch == "t":
                 vals = vals.astype(np.int64).view("datetime64[ns]")
+            elif ch == "s":
+                # codes → values through the per-call dictionary; the
+                # null code (one past the last rank) decodes to None
+                uniq = sdicts[src]
+                lut = np.empty(len(uniq) + 1, dtype=object)
+                lut[: len(uniq)] = uniq
+                lut[len(uniq)] = None
+                vals = lut[vals.astype(np.int64)]
             cols_out[o[2]] = vals
         elif o[0] == "count_star":
             cols_out[o[1]] = vals.astype(np.int64)

@@ -42,6 +42,10 @@ def random_table(rng: np.random.Generator, n_rows: int | None = None) -> Table:
         _EPOCH + rng.integers(0, 7200, size=n).astype("timedelta64[s]")
     ).astype("datetime64[ns]")
     t1[rng.random(n) < 0.1] = np.datetime64("NaT")
+    s1 = np.array(
+        [f"H{int(v)}" for v in rng.integers(0, 3, size=n)], object
+    )
+    s1[rng.random(n) < 0.1] = None  # the null LEFT JOIN writes
     return Table.from_dict(
         {
             "f1": f1,
@@ -49,9 +53,7 @@ def random_table(rng: np.random.Generator, n_rows: int | None = None) -> Table:
             "i1": rng.integers(-3, 4, size=n),
             "i2": rng.integers(0, 100, size=n),
             "t1": t1,
-            "s1": np.array(
-                [f"H{int(v)}" for v in rng.integers(0, 3, size=n)], object
-            ),
+            "s1": s1,
         }
     )
 
@@ -158,7 +160,7 @@ def random_query(rng: np.random.Generator) -> QuerySpec:
         n_keys = int(rng.integers(0, 3))
         keys = tuple(
             dict.fromkeys(
-                str(rng.choice(_NUM_COLS + (_TS_COL,)))
+                str(rng.choice(_NUM_COLS + (_TS_COL, "s1")))
                 for _ in range(n_keys)
             )
         )

@@ -20,7 +20,8 @@ query runs on the numpy interpreter in ``core/sql.py``):
 * projection: ``*`` / bare columns of any type (pass-through), scalar
   expressions over numeric columns (``+ - * /``, unary minus, CASE WHEN,
   ABS, COALESCE, numeric literals)
-* GROUP BY plain numeric/timestamp key columns with COUNT(*) /
+* GROUP BY plain key columns — numeric/timestamp, and string keys via
+  host-side sorted-rank dictionary codes — with COUNT(*) /
   COUNT/SUM/AVG/MIN/MAX over numeric columns; whole-table aggregates
 * window functions: ``agg(col) OVER (PARTITION BY numeric/timestamp
   cols)`` — the whole-partition frame (no window ORDER BY)
@@ -43,7 +44,8 @@ from .sql_parse import _AGG_REF, _Query, _expr_has_agg
 
 #: dtype characters the device layer understands
 #: f = float64 (NaN null), i = int64 (null-free), t = timestamp as int64
-#: ns (NaT sentinel), s = string/object (host-only)
+#: ns (NaT sentinel), s = string/object (host-only except as a group
+#: key, where the runner ships sorted-rank codes instead of the column)
 _KIND_TO_CHAR = {"f": "f", "i": "i", "u": "i", "b": "i", "M": "t"}
 
 
@@ -476,9 +478,11 @@ def _plan_aggregate(q: _Query, low: _Lowering) -> tuple[tuple, list[tuple]]:
             raise _Unsupported(
                 "GROUP BY expressions/ordinals run on the interpreter"
             )
+        # string keys compile too: the runner host-encodes them to
+        # sorted-rank int64 codes (sql_compile.string_group_codes), so
+        # the kernel groups over codes and decodes the tiny per-group
+        # result — the column itself never transfers
         src = low.resolve(g)
-        if low.touched[src] == "s":
-            raise _Unsupported(f"GROUP BY string column {g!r}")
         keys.append((src, low.touched[src]))
     key_srcs = [s for s, _ in keys]
 

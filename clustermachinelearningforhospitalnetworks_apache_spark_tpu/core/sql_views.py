@@ -124,7 +124,8 @@ def _canon_keys(key_arrays: list, chars: list[str], n: int) -> list[tuple]:
     """Raw per-group key columns → canonical hashable tuples: each
     component ``(is_null, value)`` with floats' NaN folded to ``(1,
     0.0)`` (NaN is not equal to itself — a raw NaN key would never merge
-    across batches) and int/timestamp values as plain ints (NaT keeps
+    across batches), string values as ``(0, str)`` with the null (None)
+    as ``(1, "")``, and int/timestamp values as plain ints (NaT keeps
     its int64 sentinel, null flag 0, so it sorts first like the compiled
     executor's group order)."""
     out = []
@@ -134,6 +135,9 @@ def _canon_keys(key_arrays: list, chars: list[str], n: int) -> list[tuple]:
             if ch == "f":
                 v = float(arr[g])
                 comps.append((1, 0.0) if np.isnan(v) else (0, v))
+            elif ch == "s":
+                v = arr[g]
+                comps.append((1, "") if v is None else (0, str(v)))
             else:
                 comps.append((0, int(arr[g])))
         out.append(tuple(comps))
@@ -186,9 +190,11 @@ def _default_accs(accs: tuple) -> np.ndarray:
 
 def _group_order(keys: list[tuple], chars: list[str]) -> np.ndarray:
     """Permutation sorting canonical keys into the compiled executor's
-    group order: keys ascending, float nulls last, NaT first (its raw
-    int64 sentinel is the minimum) — ``sql_compile._segments``' lexsort
-    conventions replayed on host."""
+    group order: keys ascending, float and string nulls last, NaT first
+    (its raw int64 sentinel is the minimum) — ``sql_compile._segments``'
+    lexsort conventions replayed on host (string keys are grouped on
+    device as sorted-rank codes with the null code last, so value order
+    with the null flag dominating replays it exactly)."""
     if not keys:
         return np.empty(0, dtype=np.int64)
     if not chars:
@@ -197,6 +203,9 @@ def _group_order(keys: list[tuple], chars: list[str]) -> np.ndarray:
     for c in reversed(range(len(chars))):  # lexsort: LAST key is primary
         if chars[c] == "f":
             comps.append(np.array([k[c][1] for k in keys], dtype=np.float64))
+            comps.append(np.array([k[c][0] for k in keys], dtype=bool))
+        elif chars[c] == "s":
+            comps.append(np.array([k[c][1] for k in keys], dtype="U"))
             comps.append(np.array([k[c][0] for k in keys], dtype=bool))
         else:
             comps.append(np.array([k[c][1] for k in keys], dtype=np.int64))
@@ -229,6 +238,11 @@ def _finalize_aggregate(
             elif ch == "t":
                 v = np.array([k[idx][1] for k in keys], dtype=np.int64)
                 cols[alias] = v.view("datetime64[ns]")
+            elif ch == "s":
+                v = np.empty(len(keys), dtype=object)
+                for i, k in enumerate(keys):
+                    v[i] = None if k[idx][0] else k[idx][1]
+                cols[alias] = v
             else:
                 cols[alias] = np.array(
                     [k[idx][1] for k in keys], dtype=np.int64
@@ -967,12 +981,15 @@ class MaterializedView:
         chars = payload.get("key_chars", "")
 
         def keys_load(ks):
+            def comp(c, ch):
+                if ch == "f":
+                    return (int(c[0]), float(c[1]))
+                if ch == "s":
+                    return (int(c[0]), str(c[1]))
+                return (int(c[0]), int(c[1]))
+
             return [
-                tuple(
-                    (int(c[0]), float(c[1]) if ch == "f" else int(c[1]))
-                    for c, ch in zip(k, chars)
-                )
-                for k in ks
+                tuple(comp(c, ch) for c, ch in zip(k, chars)) for k in ks
             ]
 
         self._last_applied = int(payload.get("last_applied", -1))

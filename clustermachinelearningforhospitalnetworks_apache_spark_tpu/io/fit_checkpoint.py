@@ -256,6 +256,10 @@ class FitCheckpointer:
         commit_path = os.path.join(self.path, COMMIT_FILE)
         if not os.path.exists(commit_path):
             return None
+        # the double-kill site: a crash here is a crash DURING recovery —
+        # the commit record and retained steps are untouched, so a second
+        # resume must land on the identical step
+        fault_point("fit_ckpt.resume", path=self.path)
         with open(commit_path) as f:
             commit = json.load(f)
         if commit.get("signature") != self.signature:

@@ -68,6 +68,7 @@ REGISTERED_SPANS = (
     "router.route",      # the routing decision (policy, chosen replica)
     "obs.demo",          # example/bench root spans
     "fed.round",         # one federated fit round: collect→merge→fit→broadcast
+    "soak.run",          # one compressed-day soak run (root of the E2E trace)
 )
 
 #: fault site (fnmatch glob) → the registered span that encloses or
@@ -94,6 +95,10 @@ SITE_COVERAGE = {
     "fleet.swap.*": "fleet.promote",
     "sql.view.maintain": "sql.view.maintain",
     "fed.round.*": "fed.round",
+    "soak.schedule.tick": "soak.run",      # chaos-event dispatch point
+    "soak.phase.transition": "soak.run",   # diurnal phase boundary
+    "soak.report.commit": "soak.run",      # SoakReport atomic-write commit
+    "soak.replica.kill": "soak.run",       # replica-kill postmortem notify
 }
 
 _CTX: contextvars.ContextVar = contextvars.ContextVar("obs_trace", default=None)

@@ -13,6 +13,8 @@ uniform surface over many executors, applied to serving:
   promotion, replica kill/drain, pull-collector health
 * :mod:`loadgen`   — replayable open-loop Poisson load (diurnal bursts,
   fixed tenant mix) for the ``serve_fleet`` bench
+* :mod:`watchdog`  — busy-but-no-progress stall detection; a wedge
+  becomes a ``watchdog.stall`` flight dump + :class:`StallError`
 
 See docs/ARCHITECTURE.md §Serving fleet.
 """
@@ -45,6 +47,7 @@ from .router import (
     POLICY_LEAST_LOADED,
     Router,
 )
+from .watchdog import StallError, StallWatchdog
 
 __all__ = [
     "AdmissionController",
@@ -72,6 +75,8 @@ __all__ = [
     "SLO_BEST_EFFORT",
     "SLO_INTERACTIVE",
     "SLO_SHED_ORDER",
+    "StallError",
+    "StallWatchdog",
     "TenantMix",
     "TokenBucket",
     "build_schedule",

@@ -180,6 +180,7 @@ def replay(
     speed: float = 1.0,
     wait_timeout_s: float = 10.0,
     mid_hook: Callable[[], None] | None = None,
+    events: Sequence[tuple[float, Callable[[], None]]] | None = None,
 ) -> dict:
     """Drive a schedule open-loop against ``submit`` and tally the
     answers.
@@ -189,19 +190,30 @@ def replay(
     fleet's and server's ``submit`` both qualify.  ``speed`` compresses
     the schedule's time axis (10.0 = drive a 30 s profile in 3 s).
     ``mid_hook`` fires once just past the schedule midpoint — the chaos
-    lever (kill a replica mid-load).  Pacing lag is measured and
-    reported: if this host can't generate the offered rate, the report
-    says so instead of silently measuring a slower load.
+    lever (kill a replica mid-load).  ``events`` generalizes it: a
+    sequence of ``(t, fn)`` in *schedule* time (same axis as
+    ``Arrival.t``), each fired exactly once when the replay clock
+    reaches ``t`` — ordered interleaving with arrivals is deterministic
+    for a fixed schedule, which is what makes a seeded chaos schedule
+    replayable.  Events left after the last arrival fire before harvest.
+    Pacing lag is measured and reported: if this host can't generate the
+    offered rate, the report says so instead of silently measuring a
+    slower load.
     """
     per_class: dict[str, ClassReport] = {}
     pending: list[tuple[Arrival, object]] = []
     n = len(schedule)
     mid_at = n // 2
+    ev = sorted(events, key=lambda e: e[0]) if events else []
+    ev_next = 0
     max_lag = 0.0
     t0 = time.perf_counter()
     for i, a in enumerate(schedule):
         if mid_hook is not None and i == mid_at:
             mid_hook()
+        while ev_next < len(ev) and ev[ev_next][0] <= a.t:
+            ev[ev_next][1]()
+            ev_next += 1
         target = t0 + a.t / speed
         now = time.perf_counter()
         if target > now:
@@ -209,6 +221,9 @@ def replay(
         else:
             max_lag = max(max_lag, now - target)
         pending.append((a, submit(a)))
+    while ev_next < len(ev):
+        ev[ev_next][1]()
+        ev_next += 1
     gen_wall = time.perf_counter() - t0
     # harvest: open loop never waited mid-stream, so waits happen here;
     # answers arrive roughly FIFO, making sequential waits cheap

@@ -187,16 +187,18 @@ class ReplicaSet:
             devices = jax.devices()
         self.placement = placement or EvenPlacement()
         self.slices = self.placement.assign(n_replicas, devices)
+        #: per-replica server recipe, kept so revive_replica can rebuild
+        #: a dead replica's server bit-for-bit on its original slice
+        self._server_kw = dict(
+            max_queue_rows=max_queue_rows,
+            max_wait_s=max_wait_s,
+            breaker_failure_threshold=breaker_failure_threshold,
+            breaker_recovery_s=breaker_recovery_s,
+        )
         self._replicas = [
             Replica(
                 s.replica_id, s,
-                InferenceServer(
-                    max_queue_rows=max_queue_rows,
-                    max_wait_s=max_wait_s,
-                    breaker_failure_threshold=breaker_failure_threshold,
-                    breaker_recovery_s=breaker_recovery_s,
-                    device=s.primary,
-                ),
+                InferenceServer(device=s.primary, **self._server_kw),
             )
             for s in self.slices
         ]
@@ -215,6 +217,9 @@ class ReplicaSet:
         self.registry = _FleetModelView(self)
         self._model_names: set[str] = set()
         self._fallbacks: dict[str, Any] = {}
+        #: name → the add/swap arguments a revived replica re-registers
+        self._model_specs: dict[str, dict] = {}
+        self._lifecycle = None
         self._swap_lock = threading.Lock()
         self._started = False
         #: front-door fast lane: the per-SLO metric label keys are
@@ -259,6 +264,11 @@ class ReplicaSet:
             )
         self._model_names.add(name)
         self._fallbacks[name] = fallback
+        self._model_specs[name] = dict(
+            model=model, n_features=n_features, buckets=buckets,
+            fallback=fallback, data_profile=data_profile,
+            guard_kw=dict(guard_kw),
+        )
 
     def swap_model(
         self,
@@ -305,6 +315,13 @@ class ReplicaSet:
             if sp.trace_id is not None:
                 sp.note("replicas", len(swapped))
         self._model_names.add(name)
+        prev = self._model_specs.get(name, {})
+        self._model_specs[name] = dict(
+            model=model, n_features=n_features,
+            buckets=buckets if buckets is not None else prev.get("buckets"),
+            fallback=prev.get("fallback"), data_profile=data_profile,
+            guard_kw=prev.get("guard_kw", {}),
+        )
         log.info(
             "fleet-wide hot swap", model=name, replicas=len(swapped),
         )
@@ -314,6 +331,7 @@ class ReplicaSet:
         """Wire one lifecycle controller into every replica's request
         path (canary routing, shadow/drift observation) — the controller
         aggregates across replicas through its own locks."""
+        self._lifecycle = controller
         for r in self._replicas:
             r.server.attach_lifecycle(controller)
 
@@ -356,6 +374,39 @@ class ReplicaSet:
         r.server.stop()
         self.metrics.inc("fleet.replicas_killed")
         log.warning("replica killed", replica=index)
+
+    def revive_replica(self, index: int) -> None:
+        """Bring a dead replica back: rebuild its server from the stored
+        recipe on its ORIGINAL device slice, re-register every served
+        model from the fleet's model specs (so it serves exactly what its
+        live peers serve, including post-kill hot swaps), and rejoin the
+        ring.  Consistent-hash tenants that failed over clockwise come
+        home on their next request — the recovery half of the chaos
+        surface :meth:`kill_replica` opens."""
+        r = self._replicas[index]
+        if r.state != REPLICA_DEAD:
+            raise ValueError(
+                f"replica {index} is {r.state!r}, not dead — revive is "
+                "only defined for killed/drained replicas"
+            )
+        server = InferenceServer(device=r.slice.primary, **self._server_kw)
+        for name, spec in list(self._model_specs.items()):
+            server.add_model(
+                name, spec["model"], n_features=spec["n_features"],
+                buckets=spec["buckets"] or DEFAULT_BUCKETS,
+                fallback=spec["fallback"],
+                data_profile=spec["data_profile"], **spec["guard_kw"],
+            )
+        if self._lifecycle is not None:
+            server.attach_lifecycle(self._lifecycle)
+        if self._started:
+            server.start()
+        # old server already stopped by kill/drain; swap in place — the
+        # Replica object (and its registered collector) stays the same
+        r.server = server
+        r.state = REPLICA_LIVE
+        self.metrics.inc("fleet.replicas_revived")
+        log.info("replica revived", replica=index)
 
     def drain_replica(self, index: int, timeout_s: float = 5.0) -> bool:
         """Graceful removal, phase 1: stop routing new work to the
@@ -684,6 +735,7 @@ class ReplicaSet:
             "rerouted": int(c.get("fleet.rerouted", 0)),
             "promotions": int(c.get("fleet.promotions", 0)),
             "replicas_killed": int(c.get("fleet.replicas_killed", 0)),
+            "replicas_revived": int(c.get("fleet.replicas_revived", 0)),
             "fallback_answers": int(c.get("serve.fallback_answers", 0)),
             "drift_trips": int(c.get("serve.drift_trips", 0)),
             "queue_rows_total": sum(

@@ -1,0 +1,30 @@
+"""Compressed-production-day soak harness (ISSUE 17).
+
+One seeded run drives every subsystem together — dirty multi-hospital
+CSVs through the firewall into the unbounded table, incremental views
+feeding per-tenant drift, a multi-tenant farm served by a replica fleet
+under open-loop diurnal load, drifted-subset retrains hot-swapped
+mid-traffic — while a seeded, replayable chaos schedule kills replicas
+and fires ``InjectedCrash`` at named sites (including a double-kill: a
+crash during crash recovery).  The run's verdict is a single
+machine-checked :class:`~.report.SoakReport` (CRC-wrapped JSON, same
+discipline as flight-recorder dumps).
+
+Entry points: :func:`~.driver.run_soak` (library),
+``tools/soak.py`` (CLI), ``tools/run_chaos.sh --soak`` (CI leg).
+"""
+
+from .schedule import (  # noqa: F401
+    ChaosEvent,
+    DiurnalPhase,
+    KIND_CRASH,
+    KIND_DOUBLE_KILL,
+    KIND_KILL,
+    KIND_REVIVE,
+    SMOKE_CONFIG,
+    SoakConfig,
+    build_chaos_schedule,
+)
+from .report import check_report, read_report, write_report  # noqa: F401
+from .resource_probe import ResourceProbe  # noqa: F401
+from .driver import run_soak  # noqa: F401

@@ -1,0 +1,222 @@
+"""SoakReport: one CRC-wrapped, machine-checked verdict per soak run.
+
+The report is the run's ONLY pass/fail surface — no grepping logs, no
+eyeballing dashboards.  ``write_report`` wraps the payload exactly like
+a flight-recorder dump (``{"crc32c": <hex>, "payload": {...}}`` over
+canonical JSON, atomic tmp+fsync+rename), so the same tamper/torn-write
+guarantees hold and the chaos leg's verification block can reuse one
+reading discipline for both artifact kinds.  ``check_report`` is the
+machine check: every invariant the acceptance criteria name, as code,
+returning the (hopefully empty) violation list.
+
+Invariants checked (ISSUE 17 acceptance):
+
+1.  zero unhandled exceptions anywhere in the run;
+2.  ``unanswered == 0`` — every request answered or cleanly shed,
+    overall and per phase;
+3.  interactive goodput within SLO at every diurnal phase
+    (in-SLO rows / offered rows ≥ the phase's floor);
+4.  every injected kill recovered, with a CRC-intact postmortem dump
+    tagged with the killing site;
+5.  at least one double-kill (a crash inside crash recovery), both of
+    its crashes recovered, and the twice-restarted fit bit-identical to
+    an uninterrupted run;
+6.  memory / disk / metric-cardinality / flight-ring growth bounded
+    (the resource probe's verdict);
+7.  one trace id follows a raw CSV row through ingest → view
+    maintenance → retrain → fleet promotion;
+8.  replayability: the chaos schedule embedded in the report equals the
+    one re-derived from the embedded config's seed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..io.fit_checkpoint import fsync_dir
+from ..io.integrity import crc32c_hex
+from ..utils.faults import fault_point
+from .schedule import SoakConfig, build_chaos_schedule
+
+SCHEMA_VERSION = 1
+
+#: the span chain invariant 7 requires under the report's trace id
+REQUIRED_TRACE_SPANS = (
+    "stream.batch", "sql.view.maintain", "lifecycle.retrain",
+    "fleet.promote",
+)
+
+
+def _canonical(payload: dict) -> str:
+    return json.dumps(
+        payload, sort_keys=True, separators=(",", ":"), default=str
+    )
+
+
+def write_report(payload: dict, path: str) -> str:
+    """Atomically write the CRC-wrapped report; returns ``path``."""
+    body = _canonical(payload)
+    record = {"crc32c": crc32c_hex(body.encode()), "payload": payload}
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    fault_point("soak.report.commit", path=path)
+    with open(tmp, "w") as f:
+        json.dump(record, f, sort_keys=True, separators=(",", ":"),
+                  default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(parent)
+    return path
+
+
+def read_report(path: str) -> dict:
+    """Load + CRC-verify one report; ``ValueError`` on tamper/torn."""
+    with open(path) as f:
+        record = json.load(f)
+    if not isinstance(record, dict) or "payload" not in record:
+        raise ValueError(f"{path}: not a SoakReport record")
+    got = crc32c_hex(_canonical(record["payload"]).encode())
+    want = record.get("crc32c")
+    if got != want:
+        raise ValueError(
+            f"{path}: crc32c mismatch ({got} computed, {want} recorded)"
+        )
+    return record["payload"]
+
+
+def check_report(payload: dict, verify_postmortems: bool = True) -> list[str]:
+    """Machine-check every invariant; → violation list (empty = pass).
+
+    ``verify_postmortems=False`` skips re-reading dump files from disk
+    (for checking a report that moved hosts); everything in-payload is
+    still checked."""
+    v: list[str] = []
+
+    # 1. zero unhandled exceptions
+    unhandled = payload.get("unhandled", None)
+    if unhandled is None:
+        v.append("report carries no 'unhandled' record")
+    elif unhandled:
+        v.append(f"{len(unhandled)} unhandled exception(s): {unhandled[:3]}")
+
+    # 2./3. per-phase answers + goodput
+    phases = payload.get("phases", [])
+    if not phases:
+        v.append("report carries no phases")
+    for p in phases:
+        name = p.get("name", "?")
+        ua = int(p.get("unanswered", -1))
+        if ua != 0:
+            v.append(f"phase {name}: unanswered={ua} (must be 0)")
+        frac = p.get("goodput_frac")
+        floor = p.get("min_goodput_frac")
+        if frac is None or floor is None:
+            v.append(f"phase {name}: goodput accounting missing")
+        elif frac < floor:
+            v.append(
+                f"phase {name}: in-SLO goodput {frac:.3f} below the "
+                f"{floor:.2f} floor"
+            )
+    if int(payload.get("unanswered_total", -1)) != 0:
+        v.append(
+            f"unanswered_total={payload.get('unanswered_total')} (must be 0)"
+        )
+
+    # 4. every injected kill recovered, postmortem CRC-intact + site-tagged
+    kills = payload.get("kills", [])
+    if not kills:
+        v.append("no chaos events recorded — the schedule never ran")
+    for k in kills:
+        label = k.get("label", "?")
+        if not k.get("recovered"):
+            v.append(f"chaos event {label}: not recovered")
+        for pm in k.get("postmortems", []):
+            pm_path, pm_site = pm.get("path"), pm.get("site")
+            if not pm_path:
+                v.append(f"chaos event {label}: postmortem path missing")
+                continue
+            if not pm_site:
+                v.append(f"chaos event {label}: postmortem has no site tag")
+            if verify_postmortems:
+                try:
+                    from ..obs.flight_recorder import read_dump
+
+                    dump = read_dump(pm_path)
+                except (OSError, ValueError) as e:
+                    v.append(
+                        f"chaos event {label}: postmortem unreadable ({e})"
+                    )
+                    continue
+                if dump.get("site") != pm_site:
+                    v.append(
+                        f"chaos event {label}: dump tagged "
+                        f"{dump.get('site')!r}, report says {pm_site!r}"
+                    )
+
+    # 5. the double-kill: present, both crashes recovered, bit-identical
+    dk = [k for k in kills if k.get("kind") == "double_kill"]
+    if not dk:
+        v.append("no double-kill executed (≥1 required)")
+    for k in dk:
+        if len(k.get("postmortems", [])) < 2:
+            v.append(
+                "double-kill left fewer than 2 postmortems — the second "
+                "crash (inside recovery) never fired"
+            )
+        if not k.get("bit_identical"):
+            v.append(
+                "double-kill: twice-restarted fit is NOT bit-identical "
+                "to the uninterrupted run"
+            )
+
+    # 6. bounded growth
+    res = payload.get("resources", {})
+    if not res.get("bounded"):
+        for r in res.get("violations", ["resource verdict missing"]):
+            v.append(f"resources: {r}")
+
+    # 7. the end-to-end trace
+    tr = payload.get("trace", {})
+    if not tr.get("trace_id"):
+        v.append("no end-to-end trace id recorded")
+    else:
+        have = set(tr.get("span_names", []))
+        missing = [s for s in REQUIRED_TRACE_SPANS if s not in have]
+        if missing:
+            v.append(
+                f"trace {tr['trace_id']}: span chain incomplete, "
+                f"missing {missing}"
+            )
+        if not tr.get("csv_file"):
+            v.append("trace does not name the raw CSV it started from")
+        if not tr.get("promoted_model"):
+            v.append("trace does not name the promoted model artifact")
+
+    # 8. replayability: re-derive the chaos schedule from the embedded
+    # config — same seed must mean the same kills in the same order
+    cfg_d = payload.get("config")
+    if not cfg_d:
+        v.append("report carries no config — the run is not replayable")
+    else:
+        try:
+            rebuilt = [
+                e.to_dict() for e in
+                build_chaos_schedule(SoakConfig.from_dict(cfg_d))
+            ]
+        except (TypeError, ValueError) as e:
+            rebuilt = None
+            v.append(f"embedded config does not rebuild: {e}")
+        if rebuilt is not None and rebuilt != payload.get("chaos_schedule"):
+            v.append(
+                "chaos schedule in the report differs from the one "
+                "re-derived from its seed — the run is not replayable"
+            )
+
+    if int(payload.get("version", -1)) != SCHEMA_VERSION:
+        v.append(
+            f"schema version {payload.get('version')} != {SCHEMA_VERSION}"
+        )
+    return v

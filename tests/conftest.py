@@ -122,6 +122,13 @@ def pytest_configure(config):
         "bit-parity per family, quorum/dropout ladder, round-journal "
         "resume (pytest -m federated)",
     )
+    config.addinivalue_line(
+        "markers",
+        "soak: compressed-production-day chaos soak tests — the smoke "
+        "run's machine-checked SoakReport, schedule replayability, "
+        "report CRC discipline (pytest -m soak; tools/soak.py --full "
+        "for the slow shape)",
+    )
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (  # noqa: E402
     build_mesh,
     set_default_mesh,

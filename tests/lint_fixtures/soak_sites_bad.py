@@ -1,0 +1,17 @@
+"""Fixture: soak chaos-dispatch sites with ONE deleted —
+``soak.schedule.tick`` has no reachable ``fault_point`` call, so the
+chaos schedule can no longer inject at the dispatcher (rule 7,
+``required-site-missing``: absence of a load-bearing site is a finding,
+the inverse direction of rule 1)."""
+
+
+def fault_point(site, **ctx):
+    pass
+
+
+def phase_boundary(phase):
+    fault_point("soak.phase.transition", phase=phase)
+
+
+def commit_report(path):
+    fault_point("soak.report.commit", path=path)

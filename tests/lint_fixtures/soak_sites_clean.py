@@ -1,0 +1,18 @@
+"""Fixture: all three soak chaos-dispatch sites reachable — rule 7
+(``required-site-missing``) stays quiet."""
+
+
+def fault_point(site, **ctx):
+    pass
+
+
+def dispatch_tick(event):
+    fault_point("soak.schedule.tick", event=event)
+
+
+def phase_boundary(phase):
+    fault_point("soak.phase.transition", phase=phase)
+
+
+def commit_report(path):
+    fault_point("soak.report.commit", path=path)

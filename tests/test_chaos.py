@@ -206,6 +206,41 @@ def test_fit_killed_mid_checkpoint_resumes_bit_identical(
     np.testing.assert_array_equal(resumed.cluster_sizes, ref.cluster_sizes)
 
 
+def test_double_kill_crash_during_crash_recovery(tmp_path, mesh8, fit_data):
+    """ISSUE 17 satellite: the double-kill — a second ``InjectedCrash``
+    fired at ``fit_ckpt.resume`` WHILE the ladder is recovering from the
+    first kill.  The twice-restarted fit must still land bit-identical
+    to the uninterrupted run."""
+    def est(ckpt_dir):
+        return KMeans(
+            k=4, seed=0, max_iter=6, tol=0.0,
+            checkpoint_dir=str(ckpt_dir), checkpoint_every=1,
+        )
+
+    ref = est(tmp_path / "ref").fit(fit_data, mesh=mesh8)
+
+    plan = faults.FaultPlan()
+    # after=1: commit #0 must land first — resume() bails out before its
+    # own fault site when no commit record exists, so a crash on the very
+    # first commit could never be followed by a crash inside recovery
+    plan.crash("fit_ckpt.save.commit", after=1)
+    plan.crash("fit_ckpt.resume")
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash) as e1:
+            est(tmp_path / "crashed").fit(fit_data, mesh=mesh8)
+        assert e1.value.site == "fit_ckpt.save.commit"
+        # the second incarnation dies INSIDE recovery, at the resume site
+        with pytest.raises(faults.InjectedCrash) as e2:
+            est(tmp_path / "crashed").fit(fit_data, mesh=mesh8)
+        assert e2.value.site == "fit_ckpt.resume"
+        assert plan.fired("fit_ckpt.save.commit") == 1
+        assert plan.fired("fit_ckpt.resume") == 1
+        # the third incarnation recovers the recovery and completes
+        resumed = est(tmp_path / "crashed").fit(fit_data, mesh=mesh8)
+    np.testing.assert_array_equal(resumed.cluster_centers, ref.cluster_centers)
+    np.testing.assert_array_equal(resumed.cluster_sizes, ref.cluster_sizes)
+
+
 # ================================================================ save kills
 SAVE_SITES = ["model_io.save.arrays", "model_io.save.meta", "model_io.save.swap"]
 

@@ -410,8 +410,8 @@ def test_fleet_exposes_the_lifecycle_controller_surface(model):
 HEALTH_KEYS = {
     "status", "started", "replicas", "models_serving", "requests",
     "served_requests", "shed", "shed_quota", "shed_load", "no_replica",
-    "rerouted", "promotions", "replicas_killed", "fallback_answers",
-    "drift_trips", "queue_rows_total", "load_factor",
+    "rerouted", "promotions", "replicas_killed", "replicas_revived",
+    "fallback_answers", "drift_trips", "queue_rows_total", "load_factor",
 }
 
 REPLICA_KEYS = {"state", "queue_rows", "breakers"}
@@ -568,6 +568,77 @@ def test_drain_replica_answers_everything_then_stops(model):
         assert fs.replicas[0].state == "dead"
         # survivors keep serving
         assert fs.predict("los", np.zeros((2, D), np.float32)).ok
+
+
+@pytest.mark.chaos
+def test_revive_replica_serves_current_model_and_tenants_come_home(model, xy):
+    """ISSUE 17: the recovery half of the kill chaos surface.  Kill a
+    replica, hot-swap the fleet WHILE it is dead, then revive it: the
+    revived replica rebuilds from the fleet's model specs (it serves the
+    post-kill swap, not the model it died with), rejoins the hash ring
+    so failed-over tenants come home, and health counts the revival."""
+    x, y = xy
+    fs = make_fleet(model, n=3)
+    with fs:
+        tenants = [f"H{i:03d}" for i in range(60)]
+        home = {
+            t: fs.router.route(tenant_id=t, model="los").index
+            for t in tenants
+        }
+        victims = [t for t in tenants if home[t] == 1]
+        assert victims  # hash spreads over 3 replicas
+        fs.kill_replica(1)
+        over = {
+            t: fs.router.route(tenant_id=t, model="los").index
+            for t in tenants
+        }
+        assert all(over[t] != 1 for t in victims)
+        successor = ht.LinearRegression(reg_param=0.7).fit((x, y))
+        fs.swap_model("los", successor)  # promotes around the corpse
+        fs.revive_replica(1)
+        assert fs.replicas[1].state == "live"
+        assert fs.replicas[1].server.registry.get("los").model is successor
+        back = {
+            t: fs.router.route(tenant_id=t, model="los").index
+            for t in tenants
+        }
+        assert back == home  # every failed-over tenant came home
+        res = fs.predict(
+            "los", np.zeros((2, D), np.float32), tenant_id=victims[0]
+        )
+        assert res.ok, res.status
+        h = fs.health()
+        assert h["replicas"]["r01"]["state"] == "live"
+        assert h["replicas_killed"] == 1
+        assert h["replicas_revived"] == 1
+        assert h["status"] == "ok"
+        # revive is only defined for dead replicas — a live one refuses
+        with pytest.raises(ValueError, match="not dead"):
+            fs.revive_replica(1)
+
+
+def test_replay_events_fire_once_in_schedule_order(model):
+    """The seeded-chaos lever ISSUE 17 adds to the load generator:
+    ``events`` are (t, fn) in schedule time, fired exactly once each,
+    deterministically interleaved with arrivals — and events past the
+    last arrival still fire before harvest."""
+    fs = make_fleet(model, n=2)
+    sched = F.build_schedule(_profile(seed=2, base_rate_rps=200.0), 1.0)
+    fired: list = []
+    events = [
+        (0.25, lambda: fired.append(0.25)),
+        (0.5, lambda: fired.append(0.5)),
+        (0.0, lambda: fired.append(0.0)),
+        (99.0, lambda: fired.append(99.0)),  # after the last arrival
+    ]
+    with fs:
+        rep = F.replay(
+            lambda a: fs.submit("los", np.zeros((a.rows, D), np.float32),
+                                tenant_id=a.tenant_id, slo=a.slo),
+            sched, speed=4.0, events=events,
+        )
+    assert fired == [0.0, 0.25, 0.5, 99.0]  # sorted, each exactly once
+    assert rep["unanswered"] == 0
 
 
 # =========================================================================

@@ -157,6 +157,37 @@ def test_obs_alias_and_forwarding_resolve(tmp_path):
     )
 
 
+def test_required_soak_sites_must_stay_reachable(tmp_path):
+    """ISSUE 17 satellite: rule 7 (``required-site-missing``) — the soak
+    harness's chaos-dispatch fault sites are load-bearing for the chaos
+    matrix, so a site going UNREACHABLE (deleted hook call) is itself a
+    finding, not just a site existing without coverage.  Completeness
+    rules need ``complete=True``; other obs_coverage rules fire over the
+    minimal tree too, so assert on the one rule under test."""
+    report = run_fixture(
+        tmp_path, "soak_sites_bad.py", ["obs_coverage"],
+        dest=f"{PKG}/soak", with_trace=True, complete=True,
+    )
+    missing = [
+        f for f in report.active if f.rule == "required-site-missing"
+    ]
+    assert any("soak.schedule.tick" in f.message for f in missing), (
+        "deleting the dispatcher's fault_point must fire "
+        f"required-site-missing; got {[f.message[:60] for f in missing]}"
+    )
+    # the two sites still present must NOT be flagged
+    assert not any("soak.phase.transition" in f.message for f in missing)
+    assert not any("soak.report.commit" in f.message for f in missing)
+
+    report = run_fixture(
+        tmp_path, "soak_sites_clean.py", ["obs_coverage"],
+        dest=f"{PKG}/soak", with_trace=True, complete=True,
+    )
+    assert not [
+        f for f in report.active if f.rule == "required-site-missing"
+    ], "all three soak sites reachable: rule 7 must stay quiet"
+
+
 # ============================================================= suppressions
 def test_suppression_with_reason_silences(tmp_path):
     report = run_fixture(tmp_path, "suppress_ok.py", ["determinism"])

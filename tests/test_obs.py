@@ -567,6 +567,73 @@ class TestFlightRecorder:
         assert rec.dump_failures == 1
 
 
+class TestFlightRecorderUnderLoad:
+    """ISSUE 17 satellite: a soak-length run must not let observability
+    itself become the resource leak — the ring and the metric series
+    set stay bounded across ≥10k spans, and postmortem dumps fired
+    concurrently (two replicas dying at once) never collide."""
+
+    def _series_count(self) -> int:
+        snap = obs_export.json_snapshot()
+        return sum(
+            len(snap.get(kind, {}))
+            for kind in ("counters", "gauges", "histograms")
+        )
+
+    def test_ring_and_metric_cardinality_bounded_over_10k_spans(
+        self, flight, tracer
+    ):
+        names = (
+            "stream.batch", "sql.view.maintain",
+            "lifecycle.retrain", "serve.request",
+        )
+        mid_series = mid_ring = None
+        for i in range(10_000):
+            with obs_trace.span(names[i % len(names)], {"i": i}):
+                pass
+            if i == 4_999:  # past any warmup: cardinality must be flat
+                mid_series = self._series_count()
+                mid_ring = len(flight.events)
+        assert tracer.emitted == 10_000
+        assert len(flight.events) <= flight.capacity
+        assert mid_ring <= flight.capacity
+        # a per-span (id-keyed) metric would grow the series set by
+        # thousands between the half-way mark and the end
+        assert self._series_count() == mid_series
+        # and a dump fired AFTER the flood still round-trips CRC-intact
+        path = obs_flight.notify("test_trigger", "load.after_flood")
+        assert obs_flight.read_dump(path)["site"] == "load.after_flood"
+
+    def test_concurrent_crash_dumps_never_collide(self, flight):
+        import threading
+
+        paths: list = []
+        lock = threading.Lock()
+
+        def die_repeatedly(t):
+            for j in range(10):
+                p = obs_flight.notify(
+                    "injected_crash", f"load.site.t{t}", burst=j
+                )
+                with lock:
+                    paths.append(p)
+
+        threads = [
+            threading.Thread(target=die_repeatedly, args=(t,))
+            for t in range(8)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(paths) == 80 and None not in paths
+        assert len(set(paths)) == 80  # no two dumps shared a file
+        for p in paths:
+            payload = obs_flight.read_dump(p)  # every one CRC-intact
+            assert payload["reason"] == "injected_crash"
+            assert payload["site"].startswith("load.site.t")
+
+
 # ================================================================== static check
 def test_check_obs_static_coverage():
     """Instrumentation cannot silently drift: every fault site and

@@ -1,0 +1,353 @@
+"""Compressed-production-day soak (ISSUE 17).
+
+One seeded smoke run (``SMOKE_CONFIG``, ~10 schedule-seconds at 2x)
+drives every subsystem together, and the suite asserts its one output
+artifact — the CRC-wrapped, machine-checked SoakReport — is clean:
+zero unhandled exceptions, unanswered=0 per phase, goodput within each
+phase's SLO floor, every injected kill recovered with a CRC-intact
+site-tagged postmortem, at least one double-kill (crash during crash
+recovery) with the twice-restarted fit bit-identical, bounded
+memory/disk/metric-cardinality growth, and one trace id followed from
+a raw CSV row to the promoted model.
+
+Also here: the chaos schedule's replayability contract (same seed →
+same kills in the same order; the structural invariants every schedule
+keeps), the report's flight-recorder-grade CRC discipline (round-trip,
+tamper detection), ``check_report``'s teeth (one doctored payload per
+invariant, each caught), and the stall watchdog's verdict ladder
+(progress → clean, busy-no-progress → StallError + dump, idle ≠ stall).
+"""
+
+import copy
+import json
+import time
+
+import pytest
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs import (
+    flight_recorder as flight,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.fleet.watchdog import (
+    StallError,
+    StallWatchdog,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.soak import (
+    KIND_DOUBLE_KILL,
+    KIND_KILL,
+    KIND_REVIVE,
+    SMOKE_CONFIG,
+    SoakConfig,
+    build_chaos_schedule,
+    check_report,
+    read_report,
+    run_soak,
+    write_report,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.soak.report import (
+    REQUIRED_TRACE_SPANS,
+    SCHEMA_VERSION,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.soak.schedule import (
+    CRASH_SITES,
+    full_config,
+)
+
+pytestmark = pytest.mark.soak
+
+
+# --------------------------------------------------------------------------
+# the smoke run — ONE run per module, every report assertion reads it
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke(tmp_path_factory):
+    wd = tmp_path_factory.mktemp("soak_smoke")
+    payload, path = run_soak(SMOKE_CONFIG, str(wd))
+    return payload, path
+
+
+def test_smoke_report_machine_checks_clean(smoke):
+    payload, _ = smoke
+    assert check_report(payload) == []
+
+
+def test_smoke_every_chaos_kind_ran_and_recovered(smoke):
+    payload, _ = smoke
+    kills = payload["kills"]
+    kinds = {k["kind"] for k in kills}
+    assert {KIND_KILL, KIND_REVIVE, KIND_DOUBLE_KILL} <= kinds
+    assert all(k["recovered"] for k in kills)
+    # every non-revive event left at least one CRC-intact postmortem
+    # whose embedded site matches the report's tag
+    for k in kills:
+        if k["kind"] == KIND_REVIVE:
+            continue
+        assert k["postmortems"], k["label"]
+        for pm in k["postmortems"]:
+            dump = flight.read_dump(pm["path"])
+            assert dump["site"] == pm["site"]
+
+
+def test_smoke_double_kill_is_a_crash_inside_recovery(smoke):
+    payload, _ = smoke
+    dk = [k for k in payload["kills"] if k["kind"] == KIND_DOUBLE_KILL]
+    assert len(dk) >= 1
+    for k in dk:
+        sites = [pm["site"] for pm in k["postmortems"]]
+        # first crash in the checkpoint commit, second inside resume
+        assert "fit_ckpt.save.commit" in sites
+        assert "fit_ckpt.resume" in sites
+        assert k["bit_identical"] is True
+
+
+def test_smoke_phase_slos_and_trace_chain(smoke):
+    payload, _ = smoke
+    names = [p["name"] for p in payload["phases"]]
+    assert names == [p.name for p in SMOKE_CONFIG.phases]
+    for p in payload["phases"]:
+        assert p["unanswered"] == 0
+        assert p["goodput_frac"] >= p["min_goodput_frac"]
+    assert payload["unanswered_total"] == 0
+    tr = payload["trace"]
+    assert tr["trace_id"]
+    assert set(REQUIRED_TRACE_SPANS) <= set(tr["span_names"])
+    assert tr["csv_file"].endswith(".csv")
+    assert tr["promoted_model"]
+
+
+def test_smoke_report_crc_round_trip(smoke):
+    payload, path = smoke
+    assert read_report(path) == json.loads(json.dumps(payload, default=str))
+
+
+def test_report_tamper_detected(smoke, tmp_path):
+    payload, _ = smoke
+    path = str(tmp_path / "r.json")
+    write_report(payload, path)
+    with open(path) as f:
+        record = json.load(f)
+    record["payload"]["unanswered_total"] = 0  # same value, but ...
+    record["payload"]["wall_s"] = -1           # ... this one lies
+    with open(path, "w") as f:
+        json.dump(record, f)
+    with pytest.raises(ValueError, match="crc32c mismatch"):
+        read_report(path)
+    with open(path, "w") as f:
+        json.dump({"not": "a report"}, f)
+    with pytest.raises(ValueError, match="not a SoakReport"):
+        read_report(path)
+
+
+# --------------------------------------------------------------------------
+# chaos schedule: replayability + structural invariants
+# --------------------------------------------------------------------------
+
+
+def test_schedule_same_seed_same_kills():
+    a = build_chaos_schedule(SMOKE_CONFIG)
+    b = build_chaos_schedule(SMOKE_CONFIG)
+    assert [e.to_dict() for e in a] == [e.to_dict() for e in b]
+    # and via the JSON round-trip the report relies on
+    cfg2 = SoakConfig.from_dict(SMOKE_CONFIG.to_dict())
+    assert [e.to_dict() for e in build_chaos_schedule(cfg2)] == [
+        e.to_dict() for e in a
+    ]
+
+
+def test_schedule_seed_changes_the_day():
+    a = [e.to_dict() for e in build_chaos_schedule(SMOKE_CONFIG)]
+    b = [
+        e.to_dict()
+        for e in build_chaos_schedule(
+            SoakConfig.from_dict({**SMOKE_CONFIG.to_dict(), "seed": 7})
+        )
+    ]
+    assert a != b
+
+
+@pytest.mark.parametrize("seed", [0, 1, 1107, 4242])
+def test_schedule_structural_invariants(seed):
+    cfg = SoakConfig.from_dict({**SMOKE_CONFIG.to_dict(), "seed": seed})
+    events = build_chaos_schedule(cfg)
+    assert [e.t for e in events] == sorted(e.t for e in events)
+    kills = [e for e in events if e.kind == KIND_KILL]
+    revives = [e for e in events if e.kind == KIND_REVIVE]
+    assert len(kills) == cfg.replica_kills
+    # replica 0 is never killed and every kill has a later revival
+    for k in kills:
+        assert k.target != "0"
+        mates = [r for r in revives if r.target == k.target and r.t > k.t]
+        assert mates, f"kill of replica {k.target} never revived"
+    crashes = [e for e in events if e.kind == "crash"]
+    assert len(crashes) == cfg.crashes
+    assert all(c.target in CRASH_SITES for c in crashes)
+    # the seeded site permutation: n crashes hit n distinct sites
+    assert len({c.target for c in crashes}) == min(
+        cfg.crashes, len(CRASH_SITES)
+    )
+    assert sum(e.kind == KIND_DOUBLE_KILL for e in events) == cfg.double_kills
+
+
+# --------------------------------------------------------------------------
+# check_report has teeth: one doctored payload per invariant
+# --------------------------------------------------------------------------
+
+
+def _doctored(payload, mutate):
+    p = copy.deepcopy(payload)
+    mutate(p)
+    return check_report(p, verify_postmortems=False)
+
+
+def test_check_report_catches_each_invariant(smoke):
+    payload, _ = smoke
+    assert check_report(payload, verify_postmortems=False) == []
+
+    def unhandled(p):
+        p["unhandled"] = ["phase night: RuntimeError('boom')"]
+
+    def unanswered(p):
+        p["phases"][0]["unanswered"] = 3
+
+    def goodput(p):
+        p["phases"][1]["goodput_frac"] = 0.0
+
+    def kill_unrecovered(p):
+        p["kills"][0]["recovered"] = False
+
+    def no_double_kill(p):
+        p["kills"] = [
+            k for k in p["kills"] if k["kind"] != KIND_DOUBLE_KILL
+        ]
+
+    def second_kill_missing(p):
+        for k in p["kills"]:
+            if k["kind"] == KIND_DOUBLE_KILL:
+                k["postmortems"] = k["postmortems"][:1]
+
+    def not_bit_identical(p):
+        for k in p["kills"]:
+            if k["kind"] == KIND_DOUBLE_KILL:
+                k["bit_identical"] = False
+
+    def unbounded(p):
+        p["resources"] = {"bounded": False, "violations": ["rss grew 9x"]}
+
+    def broken_trace(p):
+        p["trace"]["span_names"] = ["stream.batch"]
+
+    def not_replayable(p):
+        p["chaos_schedule"] = p["chaos_schedule"][:-1]
+
+    def wrong_version(p):
+        p["version"] = SCHEMA_VERSION + 1
+
+    cases = [
+        (unhandled, "unhandled exception"),
+        (unanswered, "unanswered=3"),
+        (goodput, "below the"),
+        (kill_unrecovered, "not recovered"),
+        (no_double_kill, "no double-kill"),
+        (second_kill_missing, "fewer than 2 postmortems"),
+        (not_bit_identical, "NOT bit-identical"),
+        (unbounded, "rss grew 9x"),
+        (broken_trace, "span chain incomplete"),
+        (not_replayable, "not replayable"),
+        (wrong_version, "schema version"),
+    ]
+    for mutate, needle in cases:
+        violations = _doctored(payload, mutate)
+        assert any(needle in v for v in violations), (
+            f"{mutate.__name__}: {needle!r} not in {violations}"
+        )
+
+
+def test_check_report_site_tag_must_match_dump(smoke):
+    payload, _ = smoke
+    p = copy.deepcopy(payload)
+    victim = next(k for k in p["kills"] if k["postmortems"])
+    victim["postmortems"][0]["site"] = "somewhere.else"
+    violations = check_report(p)  # verify_postmortems=True re-reads disk
+    assert any("dump tagged" in v for v in violations)
+
+
+# --------------------------------------------------------------------------
+# stall watchdog (serve/fleet/watchdog.py): the soak's hang-to-failure
+# converter, unit-tested at a tight window
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def quiet_recorder(tmp_path):
+    prev = flight.recorder()
+    rec = flight.install(
+        flight.FlightRecorder(dump_dir=str(tmp_path / "flight"))
+    )
+    yield rec
+    flight.install(prev)
+
+
+def _settle(wd, timeout_s=3.0):
+    t0 = time.monotonic()
+    while wd.stalled() is None and time.monotonic() - t0 < timeout_s:
+        time.sleep(0.02)
+    return wd.stalled()
+
+
+def test_watchdog_declares_busy_no_progress(quiet_recorder):
+    wd = StallWatchdog(window_s=0.2, poll_s=0.02)
+    wd.register("wedged", lambda: 0.0)  # no busy_fn: always busy
+    with wd:
+        err = _settle(wd)
+    assert isinstance(err, StallError)
+    assert err.stage == "wedged"
+    with pytest.raises(StallError):
+        wd.check()
+    dump = flight.read_dump(err.dump_path)
+    assert dump["site"] == "watchdog.stall"
+    assert dump["trigger"]["stage"] == "wedged"
+
+
+def test_watchdog_progress_and_idle_are_not_stalls(quiet_recorder):
+    ticks = [0]
+
+    def progress():
+        ticks[0] += 1
+        return float(ticks[0])
+
+    wd = StallWatchdog(window_s=0.15, poll_s=0.02)
+    wd.register("alive", progress)
+    wd.register("idle", lambda: 0.0, busy_fn=lambda: False)
+    with wd:
+        time.sleep(0.5)
+        assert wd.stalled() is None
+        wd.check()  # no raise
+
+
+def test_watchdog_on_stall_callback_and_raising_reader(quiet_recorder):
+    seen = []
+    wd = StallWatchdog(
+        window_s=0.15, poll_s=0.02, on_stall=seen.append
+    )
+
+    def dying():
+        raise RuntimeError("source crashed")  # reads as no-change
+
+    wd.register("dying", dying)
+    with wd:
+        err = _settle(wd)
+    assert err is not None and err.stage == "dying"
+    assert seen and seen[0] is err
+
+
+# --------------------------------------------------------------------------
+# the slow shape: the full multi-phase day, excluded from tier-1
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_day_soak_clean(tmp_path):
+    payload, _ = run_soak(full_config(), str(tmp_path))
+    assert check_report(payload) == []
+    assert len(payload["phases"]) == 4

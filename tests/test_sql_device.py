@@ -132,6 +132,35 @@ def test_fuzz_parity_green():
     )
 
 
+def test_string_group_key_parity():
+    """String GROUP BY keys ride the compiled path as host-encoded
+    sorted-rank dictionary codes (ISSUE 17): codes are order-isomorphic
+    to the values — a row's code never depends on which other rows are
+    present — so pre-filter encoding matches the interpreter's
+    post-filter group order, nulls (None) fold to one trailing group,
+    and multi-key mixes with numeric/timestamp columns lexsort
+    identically on both paths."""
+    rng = np.random.default_rng(5)
+    n = 96
+    s1 = np.array(
+        [f"H{int(i):02d}" for i in rng.integers(0, 5, n)], dtype=object
+    )
+    s1[rng.random(n) < 0.15] = None
+    table = Table.from_dict(
+        {
+            "s1": s1,
+            "i1": rng.integers(-2, 4, n),
+            "f1": rng.normal(size=n) * 10,
+        }
+    )
+    for q in (
+        "SELECT s1, count(*) AS c, sum(f1) AS s FROM events GROUP BY s1",
+        "SELECT s1, avg(f1) AS a FROM events WHERE i1 >= 1 GROUP BY s1",
+        "SELECT i1, s1, min(f1) AS lo FROM events GROUP BY i1, s1",
+    ):
+        _parity(q, table)  # mode="compile" raises if it fell back
+
+
 @pytest.mark.slow
 def test_fuzz_parity_deep():
     failures = sql_fuzz.run_fuzz(n_queries=250, seed=7)

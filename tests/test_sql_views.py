@@ -792,3 +792,69 @@ def test_retraction_rewrites_delta_under_fresh_path(tmp_path):
     assert on_disk == [second]  # the pre-replay orphan was swept
     v2 = ViewRegistry().register("win", ROW_Q, sink)  # restart
     _assert_parity(ROW_Q, sink, v2, ctx="after replay + restart")
+
+
+# ===================== string group keys (the ISSUE 17 soak's in-tree find)
+STR_Q = (
+    "SELECT s1, count(*) AS c, sum(f1) AS s, min(f1) AS lo FROM events "
+    "GROUP BY s1"
+)
+STR_WHERE_Q = (
+    "SELECT s1, count(*) AS c, avg(f1) AS a FROM events "
+    "WHERE i1 >= 1 GROUP BY s1"
+)
+
+
+def _str_batch(rng, n, pool):
+    f1 = rng.normal(size=n) * 10
+    s1 = np.array(
+        [pool[int(i)] for i in rng.integers(0, len(pool), n)], dtype=object
+    )
+    if n >= 8:  # a small schema-probe batch must stay null-free
+        f1[rng.random(n) < 0.1] = np.nan
+        s1[rng.random(n) < 0.12] = None
+    return ht.Table.from_dict(
+        {"f1": f1, "i1": rng.integers(-2, 4, n), "s1": s1}
+    )
+
+
+def test_string_group_key_view_incremental_parity(tmp_path):
+    """Regression for the bug the ISSUE 17 soak surfaced: a string-keyed
+    GROUP BY view (the soak's per-hospital drift feed) must fold
+    per-batch partials incrementally and still match the full recompute.
+
+    The minimal two-subsystem staging: UnboundedTable commits × view
+    maintenance.  Each batch deliberately introduces its hospitals in a
+    DIFFERENT first-appearance order — under the old first-appearance
+    factorization the per-batch codes were batch-relative (and, with a
+    WHERE, filter-relative), so cross-batch folds and pre-filter host
+    encodes could not agree; sorted-rank codes are order-isomorphic to
+    the values and cannot depend on which other rows are present."""
+    rng = np.random.default_rng(17)
+    pools = (
+        ("H02", "H01"),             # batch 0 meets H02 first
+        ("H00", "H03", "H01"),      # batch 1 leads with new hospitals
+        ("H03", "H00"),             # batch 2 reverses batch 1's order
+    )
+    sink = UnboundedTable(
+        str(tmp_path / "table"), _str_batch(rng, 1, pools[0]).schema,
+        name="events",
+    )
+    reg = ViewRegistry()
+    view = reg.register("per_hosp", STR_Q, sink)
+    filt = reg.register("per_hosp_busy", STR_WHERE_Q, sink)
+    for bid, pool in enumerate(pools):
+        sink.append_batch(_str_batch(rng, 80, pool), bid)
+        reg.maintain(sink, bid)
+        _assert_parity(STR_Q, sink, view, ctx=f"batch {bid}")
+        _assert_parity(STR_WHERE_Q, sink, filt, ctx=f"filtered batch {bid}")
+    assert view.describe()["incremental"], view.describe()["decisions"]
+    assert filt.describe()["incremental"], filt.describe()["decisions"]
+    before = view.read()
+    assert None in set(before.column("s1"))  # the null group is present
+
+    # restart: a fresh registry re-loads the persisted canonical keys —
+    # the (null_flag, str) tuples must round-trip through state.json
+    v2 = ViewRegistry().register("per_hosp", STR_Q, sink)
+    _assert_parity(STR_Q, sink, v2, ctx="string keys after restart")
+    _assert_bit_identical(before, v2.read())

@@ -55,6 +55,7 @@ SANCTIONED = tuple(
         "streaming/unbounded_table.py",
         "core/sql_views.py",
         "lifecycle/feedback.py", "lifecycle/journal.py",
+        "soak/report.py",
     )
 )
 

@@ -14,7 +14,12 @@ jax):
    every registered name must be emitted somewhere;
 4. lifecycle journal states exist and the transition/retrain/promote/
    rollback spans are emitted;  5/6. the farm and fleet span sets stay
-   emitted.
+   emitted;
+7. (ISSUE 17) the soak harness's chaos-dispatch fault sites
+   (``soak.schedule.tick`` / ``soak.phase.transition`` /
+   ``soak.report.commit``) stay reachable in the source AND registered
+   in ``SITE_COVERAGE`` — absence of a required site is a finding, the
+   inverse direction of rule 1.
 
 Bugfix vs the regex version (ISSUE 13 satellite): names that reach the
 hook through an f-string, a once-assigned alias, or a parameter default
@@ -52,6 +57,17 @@ _REQUIRED_SPANS = {
                   "lifecycle.promote", "lifecycle.rollback"),
     "farm": ("farm.fit", "farm.refit", "farm.predict"),
     "fleet": ("fleet.request", "fleet.promote", "router.route"),
+    "soak": ("soak.run",),
+}
+
+#: family → fault sites that must exist as REACHABLE hook calls in the
+#: source AND carry a SITE_COVERAGE entry (ISSUE 17: the soak harness's
+#: chaos-dispatch points are load-bearing for the chaos matrix — losing
+#: one silently un-tests a whole recovery path, so absence is a finding,
+#: not just presence-without-coverage)
+_REQUIRED_SITES = {
+    "soak": ("soak.schedule.tick", "soak.phase.transition",
+             "soak.report.commit"),
 }
 
 _STATE_CONST = re.compile(r"^STATE_[A-Z_]+$")
@@ -62,7 +78,7 @@ class ObsCoveragePass(Pass):
     rules = (
         "fault-site-uncovered", "coverage-target-unregistered",
         "span-unregistered", "span-never-emitted", "required-span-missing",
-        "dynamic-span-name", "dynamic-fault-site",
+        "required-site-missing", "dynamic-span-name", "dynamic-fault-site",
     )
 
     def applies_to(self, rel: str) -> bool:
@@ -290,6 +306,33 @@ class ObsCoveragePass(Pass):
                             f"{family} span {required!r} is not emitted — "
                             f"the {family} subsystem has drifted from its "
                             "instrumentation"
+                        ),
+                    )
+        # 7. required fault sites: reachable (a real hook call collected
+        # from the source) AND registered (a SITE_COVERAGE entry) — the
+        # inverse of rule 1, which only checks sites that exist
+        for family, names in _REQUIRED_SITES.items():
+            for required in names:
+                if required not in st["sites"]:
+                    yield Finding(
+                        rule="required-site-missing", path=_TRACE_REL,
+                        line=cov_line, col=0,
+                        message=(
+                            f"{family} fault site {required!r} has no "
+                            "reachable fault_point call in the source — "
+                            "the chaos schedule can no longer inject there"
+                        ),
+                    )
+                elif not any(
+                    fnmatch.fnmatchcase(required, p) for p in coverage
+                ):
+                    yield Finding(
+                        rule="required-site-missing", path=_TRACE_REL,
+                        line=cov_line, col=0,
+                        message=(
+                            f"{family} fault site {required!r} has no "
+                            "SITE_COVERAGE entry — register which span "
+                            "its failures show up under"
                         ),
                     )
 

@@ -4,6 +4,12 @@
 #
 #   tools/run_chaos.sh            # the tier-1 chaos subset
 #   tools/run_chaos.sh --slow     # include the slow soak/breaker tests
+#   tools/run_chaos.sh --soak     # ISSUE 17: the compressed-production-day
+#                                 # smoke soak (≤60 s budget) + CRC-verified
+#                                 # machine-check of its SoakReport; the
+#                                 # slow full-day shape stays behind
+#                                 # `pytest -m 'soak and slow'` /
+#                                 # `tools/soak.py --full`
 #
 # Sites covered: stream WAL boundaries (stream.after_*) on BOTH the
 # serial and the pipelined driver (tests/test_stream_pipeline.py kills
@@ -53,6 +59,40 @@ cd "$(dirname "$0")/.."
 MARK="chaos"
 if [[ "${1:-}" != "--slow" ]]; then
     MARK="chaos and not slow"
+fi
+
+if [[ "${1:-}" == "--soak" ]]; then
+    # ---- ISSUE 17: the compressed-production-day leg --------------------
+    # Same lint preflight as the kill matrix, then ONE seeded smoke soak
+    # (the whole diurnal day replays in well under the 60 s budget) and a
+    # separate-process verification pass: re-read the report through the
+    # CRC discipline and machine-check every invariant (zero unhandled,
+    # unanswered=0, per-phase goodput over its SLO floor, every kill
+    # recovered with a site-tagged CRC-intact postmortem, ≥1 double-kill
+    # bit-identical, bounded resource growth, the raw-CSV-row →
+    # promoted-model trace, and seed-replayable chaos schedule).
+    echo "== lint preflight =="
+    if ! python tools/lint.py; then
+        echo "lint preflight FAILED — fix (or suppress with a reason) before running the soak"
+        exit 1
+    fi
+    SOAK_DIR=$(mktemp -d /tmp/chaos_soak.XXXXXX)
+    echo
+    echo "== compressed-production-day smoke soak =="
+    JAX_PLATFORMS=cpu timeout -k 10 60 python tools/soak.py --workdir "$SOAK_DIR"
+    src=$?
+    if [[ $src -eq 124 || $src -eq 137 ]]; then
+        echo "SOAK EXCEEDED THE 60 s SMOKE BUDGET"
+        rm -rf "$SOAK_DIR"
+        exit 1
+    fi
+    echo
+    echo "== report verification (fresh process, CRC + machine-check) =="
+    JAX_PLATFORMS=cpu python tools/soak.py --check "$SOAK_DIR/soak_report.json"
+    crc=$?
+    rm -rf "$SOAK_DIR"
+    [[ $crc -ne 0 ]] && exit "$crc"
+    exit "$src"
 fi
 
 # ISSUE 13 preflight: the framework invariant linter must be clean before
