@@ -2236,6 +2236,155 @@ def _bench_sql_incremental() -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _bench_sql_history() -> dict:
+    """ISSUE 18: survivable history — does zone-map pruning keep the
+    recent-window SQL query flat while the table's history grows 100x?
+
+    The trajectory: an unbounded table under the seal/retire lifecycle
+    (cold batches compacted into CRC'd columnar segments with per-column
+    min/max zone maps, superseded parts retired).  Two measured points:
+
+    * **small** — a few batches of history, sealed + retired, then the
+      dashboard query ("everything since two hours ago") served off the
+      compiled path;
+    * **large** — 100x the committed rows, sealed + retired the same
+      way, same query shape.  Event time is monotone across batches, so
+      the planner's zone maps prune every cold segment and the query
+      should touch the same few hot parts it did when the table was
+      small.
+
+    Gates: ``latency_ratio_100x`` ≤ 1.25 (the acceptance bound: query
+    latency flat as history grows 100x); exact parity between the
+    pruned compiled path and the interpreter on the large table;
+    ``vs_baseline`` = unpruned-compiled / pruned-compiled latency at
+    100x (what pruning is worth once history is deep), with the
+    segment/row prune ratio reported from ``explain()``."""
+    import shutil
+    import tempfile
+
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql import (
+        execute,
+        explain,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_fuzz import (
+        compare_tables,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table_lifecycle import (
+        RetentionPolicy,
+        TableLifecycle,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.unbounded_table import (
+        UnboundedTable,
+    )
+
+    platform, on_tpu, _n, _, _mesh, _n_chips = _bench_setup(2_000_000)
+    rows = max(int(os.environ.get("BENCH_SQL_HISTORY_ROWS", "256")), 64)
+    small_batches = 4
+    growth = 100
+    large_batches = small_batches * growth
+    rng = np.random.default_rng(0)
+    base_ts = np.datetime64("2025-03-31T00:00:00")
+
+    def make_batch(b: int):
+        t = (
+            base_ts
+            + (b * 3600 + rng.integers(0, 3600, rows)).astype("timedelta64[s]")
+        ).astype("datetime64[ns]")
+        return ht.Table.from_dict(
+            {
+                "hospital": rng.integers(0, 16, rows),
+                "event_time": t,
+                "admissions": rng.integers(0, 50, rows),
+                "occupancy": rng.normal(250.0, 40.0, rows),
+            }
+        )
+
+    def recent_query(n_batches: int) -> str:
+        # "the last two hours" — the same shape at every history depth
+        cut = str(
+            (base_ts + np.timedelta64(n_batches - 2, "h"))
+            .astype("datetime64[s]")
+        ).replace("T", " ")
+        return (
+            "SELECT hospital, admissions, occupancy FROM events"
+            f" WHERE event_time >= '{cut}'"
+        )
+
+    policy = RetentionPolicy(
+        min_seal_batches=4, hot_batches=2, max_segment_batches=32,
+    )
+
+    def timed(q, resolve, reps=9):
+        execute(q, resolve, mode="auto")  # warm: compile + prune memo
+        xs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            execute(q, resolve, mode="auto")
+            xs.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(xs))
+
+    d = tempfile.mkdtemp(prefix="bench_sql_hist_")
+    try:
+        sink = UnboundedTable(d, make_batch(0).schema, name="events")
+        for b in range(small_batches):
+            sink.append_batch(make_batch(b), b)
+        TableLifecycle(sink, policy).tick()
+        q_small = recent_query(small_batches)
+        small_ms = timed(q_small, lambda _x: sink.read())
+
+        for b in range(small_batches, large_batches):
+            sink.append_batch(make_batch(b), b)
+        lc_out = TableLifecycle(sink, policy).tick()
+        q_large = recent_query(large_batches)
+        resolve = lambda _x: sink.read()  # noqa: E731
+        large_ms = timed(q_large, resolve)
+
+        # parity: the pruned compiled path answers exactly what the
+        # interpreter answers over the full assembled snapshot
+        parity = compare_tables(
+            execute(q_large, resolve, mode="interpret"),
+            execute(q_large, resolve, mode="auto"),
+        ) is None
+
+        # the unpruned compiled cost at the same depth: a detached
+        # snapshot (no table origin) runs the same plan over all rows
+        snap = sink.read()
+        detached = snap.mask(np.ones(len(snap), dtype=bool))
+        unpruned_ms = timed(q_large, lambda _x: detached)
+
+        prune = explain(q_large, resolve).get("prune", {})
+        segs = int(prune.get("segments", 0))
+        pruned = int(prune.get("segments_pruned", 0))
+        ratio = large_ms / max(small_ms, 1e-9)
+        return {
+            "metric": (
+                f"recent-window SQL latency vs {growth}x history growth "
+                f"under seal/retire + zone-map pruning "
+                f"({large_batches} batches x {rows} rows, {platform})"
+            ),
+            "value": round(ratio, 3),
+            "unit": "x_latency_at_100x_history",
+            "latency_ratio_100x": round(ratio, 3),
+            "latency_flat_1_25x": bool(ratio <= 1.25),
+            "vs_baseline": round(unpruned_ms / max(large_ms, 1e-9), 2),
+            "parity_pruned_vs_interpret": parity,
+            "query_ms_small": round(small_ms, 3),
+            "query_ms_large": round(large_ms, 3),
+            "query_ms_large_unpruned": round(unpruned_ms, 3),
+            "segments": segs,
+            "segments_pruned": pruned,
+            "segment_prune_ratio": round(pruned / max(segs, 1), 3),
+            "rows_pruned": int(prune.get("rows_pruned", 0)),
+            "rows_total": int(sink.num_rows()),
+            "segments_sealed": int(lc_out["sealed"]),
+            "parts_retired": int(lc_out["retired"]),
+            "platform": platform,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _bench_lifecycle() -> dict:
     """Continuous-learning config (ISSUE 9): the closed loop, measured.
 
@@ -3360,6 +3509,7 @@ CONFIGS = {
     "quality": lambda: _bench_quality(),                        # data firewall
     "sql_device": lambda: _bench_sql_device(),                  # ISSUE 7 A/B
     "sql_incremental": lambda: _bench_sql_incremental(),        # ISSUE 14 views
+    "sql_history": lambda: _bench_sql_history(),                # ISSUE 18 prune
     "lifecycle": lambda: _bench_lifecycle(),                    # ISSUE 9 loop
     "obs_overhead": lambda: _bench_obs_overhead(),              # ISSUE 10 gate
     "model_farm": lambda: _bench_model_farm(),                  # ISSUE 11 A/B
@@ -3606,7 +3756,8 @@ def _child_main(name: str) -> None:
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
     "kmeans256", "pallas_ab", "kmeans_fused_ab", "model_farm", "serve_fleet",
-    "federated", "sql_device", "sql_incremental", "rf20", "gbt20", "nb",
+    "federated", "sql_device", "sql_incremental", "sql_history", "rf20",
+    "gbt20", "nb",
     "gmm32", "bisecting", "streaming", "streaming_pipeline", "kmeans8",
     "serve",
 ]

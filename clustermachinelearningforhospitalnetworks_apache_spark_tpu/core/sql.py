@@ -887,13 +887,27 @@ def explain(query: str, resolve_table) -> dict:
         from .sql_views import FULL_COMPILE_DISABLED
 
         inc_ok, inc_reasons = False, [FULL_COMPILE_DISABLED]
-    return {
+    out = {
         "route": route,
         "fingerprint": plan.fingerprint,
         "nodes": plan.explain(),  # ONE copy of the per-node dict shape
         "fallback": fallback,
         "view_maintenance": "incremental" if inc_ok else inc_reasons,
     }
+    # zone-map prune preview (ISSUE 18): when the snapshot came from an
+    # unbounded table with sealed segments and the plan has a WHERE, the
+    # planner can say — from manifests alone, no data read — how much of
+    # history the compiled scan would skip.  Key present only then, so
+    # plain-table explains are byte-for-byte what they always were.
+    origin = getattr(plan.source, "_unbounded_origin", None)
+    if origin is not None and plan.filter is not None:
+        try:
+            out["prune"] = origin.prune_stats(
+                plan.filter, getattr(plan.source, "_origin_upto", None)
+            )
+        except Exception:
+            pass  # a broken manifest must not break explain
+    return out
 
 
 def execute(query: str, resolve_table, mode: str = "auto", views=None) -> Table:
@@ -932,6 +946,32 @@ def execute(query: str, resolve_table, mode: str = "auto", views=None) -> Table:
                 if d.fingerprint is not None:
                     sp.note("fingerprint", d.fingerprint)
         return out
+
+
+def _source_pruned(plan) -> Table:
+    """The compiled scan's source: the plan's pinned snapshot, or its
+    segment-pruned twin when the snapshot came from an unbounded table
+    whose sealed zone maps prove some segments can't satisfy the WHERE
+    (core/segments.py, the Flare data-skipping move).  Pruning is
+    conservative — a pruned segment contains NO row the filter accepts —
+    so result rows AND their order are identical; anything uncertain
+    (no filter, no origin, manifest trouble) scans the full snapshot."""
+    if plan.filter is None:
+        return plan.source
+    origin = getattr(plan.source, "_unbounded_origin", None)
+    if origin is None:
+        return plan.source
+    try:
+        pruned, _stats = origin.scan_pruned(
+            getattr(plan.source, "_origin_upto", None), plan.filter
+        )
+    except Exception:
+        return plan.source  # pruning is an optimization, never a risk
+    if pruned is None:
+        # every batch pruned: an empty slice of the snapshot keeps the
+        # derived-column schema the lowered signature was typed against
+        return plan.source.mask(np.zeros(len(plan.source), dtype=bool))
+    return pruned
 
 
 def _execute_dispatched(query: str, resolve_table, mode: str, views=None) -> Table:
@@ -976,8 +1016,12 @@ def _execute_dispatched(query: str, resolve_table, mode: str, views=None) -> Tab
                 # plan.source, NOT resolve_table(...) again: re-resolving
                 # could hand the kernel a DIFFERENT snapshot (a streaming
                 # commit between plan and run) whose dtypes no longer
-                # match the lowered signature
-                out = run_plan(plan, plan.source)
+                # match the lowered signature.  _source_pruned may swap
+                # in the segment-pruned twin of that SAME snapshot (rows
+                # the sealed zone maps prove can't match the WHERE never
+                # leave disk) — provably filter-equivalent, so the
+                # kernel's answer is unchanged.
+                out = run_plan(plan, _source_pruned(plan))
             except Exception as e:  # defensive: a compiled-path runtime
                 # failure must degrade to the interpreter, visibly (the
                 # dispatch log records it), never take the query down

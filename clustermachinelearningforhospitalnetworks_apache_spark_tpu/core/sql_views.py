@@ -582,6 +582,14 @@ class MaterializedView:
         # replay detector (also the snapshot-memo key), so the two can
         # never disagree about whether a replay happened
         size, mtime = self.source._part_stat(entry["file"])
+        if size < 0:
+            # part file gone: retention may have retired it into a
+            # sealed segment (ISSUE 18) — the BYTES are preserved, so
+            # the folded delta is still exact and retracting it would
+            # force a rebuild on every refresh forever
+            sealed = getattr(self.source, "sealed_rows", None)
+            if sealed is not None and sealed(int(entry["batch_id"])) == int(entry["rows"]):
+                return False
         return [size, mtime] != list(meta.get("stat", (size, mtime)))
 
     def _apply(
@@ -664,7 +672,16 @@ class MaterializedView:
             return None
         p = os.path.join(self.source.path, entry["file"])
         if not os.path.exists(p):
-            return None  # mirror UnboundedTable.read: missing parts skip
+            # retention may have retired the part into a sealed segment
+            # (ISSUE 18): fold the CRC-verified sealed slice — a view
+            # registered after retirement still covers full history.
+            # Rotten bytes raise SegmentCorruptError, which the refresh
+            # path surfaces; a plain missing part still skips, mirroring
+            # UnboundedTable.read.
+            sealed = getattr(self.source, "read_sealed_batch", None)
+            if sealed is not None:
+                return sealed(int(entry["batch_id"]))
+            return None
         return _read_parquet(p)
 
     def _max_event_ns(self, table: Table) -> int | None:

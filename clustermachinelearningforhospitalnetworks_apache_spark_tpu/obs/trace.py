@@ -69,6 +69,9 @@ REGISTERED_SPANS = (
     "obs.demo",          # example/bench root spans
     "fed.round",         # one federated fit round: collect→merge→fit→broadcast
     "soak.run",          # one compressed-day soak run (root of the E2E trace)
+    "table.seal",        # cold batches → sealed CRC-manifested segment
+    "table.retire",      # superseded part files deleted under retention
+    "table.scrub",       # segment CRC audit: quarantine + rebuild rot
 )
 
 #: fault site (fnmatch glob) → the registered span that encloses or
@@ -99,6 +102,9 @@ SITE_COVERAGE = {
     "soak.phase.transition": "soak.run",   # diurnal phase boundary
     "soak.report.commit": "soak.run",      # SoakReport atomic-write commit
     "soak.replica.kill": "soak.run",       # replica-kill postmortem notify
+    "table.seal.*": "table.seal",          # stage (segment+manifest) / commit
+    "table.retire.commit": "table.retire",  # log-first part retirement
+    "table.scrub.repair": "table.scrub",   # quarantine-and-rebuild point
 }
 
 _CTX: contextvars.ContextVar = contextvars.ContextVar("obs_trace", default=None)

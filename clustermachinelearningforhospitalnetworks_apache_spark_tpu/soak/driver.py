@@ -35,6 +35,7 @@ import numpy as np
 
 from ..core.table import Table
 from ..core.sql_views import ViewRegistry
+from ..core.table_lifecycle import RetentionPolicy, TableLifecycle
 from ..farm.farm import FarmKMeans
 from ..io.csv import CSV_TEXT_SITE, write_csv
 from ..lifecycle.farm import retrain_drifted
@@ -80,6 +81,14 @@ VIEW_QUERY = (
     "SELECT hospital_id, count(*) AS c, avg(admission_count) AS adm,"
     " avg(length_of_stay) AS alos FROM events GROUP BY hospital_id"
 )
+#: ISSUE 18 — the history lifecycle the day runs under: seal everything
+#: but the freshest two batches (each phase ingests one batch, so the
+#: previous day-segment goes cold a phase later), retire the superseded
+#: parts, scrub what's sealed.  Small chunks keep every tick exercising
+#: seal + retire + scrub rather than waiting for a deep backlog.
+RETENTION = RetentionPolicy(
+    min_seal_batches=1, hot_batches=2, max_segment_batches=4,
+)
 
 
 def _hospital_schema():
@@ -105,6 +114,7 @@ class _SoakRun:
         self.unhandled: list[str] = []
         self.kills: list[dict] = []
         self.phase_rows: list[dict] = []
+        self.lifecycle_ticks: list[dict] = []
         self.heartbeat = 0
         self._csv_seq = 0
         self._event_t0 = np.datetime64("2026-03-30T00:00:00")
@@ -176,7 +186,12 @@ class _SoakRun:
             source=FileStreamSource(
                 os.path.join(self.workdir, "incoming"), schema
             ),
-            sink=UnboundedTable(os.path.join(self.workdir, "table"), schema),
+            sink=UnboundedTable(
+                os.path.join(self.workdir, "table"), schema,
+                disk_budget_bytes=int(
+                    self.cfg.table_budget_mb * 1024 * 1024
+                ),
+            ),
             checkpoint=StreamCheckpoint(os.path.join(self.workdir, "ckpt")),
             firewall=self.firewall,
             views=self.views,
@@ -186,6 +201,27 @@ class _SoakRun:
         self.heartbeat += 1
         self._write_phase_csv(tag, drift)
         self.stream.run_once()
+
+    def lifecycle_tick(self, tag: str) -> None:
+        """One seal/retire/scrub pass over the unbounded table (ISSUE 18)
+        at a phase boundary — the retention mechanism that keeps the
+        table under ``cfg.table_budget_mb`` all day.  The scrub verdict
+        rides into the report; a lifecycle failure is an unhandled
+        entry, never a hung or silently-skipped tick."""
+        self.heartbeat += 1
+        try:
+            lc = TableLifecycle(self.stream.sink, RETENTION)
+            out = lc.tick()
+            scrub = lc.scrub()
+            self.lifecycle_ticks.append({
+                "tag": tag,
+                "sealed": int(out["sealed"]),
+                "retired": int(out["retired"]),
+                "scrub": scrub,
+                "table_bytes": int(self.stream.sink.on_disk_bytes()),
+            })
+        except Exception as e:  # noqa: BLE001 — the report must see it
+            self.unhandled.append(f"lifecycle {tag}: {e!r}")
 
     def live_windows(self, window: int = 64) -> dict[str, np.ndarray]:
         tbl = self.stream.sink.read()
@@ -501,7 +537,8 @@ def _run_inner(run: _SoakRun, chaos, tracer, t_wall0) -> dict:
     seen_counts = {t: 0 for t in run.tenants}
 
     probe = ResourceProbe(
-        run.workdir, registries=[global_registry(), run.fleet.metrics]
+        run.workdir, registries=[global_registry(), run.fleet.metrics],
+        table_dir=os.path.join(run.workdir, "table"),
     )
     probe.sample("start")
 
@@ -534,11 +571,13 @@ def _run_inner(run: _SoakRun, chaos, tracer, t_wall0) -> dict:
             except Exception as e:  # noqa: BLE001 — the report must see it
                 run.unhandled.append(f"phase {phase.name}: {e!r}")
             phase_start += phase.duration_s
+            run.lifecycle_tick(phase.name)
             probe.sample(f"after:{phase.name}")
             _boundary_lifecycle(run, phase, seen_counts)
             wd.check()
 
         trace_info = _traced_cycle(run)
+        run.lifecycle_tick("final")
         wd.check()
     finally:
         wd.stop()
@@ -569,6 +608,19 @@ def _run_inner(run: _SoakRun, chaos, tracer, t_wall0) -> dict:
         ),
         "chaos_schedule": [e.to_dict() for e in chaos],
         "resources": res,
+        "lifecycle": {
+            "ticks": run.lifecycle_ticks,
+            "segments_sealed": sum(
+                t["sealed"] for t in run.lifecycle_ticks
+            ),
+            "parts_retired": sum(
+                t["retired"] for t in run.lifecycle_ticks
+            ),
+            "scrub_repairs": sum(
+                int(t["scrub"].get("repaired", 0))
+                for t in run.lifecycle_ticks
+            ),
+        },
         "trace": trace_info,
         "fleet_health": {
             "status": health["status"],

@@ -22,7 +22,10 @@ Invariants checked (ISSUE 17 acceptance):
     its crashes recovered, and the twice-restarted fit bit-identical to
     an uninterrupted run;
 6.  memory / disk / metric-cardinality / flight-ring growth bounded
-    (the resource probe's verdict);
+    (the resource probe's verdict), and — ISSUE 18 — the history
+    lifecycle ticked all day (seal/retire/scrub, no unrebuilt
+    quarantine) with the unbounded table under ``table_budget_mb``
+    at EVERY probe sample;
 7.  one trace id follows a raw CSV row through ingest → view
     maintenance → retrain → fleet promotion;
 8.  replayability: the chaos schedule embedded in the report equals the
@@ -177,6 +180,42 @@ def check_report(payload: dict, verify_postmortems: bool = True) -> list[str]:
     if not res.get("bounded"):
         for r in res.get("violations", ["resource verdict missing"]):
             v.append(f"resources: {r}")
+
+    # 6b. ISSUE 18 — the history lifecycle ran all day and held the
+    # unbounded table under its disk budget at EVERY probe sample, not
+    # just the final one (a mid-day spike the last sample misses is
+    # exactly the pager that fires at 3am)
+    lc = payload.get("lifecycle")
+    if not lc or not lc.get("ticks"):
+        v.append(
+            "no lifecycle ticks recorded — seal/retire/scrub never ran"
+        )
+    else:
+        for t in lc["ticks"]:
+            scrub = t.get("scrub") or {}
+            if int(scrub.get("quarantined", 0)) > 0:
+                v.append(
+                    f"lifecycle tick {t.get('tag')}: "
+                    f"{scrub['quarantined']} segment(s) quarantined "
+                    "without rebuild — history lost bytes mid-day"
+                )
+    budget_mb = (payload.get("config") or {}).get("table_budget_mb")
+    if budget_mb is None:
+        v.append("config carries no table_budget_mb — budget uncheckable")
+    else:
+        for s in res.get("samples", []):
+            tk = s.get("table_kb")
+            if tk is None:
+                v.append(
+                    f"sample {s.get('label', '?')}: table_kb not "
+                    "recorded — table footprint unobservable"
+                )
+            elif tk > float(budget_mb) * 1024.0:
+                v.append(
+                    f"sample {s.get('label', '?')}: table at "
+                    f"{tk / 1024.0:.1f} MiB over the "
+                    f"{budget_mb} MiB budget"
+                )
 
     # 7. the end-to-end trace
     tr = payload.get("trace", {})

@@ -75,9 +75,10 @@ class ResourceProbe:
     fleet's); the flight recorder is read through the module-level
     install."""
 
-    def __init__(self, workdir: str, registries=()):
+    def __init__(self, workdir: str, registries=(), table_dir: str | None = None):
         self.workdir = workdir
         self.registries = list(registries)
+        self.table_dir = table_dir
         self.samples: list[dict] = []
         self._t0 = time.monotonic()
 
@@ -100,6 +101,11 @@ class ResourceProbe:
                 if f.endswith(".json")
             ]),
         }
+        if self.table_dir is not None:
+            # ISSUE 18: the unbounded table's own footprint, sampled per
+            # boundary so check_report can hold it under the budget at
+            # EVERY point of the day, not just the final sample
+            s["table_kb"] = round(_disk_kb(self.table_dir), 1)
         self.samples.append(s)
         return s
 

@@ -91,6 +91,10 @@ class SoakConfig:
     stall_window_s: float = 60.0
     wait_timeout_s: float = 15.0
     max_disk_mb: float = 256.0         # resource-probe disk ceiling
+    table_budget_mb: float = 32.0      # unbounded-table disk budget: the
+    #                                    history lifecycle (seal/retire)
+    #                                    must hold the table dir under
+    #                                    this at EVERY probe sample
     max_metric_series: int = 4096      # resource-probe series ceiling
     rss_growth_ratio: float = 2.5      # last/first RSS ceiling
 

@@ -124,9 +124,15 @@ class StreamCheckpoint:
         attempts: int,
         error: str,
         sink_rows_visible: bool = False,
+        reason: str = "poison",
     ) -> str:
         """Persist the poison batch's evidence (atomically — a quarantine
-        record must never itself be torn) and return its path."""
+        record must never itself be torn) and return its path.
+
+        ``reason`` classifies the quarantine: ``"poison"`` (the batch
+        itself kept failing) vs ``"disk:budget"`` (the table's disk
+        budget is spent — the DATA is fine and safe to reprocess once
+        retention frees space)."""
         qdir = os.path.join(self.path, QUARANTINE_DIR)
         os.makedirs(qdir, exist_ok=True)
         p = os.path.join(qdir, f"batch-{batch_id:010d}.json")
@@ -138,6 +144,7 @@ class StreamCheckpoint:
                     "files": files,
                     "attempts": attempts,
                     "error": error,
+                    "reason": reason,
                     "sink_rows_visible": sink_rows_visible,
                     "quarantined_at": time.time(),
                 },

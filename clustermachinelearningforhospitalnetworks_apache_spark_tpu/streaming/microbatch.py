@@ -63,7 +63,7 @@ from ..utils.metrics import MetricsRegistry
 from ..utils.retry import DEFAULT_REPLAY_BACKOFF, RetryPolicy
 from .checkpoint import StreamCheckpoint
 from .source import FileStreamSource
-from .unbounded_table import UnboundedTable
+from .unbounded_table import DiskBudgetExceeded, UnboundedTable
 from .watermark import WatermarkTracker
 
 log = get_logger("streaming")
@@ -251,6 +251,12 @@ class StreamExecution:
                 # BaseException and rightly flies past this handler
                 prefetched = None
                 self.metrics.inc("stream.batch_failures")
+                if isinstance(e, DiskBudgetExceeded):
+                    # the disk budget is spent, not the batch poisoned:
+                    # the retry backoff below IS the backpressure — a
+                    # lifecycle retention tick can free space between
+                    # attempts, and reads keep serving committed state
+                    self.metrics.inc("stream.backpressure")
                 log.warning(
                     "batch attempt failed",
                     batch_id=batch_id, attempt=attempts,
@@ -410,8 +416,13 @@ class StreamExecution:
         fact (``sink_rows_visible``) so an operator reprocessing the
         quarantined files knows whether doing so would double-ingest."""
         sink_visible = batch_id in self.sink.committed_batches()
+        reason = (
+            DiskBudgetExceeded.reason
+            if isinstance(err, DiskBudgetExceeded) else "poison"
+        )
         qpath = self.checkpoint.quarantine(
-            batch_id, files, attempts, repr(err), sink_rows_visible=sink_visible
+            batch_id, files, attempts, repr(err),
+            sink_rows_visible=sink_visible, reason=reason,
         )
         self.checkpoint.write_commit(batch_id, quarantined=True)
         self.source.commit_files(files)

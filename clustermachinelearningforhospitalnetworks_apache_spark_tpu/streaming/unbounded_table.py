@@ -10,6 +10,25 @@ fsync-appended with torn-tail repair (streaming/wal.py) so a crash at any
 byte boundary loses at most the in-flight batch's commit line — giving the
 same exactly-once append semantics Delta's transaction log provides,
 scaled to this pipeline's needs.
+
+History lifecycle (ISSUE 18): the commit log is the SINGLE source of
+truth for three entry kinds, replayed in order with later-wins —
+
+* ``{"batch_id", "file", "rows"}`` — a committed part (as before);
+* ``{"seal": {first, last, file, manifest, rows, batches, crc32c,
+  size}}`` — a contiguous run of batches compacted into one sealed
+  segment under ``_segments/`` (core/segments.py); a batch entry
+  appended AFTER a seal (a replay) supersedes the sealed copy of that
+  one batch;
+* ``{"retire": {...}}`` / ``{"scrub": {...}}`` — audit records from the
+  lifecycle (core/table_lifecycle.py); they change no logical content
+  and readers skip them.
+
+``read()`` assembles hot parts and sealed segments into one snapshot in
+batch-id order, verifying every segment's bytes against the CRC32C
+record in its seal entry — bitrot surfaces as a typed
+:class:`~..core.segments.SegmentCorruptError` (or a loud fallback to
+surviving parts), never a silent wrong answer.
 """
 
 from __future__ import annotations
@@ -18,10 +37,39 @@ import os
 from dataclasses import dataclass
 
 from ..core.schema import Schema
+from ..core.segments import (
+    SEGMENT_DIR, SegmentCorruptError, load_manifest, read_segment,
+    segment_may_match,
+)
 from ..core.table import Table
 from .wal import append_line, read_lines
 
 COMMIT_LOG = "_commits.log"
+
+
+class DiskBudgetExceeded(RuntimeError):
+    """The table's configured disk budget is spent: ingest must stop
+    (backpressure upstream, quarantine with reason ``disk:budget`` when
+    retries exhaust) while reads keep serving committed state."""
+
+    reason = "disk:budget"
+
+
+#: scan_pruned fast-path sentinel: "nothing pruned — serve the full
+#: memoized snapshot" (distinct from None = "everything pruned")
+_FULL_SNAPSHOT = object()
+
+
+def _seal_offsets(seal: dict) -> dict[int, tuple[int, int]]:
+    """batch_id → (row_start, row_end) inside the sealed segment, from
+    the seal entry's ordered batches list."""
+    offs: dict[int, tuple[int, int]] = {}
+    acc = 0
+    for b in seal["batches"]:
+        r = int(b["rows"])
+        offs[int(b["batch_id"])] = (acc, acc + r)
+        acc += r
+    return offs
 
 
 @dataclass
@@ -29,6 +77,11 @@ class UnboundedTable:
     path: str
     schema: Schema
     name: str = "hospital_unbounded_table"
+    #: soft cap on total on-disk bytes under ``path``; ``append_batch``
+    #: refuses (typed ``DiskBudgetExceeded``) once spent — the stream's
+    #: retry ladder turns that into backpressure, and a retention tick
+    #: that retires superseded parts is what frees space
+    disk_budget_bytes: int | None = None
 
     def __post_init__(self) -> None:
         os.makedirs(self.path, exist_ok=True)
@@ -37,12 +90,38 @@ class UnboundedTable:
     def _part_path(self, batch_id: int) -> str:
         return os.path.join(self.path, f"part-{batch_id:010d}.parquet")
 
+    @property
+    def segments_dir(self) -> str:
+        return os.path.join(self.path, SEGMENT_DIR)
+
+    def on_disk_bytes(self) -> int:
+        """Total bytes under the table directory (parts, sealed
+        segments, manifests, logs, quarantined rot — everything that
+        occupies the disk the budget bounds)."""
+        total = 0
+        for root, _dirs, files in os.walk(self.path):
+            for fn in files:
+                try:
+                    total += os.stat(os.path.join(root, fn)).st_size
+                except OSError:
+                    continue
+        return total
+
     def append_batch(self, table: Table, batch_id: int) -> dict:
         """Write a batch's rows as its part file and commit it.
 
         Idempotent per batch_id: a replayed batch overwrites the same part
         file and the duplicate commit line is de-duplicated on read.
         """
+        if self.disk_budget_bytes is not None:
+            used = self.on_disk_bytes()
+            if used >= self.disk_budget_bytes:
+                raise DiskBudgetExceeded(
+                    f"disk:budget — table {self.name!r} holds {used} bytes"
+                    f" >= budget {self.disk_budget_bytes}; refusing new"
+                    " appends (committed state keeps serving; retention"
+                    " frees space)"
+                )
         part = self._part_path(batch_id)
         self._write_parquet(table, part)
         entry = {"batch_id": batch_id, "file": os.path.basename(part), "rows": len(table)}
@@ -71,6 +150,12 @@ class UnboundedTable:
     def _append_commit(self, entry: dict) -> None:
         append_line(os.path.join(self.path, COMMIT_LOG), entry)
 
+    def append_commit_entry(self, entry: dict) -> None:
+        """Durably append a lifecycle entry (seal/retire/scrub) — same
+        fsync'd WAL append as batch commits; the log stays the single
+        source of truth for every state transition."""
+        self._append_commit(entry)
+
     # -------------------------------------------------------------- read
     def _part_stat(self, fname: str) -> tuple[int, int]:
         """(size, mtime_ns) of a part file — content identity beyond the
@@ -91,11 +176,154 @@ class UnboundedTable:
         stats when it matches their last reconcile."""
         return self._part_stat(COMMIT_LOG)
 
+    def _log_entries(self) -> list[dict]:
+        return read_lines(os.path.join(self.path, COMMIT_LOG))
+
     def committed_batches(self) -> dict[int, dict]:
+        """Batch entries by id, later replay wins — THE batch-side log
+        parse (tests monkeypatch this as the O(batches) cost probe, so
+        every read path must re-derive through here, never around it).
+        Entries carry their log position ``_seq`` for later-wins
+        arbitration against seals."""
         out: dict[int, dict] = {}
-        for e in read_lines(os.path.join(self.path, COMMIT_LOG)):
-            out[int(e["batch_id"])] = e  # later replay wins
+        for seq, e in enumerate(self._log_entries()):
+            if "batch_id" in e:  # seal/retire/scrub entries are not batches
+                d = dict(e)
+                d["_seq"] = seq
+                out[int(e["batch_id"])] = d
         return out
+
+    def _committed_state(self) -> tuple[dict[int, dict], list[dict]]:
+        """One log replay → (batches by id, committed seals), each
+        stamped with its log position ``_seq`` so later-wins races
+        (a batch replayed AFTER its seal supersedes the sealed copy;
+        a re-staged seal supersedes the one it replaces) resolve from
+        the log order alone."""
+        batches = self.committed_batches()
+        seals: dict[tuple[int, int], dict] = {}
+        for seq, e in enumerate(self._log_entries()):
+            if "seal" in e:
+                s = dict(e["seal"])
+                s["_seq"] = seq
+                seals[(int(s["first"]), int(s["last"]))] = s
+        return batches, list(seals.values())
+
+    def _assembly(
+        self, upto_batch_id: int | None = None
+    ) -> tuple[list, dict[int, dict]]:
+        """The snapshot read plan, in batch-id order: ``("part", bid,
+        entry)`` items and ``("seg", seal, [bids])`` runs (adjacent
+        bids served by the same seal — provably a contiguous row slice
+        of the segment, because every bid a seal covers appears in the
+        plan, so nothing the seal covers can sort between run
+        members)."""
+        batches, seals = self._committed_state()
+        seg_of: dict[int, dict] = {}
+        for s in sorted(seals, key=lambda s: s["_seq"]):
+            for b in s["batches"]:
+                seg_of[int(b["batch_id"])] = s  # later seal wins
+        bids = set(batches) | set(seg_of)
+        if upto_batch_id is not None:
+            bids = {b for b in bids if b <= upto_batch_id}
+        items: list = []
+        for bid in sorted(bids):
+            s = seg_of.get(bid)
+            e = batches.get(bid)
+            if s is not None and (e is None or e["_seq"] < s["_seq"]):
+                if items and items[-1][0] == "seg" and items[-1][1] is s:
+                    items[-1][2].append(bid)
+                else:
+                    items.append(("seg", s, [bid]))
+            else:
+                items.append(("part", bid, e))
+        return items, batches
+
+    def _assembly_key(self, items: list) -> tuple:
+        """Memo key: one (bid, file, rows, stat) tuple per batch, with
+        segment-served batches keyed by the segment file's stat — a
+        re-staged segment (or a retire that flips a part to its sealed
+        copy) changes the key and drops the snapshot."""
+        key = []
+        for it in items:
+            if it[0] == "part":
+                e = it[2]
+                key.append(
+                    (it[1], e["file"], e["rows"], self._part_stat(e["file"]))
+                )
+            else:
+                s = it[1]
+                sfile = SEGMENT_DIR + "/" + s["file"]
+                sstat = self._part_stat(sfile)
+                rows_by = {
+                    int(b["batch_id"]): int(b["rows"]) for b in s["batches"]
+                }
+                for bid in it[2]:
+                    key.append((bid, sfile, rows_by[bid], sstat))
+        return tuple(key)
+
+    def _seal_arrow(self, seal: dict, cache: dict):
+        """CRC-verified Arrow table for a sealed segment (None when the
+        bytes are rotten — the caller decides whether parts survive to
+        serve the run, and raises loudly when they don't)."""
+        f = seal["file"]
+        if f in cache:
+            return cache[f]
+        try:
+            at = read_segment(
+                self.segments_dir, f,
+                {"crc32c": seal["crc32c"], "size": seal["size"]},
+            )
+        except SegmentCorruptError:
+            at = None
+        cache[f] = at
+        return at
+
+    def _materialize(self, items: list, batches: dict[int, dict]) -> Table:
+        """items → one concatenated snapshot Table (the shared tail of
+        ``read`` and the pruned scan)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        parts = []
+        seg_cache: dict = {}
+        for it in items:
+            if it[0] == "part":
+                e = it[2]
+                p = os.path.join(self.path, e["file"])
+                if os.path.exists(p) and e["rows"] > 0:
+                    parts.append(pq.read_table(p))
+                continue
+            s, run = it[1], it[2]
+            at = self._seal_arrow(s, seg_cache)
+            offs = _seal_offsets(s)
+            if at is not None:
+                a, b = offs[run[0]][0], offs[run[-1]][1]
+                if b > a:
+                    parts.append(at.slice(a, b - a))
+                continue
+            # rotten segment: serve the run from surviving parts (their
+            # bytes are what was sealed — a replay after the seal would
+            # have made these bids part-served); any missing part means
+            # data loss, which MUST be loud, never a shorter answer
+            for bid in run:
+                e = batches.get(bid)
+                fname = e["file"] if e else f"part-{bid:010d}.parquet"
+                if offs[bid][1] == offs[bid][0]:
+                    continue  # sealed empty batch
+                p = os.path.join(self.path, fname)
+                if not os.path.exists(p):
+                    raise SegmentCorruptError(
+                        f"sealed segment {s['file']} failed CRC and part"
+                        f" {fname} was retired — batch {bid} is"
+                        " unrecoverable here; run scrub() to quarantine"
+                        " and rebuild what survives"
+                    )
+                parts.append(pq.read_table(p))
+        if not parts:
+            return Table.empty(self.schema)
+        # schema inferred from the data: committed batches carry derived
+        # columns (ingest_time, :82) beyond the declared source schema
+        return Table.from_arrow(pa.concat_tables(parts))
 
     def read(self, upto_batch_id: int | None = None) -> Table:
         """Snapshot of all committed rows (the reference's ``spark.sql``
@@ -113,9 +341,6 @@ class UnboundedTable:
         no-re-transfer contract of ISSUE 7.  An append (or a replay that
         changes any commit entry) changes the key and drops the snapshot.
         """
-        import pyarrow.parquet as pq
-        import pyarrow as pa
-
         from ..obs.registry import global_registry
 
         # keyed (not single-slot) memo: a pinned retrain read
@@ -128,12 +353,13 @@ class UnboundedTable:
         # — the counters make that pressure visible.
         cache: dict = getattr(self, "_snapshots", None) or {}
         self._snapshots = cache
-        # commit-log stat fast path: every append/replay appends a commit
-        # line, so an unchanged (size, mtime_ns) proves the committed
-        # state unchanged — skip re-deriving the memo key (an O(batches)
-        # log parse + part-stat sweep) per query.  (The one divergence —
-        # a part rewritten in place with its commit line still in flight
-        # — correctly keeps serving the last COMMITTED snapshot.)
+        # commit-log stat fast path: every append/replay/seal/retire
+        # appends a commit line, so an unchanged (size, mtime_ns) proves
+        # the committed state unchanged — skip re-deriving the memo key
+        # (an O(batches) log parse + part-stat sweep) per query.  (The
+        # one divergence — a part rewritten in place with its commit
+        # line still in flight — correctly keeps serving the last
+        # COMMITTED snapshot.)
         stat = self.commit_log_stat()
         memo_keys: dict = getattr(self, "_memo_keys", None) or {}
         self._memo_keys = memo_keys
@@ -141,45 +367,204 @@ class UnboundedTable:
         if fast is not None and fast[0] == stat and fast[1] in cache:
             global_registry().inc("sql.cache.snapshot.hit")
             return cache[fast[1]]
-        entries = self.committed_batches()
-        if upto_batch_id is not None:
-            entries = {
-                bid: e for bid, e in entries.items() if bid <= upto_batch_id
-            }
+        items, batches = self._assembly(upto_batch_id)
         # the key includes each part's (size, mtime_ns): a replayed batch
         # with the SAME row count still rewrites its part file, and the
         # memo must not serve the stale snapshot (ISSUE 14 — the view
         # layer's retraction detector found this blind spot)
-        key = tuple(
-            (
-                bid, entries[bid]["file"], entries[bid]["rows"],
-                self._part_stat(entries[bid]["file"]),
-            )
-            for bid in sorted(entries)
-        )
+        key = self._assembly_key(items)
         memo_keys[upto_batch_id] = (stat, key)
         while len(memo_keys) > 8:  # pins come and go; never unbounded
             memo_keys.pop(next(iter(memo_keys)))
         if key in cache:
             global_registry().inc("sql.cache.snapshot.hit")
-            return cache[key]
-        global_registry().inc("sql.cache.snapshot.miss")
-        parts = []
-        for bid in sorted(entries):
-            p = os.path.join(self.path, entries[bid]["file"])
-            if os.path.exists(p) and entries[bid]["rows"] > 0:
-                parts.append(pq.read_table(p))
-        if not parts:
-            t = Table.empty(self.schema)
+            t = cache[key]
         else:
-            # schema inferred from the data: committed batches carry derived
-            # columns (ingest_time, :82) beyond the declared source schema
-            t = Table.from_arrow(pa.concat_tables(parts))
-        while len(cache) >= 4:  # a few live views, never unbounded growth
-            cache.pop(next(iter(cache)))
-        cache[key] = t
+            global_registry().inc("sql.cache.snapshot.miss")
+            t = self._materialize(items, batches)
+            while len(cache) >= 4:  # a few live views, never unbounded growth
+                cache.pop(next(iter(cache)))
+            cache[key] = t
+        # snapshots know where they came from: the compiled SQL planner
+        # follows this back to prune sealed segments by zone map
+        # (Table is frozen; these are bookkeeping attrs, not fields)
+        object.__setattr__(t, "_unbounded_origin", self)
+        object.__setattr__(t, "_origin_upto", upto_batch_id)
         return t
 
+    # ------------------------------------------------- sealed-batch view
+    def _seg_for(self, batch_id: int) -> dict | None:
+        """The committed seal currently serving ``batch_id``, or None
+        when the batch is part-served (never sealed, or replayed after
+        its seal)."""
+        batches, seals = self._committed_state()
+        best = None
+        for s in seals:
+            for b in s["batches"]:
+                if int(b["batch_id"]) == batch_id:
+                    if best is None or s["_seq"] > best["_seq"]:
+                        best = s
+        if best is None:
+            return None
+        e = batches.get(batch_id)
+        if e is not None and e["_seq"] > best["_seq"]:
+            return None  # replayed after the seal: the part supersedes
+        return best
+
+    def sealed_rows(self, batch_id: int) -> int | None:
+        """Row count the committed seal records for ``batch_id`` (None
+        when part-served) — the view layer's retraction detector uses
+        this to tell 'part retired into a segment, bytes preserved'
+        apart from 'part vanished'."""
+        s = self._seg_for(batch_id)
+        if s is None:
+            return None
+        for b in s["batches"]:
+            if int(b["batch_id"]) == batch_id:
+                return int(b["rows"])
+        return None
+
+    def read_sealed_batch(self, batch_id: int) -> Table | None:
+        """One batch's rows sliced back out of its sealed segment
+        (CRC-verified), or None when the batch is not segment-served or
+        sealed empty.  Rotten bytes raise :class:`SegmentCorruptError`
+        — the view layer must rebuild loudly, not fold garbage."""
+        s = self._seg_for(batch_id)
+        if s is None:
+            return None
+        a, b = _seal_offsets(s)[batch_id]
+        if b == a:
+            return None
+        at = read_segment(
+            self.segments_dir, s["file"],
+            {"crc32c": s["crc32c"], "size": s["size"]},
+        )
+        return Table.from_arrow(at.slice(a, b - a))
+
+    # ---------------------------------------------------------- pruning
+    def _zones_for(self, seal: dict) -> dict | None:
+        """Zone maps from a seal's manifest, cached by manifest stat
+        (None → manifest missing/unreadable → that segment is never
+        pruned, only scanned)."""
+        cache: dict = getattr(self, "_zone_cache", None) or {}
+        self._zone_cache = cache
+        mfile = seal.get("manifest") or ""
+        mstat = self._part_stat(SEGMENT_DIR + "/" + mfile)
+        ck = (mfile, mstat)
+        if ck in cache:
+            return cache[ck]
+        man = load_manifest(self.segments_dir, seal["file"])
+        zones = man.get("zones") if man else None
+        while len(cache) >= 16:
+            cache.pop(next(iter(cache)))
+        cache[ck] = zones
+        return zones
+
+    def _prune_items(self, items: list, lowered_filter) -> tuple[list, dict]:
+        """Drop segment runs whose zone maps prove no row can match the
+        compiled filter.  Conservative: missing manifests and unknown
+        predicate shapes always survive."""
+        stats = {
+            "segments": 0, "segments_pruned": 0,
+            "rows_pruned": 0, "parts_scanned": 0,
+        }
+        seen: set[str] = set()
+        pruned: set[str] = set()
+        keep = []
+        for it in items:
+            if it[0] == "part":
+                stats["parts_scanned"] += 1
+                keep.append(it)
+                continue
+            s, run = it[1], it[2]
+            if s["file"] not in seen:
+                seen.add(s["file"])
+                stats["segments"] += 1
+            zones = self._zones_for(s)
+            if (
+                lowered_filter is not None
+                and zones is not None
+                and not segment_may_match(zones, lowered_filter)
+            ):
+                if s["file"] not in pruned:
+                    pruned.add(s["file"])
+                    stats["segments_pruned"] += 1
+                offs = _seal_offsets(s)
+                stats["rows_pruned"] += sum(
+                    offs[bid][1] - offs[bid][0] for bid in run
+                )
+                continue
+            keep.append(it)
+        return keep, stats
+
+    def prune_stats(self, lowered_filter, upto_batch_id: int | None = None) -> dict:
+        """Manifest-only prune preview for ``explain()`` — no segment or
+        part bytes are read."""
+        items, _ = self._assembly(upto_batch_id)
+        _, stats = self._prune_items(items, lowered_filter)
+        return stats
+
+    def scan_pruned(
+        self, upto_batch_id: int | None, lowered_filter
+    ) -> tuple[Table | None, dict]:
+        """Segment-pruned snapshot for the compiled executor: rows whose
+        sealed zone maps prove the filter unsatisfiable never leave
+        disk.  Returns ``(None, stats)`` when NOTHING survives (the
+        caller builds an empty result off the full snapshot's schema);
+        when nothing prunes, returns the memoized full snapshot so the
+        device-column cache keeps paying off."""
+        from ..obs.registry import global_registry
+
+        # commit-log stat fast path (same contract as read()): between
+        # appends the committed state cannot change, so a repeated
+        # (filter, pin) pair skips the O(history) log parse + zone sweep
+        # — this is what keeps the dashboard query flat at 100x history
+        fast: dict = getattr(self, "_pruned_fast", None) or {}
+        self._pruned_fast = fast
+        stat = self.commit_log_stat()
+        fk = (upto_batch_id, repr(lowered_filter))
+        hit = fast.get(fk)
+        if hit is not None and hit[0] == stat:
+            _, t, stats = hit
+            if stats["segments_pruned"]:
+                global_registry().inc(
+                    "table.segments_prune_skipped", stats["segments_pruned"]
+                )
+            if t is _FULL_SNAPSHOT:
+                return self.read(upto_batch_id), stats
+            return t, stats
+
+        items, batches = self._assembly(upto_batch_id)
+        keep, stats = self._prune_items(items, lowered_filter)
+
+        def _memo_fast(t):
+            fast[fk] = (stat, t, stats)
+            while len(fast) > 8:
+                fast.pop(next(iter(fast)))
+
+        if stats["segments_pruned"] == 0:
+            _memo_fast(_FULL_SNAPSHOT)
+            return self.read(upto_batch_id), stats
+        global_registry().inc(
+            "table.segments_prune_skipped", stats["segments_pruned"]
+        )
+        if not keep:
+            _memo_fast(None)
+            return None, stats
+        cache: dict = getattr(self, "_pruned_cache", None) or {}
+        self._pruned_cache = cache
+        key = (self._assembly_key(keep), repr(lowered_filter))
+        if key in cache:
+            t = cache[key]
+        else:
+            t = self._materialize(keep, batches)
+            while len(cache) >= 4:
+                cache.pop(next(iter(cache)))
+            cache[key] = t
+        _memo_fast(t)
+        return t, stats
+
+    # ------------------------------------------------------------- misc
     def num_rows(self) -> int:
         return sum(e["rows"] for e in self.committed_batches().values())
 

@@ -19,7 +19,10 @@ from __future__ import annotations
 import json
 import os
 
-from ..utils.faults import InjectedCrash, fault_point, mangle_bytes, torn_point
+from ..utils.faults import (
+    InjectedCrash, enospc_error, enospc_point, fault_point, mangle_bytes,
+    torn_point,
+)
 
 
 def append_line(path: str, obj: dict) -> None:
@@ -67,6 +70,17 @@ def append_lines(
                 raise InjectedCrash(
                     f"torn write at byte {cut} of {path}", site=site
                 )
+            fit = enospc_point(site, len(payload), path=path)
+            if fit is not None:
+                # injected disk-full: short write, then ENOSPC at the
+                # fsync — the failure a real full disk produces.  The
+                # partial line is exactly a torn tail, which the next
+                # append's probe repairs; the error is an OSError, so
+                # retry ladders treat it like any other IO failure.
+                f.write(payload[:fit])
+                f.flush()
+                os.fsync(f.fileno())
+                raise enospc_error(site, fit)
         f.write(payload)
         f.flush()
         os.fsync(f.fileno())

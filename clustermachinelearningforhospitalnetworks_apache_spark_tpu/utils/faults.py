@@ -28,6 +28,16 @@ Actions:
 * ``corrupt``— flip bits in a payload passed through :func:`mangle_bytes`
 * ``tear``   — report a byte offset to :func:`torn_point`; the writer
   persists exactly that prefix and raises :class:`InjectedCrash`
+* ``disk_full`` (ISSUE 18) — the failure that actually kills long-lived
+  stores: ``ENOSPC``.  A rule carries a deterministic byte budget
+  (``after_bytes``); byte-charging writers consult :func:`enospc_point`
+  with each payload's length, and the write that crosses the budget
+  persists exactly the bytes that still fit (short write) and then
+  raises ``OSError(ENOSPC)`` at the fsync — the shape a full disk
+  really produces.  Plain :func:`fault_point` sites raise ``ENOSPC``
+  outright once the budget is spent (``after_bytes=0`` means
+  immediately), so one rule family covers both "this write crosses the
+  cliff" and "the disk is already full at this boundary".
 
 Data-plane corruption (PR 3) — the faults a *producer* commits rather
 than a disk: rules that rewrite CSV text passed through
@@ -65,6 +75,7 @@ nothing.
 
 from __future__ import annotations
 
+import errno
 import fnmatch
 import random
 import threading
@@ -76,6 +87,16 @@ from typing import Callable, Iterator, Sequence
 
 class FaultError(OSError):
     """Injected transient IO failure — retryable by design."""
+
+
+def enospc_error(site: str, wrote: int = 0) -> OSError:
+    """The ``OSError`` a full disk raises — real ``errno.ENOSPC``, so
+    production handlers that special-case disk exhaustion see exactly
+    what the kernel would hand them."""
+    return OSError(
+        errno.ENOSPC,
+        f"injected ENOSPC at {site} ({wrote} bytes persisted)",
+    )
 
 
 class InjectedCrash(BaseException):
@@ -127,6 +148,7 @@ class FaultRule:
     burst_len: int = 8                         # nan_burst row run length
     seen: int = 0                              # matching calls observed
     fired: int = 0                             # times actually fired
+    bytes_seen: int = 0                        # disk_full: bytes charged so far
 
     def matches(self, site: str, ctx: dict) -> bool:
         if not fnmatch.fnmatchcase(site, self.site):
@@ -203,6 +225,22 @@ class FaultPlan:
     ) -> "FaultPlan":
         return self._add(FaultRule(site, "tear", after, 1, at_byte=at_byte, when=when))
 
+    def disk_full(
+        self, site: str, after_bytes: int = 0,
+        times: int | None = 1, after: int = 0,
+        when: Callable[[dict], bool] | None = None,
+    ) -> "FaultPlan":
+        """ENOSPC once ``after_bytes`` have been charged at matching
+        sites.  Byte-charging writers (:func:`enospc_point`) get a short
+        write — exactly the bytes that still fit land on disk — then the
+        error at the fsync; plain :func:`fault_point` sites raise once
+        the budget is spent (``after_bytes=0``: the disk is already
+        full).  Deterministic by byte count, so a kill-and-resume test
+        replays the identical ENOSPC every run."""
+        return self._add(FaultRule(
+            site, "disk_full", after, times, at_byte=after_bytes, when=when,
+        ))
+
     # ------------------------------------------------- data corruption
     def mangle_fields(
         self, site: str, rate: float = 0.02,
@@ -267,16 +305,25 @@ class FaultPlan:
 
     # ------------------------------------------------------------ runtime
     def check(self, site: str, ctx: dict) -> None:
-        """Hook for fail/crash/delay rules — called by :func:`fault_point`."""
+        """Hook for fail/crash/delay rules — called by :func:`fault_point`.
+        A ``disk_full`` rule whose byte budget is spent raises ENOSPC
+        here too: past the cliff, every durable boundary sees it."""
         delay = 0.0
         boom: BaseException | None = None
         with self._lock:
             self.calls[site] = self.calls.get(site, 0) + 1
             for r in self.rules:
-                if r.action not in ("fail", "crash", "delay"):
+                if r.action not in ("fail", "crash", "delay", "disk_full"):
                     continue
+                if r.action == "disk_full" and r.bytes_seen < (r.at_byte or 0):
+                    continue  # budget not yet spent: no ENOSPC here yet
                 if not (r.matches(site, ctx) and r.take()):
                     continue
+                if r.action == "disk_full":
+                    self.log.append((site, "disk_full"))
+                    self._ring_note(site, "disk_full")
+                    boom = enospc_error(site)
+                    break
                 self.log.append((site, r.action))
                 self._ring_note(site, r.action)
                 if r.action == "delay":
@@ -356,6 +403,29 @@ class FaultPlan:
                 if cut < 0:  # negative = from the end (-1: all but last byte)
                     cut += length
                 return max(0, min(cut, length))
+        return None
+
+    def enospc_point(self, site: str, length: int, ctx: dict) -> int | None:
+        """Hook for disk_full rules on byte-charging writers → how many
+        of ``length`` bytes fit before the injected ENOSPC (``None`` =
+        the whole write fits / no rule).  Charges the rule's byte budget
+        either way, so the budget is a property of the *disk*, not of
+        which write happens to observe it."""
+        with self._lock:
+            self.calls[site] = self.calls.get(site, 0) + 1
+            for r in self.rules:
+                if r.action != "disk_full" or not r.matches(site, ctx):
+                    continue
+                budget = r.at_byte or 0
+                fit = max(0, budget - r.bytes_seen)
+                r.bytes_seen += length
+                if fit >= length:
+                    continue  # this write still fits entirely
+                if not r.take():
+                    continue  # times exhausted: space was "freed"
+                self.log.append((site, "disk_full"))
+                self._ring_note(site, "disk_full")
+                return min(fit, length)
         return None
 
 
@@ -460,6 +530,16 @@ def torn_point(site: str, length: int, **ctx) -> int | None:
     :class:`InjectedCrash`."""
     p = _ACTIVE
     return None if p is None else p.torn_point(site, length, ctx)
+
+
+def enospc_point(site: str, length: int, **ctx) -> int | None:
+    """How many of ``length`` bytes fit before an injected ENOSPC
+    (``None`` = no disk_full rule fires).  The caller persists exactly
+    that prefix (the short write a real full disk leaves), fsyncs it,
+    and raises :func:`enospc_error` — the torn-tail repair downstream
+    already knows how to survive the partial line."""
+    p = _ACTIVE
+    return None if p is None else p.enospc_point(site, length, ctx)
 
 
 def corrupt_data(site: str, text: str, **ctx) -> str:

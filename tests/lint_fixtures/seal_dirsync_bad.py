@@ -1,0 +1,22 @@
+"""Fixture: seal-without-dirsync regression (ISSUE 18) — a segment
+publish that renames the staged bytes into place but never fsyncs the
+segments directory.  The commit-log seal entry IS fsync'd, so power
+loss here could keep a seal entry whose segment file the directory
+forgot — exactly the rename-without-dirsync shape, staged at the
+sanctioned ``core/segments.py`` path by the test."""
+
+import os
+
+
+def _publish(tmp, final_path):
+    os.replace(tmp, final_path)  # BAD: no dirsync here or in any caller
+
+
+def stage_segment(seg_dir, payload):
+    final = os.path.join(seg_dir, "seg-0000000000-0000000003.parquet")
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    _publish(tmp, final)

@@ -1,0 +1,28 @@
+"""Fixture: seal-without-dirsync clean twin — same staged publish, but
+the caller fsyncs the segments directory after the rename lands (the
+ladder :mod:`core.segments` actually implements)."""
+
+import os
+
+
+def fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _publish(tmp, final_path):
+    os.replace(tmp, final_path)
+
+
+def stage_segment(seg_dir, payload):
+    final = os.path.join(seg_dir, "seg-0000000000-0000000003.parquet")
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    _publish(tmp, final)
+    fsync_dir(seg_dir)

@@ -38,6 +38,10 @@ from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming import
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.microbatch import (
     BATCH_QUARANTINED,
 )
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table_lifecycle import (
+    RetentionPolicy,
+    TableLifecycle,
+)
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import faults
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils.retry import (
     RetryPolicy,
@@ -627,3 +631,115 @@ def test_quarantine_record_is_json_and_atomic(tmp_path):
     assert rec["batch_id"] == 7 and rec["attempts"] == 3
     assert rec["sink_rows_visible"] is True
     assert ck.quarantine_count() == 1
+
+
+# ====================================================== table lifecycle kills
+def _event_batch(bid, n=6, hospital="H01"):
+    base = np.datetime64("2025-03-31T22:00:00") + np.timedelta64(bid, "m")
+    return ht.Table.from_dict(
+        {
+            "hospital_id": np.array([hospital] * n, dtype=object),
+            "event_time": (base + np.arange(n).astype("timedelta64[s]")
+                           ).astype("datetime64[ns]"),
+            "admission_count": np.arange(n) + bid * 100,
+            "current_occupancy": np.full(n, 100),
+            "emergency_visits": np.full(n, 5),
+            "seasonality_index": np.full(n, 1.0),
+            "length_of_stay": np.full(n, 4.0),
+        },
+        ht.hospital_event_schema(),
+    )
+
+
+def _mk_history(tmp_path, n_batches=8):
+    tbl = UnboundedTable(str(tmp_path / "tbl"), ht.hospital_event_schema())
+    for bid in range(n_batches):
+        tbl.append_batch(_event_batch(bid), bid)
+    return tbl
+
+
+def _assert_tables_bit_identical(a, b):
+    assert list(a.columns) == list(b.columns)
+    assert len(a) == len(b)
+    for c in a.columns:
+        va, vb = a.column(c), b.column(c)
+        assert va.dtype == vb.dtype, c
+        if va.dtype == object:
+            assert list(va) == list(vb), c
+        else:
+            assert va.tobytes() == vb.tobytes(), c
+
+
+LIFECYCLE_POLICY = RetentionPolicy(min_seal_batches=2, hot_batches=2,
+                                   max_segment_batches=3)
+TABLE_SITES = ["table.seal.stage", "table.seal.commit", "table.retire.commit"]
+
+
+@pytest.mark.parametrize("site", TABLE_SITES)
+def test_table_lifecycle_killed_resumes_bit_identical(tmp_path, site):
+    """Kill the lifecycle at each seal/retire boundary; a reopened table
+    must read exactly the pre-lifecycle snapshot both immediately after
+    the kill and after a resumed tick completes the pass."""
+    tbl = _mk_history(tmp_path)
+    ref = tbl.read()
+    plan = faults.FaultPlan().crash(site)
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            TableLifecycle(tbl, LIFECYCLE_POLICY).tick()
+    assert plan.fired(site) == 1
+
+    reopened = UnboundedTable(tbl.path, ht.hospital_event_schema())
+    _assert_tables_bit_identical(reopened.read(), ref)  # mid-crash state
+    TableLifecycle(reopened, LIFECYCLE_POLICY).tick()   # resume finishes
+    final = UnboundedTable(tbl.path, ht.hospital_event_schema())
+    _assert_tables_bit_identical(final.read(), ref)
+
+    # retired parts are never referenced by the commit-log read plan,
+    # and every file the plan DOES reference exists on disk
+    retired = {
+        f for e in final._log_entries() if "retire" in e
+        for f in e["retire"]["files"]
+    }
+    items, _ = final._assembly()
+    for it in items:
+        if it[0] == "part":
+            assert it[2]["file"] not in retired
+            assert os.path.exists(os.path.join(final.path, it[2]["file"]))
+    for f in retired:
+        assert not os.path.exists(os.path.join(final.path, f))
+
+
+def test_table_scrub_killed_mid_repair_resumes(tmp_path):
+    """Kill scrub at table.scrub.repair (after rot is detected, before
+    the quarantine/rebuild lands); a resumed scrub must finish the
+    repair and the table reads bit-identical to the pre-rot snapshot."""
+    tbl = _mk_history(tmp_path)
+    ref = tbl.read()
+    keep = RetentionPolicy(min_seal_batches=2, hot_batches=2,
+                           max_segment_batches=3, retire_parts=False)
+    TableLifecycle(tbl, keep).seal()
+    seg = sorted(
+        f for f in os.listdir(tbl.segments_dir) if f.endswith(".parquet")
+    )[0]
+    p = os.path.join(tbl.segments_dir, seg)
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0x01
+    with open(p, "wb") as f:
+        f.write(bytes(blob))
+
+    plan = faults.FaultPlan().crash("table.scrub.repair")
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            TableLifecycle(tbl, keep).scrub()
+    assert plan.fired("table.scrub.repair") == 1
+
+    reopened = UnboundedTable(tbl.path, ht.hospital_event_schema())
+    _assert_tables_bit_identical(reopened.read(), ref)  # parts still serve
+    out = TableLifecycle(reopened, keep).scrub()
+    assert out["repaired"] == 1
+    final = UnboundedTable(tbl.path, ht.hospital_event_schema())
+    _assert_tables_bit_identical(final.read(), ref)
+    # the rotten bytes were quarantined aside, not deleted evidence
+    assert any(
+        f.endswith(".quarantine") for f in os.listdir(final.segments_dir)
+    )

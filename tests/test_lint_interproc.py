@@ -244,6 +244,8 @@ NEW_RULE_CASES = [
      False),
     ("dirsync_bad.py", f"{PKG}/streaming/checkpoint.py",
      lambda: [DurabilityPass()], {"rename-without-dirsync"}, False),
+    ("seal_dirsync_bad.py", f"{PKG}/core/segments.py",
+     lambda: [DurabilityPass()], {"rename-without-dirsync"}, False),
     ("crash_swallow_bad.py", f"{PKG}/models/crash_swallow_bad.py",
      lambda: [CrashProtocolPass()], {"crash-swallowed"}, False),
     ("journal_site_bad.py", f"{PKG}/io/fit_checkpoint.py",

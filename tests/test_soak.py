@@ -234,6 +234,22 @@ def test_check_report_catches_each_invariant(smoke):
     def unbounded(p):
         p["resources"] = {"bounded": False, "violations": ["rss grew 9x"]}
 
+    def no_lifecycle(p):
+        p["lifecycle"]["ticks"] = []
+
+    def lost_segment(p):
+        p["lifecycle"]["ticks"][0]["scrub"] = {
+            "checked": 1, "repaired": 0, "quarantined": 1,
+        }
+
+    def table_over_budget(p):
+        p["resources"]["samples"][1]["table_kb"] = (
+            p["config"]["table_budget_mb"] * 1024.0 + 1.0
+        )
+
+    def table_unobserved(p):
+        p["resources"]["samples"][0].pop("table_kb", None)
+
     def broken_trace(p):
         p["trace"]["span_names"] = ["stream.batch"]
 
@@ -252,6 +268,10 @@ def test_check_report_catches_each_invariant(smoke):
         (second_kill_missing, "fewer than 2 postmortems"),
         (not_bit_identical, "NOT bit-identical"),
         (unbounded, "rss grew 9x"),
+        (no_lifecycle, "seal/retire/scrub never ran"),
+        (lost_segment, "quarantined without rebuild"),
+        (table_over_budget, "over the"),
+        (table_unobserved, "table_kb not recorded"),
         (broken_trace, "span chain incomplete"),
         (not_replayable, "not replayable"),
         (wrong_version, "schema version"),

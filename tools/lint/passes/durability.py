@@ -54,6 +54,7 @@ SANCTIONED = tuple(
         "streaming/wal.py", "streaming/checkpoint.py",
         "streaming/unbounded_table.py",
         "core/sql_views.py",
+        "core/segments.py",
         "lifecycle/feedback.py", "lifecycle/journal.py",
         "soak/report.py",
     )

@@ -43,7 +43,15 @@
 # (tests/test_federated.py kills a cross-silo k-means fit at every
 # round phase — fed.round.{collect,merge,fit,broadcast} — and asserts
 # the journal-resumed coordinator finishes bit-identical without
-# re-asking silos for work already journaled).
+# re-asking silos for work already journaled), and the table history
+# lifecycle (ISSUE 18: tests/test_chaos.py kills seal/retire/scrub at
+# table.seal.{stage,commit} / table.retire.commit / table.scrub.repair
+# and asserts resumed reads are bit-identical with retired parts never
+# referenced; tests/test_table_lifecycle.py adds the disk-exhaustion
+# rows — ENOSPC injected at stream.after_sink / table.seal.commit /
+# fit_ckpt.save.arrays degrades without an unhandled exception, and a
+# table-level disk budget backpressures ingest into a `disk:budget`
+# quarantine while committed reads keep serving).
 #
 # ISSUE 10: every InjectedCrash dumps the observability flight recorder
 # (bounded event ring + metrics snapshot, CRC32C-wrapped, atomic write).
@@ -111,6 +119,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
     tests/test_stream_pipeline.py tests/test_gbt_fused.py \
     tests/test_lifecycle.py tests/test_model_farm.py tests/test_fleet.py \
     tests/test_sql_views.py tests/test_federated.py \
+    tests/test_table_lifecycle.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -125,7 +134,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet|sql_views|federated)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet|sql_views|federated|table_lifecycle)\.py::(\S+)",
         line,
     )
     if not m:
@@ -191,7 +200,7 @@ for site in sorted(sites):
 # every kill family in the matrix must have left at least one artifact
 import fnmatch
 FAMILIES = ["stream.after_*", "wal.append", "fit_ckpt.*",
-            "model_io.save.*", "lifecycle.*", "fed.round.*"]
+            "model_io.save.*", "lifecycle.*", "fed.round.*", "table.*"]
 missing = [
     fam for fam in FAMILIES
     if not any(fnmatch.fnmatchcase(s, fam) for s in sites)
