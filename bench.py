@@ -402,7 +402,9 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
         device_dataset,
     )
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.partitioner import (
+        family as partitioner_family,
+    )
 
     platform, on_tpu, n, timed_iters, mesh, n_chips = _bench_setup(default_rows)
 
@@ -422,8 +424,13 @@ def _bench_kmeans_lloyd(k: int, default_rows: int, bundled: bool = False) -> dic
     cen[:k] = x[rng.choice(n, size=k, replace=False)]
     c_valid = np.zeros((k_pad,), dtype=np.float32)
     c_valid[:k] = 1.0
-    centers0 = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
-    c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+    km_pt = partitioner_family("kmeans")
+    centers0 = jax.device_put(
+        cen, km_pt.sharding("state/centers", mesh=mesh, ndim=2)
+    )
+    c_valid_dev = jax.device_put(
+        c_valid, km_pt.sharding("state/c_valid", mesh=mesh, ndim=1)
+    )
 
     est = KMeans(k=k)
     n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
@@ -1407,7 +1414,9 @@ def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
         device_dataset,
     )
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.partitioner import (
+        family as partitioner_family,
+    )
 
     platform, on_tpu, n, iters, mesh, n_chips = _bench_setup(2_000_000)
     if not on_tpu:
@@ -1423,9 +1432,13 @@ def _bench_pallas_ab(k: int = 64, d: int = 64) -> dict:
     ds = device_dataset(x, mesh=mesh)
     rng = np.random.default_rng(1)
     cen = x[rng.choice(n, size=k, replace=False)]
-    centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    km_pt = partitioner_family("kmeans")
+    centers = jax.device_put(
+        cen, km_pt.sharding("state/centers", mesh=mesh, ndim=2)
+    )
     c_valid = jax.device_put(
-        np.ones((k,), np.float32), NamedSharding(mesh, P(MODEL_AXIS))
+        np.ones((k,), np.float32),
+        km_pt.sharding("state/c_valid", mesh=mesh, ndim=1),
     )
     n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
 
@@ -1470,7 +1483,9 @@ def _bench_kmeans_fused_ab(k: int = 256, d: int = 8) -> dict:
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.sharding import (
         device_dataset,
     )
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.partitioner import (
+        family as partitioner_family,
+    )
 
     platform, on_tpu, n, iters, mesh, n_chips = _bench_setup(10_000_000)
     if not on_tpu:
@@ -1490,8 +1505,13 @@ def _bench_kmeans_fused_ab(k: int = 256, d: int = 8) -> dict:
     cen[:k] = x[rng.choice(n, size=k, replace=False)]
     c_valid = np.zeros((k_pad,), dtype=np.float32)
     c_valid[:k] = 1.0
-    centers0 = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
-    c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+    km_pt = partitioner_family("kmeans")
+    centers0 = jax.device_put(
+        cen, km_pt.sharding("state/centers", mesh=mesh, ndim=2)
+    )
+    c_valid_dev = jax.device_put(
+        c_valid, km_pt.sharding("state/c_valid", mesh=mesh, ndim=1)
+    )
     n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
     chunk = int(os.environ.get("BENCH_KMEANS_CHUNK", 131072))
 
@@ -3292,6 +3312,170 @@ def _bench_serve_fleet() -> dict:
     }
 
 
+def _bench_serve_fleet_multiproc() -> dict:
+    """Multi-process fleet scaling (ISSUE 19b): N replicas as REAL OS
+    processes (``serve/fleet/proc.ProcReplicaSet``), each with its own
+    JAX runtime, driven over the length-prefixed socket RPC.
+
+    The question the in-process leg (``serve_fleet``) cannot answer:
+    does goodput scale with N once replicas stop sharing a Python
+    process?  Here every leg offers the SAME saturating load (a fixed
+    multiple of the single-server raw rate), so aggregate in-SLO
+    goodput measures delivered capacity, and ``scaling_1to2`` /
+    ``scaling_2to4`` are the headline ratios.
+
+    Honest accounting (PR 4 discipline): on a single-core host the N
+    worker processes timeshare one core, so the ratios CANNOT clear the
+    gate there — the gate is armed (``pending``) and only evaluated
+    when ``host_cores >= 2``; the measured ratios are still recorded to
+    the evidence sidecar either way.  There is deliberately no
+    ``shared_core_proxy`` escape hatch: these are real processes, and
+    ``host_cores`` carries the whole story.
+    """
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        fleet as F,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.registry import (
+        ServingModel,
+    )
+
+    platform, on_tpu, _, _, _, n_chips = _bench_setup(4000)
+    host_cores = os.cpu_count() or 1
+    overload = float(os.environ.get("BENCH_FLEET_OVERLOAD", 2.5))
+    dur = float(os.environ.get("BENCH_FLEET_SECONDS", 3.0))
+    legs = tuple(
+        int(v)
+        for v in os.environ.get("BENCH_FLEET_PROCS", "1,2,4").split(",")
+    )
+
+    # served model: small enough that N workers' cold inits stay cheap
+    # (they share the persistent compile cache), heavy enough per row
+    # that worker compute — not RPC framing — dominates
+    rng = np.random.default_rng(0)
+    n_train, d, k = 4000, 32, 256
+    x = rng.normal(size=(n_train, d)).astype(np.float32)
+    model = ht.KMeans(k=k, max_iter=2, seed=0).fit(x)
+    rows = 16
+    buckets = (rows,)
+
+    classes = F.default_slo_classes()
+    deadlines = {name: c.default_deadline_s for name, c in classes.items()}
+    pin_s = deadlines["interactive"]
+    mix = tuple(
+        F.TenantMix(f"H{i:02d}", 1.0, "interactive", rows) for i in range(8)
+    )
+
+    # single-server raw executable rate: the load yardstick every leg
+    # is offered the same multiple of
+    probe_sm = ServingModel(model, buckets=buckets)
+    probe_sm.warmup()
+    probe_x = x[:rows]
+    t0 = time.perf_counter()
+    probed = 0
+    while time.perf_counter() - t0 < 0.6:
+        probe_sm.predict_bucketed(probe_x)
+        probed += rows
+    raw_rate = probed / (time.perf_counter() - t0)
+    offered_rate = overload * raw_rate
+
+    parent_pid = os.getpid()
+
+    def run_n(n: int) -> dict:
+        sched = F.build_schedule(
+            F.LoadProfile(
+                base_rate_rps=offered_rate / rows, tenants=mix, seed=42,
+                burst_start_s=dur / 3.0, burst_dur_s=dur / 3.0,
+                burst_mult=1.5,
+            ),
+            dur,
+        )
+        fs = F.ProcReplicaSet(n_replicas=n, max_queue_rows=384)
+        fs.add_model("km", model, buckets=buckets)
+        with fs:
+            pids = [r.server.pid for r in fs.replicas]
+            rep = F.replay(
+                lambda a: fs.submit(
+                    "km", x[: a.rows], tenant_id=a.tenant_id, slo=a.slo,
+                    deadline_s=deadlines[a.slo],
+                ),
+                sched, wait_timeout_s=8.0,
+            )
+        r = rep["reports"].get("interactive")
+        hit = (
+            r.in_slo(pin_s) if r is not None
+            else {"rows": 0, "p50_ms": None, "p99_ms": None}
+        )
+        return {
+            "n_procs": n,
+            "in_slo_rows_per_s": round(hit["rows"] / rep["gen_wall_s"], 1),
+            "total_ok_rows_per_s": round(
+                rep["ok_rows"] / rep["gen_wall_s"], 1
+            ),
+            "in_slo_p99_ms": hit["p99_ms"],
+            "unanswered": rep["unanswered"],
+            # the leg's own proof these were distinct OS processes
+            "distinct_procs": (
+                len(set(pids)) == n and parent_pid not in pids
+            ),
+            "worker_pids": pids,
+        }
+
+    leg_rows = [run_n(n) for n in legs]
+    goodput = {r["n_procs"]: r["in_slo_rows_per_s"] for r in leg_rows}
+
+    def ratio(a: int, b: int):
+        if a in goodput and b in goodput and goodput[a] > 0:
+            return round(goodput[b] / goodput[a], 2)
+        return None
+
+    scaling_1to2 = ratio(1, 2)
+    scaling_2to4 = ratio(2, 4)
+
+    gate_min_ratio = 1.7
+    if host_cores < 2:
+        gate = "pending"
+        gate_detail = (
+            f"{host_cores}-core host: N worker processes timeshare one "
+            "core, so the ratio cannot reflect capacity; gate armed, "
+            "evaluated on the next multi-core run (ratios recorded)"
+        )
+    elif scaling_1to2 is None:
+        gate = "error"
+        gate_detail = "missing the N=1 or N=2 leg"
+    else:
+        gate = "pass" if scaling_1to2 >= gate_min_ratio else "fail"
+        gate_detail = (
+            f"scaling_1to2={scaling_1to2} vs min {gate_min_ratio} "
+            f"on {host_cores} cores"
+        )
+
+    row = {
+        "metric": (
+            f"serve_fleet_multiproc in-SLO goodput scaling across real "
+            f"OS-process replicas N={list(legs)} (KMeans k={k} d={d}, "
+            f"{platform}, {host_cores} host cores)"
+        ),
+        "value": scaling_1to2,
+        "unit": "goodput ratio N=1 -> N=2",
+        "scaling_1to2": scaling_1to2,
+        "scaling_2to4": scaling_2to4,
+        "gate_min_ratio": gate_min_ratio,
+        "gate": gate,
+        "gate_detail": gate_detail,
+        "host_cores": host_cores,
+        "legs": leg_rows,
+        "all_legs_distinct_procs": all(r["distinct_procs"] for r in leg_rows),
+        "all_legs_answered": all(r["unanswered"] == 0 for r in leg_rows),
+        "raw_rate_rows_per_s": round(raw_rate, 1),
+        "offered_rows_per_s": round(offered_rate, 1),
+        "p99_pin_ms": pin_s * 1e3,
+        "platform": platform,
+    }
+    _sidecar_append({"kind": "serve_fleet_multiproc", **row})
+    return row
+
+
 def _bench_federated() -> dict:
     """Federated-fit config (ISSUE 16): a ≥4-silo cross-silo k-means fit
     vs the pooled fit on the same rows.
@@ -3514,14 +3698,18 @@ CONFIGS = {
     "obs_overhead": lambda: _bench_obs_overhead(),              # ISSUE 10 gate
     "model_farm": lambda: _bench_model_farm(),                  # ISSUE 11 A/B
     "serve_fleet": lambda: _bench_serve_fleet(),                # ISSUE 12 fleet
+    "serve_fleet_multiproc": lambda: _bench_serve_fleet_multiproc(),  # ISSUE 19
     "federated": lambda: _bench_federated(),                    # ISSUE 16 silos
     "soak": lambda: _bench_soak(),                              # ISSUE 17 day
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets
 # the compile + 10M-row CPU-proxy headroom.
-_CONFIG_TIMEOUT = {"kmeans256": 780}  # 5-candidate autotune + bf16 A/B
-# (each candidate pays a ~20-40s cold compile before its ≥2s window)
+_CONFIG_TIMEOUT = {
+    "kmeans256": 780,  # 5-candidate autotune + bf16 A/B
+    # (each candidate pays a ~20-40s cold compile before its ≥2s window)
+    "serve_fleet_multiproc": 600,  # 3 legs x N worker spawns + cold inits
+}
 _DEFAULT_CONFIG_TIMEOUT = 420
 
 
@@ -3756,6 +3944,7 @@ def _child_main(name: str) -> None:
 #: win-or-retire decision needs, then the reference's own hot paths).
 _TPU_PRIORITY = [
     "kmeans256", "pallas_ab", "kmeans_fused_ab", "model_farm", "serve_fleet",
+    "serve_fleet_multiproc",
     "federated", "sql_device", "sql_incremental", "sql_history", "rf20",
     "gbt20", "nb",
     "gmm32", "bisecting", "streaming", "streaming_pipeline", "kmeans8",

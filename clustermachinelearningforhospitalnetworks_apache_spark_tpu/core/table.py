@@ -361,8 +361,16 @@ class Table:
                     f"column {name!r} ({col.dtype}) has no device "
                     "representation — string columns stay on the host"
                 )
+            from ..parallel.mesh import single_device_mesh
+            from ..parallel.partitioner import family as _partitioner_family
+
             with enable_x64():
-                arr = jax.device_put(host)
+                # the SQL executor's bucket placement, declared in the one
+                # partitioner: replicated over the single-device SQL mesh
+                # (device 0 — identical to the former bare device_put)
+                arr = _partitioner_family("sql").put(
+                    "column", host, single_device_mesh()
+                )
             self._device_cache[key] = arr
         return arr
 

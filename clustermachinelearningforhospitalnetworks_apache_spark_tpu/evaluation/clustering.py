@@ -30,9 +30,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..parallel.mesh import DATA_AXIS, default_mesh
+from ..parallel.partitioner import family as _partitioner_family
+
+#: row-aligned silhouette layouts — rules in parallel/partitioner.py
+_pt = _partitioner_family("clustering_eval")
 from ..parallel.sharding import DeviceDataset, device_dataset, shard_rows
 
 #: rows per scan step — bounds the (chunk, k) distance tile in VMEM/HBM
@@ -124,8 +128,12 @@ def _make_silhouette(mesh: Mesh, k: int, chunk: int):
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P()),
+            in_specs=(
+                _pt.spec("rows/x", 2),
+                _pt.spec("rows/assign", 1),
+                _pt.spec("rows/w", 1),
+            ),
+            out_specs=(_pt.spec("scalar/s"), _pt.spec("scalar/w")),
         )
     )
 

@@ -188,6 +188,22 @@ def pack_tenants(
 # ==========================================================================
 
 
+def _place_stack(path: str, arr) -> jax.Array:
+    """Tenant-stacked array onto the device through the declarative farm
+    rules (``parallel/partitioner.py`` family ``"farm"``): the TENANT
+    axis aliases to None on a single runtime — the vmap-over-tenants
+    placement every CPU/single-chip farm uses — and flips to a mesh axis
+    on a tenant-bucketed pod by re-registering the alias, with zero
+    changes here.  The single-device mesh keeps today's placement
+    bit-identical (device 0, one copy)."""
+    from ..parallel.mesh import single_device_mesh
+    from ..parallel.partitioner import family as _partitioner_family
+
+    return _partitioner_family("farm").put(
+        path, np.asarray(arr, np.float32), single_device_mesh()
+    )
+
+
 def _linear_stats(xa, y, w):
     """Per-tenant WLS sufficient statistics on the (R, dd) augmented
     design: (Gram, moment, Σw).  The one copy both the vmapped farm fit
@@ -815,8 +831,9 @@ class FarmLinearRegression:
         sp = _trace.span("farm.fit", {"family": "linear"})
         with sp:
             theta, theta_g = _farm_linear_fit(
-                jnp.asarray(batch.x), jnp.asarray(batch.y),
-                jnp.asarray(batch.w),
+                _place_stack("stack/x", batch.x),
+                _place_stack("stack/y", batch.y),
+                _place_stack("stack/w", batch.w),
                 jnp.float32(self.reg_param), jnp.float32(self.pool),
                 self.fit_intercept,
             )
@@ -889,8 +906,8 @@ class FarmKMeans:
         centers0, c_valid = _init_farm_centers(
             batch.x, batch.w, self.k, self.seed
         )
-        x_dev = jnp.asarray(batch.x, jnp.float32)
-        w_dev = jnp.asarray(batch.w, jnp.float32)
+        x_dev = _place_stack("stack/x", batch.x)
+        w_dev = _place_stack("stack/w", batch.w)
         cv_dev = jnp.asarray(c_valid)
 
         ckpt = None

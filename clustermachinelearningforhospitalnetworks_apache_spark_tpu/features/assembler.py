@@ -67,10 +67,10 @@ class VectorAssembler:
         host numpy.
         """
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..core.schema import LABEL_COL
         from ..parallel.mesh import DATA_AXIS, default_mesh
+        from ..parallel.partitioner import family as _partitioner_family
         from ..parallel.sharding import DeviceDataset
 
         if label_col is None and LABEL_COL in view.out_names:
@@ -100,11 +100,10 @@ class VectorAssembler:
             # power-of-two bucket, power-of-two data axis: the bucket is
             # already divisible, so this is a pure device-to-device
             # resharding (no host round trip)
-            row = NamedSharding(mesh, P(DATA_AXIS))
-            mat = NamedSharding(mesh, P(DATA_AXIS, None))
-            x = jax.device_put(x, mat)
-            y = jax.device_put(y, row)
-            w = jax.device_put(w, row)
+            _pt = _partitioner_family("rows")
+            x = _pt.put("batch/x", x, mesh)
+            y = _pt.put("batch/y", y, mesh)
+            w = _pt.put("batch/w", w, mesh)
         return DeviceDataset(x=x, y=y, w=w)
 
 

@@ -46,11 +46,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..io.model_io import register_model
 from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, default_mesh
+from ..parallel.partitioner import family as _partitioner_family
+
+#: row-parallel bisecting layouts — rules in parallel/partitioner.py
+_PT = _partitioner_family("bisecting")
 from ..parallel.sharding import DeviceDataset
 from .base import Estimator, as_device_dataset
 from ..parallel.sharding import chunk_layout, chunked_pad
@@ -294,8 +298,9 @@ def _make_fit_loop(
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P(), P()),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(_PT.spec("batch/x", 2), _PT.spec("batch/w", 1))
+            + (_PT.spec("const/state"),) * 3,
+            out_specs=(_PT.spec("const/state"),) * 4,
         )
     )
 

@@ -31,11 +31,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.scipy.special import logsumexp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..io.model_io import register_model
 from ..ops.distance import matmul_p, validate_matmul_precision
 from ..parallel.mesh import DATA_AXIS, default_mesh
+from ..parallel.partitioner import family as _partitioner_family
+
+#: declarative EM layouts — rules in parallel/partitioner.py
+_PT = _partitioner_family("gmm")
 from ..parallel.outofcore import add_stats as _gmm_add_stats
 from ..parallel.sharding import DeviceDataset
 from .base import ClusteringModel, Estimator, Model, as_device_dataset, check_features
@@ -225,16 +229,10 @@ def _make_em_loop(
             shard_fn,
             mesh=mesh,
             in_specs=(
-                P(DATA_AXIS, None),
-                P(DATA_AXIS),
-                P(),
-                P(),
-                P(),
-                P(),
-                P(),
-                P(),
-            ),
-            out_specs=(P(), P(), P(), P(), P()),
+                _PT.spec("batch/x", 2),
+                _PT.spec("batch/w", 1),
+            ) + (_PT.spec("const/params"),) * 6,
+            out_specs=(_PT.spec("const/params"),) * 5,
         )
     )
 
@@ -284,8 +282,9 @@ def _make_em_stats_step(
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P(), P(), P()),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(_PT.spec("batch/x", 2), _PT.spec("batch/w", 1))
+            + (_PT.spec("const/params"),) * 4,
+            out_specs=(_PT.spec("const/params"),) * 4,
         )
     )
 
@@ -333,8 +332,9 @@ def _make_predict_assigned(mesh: Mesh | None, chunk: int):
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(), P(), P()),
-            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(_PT.spec("batch/x", 2),)
+            + (_PT.spec("const/params"),) * 3,
+            out_specs=(_PT.spec("rows/assign", 1), _PT.spec("rows/logprob", 1)),
         )
     )
 
@@ -396,7 +396,7 @@ class GaussianMixtureModel(ClusteringModel):
         chunked assign and the training E-step's row scan).  Mesh-sharded
         inputs run shard-locally under ``shard_map``.
         """
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from jax.sharding import Mesh
 
         check_features(x, self.means.shape[1], "GaussianMixtureModel")
         logw, means, chols = self._device_params()
@@ -405,12 +405,11 @@ class GaussianMixtureModel(ClusteringModel):
         fn = _make_predict_assigned(mesh, chunk)
         xf = x.astype(jnp.float32)
         if mesh is not None:
-            rep = NamedSharding(mesh, P())
             return fn(
                 xf,
-                jax.device_put(logw, rep),
-                jax.device_put(means, rep),
-                jax.device_put(chols, rep),
+                _PT.put("const/logw", logw, mesh),
+                _PT.put("const/means", means, mesh),
+                _PT.put("const/chols", chols, mesh),
             )
         return fn(xf, logw, means, chols)
 

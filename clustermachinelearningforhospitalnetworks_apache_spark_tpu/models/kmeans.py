@@ -35,11 +35,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..io.model_io import register_model
 from ..ops.distance import normalize_rows, pairwise_sqdist, sq_norms
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
+from ..parallel.partitioner import family as _partitioner_family
+
+#: the one declarative rule table for Lloyd layouts (parallel/partitioner.py)
+_PT = _partitioner_family("kmeans")
 from ..parallel.outofcore import add_stats as _add_stats
 from ..parallel.sharding import (
     DeviceDataset,
@@ -209,8 +213,10 @@ def _make_train_step(
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
-            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P()),
+            in_specs=(_PT.spec("batch/x", 2), _PT.spec("batch/w", 1),
+                      _PT.spec("state/centers", 2), _PT.spec("state/c_valid", 1)),
+            out_specs=(_PT.spec("stats/sums", 2), _PT.spec("stats/counts", 1),
+                       _PT.spec("scalar/cost"), _PT.spec("scalar/move")),
         )
     )
 
@@ -240,8 +246,10 @@ def _make_stats_step(
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
-            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P()),
+            in_specs=(_PT.spec("batch/x", 2), _PT.spec("batch/w", 1),
+                      _PT.spec("state/centers", 2), _PT.spec("state/c_valid", 1)),
+            out_specs=(_PT.spec("stats/sums", 2), _PT.spec("stats/counts", 1),
+                       _PT.spec("scalar/cost")),
         )
     )
 
@@ -296,8 +304,10 @@ def _make_train_step_fused(mesh: Mesh, k_pad: int, cosine: bool):
         jax.shard_map(
             shard_fn,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(MODEL_AXIS, None), P(MODEL_AXIS)),
-            out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS), P(), P()),
+            in_specs=(_PT.spec("batch/x", 2), _PT.spec("batch/w", 1),
+                      _PT.spec("state/centers", 2), _PT.spec("state/c_valid", 1)),
+            out_specs=(_PT.spec("stats/sums", 2), _PT.spec("stats/counts", 1),
+                       _PT.spec("scalar/cost"), _PT.spec("scalar/move")),
             check_vma=False,
         )
     )
@@ -665,8 +675,8 @@ class KMeans(Estimator):
                 )
             cen = pad_slots(centers0, k_pad)
         c_valid = slot_mask(self.k, k_pad)
-        centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
-        c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+        centers = _PT.put("state/centers", cen, mesh)
+        c_valid_dev = _PT.put("state/c_valid", c_valid, mesh)
 
         _, b = hd.block_shape(mesh)
         n_loc = b // mesh.shape[DATA_AXIS]
@@ -802,10 +812,8 @@ class KMeans(Estimator):
         cen = pad_slots(
             np.asarray(state.params["centers"], np.float32), k_pad
         )
-        centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
-        c_valid = jax.device_put(
-            slot_mask(self.k, k_pad), NamedSharding(mesh, P(MODEL_AXIS))
-        )
+        centers = _PT.put("state/centers", cen, mesh)
+        c_valid = _PT.put("state/c_valid", slot_mask(self.k, k_pad), mesh)
         n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
         if final or self.matmul_precision == "highest":
             # exact precision for the closing stats pass (same rule as
@@ -947,8 +955,8 @@ class KMeans(Estimator):
                 )
             cen = pad_slots(centers0, k_pad)
         c_valid = slot_mask(self.k, k_pad)
-        centers = jax.device_put(cen, NamedSharding(mesh, P(MODEL_AXIS, None)))
-        c_valid_dev = jax.device_put(c_valid, NamedSharding(mesh, P(MODEL_AXIS)))
+        centers = _PT.put("state/centers", cen, mesh)
+        c_valid_dev = _PT.put("state/c_valid", c_valid, mesh)
 
         n_loc = ds.n_padded // mesh.shape[DATA_AXIS]
         cosine = self.distance_measure == "cosine"

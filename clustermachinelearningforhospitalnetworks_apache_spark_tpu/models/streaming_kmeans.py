@@ -327,8 +327,8 @@ class StreamingKMeans:
         if not batches:
             return self
         from ..parallel.mesh import DATA_AXIS
+        from ..parallel.partitioner import family as _partitioner_family
         from ..parallel.sharding import pad_rows, stack_ragged
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = microbatch_mesh(
             max(b.shape[0] for b, _ in batches), mesh,
@@ -347,8 +347,9 @@ class StreamingKMeans:
         xs, ws = stack_ragged(
             [b for b, _ in batches], [bw for _, bw in batches], pad_to=n_pad
         )
-        xs = jax.device_put(xs, NamedSharding(mesh, P(None, DATA_AXIS, None)))
-        ws = jax.device_put(ws, NamedSharding(mesh, P(None, DATA_AXIS)))
+        _pt = _partitioner_family("streaming_kmeans")
+        xs = _pt.put("stack/x", xs, mesh)
+        ws = _pt.put("stack/w", ws, mesh)
         self._place_state_mesh(mesh)
         mode, param = self._alpha()
         drain = _make_update_many(self.k, mode, param, self.seed)

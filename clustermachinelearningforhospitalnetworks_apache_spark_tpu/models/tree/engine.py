@@ -32,9 +32,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ...parallel.mesh import DATA_AXIS, default_mesh
+from ...parallel.partitioner import family as _partitioner_family
+
+#: column-major histogram layouts — rules in parallel/partitioner.py
+_PT = _partitioner_family("trees")
 from .binning import digitize, quantile_thresholds
 
 
@@ -144,12 +148,12 @@ def _make_level_hist(
         shard_fn,
         mesh=mesh,
         in_specs=(
-            P(None, DATA_AXIS),
-            P(None, DATA_AXIS),
-            P(None, DATA_AXIS),
-            P(None, DATA_AXIS),
+            _PT.spec("cols/binned", 2),
+            _PT.spec("cols/labels", 2),
+            _PT.spec("cols/weights", 2),
+            _PT.spec("cols/draws", 2),
         ),
-        out_specs=P(),
+        out_specs=_PT.spec("hist"),
         # interpret-mode pallas_call's internal block slicing mixes varying
         # operands with unvarying grid indices, which the vma checker
         # rejects (jax suggests this exact workaround); compiled TPU runs
@@ -514,13 +518,11 @@ def _make_bootstrap(mesh: Mesh, T: int, n_pad: int, rate: float):
     weights on a tunneled chip; on-device generation is milliseconds and
     moves nothing.
     """
-    from jax.sharding import NamedSharding
-
     def draw(seed):
         return _bootstrap_draw(seed, rate, T, n_pad)
 
     return jax.jit(
-        draw, out_shardings=NamedSharding(mesh, P(None, DATA_AXIS))
+        draw, out_shardings=_PT.sharding("cols/draws", mesh, ndim=2)
     )
 
 
@@ -963,14 +965,12 @@ def _make_block_bootstrap(mesh: Mesh, T: int, b: int, rate: float):
     the SAME weights.  The stream differs from the resident path's single
     (T, n_pad) draw (same distribution, different PRNG shape) — bit-equal
     out-of-core-vs-resident checks therefore use ``bootstrap=False``."""
-    from jax.sharding import NamedSharding
-
     def draw(seed, block_idx):
         key = jax.random.fold_in(jax.random.key(seed), block_idx)
         return jax.random.poisson(key, rate, shape=(T, b)).astype(jnp.float32)
 
     return jax.jit(
-        draw, out_shardings=NamedSharding(mesh, P(None, DATA_AXIS))
+        draw, out_shardings=_PT.sharding("cols/draws", mesh, ndim=2)
     )
 
 

@@ -65,6 +65,7 @@ REGISTERED_SPANS = (
     "farm.predict",      # tenant-routed predict (host convenience path)
     "fleet.request",     # serving-fleet front door: admission→route→answer
     "fleet.promote",     # atomic fleet-wide swap (every replica or none)
+    "fleet.proc",        # replica worker-process spawn/kill (proc fleet)
     "router.route",      # the routing decision (policy, chosen replica)
     "obs.demo",          # example/bench root spans
     "fed.round",         # one federated fit round: collect→merge→fit→broadcast
@@ -96,6 +97,7 @@ SITE_COVERAGE = {
     "lifecycle.rollback": "lifecycle.rollback",
     "lifecycle.feedback.*": "lifecycle.feedback",
     "fleet.swap.*": "fleet.promote",
+    "fleet.proc.*": "fleet.proc",   # worker spawn / rpc mangle / SIGKILL
     "sql.view.maintain": "sql.view.maintain",
     "fed.round.*": "fed.round",
     "soak.schedule.tick": "soak.run",      # chaos-event dispatch point

@@ -123,12 +123,14 @@ def assign_clusters_chunked(
     path.  A mesh-sharded ``x`` is processed shard-locally under
     ``shard_map`` (assignment is embarrassingly row-parallel); anything
     else goes through one jitted chunked scan."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
+
+    from ..parallel.partitioner import family as _partitioner_family
 
     mesh = getattr(getattr(x, "sharding", None), "mesh", None)
     if isinstance(mesh, Mesh):
         return _assign_chunked_sharded(mesh, chunk)(
-            x, jax.device_put(centers, NamedSharding(mesh, P()))
+            x, _partitioner_family("distance").put("const/centers", centers, mesh)
         )
     return _assign_chunked_jit(chunk)(x, centers)
 
@@ -142,15 +144,14 @@ def _assign_chunked_jit(chunk: int):
 
 @lru_cache(maxsize=64)
 def _assign_chunked_sharded(mesh, chunk: int):
-    from jax.sharding import PartitionSpec as P
+    from ..parallel.partitioner import family as _partitioner_family
 
-    from ..parallel.mesh import DATA_AXIS
-
+    _pt = _partitioner_family("distance")
     return jax.jit(
         jax.shard_map(
             lambda xs, cen: _assign_chunked_local(xs, cen, chunk),
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P()),
-            out_specs=P(DATA_AXIS),
+            in_specs=(_pt.spec("rows/x", 2), _pt.spec("const/centers")),
+            out_specs=_pt.spec("rows/assign", 1),
         )
     )

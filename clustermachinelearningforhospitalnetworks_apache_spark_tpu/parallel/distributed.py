@@ -69,3 +69,26 @@ def context() -> DistributedContext:
 
 def is_coordinator() -> bool:
     return context().is_coordinator
+
+
+_CLUSTER_MESH = None
+
+
+def cluster_mesh():
+    """The hybrid DCN×ICI mesh for an initialized multi-process runtime,
+    or ``None`` single-host — the mesh the partitioner's
+    ``active_mesh()`` resolves against, so every family's rule table
+    lands on the topology-aware layout the moment ``initialize()`` has
+    run, with zero per-call-site changes (ISSUE 19 tentpole b).
+
+    Cached: ``build_hybrid_mesh`` re-derives the same layout every call
+    and mesh identity matters for the partitioner's resolution cache.
+    """
+    global _CLUSTER_MESH
+    if _CTX is None or _CTX.num_processes <= 1:
+        return None
+    if _CLUSTER_MESH is None:
+        from .mesh import build_hybrid_mesh
+
+        _CLUSTER_MESH = build_hybrid_mesh(_CTX.num_processes)
+    return _CLUSTER_MESH

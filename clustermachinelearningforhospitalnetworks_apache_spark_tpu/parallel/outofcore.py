@@ -30,8 +30,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .mesh import DATA_AXIS, default_mesh
-from .sharding import DeviceDataset, device_dataset, pad_block_host, pad_rows
+from .mesh import default_mesh
+from .partitioner import family as _partitioner_family
+from .sharding import DeviceDataset, device_dataset, pad_block_host
 
 # Pytree accumulator for per-block sufficient statistics — shared by every
 # out-of-core estimator driver (KMeans / LinearRegression / GMM).
@@ -163,8 +164,9 @@ class HostDataset:
         """(n_blocks, padded rows per block) for this mesh — every block is
         transferred at exactly this static shape."""
         mesh = mesh or default_mesh()
-        shards = mesh.shape[DATA_AXIS]
-        b = pad_rows(min(self.max_device_rows, max(self.n, 1)), shards)
+        b = _partitioner_family("rows").round_rows(
+            min(self.max_device_rows, max(self.n, 1)), mesh
+        )
         return -(-self.n // b), b
 
     def sample_rows(self, size: int, seed: int) -> np.ndarray:

@@ -11,6 +11,9 @@ uniform surface over many executors, applied to serving:
   ORDERED shed thresholds (best_effort → batch → interactive)
 * :mod:`replica_set` — the composed front door: atomic fleet-wide
   promotion, replica kill/drain, pull-collector health
+* :mod:`proc`      — the multi-process fleet (ISSUE 19): each replica a
+  real OS process with its own jax runtime behind a length-prefixed
+  frame RPC, same router/admission/swap semantics
 * :mod:`loadgen`   — replayable open-loop Poisson load (diurnal bursts,
   fixed tenant mix) for the ``serve_fleet`` bench
 * :mod:`watchdog`  — busy-but-no-progress stall detection; a wedge
@@ -32,6 +35,13 @@ from .admission import (
 )
 from .loadgen import Arrival, ClassReport, LoadProfile, TenantMix, build_schedule, replay
 from .placement import EvenPlacement, PinnedPlacement, Placement, ReplicaSlice
+from .proc import (
+    FrameError,
+    ProcReplica,
+    ProcReplicaSet,
+    ProcServerClient,
+    RPCError,
+)
 from .replica_set import (
     DEFAULT_ADMISSION,
     REPLICA_DEAD,
@@ -57,18 +67,23 @@ __all__ = [
     "ConsistentHashRing",
     "DEFAULT_ADMISSION",
     "EvenPlacement",
+    "FrameError",
     "LoadProfile",
     "NoReplicaAvailable",
     "POLICY_CONSISTENT_HASH",
     "POLICY_LEAST_LOADED",
     "PinnedPlacement",
     "Placement",
+    "ProcReplica",
+    "ProcReplicaSet",
+    "ProcServerClient",
     "REPLICA_DEAD",
     "REPLICA_DRAINING",
     "REPLICA_LIVE",
     "Replica",
     "ReplicaSet",
     "ReplicaSlice",
+    "RPCError",
     "Router",
     "SLOClass",
     "SLO_BATCH",

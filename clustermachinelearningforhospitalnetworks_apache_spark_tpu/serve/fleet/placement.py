@@ -78,27 +78,23 @@ class EvenPlacement(Placement):
     def assign(
         self, n_replicas: int, devices: Sequence[Any]
     ) -> tuple[ReplicaSlice, ...]:
-        if n_replicas < 1:
-            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        # the split itself lives in the one partitioner (the logical
+        # replica axis partitions the device LIST); this class adds the
+        # fleet-facing slice objects and the oversubscription warning
+        from ...parallel.partitioner import partition_devices
+
         devs = tuple(devices)
-        if not devs:
-            raise ValueError("no devices to place replicas on")
-        if n_replicas > len(devs):
+        if devs and n_replicas > len(devs):
             log.warning(
                 "replica oversubscription: round-robining devices",
                 n_replicas=n_replicas, n_devices=len(devs),
             )
-            return tuple(
-                ReplicaSlice(i, (devs[i % len(devs)],))
-                for i in range(n_replicas)
+        return tuple(
+            ReplicaSlice(i, slice_devs)
+            for i, slice_devs in enumerate(
+                partition_devices(devs, n_replicas)
             )
-        per, extra = divmod(len(devs), n_replicas)
-        out, start = [], 0
-        for i in range(n_replicas):
-            width = per + (1 if i < extra else 0)
-            out.append(ReplicaSlice(i, devs[start : start + width]))
-            start += width
-        return tuple(out)
+        )
 
 
 class PinnedPlacement(Placement):

@@ -195,13 +195,7 @@ class ReplicaSet:
             breaker_failure_threshold=breaker_failure_threshold,
             breaker_recovery_s=breaker_recovery_s,
         )
-        self._replicas = [
-            Replica(
-                s.replica_id, s,
-                InferenceServer(device=s.primary, **self._server_kw),
-            )
-            for s in self.slices
-        ]
+        self._replicas = [self._make_replica(s) for s in self.slices]
         self.router = Router(self._replicas, policy=policy, vnodes=vnodes)
         self.admission: AdmissionController | None = (
             AdmissionController() if admission == DEFAULT_ADMISSION
@@ -235,6 +229,21 @@ class ReplicaSet:
             "replica set built", replicas=n_replicas,
             policy=policy, devices=len(tuple(devices)),
         )
+
+    # ------------------------------------------------------------ seams
+    def _build_server(self, slice_: ReplicaSlice):
+        """Build one replica's server on its slice — the seam the
+        multi-process fleet (:mod:`.proc`) overrides to spawn a real OS
+        process instead of an in-process :class:`InferenceServer`.
+        Used by both construction and :meth:`revive_replica`, so a
+        revived replica is rebuilt through the same path it was born."""
+        return InferenceServer(device=slice_.primary, **self._server_kw)
+
+    def _make_replica(self, slice_: ReplicaSlice) -> Replica:
+        """Wrap a slice and its freshly built server in the fleet's
+        replica type (the proc fleet returns a :class:`ProcReplica`
+        whose health/load reads are parent-side)."""
+        return Replica(slice_.replica_id, slice_, self._build_server(slice_))
 
     # ------------------------------------------------------------ setup
     def add_model(
@@ -389,7 +398,7 @@ class ReplicaSet:
                 f"replica {index} is {r.state!r}, not dead — revive is "
                 "only defined for killed/drained replicas"
             )
-        server = InferenceServer(device=r.slice.primary, **self._server_kw)
+        server = self._build_server(r.slice)
         for name, spec in list(self._model_specs.items()):
             server.add_model(
                 name, spec["model"], n_features=spec["n_features"],

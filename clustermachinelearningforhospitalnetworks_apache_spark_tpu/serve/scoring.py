@@ -20,7 +20,8 @@ import jax
 import numpy as np
 
 from ..models.base import Model
-from ..parallel.mesh import DATA_AXIS, default_mesh
+from ..parallel.mesh import default_mesh
+from ..parallel.partitioner import family as _partitioner_family
 from ..parallel.sharding import device_dataset, unpad
 from ..utils.profiling import device_fence
 
@@ -80,8 +81,8 @@ def bulk_score(
     if n <= chunk_rows:
         ds = device_dataset(x, mesh=mesh)
         return unpad(fn(ds.x), n)
-    n_shards = mesh.shape[DATA_AXIS]
-    chunk = -(-chunk_rows // n_shards) * n_shards  # divisible by data axis
+    # row-chunk multiple from the one partitioner (divisible by data axis)
+    chunk = _partitioner_family("rows").round_rows(chunk_rows, mesh)
     out = np.empty((n,), dtype=np.float32)
     for s in range(0, n, chunk):
         piece = x[s : s + chunk]
@@ -113,8 +114,9 @@ class ShardedScorer:
     ):
         self.model = model
         self.mesh = mesh or default_mesh()
-        n_shards = self.mesh.shape[DATA_AXIS]
-        self.chunk_rows = -(-chunk_rows // n_shards) * n_shards
+        self.chunk_rows = _partitioner_family("rows").round_rows(
+            chunk_rows, self.mesh
+        )
         self._fn = jax.jit(model.serving_predict_fn())
 
     def warmup(self) -> "ShardedScorer":

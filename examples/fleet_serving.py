@@ -19,6 +19,13 @@ to end with the replayable open-loop load generator:
    serve.request — under a single trace id.
 
     PYTHONPATH=. python examples/fleet_serving.py
+    PYTHONPATH=. python examples/fleet_serving.py --multiproc
+
+With ``--multiproc`` the same demo runs on ``ProcReplicaSet`` (ISSUE
+19): every replica is a REAL OS process with its own JAX runtime behind
+the length-prefixed socket RPC — same router, same SLO ladder, same
+kill/reroute semantics, and the killed replica is revived as a freshly
+spawned process through the same seam it was born from.
 """
 
 from __future__ import annotations
@@ -38,7 +45,7 @@ from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs import trace
 from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import fleet as F
 
 
-def main() -> None:
+def main(multiproc: bool = False) -> None:
     rng = np.random.default_rng(0)
 
     # ------------------------------------------------------------ train
@@ -49,7 +56,8 @@ def main() -> None:
     model = ht.LinearRegression().fit((x, y))
 
     # ------------------------------------------------------- the fleet
-    fleet = F.ReplicaSet(
+    fleet_cls = F.ProcReplicaSet if multiproc else F.ReplicaSet
+    fleet = fleet_cls(
         n_replicas=4,
         policy=F.POLICY_CONSISTENT_HASH,
         max_queue_rows=512,            # SLO-sized, per replica
@@ -62,6 +70,10 @@ def main() -> None:
     print("placement:")
     for s in fleet.slices:
         print(f"  replica {s.replica_id}: {[str(dv) for dv in s.devices]}")
+    if multiproc:
+        print("worker processes (parent pid", f"{os.getpid()}):")
+        for r in fleet.replicas:
+            print(f"  replica {r.index}: pid {r.server.pid}")
 
     with fleet:
         # ------------------------------------------ 2. replayable load
@@ -123,6 +135,12 @@ def main() -> None:
         print(f"\nkilled replica {victim}: H00 rerouted -> ok="
               f"{rerouted.ok}; health status={h['status']!r}, "
               f"replicas={ {k: v['state'] for k, v in h['replicas'].items()} }")
+        if multiproc:
+            # the killed worker was a real process; revive spawns a new one
+            fleet.revive_replica(victim)
+            print(f"revived replica {victim}: fresh worker pid "
+                  f"{fleet.replicas[victim].server.pid}, ok="
+                  f"{fleet.predict('los', x[:4], tenant_id='H00').ok}")
 
         # ----------------------------------------- 6. the routed trace
         with trace.active(trace.Tracer()) as tracer:
@@ -134,4 +152,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    main(multiproc="--multiproc" in sys.argv[1:])

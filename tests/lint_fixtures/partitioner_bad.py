@@ -1,0 +1,21 @@
+"""Fixture: handrolled-sharding — layout construction outside parallel/."""
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def shard_batch(mesh, x):
+    spec = P("data", None)                        # BAD: aliased constructor
+    return jax.device_put(x, NamedSharding(mesh, spec))   # BAD
+
+
+def build_mesh(devices):
+    return Mesh(devices, axis_names=("data",))    # BAD: hand-built mesh
+
+
+def via_module(x, mesh):
+    import jax.sharding as sharding
+
+    s = sharding.PartitionSpec("model")           # BAD: module-attr path
+    return jax.device_put(x, sharding.NamedSharding(mesh, s))  # BAD

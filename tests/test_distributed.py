@@ -87,6 +87,10 @@ _WORKER = textwrap.dedent(
     sys.path.insert(0, os.path.join(@@REPO@@, "tests"))
     assert ctx.num_processes == 2, ctx
     assert ctx.global_devices == 4, ctx
+    # the runtime is wired: anything failing past this marker is a
+    # COLLECTIVES capability gap, not a bootstrap regression — the
+    # parent only honors the CPU-backend skip when it sees this
+    print("BOOTSTRAP_OK", flush=True)
 
     import numpy as np
     import jax.numpy as jnp
@@ -104,11 +108,30 @@ _WORKER = textwrap.dedent(
         sh = NamedSharding(mesh, spec)
         return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
 
-    # ---- phase 1: WLS fit, 1-D data mesh over both processes ----------
-    mesh = build_mesh(MeshConfig(data=4, model=1))
-    xg = put(mesh, x, P(DATA_AXIS, None))
-    yg = put(mesh, y, P(DATA_AXIS))
-    wg = put(mesh, np.ones((n,), np.float32), P(DATA_AXIS))
+    # ---- phase 1: WLS fit over the hybrid DCN mesh, partitioner-routed
+    # The package's own distributed module reads the live runtime
+    # (initialize() is a no-op re-read here) and hands back the
+    # topology-aware DCN x ICI mesh; the batch layout comes from the one
+    # declarative partitioner, not a hand-built PartitionSpec.
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (
+        distributed as pkg_distributed,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.partitioner import (
+        family as partitioner_family,
+    )
+    pctx = pkg_distributed.context()
+    assert pctx.num_processes == 2, pctx
+    mesh = pkg_distributed.cluster_mesh()
+    assert mesh is not None and mesh.devices.size == 4, mesh
+    rows_pt = partitioner_family("rows")
+
+    def put_rows(path, arr):
+        sh = rows_pt.sharding(path, mesh=mesh, ndim=arr.ndim)
+        return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+    xg = put_rows("batch/x", x)
+    yg = put_rows("batch/y", y)
+    wg = put_rows("batch/w", np.ones((n,), np.float32))
 
     from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.linear_regression import (
         _wls_fit,
@@ -350,7 +373,16 @@ def test_two_process_cluster_fit(tmp_path):
     ):
         # jax 0.4.x jaxlib: the CPU runtime has no cross-process
         # collectives at all (gloo-backed CPU collectives land in later
-        # jaxlibs) — the capability under test cannot exist here
+        # jaxlibs) — the capability under test cannot exist here.  The
+        # skip is honored ONLY when every worker proved its bootstrap
+        # (coordinator handshake, process/device counts) first: a broken
+        # jax.distributed.initialize must fail loudly, not hide behind
+        # the collectives skip.
+        assert all("BOOTSTRAP_OK" in out for out in outs), (
+            "distributed bootstrap failed BEFORE the collectives probe "
+            "— this is a regression, not a backend capability gap:\n"
+            + "\n".join(outs)
+        )
         pytest.skip("this jaxlib's CPU backend lacks multiprocess collectives")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"

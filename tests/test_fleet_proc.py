@@ -1,0 +1,381 @@
+"""serve/fleet/proc — the multi-process fleet (ISSUE 19b).
+
+Contracts:
+
+1. framing — torn header/payload, bad magic, oversize length, and
+   undecodable pickle each raise :class:`FrameError`; clean EOF at a
+   frame boundary is ``None``; an oversize SEND is refused before any
+   bytes hit the wire;
+2. transport ladder — an RPC timeout or wire death feeds the parent-
+   side breaker; transport death completes EVERY in-flight request
+   ``unavailable`` (answered, never stranded) and flips the client so
+   ``submit`` raises ``KeyError`` — the fleet's reroute signal;
+3. the fleet over real processes — predict parity with the in-process
+   model, atomic fleet-wide swap, SIGKILL mid-load with unanswered=0
+   and a CRC-intact postmortem, revive through the same build seam;
+4. the ``fleet.proc.rpc`` chaos site — a corrupt frame on the wire is
+   transport death, answered by the same ladder.
+
+Framing/transport tests run on plain socketpairs (no worker process);
+the process-backed tests share ONE module-scoped 2-replica fleet to
+keep the spawn bill bounded.
+"""
+
+import itertools
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.obs.flight_recorder import (
+    read_dump,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.breaker import (
+    CircuitBreaker,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.fleet import (
+    proc as FP,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import (
+    faults,
+)
+
+pytestmark = [pytest.mark.fleet]
+
+D = 4
+
+
+# --------------------------------------------------------------- framing
+
+
+class TestFraming:
+    def test_round_trip(self):
+        a, b = socket.socketpair()
+        with a, b:
+            FP.send_frame(a, {"op": "ping", "x": np.arange(3)})
+            msg = FP.recv_frame(b)
+        assert msg["op"] == "ping"
+        np.testing.assert_array_equal(msg["x"], np.arange(3))
+
+    def test_clean_eof_is_none(self):
+        a, b = socket.socketpair()
+        with b:
+            a.close()
+            assert FP.recv_frame(b) is None
+
+    def test_torn_header(self):
+        a, b = socket.socketpair()
+        with b:
+            a.sendall(b"CM")      # 2 of 8 header bytes, then death
+            a.close()
+            with pytest.raises(FP.FrameError, match="mid-frame"):
+                FP.recv_frame(b)
+
+    def test_torn_payload(self):
+        a, b = socket.socketpair()
+        with b:
+            a.sendall(struct.pack(">4sI", b"CMP1", 100) + b"x" * 10)
+            a.close()
+            with pytest.raises(FP.FrameError, match="mid-frame"):
+                FP.recv_frame(b)
+
+    def test_bad_magic(self):
+        a, b = socket.socketpair()
+        with a, b:
+            a.sendall(struct.pack(">4sI", b"XXXX", 4) + b"abcd")
+            with pytest.raises(FP.FrameError, match="magic"):
+                FP.recv_frame(b)
+
+    def test_oversize_frame_refused_without_buffering(self):
+        a, b = socket.socketpair()
+        with a, b:
+            # a corrupted length field must not make the receiver try to
+            # buffer gigabytes — it fails on the header alone
+            a.sendall(struct.pack(">4sI", b"CMP1", FP.MAX_FRAME_BYTES + 1))
+            with pytest.raises(FP.FrameError, match="oversize"):
+                FP.recv_frame(b)
+
+    def test_undecodable_payload(self):
+        a, b = socket.socketpair()
+        with a, b:
+            a.sendall(struct.pack(">4sI", b"CMP1", 4) + b"\xff\xfe\xfd\xfc")
+            with pytest.raises(FP.FrameError, match="undecodable"):
+                FP.recv_frame(b)
+
+    def test_oversize_send_refused_before_write(self):
+        a, b = socket.socketpair()
+        with a, b:
+            with pytest.raises(FP.FrameError, match="exceeds"):
+                FP.send_frame(a, {"blob": b"x" * 64}, max_bytes=32)
+            # nothing hit the wire
+            b.setblocking(False)
+            with pytest.raises(BlockingIOError):
+                b.recv(1)
+
+
+# --------------------------------------------------------------- transport
+
+
+class _FakeProc:
+    """Stands in for the Popen handle on a loopback client."""
+
+    pid = -1
+
+    def __init__(self):
+        self._rc = None
+
+    def poll(self):
+        return self._rc
+
+
+def _loopback_client(rpc_timeout_s=0.2):
+    """A ProcServerClient wired to a test-controlled peer socket instead
+    of a spawned worker — the transport ladder in isolation."""
+    parent, peer = socket.socketpair()
+    c = FP.ProcServerClient.__new__(FP.ProcServerClient)
+    c.replica_id = 0
+    c._server_kw = {}
+    c.max_queue_rows = 64
+    c.breaker = CircuitBreaker(failure_threshold=2, recovery_timeout_s=60.0)
+    c._worker_threads = 1
+    c._spawn_timeout_s = 1.0
+    c._rpc_timeout_s = rpc_timeout_s
+    c._max_frame = FP.MAX_FRAME_BYTES
+    c._env_extra = {}
+    c.registry = FP._ClientRegistry()
+    c._send_lock = threading.Lock()
+    c._state_lock = threading.Lock()
+    c._pending = {}
+    c._ids = itertools.count(1)
+    c._inflight_rows = 0
+    c._dead = threading.Event()
+    c._closing = False
+    c._sock = parent
+    c._proc = _FakeProc()
+    c.pid = -1
+    c.counters = {
+        "serve.requests": 0.0, "fleet.proc.rpc_sent": 0.0,
+        "fleet.proc.short_circuited": 0.0,
+        "fleet.proc.transport_down": 0.0, "fleet.proc.killed": 0.0,
+    }
+    c.last_postmortem = None
+    threading.Thread(target=c._recv_loop, daemon=True).start()
+    return c, peer
+
+
+class TestTransportLadder:
+    def test_rpc_timeout_counts_against_breaker(self):
+        c, peer = _loopback_client(rpc_timeout_s=0.05)
+        with peer:
+            with pytest.raises(FP.RPCError, match="timed out"):
+                c._call("ping")
+            assert c.breaker._consecutive_failures == 1
+            # peer actually received the request frame
+            assert FP.recv_frame(peer)["op"] == "ping"
+
+    def test_transport_death_answers_all_inflight(self):
+        c, peer = _loopback_client()
+        c.registry._entries["m"] = FP._RegistryEntry(object())
+        reqs = [c.submit("m", np.zeros((2, D), np.float32)) for _ in range(5)]
+        assert c.inflight_rows() == 10
+        peer.close()              # worker death
+        results = [r.wait(5.0) for r in reqs]
+        assert all(r.status == "unavailable" for r in results)
+        assert c.inflight_rows() == 0
+        assert not c.alive()
+        # and the fleet's reroute signal fires on the next dispatch
+        with pytest.raises(KeyError):
+            c.submit("m", np.zeros((1, D), np.float32))
+
+    def test_torn_frame_from_peer_is_transport_death(self):
+        c, peer = _loopback_client()
+        c.registry._entries["m"] = FP._RegistryEntry(object())
+        req = c.submit("m", np.zeros((1, D), np.float32))
+        with peer:
+            peer.sendall(b"garbage!")   # bad magic → FrameError → down
+            assert req.wait(5.0).status == "unavailable"
+
+    def test_unknown_model_is_keyerror_before_any_rpc(self):
+        c, peer = _loopback_client()
+        with peer:
+            with pytest.raises(KeyError):
+                c.submit("nope", np.zeros((1, D), np.float32))
+
+    def test_open_breaker_short_circuits_submit(self):
+        c, peer = _loopback_client()
+        c.registry._entries["m"] = FP._RegistryEntry(object())
+        with peer:
+            c.breaker.record_failure()
+            c.breaker.record_failure()  # threshold=2 → OPEN
+            with pytest.raises(KeyError, match="breaker"):
+                c.submit("m", np.zeros((1, D), np.float32))
+            assert c.counters["fleet.proc.short_circuited"] == 1
+
+
+# --------------------------------------------------------------- processes
+
+
+@pytest.fixture(scope="module")
+def proc_fleet():
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+        KMeans,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(192, D)).astype(np.float32)
+    model = KMeans(k=3, max_iter=5, seed=0).fit(x)
+    fs = FP.ProcReplicaSet(n_replicas=2, max_wait_s=0.005)
+    fs.add_model("km", model, n_features=D)
+    fs.start()
+    yield fs, model, x
+    fs.stop()
+
+
+class TestProcFleet:
+    def test_predict_parity_with_in_process_model(self, proc_fleet):
+        fs, _, x = proc_fleet
+        # compare against the CURRENTLY served model (order-independent
+        # with the swap test on the shared fleet)
+        current = fs.registry.get("km").model
+        r = fs.predict("km", x[:16], tenant_id="h1")
+        assert r.status == "ok"
+        np.testing.assert_array_equal(
+            np.asarray(r.value), np.asarray(current.predict(x[:16]))
+        )
+
+    def test_each_replica_is_a_distinct_os_process(self, proc_fleet):
+        fs, _, _ = proc_fleet
+        pids = {r.server.pid for r in fs.replicas}
+        assert len(pids) == 2
+        assert os.getpid() not in pids
+        for pid in pids:
+            os.kill(pid, 0)   # alive
+
+    def test_atomic_swap_across_processes(self, proc_fleet):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+            KMeans,
+        )
+
+        fs, _, x = proc_fleet
+        m2 = KMeans(k=3, max_iter=9, seed=5).fit(x)
+        fs.swap_model("km", m2, n_features=D)
+        r = fs.predict("km", x[:16], tenant_id="h1")
+        assert r.status == "ok"
+        np.testing.assert_array_equal(
+            np.asarray(r.value), np.asarray(m2.predict(x[:16]))
+        )
+
+    def test_lifecycle_attachment_is_loudly_unsupported(self, proc_fleet):
+        fs, _, _ = proc_fleet
+        with pytest.raises(NotImplementedError):
+            fs.attach_lifecycle(object())
+
+    @pytest.mark.chaos
+    def test_sigkill_mid_load_unanswered_zero_then_revive(self, proc_fleet):
+        """The tentpole chaos row: SIGKILL a replica PROCESS mid-load —
+        every in-flight request is answered (ok or unavailable, zero
+        stranded), the router reroutes, the postmortem round-trips CRC-
+        intact, and revive rebuilds through the spawn seam."""
+        fs, _, x = proc_fleet
+        reqs = [
+            fs.submit("km", x[i % 64: i % 64 + 4], tenant_id=f"t{i}")
+            for i in range(24)
+        ]
+        fs.kill_replica(0)
+        results = [r.wait(15.0) for r in reqs]
+        statuses = {r.status for r in results}
+        assert statuses <= {"ok", "unavailable", "rejected"}, statuses
+        assert sum(r.status == "ok" for r in results) > 0
+        # unanswered == 0: wait() never hit its client timeout
+        assert all(r.detail != "client wait timed out" for r in results)
+        # postmortem round-trips CRC-intact
+        dump = fs.replicas[0].server.last_postmortem
+        assert dump is not None
+        post = read_dump(dump)
+        assert post["site"] == "fleet.proc.kill"
+        assert post["trigger"]["replica"] == 0
+        # router reroutes to the survivor
+        r = fs.predict("km", x[:4], tenant_id="h1")
+        assert r.status == "ok"
+        # revive rebuilds a REAL process through the same seam
+        fs.revive_replica(0)
+        assert fs.replicas[0].healthy()
+        assert fs.replicas[0].server.pid not in (None, os.getpid())
+        assert fs.predict("km", x[:4], tenant_id="h1").status == "ok"
+        assert fs.health()["status"] == "ok"
+
+    @pytest.mark.chaos
+    def test_external_sigkill_reaped_and_rerouted(self, proc_fleet):
+        """A kill the fleet API never saw (OOM killer shape): routing
+        excludes the dead process immediately, reap() flips it DEAD so
+        revive accepts it."""
+        fs, _, x = proc_fleet
+        victim = fs.replicas[1]
+        os.kill(victim.server.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 10.0
+        while victim.server.alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not victim.healthy()
+        assert fs.predict("km", x[:4], tenant_id="h1").status == "ok"
+        assert fs.reap() == [1]
+        fs.revive_replica(1)
+        assert fs.predict("km", x[:4], tenant_id="h1").status == "ok"
+
+    @pytest.mark.chaos
+    def test_rpc_corruption_site_is_transport_death(self, proc_fleet):
+        """fleet.proc.rpc: a corrupt frame on the wire has no resync
+        point — the worker dies loudly, the parent answers in-flight
+        work, and revive recovers the replica."""
+        fs, _, x = proc_fleet
+        target = fs.router.route(tenant_id="h1", model="km").index
+        plan = faults.FaultPlan().corrupt(
+            "fleet.proc.rpc", at_byte=1, times=1,
+            when=lambda ctx: ctx.get("replica") == target,
+        )
+        with faults.active(plan):
+            req = fs.submit("km", x[:4], tenant_id="h1")
+            res = req.wait(10.0)
+        # the corrupted dispatch itself is answered, one way or the other
+        assert res.status in ("ok", "unavailable")
+        assert plan.fired("fleet.proc.rpc") == 1
+        victim = fs.replicas[target]
+        deadline = time.monotonic() + 10.0
+        while victim.server.alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not victim.healthy()
+        assert fs.reap() == [target]
+        fs.revive_replica(target)
+        assert fs.predict("km", x[:4], tenant_id="h1").status == "ok"
+
+
+@pytest.mark.chaos
+def test_spawn_fault_rides_retry_ladder():
+    """fleet.proc.spawn: a failed worker spawn rides the SAME retry
+    ladder the rest of the stack uses — one injected OSError costs one
+    backoff retry, not a dead replica."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+        KMeans,
+    )
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(96, D)).astype(np.float32)
+    model = KMeans(k=2, max_iter=3, seed=0).fit(x)
+    plan = faults.FaultPlan().fail(
+        "fleet.proc.spawn", times=1,
+        error=lambda: OSError("injected spawn failure"),
+    )
+    with faults.active(plan):
+        fs = FP.ProcReplicaSet(n_replicas=1, max_wait_s=0.005)
+    assert plan.fired("fleet.proc.spawn") == 1
+    try:
+        fs.add_model("km", model, n_features=D)
+        with fs:
+            assert fs.predict("km", x[:4], tenant_id="h1").status == "ok"
+    except BaseException:
+        fs.stop()
+        raise

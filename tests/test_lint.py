@@ -86,6 +86,7 @@ RULE_CASES = [
      {"fault-site-uncovered", "dynamic-fault-site"}, {"with_trace": True}),
     ("obs_spans_bad.py", ["obs_coverage"],
      {"span-unregistered", "dynamic-span-name"}, {"with_trace": True}),
+    ("partitioner_bad.py", ["partitioner"], {"handrolled-sharding"}, {}),
 ]
 
 
@@ -129,6 +130,17 @@ def test_metric_label_counts(tmp_path):
         tmp_path, "metric_labels_bad.py", ["metric_labels"]
     )
     assert len([f for f in report.active if f.rule == "raw-metric-label"]) == 6
+
+
+def test_partitioner_alias_resolution_counts(tmp_path):
+    """All five construction shapes in the fixture are caught — the
+    ``as P`` alias, the direct-name import, the hand-built Mesh, and
+    both ``sharding.``-module-attribute paths — while isinstance and
+    annotation *uses* of PartitionSpec in the clean twin stay exempt
+    (only a call mints a layout)."""
+    report = run_fixture(tmp_path, "partitioner_bad.py", ["partitioner"])
+    hits = [f for f in report.active if f.rule == "handrolled-sharding"]
+    assert len(hits) == 5, [(f.line, f.message) for f in hits]
 
 
 def test_obs_alias_and_forwarding_resolve(tmp_path):

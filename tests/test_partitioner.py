@@ -1,0 +1,264 @@
+"""parallel/partitioner — the ONE declarative sharding rule table (ISSUE 19a).
+
+Contracts:
+
+1. rule resolution — ordered first-match-wins over ``fnmatch`` path
+   patterns, unmatched leaves fall to the family default (replicated),
+   aliases map logical axes (tenant/replica) to mesh axes or None;
+2. ``spec(path, ndim)`` pads with None up to the leaf's rank and
+   REFUSES a rule longer than the rank (a rule written for a matrix
+   must not silently mis-shard a vector);
+3. caching — spec resolution is cached per (path, ndim), NamedSharding
+   resolution per (family, path, ndim, mesh), and ``register_family``
+   invalidates exactly its own family's cached resolutions;
+4. ``partition_devices`` — the replica-axis split the fleet placement
+   delegates to (contiguous even split, round-robin oversubscription);
+5. migration gate — the family tables reproduce the exact specs the
+   scattered call sites used to hand-build, and the sharded estimators
+   stay bit-identical to single-device fits THROUGH the partitioner
+   layer (kmeans is the canary family; every other family's parity is
+   pinned by its own suite, which now routes through this module).
+"""
+
+import numpy as np
+import pytest
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel import (
+    partitioner as PT,
+)
+
+pytestmark = [pytest.mark.fast]
+
+
+def _pt(rules, default=(), aliases=None, family="test"):
+    return PT.Partitioner(
+        family, [PT.Rule(p, a) for p, a in rules],
+        default=default, aliases=aliases,
+    )
+
+
+# --------------------------------------------------------------- resolution
+
+
+class TestRuleResolution:
+    def test_first_match_wins_in_declaration_order(self):
+        pt = _pt([
+            ("batch/x", (PT.MODEL,)),   # specific, listed first
+            ("batch/*", (PT.DATA,)),
+        ])
+        assert pt.axes_for("batch/x") == (PT.MODEL,)
+        assert pt.axes_for("batch/w") == (PT.DATA,)
+
+    def test_later_broad_rule_shadowed_not_merged(self):
+        pt = _pt([
+            ("batch/*", (PT.DATA,)),
+            ("batch/x", (PT.MODEL,)),   # unreachable: glob above wins
+        ])
+        assert pt.axes_for("batch/x") == (PT.DATA,)
+
+    def test_unmatched_leaf_falls_to_family_default(self):
+        pt = _pt([("batch/*", (PT.DATA,))])
+        # default () = fully replicated
+        assert pt.axes_for("state/centers") == ()
+
+    def test_unmatched_leaf_custom_default(self):
+        pt = _pt([("batch/*", (PT.DATA,))], default=(PT.MODEL,))
+        assert pt.axes_for("anything/else") == (PT.MODEL,)
+
+    def test_alias_resolution_tenant_defaults_to_none(self):
+        pt = _pt([("stack/*", (PT.TENANT,))])
+        sp = pt.spec("stack/x", ndim=2)
+        # default alias: the tenant axis is a replication decision until
+        # a pod maps it onto a real mesh axis
+        assert tuple(sp) == (None, None)
+
+    def test_alias_override_maps_tenant_onto_mesh_axis(self):
+        pt = _pt(
+            [("stack/*", (PT.TENANT,))],
+            aliases={PT.TENANT: "data"},
+        )
+        assert tuple(pt.spec("stack/x", ndim=2)) == ("data", None)
+
+    def test_invalid_axis_name_rejected_at_rule_construction(self):
+        with pytest.raises(ValueError):
+            PT.Rule("batch/*", ("bogus_axis",))
+
+    def test_match_is_fnmatch_not_prefix(self):
+        pt = _pt([("state/c*", (PT.MODEL,))])
+        assert pt.axes_for("state/centers") == (PT.MODEL,)
+        assert pt.axes_for("state/weights") == ()
+
+
+class TestSpecPadding:
+    def test_spec_pads_rank_with_replicated_dims(self):
+        pt = _pt([("batch/*", (PT.DATA,))])
+        assert tuple(pt.spec("batch/x", ndim=3)) == ("data", None, None)
+        assert tuple(pt.spec("batch/w", ndim=1)) == ("data",)
+
+    def test_rule_longer_than_rank_is_an_error(self):
+        pt = _pt([("cols/*", (None, PT.DATA))])
+        with pytest.raises(ValueError):
+            pt.spec("cols/binned", ndim=1)
+
+    def test_scalar_spec_is_empty(self):
+        pt = _pt([])
+        assert tuple(pt.spec("scalar/cost")) == ()
+
+
+# --------------------------------------------------------------- caching
+
+
+class TestCaching:
+    def test_spec_cache_keyed_by_path_and_ndim(self):
+        pt = _pt([("batch/*", (PT.DATA,))])
+        a = pt.spec("batch/x", ndim=2)
+        b = pt.spec("batch/x", ndim=2)
+        c = pt.spec("batch/x", ndim=3)
+        assert a is b          # cache hit: identical object
+        assert tuple(c) != tuple(a)
+
+    def test_sharding_cache_keyed_by_mesh(self):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+            default_mesh,
+            single_device_mesh,
+        )
+
+        pt = PT.family("rows")
+        m1, m2 = default_mesh(), single_device_mesh()
+        s1 = pt.sharding("batch/x", mesh=m1, ndim=2)
+        s1b = pt.sharding("batch/x", mesh=m1, ndim=2)
+        s2 = pt.sharding("batch/x", mesh=m2, ndim=2)
+        assert s1 is s1b       # same (family, path, ndim, mesh) → cached
+        assert s1 is not s2
+        assert s1.mesh is m1 and s2.mesh is m2
+
+    def test_register_family_clears_only_its_own_resolutions(self):
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+            default_mesh,
+        )
+
+        PT.register_family("tmp_fam_a", [("batch/*", (PT.DATA,))])
+        PT.register_family("tmp_fam_b", [("batch/*", (PT.DATA,))])
+        mesh = default_mesh()
+        sa = PT.family("tmp_fam_a").sharding("batch/x", mesh=mesh, ndim=2)
+        sb = PT.family("tmp_fam_b").sharding("batch/x", mesh=mesh, ndim=2)
+        n_before = PT.resolution_cache_size()
+        # re-registering A must drop A's cached resolutions, not B's
+        PT.register_family("tmp_fam_a", [("batch/*", (PT.DATA,))])
+        assert PT.resolution_cache_size() < n_before
+        sb2 = PT.family("tmp_fam_b").sharding("batch/x", mesh=mesh, ndim=2)
+        assert sb2 is sb
+        sa2 = PT.family("tmp_fam_a").sharding("batch/x", mesh=mesh, ndim=2)
+        assert sa2 is not sa
+
+    def test_unknown_family_is_loud(self):
+        with pytest.raises(KeyError):
+            PT.family("no_such_family")
+
+
+# --------------------------------------------------------------- devices
+
+
+class TestPartitionDevices:
+    def test_contiguous_even_split(self):
+        out = PT.partition_devices(list("abcdefgh"), 4)
+        assert list(out) == [("a", "b"), ("c", "d"), ("e", "f"), ("g", "h")]
+
+    def test_remainder_spreads_over_first_slices(self):
+        out = PT.partition_devices(list("abcde"), 2)
+        assert [len(s) for s in out] == [3, 2]
+        assert out[0] == ("a", "b", "c")
+
+    def test_oversubscription_round_robins_single_device_slices(self):
+        out = PT.partition_devices(list("ab"), 5)
+        assert list(out) == [("a",), ("b",), ("a",), ("b",), ("a",)]
+
+    def test_zero_replicas_rejected(self):
+        with pytest.raises(ValueError):
+            PT.partition_devices(list("ab"), 0)
+
+    def test_no_devices_rejected(self):
+        with pytest.raises(ValueError):
+            PT.partition_devices([], 2)
+
+
+# --------------------------------------------------------------- rounding
+
+
+def test_round_rows_is_multiple_of_data_shards():
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+        default_mesh,
+    )
+
+    pt = PT.family("rows")
+    mesh = default_mesh()
+    m = pt.data_shards(mesh)
+    assert m >= 1
+    for n in (1, m, m + 1, 1000):
+        r = pt.round_rows(n, mesh)
+        assert r % m == 0 and r >= n
+
+
+# --------------------------------------------------------------- migration
+
+
+class TestMigrationGate:
+    """The family tables reproduce the exact literal specs the migrated
+    call sites used to hand-build (the bit-parity precondition)."""
+
+    def test_kmeans_table_matches_former_literals(self):
+        from jax.sharding import PartitionSpec as P
+
+        pt = PT.family("kmeans")
+        assert pt.spec("batch/x", ndim=2) == P("data", None)
+        assert pt.spec("batch/w", ndim=1) == P("data")
+        assert pt.spec("state/centers", ndim=2) == P("model", None)
+        assert pt.spec("state/c_valid", ndim=1) == P("model")
+        assert pt.spec("stats/sums", ndim=2) == P("model", None)
+        assert pt.spec("stats/counts", ndim=1) == P("model")
+        assert pt.spec("scalar/cost") == P()
+
+    def test_gmm_trees_farm_sql_tables(self):
+        from jax.sharding import PartitionSpec as P
+
+        gmm = PT.family("gmm")
+        assert gmm.spec("batch/x", ndim=2) == P("data", None)
+        assert gmm.spec("const/params") == P()
+        assert gmm.spec("rows/assign", ndim=1) == P("data")
+        trees = PT.family("trees")
+        assert trees.spec("cols/binned", ndim=2) == P(None, "data")
+        farm = PT.family("farm")
+        # tenant axis replicated by default (single-pod placement)
+        assert farm.spec("stack/x", ndim=3) == P(None, None, None)
+        sql = PT.family("sql")
+        assert sql.spec("column", ndim=1) == P(None)
+
+    def test_kmeans_sharded_vs_single_device_bit_parity(self):
+        """The migration gate proper: a sharded fit THROUGH the
+        partitioner layer is bit-identical to the single-device fit."""
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models.kmeans import (
+            KMeans,
+        )
+        from clustermachinelearningforhospitalnetworks_apache_spark_tpu.parallel.mesh import (
+            default_mesh,
+            single_device_mesh,
+        )
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(256, 5)).astype(np.float32)
+        single = KMeans(k=4, max_iter=8, seed=3).fit(
+            x, mesh=single_device_mesh()
+        )
+        sharded = KMeans(k=4, max_iter=8, seed=3).fit(
+            x, mesh=default_mesh()
+        )
+        # 1-ulp f32 tolerance: the 8-shard psum reduces in a different
+        # order than the single-device sum (repo-wide parity discipline;
+        # see tests/test_option_parity.py)
+        np.testing.assert_allclose(
+            np.asarray(single.cluster_centers),
+            np.asarray(sharded.cluster_centers), atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            single.training_cost, sharded.training_cost, rtol=1e-6,
+        )

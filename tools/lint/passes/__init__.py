@@ -13,6 +13,7 @@ from .durability import DurabilityPass
 from .jit_hygiene import JitHygienePass
 from .metric_labels import MetricLabelsPass
 from .obs_coverage import ObsCoveragePass
+from .partitioner import PartitionerPass
 from .trace_safety import TraceSafetyPass
 
 
@@ -24,6 +25,7 @@ def all_passes():
         DeterminismPass(),
         MetricLabelsPass(),
         ObsCoveragePass(),
+        PartitionerPass(),
         DurabilityPass(),
         CrashProtocolPass(),
     ]

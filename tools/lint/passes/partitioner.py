@@ -1,0 +1,93 @@
+"""Partitioner pass (ISSUE 19 satellite rule).
+
+ISSUE 19a made ``parallel/partitioner.py`` the ONE place that maps
+pytree paths to mesh layouts: every estimator, scorer, and the fleet
+placement resolve shardings through a registered family's rule table.
+A hand-rolled ``PartitionSpec`` / ``NamedSharding`` / ``Mesh``
+construction anywhere else re-opens the drift the migration closed —
+two sources of truth for the same leaf's layout, with the bit-parity
+gate only guarding the declarative one.
+
+Rule:
+
+* ``handrolled-sharding`` — constructing ``jax.sharding.PartitionSpec``
+  / ``NamedSharding`` / ``PositionalSharding`` / ``Mesh`` (or building a
+  device mesh via ``jax.make_mesh`` / ``mesh_utils.create_device_mesh``)
+  outside ``parallel/``.  Import aliases are resolved through the module
+  import table, so ``from jax.sharding import PartitionSpec as P`` does
+  not hide the call.  ``isinstance`` checks and type annotations are
+  naturally exempt — only *calls* construct a layout.
+
+Scope: the package minus ``parallel/`` (the layer that owns layout),
+plus ``bench.py`` and ``examples/`` — the same wider emit set the obs
+pass scans, because a benchmark hand-building a spec would bench a
+layout no estimator actually uses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutils import call_name
+from ..engine import Finding, Pass, attach_node, PKG_NAME
+
+#: fully-resolved constructors that mint a sharding/mesh layout
+_LAYOUT_CONSTRUCTORS = {
+    "jax.sharding.PartitionSpec",
+    "jax.sharding.NamedSharding",
+    "jax.sharding.PositionalSharding",
+    "jax.sharding.Mesh",
+    "jax.make_mesh",
+    "jax.experimental.mesh_utils.create_device_mesh",
+}
+
+_OWNING_DIR = f"{PKG_NAME}/parallel/"
+
+
+def _resolve(ctx, name: str) -> str:
+    """Expand the leading component of a dotted call name through the
+    file's import table: ``P`` → ``jax.sharding.PartitionSpec``,
+    ``sharding.Mesh`` → ``jax.sharding.Mesh``."""
+    parts = name.split(".")
+    imp = ctx.index.imports.get(parts[0])
+    if imp is None:
+        return name
+    module, original, level = imp
+    if level:                      # relative import: package-internal
+        return name
+    head = f"{module}.{original}" if original else module
+    return ".".join([head, *parts[1:]])
+
+
+class PartitionerPass(Pass):
+    name = "partitioner"
+    rules = ("handrolled-sharding",)
+
+    def applies_to(self, rel: str) -> bool:
+        if rel.startswith(_OWNING_DIR):
+            return False           # the layer that owns layout
+        return rel.startswith(PKG_NAME + "/") or rel == "bench.py" \
+            or rel.startswith("examples/")
+
+    def check_file(self, ctx, project):
+        for node in ctx.nodes(ast.Call):
+            name = call_name(node)
+            if name is None:
+                continue
+            resolved = _resolve(ctx, name)
+            if resolved not in _LAYOUT_CONSTRUCTORS:
+                continue
+            short = resolved.rsplit(".", 1)[-1]
+            f = Finding(
+                rule="handrolled-sharding",
+                path=ctx.rel, line=node.lineno, col=node.col_offset,
+                message=(
+                    f"hand-rolled {short}() outside parallel/ — layouts "
+                    "are declared once in parallel/partitioner.py rule "
+                    "tables; resolve through partitioner.family(...)."
+                    "spec()/sharding() (or register a family) so the "
+                    "bit-parity gate guards this leaf too"
+                ),
+                symbol=ctx.symbol_at(node),
+            )
+            yield attach_node(f, node)

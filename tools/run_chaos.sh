@@ -35,6 +35,15 @@
 # and the serving fleet (tests/test_fleet.py kills a replica under
 # open-loop load — every in-flight request answered or cleanly shed,
 # zero unhandled, router reroutes — and drains one gracefully),
+# and the multi-PROCESS fleet (ISSUE 19: tests/test_fleet_proc.py
+# SIGKILLs a live replica worker process mid-load at fleet.proc.kill —
+# every in-flight request answered, unanswered=0, a CRC-intact
+# site-tagged postmortem written, router reroutes, revive respawns a
+# fresh OS process through the same seam it was born from; plus an
+# EXTERNAL SIGKILL the fleet only discovers via reap(), and wire-level
+# RPC corruption at fleet.proc.rpc — the victim worker dies loudly on
+# the torn frame, the parent answers all of its in-flight requests, and
+# spawn failures injected at fleet.proc.spawn ride the retry ladder),
 # and the incremental SQL views (tests/test_sql_views.py kills view
 # maintenance at sql.view.maintain mid-stream and asserts the resumed
 # view state is bit-identical to an uninterrupted run, plus the
@@ -118,6 +127,7 @@ LOG=$(mktemp /tmp/chaos_run.XXXXXX.log)
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
     tests/test_stream_pipeline.py tests/test_gbt_fused.py \
     tests/test_lifecycle.py tests/test_model_farm.py tests/test_fleet.py \
+    tests/test_fleet_proc.py \
     tests/test_sql_views.py tests/test_federated.py \
     tests/test_table_lifecycle.py \
     -m "$MARK" \
@@ -134,7 +144,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet|sql_views|federated|table_lifecycle)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet_proc|fleet|sql_views|federated|table_lifecycle)\.py::(\S+)",
         line,
     )
     if not m:
@@ -200,7 +210,8 @@ for site in sorted(sites):
 # every kill family in the matrix must have left at least one artifact
 import fnmatch
 FAMILIES = ["stream.after_*", "wal.append", "fit_ckpt.*",
-            "model_io.save.*", "lifecycle.*", "fed.round.*", "table.*"]
+            "model_io.save.*", "lifecycle.*", "fed.round.*", "table.*",
+            "fleet.proc.kill"]
 missing = [
     fam for fam in FAMILIES
     if not any(fnmatch.fnmatchcase(s, fam) for s in sites)
