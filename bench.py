@@ -3674,6 +3674,265 @@ def _bench_soak() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _autotune_serve_sweep(store, platform: str, sweep_s: float):
+    """Offline sweep of ``serve.microbatch.max_wait_ms``: one trial per
+    domain value, scored by a synchronous single-row client (the worst
+    case for linger — exactly the workload the sweep should discover).
+    Shared by the ``autotune`` bench config and ``tools/autotune.py``.
+    Returns the ``serve_rps(wait_ms, seconds)`` harness for A/B reuse."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu import (
+        tune,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.models import (
+        LinearRegression,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve import (
+        InferenceServer,
+    )
+
+    rng = np.random.default_rng(0)
+    d = 8
+    x = _make_data(50_000, d, 8)
+    y = (x @ rng.normal(size=(d,)).astype(np.float32)).astype(np.float32)
+    model = LinearRegression().fit((x, y))
+
+    def serve_rps(wait_ms: float, seconds: float) -> float:
+        srv = InferenceServer(max_wait_s=wait_ms / 1e3)
+        srv.add_model("los", model, buckets=(1, 2, 4))
+        with srv:
+            srv.predict("los", x[:1])  # warm the dispatch path
+            n_req, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                srv.predict("los", x[n_req % 1024][None, :])
+                n_req += 1
+            return n_req / (time.perf_counter() - t0)
+
+    wait_knob = tune.REGISTRY.get("serve.microbatch.max_wait_ms")
+    for v in wait_knob.domain:
+        store.add([tune.make_trial(
+            knob=wait_knob.name, value=v, score=serve_rps(v, sweep_s),
+            platform=platform, shape_rows=1, metric=wait_knob.metric,
+        )])
+    return serve_rps
+
+
+def _autotune_seal_sweep(store, platform: str, work: str, rows: int,
+                         n_batches: int, scan_reps: int):
+    """Offline sweep of ``table.seal.max_segment_batches``: one sealed
+    table per candidate, scored by cold recent-window scans (scans/sec).
+    Shared by the ``autotune`` bench config and ``tools/autotune.py``.
+    Returns ``(tables, flt, cold_scan_ms)`` for A/B reuse."""
+    import clustermachinelearningforhospitalnetworks_apache_spark_tpu as ht
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu import (
+        tune,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_parse import (
+        parse,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_plan import (
+        plan_query,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table_lifecycle import (
+        RetentionPolicy,
+        TableLifecycle,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.unbounded_table import (
+        UnboundedTable,
+    )
+
+    rng = np.random.default_rng(0)
+    base_ts = np.datetime64("2025-03-31T00:00:00")
+
+    def make_batch(b: int):
+        t = (
+            base_ts
+            + (b * 3600 + rng.integers(0, 3600, rows)).astype("timedelta64[s]")
+        ).astype("datetime64[ns]")
+        return ht.Table.from_dict({
+            "hospital": rng.integers(0, 16, rows),
+            "event_time": t,
+            "admissions": rng.integers(0, 50, rows),
+        })
+
+    def build_table(seg_batches: int) -> UnboundedTable:
+        dirp = os.path.join(work, f"seal_{seg_batches}")
+        sink = UnboundedTable(dirp, make_batch(0).schema, name="events")
+        for b in range(n_batches):
+            sink.append_batch(make_batch(b), b)
+        TableLifecycle(sink, RetentionPolicy(
+            min_seal_batches=4, hot_batches=2,
+            max_segment_batches=seg_batches,
+        )).tick()
+        return sink
+
+    # the cutoff lands MID-history: a whole-history segment straddles it
+    # (zone maps can't prune what the filter cuts into), small segments
+    # drop everything older — the granularity the knob actually buys
+    cut = str(
+        (base_ts + np.timedelta64(3 * n_batches // 4, "h"))
+        .astype("datetime64[s]")
+    ).replace("T", " ")
+    q = parse(
+        "SELECT hospital, admissions FROM events"
+        f" WHERE event_time >= '{cut}'"
+    )
+
+    def cold_scan_ms(sink, flt) -> float:
+        # drop every snapshot/prune memo: both legs pay assembly +
+        # materialization of the surviving segments, which is the cost
+        # the segment size actually governs
+        sink._pruned_fast = {}
+        sink._pruned_cache = {}
+        sink._snapshots = {}
+        sink._memo_keys = {}
+        t0 = time.perf_counter()
+        sink.scan_pruned(None, flt)
+        return (time.perf_counter() - t0) * 1e3
+
+    seal_knob = tune.REGISTRY.get("table.seal.max_segment_batches")
+    tables: dict[int, UnboundedTable] = {}
+    sweep_vals = (8, 16, int(seal_knob.default))
+    flt = None
+    for v in sweep_vals:
+        tables[v] = build_table(v)
+        if flt is None:
+            flt = plan_query(q, lambda _x: tables[v].read()).filter
+        ms = min(cold_scan_ms(tables[v], flt) for _ in range(scan_reps))
+        store.add([tune.make_trial(
+            knob=seal_knob.name, value=v, score=1e3 / max(ms, 1e-9),
+            platform=platform, shape_rows=rows * n_batches,
+            metric=seal_knob.metric,
+        )])
+    return tables, flt, cold_scan_ms
+
+
+def _bench_autotune() -> dict:
+    """ISSUE 20: the measurement-driven autotuner, end to end.
+
+    Two migrated knobs — one serve-side, one ingest-side — each taken
+    through the full tune/ loop: an offline sweep over the declared
+    domain feeds a :class:`TrialStore`, the :class:`Selector` picks the
+    measured winner (every selection carries an ``explain()`` reason),
+    and a fenced tuned-vs-default A/B (interleaved, best-of-N per leg)
+    gates the claim:
+
+    * ``serve.microbatch.max_wait_ms`` — a synchronous single-row
+      client pays the full linger deadline per request; the tuned
+      0 ms linger dispatches immediately (``tuned_vs_default`` = rps
+      ratio).
+    * ``table.seal.max_segment_batches`` — monotone event time +
+      a recent-window filter: small sealed segments let the zone maps
+      prune cold history, the default 64-batch segment scans everything
+      (``tuned_vs_default`` = cold-scan latency ratio; the prune memos
+      are cleared per rep so both legs pay materialization honestly).
+
+    Both A/B legs run inside ``tune.ab_fence()`` and the row proves the
+    freeze: a resolve attempted mid-A/B must come back
+    ``frozen:fenced-ab``.  Gate: BOTH knobs ≥ 1.05x on the CPU proxy.
+    """
+    import shutil
+    import tempfile
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu import (
+        tune,
+    )
+
+    platform, on_tpu, _n, _, _mesh, _n_chips = _bench_setup(400_000)
+    work = tempfile.mkdtemp(prefix="bench_autotune_")
+    store = tune.TrialStore(os.path.join(work, "trials.json"))
+
+    sweep_s = float(os.environ.get("BENCH_AUTOTUNE_SWEEP_SECONDS", 0.4))
+    rows = max(int(os.environ.get("BENCH_AUTOTUNE_ROWS", "2048")), 256)
+    n_batches = 48
+    scan_reps = max(int(os.environ.get("BENCH_AUTOTUNE_SCAN_REPS", 5)), 2)
+
+    serve_rps = _autotune_serve_sweep(store, platform, sweep_s)
+    tables, flt, cold_scan_ms = _autotune_seal_sweep(
+        store, platform, work, rows, n_batches, scan_reps
+    )
+    wait_knob = tune.REGISTRY.get("serve.microbatch.max_wait_ms")
+    seal_knob = tune.REGISTRY.get("table.seal.max_segment_batches")
+
+    # -------------------- select, then the fenced A/B -------------------
+    sel = tune.Selector(store, platform=platform)
+    tuned_wait = float(sel.resolve(wait_knob, 1))
+    wait_reason = sel.explain(wait_knob.name)["reason"]
+    tuned_seal = int(sel.resolve(seal_knob, rows * n_batches))
+    seal_reason = sel.explain(seal_knob.name)["reason"]
+
+    ab_s = float(os.environ.get("BENCH_AUTOTUNE_AB_SECONDS", 0.6))
+    n_ab = max(int(os.environ.get("BENCH_AUTOTUNE_AB_RUNS", 2)), 1)
+    wait_legs: dict[str, list[float]] = {"default": [], "tuned": []}
+    seal_legs: dict[str, list[float]] = {"default": [], "tuned": []}
+    with tune.ab_fence():
+        # the freeze probe: selection is DISABLED while the A/B runs
+        frozen = (
+            sel.resolve(wait_knob, 1) == tuned_wait
+            and sel.explain(wait_knob.name)["reason"]
+            == tune.REASON_FROZEN_FENCED
+        )
+        for _ in range(n_ab):  # interleaved: drift hits both legs alike
+            wait_legs["default"].append(
+                serve_rps(float(wait_knob.default), ab_s)
+            )
+            wait_legs["tuned"].append(serve_rps(tuned_wait, ab_s))
+            for _r in range(scan_reps):
+                seal_legs["default"].append(
+                    cold_scan_ms(tables[int(seal_knob.default)], flt)
+                )
+                # the selector only picks measured values, so the tuned
+                # table already exists from the sweep
+                seal_legs["tuned"].append(
+                    cold_scan_ms(tables[tuned_seal], flt)
+                )
+    shutil.rmtree(work, ignore_errors=True)
+
+    wait_ratio = max(wait_legs["tuned"]) / max(max(wait_legs["default"]), 1e-9)
+    seal_ratio = min(seal_legs["default"]) / max(min(seal_legs["tuned"]), 1e-9)
+    row = {
+        "metric": (
+            "autotuner tuned-vs-default, fenced interleaved A/B on 2 "
+            f"migrated knobs (serve linger + seal segment size, {platform})"
+        ),
+        "value": round(min(wait_ratio, seal_ratio), 3),
+        "unit": "x_tuned_vs_default_min",
+        "vs_baseline": round(min(wait_ratio, seal_ratio), 2),
+        "gate_1_05_both": bool(wait_ratio >= 1.05 and seal_ratio >= 1.05),
+        "fence_frozen_during_ab": bool(frozen),
+        "trials_banked": len(store),
+        "knobs": {
+            wait_knob.name: {
+                "side": "serve",
+                "default": float(wait_knob.default),
+                "tuned": tuned_wait,
+                "reason": wait_reason,
+                "tuned_vs_default": round(wait_ratio, 3),
+                "default_rps": round(max(wait_legs["default"]), 1),
+                "tuned_rps": round(max(wait_legs["tuned"]), 1),
+            },
+            seal_knob.name: {
+                "side": "ingest",
+                "default": int(seal_knob.default),
+                "tuned": tuned_seal,
+                "reason": seal_reason,
+                "tuned_vs_default": round(seal_ratio, 3),
+                "default_scan_ms": round(min(seal_legs["default"]), 3),
+                "tuned_scan_ms": round(min(seal_legs["tuned"]), 3),
+            },
+        },
+        "platform": platform,
+    }
+    _sidecar_append({
+        "kind": "autotune_ab",
+        "wait_rps_runs": {k: [round(r, 1) for r in v]
+                          for k, v in wait_legs.items()},
+        "seal_scan_ms_runs": {k: [round(r, 3) for r in v]
+                              for k, v in seal_legs.items()},
+        **row,
+    })
+    return row
+
+
 CONFIGS = {
     # BASELINE.json configs; north star FIRST — the driver's single parsed
     # line is the first JSON line printed.
@@ -3701,6 +3960,7 @@ CONFIGS = {
     "serve_fleet_multiproc": lambda: _bench_serve_fleet_multiproc(),  # ISSUE 19
     "federated": lambda: _bench_federated(),                    # ISSUE 16 silos
     "soak": lambda: _bench_soak(),                              # ISSUE 17 day
+    "autotune": lambda: _bench_autotune(),                      # ISSUE 20 knobs
 }
 
 # Per-config watchdog budget (seconds); kmeans256 is the headline and gets
@@ -3948,7 +4208,7 @@ _TPU_PRIORITY = [
     "federated", "sql_device", "sql_incremental", "sql_history", "rf20",
     "gbt20", "nb",
     "gmm32", "bisecting", "streaming", "streaming_pipeline", "kmeans8",
-    "serve",
+    "serve", "autotune",
 ]
 
 

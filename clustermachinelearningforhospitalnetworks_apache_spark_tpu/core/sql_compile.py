@@ -51,13 +51,15 @@ from .table import Table
 #: int64 view of NaT — the device null sentinel for timestamp columns
 NAT_SENTINEL = int(np.datetime64("NaT", "ns").view(np.int64))
 
-_MIN_BUCKET = 256
-
 
 def bucket_for_rows(n: int) -> int:
-    """Smallest power-of-two bucket ≥ n (min 256 keeps the executable
-    count bounded for tiny tables)."""
-    b = _MIN_BUCKET
+    """Smallest power-of-two bucket ≥ n, floored at the registry's
+    ``sql.rowbucket.min`` (the floor keeps the executable count bounded
+    for tiny tables; resolved per call so a tuned floor applies to new
+    compilations without touching already-cached executables)."""
+    from ..tune import knob
+
+    b = int(knob("sql.rowbucket.min"))
     while b < n:
         b <<= 1
     return b

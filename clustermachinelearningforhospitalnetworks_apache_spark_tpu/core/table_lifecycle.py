@@ -35,6 +35,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..obs.trace import span
+from ..tune import knob
 from ..utils.faults import fault_point
 from .segments import (
     SegmentCorruptError, manifest_name, quarantine_segment, write_segment,
@@ -52,15 +53,34 @@ class RetentionPolicy:
     bounds segment size so one seal never rewrites unbounded history;
     ``retire_parts=False`` keeps part files forever (belt and
     suspenders for operators who want segments as pure acceleration).
+
+    The seal chunk knobs (``table.seal.min_batches`` /
+    ``table.seal.max_segment_batches``) are registry-owned: ``None``
+    resolves through :func:`tune.knob` when the policy is built, so a
+    frozen policy still pins ONE value for its lifetime — segment
+    boundaries must not move between two passes of the same policy.
     """
 
-    min_seal_batches: int = 4
+    min_seal_batches: int | None = None
     hot_batches: int = 2
-    max_segment_batches: int = 64
+    max_segment_batches: int | None = None
     retire_parts: bool = True
     #: column whose per-part max must fall below the seal watermark for
     #: a batch to count as cold (None → age by batch id alone)
     watermark_column: str | None = None
+
+    def __post_init__(self) -> None:
+        # frozen dataclass: resolve knob-owned fields once, at build
+        if self.min_seal_batches is None:
+            object.__setattr__(
+                self, "min_seal_batches",
+                int(knob("table.seal.min_batches")),
+            )
+        if self.max_segment_batches is None:
+            object.__setattr__(
+                self, "max_segment_batches",
+                int(knob("table.seal.max_segment_batches")),
+            )
 
 
 def _as_ns(watermark) -> int:

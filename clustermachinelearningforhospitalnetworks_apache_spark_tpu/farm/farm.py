@@ -65,7 +65,14 @@ _BIG = np.float32(1e30)
 _EPS = 1e-6
 
 
-def _next_pow2(n: int, floor: int = 8) -> int:
+def _next_pow2(n: int, floor: int | None = None) -> int:
+    # floor=None → the registry's farm.pack.r_floor: the smallest
+    # tenant-bucket R the farm pads fleets to (callers with a different
+    # axis to pad — e.g. the tenant-count axis — pass their own floor)
+    if floor is None:
+        from ..tune import knob
+
+        floor = int(knob("farm.pack.r_floor"))
     p = floor
     while p < n:
         p *= 2

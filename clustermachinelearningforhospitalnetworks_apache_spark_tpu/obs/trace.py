@@ -73,6 +73,8 @@ REGISTERED_SPANS = (
     "table.seal",        # cold batches → sealed CRC-manifested segment
     "table.retire",      # superseded part files deleted under retention
     "table.scrub",       # segment CRC audit: quarantine + rebuild rot
+    "tune.store",        # autotuner trial-store durable commit
+    "tune.select",       # one live-retune decision: select→journal→apply
 )
 
 #: fault site (fnmatch glob) → the registered span that encloses or
@@ -107,6 +109,8 @@ SITE_COVERAGE = {
     "table.seal.*": "table.seal",          # stage (segment+manifest) / commit
     "table.retire.commit": "table.retire",  # log-first part retirement
     "table.scrub.repair": "table.scrub",   # quarantine-and-rebuild point
+    "tune.store.commit": "tune.store",     # trial merge atomic-write commit
+    "tune.select.apply": "tune.select",    # between retune intent and apply
 }
 
 _CTX: contextvars.ContextVar = contextvars.ContextVar("obs_trace", default=None)

@@ -27,6 +27,8 @@ from typing import Callable, Union
 
 import numpy as np
 
+from ..tune import knob
+from ..tune import default as knob_default
 from ..utils.logging import get_logger
 from .breaker import CircuitBreaker
 from .metrics import ServingMetrics
@@ -46,8 +48,11 @@ from .registry import ServingModel
 log = get_logger("serve")
 
 #: default linger for followers when the queue is shallow — 2 ms buys
-#: coalescing at realistic arrival rates without a visible latency bump
-DEFAULT_MAX_WAIT_S = 0.002
+#: coalescing at realistic arrival rates without a visible latency bump.
+#: Owned by the knob registry (``serve.microbatch.max_wait_ms``); this
+#: compat constant is the DECLARED default — pass ``max_wait_s=None`` to
+#: resolve through the installed selector instead.
+DEFAULT_MAX_WAIT_S = knob_default("serve.microbatch.max_wait_ms") / 1e3
 
 Fallback = Union["ServingModel", Callable[[np.ndarray], np.ndarray], None]
 
@@ -67,16 +72,22 @@ class MicroBatcher:
     def __init__(
         self,
         model: ServingModel,
-        max_queue_rows: int = 4096,
-        max_wait_s: float = DEFAULT_MAX_WAIT_S,
+        max_queue_rows: int | None = None,
+        max_wait_s: float | None = None,
         fallback: Fallback = None,
         metrics: ServingMetrics | None = None,
         breaker: CircuitBreaker | None = None,
     ):
         self.model = model
         self.metrics = metrics or model.metrics
+        # None → resolved through the knob registry (declared default
+        # when no selector is installed — bit-identical to the old
+        # literals, pinned by tests/test_autotune.py)
         self.queue = RequestQueue(max_rows=max_queue_rows)
-        self.max_wait_s = max_wait_s
+        self.max_wait_s = (
+            knob("serve.microbatch.max_wait_ms") / 1e3
+            if max_wait_s is None else float(max_wait_s)
+        )
         self.fallback = fallback
         #: wraps the primary executable: repeated failures OPEN it and
         #: requests short-circuit to the fallback without device time

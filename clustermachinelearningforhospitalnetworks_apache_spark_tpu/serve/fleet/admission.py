@@ -35,6 +35,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Mapping
 
+from ...tune import knob
+
 #: SLO classes, in SHED order: under rising load, earlier classes are
 #: refused admission first.  interactive = a clinician waiting on the
 #: answer; batch = a scheduled job that can retry; best_effort =
@@ -72,11 +74,23 @@ def default_slo_classes() -> dict[str, SLOClass]:
     The thresholds are queue-sojourn budgets, not fairness knobs: a
     class's floor bounds how many lower-class rows an interactive
     request can queue behind, which is what keeps its deadline
-    meetable while the fleet is saturated."""
+    meetable while the fleet is saturated.
+
+    The batch/best_effort thresholds are owned by the knob registry
+    (``serve.slo.*.shed_load``) — the live retuner moves them by
+    swapping a fresh frozen :class:`SLOClass` into
+    ``AdmissionController.classes`` (an atomic dict-entry store), never
+    by mutating one in place.  interactive's 1.0 is not a knob: it is
+    the ladder's invariant (nothing sits above it to protect)."""
     return {
         SLO_INTERACTIVE: SLOClass(SLO_INTERACTIVE, 1.0, 0.030),
-        SLO_BATCH: SLOClass(SLO_BATCH, 0.45, 0.500),
-        SLO_BEST_EFFORT: SLOClass(SLO_BEST_EFFORT, 0.25, 2.0),
+        SLO_BATCH: SLOClass(
+            SLO_BATCH, float(knob("serve.slo.batch.shed_load")), 0.500
+        ),
+        SLO_BEST_EFFORT: SLOClass(
+            SLO_BEST_EFFORT,
+            float(knob("serve.slo.best_effort.shed_load")), 2.0,
+        ),
     }
 
 
@@ -150,6 +164,20 @@ class AdmissionController:
             for t, (r, b) in (tenant_quotas or {}).items()
         }
         self._lock = threading.Lock()
+
+    def set_shed_load(self, slo: str, shed_load: float) -> None:
+        """Atomically replace one class's threshold — the live-retune
+        apply path.  A fresh frozen :class:`SLOClass` lands in the dict
+        in ONE store; in-flight ``admit`` calls see the old or the new
+        contract, never a mix."""
+        cls = self.classes.get(slo)
+        if cls is None:
+            raise ValueError(
+                f"unknown SLO class {slo!r}; one of {sorted(self.classes)}"
+            )
+        self.classes[slo] = SLOClass(
+            cls.name, float(shed_load), cls.default_deadline_s
+        )
 
     def set_quota(self, tenant_id: str, rate: float, burst: float) -> None:
         with self._lock:

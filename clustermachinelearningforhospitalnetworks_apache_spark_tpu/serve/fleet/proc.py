@@ -80,6 +80,7 @@ import numpy as np
 from ...obs import flight_recorder as _flight
 from ...obs import trace as _trace
 from ...obs.registry import replica_label
+from ...tune import knob
 from ...utils.faults import fault_point, mangle_bytes
 from ...utils.logging import get_logger
 from ...utils.retry import RetryPolicy, call_with_retry
@@ -256,7 +257,13 @@ class ProcServerClient:
     ):
         self.replica_id = replica_id
         self._server_kw = dict(server_kw)
-        self.max_queue_rows = int(self._server_kw.get("max_queue_rows", 4096))
+        # the registry owns the bound: this fallback used to be a fifth
+        # hand-copied 4096 that could (and did) diverge from the other
+        # four — now every path resolves serve.queue.max_rows
+        mq = self._server_kw.get("max_queue_rows")
+        self.max_queue_rows = int(
+            knob("serve.queue.max_rows") if mq is None else mq
+        )
         self.breaker = CircuitBreaker(
             failure_threshold=int(
                 self._server_kw.get("breaker_failure_threshold", 5)

@@ -43,9 +43,9 @@ from ...obs.registry import (
     replica_label,
     split_labels,
 )
+from ...tune import knob
 from ...utils.faults import fault_point
 from ...utils.logging import get_logger
-from ..batcher import DEFAULT_MAX_WAIT_S
 from ..breaker import STATE_OPEN
 from ..bucketing import DEFAULT_BUCKETS
 from ..queue import (
@@ -176,8 +176,8 @@ class ReplicaSet:
         policy: str = POLICY_CONSISTENT_HASH,
         vnodes: int = 160,
         admission: AdmissionController | str | None = DEFAULT_ADMISSION,
-        max_queue_rows: int = 4096,
-        max_wait_s: float = DEFAULT_MAX_WAIT_S,
+        max_queue_rows: int | None = None,
+        max_wait_s: float | None = None,
         breaker_failure_threshold: int = 5,
         breaker_recovery_s: float = 5.0,
     ):
@@ -188,10 +188,20 @@ class ReplicaSet:
         self.placement = placement or EvenPlacement()
         self.slices = self.placement.assign(n_replicas, devices)
         #: per-replica server recipe, kept so revive_replica can rebuild
-        #: a dead replica's server bit-for-bit on its original slice
+        #: a dead replica's server bit-for-bit on its original slice.
+        #: Knob-owned bounds resolve ONCE here — every replica (and
+        #: every revive) shares the value selected at fleet build time;
+        #: live retuning (``set_max_wait_s``) moves the running batchers
+        #: AND this recipe, so revives serve the retuned value.
         self._server_kw = dict(
-            max_queue_rows=max_queue_rows,
-            max_wait_s=max_wait_s,
+            max_queue_rows=(
+                int(knob("serve.queue.max_rows"))
+                if max_queue_rows is None else max_queue_rows
+            ),
+            max_wait_s=(
+                knob("serve.microbatch.max_wait_ms") / 1e3
+                if max_wait_s is None else max_wait_s
+            ),
             breaker_failure_threshold=breaker_failure_threshold,
             breaker_recovery_s=breaker_recovery_s,
         )
@@ -640,6 +650,25 @@ class ReplicaSet:
                 if replica is not None:
                     sp.note("replica", replica_label(replica.index))
         return result
+
+    def set_max_wait_s(self, max_wait_s: float) -> int:
+        """Retune the micro-batch linger fleet-wide, live: one float
+        attribute store per running batcher (each worker reads
+        ``max_wait_s`` fresh every loop — the existing atomic path, no
+        new mutation protocol) plus the revive recipe, so a replica
+        revived after the retune serves the tuned value too.  This is
+        the apply seam of :class:`~...tune.live.LiveRetuner`; returns
+        the number of batchers moved."""
+        wait = float(max_wait_s)
+        self._server_kw["max_wait_s"] = wait
+        moved = 0
+        for r in self._replicas:
+            if r.state == REPLICA_DEAD:
+                continue
+            for b in list(r.server._batchers.values()):
+                b.max_wait_s = wait
+                moved += 1
+        return moved
 
     def predict(
         self,

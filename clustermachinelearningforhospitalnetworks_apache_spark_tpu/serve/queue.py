@@ -28,6 +28,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..tune import knob
+
 #: result statuses, 503-analogue semantics
 STATUS_OK = "ok"
 STATUS_REJECTED = "rejected"             # queue saturated at admission
@@ -108,7 +110,11 @@ class Request:
 class RequestQueue:
     """Row-bounded FIFO with shed-at-admission semantics."""
 
-    def __init__(self, max_rows: int = 4096):
+    def __init__(self, max_rows: int | None = None):
+        # None → the registry's serve.queue.max_rows (the ONE copy of a
+        # bound that previously lived as five diverged 4096 literals)
+        if max_rows is None:
+            max_rows = int(knob("serve.queue.max_rows"))
         if max_rows < 1:
             raise ValueError("max_rows must be positive")
         self.max_rows = max_rows

@@ -22,10 +22,11 @@ from ..obs import trace as _trace
 from ..obs.registry import global_registry
 from ..quality.drift import DriftMonitor, InputGuard, POLICY_REJECT
 from ..quality.sketches import DataProfile, PSI_DRIFT
+from ..tune import knob
 from ..utils.faults import fault_point
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsRegistry
-from .batcher import DEFAULT_MAX_WAIT_S, Fallback, MicroBatcher
+from .batcher import Fallback, MicroBatcher
 from .breaker import STATE_CLOSED, CircuitBreaker
 from .bucketing import DEFAULT_BUCKETS
 from .metrics import ServingMetrics
@@ -81,8 +82,8 @@ class InferenceServer:
     def __init__(
         self,
         registry: ModelRegistry | None = None,
-        max_queue_rows: int = 4096,
-        max_wait_s: float = DEFAULT_MAX_WAIT_S,
+        max_queue_rows: int | None = None,
+        max_wait_s: float | None = None,
         breaker_failure_threshold: int = 5,
         breaker_recovery_s: float = 5.0,
         ingest_metrics: MetricsRegistry | None = None,
@@ -93,8 +94,16 @@ class InferenceServer:
         #: builds — add_model and swap alike — compiles for this device
         self.device = device
         self.metrics: ServingMetrics = self.registry.metrics
-        self.max_queue_rows = max_queue_rows
-        self.max_wait_s = max_wait_s
+        # None → knob registry (serve.queue.max_rows /
+        # serve.microbatch.max_wait_ms) at the moment batchers are built
+        self.max_queue_rows = (
+            int(knob("serve.queue.max_rows"))
+            if max_queue_rows is None else max_queue_rows
+        )
+        self.max_wait_s = (
+            knob("serve.microbatch.max_wait_ms") / 1e3
+            if max_wait_s is None else max_wait_s
+        )
         self.breaker_failure_threshold = breaker_failure_threshold
         self.breaker_recovery_s = breaker_recovery_s
         self.ingest_metrics = ingest_metrics

@@ -124,7 +124,9 @@ class _SoakRun:
         self.fleet: ReplicaSet | None = None
         self.stream: StreamExecution | None = None
         self._kill_records: dict[str, dict] = {}  # replica idx -> record
-        for sub in ("incoming", "table", "ckpt", "models", "flight"):
+        self.retune_event: dict | None = None  # ISSUE 20 mid-day record
+        for sub in ("incoming", "table", "ckpt", "models", "flight",
+                    "tune"):
             os.makedirs(os.path.join(workdir, sub), exist_ok=True)
 
     # ------------------------------------------------------------ data
@@ -574,6 +576,8 @@ def _run_inner(run: _SoakRun, chaos, tracer, t_wall0) -> dict:
             run.lifecycle_tick(phase.name)
             probe.sample(f"after:{phase.name}")
             _boundary_lifecycle(run, phase, seen_counts)
+            if pi == (len(cfg.phases) - 1) // 2:
+                _midday_retune(run, phase.name)
             wd.check()
 
         trace_info = _traced_cycle(run)
@@ -634,6 +638,7 @@ def _run_inner(run: _SoakRun, chaos, tracer, t_wall0) -> dict:
             "rows_quarantined": quarantined,
             "csv_files": run._csv_seq,
         },
+        "retune": run.retune_event,
     }
 
 
@@ -743,6 +748,80 @@ def _boundary_lifecycle(run, phase, seen_counts) -> None:
         )
     except Exception as e:  # noqa: BLE001 — the report must see it
         run.unhandled.append(f"boundary {phase.name}: {e!r}")
+
+
+def _midday_retune(run, phase_name: str) -> None:
+    """ISSUE 20: the mid-day live-retune event.
+
+    Between phases the loadgen is quiet, so the driver probes the LIVE
+    fleet — short synchronous single-row bursts through the front door,
+    once at the deployed micro-batch linger and once at the 0 ms
+    candidate (observed load on the serving fleet, not an offline
+    sweep), each banked as a ``source="live"`` trial — then lets the
+    :class:`~..tune.LiveRetuner` re-decide through its journaled
+    intent → ``tune.select.apply`` → commit protocol.  The journal lives
+    in the workdir, so a restarted soak resumes the tuned value;
+    :func:`~.report.check_report` asserts interactive goodput does not
+    regress across this boundary."""
+    from .. import tune
+    from ..streaming.wal import read_lines
+
+    try:
+        tune_dir = os.path.join(run.workdir, "tune")
+        deployed_ms = float(run.fleet._server_kw["max_wait_s"]) * 1e3
+        rt = tune.LiveRetuner(
+            "serve.microbatch.max_wait_ms",
+            journal_path=os.path.join(tune_dir, "retune.journal"),
+            apply_fn=run.fleet.set_max_wait_s,
+            selector=tune.Selector(
+                tune.TrialStore(os.path.join(tune_dir, "trials.json"))
+            ),
+            convert=lambda ms: ms / 1e3,
+        )
+        model = run.fleet.registry.get(SERVING_NAME).model
+        tenant = run.tenants[0]
+        routed = model.route_request(tenant, run.req_pool[tenant][:1])
+
+        def probe_rps(seconds: float = 0.2) -> float:
+            n, t0 = 0, time.monotonic()
+            while time.monotonic() - t0 < seconds:
+                run.fleet.predict(SERVING_NAME, routed, tenant_id=tenant)
+                run.heartbeat += 1
+                n += 1
+            return n / max(time.monotonic() - t0, 1e-9)
+
+        probes: dict[float, float] = {}
+        for v in dict.fromkeys((0.0, deployed_ms)):  # each value once
+            run.fleet.set_max_wait_s(v / 1e3)
+            rt.current = v  # observe() records against the serving value
+            probes[v] = probe_rps()
+            rt.observe(probes[v], meta={"phase": phase_name})
+        # restore the deployed value: the MOVE must go through the
+        # journaled retune protocol, not through the probe loop
+        run.fleet.set_max_wait_s(deployed_ms / 1e3)
+        rt.current = deployed_ms
+        out = rt.retune(shape_rows=1)
+        run.retune_event = {
+            **out,
+            "boundary_after_phase": phase_name,
+            "probe_rps": {str(k): round(p, 1) for k, p in probes.items()},
+            "journal_kinds": [
+                e.get("kind") for e in read_lines(rt.journal_path)
+            ] if os.path.exists(rt.journal_path) else [],
+        }
+        log.info(
+            "mid-day retune", knob=out["knob"], old=out["old"],
+            new=out["new"], applied=out["applied"], reason=out["reason"],
+        )
+    # cmlhn: disable=crash-swallowed — the soak driver IS the recovery boundary: the kill is delivered onward as a site-tagged postmortem in the machine-checked SoakReport
+    except faults.InjectedCrash as e:
+        run._record_event(
+            kind=KIND_CRASH, target=str(e.site),
+            label=f"crash:{e.site}@retune:{phase_name}",
+            recovered=True, postmortems=[run._last_postmortem(e)],
+        )
+    except Exception as e:  # noqa: BLE001 — the report must see it
+        run.unhandled.append(f"retune {phase_name}: {e!r}")
 
 
 def _traced_cycle(run) -> dict:

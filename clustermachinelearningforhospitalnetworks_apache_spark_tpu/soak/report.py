@@ -217,6 +217,67 @@ def check_report(payload: dict, verify_postmortems: bool = True) -> list[str]:
                     f"{budget_mb} MiB budget"
                 )
 
+    # 6c. ISSUE 20 — the mid-day live retune: the serving knob moved
+    # through the journaled intent→apply→commit protocol, and goodput
+    # did not regress across the retune boundary (the tuned value must
+    # never buy probe throughput at the cost of in-SLO serving)
+    rt = payload.get("retune")
+    retune_crashed = any(
+        "@retune:" in (k.get("label") or "") for k in kills
+    )
+    if rt is None:
+        if not retune_crashed:
+            v.append(
+                "no mid-day retune recorded — the live-retune leg "
+                "never ran"
+            )
+    else:
+        if not rt.get("applied"):
+            v.append(
+                f"mid-day retune did not apply (reason "
+                f"{rt.get('reason')!r}) — the serving knob never moved"
+            )
+        else:
+            kinds = rt.get("journal_kinds") or []
+            if "intent" not in kinds or (kinds and kinds[-1] != "commit"):
+                v.append(
+                    f"retune journal kinds {kinds} — an applied retune "
+                    "must leave intent→commit, commit last"
+                )
+            if not str(rt.get("reason", "")).startswith("tuned:"):
+                v.append(
+                    f"applied retune carries reason {rt.get('reason')!r} "
+                    "— an applied move must name its winning trial"
+                )
+        boundary = rt.get("boundary_after_phase")
+        names = [p.get("name") for p in phases]
+        if boundary not in names:
+            v.append(
+                f"retune boundary {boundary!r} names no phase in the "
+                "report"
+            )
+        else:
+            cut = names.index(boundary)
+            before = [
+                p.get("goodput_frac") for p in phases[: cut + 1]
+                if p.get("goodput_frac") is not None
+            ]
+            after = [
+                p.get("goodput_frac") for p in phases[cut + 1:]
+                if p.get("goodput_frac") is not None
+            ]
+            if not after:
+                v.append(
+                    "no phases after the retune boundary — the retuned "
+                    "value never served"
+                )
+            elif before and min(after) + 0.05 < min(before):
+                v.append(
+                    f"goodput regressed across the retune boundary: "
+                    f"min {min(before):.3f} before vs {min(after):.3f} "
+                    "after"
+                )
+
     # 7. the end-to-end trace
     tr = payload.get("trace", {})
     if not tr.get("trace_id"):

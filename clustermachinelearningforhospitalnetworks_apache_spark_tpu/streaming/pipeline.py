@@ -57,6 +57,7 @@ from typing import Any, Callable
 
 from ..core.table import Table
 from ..parallel.sharding import batch_rows
+from ..tune import knob
 from ..utils.logging import get_logger
 from ..utils.profiling import StageClock
 from .microbatch import BatchInfo, StreamExecution
@@ -171,8 +172,9 @@ class _Prefetcher(threading.Thread):
             for f in src.list_files()
             if f not in seen and f not in self.claimed
         ]
-        if src.max_files_per_batch > 0:
-            new = new[: src.max_files_per_batch]
+        cap = src.files_cap()
+        if cap > 0:
+            new = new[:cap]
         return new
 
     def run(self) -> None:
@@ -281,8 +283,10 @@ class PipelinedStreamExecution(StreamExecution):
     Call :meth:`close` (or use as a context manager) when done.
     """
 
-    pipeline_depth: int = 2
-    worker_poll_interval_s: float = 0.05
+    #: None → knob registry (stream.pipeline.depth /
+    #: stream.worker.poll_interval_ms), resolved when the worker spawns
+    pipeline_depth: int | None = None
+    worker_poll_interval_s: float | None = None
     stage: Callable[[Table], Any] | None = None
     clock: StageClock = field(default_factory=StageClock)
     _prefetcher: _Prefetcher | None = field(default=None, repr=False)
@@ -293,9 +297,16 @@ class PipelinedStreamExecution(StreamExecution):
         # recovery through the serial path first, and its commit marks
         # the files seen before the worker could ever re-claim them)
         if self._prefetcher is None:
-            self._prefetcher = _Prefetcher(
-                self, self.pipeline_depth, self.worker_poll_interval_s
+            depth = (
+                int(knob("stream.pipeline.depth"))
+                if self.pipeline_depth is None else self.pipeline_depth
             )
+            poll = (
+                knob("stream.worker.poll_interval_ms") / 1e3
+                if self.worker_poll_interval_s is None
+                else self.worker_poll_interval_s
+            )
+            self._prefetcher = _Prefetcher(self, depth, poll)
             self._prefetcher.start()
         return self._prefetcher
 
@@ -522,7 +533,7 @@ def make_sql_feature_stage(
     statement: str,
     feature_cols,
     label_col: str | None = None,
-    min_compiled_rows: int = 4096,
+    min_compiled_rows: int | None = None,
 ):
     """Stage-hook factory (ISSUE 7): run a SQL statement over each
     micro-batch's accepted rows on the prefetch worker, then extract the
@@ -545,6 +556,10 @@ def make_sql_feature_stage(
 
     feature_cols = list(feature_cols)
     stmt = statement.replace("__THIS__", "__this__")
+    if min_compiled_rows is None:
+        # resolved once per stage build, not per batch: Flare's decide-
+        # ahead rule — the threshold must not flap mid-stream
+        min_compiled_rows = int(knob("sql.stage.min_compiled_rows"))
 
     def _resolver(table: Table):
         # per-call closure (the worker and a commit-thread re-stage may

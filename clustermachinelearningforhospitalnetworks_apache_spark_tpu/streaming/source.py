@@ -22,6 +22,7 @@ from typing import TYPE_CHECKING
 from ..core.schema import Schema
 from ..core.table import Table
 from ..io.csv import read_csv
+from ..tune import knob
 from ..utils.faults import fault_point
 
 if TYPE_CHECKING:  # pragma: no cover — typing only, avoids import cycle
@@ -44,7 +45,8 @@ class FileStreamSource:
     #: SEQUENCE of batches — which is what lets the pipelined driver
     #: overlap batch N+1's parse with batch N's device update instead of
     #: swallowing the whole backlog as one serial mega-batch.
-    max_files_per_batch: int = 0
+    #: None → the registry's stream.source.max_files_per_batch.
+    max_files_per_batch: int | None = None
     #: per-file read retry (exponential backoff + jitter): a flaky
     #: hospital-source mount answers after a beat instead of failing the
     #: whole micro-batch; a persistent failure still surfaces (and the
@@ -91,9 +93,18 @@ class FileStreamSource:
         between poll and commit replays the same files), capped at
         ``max_files_per_batch`` when set."""
         new = [f for f in self.list_files() if f not in self._seen]
-        if self.max_files_per_batch > 0:
-            new = new[: self.max_files_per_batch]
+        cap = self.files_cap()
+        if cap > 0:
+            new = new[:cap]
         return new
+
+    def files_cap(self) -> int:
+        """The resolved per-batch file cap (0 = unbounded) — the ONE
+        copy of the capping rule; the pipelined driver's worker-side
+        poll applies this too (it used to carry its own slice)."""
+        if self.max_files_per_batch is None:
+            return int(knob("stream.source.max_files_per_batch"))
+        return self.max_files_per_batch
 
     def commit_files(self, files: list[str]) -> None:
         with self._seen_lock:

@@ -1,0 +1,20 @@
+"""Fixture: every untracked-knob binding shape the rule must catch."""
+
+# shape 1a: module constant under a registered py_name
+max_wait_s = 0.004
+
+# shape 3: alias — a module constant laundered into a knob-named default
+_QUEUE_BOUND = 8192
+
+
+class Server:
+    def __init__(self, max_queue_rows: int = 4096):   # shape 2: default
+        # shape 1b: attribute assignment of a raw literal
+        self.pipeline_depth = 3
+        self.rows = max_queue_rows
+
+
+def build(max_rows=_QUEUE_BOUND):                     # flags _QUEUE_BOUND
+    # negative/unary literals count too
+    min_compiled_rows = +2048
+    return min_compiled_rows

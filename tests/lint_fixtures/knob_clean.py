@@ -1,0 +1,29 @@
+"""Clean twin: knob-owned names resolved through the registry, and the
+shapes the rule must NOT flag."""
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.tune import (
+    knob,
+)
+
+# unregistered names keep their literals — only the registry set is law
+retry_budget = 7
+
+
+class Server:
+    def __init__(self, max_queue_rows: int | None = None):
+        # None-sentinel default resolved through the registry: clean
+        if max_queue_rows is None:
+            max_queue_rows = int(knob("serve.queue.max_rows"))
+        self.rows = max_queue_rows
+        # non-literal values under a knob name are fine (the resolution
+        # path itself assigns these names)
+        self.max_wait_s = knob("serve.microbatch.max_wait_ms") / 1e3
+        # bools are ints to the AST but never a tuned quantity
+        self.fused_rounds = True
+
+
+def sweep():
+    # call KEYWORDS are exempt: explicitly pinning an operating point
+    # (benches sweeping a domain, soak configs) is the sanctioned way
+    # to pass a non-default value
+    return Server(max_queue_rows=1024)

@@ -1,0 +1,336 @@
+"""ISSUE 20: the measurement-driven autotuner (``tune/``).
+
+Four contracts under test:
+
+* **registry round-trip** — knobs declare once (idempotent re-add,
+  conflicting redeclaration refused, domain/mode validated), and every
+  migrated call site's resolved default is BIT-IDENTICAL to the literal
+  it replaced while no selector is installed (the migration must be
+  behavior-neutral until trials exist).
+* **selector discipline** — thin coverage falls back to the declared
+  default (``default:no-trials``), measured coverage picks the best
+  value and names the winning trial (``tuned:<id>``), and NO selection
+  ever happens inside a fenced A/B (``frozen:fenced-ab`` — probed with
+  trials present, both with and without a pre-fence selection to pin).
+* **store durability** (chaos) — a killed trial commit leaves the
+  previous document intact and the replayed add merges by content hash
+  to a byte-identical store: exactly-once.
+* **live retuning** (chaos) — a committed retune survives restart via
+  the journal; a kill at ``tune.select.apply`` leaves the PREVIOUS
+  value serving (intent without commit is ignored on resume).
+
+Plus the PR's bugfix-sweep regression: the five previously-diverged
+``max_queue_rows``/``max_rows`` copies all resolve through ONE registry
+entry now.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu import tune
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.streaming.wal import (
+    read_lines,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.tune import (
+    Knob, KnobRegistry, LiveRetuner, Selector, TrialStore, make_trial,
+)
+from clustermachinelearningforhospitalnetworks_apache_spark_tpu.utils import (
+    faults,
+)
+
+pytestmark = pytest.mark.fast
+
+WAIT_KNOB = "serve.microbatch.max_wait_ms"
+
+
+def _store_with_wait_trials(tmp_path, name="trials.json"):
+    st = TrialStore(str(tmp_path / name))
+    st.add([
+        make_trial(knob=WAIT_KNOB, value=v, score=s, shape_rows=64,
+                   metric="span:serve.request")
+        for v, s in [(0.0, 9000.0), (2.0, 400.0), (8.0, 90.0)]
+    ])
+    return st
+
+
+# ================================================================ registry
+def test_registry_round_trip_and_validation():
+    reg = KnobRegistry()
+    k = Knob(name="x.y", default=4, domain=(2, 4, 8), metric="span:x",
+             mode="max", py_names=("y",))
+    reg.add(k)
+    reg.add(k)                                # idempotent re-declare
+    assert reg.get("x.y").default == 4
+    assert "x.y" in reg and reg.names() == ["x.y"]
+    assert reg.py_name_map() == {"y": "x.y"}
+    with pytest.raises(ValueError, match="different declaration"):
+        reg.add(Knob(name="x.y", default=2, domain=(2, 4, 8)))
+    with pytest.raises(ValueError, match="not in domain"):
+        Knob(name="bad", default=3, domain=(2, 4))
+    with pytest.raises(ValueError, match="mode"):
+        Knob(name="bad", default=2, domain=(2,), mode="sideways")
+    with pytest.raises(KeyError, match="unregistered"):
+        reg.get("nope")
+
+
+def test_migrated_defaults_parity():
+    """With no selector installed, every migrated call site resolves to
+    the EXACT literal it replaced — bit-tight, not approximately."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.sql_compile import (
+        bucket_for_rows,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.core.table_lifecycle import (
+        RetentionPolicy,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.farm.farm import (
+        _next_pow2,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.batcher import (
+        DEFAULT_MAX_WAIT_S,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.fleet.admission import (
+        default_slo_classes,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.queue import (
+        RequestQueue,
+    )
+
+    assert tune.installed() is None
+    assert DEFAULT_MAX_WAIT_S == 0.002          # was the literal 0.002
+    assert tune.knob(WAIT_KNOB) / 1e3 == 0.002
+    assert RequestQueue().max_rows == 4096      # was five 4096 copies
+    classes = default_slo_classes()
+    assert classes["batch"].shed_load == 0.45
+    assert classes["best_effort"].shed_load == 0.25
+    assert classes["interactive"].shed_load == 1.0   # invariant, not a knob
+    pol = RetentionPolicy()
+    assert (pol.min_seal_batches, pol.max_segment_batches) == (4, 64)
+    assert bucket_for_rows(1) == 256            # was _MIN_BUCKET = 256
+    assert bucket_for_rows(300) == 512
+    assert _next_pow2(3) == 8                   # was floor=8
+    assert tune.knob("stream.pipeline.depth") == 2
+    assert tune.knob("stream.worker.poll_interval_ms") / 1e3 == 0.05
+    assert tune.knob("stream.source.max_files_per_batch") == 0
+    assert tune.knob("sql.stage.min_compiled_rows") == 4096
+
+
+def test_queue_bound_unified_regression(monkeypatch):
+    """Bugfix-sweep regression: the proc-fleet facade's queue bound used
+    to be a fifth hand-copied ``4096`` that could diverge from the other
+    four — every path must now agree with the ONE registry entry."""
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.fleet.proc import (
+        ProcServerClient,
+    )
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu.serve.queue import (
+        RequestQueue,
+    )
+
+    # only the bound derivation matters here, not a live worker process
+    monkeypatch.setattr(ProcServerClient, "_spawn", lambda self: None)
+    client = ProcServerClient(0, {})
+    assert client.max_queue_rows == int(tune.knob("serve.queue.max_rows"))
+    assert client.max_queue_rows == RequestQueue().max_rows == 4096
+    assert ProcServerClient(0, {"max_queue_rows": 512}).max_queue_rows == 512
+
+
+# ================================================================ selector
+def test_selector_thin_coverage_falls_back_to_default(tmp_path):
+    st = TrialStore(str(tmp_path / "t.json"))
+    # ONE distinct value measured: no gradient — default must win
+    st.add([make_trial(knob=WAIT_KNOB, value=0.0, score=9000.0)])
+    sel = Selector(st)
+    k = tune.REGISTRY.get(WAIT_KNOB)
+    assert sel.resolve(k, 64) == k.default
+    ex = sel.explain(WAIT_KNOB)
+    assert ex["reason"] == tune.REASON_DEFAULT_NO_TRIALS
+    assert ex["value"] == k.default
+
+
+def test_selector_picks_best_and_names_the_trial(tmp_path):
+    st = _store_with_wait_trials(tmp_path)
+    sel = Selector(st)
+    with tune.active(sel):
+        assert tune.knob(WAIT_KNOB, 64) == 0.0
+    ex = sel.explain(WAIT_KNOB)
+    assert ex["reason"].startswith(tune.REASON_TUNED_PREFIX)
+    tid = ex["reason"][len(tune.REASON_TUNED_PREFIX):]
+    assert tid in {t["trial_id"] for t in st.trials(knob=WAIT_KNOB)}
+    assert ex["trials_considered"] == 3
+
+
+def test_selector_interpolates_between_shape_buckets(tmp_path):
+    st = TrialStore(str(tmp_path / "t.json"))
+    # value 1.0 wins at small shapes, value 4.0 at large; at bucket 256
+    # the log2-interpolated scores must cross over to 4.0
+    st.add([
+        make_trial(knob=WAIT_KNOB, value=1.0, score=1000.0, shape_rows=16),
+        make_trial(knob=WAIT_KNOB, value=1.0, score=100.0, shape_rows=1024),
+        make_trial(knob=WAIT_KNOB, value=4.0, score=200.0, shape_rows=16),
+        make_trial(knob=WAIT_KNOB, value=4.0, score=900.0, shape_rows=1024),
+    ])
+    sel = Selector(st)
+    k = tune.REGISTRY.get(WAIT_KNOB)
+    assert sel.resolve(k, 16) == 1.0
+    assert sel.resolve(k, 1024) == 4.0
+    assert sel.resolve(k, 512) == 4.0      # nearer the large regime
+    assert sel.resolve(k, 32) == 1.0       # nearer the small regime
+    # min-mode knob: lower score wins
+    st.add([
+        make_trial(knob="sql.rowbucket.min", value=64, score=5.0),
+        make_trial(knob="sql.rowbucket.min", value=256, score=9.0),
+    ])
+    assert sel.resolve(tune.REGISTRY.get("sql.rowbucket.min"), 1) == 64
+
+
+def test_no_selection_inside_fenced_ab(tmp_path):
+    """The acceptance probe: trials exist that WOULD move the knob, but
+    inside the fence nothing is selected — the value already in effect
+    (last pre-fence selection, else the default) is returned with the
+    frozen reason, and nesting keeps the fence closed."""
+    st = _store_with_wait_trials(tmp_path)
+    sel = Selector(st)
+    k = tune.REGISTRY.get(WAIT_KNOB)
+    with tune.active(sel):
+        # no pre-fence selection yet: frozen resolves pin the DEFAULT
+        with tune.ab_fence():
+            assert tune.fence_active()
+            assert tune.knob(WAIT_KNOB, 64) == k.default
+            assert sel.explain(WAIT_KNOB)["reason"] == \
+                tune.REASON_FROZEN_FENCED
+            with tune.ab_fence():               # nested: still fenced
+                assert tune.knob(WAIT_KNOB, 64) == k.default
+        assert not tune.fence_active()
+        # selection outside the fence moves it...
+        assert tune.knob(WAIT_KNOB, 64) == 0.0
+        # ...and a later fence pins THAT value, still without selecting
+        with tune.ab_fence():
+            assert tune.knob(WAIT_KNOB, 64) == 0.0
+            assert sel.explain(WAIT_KNOB)["reason"] == \
+                tune.REASON_FROZEN_FENCED
+
+
+# ================================================================== store
+def test_store_round_trip_and_content_hash_dedup(tmp_path):
+    st = _store_with_wait_trials(tmp_path)
+    assert len(st) == 3
+    # same observation again: content hash dedups, document unchanged
+    before = open(st.path, "rb").read()
+    assert st.add([make_trial(knob=WAIT_KNOB, value=0.0, score=9000.0,
+                              shape_rows=64,
+                              metric="span:serve.request")]) == 0
+    assert open(st.path, "rb").read() == before
+    assert len(TrialStore(st.path)) == 3
+
+
+@pytest.mark.chaos
+def test_killed_store_commit_replays_exactly_once(tmp_path):
+    """Kill the durable commit, replay the add: the resumed store must
+    be BYTE-identical to one that never crashed."""
+    base = [make_trial(knob=WAIT_KNOB, value=2.0, score=400.0)]
+    extra = [make_trial(knob=WAIT_KNOB, value=0.0, score=9000.0)]
+
+    ref = TrialStore(str(tmp_path / "ref.json"))
+    ref.add(base)
+    ref.add(extra)
+
+    st = TrialStore(str(tmp_path / "t.json"))
+    st.add(base)
+    plan = faults.FaultPlan().crash("tune.store.commit")
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            st.add(extra)
+    assert plan.fired("tune.store.commit") == 1
+    # the kill landed before the tmp write: previous document intact
+    resumed = TrialStore(str(tmp_path / "t.json"))
+    assert len(resumed) == 1
+    resumed.add(extra)                          # the replay
+    assert open(resumed.path, "rb").read() == open(ref.path, "rb").read()
+
+
+# ============================================================ live retune
+class _Holder:
+    def __init__(self, value):
+        self.value = value
+
+    def apply(self, v):
+        self.value = v
+
+
+def _retuner(tmp_path, st, holder):
+    sel = Selector(st)
+    return LiveRetuner(
+        WAIT_KNOB, journal_path=str(tmp_path / "retune.journal"),
+        apply_fn=holder.apply, selector=sel, convert=lambda ms: ms / 1e3,
+    )
+
+
+def test_live_retune_applies_journals_and_resumes(tmp_path):
+    st = _store_with_wait_trials(tmp_path)
+    holder = _Holder(0.002)
+    rt = _retuner(tmp_path, st, holder)
+    out = rt.retune(shape_rows=64)
+    assert out["applied"] and out["old"] == 2.0 and out["new"] == 0.0
+    assert out["reason"].startswith(tune.REASON_TUNED_PREFIX)
+    assert holder.value == 0.0                  # converted ms → s
+    kinds = [e["kind"] for e in read_lines(rt.journal_path)]
+    assert kinds == ["intent", "commit"]
+    # a fresh process resumes the COMMITTED value through the journal
+    holder2 = _Holder(0.002)
+    rt2 = _retuner(tmp_path, st, holder2)
+    assert rt2.resume() == 0.0
+    assert holder2.value == 0.0 and rt2.current == 0.0
+    # steady state: re-selecting the same value applies nothing new
+    out2 = rt2.retune(shape_rows=64)
+    assert not out2["applied"]
+    assert [e["kind"] for e in read_lines(rt.journal_path)] == kinds
+
+
+def test_live_observe_feeds_the_store(tmp_path):
+    st = TrialStore(str(tmp_path / "t.json"))
+    holder = _Holder(0.002)
+    rt = _retuner(tmp_path, st, holder)
+    t = rt.observe(1234.5, shape_rows=128, meta={"phase": "midday"})
+    assert t["source"] == "live" and t["value"] == 2.0
+    assert TrialStore(st.path).trials(knob=WAIT_KNOB)[0]["meta"] == \
+        {"phase": "midday"}
+
+
+@pytest.mark.chaos
+def test_killed_live_retune_leaves_previous_value_serving(tmp_path):
+    """Kill between intent and apply: the old value keeps serving, the
+    journal shows an uncommitted intent, and resume() ignores it."""
+    st = _store_with_wait_trials(tmp_path)
+    holder = _Holder(0.002)
+    rt = _retuner(tmp_path, st, holder)
+    plan = faults.FaultPlan().crash("tune.select.apply")
+    with faults.active(plan):
+        with pytest.raises(faults.InjectedCrash):
+            rt.retune(shape_rows=64)
+    assert plan.fired("tune.select.apply") == 1
+    assert holder.value == 0.002                # previous value serving
+    assert [e["kind"] for e in read_lines(rt.journal_path)] == ["intent"]
+    # restart: the uncommitted intent must NOT be replayed
+    holder2 = _Holder(0.002)
+    rt2 = _retuner(tmp_path, st, holder2)
+    assert rt2.resume() is None
+    assert holder2.value == 0.002 and rt2.current == 2.0
+    # the retry (no fault) completes the move
+    out = rt2.retune(shape_rows=64)
+    assert out["applied"] and holder2.value == 0.0
+    assert [e["kind"] for e in read_lines(rt.journal_path)] == \
+        ["intent", "intent", "commit"]
+
+
+def test_live_retune_is_frozen_inside_fence(tmp_path):
+    st = _store_with_wait_trials(tmp_path)
+    holder = _Holder(0.002)
+    rt = _retuner(tmp_path, st, holder)
+    with tune.ab_fence():
+        out = rt.retune(shape_rows=64)
+    assert not out["applied"]
+    assert out["reason"] == tune.REASON_FROZEN_FENCED
+    assert holder.value == 0.002
+    assert not os.path.exists(rt.journal_path)  # nothing even journaled

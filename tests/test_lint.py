@@ -40,7 +40,7 @@ from lint import load_baseline, passes_by_name, run, write_baseline  # noqa: E40
 def run_fixture(
     tmp_path, fixture: str, passes: list[str],
     dest: str = f"{PKG}/models", complete: bool = False,
-    with_trace: bool = False, baseline=None,
+    with_trace: bool = False, with_knobs: bool = False, baseline=None,
 ):
     """Run ``passes`` over one fixture, staged into a temp tree shaped
     like the repo so path-scoped passes apply."""
@@ -57,6 +57,13 @@ def run_fixture(
             os.path.join(ROOT, PKG, "obs", "trace.py"), obs / "trace.py"
         )
         paths.append(str(obs / "trace.py"))
+    if with_knobs:
+        tune = root / PKG / "tune"
+        tune.mkdir(parents=True, exist_ok=True)
+        shutil.copy(
+            os.path.join(ROOT, PKG, "tune", "knobs.py"), tune / "knobs.py"
+        )
+        paths.append(str(tune / "knobs.py"))
     return run(
         paths=paths, passes=passes_by_name(passes), root=str(root),
         complete=complete, baseline=baseline,
@@ -87,6 +94,7 @@ RULE_CASES = [
     ("obs_spans_bad.py", ["obs_coverage"],
      {"span-unregistered", "dynamic-span-name"}, {"with_trace": True}),
     ("partitioner_bad.py", ["partitioner"], {"handrolled-sharding"}, {}),
+    ("knob_bad.py", ["knobs"], {"untracked-knob"}, {"with_knobs": True}),
 ]
 
 
@@ -140,6 +148,20 @@ def test_partitioner_alias_resolution_counts(tmp_path):
     (only a call mints a layout)."""
     report = run_fixture(tmp_path, "partitioner_bad.py", ["partitioner"])
     hits = [f for f in report.active if f.rule == "handrolled-sharding"]
+    assert len(hits) == 5, [(f.line, f.message) for f in hits]
+
+
+def test_untracked_knob_binding_shapes(tmp_path):
+    """All five binding shapes in the fixture are caught — the module
+    constant, the attribute assignment, the signature default, the
+    alias-laundered default (flagged at the constant, like
+    ``handrolled-sharding`` resolves import aliases), and the unary-
+    prefixed literal — while call keywords, None-sentinel defaults,
+    knob()-derived values and bools in the clean twin stay exempt."""
+    report = run_fixture(
+        tmp_path, "knob_bad.py", ["knobs"], with_knobs=True
+    )
+    hits = [f for f in report.active if f.rule == "untracked-knob"]
     assert len(hits) == 5, [(f.line, f.message) for f in hits]
 
 

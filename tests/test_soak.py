@@ -259,6 +259,20 @@ def test_check_report_catches_each_invariant(smoke):
     def wrong_version(p):
         p["version"] = SCHEMA_VERSION + 1
 
+    def no_retune(p):
+        p["retune"] = None
+
+    def retune_not_applied(p):
+        p["retune"]["applied"] = False
+
+    def retune_commitless_journal(p):
+        p["retune"]["journal_kinds"] = ["intent"]
+
+    def retune_goodput_regressed(p):
+        # below min(before) - tolerance but still over the phase floor,
+        # so only the retune-boundary invariant can catch it
+        p["phases"][-1]["goodput_frac"] = 0.6
+
     cases = [
         (unhandled, "unhandled exception"),
         (unanswered, "unanswered=3"),
@@ -275,6 +289,10 @@ def test_check_report_catches_each_invariant(smoke):
         (broken_trace, "span chain incomplete"),
         (not_replayable, "not replayable"),
         (wrong_version, "schema version"),
+        (no_retune, "live-retune leg never ran"),
+        (retune_not_applied, "never moved"),
+        (retune_commitless_journal, "commit last"),
+        (retune_goodput_regressed, "regressed across the retune"),
     ]
     for mutate, needle in cases:
         violations = _doctored(payload, mutate)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Offline autotune sweeps: measure knob candidates, bank trials.
+
+The ISSUE 20 loop has three legs — this is the first one:
+
+    tools/autotune.py  ──trials──►  tune/store.py  ──►  tune/select.py
+
+Each sweep measures every candidate value of a knob's declared domain
+under a representative workload (the SAME harnesses the ``autotune``
+bench config gates with — bench.py owns them, this CLI reuses them) and
+banks one trial per value into a durable :class:`~tune.store.TrialStore`.
+A process that later installs a :class:`~tune.select.Selector` over that
+store gets measured winners instead of hand-set defaults; ``--explain``
+shows exactly what it would pick and why.
+
+    tools/autotune.py --list                     # the registered knobs
+    tools/autotune.py --store trials.json        # run every sweep
+    tools/autotune.py --store trials.json --knob serve.microbatch.max_wait_ms
+    tools/autotune.py --store trials.json --explain   # selection preview
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sweeps():
+    """knob name → callable(store, platform) running its offline sweep.
+
+    Only knobs with a bench-grade measurement harness are sweepable from
+    here; the others tune from live stats (``LiveRetuner.observe``) or
+    wait for a harness.  bench.py owns the harnesses so the bench gate
+    and this CLI can never measure two different things."""
+    import bench
+
+    def serve_wait(store, platform):
+        sweep_s = float(os.environ.get("BENCH_AUTOTUNE_SWEEP_SECONDS", 0.4))
+        bench._autotune_serve_sweep(store, platform, sweep_s)
+
+    def seal_batches(store, platform):
+        import shutil
+
+        rows = max(int(os.environ.get("BENCH_AUTOTUNE_ROWS", "2048")), 256)
+        reps = max(int(os.environ.get("BENCH_AUTOTUNE_SCAN_REPS", 5)), 2)
+        work = tempfile.mkdtemp(prefix="autotune_seal_")
+        try:
+            bench._autotune_seal_sweep(store, platform, work, rows, 48, reps)
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+
+    return {
+        "serve.microbatch.max_wait_ms": serve_wait,
+        "table.seal.max_segment_batches": seal_batches,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--store", help="trial store path (JSON document)")
+    ap.add_argument("--knob", help="sweep only this knob")
+    ap.add_argument("--list", action="store_true",
+                    help="print the knob registry and exit")
+    ap.add_argument("--explain", action="store_true",
+                    help="print what a Selector over --store would pick")
+    args = ap.parse_args()
+
+    from clustermachinelearningforhospitalnetworks_apache_spark_tpu import (
+        tune,
+    )
+
+    sweeps = _sweeps()
+    if args.list:
+        for name in tune.REGISTRY.names():
+            k = tune.REGISTRY.get(name)
+            how = "sweep:tools/autotune.py" if name in sweeps else "live"
+            print(f"{name:<36} default={k.default!r:<8} mode={k.mode} "
+                  f"metric={k.metric or '-'} [{how}]")
+            print(f"{'':<36} domain={list(k.domain)}")
+        return 0
+
+    if not args.store:
+        ap.error("--store is required (or use --list)")
+    store = tune.TrialStore(args.store)
+
+    if args.explain:
+        sel = tune.Selector(store)
+        for name in tune.REGISTRY.names():
+            sel.resolve(tune.REGISTRY.get(name))
+            print(f"{name:<36} {json.dumps(sel.explain(name))}")
+        return 0
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    names = [args.knob] if args.knob else sorted(sweeps)
+    for name in names:
+        if name not in sweeps:
+            known = ", ".join(sorted(sweeps))
+            print(f"no offline sweep harness for {name!r} (have: {known})")
+            return 2
+        before = len(store)
+        sweeps[name](store, platform)
+        print(f"{name}: banked {len(store) - before} trial(s) "
+              f"on {platform} -> {args.store}")
+    sel = tune.Selector(store, platform=platform)
+    for name in names:
+        sel.resolve(tune.REGISTRY.get(name))
+        print(f"  would select: {json.dumps(sel.explain(name))}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

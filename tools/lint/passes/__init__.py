@@ -11,6 +11,7 @@ from .crash_protocol import CrashProtocolPass
 from .determinism import DeterminismPass
 from .durability import DurabilityPass
 from .jit_hygiene import JitHygienePass
+from .knobs import KnobsPass
 from .metric_labels import MetricLabelsPass
 from .obs_coverage import ObsCoveragePass
 from .partitioner import PartitionerPass
@@ -26,6 +27,7 @@ def all_passes():
         MetricLabelsPass(),
         ObsCoveragePass(),
         PartitionerPass(),
+        KnobsPass(),
         DurabilityPass(),
         CrashProtocolPass(),
     ]

@@ -57,6 +57,7 @@ SANCTIONED = tuple(
         "core/segments.py",
         "lifecycle/feedback.py", "lifecycle/journal.py",
         "soak/report.py",
+        "tune/store.py",
     )
 )
 

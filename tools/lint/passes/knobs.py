@@ -1,0 +1,195 @@
+"""Untracked-knob pass (ISSUE 20 satellite rule).
+
+ISSUE 20 made ``tune/knobs.py`` the ONE place that owns every hand-set
+performance constant: call sites resolve through ``knob("...")`` and
+the registry's declared default replaces the literal they used to
+carry.  A raw numeric literal re-assigned to one of those names outside
+``tune/`` re-opens the drift the migration closed — the serve layer
+alone had FIVE independently-hand-copied ``4096`` queue bounds before
+this PR, and one of them (the proc-fleet fallback) could diverge
+silently.
+
+Rule:
+
+* ``untracked-knob`` — a numeric literal (int/float, bools exempt)
+  bound to an identifier in the registered-knob ``py_names`` set,
+  outside ``tune/``.  Three binding shapes are findings, matching how
+  the five diverged copies actually manifested:
+
+  1. assignment / annotated assignment (``max_wait_s = 0.002``,
+     including attribute targets like ``self.max_wait_s = 0.002``);
+  2. function-parameter defaults (``def __init__(..., max_queue_rows:
+     int = 4096)`` — the main vector: signature defaults are where
+     hand copies hide);
+  3. alias-resolved defaults, like ``handrolled-sharding``'s import
+     aliases: a module constant ``_WAIT = 0.002`` used as a knob-named
+     parameter's default is flagged at the constant's assignment.
+
+  Call *keyword arguments* (``RetentionPolicy(min_seal_batches=1)``)
+  are exempt on purpose: passing an explicit value at a call site is
+  how benches sweep domains and how operators pin an operating point —
+  the rule guards *defaults and constants*, the places a second source
+  of truth takes root.
+
+The registered-name set is read from ``tune/knobs.py`` by AST (the
+engine never imports the package), so the pass stays in lockstep with
+the registry by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..engine import Finding, Pass, attach_node, PKG_NAME
+
+_KNOBS_REL = f"{PKG_NAME}/tune/knobs.py"
+_OWNING_DIR = f"{PKG_NAME}/tune/"
+
+
+def _is_numeric_literal(node) -> bool:
+    """int/float constants (optionally unary-negated); bools are ints
+    to the AST but never a tuned quantity — exempt."""
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        node = node.operand
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, (int, float))
+        and not isinstance(node.value, bool)
+    )
+
+
+def registered_py_names(tree: ast.Module) -> dict[str, str]:
+    """``py_names`` identifier → knob name, extracted from the
+    registry file's ``Knob(...)`` calls without importing it."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Name
+        ) and node.func.id == "Knob"):
+            continue
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        name_node, py_node = kw.get("name"), kw.get("py_names")
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(py_node, (ast.Tuple, ast.List))):
+            continue
+        for el in py_node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out[el.value] = str(name_node.value)
+    return out
+
+
+class KnobsPass(Pass):
+    name = "knobs"
+    rules = ("untracked-knob",)
+
+    def applies_to(self, rel: str) -> bool:
+        if rel.startswith(_OWNING_DIR):
+            return False           # the layer that owns the constants
+        return rel.startswith(PKG_NAME + "/")
+
+    # ------------------------------------------------------------ registry
+    def _py_names(self, project) -> dict[str, str]:
+        cached = project.state.get("knobs")
+        if cached is not None:
+            return cached
+        names: dict[str, str] = {}
+        ctx = project.context(_KNOBS_REL)
+        if ctx is not None:
+            names = registered_py_names(ctx.tree)
+        else:
+            # partial scans (explicit paths, --changed-only) won't have
+            # the registry in the project — read it from disk so the
+            # rule never silently weakens
+            path = os.path.join(project.root, _KNOBS_REL)
+            if os.path.exists(path):
+                with open(path) as f:
+                    names = registered_py_names(ast.parse(f.read()))
+        project.state["knobs"] = names
+        return names
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _target_name(t) -> str | None:
+        if isinstance(t, ast.Name):
+            return t.id
+        if isinstance(t, ast.Attribute):
+            return t.attr
+        return None
+
+    def _module_consts(self, ctx) -> dict[str, ast.AST]:
+        """Module-level ``NAME = <numeric literal>`` assignments — the
+        alias table for shape 3."""
+        consts: dict[str, ast.AST] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_numeric_literal(node.value):
+                consts[node.targets[0].id] = node
+        return consts
+
+    def _finding(self, ctx, node, ident: str, knob_name: str, how: str):
+        f = Finding(
+            rule="untracked-knob",
+            path=ctx.rel, line=node.lineno, col=node.col_offset,
+            message=(
+                f"numeric literal {how} {ident!r} — this constant is "
+                f"owned by the knob registry ({knob_name}); resolve "
+                f'through tune.knob("{knob_name}") (None-default '
+                "sentinel at call sites) so sweeps, live retuning and "
+                "the explain() audit trail see every copy"
+            ),
+            symbol=ctx.symbol_at(node),
+        )
+        return attach_node(f, node)
+
+    # ------------------------------------------------------------- check
+    def check_file(self, ctx, project):
+        names = self._py_names(project)
+        if not names:
+            return
+        # shape 1: (annotated) assignments, incl. attribute targets
+        for node in ctx.nodes(ast.Assign, ast.AnnAssign):
+            value = node.value
+            if value is None or not _is_numeric_literal(value):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                ident = self._target_name(t)
+                if ident in names:
+                    yield self._finding(
+                        ctx, node, ident, names[ident], "assigned to"
+                    )
+        # shapes 2+3: parameter defaults, alias-resolved
+        consts = None
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            a = fn.args
+            pos = a.posonlyargs + a.args
+            pairs = list(zip(pos[len(pos) - len(a.defaults):], a.defaults))
+            pairs += [
+                (arg, d) for arg, d in zip(a.kwonlyargs, a.kw_defaults)
+                if d is not None
+            ]
+            for arg, default_node in pairs:
+                if arg.arg not in names:
+                    continue
+                if _is_numeric_literal(default_node):
+                    yield self._finding(
+                        ctx, default_node, arg.arg, names[arg.arg],
+                        "as parameter default for",
+                    )
+                elif isinstance(default_node, ast.Name):
+                    if consts is None:
+                        consts = self._module_consts(ctx)
+                    alias = consts.get(default_node.id)
+                    if alias is not None:
+                        yield self._finding(
+                            ctx, alias, arg.arg, names[arg.arg],
+                            f"aliased via {default_node.id!r} into "
+                            "parameter default for",
+                        )

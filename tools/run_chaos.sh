@@ -60,7 +60,12 @@
 # rows — ENOSPC injected at stream.after_sink / table.seal.commit /
 # fit_ckpt.save.arrays degrades without an unhandled exception, and a
 # table-level disk budget backpressures ingest into a `disk:budget`
-# quarantine while committed reads keep serving).
+# quarantine while committed reads keep serving), and the autotuner
+# (ISSUE 20: tests/test_autotune.py kills the trial-store commit at
+# tune.store.commit — the replayed add merges by content hash to a
+# byte-identical store, exactly-once — and the live retune between
+# journal intent and apply at tune.select.apply — the previous value
+# keeps serving and the uncommitted intent is ignored on resume).
 #
 # ISSUE 10: every InjectedCrash dumps the observability flight recorder
 # (bounded event ring + metrics snapshot, CRC32C-wrapped, atomic write).
@@ -129,7 +134,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_quality.py \
     tests/test_lifecycle.py tests/test_model_farm.py tests/test_fleet.py \
     tests/test_fleet_proc.py \
     tests/test_sql_views.py tests/test_federated.py \
-    tests/test_table_lifecycle.py \
+    tests/test_table_lifecycle.py tests/test_autotune.py \
     -m "$MARK" \
     -q -rA -p no:cacheprovider -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
@@ -144,7 +149,7 @@ from collections import defaultdict
 tally = defaultdict(lambda: [0, 0])  # site -> [passed, failed]
 for line in open(sys.argv[1]):
     m = re.match(
-        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet_proc|fleet|sql_views|federated|table_lifecycle)\.py::(\S+)",
+        r"(PASSED|FAILED|ERROR)\s+tests/test_(?:chaos|quality|stream_pipeline|gbt_fused|lifecycle|model_farm|fleet_proc|fleet|sql_views|federated|table_lifecycle|autotune)\.py::(\S+)",
         line,
     )
     if not m:
@@ -211,7 +216,7 @@ for site in sorted(sites):
 import fnmatch
 FAMILIES = ["stream.after_*", "wal.append", "fit_ckpt.*",
             "model_io.save.*", "lifecycle.*", "fed.round.*", "table.*",
-            "fleet.proc.kill"]
+            "fleet.proc.kill", "tune.*"]
 missing = [
     fam for fam in FAMILIES
     if not any(fnmatch.fnmatchcase(s, fam) for s in sites)
